@@ -1,0 +1,23 @@
+"""Dispatcher mirroring apex/multi_tensor_apply/multi_tensor_apply.py:3-30.
+
+The reference feeds a chunk size, an overflow buffer and tensor lists to a
+CUDA op.  Here the "ops" are the pure functions in ``apex_tpu.ops.multi_tensor``
+and chunking is XLA's job, so ``chunk_size`` is accepted and ignored (kept for
+API parity).  Unlike the reference there is no extension to fail to import, so
+``available`` is always True; the flag is kept because downstream code in the
+reference checks it (e.g. apex/amp/scaler.py) and users may too.
+"""
+
+
+class MultiTensorApply:
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag_buffer, tensor_lists, *args, **kwargs):
+        return op(noop_flag_buffer, tensor_lists, *args, **kwargs)
+
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)

@@ -1,0 +1,22 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual devices so mesh/collective code paths are
+exercised without TPU hardware (SURVEY.md §4: the reference tests distributed
+behavior single-node with --nproc_per_node=2; our analogue is an 8-device
+virtual mesh).  Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

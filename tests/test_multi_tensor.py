@@ -1,0 +1,138 @@
+"""L0-style fused-vs-reference tests for the multi_tensor suite.
+
+Mirrors tests/L0/run_amp/test_multi_tensor_scale.py / _axpby / _l2norm in the
+reference: dtype cross-products, numerics vs an unfused numpy oracle, and
+overflow-flag behavior (inf/nan anywhere sets the flag).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu import ops
+
+DTYPES = [jnp.float16, jnp.bfloat16, jnp.float32]
+
+
+def _lists(rng, dtype, shapes=((17,), (64, 33), (5, 7, 9))):
+    return [jnp.asarray(rng.standard_normal(s), dtype) for s in shapes]
+
+
+@pytest.mark.parametrize("in_dtype", DTYPES)
+@pytest.mark.parametrize("out_dtype", DTYPES)
+def test_scale_cross_product(rng, in_dtype, out_dtype):
+    ins = _lists(rng, in_dtype)
+    outs = [jnp.zeros_like(x, dtype=out_dtype) for x in ins]
+    flag, got = multi_tensor_applier(
+        ops.multi_tensor_scale, ops.zero_flag(), [ins, outs], 0.5)
+    assert int(flag) == 0
+    for x, y in zip(ins, got):
+        assert y.dtype == out_dtype
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32),
+            np.asarray(x, np.float32) * 0.5, rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("bad", [np.inf, -np.inf, np.nan])
+@pytest.mark.parametrize("which_tensor", [0, 2])
+def test_scale_overflow_flag(rng, bad, which_tensor):
+    ins = _lists(rng, jnp.float32)
+    ins[which_tensor] = ins[which_tensor].at[0].set(bad)
+    outs = [jnp.zeros_like(x) for x in ins]
+    flag, _ = ops.multi_tensor_scale(ops.zero_flag(), [ins, outs], 1.0)
+    assert int(flag) == 1
+
+
+def test_axpby(rng):
+    xs = _lists(rng, jnp.float32)
+    ys = _lists(rng, jnp.float32)
+    outs = [jnp.zeros_like(x) for x in xs]
+    flag, got = ops.multi_tensor_axpby(ops.zero_flag(), [xs, ys, outs], 2.0, -3.0)
+    assert int(flag) == 0
+    for x, y, o in zip(xs, ys, got):
+        np.testing.assert_allclose(
+            np.asarray(o), 2.0 * np.asarray(x) - 3.0 * np.asarray(y), rtol=1e-6)
+
+
+@pytest.mark.parametrize("arg_to_check,expect", [(0, 1), (1, 0), (-1, 1)])
+def test_axpby_checks_selected_arg(rng, arg_to_check, expect):
+    # inf planted in x only; flag fires iff x is checked
+    xs = _lists(rng, jnp.float32)
+    xs[0] = xs[0].at[3].set(np.inf)
+    ys = _lists(rng, jnp.float32)
+    outs = [jnp.zeros_like(x) for x in xs]
+    flag, _ = ops.multi_tensor_axpby(
+        ops.zero_flag(), [xs, ys, outs], 1.0, 1.0, arg_to_check)
+    assert int(flag) == expect
+
+
+def test_l2norm(rng):
+    xs = _lists(rng, jnp.float32)
+    _, total, per = ops.multi_tensor_l2norm(ops.zero_flag(), [xs], per_tensor=True)
+    ref_per = np.array([np.linalg.norm(np.asarray(x).ravel()) for x in xs])
+    ref_total = np.sqrt((ref_per ** 2).sum())
+    np.testing.assert_allclose(float(total), ref_total, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(per), ref_per, rtol=1e-5)
+
+
+def test_l2norm_fp16_storage_fp32_math(rng):
+    xs = _lists(rng, jnp.float16)
+    _, total, _ = ops.multi_tensor_l2norm(ops.zero_flag(), [xs])
+    assert total.dtype == jnp.float32
+
+
+def test_maxnorm(rng):
+    xs = _lists(rng, jnp.float32)
+    _, total, per = ops.multi_tensor_maxnorm(ops.zero_flag(), [xs], per_tensor=True)
+    ref = [np.abs(np.asarray(x)).max() for x in xs]
+    np.testing.assert_allclose(float(total), max(ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(per), ref, rtol=1e-6)
+
+
+def test_maxnorm_empty_list():
+    _, total, per = ops.multi_tensor_maxnorm(ops.zero_flag(), [[]], per_tensor=True)
+    assert float(total) == 0.0 and per.shape == (0,)
+
+
+def test_sgd_skips_when_flag_set(rng):
+    """multi_tensor_sgd honors an already-set noop flag: params untouched
+    (reference early-exit, multi_tensor_sgd_kernel.cu:46)."""
+    gs = _lists(rng, jnp.float32)
+    ps = _lists(rng, jnp.float32)
+    ms = [jnp.zeros_like(p) for p in ps]
+    set_flag = jnp.ones((), jnp.int32)
+    _, new_ps, new_ms = ops.multi_tensor_sgd(
+        set_flag, [gs, ps, ms], 0.0, 0.9, 0.0, 0.1, False, True, False)
+    for p, np_ in zip(ps, new_ps):
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(np_))
+    # and runs normally with a clean flag
+    _, new_ps2, _ = ops.multi_tensor_sgd(
+        ops.zero_flag(), [gs, ps, ms], 0.0, 0.9, 0.0, 0.1, False, True, False)
+    assert any(np.any(np.asarray(a) != np.asarray(b))
+               for a, b in zip(ps, new_ps2))
+
+
+def test_optimizer_ops_propagate_nonfinite(rng):
+    """Adam must NOT write the flag on bad grads (reference propagates,
+    multi_tensor_adam.cu:40-41)."""
+    gs = _lists(rng, jnp.float32)
+    gs[0] = gs[0].at[0].set(np.nan)
+    ps = _lists(rng, jnp.float32)
+    ms = [jnp.zeros_like(p) for p in ps]
+    vs = [jnp.zeros_like(p) for p in ps]
+    flag, new_ps, _, _ = ops.multi_tensor_adam(
+        ops.zero_flag(), [gs, ps, ms, vs], 1e-3, 0.9, 0.999, 1e-8, 1,
+        ops.ADAM_MODE_L2, True, 0.0)
+    assert int(flag) == 0
+    assert np.isnan(np.asarray(new_ps[0])).any()
+
+
+def test_flag_accumulates_across_calls(rng):
+    ins = _lists(rng, jnp.float32)
+    outs = [jnp.zeros_like(x) for x in ins]
+    flag = ops.zero_flag()
+    flag, _ = ops.multi_tensor_scale(flag, [ins, outs], 1.0)
+    bad = [x.at[0].set(np.nan) for x in ins]
+    flag, _ = ops.multi_tensor_scale(flag, [bad, outs], 1.0)
+    flag, _ = ops.multi_tensor_scale(flag, [ins, outs], 1.0)  # clean call keeps it set
+    assert int(flag) == 1
