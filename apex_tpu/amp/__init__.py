@@ -1,0 +1,18 @@
+"""Mixed-precision core (reference: apex/amp/).
+
+Public surface: initialize, scale_loss (added with the training facade),
+state_dict/load_state_dict, master_params, LossScaler, the O1 registry API,
+and the trace-time policy engine.
+"""
+from ._amp_state import _amp_state, master_params, maybe_print  # noqa: F401
+from .frontend import (  # noqa: F401
+    Properties, initialize, load_state_dict, opt_levels, resolve_dtype,
+    set_default_half_dtype, get_default_half_dtype, state_dict)
+from .policy import (  # noqa: F401
+    CastPolicy, apply_op_policy, autocast, current_policy, disable_casts,
+    float_function, half_function, promote_function, register_float_function,
+    register_half_function, register_promote_function)
+from .scaler import (  # noqa: F401
+    LossScaler, ScalerState, init_scaler_state, unscale_grads,
+    unscale_with_stashed_grads, update_scale_state)
+from . import lists  # noqa: F401

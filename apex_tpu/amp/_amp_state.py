@@ -1,0 +1,47 @@
+"""Shared amp session state (reference: apex/amp/_amp_state.py).
+
+A module-level stash through which frontend / handle / initialize communicate.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class AmpState:
+    def __init__(self):
+        self.hard_override = False
+        self.allow_incoming_model_not_fp32 = False
+        self.verbosity = 1
+        # populated by amp.initialize:
+        self.opt_properties = None
+        self.loss_scalers = []
+        self.handle = None
+
+
+_amp_state = AmpState()
+
+
+def warn_or_err(msg):
+    if _amp_state.hard_override:
+        print("Warning:  " + msg)
+    else:
+        raise RuntimeError(msg)
+
+
+def maybe_print(msg, rank0=False):
+    """Verbosity-gated print; rank0 gating via jax.process_index
+    (the reference gates on torch.distributed rank, _amp_state.py:38-50)."""
+    if _amp_state.verbosity > 0:
+        if rank0 and jax.process_count() > 1 and jax.process_index() != 0:
+            return
+        print(msg)
+
+
+def master_params(optimizer):
+    """Iterate the (master) params owned by ``optimizer``
+    (reference: _amp_state.py:59-68).  Used e.g. for gradient clipping:
+    ``clip_grad_norm(amp.master_params(optimizer), max_norm)``.
+    """
+    for group in optimizer.param_groups:
+        for p in group["params"]:
+            yield p

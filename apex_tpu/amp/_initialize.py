@@ -1,0 +1,16 @@
+"""Applies the chosen amp Properties to models/optimizers
+(reference: apex/amp/_initialize.py:145-263).
+
+The full implementation lands with the nn/training facade; until then
+``amp.initialize`` fails loudly here instead of deep in a cast path.
+"""
+from __future__ import annotations
+
+
+def _initialize(models, optimizers, properties, num_losses=1,
+                cast_model_outputs=None):
+    raise NotImplementedError(
+        "amp.initialize requires the apex_tpu.nn model facade, which is "
+        "being added in the next milestone of this build.  The functional "
+        "amp API (apex_tpu.amp.LossScaler, init_scaler_state, unscale_grads, "
+        "update_scale_state, autocast/CastPolicy) is available now.")

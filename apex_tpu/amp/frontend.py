@@ -1,0 +1,297 @@
+"""amp frontend: opt-level presets, ``initialize``, amp checkpoint state.
+
+Reference: apex/amp/frontend.py.  O0–O3 are property bundles; ``initialize``
+validates kwarg overrides against the chosen preset and delegates to
+``_initialize``.  TPU adaptations:
+
+* dtypes are jnp dtypes; ``"float16"``/``"bfloat16"``/``"float32"`` strings and
+  torch dtypes are accepted and resolved.  The presets default to float16 for
+  reference parity; on TPU, bf16 is usually the right choice — pass
+  ``cast_model_type="bfloat16"`` (O2/O3) or call
+  ``amp.set_default_half_dtype("bfloat16")`` before ``initialize``.  With bf16
+  a ``loss_scale=1.0`` static scaler is typically sufficient; dynamic scaling
+  still works and is exercised for parity testing (SURVEY.md §7 hard parts).
+* ``patch_torch_functions`` keeps its name (it now toggles the trace-time cast
+  policy rather than monkey-patching torch).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax.numpy as jnp
+
+from ._amp_state import _amp_state, maybe_print, warn_or_err
+
+_DTYPE_ALIASES = {
+    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+}
+
+_default_half_dtype = jnp.float16
+
+
+def set_default_half_dtype(dtype):
+    """Set what 'half' means for the O1-O3 presets (float16 or bfloat16)."""
+    global _default_half_dtype
+    _default_half_dtype = resolve_dtype(dtype)
+
+
+def get_default_half_dtype():
+    return _default_half_dtype
+
+
+def resolve_dtype(value):
+    """Resolve strings / numpy / jnp / torch dtypes to a jnp dtype."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        try:
+            return _DTYPE_ALIASES[value.lower()]
+        except KeyError:
+            raise ValueError(f"Unknown dtype string {value!r}") from None
+    mod = type(value).__module__
+    if mod.startswith("torch"):  # torch.dtype, without importing torch
+        name = str(value).split(".")[-1]
+        return _DTYPE_ALIASES[name]
+    return jnp.dtype(value).type
+
+
+class Properties:
+    """Default properties + consistency-checked attribute routing
+    (reference: frontend.py:7-97)."""
+
+    def __init__(self):
+        self.options = {
+            "enabled": False,
+            "opt_level": None,
+            "cast_model_type": None,
+            "patch_torch_functions": False,
+            "keep_batchnorm_fp32": None,
+            "master_weights": None,
+            "loss_scale": 1.0,
+        }
+
+    def _update_options_dict(self, new_options):
+        for k, v in new_options:
+            if k in self.options:
+                self.options[k] = v
+            else:
+                raise ValueError(f"Tried to set unexpected option {k}")
+
+    def __getattr__(self, name):
+        if "options" in self.__dict__:
+            options = self.__dict__["options"]
+            if name in options:
+                return options[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __setattr__(self, name, value):
+        if "options" not in self.__dict__:
+            super().__setattr__(name, value)
+            return
+        if name not in self.options:
+            super().__setattr__(name, value)
+            return
+        if name == "cast_model_type":
+            value = resolve_dtype(value) if not isinstance(value, bool) else value
+            if self.opt_level == "O1" and value is not None:
+                if value is not False and value is not jnp.float32:
+                    warn_or_err(
+                        "O1 inserts casts around functions rather than model "
+                        "weights, so with O1, the model weights themselves "
+                        "should remain FP32. If you wish to cast the model to "
+                        "a different type, use opt_level='O2' or 'O3'. "
+                        f"cast_model_type was {value}")
+            self.options[name] = value
+        elif name == "patch_torch_functions":
+            if self.opt_level != "O1" and value:
+                warn_or_err("Currently, patch_torch_functions=True should "
+                            "only be set by selecting opt_level='O1'.")
+            self.options[name] = value
+        elif name == "keep_batchnorm_fp32":
+            if self.opt_level == "O1" and value is not None:
+                warn_or_err(
+                    "With opt_level O1, batchnorm functions are automatically "
+                    "patched to run in FP32, so keep_batchnorm_fp32 should be "
+                    f"None. keep_batchnorm_fp32 was {value}")
+            if value == "False":
+                self.options[name] = False
+            elif value == "True":
+                self.options[name] = True
+            else:
+                assert value in (True, False, None), (
+                    "keep_batchnorm_fp32 must be a boolean, the string 'True' "
+                    f"or 'False', or None, found keep_batchnorm_fp32={value}")
+                self.options[name] = value
+        elif name == "master_weights":
+            if self.opt_level == "O1" and value is not None:
+                warn_or_err("It doesn't make sense to use master_weights with "
+                            "O1. With O1, your model weights themselves should "
+                            "be FP32.")
+            self.options[name] = value
+        elif name == "loss_scale":
+            if value == "dynamic":
+                self.options[name] = value
+            else:
+                self.options[name] = float(value)
+        else:
+            self.options[name] = value
+
+
+class O3:
+    brief = "O3:  Pure half-precision training."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O3"
+        properties.cast_model_type = _default_half_dtype
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = False
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+class O2:
+    brief = ("O2:  Half-precision training with FP32 batchnorm and FP32 "
+             "master weights.")
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O2"
+        properties.cast_model_type = _default_half_dtype
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O1:
+    brief = "O1:  Insert automatic casts around compute functions."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O1"
+        properties.cast_model_type = None
+        properties.patch_torch_functions = True
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = None
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O0:
+    brief = "O0:  Pure FP32 training."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O0"
+        properties.cast_model_type = jnp.float32
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}
+
+
+def initialize(models, optimizers=None, enabled=True, opt_level="O1",
+               cast_model_type=None, patch_torch_functions=None,
+               keep_batchnorm_fp32=None, master_weights=None, loss_scale=None,
+               cast_model_outputs=None, num_losses=1, verbosity=1,
+               min_loss_scale=None, max_loss_scale=2.0 ** 24):
+    """Initialize models and optimizers for mixed-precision training
+    (reference: frontend.py:195-358; same argument surface)."""
+    from ._initialize import _initialize
+
+    _amp_state.opt_properties = Properties()
+    _amp_state.verbosity = verbosity
+
+    if not enabled:
+        handle = None
+        _amp_state.handle = handle
+        if optimizers is None:
+            return models
+        return models, optimizers
+
+    if opt_level not in opt_levels:
+        raise RuntimeError(
+            f"Unexpected optimization level {opt_level}. Options are 'O0', "
+            "'O1', 'O2', 'O3'.  Note that in `O0`, `O1`, etc., the prefix O "
+            "is the letter O, not the number zero.")
+
+    _amp_state.opt_properties = opt_levels[opt_level](_amp_state.opt_properties)
+    maybe_print(f"Selected optimization level {opt_levels[opt_level].brief}",
+                True)
+    maybe_print("Defaults for this optimization level are:", True)
+    for k, v in _amp_state.opt_properties.options.items():
+        maybe_print(f"{k:22} : {v}", True)
+
+    _amp_state.min_loss_scale = min_loss_scale
+    _amp_state.max_loss_scale = max_loss_scale
+
+    maybe_print("Processing user overrides (additional kwargs that are not "
+                "None)...", True)
+    for name, value in (("enabled", enabled),
+                        ("cast_model_type", cast_model_type),
+                        ("patch_torch_functions", patch_torch_functions),
+                        ("keep_batchnorm_fp32", keep_batchnorm_fp32),
+                        ("master_weights", master_weights),
+                        ("loss_scale", loss_scale)):
+        if value is not None:
+            setattr(_amp_state.opt_properties, name, value)
+
+    maybe_print("After processing overrides, optimization options are:", True)
+    for k, v in _amp_state.opt_properties.options.items():
+        maybe_print(f"{k:22} : {v}", True)
+
+    return _initialize(models, optimizers, _amp_state.opt_properties,
+                       num_losses, cast_model_outputs)
+
+
+def state_dict(destination=None):
+    """amp checkpoint state: per-loss-scaler scale + unskipped counter
+    (reference: frontend.py:361-370)."""
+    if destination is None:
+        destination = OrderedDict()
+    for idx, loss_scaler in enumerate(_amp_state.loss_scalers):
+        destination[f"loss_scaler{idx}"] = {
+            "loss_scale": loss_scaler.loss_scale(),
+            "unskipped": loss_scaler._unskipped,
+        }
+    return destination
+
+
+def load_state_dict(state_dict):
+    """Reference: frontend.py:373-400 (same warnings/errors)."""
+    if len(state_dict) != len(_amp_state.loss_scalers):
+        print(f"Warning: state_dict contains {len(state_dict)} entries, while "
+              f"{len(_amp_state.loss_scalers)} loss_scalers are used")
+
+    state_dict = state_dict.copy()
+    nb_loss_scalers = len(_amp_state.loss_scalers)
+    unexpected_keys = []
+    idx = 0
+    for key in state_dict:
+        if "loss_scaler" not in key:
+            unexpected_keys.append(key)
+        else:
+            if idx > (nb_loss_scalers - 1):
+                print(f"Skipping loss_scaler[{idx}], since num_losses was set "
+                      f"to {nb_loss_scalers}")
+                break
+            _amp_state.loss_scalers[idx]._loss_scale = \
+                state_dict[key]["loss_scale"]
+            _amp_state.loss_scalers[idx]._unskipped = \
+                state_dict[key]["unskipped"]
+            idx += 1
+
+    if unexpected_keys:
+        raise RuntimeError(
+            "Error(s) in loading state_dict. Unexpected key(s) in state_dict: "
+            + ", ".join(f'"{k}"' for k in unexpected_keys) + ". ")

@@ -1,0 +1,3 @@
+from . import op_categories  # noqa: F401
+from .op_categories import (  # noqa: F401
+    BANNED_FUNCS, CASTS, FP16_FUNCS, FP32_FUNCS, SEQUENCE_CASTS)

@@ -1,0 +1,69 @@
+"""Casting-policy tables for the O1 trace-time policy, in terms of
+``apex_tpu.nn.functional`` op names.
+
+Mirrors the reference tables (apex/amp/lists/functional_overrides.py and
+torch_overrides.py) translated to this framework's op vocabulary:
+
+* WIDEN-to-half (MXU-friendly): convolutions and matmul-shaped ops — the
+  reference's FP16_FUNCS (functional_overrides.py:18-27,
+  torch_overrides.py:7-27).
+* Keep-float (stability): softmax/normalization/losses, transcendental
+  pointwise ops and reductions — FP32_FUNCS (functional_overrides.py:29-68,
+  torch_overrides.py:29-61).
+* PROMOTE: multi-arg ops cast to the widest input type — CASTS
+  (torch_overrides.py:86-108).
+* SEQUENCE_CASTS: cat/stack (torch_overrides.py:112-115).
+* BANNED: binary_cross_entropy (functional_overrides.py:70-80) — raises under
+  O1 unless allow_banned.
+
+There is no CUDA-version-dependent bmm placement: the MXU handles batched
+matmul in half natively, so ``bmm`` is always on the half list.
+"""
+
+FP16_FUNCS = [
+    "conv1d", "conv2d", "conv3d",
+    "conv_transpose1d", "conv_transpose2d", "conv_transpose3d",
+    "linear", "matmul", "mm", "bmm", "addmm", "einsum", "dot_general",
+    "prelu",
+]
+
+FP32_FUNCS = [
+    # pointwise transcendentals
+    "softplus", "softmin", "log_softmax", "softmax", "gelu",
+    "acos", "asin", "cosh", "erfinv", "exp", "expm1",
+    "log", "log10", "log2", "reciprocal", "rsqrt", "sinh", "tan", "pow",
+    # normalization
+    "layer_norm", "group_norm", "batch_norm", "local_response_norm",
+    "normalize", "cosine_similarity",
+    # losses
+    "cross_entropy", "nll_loss", "l1_loss", "mse_loss", "smooth_l1_loss",
+    "kl_div", "poisson_nll_loss", "cosine_embedding_loss",
+    "hinge_embedding_loss", "margin_ranking_loss", "multilabel_margin_loss",
+    "multilabel_soft_margin_loss", "multi_margin_loss",
+    "binary_cross_entropy_with_logits", "soft_margin_loss",
+    "triplet_margin_loss", "ctc_loss",
+    # reductions
+    "cumprod", "cumsum", "dist", "norm", "prod", "std", "sum", "var",
+    "renorm",
+]
+
+CASTS = [
+    "addcdiv", "addcmul", "atan2", "cross", "bilinear", "dot",
+    "add", "div", "mul",
+    "eq", "equal", "ge", "gt", "le", "lt", "ne",
+]
+
+SEQUENCE_CASTS = ["cat", "stack", "concatenate"]
+
+BANNED_FUNCS = [
+    ("binary_cross_entropy",
+     ("\namp does not work out-of-the-box with `binary_cross_entropy`. "
+      "It requires that the output of the previous function be already a "
+      "float tensor. \n\nMost models have a Sigmoid right before BCELoss. "
+      "In that case, you can use\n    binary_cross_entropy_with_logits\nto "
+      "combine Sigmoid+BCELoss into a single layer that is compatible with "
+      "amp.\nAnother option is to add\n    amp.register_float_function(...)\n"
+      "before calling `amp.init()`.\nIf you _really_ know what you are "
+      "doing, you can disable this error by passing allow_banned=True to "
+      "`amp.init()`.")),
+]

@@ -1,0 +1,239 @@
+"""O1 autocast as a *trace-time* dtype policy.
+
+The reference implements O1 by monkey-patching torch namespaces with cast
+wrappers (apex/amp/amp.py:68-177, wrap.py).  Under JAX there is no eager
+dispatch to intercept: every op in the model runs during a single trace.  The
+idiomatic equivalent — producing the same observable dtype behavior — is a
+policy object consulted by every ``apex_tpu.nn.functional`` op while tracing:
+
+* ops on the half list (convs, matmuls → MXU) cast float args to the policy's
+  half dtype (reference whitelist, amp.py:90-95);
+* ops on the float list (softmax/norms/losses/transcendentals) cast float args
+  to fp32 (blacklist, amp.py:96-101);
+* promote ops cast all float args to the widest participating float type
+  (wrap.py:65-90), sequence ops likewise over their element list;
+* banned ops raise (amp.py:164-171) unless ``allow_banned``.
+
+The user registry API (``register_half_function`` etc., amp.py:30-64) is kept:
+it wraps functions on arbitrary Python modules with cast wrappers driven by
+the active policy.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import lists
+from ._amp_state import maybe_print
+
+_FLOAT_DTYPES = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
+
+
+def _is_float_array(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _cast_tree(tree, dtype):
+    def cast(x):
+        if hasattr(x, "dtype") and _is_float_array(x) and x.dtype != dtype:
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(cast, tree)
+
+
+_WIDTH = {jnp.dtype(jnp.float16): 0, jnp.dtype(jnp.bfloat16): 0,
+          jnp.dtype(jnp.float32): 1, jnp.dtype(jnp.float64): 2}
+
+
+def widest_float_dtype(tree):
+    """The widest participating float dtype (wrap.py:65-78's promotion rule:
+    fp16 collections stay fp16, anything mixed promotes to fp32)."""
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if _is_float_array(x)]
+    if not leaves:
+        return None
+    dtypes = {jnp.dtype(x.dtype) for x in leaves}
+    if len(dtypes) == 1:
+        return next(iter(dtypes)).type
+    width = max(_WIDTH.get(d, 1) for d in dtypes)
+    if width == 0:  # mixed half types (fp16 + bf16): promote to fp32
+        return jnp.float32
+    return jnp.float64 if width == 2 else jnp.float32
+
+
+class CastPolicy:
+    """The active-cast configuration for one amp session."""
+
+    def __init__(self, half_dtype=jnp.float16, enabled: bool = True,
+                 allow_banned: bool = False, verbose: bool = False):
+        self.half_dtype = jnp.dtype(half_dtype).type
+        self.enabled = enabled
+        self.allow_banned = allow_banned
+        self.verbose = verbose
+        self.user_half = set()
+        self.user_float = set()
+        self.user_promote = set()
+
+    # -- category lookup ---------------------------------------------------
+    def category_of(self, op_name: str) -> Optional[str]:
+        if op_name in self.user_half:
+            return "half"
+        if op_name in self.user_float:
+            return "float"
+        if op_name in self.user_promote:
+            return "promote"
+        for name, _msg in lists.BANNED_FUNCS:
+            if op_name == name:
+                return "banned"
+        if op_name in lists.FP16_FUNCS:
+            return "half"
+        if op_name in lists.FP32_FUNCS:
+            return "float"
+        if op_name in lists.CASTS:
+            return "promote"
+        if op_name in lists.SEQUENCE_CASTS:
+            return "sequence"
+        return None
+
+    # -- the cast itself ---------------------------------------------------
+    def cast_args(self, op_name: str, args, kwargs=None):
+        """Apply this policy's cast for ``op_name`` to (args, kwargs)."""
+        kwargs = {} if kwargs is None else kwargs
+        cat = self.category_of(op_name)
+        if cat is None:
+            return args, kwargs
+        if cat == "banned":
+            if not self.allow_banned:
+                msg = dict(lists.BANNED_FUNCS)[op_name]
+                raise NotImplementedError(msg)
+            return args, kwargs
+        if cat == "half":
+            dtype = self.half_dtype
+        elif cat == "float":
+            dtype = jnp.float32
+        else:  # promote / sequence
+            dtype = widest_float_dtype((args, kwargs))
+            if dtype is None:
+                return args, kwargs
+        if self.verbose:
+            maybe_print(f"amp: casting args of {op_name} to "
+                        f"{jnp.dtype(dtype).name}")
+        return _cast_tree(args, dtype), _cast_tree(kwargs, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Active-policy stack
+# ---------------------------------------------------------------------------
+
+_policy_stack: list = []
+
+
+def current_policy() -> Optional[CastPolicy]:
+    """The innermost active policy, or None when casts are disabled."""
+    return _policy_stack[-1] if _policy_stack else None
+
+
+@contextlib.contextmanager
+def autocast(policy: Optional[CastPolicy]):
+    """Activate ``policy`` for the duration (used by amp-initialized model
+    forwards and user code).  ``autocast(None)`` == the reference handle's
+    ``disable_casts`` (handle.py:163-167)."""
+    _policy_stack.append(policy)
+    try:
+        yield policy
+    finally:
+        _policy_stack.pop()
+
+
+disable_casts = functools.partial(autocast, None)
+
+
+def apply_op_policy(op_name: str, args, kwargs=None):
+    """Hook called by apex_tpu.nn.functional ops: cast per the active policy."""
+    pol = current_policy()
+    if pol is None or not pol.enabled:
+        return args, ({} if kwargs is None else kwargs)
+    return pol.cast_args(op_name, args, kwargs)
+
+
+# ---------------------------------------------------------------------------
+# User registry / decorator API (reference amp.py:30-64)
+# ---------------------------------------------------------------------------
+
+def _wrapped(fn, op_name: str):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        args, kwargs = apply_op_policy(op_name, args, kwargs)
+        return fn(*args, **kwargs)
+    wrapper._amp_registered = op_name
+    return wrapper
+
+
+def _register(user_set_name: str, module, name: str):
+    for pol in _policy_stack:
+        if pol is not None:
+            getattr(pol, user_set_name).add(name)
+    _pending_registrations.append((user_set_name, name))
+    setattr(module, name, _wrapped(getattr(module, name), name))
+
+
+# registrations made before amp.initialize() creates the session policy are
+# replayed onto it (the reference requires registration before amp.init too,
+# amp.py:30-42)
+_pending_registrations: list = []
+
+
+def replay_registrations(policy: CastPolicy):
+    for user_set_name, name in _pending_registrations:
+        getattr(policy, user_set_name).add(name)
+
+
+def register_half_function(module, name):
+    _register("user_half", module, name)
+
+
+def register_float_function(module, name):
+    _register("user_float", module, name)
+
+
+def register_promote_function(module, name):
+    _register("user_promote", module, name)
+
+
+def half_function(fn):
+    """Decorator: run ``fn`` with float args cast to the policy half dtype."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        pol = current_policy()
+        if pol is not None and pol.enabled:
+            args = _cast_tree(args, pol.half_dtype)
+            kwargs = _cast_tree(kwargs, pol.half_dtype)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+def float_function(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        pol = current_policy()
+        if pol is not None and pol.enabled:
+            args = _cast_tree(args, jnp.float32)
+            kwargs = _cast_tree(kwargs, jnp.float32)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+def promote_function(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        pol = current_policy()
+        if pol is not None and pol.enabled:
+            dtype = widest_float_dtype((args, kwargs))
+            if dtype is not None:
+                args = _cast_tree(args, dtype)
+                kwargs = _cast_tree(kwargs, dtype)
+        return fn(*args, **kwargs)
+    return wrapper

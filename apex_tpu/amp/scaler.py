@@ -1,0 +1,199 @@
+"""Loss scaling (reference: apex/amp/scaler.py).
+
+Two layers:
+
+* a **functional core** (`ScalerState`, `update_scale_state`, `unscale_grads`)
+  that lives entirely on device so a whole train step — unscale, overflow
+  check, conditional skip, scale update — compiles into one XLA program with
+  **zero** host round-trips (the reference pays one D2H sync per step,
+  scaler.py:197-200; we only sync when the user *asks* for the scale);
+* a **stateful `LossScaler`** with the reference's exact API and dynamics:
+  dynamic scaling starts at ``min(max_loss_scale, 2**16)``, halves on
+  overflow (clamped to ``min_loss_scale``), and doubles after
+  ``scale_window=2000`` consecutive clean steps, clamped to
+  ``max_loss_scale=2**24`` (scaler.py:38-56,197-217).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..multi_tensor_apply import multi_tensor_applier
+from .. import ops
+
+_f32 = jnp.float32
+
+
+class ScalerState(NamedTuple):
+    """On-device dynamic-loss-scale state."""
+    loss_scale: jax.Array   # f32 scalar
+    unskipped: jax.Array    # i32 scalar — clean steps since last change
+    overflow: jax.Array     # i32 scalar — this step's noop flag
+
+
+def init_scaler_state(loss_scale, init_scale=2.0 ** 16,
+                      max_loss_scale=2.0 ** 24) -> ScalerState:
+    if loss_scale == "dynamic":
+        scale = min(max_loss_scale, init_scale)
+    else:
+        scale = float(loss_scale)
+    return ScalerState(jnp.asarray(scale, _f32), jnp.zeros((), jnp.int32),
+                       jnp.zeros((), jnp.int32))
+
+
+def update_scale_state(state: ScalerState, *, dynamic: bool,
+                       scale_factor: float = 2.0,
+                       scale_window: int = 2000,
+                       min_loss_scale: Optional[float] = None,
+                       max_loss_scale: float = 2.0 ** 24):
+    """Pure version of LossScaler.update_scale (scaler.py:197-215).
+
+    Returns (new_state, should_skip).  ``should_skip`` is a device bool —
+    feed it to ``jnp.where``/``lax.cond`` to skip the optimizer step without
+    leaving the compiled program (the reference instead monkey-patches
+    ``optimizer.step``, handle.py:128-154; observable effect is identical).
+    """
+    overflow = state.overflow > 0
+    if not dynamic:
+        # static scale: never skips, never changes (reference: _has_overflow
+        # is only ever read for dynamic scalers)
+        new_unskipped = state.unskipped + 1
+        return ScalerState(state.loss_scale, new_unskipped,
+                           jnp.zeros((), jnp.int32)), jnp.zeros((), jnp.bool_)
+
+    halved = state.loss_scale / scale_factor
+    if min_loss_scale is not None:
+        halved = jnp.maximum(jnp.asarray(min_loss_scale, _f32), halved)
+    scale = jnp.where(overflow, halved, state.loss_scale)
+    unskipped = jnp.where(overflow, 0, state.unskipped + 1)
+
+    grow = unskipped == scale_window
+    scale = jnp.where(grow,
+                      jnp.minimum(jnp.asarray(max_loss_scale, _f32),
+                                  scale * scale_factor), scale)
+    unskipped = jnp.where(grow, 0, unskipped)
+    return ScalerState(scale, unskipped, jnp.zeros((), jnp.int32)), overflow
+
+
+def unscale_grads(state: ScalerState, model_grads: Sequence[jax.Array],
+                  master_dtypes=None, check_overflow: bool = True,
+                  scale_override=None):
+    """master_grad = model_grad / loss_scale, flagging non-finites.
+
+    Functional analogue of LossScaler.unscale (scaler.py:76-124): uses
+    multi_tensor_scale with 1/scale.  Returns (new_state, master_grads).
+    """
+    scale = state.loss_scale if scale_override is None \
+        else jnp.asarray(scale_override, _f32)
+    inv = 1.0 / scale
+    outs = [g if master_dtypes is None else jnp.zeros(g.shape, master_dtypes[i])
+            for i, g in enumerate(model_grads)]
+    flag, masters = multi_tensor_applier(
+        ops.multi_tensor_scale, state.overflow, [list(model_grads), outs], inv)
+    if not check_overflow:
+        flag = state.overflow
+    return ScalerState(state.loss_scale, state.unskipped, flag), masters
+
+
+def unscale_with_stashed_grads(state: ScalerState, model_grads, stashed_grads,
+                               scale_override=None):
+    """Grad accumulation across backward passes: out = (1/scale)*new + 1*stashed
+    via the fused axpby (reference scaler.py:152-189).  Returns
+    (new_state, master_grads)."""
+    out_scale = 1.0
+    if scale_override is not None:
+        # (grads_have_scale, stashed_have_scale, out_scale) triple, as in
+        # scaler.py:160-165
+        grads_have_scale, stashed_have_scale, out_scale = scale_override
+    else:
+        grads_have_scale, stashed_have_scale = state.loss_scale, 1.0
+    outs = [jnp.zeros_like(s) for s in stashed_grads]
+    flag, masters = multi_tensor_applier(
+        ops.multi_tensor_axpby, state.overflow,
+        [list(model_grads), list(stashed_grads), outs],
+        out_scale / grads_have_scale, out_scale / stashed_have_scale, 0)
+    return ScalerState(state.loss_scale, state.unskipped, flag), masters
+
+
+class LossScaler:
+    """Stateful facade with the reference's API (apex/amp/scaler.py:33).
+
+    Holds a `ScalerState` of device arrays; `loss_scale()` performs the one
+    host readback (only when called — e.g. for printing or `amp.state_dict`).
+    """
+    warned_no_fused_kernel = False
+    warned_unscaling_non_fp32_grad = False
+    has_fused_kernel = True
+
+    def __init__(self, loss_scale, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, min_loss_scale=None,
+                 max_loss_scale=2.0 ** 24):
+        self.dynamic = loss_scale == "dynamic"
+        self._state = init_scaler_state(loss_scale, init_scale, max_loss_scale)
+        self._max_loss_scale = max_loss_scale
+        self._min_loss_scale = min_loss_scale
+        self._scale_seq_len = scale_window
+        self._scale_factor = scale_factor
+
+    # -- state plumbing ----------------------------------------------------
+    @property
+    def state(self) -> ScalerState:
+        return self._state
+
+    @state.setter
+    def state(self, s: ScalerState):
+        self._state = s
+
+    # reference-compat accessors (frontend.state_dict reads these)
+    def loss_scale(self):
+        return float(self._state.loss_scale)
+
+    @property
+    def _unskipped(self):
+        return int(self._state.unskipped)
+
+    @_unskipped.setter
+    def _unskipped(self, v):
+        self._state = self._state._replace(unskipped=jnp.asarray(v, jnp.int32))
+
+    @property
+    def _loss_scale(self):
+        return float(self._state.loss_scale)
+
+    @_loss_scale.setter
+    def _loss_scale(self, v):
+        self._state = self._state._replace(loss_scale=jnp.asarray(v, _f32))
+
+    # -- reference API -----------------------------------------------------
+    def clear_overflow_state(self):
+        self._state = self._state._replace(overflow=jnp.zeros((), jnp.int32))
+
+    def unscale(self, model_grads, master_grads, unused_scale=None,
+                models_are_masters=False, scale_override=None):
+        """Returns the new master grads (functional; callers rebind)."""
+        self._state, masters = unscale_grads(
+            self._state, list(model_grads),
+            master_dtypes=[m.dtype for m in master_grads],
+            scale_override=scale_override)
+        return masters
+
+    def unscale_with_stashed(self, model_grads, stashed_master_grads,
+                             master_grads, scale_override=None):
+        self._state, masters = unscale_with_stashed_grads(
+            self._state, model_grads, stashed_master_grads, scale_override)
+        return masters
+
+    def update_scale(self):
+        """One host sync, as in the reference (scaler.py:197-200): returns a
+        Python bool ``should_skip``."""
+        new_state, should_skip = update_scale_state(
+            self._state, dynamic=self.dynamic,
+            scale_factor=self._scale_factor,
+            scale_window=self._scale_seq_len,
+            min_loss_scale=self._min_loss_scale,
+            max_loss_scale=self._max_loss_scale)
+        skip = bool(should_skip)
+        self._state = new_state
+        return skip

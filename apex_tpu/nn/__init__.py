@@ -1,0 +1,1 @@
+from .parameter import Parameter, is_param, param_grads, param_values  # noqa: F401

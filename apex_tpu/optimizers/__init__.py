@@ -1,0 +1,5 @@
+from .base import Optimizer, required, split_by_dtype  # noqa: F401
+from .fused_adam import FusedAdam  # noqa: F401
+from .fused_lamb import FusedLAMB  # noqa: F401
+from .fused_novograd import FusedNovoGrad  # noqa: F401
+from .fused_sgd import FusedSGD  # noqa: F401

@@ -1,0 +1,128 @@
+"""Optimizer base class with torch.optim semantics (param_groups / state /
+zero_grad / add_param_group / state_dict), holding apex_tpu.nn.Parameter
+handles whose ``.data``/``.grad`` are jax Arrays.
+
+The reference optimizers subclass torch.optim.Optimizer; this provides the
+same observable surface so the amp layer (`_process_optimizer`) can patch
+instances the way apex does (reference: apex/amp/_process_optimizer.py:321).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List
+
+import jax.numpy as jnp
+
+from ..nn.parameter import Parameter
+
+required = object()  # sentinel, as torch.optim.optimizer.required
+
+
+class Optimizer:
+    def __init__(self, params, defaults: Dict[str, Any]):
+        self.defaults = defaults
+        self.state: Dict[Parameter, Dict[str, Any]] = defaultdict(dict)
+        self.param_groups: List[Dict[str, Any]] = []
+
+        param_groups = list(params)
+        if len(param_groups) == 0:
+            raise ValueError("optimizer got an empty parameter list")
+        if not isinstance(param_groups[0], dict):
+            param_groups = [{"params": param_groups}]
+        for group in param_groups:
+            self.add_param_group(group)
+
+    def add_param_group(self, param_group: Dict[str, Any]):
+        assert isinstance(param_group, dict), "param group must be a dict"
+        params = param_group["params"]
+        if isinstance(params, Parameter):
+            param_group["params"] = [params]
+        else:
+            param_group["params"] = list(params)
+        for p in param_group["params"]:
+            if not isinstance(p, Parameter):
+                raise TypeError(
+                    f"optimizer can only optimize Parameters, got {type(p)}")
+        for name, default in self.defaults.items():
+            if default is required and name not in param_group:
+                raise ValueError(
+                    f"parameter group didn't specify a value of required "
+                    f"optimization parameter {name}")
+            param_group.setdefault(name, default)
+
+        seen = set()
+        for group in self.param_groups:
+            seen.update(id(p) for p in group["params"])
+        if any(id(p) in seen for p in param_group["params"]):
+            raise ValueError("some parameters appear in more than one "
+                             "parameter group")
+        self.param_groups.append(param_group)
+
+    def zero_grad(self, set_to_none: bool = False):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if set_to_none:
+                    p.grad = None
+                elif p.grad is not None:
+                    p.grad = jnp.zeros_like(p.grad)
+
+    # -- checkpointing (torch-compatible structure) ------------------------
+    def _all_params(self) -> List[Parameter]:
+        return [p for g in self.param_groups for p in g["params"]]
+
+    def state_dict(self) -> Dict[str, Any]:
+        param_mappings: Dict[int, int] = {}
+        start = 0
+        packed_groups = []
+        for group in self.param_groups:
+            packed = {k: v for k, v in group.items() if k != "params"}
+            param_mappings.update(
+                {id(p): i + start for i, p in enumerate(group["params"])})
+            packed["params"] = [param_mappings[id(p)] for p in group["params"]]
+            start += len(group["params"])
+            packed_groups.append(packed)
+        packed_state = {param_mappings[id(p)]: v for p, v in self.state.items()
+                        if isinstance(p, Parameter)}
+        return {"state": packed_state, "param_groups": packed_groups}
+
+    def load_state_dict(self, state_dict: Dict[str, Any]):
+        groups = self.param_groups
+        saved_groups = state_dict["param_groups"]
+        if len(groups) != len(saved_groups):
+            raise ValueError("loaded state dict has a different number of "
+                             "parameter groups")
+        idx_to_param = {}
+        start = 0
+        for group, saved in zip(groups, saved_groups):
+            if len(group["params"]) != len(saved["params"]):
+                raise ValueError("loaded state dict contains a parameter "
+                                 "group that doesn't match the size of "
+                                 "optimizer's group")
+            for i, p in enumerate(group["params"]):
+                idx_to_param[saved["params"][i]] = p
+            start += len(group["params"])
+            for k, v in saved.items():
+                if k != "params":
+                    group[k] = v
+        self.state = defaultdict(dict)
+        for idx, s in state_dict["state"].items():
+            self.state[idx_to_param[idx]] = {
+                k: (jnp.asarray(v) if hasattr(v, "shape") else v)
+                for k, v in s.items()}
+
+    def step(self, closure=None):
+        raise NotImplementedError
+
+
+def split_by_dtype(params: Iterable[Parameter]):
+    """Group params-with-grads by storage dtype, preserving order.
+
+    The reference splits fp16/fp32 (e.g. fused_adam.py:118-140); on TPU the
+    cross-product adds bf16.  Returns dict dtype -> list[Parameter].
+    """
+    buckets: Dict[Any, List[Parameter]] = {}
+    for p in params:
+        if p.grad is None:
+            continue
+        buckets.setdefault(jnp.dtype(p.dtype), []).append(p)
+    return buckets

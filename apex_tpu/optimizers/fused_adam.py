@@ -1,0 +1,84 @@
+"""FusedAdam (reference: apex/optimizers/fused_adam.py) — Adam/AdamW with the
+whole per-dtype-bucket update compiled into one XLA executable."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..multi_tensor_apply import multi_tensor_applier
+from .base import Optimizer, split_by_dtype
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("beta1", "beta2", "eps", "mode", "bias_correction",
+                     "weight_decay"))
+def _adam_step(flag, lists, lr, step, beta1, beta2, eps, mode,
+               bias_correction, weight_decay):
+    return multi_tensor_applier(
+        ops.multi_tensor_adam, flag, lists, lr, beta1, beta2, eps, step,
+        mode, bias_correction, weight_decay)
+
+
+class FusedAdam(Optimizer):
+    """Drop-in replacement for torch.optim.Adam / AdamW
+    (``adam_w_mode=True`` selects decoupled weight decay, reference
+    fused_adam.py:52-54,75)."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
+                 weight_decay=0.0, amsgrad=False, set_grad_none=True):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad "
+                               "variant.")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay)
+        super().__init__(params, defaults)
+        self.adam_w_mode = 1 if adam_w_mode else 0
+        self.set_grad_none = set_grad_none
+        self._overflow_buf = ops.zero_flag()
+
+    def zero_grad(self, set_to_none: bool = None):
+        if set_to_none is None:
+            set_to_none = self.set_grad_none
+        super().zero_grad(set_to_none)
+
+    def step(self, closure=None, grads=None, output_params=None, scale=None,
+             grad_norms=None):
+        if any(x is not None for x in [grads, output_params, scale,
+                                       grad_norms]):
+            raise RuntimeError(
+                "FusedAdam has been updated.  Simply initialize it "
+                "identically to torch.optim.Adam, and call step() with no "
+                "arguments.")
+        loss = closure() if closure is not None else None
+
+        for group in self.param_groups:
+            bias_correction = bool(group["bias_correction"])
+            beta1, beta2 = group["betas"]
+            group["step"] = group.get("step", 0) + 1
+
+            for dtype, plist in split_by_dtype(group["params"]).items():
+                for p in plist:
+                    state = self.state[p]
+                    if len(state) == 0:
+                        state["exp_avg"] = jnp.zeros_like(p.data)
+                        state["exp_avg_sq"] = jnp.zeros_like(p.data)
+                lists = [[p.grad for p in plist],
+                         [p.data for p in plist],
+                         [self.state[p]["exp_avg"] for p in plist],
+                         [self.state[p]["exp_avg_sq"] for p in plist]]
+                _, new_ps, new_ms, new_vs = _adam_step(
+                    self._overflow_buf, lists,
+                    jnp.asarray(group["lr"], jnp.float32),
+                    jnp.asarray(group["step"], jnp.int32),
+                    beta1, beta2, group["eps"], self.adam_w_mode,
+                    bias_correction, group["weight_decay"])
+                for p, nd, nm, nv in zip(plist, new_ps, new_ms, new_vs):
+                    p.data = nd
+                    self.state[p]["exp_avg"] = nm
+                    self.state[p]["exp_avg_sq"] = nv
+        return loss

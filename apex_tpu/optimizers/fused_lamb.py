@@ -1,0 +1,88 @@
+"""FusedLAMB (reference: apex/optimizers/fused_lamb.py).
+
+As in the reference host function (csrc/multi_tensor_lamb.cu:241-247), the
+gradient norm for clipping is computed over the launched list — one fused
+program per dtype bucket: l2norm + stage1 + per-tensor norms + stage2.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..multi_tensor_apply import multi_tensor_applier
+from .base import Optimizer, split_by_dtype
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("beta1", "beta2", "eps", "bias_correction",
+                     "weight_decay", "grad_averaging", "mode",
+                     "max_grad_norm"))
+def _lamb_step(flag, lists, lr, step, beta1, beta2, eps, bias_correction,
+               weight_decay, grad_averaging, mode, max_grad_norm):
+    flag, grad_norm, _ = ops.multi_tensor_l2norm(flag, [lists[0]])
+    return multi_tensor_applier(
+        ops.multi_tensor_lamb, flag, lists, lr, beta1, beta2, eps, step,
+        bias_correction, weight_decay, grad_averaging, mode, grad_norm,
+        max_grad_norm)
+
+
+class FusedLAMB(Optimizer):
+    """LAMB with global-grad-norm clipping and per-tensor trust ratios
+    (reference fused_lamb.py:4,92-175)."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 amsgrad=False, adam_w_mode=True, grad_averaging=True,
+                 set_grad_none=True, max_grad_norm=1.0):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad "
+                               "variant.")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay,
+                        grad_averaging=grad_averaging,
+                        max_grad_norm=max_grad_norm)
+        super().__init__(params, defaults)
+        self.adam_w_mode = 1 if adam_w_mode else 0
+        self.set_grad_none = set_grad_none
+        self._overflow_buf = ops.zero_flag()
+
+    def zero_grad(self, set_to_none: bool = None):
+        if set_to_none is None:
+            set_to_none = self.set_grad_none
+        super().zero_grad(set_to_none)
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+
+        for group in self.param_groups:
+            bias_correction = bool(group["bias_correction"])
+            beta1, beta2 = group["betas"]
+            grad_averaging = 1 if group["grad_averaging"] else 0
+            group["step"] = group.get("step", 0) + 1
+
+            for dtype, plist in split_by_dtype(group["params"]).items():
+                for p in plist:
+                    state = self.state[p]
+                    if len(state) == 0:
+                        state["exp_avg"] = jnp.zeros_like(p.data)
+                        state["exp_avg_sq"] = jnp.zeros_like(p.data)
+                lists = [[p.grad for p in plist],
+                         [p.data for p in plist],
+                         [self.state[p]["exp_avg"] for p in plist],
+                         [self.state[p]["exp_avg_sq"] for p in plist]]
+                _, new_ps, new_ms, new_vs = _lamb_step(
+                    self._overflow_buf, lists,
+                    jnp.asarray(group["lr"], jnp.float32),
+                    jnp.asarray(group["step"], jnp.int32),
+                    beta1, beta2, group["eps"], bias_correction,
+                    group["weight_decay"], grad_averaging, self.adam_w_mode,
+                    group["max_grad_norm"])
+                for p, nd, nm, nv in zip(plist, new_ps, new_ms, new_vs):
+                    p.data = nd
+                    self.state[p]["exp_avg"] = nm
+                    self.state[p]["exp_avg_sq"] = nv
+        return loss

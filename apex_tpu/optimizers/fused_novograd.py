@@ -1,0 +1,100 @@
+"""FusedNovoGrad (reference: apex/optimizers/fused_novograd.py).
+
+Layout deviation from the reference: per-tensor second-moment norms are kept
+one-per-param in ``self.state[p]["exp_avg_sq"]`` (a scalar) instead of two
+flat per-group tensors (``group['exp_avg_sq'][0/1]``, fused_novograd.py:158-177)
+— same math, but state_dict round-trips through the standard per-param
+packing and a third bf16 bucket needs no special casing.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..multi_tensor_apply import multi_tensor_applier
+from .base import Optimizer, split_by_dtype
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("beta1", "beta2", "eps", "bias_correction",
+                     "weight_decay", "grad_averaging", "moment_mode",
+                     "norm_type"))
+def _novograd_step(flag, lists, lr, step, beta1, beta2, eps, bias_correction,
+                   weight_decay, grad_averaging, moment_mode, norm_type):
+    return multi_tensor_applier(
+        ops.multi_tensor_novograd, flag, lists, lr, beta1, beta2, eps, step,
+        bias_correction, weight_decay, grad_averaging, moment_mode, norm_type)
+
+
+class FusedNovoGrad(Optimizer):
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.95, 0.98), eps=1e-8, weight_decay=0.0,
+                 amsgrad=False, reg_inside_moment=False, grad_averaging=True,
+                 norm_type=2, init_zero=False, set_grad_none=True):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad "
+                               "variant.")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay,
+                        grad_averaging=grad_averaging, norm_type=norm_type,
+                        init_zero=init_zero)
+        super().__init__(params, defaults)
+        # moment_mode 0 applies weight decay inside the moment update
+        # (reference fused_novograd.py:87)
+        self.moment_mode = 0 if reg_inside_moment else 1
+        self.set_grad_none = set_grad_none
+        self._overflow_buf = ops.zero_flag()
+
+    def zero_grad(self, set_to_none: bool = None):
+        if set_to_none is None:
+            set_to_none = self.set_grad_none
+        super().zero_grad(set_to_none)
+
+    def _init_norm(self, p, group):
+        """First-step norm init so the first blend is a no-op, or zero
+        (reference fused_novograd.py:158-174)."""
+        if group["init_zero"]:
+            return jnp.zeros((), jnp.float32)
+        g = p.grad.astype(jnp.float32)
+        if group["norm_type"] == 0:
+            return jnp.max(jnp.abs(g))
+        elif group["norm_type"] == 2:
+            return jnp.sqrt(jnp.sum(g * g))
+        raise RuntimeError("FusedNovoGrad only support l2/inf norm now.")
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+
+        for group in self.param_groups:
+            bias_correction = bool(group["bias_correction"])
+            beta1, beta2 = group["betas"]
+            grad_averaging = 1 if group["grad_averaging"] else 0
+            group["step"] = group.get("step", 0) + 1
+
+            for dtype, plist in split_by_dtype(group["params"]).items():
+                for p in plist:
+                    state = self.state[p]
+                    if "exp_avg" not in state:
+                        state["exp_avg"] = jnp.zeros_like(p.data)
+                    if "exp_avg_sq" not in state:
+                        state["exp_avg_sq"] = self._init_norm(p, group)
+                lists = [[p.grad for p in plist],
+                         [p.data for p in plist],
+                         [self.state[p]["exp_avg"] for p in plist],
+                         [self.state[p]["exp_avg_sq"] for p in plist]]
+                _, new_ps, new_ms, new_norms = _novograd_step(
+                    self._overflow_buf, lists,
+                    jnp.asarray(group["lr"], jnp.float32),
+                    jnp.asarray(group["step"], jnp.int32),
+                    beta1, beta2, group["eps"], bias_correction,
+                    group["weight_decay"], grad_averaging, self.moment_mode,
+                    group["norm_type"])
+                for p, nd, nm, nv in zip(plist, new_ps, new_ms, new_norms):
+                    p.data = nd
+                    self.state[p]["exp_avg"] = nm
+                    self.state[p]["exp_avg_sq"] = nv
+        return loss

@@ -1,0 +1,165 @@
+"""FusedSGD (reference: apex/optimizers/fused_sgd.py).
+
+The whole per-dtype-bucket update — momentum, weight decay, nesterov, grad
+unscale via ``scale``, and the optional half model-copy writeback — compiles
+into one XLA executable per bucket structure (the reference batches it into
+one ``multi_tensor_sgd`` launch; XLA fuses the same way).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..multi_tensor_apply import multi_tensor_applier
+from .base import Optimizer, required, split_by_dtype
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("weight_decay", "momentum", "dampening", "nesterov",
+                     "first_run", "wd_after_momentum"))
+def _sgd_step(flag, lists, lr, scale, weight_decay, momentum, dampening,
+              nesterov, first_run, wd_after_momentum):
+    return multi_tensor_applier(
+        ops.multi_tensor_sgd, flag, lists, weight_decay, momentum, dampening,
+        lr, nesterov, first_run, wd_after_momentum, scale)
+
+
+class FusedSGD(Optimizer):
+    """Drop-in for torch.optim.SGD semantics with multi-tensor batching.
+
+    amp integration (reference fused_sgd.py:95-96,139-212): when
+    ``_amp_stash`` is present the 4-list launch writes both the fp32 master
+    params and the half model params in one pass, and ``most_recent_scale``
+    folds gradient unscaling into the kernel.
+    """
+
+    def __init__(self, params, lr=required, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False,
+                 wd_after_momentum=False,
+                 materialize_master_grads=True):
+        if lr is not required and lr < 0.0:
+            raise ValueError(f"Invalid learning rate: {lr}")
+        if momentum < 0.0:
+            raise ValueError(f"Invalid momentum value: {momentum}")
+        if weight_decay < 0.0:
+            raise ValueError(f"Invalid weight_decay value: {weight_decay}")
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires a momentum and zero dampening")
+        defaults = dict(lr=lr, momentum=momentum, dampening=dampening,
+                        weight_decay=weight_decay, nesterov=nesterov)
+        super().__init__(params, defaults)
+
+        self.wd_after_momentum = wd_after_momentum
+        self.materialize_master_grads = materialize_master_grads
+        self.most_recent_scale = 1.0
+        self.scale_set_by_backward = False
+        self._overflow_buf = ops.zero_flag()
+
+    def get_momentums(self, params):
+        momentums = []
+        first_run = True
+        for p in params:
+            state = self.state[p]
+            if "momentum_buffer" not in state:
+                first_run = True
+                state["momentum_buffer"] = jnp.zeros_like(p.data,
+                                                          dtype=jnp.float32)
+            else:
+                first_run = False
+            momentums.append(state["momentum_buffer"])
+        return momentums, first_run
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+
+        explicit_master_params = (
+            hasattr(self, "_amp_stash")
+            and hasattr(self._amp_stash, "fp32_from_fp16_groups"))
+
+        for gid, group in enumerate(self.param_groups):
+            wd = group["weight_decay"]
+            momentum = group["momentum"]
+            dampening = group["dampening"]
+            nesterov = group["nesterov"]
+
+            launch_params: list = []   # parallel to launch sets
+            launch_sets: list = []
+            first_runs: list = []
+            model_param_sets: list = []
+
+            if explicit_master_params:
+                stash = self._amp_stash
+
+                fp32_params = [p for p in stash.fp32_from_fp32_groups[gid]
+                               if p.grad is not None]
+                fp32_grads = [p.grad for p in fp32_params]
+                fp32_mom, fr32 = self.get_momentums(fp32_params)
+
+                if self.materialize_master_grads:
+                    fp16_model = [p for i, p in enumerate(stash.fp16_groups[gid])
+                                  if stash.fp32_from_fp16_groups[gid][i].grad
+                                  is not None]
+                    masters = [p for p in stash.fp32_from_fp16_groups[gid]
+                               if p.grad is not None]
+                    master_grads = [p.grad for p in masters]
+                    m_mom, fr16 = self.get_momentums(masters)
+                    launch_sets.append([master_grads,
+                                        [p.data for p in masters], m_mom,
+                                        [p.data for p in fp16_model]])
+                else:
+                    fp16_model = [p for p in stash.fp16_groups[gid]
+                                  if p.grad is not None]
+                    model_grads = [p.grad for p in fp16_model]
+                    masters = [p for i, p in
+                               enumerate(stash.fp32_from_fp16_groups[gid])
+                               if stash.fp16_groups[gid][i].grad is not None]
+                    m_mom, fr16 = self.get_momentums(masters)
+                    launch_sets.append([model_grads,
+                                        [p.data for p in masters], m_mom,
+                                        [p.data for p in fp16_model]])
+                launch_params.append(masters)
+                model_param_sets.append(fp16_model)
+                first_runs.append(fr16)
+
+                launch_sets.append([fp32_grads,
+                                    [p.data for p in fp32_params], fp32_mom])
+                launch_params.append(fp32_params)
+                model_param_sets.append(None)
+                first_runs.append(fr32)
+            else:
+                for dtype, plist in split_by_dtype(group["params"]).items():
+                    moms, fr = self.get_momentums(plist)
+                    launch_sets.append([[p.grad for p in plist],
+                                        [p.data for p in plist], moms])
+                    launch_params.append(plist)
+                    model_param_sets.append(None)
+                    first_runs.append(fr)
+
+            for plist, launch_set, model_plist, first_run in zip(
+                    launch_params, launch_sets, model_param_sets, first_runs):
+                if not launch_set[0]:
+                    continue
+                out = _sgd_step(
+                    self._overflow_buf, launch_set,
+                    jnp.asarray(group["lr"], jnp.float32),
+                    jnp.asarray(1.0 / self.most_recent_scale, jnp.float32),
+                    wd, momentum, dampening, nesterov, first_run,
+                    self.wd_after_momentum)
+                if model_plist is not None:
+                    _, new_ps, new_ms, new_model = out
+                    for mp, nd in zip(model_plist, new_model):
+                        mp.data = nd
+                else:
+                    _, new_ps, new_ms = out
+                for p, nd, nm in zip(plist, new_ps, new_ms):
+                    p.data = nd
+                    self.state[p]["momentum_buffer"] = nm
+
+        self.most_recent_scale = 1.0
+        self.scale_set_by_backward = False
+        return loss

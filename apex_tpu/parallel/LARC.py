@@ -1,0 +1,90 @@
+"""LARC — layer-wise adaptive rate control optimizer wrapper
+(reference: apex/parallel/LARC.py:5-107).
+
+Computes a per-param trust ratio ``tc * ||p|| / (||g|| + wd*||p|| + eps)``,
+in 'clip' mode capped so the effective lr is ``min(adaptive_lr, lr)``,
+modifies grads in place, then delegates to the wrapped optimizer with its
+weight decay absorbed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LARC:
+    def __init__(self, optimizer, trust_coefficient=0.02, clip=True,
+                 eps=1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.eps = eps
+        self.clip = clip
+
+    def __getstate__(self):
+        return self.optim.__getstate__()
+
+    def __setstate__(self, state):
+        self.optim.__setstate__(state)
+
+    @property
+    def state(self):
+        return self.optim.state
+
+    def __repr__(self):
+        return self.optim.__repr__()
+
+    @property
+    def param_groups(self):
+        return self.optim.param_groups
+
+    @param_groups.setter
+    def param_groups(self, value):
+        self.optim.param_groups = value
+
+    def state_dict(self):
+        return self.optim.state_dict()
+
+    def load_state_dict(self, state_dict):
+        self.optim.load_state_dict(state_dict)
+
+    def zero_grad(self, *args, **kwargs):
+        self.optim.zero_grad(*args, **kwargs)
+
+    def add_param_group(self, param_group):
+        self.optim.add_param_group(param_group)
+
+    def step(self):
+        from .. import ops
+
+        weight_decays = []
+        for group in self.optim.param_groups:
+            weight_decay = group.get("weight_decay", 0)
+            weight_decays.append(weight_decay)
+            group["weight_decay"] = 0
+            params = [p for p in group["params"] if p.grad is not None]
+            if not params:
+                continue
+            # batched per-tensor norms via the fused op (one program each for
+            # params and grads instead of 2N eager reductions)
+            _, _, p_norms = ops.multi_tensor_l2norm(
+                ops.zero_flag(), [[p.data for p in params]], per_tensor=True)
+            _, _, g_norms = ops.multi_tensor_l2norm(
+                ops.zero_flag(), [[p.grad for p in params]], per_tensor=True)
+            for i, p in enumerate(params):
+                param_norm, grad_norm = p_norms[i], g_norms[i]
+                adaptive_lr = self.trust_coefficient * param_norm / (
+                    grad_norm + param_norm * weight_decay + self.eps)
+                if self.clip:
+                    adaptive_lr = jnp.minimum(adaptive_lr / group["lr"], 1.0)
+                # zero param or grad norm -> leave the grad untouched
+                # (reference LARC.py:92)
+                active = (param_norm != 0) & (grad_norm != 0)
+                adaptive_lr = jnp.where(active, adaptive_lr, 1.0)
+                wd_term = jnp.where(active, weight_decay, 0.0)
+                gd = p.grad.astype(jnp.float32)
+                new_grad = (gd + wd_term * p.data.astype(jnp.float32)) \
+                    * adaptive_lr
+                p.grad = new_grad.astype(p.grad.dtype)
+
+        self.optim.step()
+        for i, group in enumerate(self.optim.param_groups):
+            group["weight_decay"] = weight_decays[i]

@@ -1,0 +1,1 @@
+from .LARC import LARC  # noqa: F401

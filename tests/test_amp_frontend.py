@@ -1,0 +1,109 @@
+"""Properties / opt-level preset behavior (reference frontend.py:7-191) and
+amp.state_dict round-trip (frontend.py:361-400)."""
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import LossScaler, Properties
+from apex_tpu.amp._amp_state import _amp_state
+from apex_tpu.amp.frontend import opt_levels, resolve_dtype
+
+
+def _props(level):
+    return opt_levels[level](Properties())
+
+
+def test_preset_O0():
+    p = _props("O0")
+    assert p.cast_model_type == jnp.float32
+    assert p.patch_torch_functions is False
+    assert p.master_weights is False
+    assert p.loss_scale == 1.0
+
+
+def test_preset_O1():
+    p = _props("O1")
+    assert p.cast_model_type is None
+    assert p.patch_torch_functions is True
+    assert p.keep_batchnorm_fp32 is None
+    assert p.loss_scale == "dynamic"
+
+
+def test_preset_O2():
+    p = _props("O2")
+    assert p.cast_model_type == jnp.float16
+    assert p.keep_batchnorm_fp32 is True
+    assert p.master_weights is True
+    assert p.loss_scale == "dynamic"
+
+
+def test_preset_O3():
+    p = _props("O3")
+    assert p.cast_model_type == jnp.float16
+    assert p.keep_batchnorm_fp32 is False
+    assert p.master_weights is False
+    assert p.loss_scale == 1.0
+
+
+def test_O1_rejects_cast_model_type():
+    p = _props("O1")
+    with pytest.raises(RuntimeError):
+        p.cast_model_type = jnp.float16
+
+
+def test_O1_rejects_keep_batchnorm():
+    p = _props("O1")
+    with pytest.raises(RuntimeError):
+        p.keep_batchnorm_fp32 = True
+
+
+def test_O2_accepts_bfloat16_override():
+    p = _props("O2")
+    p.cast_model_type = "bfloat16"
+    assert p.cast_model_type == jnp.bfloat16
+
+
+def test_keep_batchnorm_string_conversion():
+    p = _props("O2")
+    p.keep_batchnorm_fp32 = "False"
+    assert p.keep_batchnorm_fp32 is False
+    p.keep_batchnorm_fp32 = "True"
+    assert p.keep_batchnorm_fp32 is True
+
+
+def test_loss_scale_coerced_to_float():
+    p = _props("O2")
+    p.loss_scale = 128
+    assert p.loss_scale == 128.0 and isinstance(p.loss_scale, float)
+    p.loss_scale = "dynamic"
+    assert p.loss_scale == "dynamic"
+
+
+def test_resolve_dtype_aliases():
+    assert resolve_dtype("bf16") == jnp.bfloat16
+    assert resolve_dtype("float16") == jnp.float16
+    assert resolve_dtype(jnp.float32) == jnp.float32
+    import torch
+    assert resolve_dtype(torch.float16) == jnp.float16
+    assert resolve_dtype(torch.bfloat16) == jnp.bfloat16
+
+
+def test_state_dict_roundtrip():
+    _amp_state.loss_scalers = [LossScaler("dynamic"), LossScaler(128.0)]
+    _amp_state.loss_scalers[0]._loss_scale = 2.0 ** 12
+    _amp_state.loss_scalers[0]._unskipped = 7
+    sd = amp.state_dict()
+    assert sd["loss_scaler0"] == {"loss_scale": 2.0 ** 12, "unskipped": 7}
+    assert sd["loss_scaler1"]["loss_scale"] == 128.0
+
+    _amp_state.loss_scalers = [LossScaler("dynamic"), LossScaler("dynamic")]
+    amp.load_state_dict(sd)
+    assert _amp_state.loss_scalers[0].loss_scale() == 2.0 ** 12
+    assert _amp_state.loss_scalers[0]._unskipped == 7
+    assert _amp_state.loss_scalers[1].loss_scale() == 128.0
+
+
+def test_load_state_dict_rejects_unexpected_keys():
+    _amp_state.loss_scalers = [LossScaler("dynamic")]
+    with pytest.raises(RuntimeError):
+        amp.load_state_dict({"bogus": {}})

@@ -1,0 +1,239 @@
+"""Fused optimizer tests vs torch.optim CPU oracles — the reference's own
+strategy (tests/L0/run_optimizers/test_adam.py compares FusedAdam vs
+torch.optim.Adam).  LAMB/NovoGrad use independent numpy oracles since torch
+has no reference implementation."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.nn import Parameter
+from apex_tpu.optimizers import FusedAdam, FusedLAMB, FusedNovoGrad, FusedSGD
+from apex_tpu.parallel import LARC
+
+SHAPES = [(7,), (31, 13), (2, 3, 5)]
+
+
+def _make_pair(rng, shapes=SHAPES):
+    """Matched (apex_tpu params, torch params) with identical data+grads."""
+    ours, theirs = [], []
+    for s in shapes:
+        w = rng.standard_normal(s).astype(np.float32)
+        g = rng.standard_normal(s).astype(np.float32)
+        p = Parameter(jnp.asarray(w))
+        p.grad = jnp.asarray(g)
+        ours.append(p)
+        tp = torch.nn.Parameter(torch.tensor(w))
+        tp.grad = torch.tensor(g)
+        theirs.append(tp)
+    return ours, theirs
+
+
+def _step_both(opt, topt, ours, theirs, rng, n=5):
+    for _ in range(n):
+        opt.step()
+        topt.step()
+        for p, tp in zip(ours, theirs):
+            g = rng.standard_normal(p.shape).astype(np.float32)
+            p.grad = jnp.asarray(g)
+            tp.grad = torch.tensor(g)
+
+
+def _assert_close(ours, theirs, rtol=2e-5, atol=2e-6):
+    for p, tp in zip(ours, theirs):
+        np.testing.assert_allclose(np.asarray(p.data),
+                                   tp.detach().numpy(), rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_fused_adam_matches_torch_adamw(rng, wd):
+    ours, theirs = _make_pair(rng)
+    opt = FusedAdam(ours, lr=1e-2, weight_decay=wd, adam_w_mode=True)
+    topt = torch.optim.AdamW(theirs, lr=1e-2, weight_decay=wd)
+    _step_both(opt, topt, ours, theirs, rng)
+    _assert_close(ours, theirs)
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_fused_adam_l2_matches_torch_adam(rng, wd):
+    ours, theirs = _make_pair(rng)
+    opt = FusedAdam(ours, lr=1e-2, weight_decay=wd, adam_w_mode=False)
+    topt = torch.optim.Adam(theirs, lr=1e-2, weight_decay=wd)
+    _step_both(opt, topt, ours, theirs, rng)
+    _assert_close(ours, theirs)
+
+
+@pytest.mark.parametrize("momentum,nesterov,wd", [
+    (0.0, False, 0.0), (0.9, False, 0.0), (0.9, True, 0.0), (0.9, False, 1e-4)])
+def test_fused_sgd_matches_torch(rng, momentum, nesterov, wd):
+    ours, theirs = _make_pair(rng)
+    opt = FusedSGD(ours, lr=0.1, momentum=momentum, nesterov=nesterov,
+                   weight_decay=wd)
+    topt = torch.optim.SGD(theirs, lr=0.1, momentum=momentum,
+                           nesterov=nesterov, weight_decay=wd)
+    _step_both(opt, topt, ours, theirs, rng)
+    _assert_close(ours, theirs)
+
+
+def _numpy_lamb_reference(ws, gs, n_steps, rng, lr=1e-2, b1=0.9, b2=0.999,
+                          eps=1e-6, wd=0.01, max_grad_norm=1.0):
+    """Independent LAMB oracle following the published algorithm + the
+    reference's clipping/trust-ratio conventions."""
+    ms = [np.zeros_like(w) for w in ws]
+    vs = [np.zeros_like(w) for w in ws]
+    ws = [w.copy() for w in ws]
+    gs = [g.copy() for g in gs]
+    rngs = np.random.default_rng(999)
+    for step in range(1, n_steps + 1):
+        gnorm = np.sqrt(sum((g ** 2).sum() for g in gs))
+        clip = gnorm / max_grad_norm if gnorm > max_grad_norm else 1.0
+        bc1 = 1 - b1 ** step
+        bc2 = 1 - b2 ** step
+        for i in range(len(ws)):
+            g = gs[i] / clip
+            ms[i] = b1 * ms[i] + (1 - b1) * g
+            vs[i] = b2 * vs[i] + (1 - b2) * g * g
+            u = (ms[i] / bc1) / (np.sqrt(vs[i] / bc2) + eps) + wd * ws[i]
+            pn = np.linalg.norm(ws[i].ravel())
+            un = np.linalg.norm(u.ravel())
+            ratio = lr * pn / un if (pn != 0 and un != 0) else lr
+            ws[i] = ws[i] - ratio * u
+        gs = [rngs.standard_normal(w.shape).astype(np.float32) for w in ws]
+    return ws
+
+
+def test_fused_lamb_matches_numpy_oracle(rng):
+    ws = [rng.standard_normal(s).astype(np.float32) for s in SHAPES]
+    gs = [rng.standard_normal(s).astype(np.float32) for s in SHAPES]
+    params = []
+    for w, g in zip(ws, gs):
+        p = Parameter(jnp.asarray(w))
+        p.grad = jnp.asarray(g)
+        params.append(p)
+    opt = FusedLAMB(params, lr=1e-2, weight_decay=0.01, max_grad_norm=1.0)
+    rngs = np.random.default_rng(999)
+    n = 4
+    for _ in range(n):
+        opt.step()
+        for p in params:
+            p.grad = jnp.asarray(
+                rngs.standard_normal(p.shape).astype(np.float32))
+    ref = _numpy_lamb_reference(ws, gs, n, rng)
+    for p, w in zip(params, ref):
+        np.testing.assert_allclose(np.asarray(p.data), w, rtol=1e-4, atol=1e-5)
+
+
+def _numpy_novograd_reference(ws, gs, n_steps, lr=1e-2, b1=0.95, b2=0.98,
+                              eps=1e-8, wd=0.01, moment_mode=1):
+    ms = [np.zeros_like(w) for w in ws]
+    gns = [np.sqrt((g.astype(np.float64) ** 2).sum()) for g in gs]  # init
+    ws = [w.copy() for w in ws]
+    gs = [g.copy() for g in gs]
+    rngs = np.random.default_rng(999)
+    for step in range(1, n_steps + 1):
+        bc1 = 1 - b1 ** step
+        bc2 = np.sqrt(1 - b2 ** step)
+        for i in range(len(ws)):
+            g = gs[i]
+            gns[i] = np.sqrt(b2 * gns[i] ** 2 + (1 - b2) * (g ** 2).sum())
+            denom = gns[i] / bc2 + eps
+            if moment_mode == 0:
+                gp = g / denom + wd * ws[i]
+                ms[i] = b1 * ms[i] + (1 - b1) * gp
+                ws[i] = ws[i] - lr * (ms[i] / bc1)
+            else:
+                ms[i] = b1 * ms[i] + (1 - b1) * g
+                ws[i] = ws[i] - lr * ((ms[i] / bc1) / denom + wd * ws[i])
+        gs = [rngs.standard_normal(w.shape).astype(np.float32) for w in ws]
+    return ws
+
+
+@pytest.mark.parametrize("reg_inside_moment", [False, True])
+def test_fused_novograd_matches_numpy_oracle(rng, reg_inside_moment):
+    ws = [rng.standard_normal(s).astype(np.float32) for s in SHAPES]
+    gs = [rng.standard_normal(s).astype(np.float32) for s in SHAPES]
+    params = []
+    for w, g in zip(ws, gs):
+        p = Parameter(jnp.asarray(w))
+        p.grad = jnp.asarray(g)
+        params.append(p)
+    opt = FusedNovoGrad(params, lr=1e-2, weight_decay=0.01,
+                        reg_inside_moment=reg_inside_moment)
+    rngs = np.random.default_rng(999)
+    n = 4
+    for _ in range(n):
+        opt.step()
+        for p in params:
+            p.grad = jnp.asarray(
+                rngs.standard_normal(p.shape).astype(np.float32))
+    ref = _numpy_novograd_reference(
+        ws, gs, n, moment_mode=0 if reg_inside_moment else 1)
+    for p, w in zip(params, ref):
+        np.testing.assert_allclose(np.asarray(p.data), w, rtol=1e-4, atol=1e-5)
+
+
+def test_mixed_dtype_buckets(rng):
+    p32 = Parameter(jnp.asarray(rng.standard_normal((8,)), jnp.float32))
+    p16 = Parameter(jnp.asarray(rng.standard_normal((8,)), jnp.bfloat16))
+    p32.grad = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+    p16.grad = jnp.asarray(rng.standard_normal((8,)), jnp.bfloat16)
+    opt = FusedAdam([p32, p16], lr=1e-2)
+    opt.step()
+    assert p32.dtype == jnp.float32 and p16.dtype == jnp.bfloat16
+
+
+def test_zero_grad_set_to_none(rng):
+    ours, _ = _make_pair(rng)
+    opt = FusedAdam(ours, lr=1e-2)  # set_grad_none default True
+    opt.zero_grad()
+    assert all(p.grad is None for p in ours)
+
+
+def test_state_dict_roundtrip(rng):
+    ours, _ = _make_pair(rng)
+    opt = FusedAdam(ours, lr=1e-2)
+    opt.step()
+    sd = opt.state_dict()
+
+    ours2 = [Parameter(p.data) for p in ours]
+    opt2 = FusedAdam(ours2, lr=5e-4)
+    opt2.load_state_dict(sd)
+    assert opt2.param_groups[0]["lr"] == 1e-2
+    for p, p2 in zip(ours, ours2):
+        np.testing.assert_allclose(
+            np.asarray(opt.state[p]["exp_avg"]),
+            np.asarray(opt2.state[p2]["exp_avg"]))
+
+
+def test_duplicate_param_rejected(rng):
+    ours, _ = _make_pair(rng)
+    opt = FusedAdam(ours, lr=1e-2)
+    with pytest.raises(ValueError):
+        opt.add_param_group({"params": [ours[0]]})
+
+
+def test_larc_clips_effective_lr(rng):
+    # huge grads -> adaptive_lr tiny -> update much smaller than plain SGD
+    w = np.ones((16,), np.float32)
+    p = Parameter(jnp.asarray(w))
+    p.grad = jnp.asarray(1000.0 * np.ones((16,), np.float32))
+    base = FusedSGD([p], lr=0.1)
+    opt = LARC(base, trust_coefficient=0.001, clip=True)
+    opt.step()
+    delta = np.abs(np.asarray(p.data) - w).max()
+    assert delta < 0.1 * 1000.0  # plain SGD would move 100.0
+    assert delta > 0
+
+
+def test_larc_delegates_api(rng):
+    ours, _ = _make_pair(rng)
+    base = FusedSGD(ours, lr=0.1, weight_decay=0.01)
+    opt = LARC(base)
+    assert opt.param_groups is base.param_groups
+    opt.zero_grad(set_to_none=True)
+    assert all(p.grad is None for p in ours)
+    # weight decay restored after step
+    for p in ours:
+        p.grad = jnp.zeros(p.shape, jnp.float32)
+    opt.step()
+    assert base.param_groups[0]["weight_decay"] == 0.01

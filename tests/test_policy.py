@@ -1,0 +1,133 @@
+"""O1 policy engine: dtype-propagation matrix per op category
+(mirrors tests/L0/run_amp/test_basic_casts.py:14-100 in the reference —
+linear ALWAYS_HALF, softmax ALWAYS_FLOAT, promotion to widest, banned raises).
+"""
+import types
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.amp import policy
+from apex_tpu.amp.policy import CastPolicy, apply_op_policy, autocast
+
+
+@pytest.fixture
+def pol():
+    return CastPolicy(half_dtype=jnp.float16)
+
+
+def test_half_op_casts_down(pol):
+    x = jnp.ones((4, 4), jnp.float32)
+    with autocast(pol):
+        (args, _) = apply_op_policy("linear", (x,))[0], None
+    assert args[0].dtype == jnp.float16
+
+
+def test_float_op_casts_up(pol):
+    x = jnp.ones((4, 4), jnp.float16)
+    with autocast(pol):
+        args, _ = apply_op_policy("softmax", (x,))
+    assert args[0].dtype == jnp.float32
+
+
+def test_match_input_untouched(pol):
+    # ops in no list (e.g. relu) pass through
+    x = jnp.ones((4,), jnp.float16)
+    with autocast(pol):
+        args, _ = apply_op_policy("relu", (x,))
+    assert args[0].dtype == jnp.float16
+
+
+def test_promotion_to_widest(pol):
+    a = jnp.ones((4,), jnp.float16)
+    b = jnp.ones((4,), jnp.float32)
+    with autocast(pol):
+        args, _ = apply_op_policy("add", (a, b))
+    assert args[0].dtype == jnp.float32 and args[1].dtype == jnp.float32
+
+
+def test_promotion_same_dtype_stays(pol):
+    a = jnp.ones((4,), jnp.float16)
+    b = jnp.ones((4,), jnp.float16)
+    with autocast(pol):
+        args, _ = apply_op_policy("add", (a, b))
+    assert args[0].dtype == jnp.float16
+
+
+def test_int_args_untouched(pol):
+    idx = jnp.ones((4,), jnp.int32)
+    x = jnp.ones((4,), jnp.float32)
+    with autocast(pol):
+        args, _ = apply_op_policy("linear", (x, idx))
+    assert args[1].dtype == jnp.int32
+
+
+def test_banned_raises(pol):
+    x = jnp.ones((4,), jnp.float16)
+    with autocast(pol):
+        with pytest.raises(NotImplementedError):
+            apply_op_policy("binary_cross_entropy", (x,))
+
+
+def test_banned_allowed_when_opted_in():
+    pol = CastPolicy(allow_banned=True)
+    x = jnp.ones((4,), jnp.float16)
+    with autocast(pol):
+        args, _ = apply_op_policy("binary_cross_entropy", (x,))
+    assert args[0].dtype == jnp.float16
+
+
+def test_no_policy_is_noop():
+    x = jnp.ones((4,), jnp.float32)
+    args, _ = apply_op_policy("linear", (x,))
+    assert args[0].dtype == jnp.float32
+
+
+def test_disable_casts_inside_policy(pol):
+    x = jnp.ones((4, 4), jnp.float32)
+    with autocast(pol):
+        with policy.disable_casts():
+            args, _ = apply_op_policy("linear", (x,))
+    assert args[0].dtype == jnp.float32
+
+
+def test_bfloat16_policy():
+    pol = CastPolicy(half_dtype=jnp.bfloat16)
+    x = jnp.ones((4, 4), jnp.float32)
+    with autocast(pol):
+        args, _ = apply_op_policy("conv2d", (x,))
+    assert args[0].dtype == jnp.bfloat16
+
+
+def test_register_half_function_on_user_module(pol):
+    mod = types.SimpleNamespace(myop=lambda x: x)
+    policy.register_half_function(mod, "myop")
+    x = jnp.ones((4,), jnp.float32)
+    with autocast(pol):
+        y = mod.myop(x)
+    assert y.dtype == jnp.float32  # pol predates registration? no — stack reg
+    # a policy created after registration picks it up via replay
+    pol2 = CastPolicy()
+    policy.replay_registrations(pol2)
+    with autocast(pol2):
+        y2 = mod.myop(x)
+    assert y2.dtype == jnp.float16
+
+
+def test_decorators(pol):
+    @policy.half_function
+    def h(x):
+        return x
+
+    @policy.float_function
+    def f(x):
+        return x
+
+    x32 = jnp.ones((2,), jnp.float32)
+    x16 = jnp.ones((2,), jnp.float16)
+    with autocast(pol):
+        assert h(x32).dtype == jnp.float16
+        assert f(x16).dtype == jnp.float32
+    # inactive outside policy
+    assert h(x32).dtype == jnp.float32
+    assert f(x16).dtype == jnp.float16

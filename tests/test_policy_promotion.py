@@ -1,0 +1,24 @@
+"""Regression: mixed fp16+bf16 promote to fp32 (not an arbitrary half type)."""
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import CastPolicy, apply_op_policy, autocast
+
+
+def test_mixed_half_types_promote_to_fp32():
+    a = jnp.ones((4,), jnp.float16)
+    b = jnp.ones((4,), jnp.bfloat16)
+    with autocast(CastPolicy()):
+        for order in [(a, b), (b, a)]:
+            args, _ = apply_op_policy("add", order)
+            assert args[0].dtype == jnp.float32
+            assert args[1].dtype == jnp.float32
+
+
+def test_half_and_fp64_promotes_to_fp64():
+    a = jnp.ones((4,), jnp.float16)
+    b = jnp.ones((4,), jnp.float64)
+    with autocast(CastPolicy()):
+        args, _ = apply_op_policy("add", (a, b))
+    # CPU x64 is disabled by default so the widest representable is fine as
+    # long as it is not a half type
+    assert args[0].dtype not in (jnp.float16, jnp.bfloat16)

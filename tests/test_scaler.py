@@ -1,0 +1,116 @@
+"""LossScaler dynamics vs the reference contract (apex/amp/scaler.py):
+dynamic init min(max,2**16), halve on overflow, grow x2 after scale_window
+clean steps, min/max clamps; static scaler never skips; unscale writes
+1/scale * grad and sets the overflow flag on non-finite grads."""
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.amp import (LossScaler, init_scaler_state, unscale_grads,
+                          update_scale_state)
+
+
+def test_dynamic_init_defaults():
+    s = LossScaler("dynamic")
+    assert s.dynamic
+    assert s.loss_scale() == 2.0 ** 16
+
+
+def test_dynamic_init_clamped_by_max():
+    s = LossScaler("dynamic", max_loss_scale=2.0 ** 10)
+    assert s.loss_scale() == 2.0 ** 10
+
+
+def test_static_scale():
+    s = LossScaler(128.0)
+    assert not s.dynamic
+    assert s.loss_scale() == 128.0
+    # static never skips and never changes even with overflow flagged
+    s._state = s._state._replace(overflow=jnp.ones((), jnp.int32))
+    assert s.update_scale() is False
+    assert s.loss_scale() == 128.0
+
+
+def test_overflow_halves_and_resets_window():
+    s = LossScaler("dynamic")
+    s._state = s._state._replace(unskipped=jnp.asarray(1500, jnp.int32),
+                                 overflow=jnp.ones((), jnp.int32))
+    assert s.update_scale() is True
+    assert s.loss_scale() == 2.0 ** 15
+    assert s._unskipped == 0
+
+
+def test_growth_after_scale_window():
+    s = LossScaler("dynamic", scale_window=3)
+    for _ in range(2):
+        assert s.update_scale() is False
+        assert s.loss_scale() == 2.0 ** 16
+    s.update_scale()  # third clean step -> grow
+    assert s.loss_scale() == 2.0 ** 17
+    assert s._unskipped == 0
+
+
+def test_growth_clamped_at_max():
+    s = LossScaler("dynamic", scale_window=1, max_loss_scale=2.0 ** 16)
+    s.update_scale()
+    assert s.loss_scale() == 2.0 ** 16
+
+
+def test_halving_clamped_at_min():
+    s = LossScaler("dynamic", min_loss_scale=2.0 ** 16)
+    s._state = s._state._replace(overflow=jnp.ones((), jnp.int32))
+    s.update_scale()
+    assert s.loss_scale() == 2.0 ** 16
+
+
+def test_unscale_writes_master_grads():
+    s = LossScaler(1024.0)
+    model_grads = [jnp.full((8,), 1024.0, jnp.float16),
+                   jnp.full((4, 4), 2.0 * 1024.0, jnp.float16)]
+    masters = [jnp.zeros((8,), jnp.float32), jnp.zeros((4, 4), jnp.float32)]
+    out = s.unscale(model_grads, masters)
+    np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+    np.testing.assert_allclose(np.asarray(out[1]), 2.0)
+    assert s.update_scale() is False
+
+
+def test_unscale_detects_overflow_and_update_skips():
+    s = LossScaler("dynamic")
+    bad = [jnp.asarray([1.0, np.inf], jnp.float16)]
+    masters = [jnp.zeros((2,), jnp.float32)]
+    s.unscale(bad, masters)
+    assert s.update_scale() is True
+    assert s.loss_scale() == 2.0 ** 15
+    # clear_overflow_state resets the flag
+    s.clear_overflow_state()
+    assert s.update_scale() is False
+
+
+def test_unscale_with_stashed_accumulates():
+    s = LossScaler(2.0)
+    model = [jnp.asarray([4.0, 8.0], jnp.float16)]   # scaled by 2
+    stashed = [jnp.asarray([1.0, 1.0], jnp.float32)]  # already unscaled
+    masters = [jnp.zeros((2,), jnp.float32)]
+    out = s.unscale_with_stashed(model, stashed, masters)
+    np.testing.assert_allclose(np.asarray(out[0]), [3.0, 5.0])
+
+
+def test_functional_state_roundtrip_under_jit():
+    import jax
+
+    @jax.jit
+    def step(state, grads):
+        state, masters = unscale_grads(state, grads)
+        state, skip = update_scale_state(state, dynamic=True, scale_window=2000)
+        return state, skip, masters
+
+    state = init_scaler_state("dynamic")
+    grads = [jnp.full((16,), 2.0 ** 16, jnp.float32)]
+    state, skip, masters = step(state, grads)
+    assert not bool(skip)
+    assert float(state.loss_scale) == 2.0 ** 16
+    np.testing.assert_allclose(np.asarray(masters[0]), 1.0)
+
+    bad = [jnp.full((16,), np.nan, jnp.float32)]
+    state, skip, _ = step(state, bad)
+    assert bool(skip)
+    assert float(state.loss_scale) == 2.0 ** 15
