@@ -16,6 +16,9 @@ class AmpState:
         self.opt_properties = None
         self.loss_scalers = []
         self.handle = None
+        # O1's session policy, applied ambiently to every Module call
+        # (the analogue of the reference patching torch globally)
+        self.ambient_policy = None
 
 
 _amp_state = AmpState()

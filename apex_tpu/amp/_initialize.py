@@ -1,16 +1,170 @@
 """Applies the chosen amp Properties to models/optimizers
 (reference: apex/amp/_initialize.py:145-263).
 
-The full implementation lands with the nn/training facade; until then
-``amp.initialize`` fails loudly here instead of deep in a cast path.
+TPU adaptations:
+* model casting operates on apex_tpu.nn.Module parameters (``convert_network``
+  == cast all float params except ``_BatchNorm`` modules, mirroring
+  fp16util.py:60-70);
+* the forward patch is implemented by tagging the model with
+  ``_amp_input_cast_dtype`` / ``_amp_output_cast_dtype`` / ``_amp_policy``
+  attributes that the autograd tape honors on every call — same observable
+  behavior as patching ``model.forward``, but the casts are recorded in the
+  tape program so backward's re-execution sees identical dtypes;
+* O1 installs a trace-time CastPolicy instead of monkey-patching torch.
 """
 from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.modules import Module, _BatchNorm
+from ._amp_state import _amp_state, warn_or_err
+from ._process_optimizer import _process_optimizer
+from .policy import CastPolicy, replay_registrations
+from .scaler import LossScaler
+
+
+def check_models(models):
+    for model in models:
+        if type(model).__name__ == "DistributedDataParallel" and \
+                not type(model).__module__.startswith("apex_tpu"):
+            raise RuntimeError(
+                "Incoming model is an instance of an unsupported parallel "
+                "wrapper. apex_tpu.parallel.DistributedDataParallel must be "
+                "applied AFTER amp.initialize.")
+        if not isinstance(model, Module):
+            raise RuntimeError("amp.initialize expects apex_tpu.nn.Module "
+                               f"models, got {type(model)}")
+
+
+def check_params_fp32(models):
+    for model in models:
+        for name, param in model.named_parameters():
+            if param.requires_grad and not jnp.issubdtype(
+                    param.dtype, jnp.floating):
+                continue
+            if param.requires_grad and \
+                    jnp.dtype(param.dtype) != jnp.dtype(jnp.float32):
+                warn_or_err(
+                    f"Found param {name} with type {param.dtype}, expected "
+                    "float32.  When using amp.initialize, you do not need to "
+                    "call .half()/.bfloat16() on your model before passing "
+                    "it, no matter what optimization level you choose.")
+
+
+def check_optimizers(optimizers):
+    for optim in optimizers:
+        if hasattr(optim, "_amp_stash"):
+            raise RuntimeError(
+                "An incoming optimizer has already been processed by "
+                "amp.initialize; reuse is not supported.")
+
+
+def convert_network(model: Module, dtype):
+    """Cast float params and buffers to ``dtype``, skipping batchnorm modules
+    entirely (params AND running stats stay fp32 — reference fp16util.py:60-70
+    via _initialize.py:176-179)."""
+    model._cast_params(dtype, predicate=lambda m: not isinstance(m,
+                                                                 _BatchNorm))
+    return model
+
+
+def _patch_state_dict_fp32(model: Module):
+    """O2StateDictHook analogue (reference _initialize.py:133-142,207-210):
+    model.state_dict() returns fp32 views of half params."""
+    old_state_dict = model.state_dict
+
+    def fp32_state_dict():
+        sd = old_state_dict()
+        for k, v in sd.items():
+            if jnp.issubdtype(v.dtype, jnp.floating) and \
+                    jnp.dtype(v.dtype) != jnp.dtype(jnp.float32):
+                sd[k] = v.astype(jnp.float32)
+        return sd
+
+    model.state_dict = fp32_state_dict
 
 
 def _initialize(models, optimizers, properties, num_losses=1,
                 cast_model_outputs=None):
-    raise NotImplementedError(
-        "amp.initialize requires the apex_tpu.nn model facade, which is "
-        "being added in the next milestone of this build.  The functional "
-        "amp API (apex_tpu.amp.LossScaler, init_scaler_state, unscale_grads, "
-        "update_scale_state, autocast/CastPolicy) is available now.")
+    from ..optimizers.base import Optimizer
+    from ..parallel.LARC import LARC
+
+    optimizers_was_list = False
+    if isinstance(optimizers, (Optimizer, LARC)):
+        optimizers = [optimizers]
+    elif optimizers is None:
+        optimizers = []
+    elif isinstance(optimizers, list):
+        optimizers_was_list = True
+        check_optimizers(optimizers)
+    else:
+        raise TypeError("optimizers must be either a single optimizer or a "
+                        "list of optimizers.")
+
+    if isinstance(models, Module):
+        models_was_list = False
+        models = [models]
+    elif isinstance(models, list):
+        models_was_list = True
+    else:
+        raise TypeError("models must be either a single model or a list of "
+                        "models.")
+
+    check_models(models)
+    if not _amp_state.allow_incoming_model_not_fp32:
+        check_params_fp32(models)
+
+    if properties.cast_model_type:
+        if properties.keep_batchnorm_fp32:
+            for model in models:
+                convert_network(model, properties.cast_model_type)
+        else:
+            for model in models:
+                model.to(properties.cast_model_type)
+
+        for model in models:
+            model._amp_input_cast_dtype = properties.cast_model_type
+            model._amp_output_cast_dtype = (
+                cast_model_outputs if cast_model_outputs is not None
+                else jnp.float32)
+            _patch_state_dict_fp32(model)
+    elif cast_model_outputs is not None:
+        for model in models:
+            model._amp_output_cast_dtype = cast_model_outputs
+
+    for i, optimizer in enumerate(optimizers):
+        optimizers[i] = _process_optimizer(optimizer, properties)
+
+    _amp_state.loss_scalers = []
+    for _ in range(num_losses):
+        _amp_state.loss_scalers.append(
+            LossScaler(properties.loss_scale,
+                       min_loss_scale=getattr(_amp_state, "min_loss_scale",
+                                              None),
+                       max_loss_scale=getattr(_amp_state, "max_loss_scale",
+                                              2.0 ** 24)))
+
+    if properties.patch_torch_functions:
+        from . import frontend
+        policy = CastPolicy(
+            half_dtype=frontend.get_default_half_dtype(),
+            enabled=True,
+            verbose=(_amp_state.verbosity == 2))
+        replay_registrations(policy)
+        # The reference patches torch *globally* (amp.py:68-177), so every
+        # module — criterion included — sees the casts.  The tape-level
+        # equivalent: an ambient policy applied to every Module call that
+        # has no explicit tags (autograd.record_module_call).
+        _amp_state.handle = policy
+        _amp_state.ambient_policy = policy
+        for model in models:
+            model._amp_policy = policy
+        # the optimizer step itself must not be cast (reference
+        # _initialize.py:239-246) — our optimizers run on raw arrays outside
+        # any policy scope, so nothing to patch.
+
+    if optimizers_was_list:
+        return (models if models_was_list else models[0]), optimizers
+    if models_was_list:
+        return models if len(optimizers) == 0 else (models, optimizers[0])
+    return models[0] if len(optimizers) == 0 else (models[0], optimizers[0])

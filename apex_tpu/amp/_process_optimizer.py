@@ -1,0 +1,437 @@
+"""In-place patching of optimizer instances for amp
+(reference: apex/amp/_process_optimizer.py).
+
+Same observable machinery as the reference: an ``_amp_stash`` holding
+fp16/master param groups, lazy master-weight creation (half param → fp32
+master swapped into ``param_groups``), patched ``step`` (master→model copyback),
+``zero_grad``, ``add_param_group``, and the ``_prepare_amp_backward`` /
+``_post_amp_backward`` pair the ``scale_loss`` context drives.  "fp16" here
+means the session's half dtype (float16 or bfloat16).
+"""
+from __future__ import annotations
+
+import types
+
+import jax.numpy as jnp
+
+from .. import ops
+from ..multi_tensor_apply import multi_tensor_applier
+from ..nn.parameter import Parameter
+from ._amp_state import maybe_print
+
+
+def _is_half(p):
+    return jnp.dtype(p.dtype) in (jnp.dtype(jnp.float16),
+                                  jnp.dtype(jnp.bfloat16))
+
+
+def _is_fp32(p):
+    return jnp.dtype(p.dtype) == jnp.dtype(jnp.float32)
+
+
+class AmpOptimizerState:
+    pass
+
+
+def _master_params_to_model_params(self):
+    stash = self._amp_stash
+    if len(stash.all_fp16_params) > 0:
+        _, new_model = multi_tensor_applier(
+            ops.multi_tensor_scale, ops.zero_flag(),
+            [[p.data for p in stash.all_fp32_from_fp16_params],
+             [p.data for p in stash.all_fp16_params]], 1.0)
+        for mp, nd in zip(stash.all_fp16_params, new_model):
+            mp.data = nd
+
+
+def lazy_init_with_master_weights(self):
+    stash = self._amp_stash
+    stash.fp16_groups = []
+    stash.fp32_from_fp16_groups = []
+    stash.fp32_from_fp32_groups = []
+    for i, param_group in enumerate(self.param_groups):
+        fp16_params_this_group = []
+        fp32_params_this_group = []
+        fp32_from_fp16_params_this_group = []
+        for i, param in enumerate(param_group["params"]):
+            if param.requires_grad:
+                if _is_half(param):
+                    fp16_params_this_group.append(param)
+                    master = Parameter(param.data.astype(jnp.float32),
+                                       name=param.name)
+                    param_group["params"][i] = master
+                    fp32_from_fp16_params_this_group.append(master)
+                    if param in self.state:
+                        self.state[master] = self.state.pop(param)
+                elif _is_fp32(param):
+                    fp32_params_this_group.append(param)
+                else:
+                    raise TypeError(
+                        "Optimizer's parameters must be float32 or half "
+                        f"(float16/bfloat16). Received {param.dtype}")
+        stash.fp16_groups.append(fp16_params_this_group)
+        stash.fp32_from_fp16_groups.append(fp32_from_fp16_params_this_group)
+        stash.fp32_from_fp32_groups.append(fp32_params_this_group)
+
+    stash.all_fp16_params = [p for g in stash.fp16_groups for p in g]
+    stash.all_fp32_from_fp16_params = [
+        p for g in stash.fp32_from_fp16_groups for p in g]
+    stash.all_fp32_from_fp32_params = [
+        p for g in stash.fp32_from_fp32_groups for p in g]
+
+    stash.all_fp16_grad_stash = [None] * len(stash.all_fp16_params)
+    stash.all_fp32_from_fp32_grad_stash = \
+        [None] * len(stash.all_fp32_from_fp32_params)
+
+    for param in stash.all_fp32_from_fp16_params:
+        param.grad = None
+    for param in stash.all_fp32_from_fp32_params:
+        param.grad = None
+
+
+def post_backward_models_are_masters(scaler, params, stashed_grads,
+                                     scale_override=None):
+    grads_have_scale = scaler.loss_scale()
+    stashed_have_scale, out_scale = 1.0, 1.0
+
+    if scaler.loss_scale() == 1.0 and not scaler.dynamic:
+        for i in range(len(stashed_grads)):
+            stashed_grads[i] = None
+        return
+
+    if scale_override is not None:
+        grads_have_scale, stashed_have_scale, out_scale = scale_override
+
+    grads_needing_unscale = []
+    grads_needing_unscale_with_stash = []
+    stashed = []
+    for param, stashed_grad in zip(params, stashed_grads):
+        if param.grad is None and stashed_grad is not None:
+            param.grad = stashed_grad
+        elif param.grad is not None and stashed_grad is None:
+            grads_needing_unscale.append(param)
+        elif param.grad is not None and stashed_grad is not None:
+            grads_needing_unscale_with_stash.append(param)
+            stashed.append(stashed_grad)
+
+    if grads_needing_unscale:
+        new = scaler.unscale(
+            [p.grad for p in grads_needing_unscale],
+            [p.grad for p in grads_needing_unscale],
+            None, models_are_masters=True,
+            scale_override=grads_have_scale / out_scale)
+        for p, g in zip(grads_needing_unscale, new):
+            p.grad = g
+
+    if grads_needing_unscale_with_stash:
+        new = scaler.unscale_with_stashed(
+            [p.grad for p in grads_needing_unscale_with_stash],
+            stashed,
+            [p.grad for p in grads_needing_unscale_with_stash],
+            scale_override=(grads_have_scale, stashed_have_scale, out_scale))
+        for p, g in zip(grads_needing_unscale_with_stash, new):
+            p.grad = g
+
+    for i in range(len(stashed_grads)):
+        stashed_grads[i] = None
+
+
+def prepare_backward_with_master_weights(self):
+    stash = self._amp_stash
+    self._amp_lazy_init()
+    for param in stash.all_fp16_params:
+        # grad copy elision (reference _process_optimizer.py:145-149)
+        param.grad = None
+    for i, param in enumerate(stash.all_fp32_from_fp32_params):
+        stash.all_fp32_from_fp32_grad_stash[i] = param.grad
+        param.grad = None
+
+
+def post_backward_with_master_weights(self, scaler):
+    stash = self._amp_stash
+    self._amp_lazy_init()
+
+    fp16_needing_unscale = []
+    new_masters = []
+    fp16_needing_unscale_with_stash = []
+    preexisting_masters = []
+    for fp16_param, fp32_param in zip(stash.all_fp16_params,
+                                      stash.all_fp32_from_fp16_params):
+        if fp16_param.grad is None:
+            continue
+        if fp32_param.grad is None:
+            fp16_needing_unscale.append(fp16_param)
+            new_masters.append(fp32_param)
+        else:
+            fp16_needing_unscale_with_stash.append(fp16_param)
+            preexisting_masters.append(fp32_param)
+
+    if fp16_needing_unscale:
+        new = scaler.unscale(
+            [p.grad for p in fp16_needing_unscale],
+            [jnp.zeros(p.shape, jnp.float32) for p in fp16_needing_unscale],
+            scaler.loss_scale(), models_are_masters=False)
+        for mp, g in zip(new_masters, new):
+            mp.grad = g
+
+    if fp16_needing_unscale_with_stash:
+        new = scaler.unscale_with_stashed(
+            [p.grad for p in fp16_needing_unscale_with_stash],
+            [p.grad for p in preexisting_masters],
+            [p.grad for p in preexisting_masters])
+        for mp, g in zip(preexisting_masters, new):
+            mp.grad = g
+
+    post_backward_models_are_masters(
+        scaler, stash.all_fp32_from_fp32_params,
+        stash.all_fp32_from_fp32_grad_stash)
+
+
+def lazy_init_no_master_weights(self):
+    stash = self._amp_stash
+    stash.all_fp16_params = []
+    stash.all_fp32_params = []
+    for param_group in self.param_groups:
+        for param in param_group["params"]:
+            if _is_half(param):
+                stash.all_fp16_params.append(param)
+            elif _is_fp32(param):
+                stash.all_fp32_params.append(param)
+            else:
+                raise TypeError(
+                    "Optimizer's parameters must be float32 or half "
+                    f"(float16/bfloat16). Received {param.dtype}")
+    stash.all_fp16_grad_stash = [None] * len(stash.all_fp16_params)
+    stash.all_fp32_grad_stash = [None] * len(stash.all_fp32_params)
+
+
+def prepare_backward_no_master_weights(self):
+    stash = self._amp_stash
+    self._amp_lazy_init()
+    for i, param in enumerate(stash.all_fp16_params):
+        stash.all_fp16_grad_stash[i] = param.grad
+        param.grad = None
+    for i, param in enumerate(stash.all_fp32_params):
+        stash.all_fp32_grad_stash[i] = param.grad
+        param.grad = None
+
+
+def post_backward_no_master_weights(self, scaler):
+    stash = self._amp_stash
+    self._amp_lazy_init()
+    split_types = ((stash.all_fp16_params, stash.all_fp16_grad_stash),
+                   (stash.all_fp32_params, stash.all_fp32_grad_stash))
+    for params, stashed_grads in split_types:
+        post_backward_models_are_masters(scaler, params, stashed_grads)
+
+
+# --------------------------------------------------------------------------
+# FusedSGD versions (reference _process_optimizer.py:252-310): FusedSGD can
+# keep scaled grads and fold 1/scale into the kernel itself.
+# --------------------------------------------------------------------------
+
+def prepare_backward_with_master_weights_FusedSGD(self):
+    if self.materialize_master_grads:
+        prepare_backward_with_master_weights(self)
+    else:
+        stash = self._amp_stash
+        self._amp_lazy_init()
+        for i, param in enumerate(stash.all_fp16_params):
+            stash.all_fp16_grad_stash[i] = param.grad
+            param.grad = None
+        for i, param in enumerate(stash.all_fp32_from_fp32_params):
+            stash.all_fp32_from_fp32_grad_stash[i] = param.grad
+            param.grad = None
+
+
+def post_backward_with_master_weights_FusedSGD(self, scaler):
+    if self.materialize_master_grads:
+        post_backward_with_master_weights(self, scaler)
+    else:
+        stash = self._amp_stash
+        self._amp_lazy_init()
+
+        grads_have_scale = scaler.loss_scale()
+        stashed_have_scale = self.most_recent_scale
+        out_scale = grads_have_scale
+        if self.scale_set_by_backward:
+            out_scale = min(grads_have_scale, self.most_recent_scale)
+
+        split_types = (
+            (stash.all_fp16_params, stash.all_fp16_grad_stash),
+            (stash.all_fp32_from_fp32_params,
+             stash.all_fp32_from_fp32_grad_stash))
+        for params, stashed_grads in split_types:
+            post_backward_models_are_masters(
+                scaler, params, stashed_grads,
+                (grads_have_scale, stashed_have_scale, out_scale))
+
+        self.most_recent_scale = out_scale
+        self.scale_set_by_backward = True
+
+
+def prepare_backward_no_master_weights_FusedSGD(self):
+    prepare_backward_no_master_weights(self)
+
+
+def post_backward_no_master_weights_FusedSGD(self, scaler):
+    post_backward_no_master_weights(self, scaler)
+
+
+def _amp_lazy_init(self):
+    stash = self._amp_stash
+    if not stash.lazy_init_called:
+        self._lazy_init_maybe_master_weights()
+        stash.lazy_init_called = True
+
+
+def _process_optimizer(optimizer, properties):
+    from ..optimizers import FusedSGD
+
+    if hasattr(optimizer, "_amp_stash"):
+        raise RuntimeError("A given optimizer should only be passed through "
+                           "amp.initialize once.")
+    optimizer._amp_stash = AmpOptimizerState()
+    optimizer._amp_stash.lazy_init_called = False
+    optimizer._amp_stash.already_patched = False
+    optimizer._amp_stash.params_have_scaled_gradients = False
+
+    for name in ("_lazy_init_maybe_master_weights",
+                 "_master_params_to_model_params",
+                 "_prepare_amp_backward",
+                 "_post_amp_backward",
+                 "_amp_lazy_init"):
+        if hasattr(optimizer, name):
+            raise RuntimeError(
+                f"Incoming optimizer already has {name} defined.")
+
+    if properties.master_weights:
+        optimizer._lazy_init_maybe_master_weights = types.MethodType(
+            lazy_init_with_master_weights, optimizer)
+        optimizer._master_params_to_model_params = types.MethodType(
+            _master_params_to_model_params, optimizer)
+
+        old_step = optimizer.step
+
+        def new_step(self, closure=None):
+            if closure is not None:
+                raise RuntimeError("Currently, Amp does not support closure "
+                                   "use with optimizers.")
+            retval = old_step()
+            if not isinstance(self, FusedSGD):
+                self._master_params_to_model_params()
+            for param in self._amp_stash.all_fp32_from_fp16_params:
+                param.grad = None
+            return retval
+
+        optimizer.step = types.MethodType(new_step, optimizer)
+
+        old_zero_grad = optimizer.zero_grad  # noqa: F841 (kept for parity)
+
+        def new_zero_grad(self, set_to_none: bool = False):
+            stash = self._amp_stash
+            self._amp_lazy_init()
+            for param in stash.all_fp16_params:
+                if param.grad is not None:
+                    param.grad = None if set_to_none \
+                        else jnp.zeros_like(param.grad)
+            for param in stash.all_fp32_from_fp32_params:
+                if param.grad is not None:
+                    param.grad = None if set_to_none \
+                        else jnp.zeros_like(param.grad)
+            for param in self._amp_stash.all_fp32_from_fp16_params:
+                param.grad = None
+
+        optimizer.zero_grad = types.MethodType(new_zero_grad, optimizer)
+
+        if isinstance(optimizer, FusedSGD):
+            optimizer._prepare_amp_backward = types.MethodType(
+                prepare_backward_with_master_weights_FusedSGD, optimizer)
+            optimizer._post_amp_backward = types.MethodType(
+                post_backward_with_master_weights_FusedSGD, optimizer)
+        else:
+            optimizer._prepare_amp_backward = types.MethodType(
+                prepare_backward_with_master_weights, optimizer)
+            optimizer._post_amp_backward = types.MethodType(
+                post_backward_with_master_weights, optimizer)
+    else:
+        optimizer._lazy_init_maybe_master_weights = types.MethodType(
+            lazy_init_no_master_weights, optimizer)
+        if isinstance(optimizer, FusedSGD):
+            optimizer._prepare_amp_backward = types.MethodType(
+                prepare_backward_no_master_weights_FusedSGD, optimizer)
+            optimizer._post_amp_backward = types.MethodType(
+                post_backward_no_master_weights_FusedSGD, optimizer)
+        else:
+            optimizer._prepare_amp_backward = types.MethodType(
+                prepare_backward_no_master_weights, optimizer)
+            optimizer._post_amp_backward = types.MethodType(
+                post_backward_no_master_weights, optimizer)
+
+    optimizer._amp_lazy_init = types.MethodType(_amp_lazy_init, optimizer)
+
+    old_add_param_group = optimizer.add_param_group
+
+    def new_add_param_group(self, new_group):
+        stash = self._amp_stash
+        if not stash.lazy_init_called:
+            self._lazy_init_maybe_master_weights()
+            stash.lazy_init_called = True
+
+        assert isinstance(new_group, dict), "param group must be a dict"
+        new_params = new_group["params"]
+        if isinstance(new_params, Parameter):
+            new_group["params"] = [new_params]
+        elif isinstance(new_params, set):
+            raise TypeError("optimizer parameters need to be organized in "
+                            "ordered collections; sets are not allowed.")
+        else:
+            new_group["params"] = list(new_params)
+
+        if properties.master_weights:
+            fp16_params_this_group = []
+            fp32_params_this_group = []
+            fp32_from_fp16_params_this_group = []
+            for i, param in enumerate(new_group["params"]):
+                if param.requires_grad:
+                    if _is_half(param):
+                        fp16_params_this_group.append(param)
+                        master = Parameter(param.data.astype(jnp.float32),
+                                           name=param.name)
+                        new_group["params"][i] = master
+                        fp32_from_fp16_params_this_group.append(master)
+                    elif _is_fp32(param):
+                        fp32_params_this_group.append(param)
+                    else:
+                        raise TypeError(
+                            "Optimizer's parameters must be float32 or half "
+                            f"(float16/bfloat16). Received {param.dtype}")
+            stash.fp16_groups.append(fp16_params_this_group)
+            stash.fp32_from_fp16_groups.append(
+                fp32_from_fp16_params_this_group)
+            stash.fp32_from_fp32_groups.append(fp32_params_this_group)
+            stash.all_fp16_params += fp16_params_this_group
+            stash.all_fp32_from_fp16_params += \
+                fp32_from_fp16_params_this_group
+            stash.all_fp32_from_fp32_params += fp32_params_this_group
+            stash.all_fp16_grad_stash += [None] * len(fp16_params_this_group)
+            stash.all_fp32_from_fp32_grad_stash += \
+                [None] * len(fp32_params_this_group)
+        else:
+            for param in new_group["params"]:
+                if _is_half(param):
+                    stash.all_fp16_params.append(param)
+                    stash.all_fp16_grad_stash.append(None)
+                elif _is_fp32(param):
+                    stash.all_fp32_params.append(param)
+                    stash.all_fp32_grad_stash.append(None)
+                else:
+                    raise TypeError(
+                        "Optimizer's parameters must be float32 or half "
+                        f"(float16/bfloat16). Received {param.dtype}")
+
+        old_add_param_group(new_group)
+
+    optimizer.add_param_group = types.MethodType(new_add_param_group,
+                                                 optimizer)
+    return optimizer

@@ -211,6 +211,7 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
 
     _amp_state.opt_properties = Properties()
     _amp_state.verbosity = verbosity
+    _amp_state.ambient_policy = None
 
     if not enabled:
         handle = None

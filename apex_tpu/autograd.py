@@ -1,0 +1,492 @@
+"""Eager-looking autograd over JAX: the piece that lets Apex's imperative
+training-loop API (``out = model(x); loss = crit(out, y);
+scaled_loss.backward(); optimizer.step()``) run on a trace-once functional
+runtime.
+
+How it works, TPU-first rather than torch-tape-faithful:
+
+* ``model(x)`` and tape-aware ops return :class:`Tensor` — a concrete jnp
+  value (usable immediately: print it, branch on it) plus a record of the op
+  and its inputs.
+* ``loss.backward()`` **linearizes** the recorded graph into a hashable
+  program (topologically ordered instruction tuple).  Equal programs across
+  training steps hit a cache of compiled ``jax.value_and_grad`` executables,
+  so the steady-state cost of the imperative API is one compiled XLA program
+  per backward — the Python-side graph build is a few microseconds per op.
+* gradients accumulate into ``Parameter.grad`` (torch semantics, which amp's
+  grad-accumulation path relies on — reference
+  apex/amp/_process_optimizer.py:142-158).
+
+Randomness (dropout) is recorded as a const leaf so the backward re-execution
+sees the identical mask.  BatchNorm running stats update eagerly on the
+forward call and are *not* re-updated by backward's re-execution.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .amp import policy as _policy
+from .nn.parameter import Parameter
+
+Array = jax.Array
+
+_grad_enabled = [True]
+
+
+@contextlib.contextmanager
+def no_grad():
+    _grad_enabled.append(False)
+    try:
+        yield
+    finally:
+        _grad_enabled.pop()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled[-1]
+
+
+# ---------------------------------------------------------------------------
+# Op registry: name -> callable on raw arrays
+# ---------------------------------------------------------------------------
+
+_OPS: Dict[str, Any] = {}
+
+
+def register_op(name: str, fn):
+    _OPS[name] = fn
+    return fn
+
+
+def _init_builtin_ops():
+    _OPS.update({
+        "add": lambda a, b: a + b,
+        "sub": lambda a, b: a - b,
+        "rsub": lambda a, b: b - a,
+        "mul": lambda a, b: a * b,
+        "div": lambda a, b: a / b,
+        "rdiv": lambda a, b: b / a,
+        "pow": lambda a, b: a ** b,
+        "neg": lambda a: -a,
+        "abs": jnp.abs,
+        "exp": jnp.exp,
+        "log": jnp.log,
+        "sqrt": jnp.sqrt,
+        "matmul": lambda a, b: jnp.matmul(
+            a, b, preferred_element_type=jnp.float32).astype(
+                jnp.result_type(a, b)),
+        "sum": lambda a, axis=None, keepdims=False: jnp.sum(
+            a, axis=axis, keepdims=keepdims),
+        "mean": lambda a, axis=None, keepdims=False: jnp.mean(
+            a, axis=axis, keepdims=keepdims),
+        "max": lambda a, axis=None, keepdims=False: jnp.max(
+            a, axis=axis, keepdims=keepdims),
+        "min": lambda a, axis=None, keepdims=False: jnp.min(
+            a, axis=axis, keepdims=keepdims),
+        "reshape": lambda a, shape=None: a.reshape(shape),
+        "transpose": lambda a, axes=None: jnp.transpose(a, axes),
+        "getitem": lambda a, idx=None: a[idx],
+        "getitem_dyn": _getitem_dyn,
+        "astype": lambda a, dtype=None: a.astype(dtype),
+        "squeeze": lambda a, axis=None: jnp.squeeze(a, axis),
+    })
+
+
+_DYN_SLOT = "__dyn_index__"
+
+
+def _getitem_dyn(a, *index_arrays, structure=None):
+    """Rebuild an index tuple whose array elements were lifted as tape
+    inputs (marked by _DYN_SLOT placeholders in ``structure``)."""
+    it = iter(index_arrays)
+    idx = tuple(next(it) if e == _DYN_SLOT else _thaw(e) for e in structure)
+    return a[idx if len(idx) != 1 else idx[0]]
+
+
+_init_builtin_ops()
+
+
+def _is_arraylike(x) -> bool:
+    return isinstance(x, (jax.Array, jnp.ndarray)) or (
+        hasattr(x, "shape") and hasattr(x, "dtype")
+        and not isinstance(x, (Tensor, Parameter)))
+
+
+# ---------------------------------------------------------------------------
+# Tensor
+# ---------------------------------------------------------------------------
+
+class Tensor:
+    """A concrete value + its provenance on the tape."""
+    __slots__ = ("value", "op", "inputs", "static", "module", "m_training",
+                 "m_key", "pol")
+
+    def __init__(self, value, op, inputs=(), static=(), module=None,
+                 m_training=False, m_key=None):
+        self.value = value
+        self.op = op                    # "const" | "param" | "module" | op name
+        self.inputs = tuple(inputs)     # Tensors (for const/param: source)
+        self.static = static            # hashable static arg descriptor
+        self.module = module
+        self.m_training = m_training
+        self.m_key = m_key
+        self.pol = _policy.current_policy()
+
+    # -- numpy-ish surface -------------------------------------------------
+    @property
+    def shape(self):
+        return self.value.shape
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    def item(self):
+        return self.value.item()
+
+    def __float__(self):
+        return float(self.value)
+
+    def __array__(self, dtype=None):
+        import numpy as np
+        return np.asarray(self.value, dtype)
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self.value)
+
+    def detach(self):
+        return Tensor(self.value, "const")
+
+    def __repr__(self):
+        return f"tape.Tensor({self.value!r})"
+
+    # -- graph building ----------------------------------------------------
+    def _binop(self, other, name):
+        return record_op(name, (self, other), {})
+
+    __add__ = lambda self, o: self._binop(o, "add")
+    __radd__ = lambda self, o: self._binop(o, "add")
+    __sub__ = lambda self, o: self._binop(o, "sub")
+    __rsub__ = lambda self, o: self._binop(o, "rsub")
+    __mul__ = lambda self, o: self._binop(o, "mul")
+    __rmul__ = lambda self, o: self._binop(o, "mul")
+    __truediv__ = lambda self, o: self._binop(o, "div")
+    __rtruediv__ = lambda self, o: self._binop(o, "rdiv")
+    __pow__ = lambda self, o: self._binop(o, "pow")
+    __matmul__ = lambda self, o: self._binop(o, "matmul")
+    __neg__ = lambda self: record_op("neg", (self,), {})
+
+    def sum(self, axis=None, keepdims=False):
+        return record_op("sum", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return record_op("mean", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return record_op("max", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return record_op("reshape", (self,), {"shape": shape})
+
+    def view(self, *shape):
+        return self.reshape(*shape)
+
+    def transpose(self, *axes):
+        return record_op("transpose", (self,), {"axes": axes or None})
+
+    def squeeze(self, axis=None):
+        return record_op("squeeze", (self,), {"axis": axis})
+
+    def astype(self, dtype):
+        return record_op("astype", (self,), {"dtype": jnp.dtype(dtype).name})
+
+    def float(self):
+        return self.astype(jnp.float32)
+
+    def half(self):
+        return self.astype(jnp.float16)
+
+    def __getitem__(self, idx):
+        elems = idx if isinstance(idx, tuple) else (idx,)
+        if any(isinstance(e, Tensor) or _is_arraylike(e) for e in elems):
+            # array indices (gathers, boolean masks) are tape inputs, not
+            # static constants — they change between steps and are unhashable
+            arrays = [e for e in elems
+                      if isinstance(e, Tensor) or _is_arraylike(e)]
+            structure = tuple(
+                _DYN_SLOT if (isinstance(e, Tensor) or _is_arraylike(e))
+                else _freeze(e) for e in elems)
+            return record_op("getitem_dyn", (self, *arrays),
+                             {"structure": structure})
+        return record_op("getitem", (self,), {"idx": idx})
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self):
+        backward(self)
+
+
+def lift(x) -> Tensor:
+    """Wrap a raw value / Parameter as a tape leaf."""
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, Parameter):
+        return Tensor(x.data, "param", static=(), module=x)
+    return Tensor(jnp.asarray(x), "const")
+
+
+# ---------------------------------------------------------------------------
+# Recording
+# ---------------------------------------------------------------------------
+
+def _freeze(v):
+    """Make a static kwarg hashable."""
+    if isinstance(v, list):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, slice):
+        return ("__slice__", v.start, v.stop, v.step)
+    if isinstance(v, tuple):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
+def _thaw(v):
+    if isinstance(v, tuple):
+        if len(v) == 4 and v[0] == "__slice__":
+            return slice(v[1], v[2], v[3])
+        return tuple(_thaw(x) for x in v)
+    return v
+
+
+def record_op(name: str, array_args: Sequence, static_kwargs: Dict) -> Tensor:
+    """Record ``name(*array_args, **static_kwargs)``; array_args may mix
+    Tensors, Parameters and raw arrays/scalars."""
+    fn = _OPS[name]
+    inputs = tuple(lift(a) for a in array_args)
+    static = tuple(sorted(
+        (k, _freeze(v)) for k, v in static_kwargs.items()))
+    kwargs = {k: _thaw(v) for k, v in static}
+    args, kwargs2 = _policy.apply_op_policy(
+        name, tuple(t.value for t in inputs), kwargs)
+    value = fn(*args, **kwargs2)
+    if not is_grad_enabled():
+        return Tensor(value, "const")
+    return Tensor(value, name, inputs, static)
+
+
+def _amp_tags(module):
+    """amp.initialize tags models with cast dtypes / an O1 policy
+    (apex_tpu/amp/_initialize.py) — the tape-level equivalent of the
+    reference's model.forward patch (_initialize.py:190-201).  Untagged
+    modules (criterions, user modules) fall back to the session's ambient O1
+    policy, mirroring the reference's global torch patching."""
+    from .amp._amp_state import _amp_state
+    in_cast = getattr(module, "_amp_input_cast_dtype", None)
+    out_cast = getattr(module, "_amp_output_cast_dtype", None)
+    pol = getattr(module, "_amp_policy", None)
+    if pol is None and in_cast is None:
+        pol = _amp_state.ambient_policy
+    return in_cast, out_cast, pol
+
+
+def _run_module(module, ctx, in_vals, in_cast, out_cast, pol):
+    if in_cast is not None:
+        in_vals = tuple(
+            v.astype(in_cast) if hasattr(v, "dtype")
+            and jnp.issubdtype(v.dtype, jnp.floating) else v
+            for v in in_vals)
+    scope = _policy.autocast(pol) if pol is not None \
+        else contextlib.nullcontext()
+    with scope:
+        value = module.forward(ctx, *in_vals)
+    if out_cast is not None and hasattr(value, "dtype") and \
+            jnp.issubdtype(value.dtype, jnp.floating):
+        value = value.astype(out_cast)
+    return value
+
+
+def record_module_call(module, inputs: Sequence):
+    """Module.__call__ entry: run eagerly (stats update now), record for
+    backward re-execution."""
+    from .nn.modules import Ctx
+    needs_key = any(getattr(m, "p", None) is not None
+                    and type(m).__name__ == "Dropout"
+                    for m in module.modules()) and module.training
+    key = None
+    if needs_key:
+        from .nn.modules import _next_key
+        key = _next_key()
+    in_cast, out_cast, pol = _amp_tags(module)
+    in_tensors = tuple(lift(x) for x in inputs)
+    ctx = Ctx(env={}, stats_out=None, training=module.training, key=key)
+    value = _run_module(module, ctx, tuple(t.value for t in in_tensors),
+                        in_cast, out_cast, pol)
+    if not is_grad_enabled():
+        return Tensor(value, "const") if not isinstance(value, tuple) else value
+    t = Tensor(value, "module", in_tensors, module=module,
+               m_training=module.training, m_key=key)
+    t.pol = pol
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Linearization + compiled backward
+# ---------------------------------------------------------------------------
+
+class _Program:
+    """Hashable linearized graph + the live objects needed to execute it."""
+    __slots__ = ("instructions", "modules", "consts", "params", "key_consts",
+                 "cache_key")
+
+    def __init__(self, instructions, modules, consts, params, key_consts,
+                 cache_key):
+        self.instructions = instructions
+        self.modules = modules
+        self.consts = consts
+        self.params = params
+        self.key_consts = key_consts
+        self.cache_key = cache_key
+
+
+def _linearize(root: Tensor) -> _Program:
+    index: Dict[int, int] = {}
+    instructions: List[tuple] = []
+    modules: List = []
+    consts: List[Array] = []
+    params: List[Parameter] = []
+    param_idx: Dict[int, int] = {}
+    key_consts: List = []
+
+    def visit(t: Tensor) -> int:
+        if id(t) in index:
+            return index[id(t)]
+        if t.op == "const":
+            instructions.append(("const", len(consts)))
+            consts.append(t.value)
+        elif t.op == "param":
+            p = t.module  # Parameter stashed in .module slot
+            if id(p) not in param_idx:
+                param_idx[id(p)] = len(params)
+                params.append(p)
+            instructions.append(("param", param_idx[id(p)]))
+        elif t.op == "module":
+            in_idx = tuple(visit(i) for i in t.inputs)
+            mod = t.module
+            m_params = [p for p in mod.parameters() if p is not None]
+            for p in m_params:
+                if id(p) not in param_idx:
+                    param_idx[id(p)] = len(params)
+                    params.append(p)
+            p_idx = tuple(param_idx[id(p)] for p in m_params)
+            key_id = None
+            if t.m_key is not None:
+                key_id = len(key_consts)
+                key_consts.append(t.m_key)
+            in_cast, out_cast, _ = _amp_tags(mod)
+            instructions.append(
+                ("module", len(modules), in_idx, p_idx, t.m_training, key_id,
+                 jnp.dtype(in_cast).name if in_cast is not None else None,
+                 jnp.dtype(out_cast).name if out_cast is not None else None))
+            modules.append((mod, t.pol))
+        else:
+            in_idx = tuple(visit(i) for i in t.inputs)
+            instructions.append(("op", t.op, t.static, in_idx, len(modules)))
+            modules.append((None, t.pol))
+        index[id(t)] = len(instructions) - 1
+        return index[id(t)]
+
+    visit(root)
+    cache_key = (
+        tuple(instructions),
+        tuple((id(m) if m is not None else 0,
+               id(p) if p is not None else 0) for m, p in modules),
+        tuple((v.shape, str(v.dtype)) for v in consts),
+        tuple((p.shape, str(p.dtype)) for p in params),
+    )
+    return _Program(tuple(instructions), modules, consts, params, key_consts,
+                    cache_key)
+
+
+def _execute(program: _Program, param_vals, const_vals, key_vals):
+    """Pure re-execution of the program (used under value_and_grad)."""
+    from .nn.modules import Ctx
+    results: List[Any] = []
+    for ins in program.instructions:
+        kind = ins[0]
+        if kind == "const":
+            results.append(const_vals[ins[1]])
+        elif kind == "param":
+            results.append(param_vals[ins[1]])
+        elif kind == "module":
+            _, mod_i, in_idx, p_idx, training, key_id, in_cast, out_cast = ins
+            mod, pol = program.modules[mod_i]
+            env = {id(program.params[pi]): param_vals[pi] for pi in p_idx}
+            key = key_vals[key_id] if key_id is not None else None
+            ctx = Ctx(env=env, stats_out={}, training=training, key=key)
+            results.append(_run_module(
+                mod, ctx, tuple(results[i] for i in in_idx),
+                jnp.dtype(in_cast) if in_cast else None,
+                jnp.dtype(out_cast) if out_cast else None, pol))
+        else:
+            _, op_name, static, in_idx, mod_i = ins
+            _, pol = program.modules[mod_i]
+            kwargs = {k: _thaw(v) for k, v in static}
+            args = tuple(results[i] for i in in_idx)
+            # re-apply the policy recorded at forward time so backward's
+            # re-execution sees identical dtypes
+            if pol is not None and pol.enabled:
+                args, kwargs = pol.cast_args(op_name, args, kwargs)
+            results.append(_OPS[op_name](*args, **kwargs))
+    return results[-1]
+
+
+# LRU-bounded: each cached executable closes over its _Program (pinning the
+# module/param objects it references), so eviction is what lets dead models
+# be collected in long-lived processes.
+from collections import OrderedDict  # noqa: E402
+
+_compiled_cache: "OrderedDict[Any, Any]" = OrderedDict()
+_COMPILED_CACHE_MAX = 64
+
+
+def backward(root: Tensor):
+    """Compute d(root)/d(params) and accumulate into ``.grad``."""
+    if root.value.size != 1:
+        raise RuntimeError("backward() requires a scalar loss")
+    program = _linearize(root)
+    if not program.params:
+        raise RuntimeError("loss does not depend on any Parameter")
+
+    cached = _compiled_cache.get(program.cache_key)
+    if cached is None:
+        def f(param_vals, const_vals, key_vals, prog=program):
+            out = _execute(prog, param_vals, const_vals, key_vals)
+            return out.astype(jnp.float32).reshape(())
+
+        cached = jax.jit(jax.value_and_grad(f))
+        _compiled_cache[program.cache_key] = cached
+        while len(_compiled_cache) > _COMPILED_CACHE_MAX:
+            _compiled_cache.popitem(last=False)
+    else:
+        # reuse compiled executable: it closed over an older program whose
+        # module/param identities match (enforced by the id-based cache_key)
+        _compiled_cache.move_to_end(program.cache_key)
+
+    loss_val, grads = cached([p.data for p in program.params],
+                             program.consts, program.key_consts)
+    root.value = loss_val.astype(root.value.dtype)
+    for p, g in zip(program.params, grads):
+        if not p.requires_grad:
+            continue
+        g = g.astype(p.dtype)
+        p.grad = g if p.grad is None else p.grad + g

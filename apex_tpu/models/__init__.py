@@ -1,0 +1,2 @@
+from .resnet import (ResNet, BasicBlock, Bottleneck, resnet18, resnet34,
+                     resnet50, resnet101)  # noqa: F401

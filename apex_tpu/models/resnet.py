@@ -1,0 +1,123 @@
+"""ResNet family (torchvision-compatible architecture), the flagship model
+for the ImageNet benchmark config (reference: examples/imagenet/main_amp.py
+uses torchvision resnet50; we are standalone so the architecture lives here).
+
+NCHW layout to match the reference's data pipeline; XLA lays out for TPU
+internally.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, in_planes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(in_planes, planes, 3, stride=stride,
+                               padding=1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.relu = nn.ReLU()
+        self.conv2 = nn.Conv2d(planes, planes, 3, padding=1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.downsample = downsample
+
+    def forward(self, ctx, x):
+        identity = x
+        out = self.bn1.forward(ctx, self.conv1.forward(ctx, x))
+        out = self.relu.forward(ctx, out)
+        out = self.bn2.forward(ctx, self.conv2.forward(ctx, out))
+        if self.downsample is not None:
+            identity = self.downsample.forward(ctx, x)
+        return self.relu.forward(ctx, out + identity)
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, in_planes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(in_planes, planes, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride=stride, padding=1,
+                               bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = nn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(planes * 4)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, ctx, x):
+        identity = x
+        out = self.relu.forward(ctx, self.bn1.forward(
+            ctx, self.conv1.forward(ctx, x)))
+        out = self.relu.forward(ctx, self.bn2.forward(
+            ctx, self.conv2.forward(ctx, out)))
+        out = self.bn3.forward(ctx, self.conv3.forward(ctx, out))
+        if self.downsample is not None:
+            identity = self.downsample.forward(ctx, x)
+        return self.relu.forward(ctx, out + identity)
+
+
+class ResNet(nn.Module):
+    def __init__(self, block, layers, num_classes=1000, small_input=False):
+        """``small_input`` uses the CIFAR stem (3x3 conv, no maxpool)."""
+        super().__init__()
+        self.in_planes = 64
+        if small_input:
+            self.conv1 = nn.Conv2d(3, 64, 3, stride=1, padding=1, bias=False)
+            self.maxpool = nn.Identity()
+        else:
+            self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False)
+            self.maxpool = nn.MaxPool2d(3, stride=2, padding=1)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.relu = nn.ReLU()
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
+        self.avgpool = nn.AdaptiveAvgPool2d((1, 1))
+        self.flatten = nn.Flatten()
+        self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.in_planes != planes * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2d(self.in_planes, planes * block.expansion, 1,
+                          stride=stride, bias=False),
+                nn.BatchNorm2d(planes * block.expansion))
+        layers = [block(self.in_planes, planes, stride, downsample)]
+        self.in_planes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.in_planes, planes))
+        return nn.Sequential(*layers)
+
+    def forward(self, ctx, x):
+        x = self.relu.forward(ctx, self.bn1.forward(
+            ctx, self.conv1.forward(ctx, x)))
+        x = self.maxpool.forward(ctx, x)
+        x = self.layer1.forward(ctx, x)
+        x = self.layer2.forward(ctx, x)
+        x = self.layer3.forward(ctx, x)
+        x = self.layer4.forward(ctx, x)
+        x = self.avgpool.forward(ctx, x)
+        x = self.flatten.forward(ctx, x)
+        return self.fc.forward(ctx, x)
+
+
+def resnet18(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, **kw)
+
+
+def resnet34(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw):
+    return ResNet(Bottleneck, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet101(num_classes=1000, **kw):
+    return ResNet(Bottleneck, [3, 4, 23, 3], num_classes, **kw)

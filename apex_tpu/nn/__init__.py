@@ -1,1 +1,9 @@
+from . import functional  # noqa: F401
 from .parameter import Parameter, is_param, param_grads, param_values  # noqa: F401
+from .modules import (  # noqa: F401
+    AdaptiveAvgPool2d, AvgPool2d, BatchNorm1d, BatchNorm2d, BatchNorm3d,
+    BCELoss, BCEWithLogitsLoss, Buffer, Conv1d, Conv2d, Conv3d,
+    ConvTranspose2d, CrossEntropyLoss, Ctx, Dropout, Embedding, Flatten,
+    GELU, Identity, L1Loss, LayerNorm, LeakyReLU, Linear, MaxPool2d,
+    Module, ModuleList, MSELoss, NLLLoss, ReLU, Sequential, Sigmoid,
+    Softmax, Tanh, _BatchNorm, manual_seed)

@@ -1,0 +1,360 @@
+"""Policy-aware functional ops — the framework's op vocabulary.
+
+Every op consults the active amp cast policy (apex_tpu.amp.policy) at trace
+time, giving the reference's O1 behavior (whitelist→half, blacklist→fp32,
+promote, banned — apex/amp/lists/) without monkey-patching.  All ops are pure
+jnp/lax and jit-friendly; convs and matmuls rely on the MXU's native
+fp32 accumulation for half-precision inputs (XLA's default on TPU;
+``preferred_element_type`` is deliberately NOT used because its fp32 outputs
+break the conv transpose rule under autodiff with half weights).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..amp.policy import apply_op_policy
+
+Array = jax.Array
+
+
+def _policied(op_name):
+    """Decorator: run the op with args cast per the active amp policy."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            args, kwargs = apply_op_policy(op_name, args, kwargs)
+            return fn(*args, **kwargs)
+        wrapper._op_name = op_name
+        return wrapper
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# MXU ops (half list)
+# ---------------------------------------------------------------------------
+
+@_policied("linear")
+def linear(x: Array, weight: Array, bias: Optional[Array] = None) -> Array:
+    """x @ W^T + b with torch Linear weight layout (out, in)."""
+    y = jnp.matmul(x, weight.T)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@_policied("matmul")
+def matmul(a: Array, b: Array) -> Array:
+    return jnp.matmul(a, b)
+
+
+def _conv_dn(ndim):
+    # torch layout: input NCHW, kernel OIHW
+    if ndim == 1:
+        return ("NCH", "OIH", "NCH")
+    if ndim == 2:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, ndim,
+          transposed=False, output_padding=0):
+    if isinstance(stride, int):
+        stride = (stride,) * ndim
+    if isinstance(dilation, int):
+        dilation = (dilation,) * ndim
+    if isinstance(padding, int):
+        padding = ((padding, padding),) * ndim
+    elif isinstance(padding, (tuple, list)) and padding and \
+            isinstance(padding[0], int):
+        padding = tuple((p, p) for p in padding)
+    spec = _conv_dn(ndim)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, spec)
+    if transposed:
+        if isinstance(output_padding, int):
+            output_padding = (output_padding,) * ndim
+        pads = []
+        k = weight.shape[2:]
+        for i in range(ndim):
+            eff_k = (k[i] - 1) * dilation[i] + 1
+            lo = eff_k - 1 - padding[i][0]
+            hi = eff_k - 1 - padding[i][1] + output_padding[i]
+            pads.append((lo, hi))
+        y = lax.conv_transpose(
+            x, weight, strides=stride, padding=pads,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            transpose_kernel=True)
+    else:
+        y = lax.conv_general_dilated(
+            x, weight, window_strides=stride, padding=padding,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * ndim)
+    return y
+
+
+@_policied("conv1d")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1)
+
+
+@_policied("conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2)
+
+
+@_policied("conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3)
+
+
+@_policied("conv_transpose2d")
+def conv_transpose2d(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1):
+    # torch transposed-conv kernel layout is (in, out, kH, kW): swap to OIHW
+    weight = jnp.swapaxes(weight, 0, 1)
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 transposed=True, output_padding=output_padding)
+
+
+# ---------------------------------------------------------------------------
+# Normalization (float list)
+# ---------------------------------------------------------------------------
+
+@_policied("batch_norm")
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.1, eps=1e-5,
+               axis_name=None, axis_index_groups=None):
+    """torch-semantics batch norm over axis 1 (NC...).
+
+    When ``axis_name`` is given and we are inside a mapped axis, batch
+    statistics are averaged across that mesh axis — this is the SyncBatchNorm
+    collective path (reference: apex/parallel/optimized_sync_batchnorm_kernel.py:30-45,
+    all_gather + welford merge; here a psum of (sum, sqsum, count) is the
+    TPU-native equivalent).  Returns (y, new_running_mean, new_running_var).
+    """
+    reduce_axes = (0,) + tuple(range(2, x.ndim))
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    xf = x.astype(jnp.float32)
+    if training:
+        local_count = 1
+        for a in reduce_axes:
+            local_count *= x.shape[a]
+        s = jnp.sum(xf, axis=reduce_axes)
+        sq = jnp.sum(xf * xf, axis=reduce_axes)
+        count = jnp.asarray(local_count, jnp.float32)
+        if axis_name is not None:
+            s = lax.psum(s, axis_name, axis_index_groups=axis_index_groups)
+            sq = lax.psum(sq, axis_name, axis_index_groups=axis_index_groups)
+            count = lax.psum(count, axis_name,
+                             axis_index_groups=axis_index_groups)
+        mean = s / count
+        var = sq / count - mean * mean  # biased, used for normalization
+        # unbiased variance feeds the running stats (reference
+        # sync_batchnorm.py:114-121)
+        unbiased = var * (count / jnp.maximum(count - 1.0, 1.0))
+        new_rm = (1 - momentum) * running_mean + momentum * mean \
+            if running_mean is not None else None
+        new_rv = (1 - momentum) * running_var + momentum * unbiased \
+            if running_var is not None else None
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    y = (xf - mean.reshape(shape)) * inv.reshape(shape)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y.astype(x.dtype), new_rm, new_rv
+
+
+@_policied("layer_norm")
+def layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5):
+    n = len(normalized_shape) if isinstance(normalized_shape, (tuple, list)) \
+        else 1
+    axes = tuple(range(x.ndim - n, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Activations (match-input unless listed)
+# ---------------------------------------------------------------------------
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@_policied("gelu")
+def gelu(x, approximate="tanh"):
+    return jax.nn.gelu(x, approximate=(approximate == "tanh"))
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@_policied("softmax")
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@_policied("log_softmax")
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def dropout(x, p=0.5, training=True, key=None):
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        raise ValueError("dropout in training mode requires a PRNG key")
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+def max_pool2d(x, kernel_size, stride=None, padding=0):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    # init must stay a Python scalar: a traced/committed array init stops
+    # JAX recognizing the max monoid, breaking reverse AD under jit
+    neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, neg_inf, lax.max,
+        (1, 1) + kernel_size, (1, 1) + stride,
+        ((0, 0), (0, 0)) + tuple(padding))
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    s = lax.reduce_window(
+        x.astype(jnp.float32), 0.0, lax.add,
+        (1, 1) + kernel_size, (1, 1) + stride,
+        ((0, 0), (0, 0)) + tuple(padding))
+    return (s / (kernel_size[0] * kernel_size[1])).astype(x.dtype)
+
+
+def adaptive_avg_pool2d(x, output_size=(1, 1)):
+    if output_size not in ((1, 1), 1):
+        raise NotImplementedError("only global adaptive average pooling")
+    return jnp.mean(x.astype(jnp.float32), axis=(2, 3),
+                    keepdims=True).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Losses (float list)
+# ---------------------------------------------------------------------------
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+@_policied("cross_entropy")
+def cross_entropy(logits, target, weight=None, reduction="mean",
+                  label_smoothing=0.0):
+    """Softmax cross entropy with integer class targets (torch semantics:
+    logits (N, C, ...), target (N, ...))."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=1)
+    tgt = jax.nn.one_hot(target, logits.shape[1], axis=1, dtype=logp.dtype)
+    if label_smoothing > 0.0:
+        c = logits.shape[1]
+        tgt = tgt * (1.0 - label_smoothing) + label_smoothing / c
+    nll = -(tgt * logp).sum(axis=1)
+    if weight is not None:
+        w = weight[target]
+        nll = nll * w
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.sum(w)
+    return _reduce(nll, reduction)
+
+
+@_policied("nll_loss")
+def nll_loss(logp, target, reduction="mean"):
+    nll = -jnp.take_along_axis(logp, target[:, None], axis=1)[:, 0]
+    return _reduce(nll, reduction)
+
+
+@_policied("mse_loss")
+def mse_loss(input, target, reduction="mean"):
+    return _reduce(jnp.square(input - target), reduction)
+
+
+@_policied("l1_loss")
+def l1_loss(input, target, reduction="mean"):
+    return _reduce(jnp.abs(input - target), reduction)
+
+
+@_policied("binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logits, target, reduction="mean"):
+    logits = logits.astype(jnp.float32)
+    loss = jnp.maximum(logits, 0) - logits * target + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _reduce(loss, reduction)
+
+
+@_policied("binary_cross_entropy")
+def binary_cross_entropy(probs, target, reduction="mean"):
+    # reaching here at all means the policy allowed it (allow_banned)
+    probs = probs.astype(jnp.float32)
+    eps = 1e-12
+    loss = -(target * jnp.log(probs + eps)
+             + (1 - target) * jnp.log(1 - probs + eps))
+    return _reduce(loss, reduction)
+
+
+# ---------------------------------------------------------------------------
+# Misc
+# ---------------------------------------------------------------------------
+
+def embedding(ids, weight):
+    return weight[ids]
+
+
+def flatten(x, start_dim=1):
+    return x.reshape(x.shape[:start_dim] + (-1,))
+
+
+def pad(x, pad_width, value=0.0):
+    return jnp.pad(x, pad_width, constant_values=value)

@@ -1,0 +1,645 @@
+"""torch-shaped stateful modules over a pure functional execution core.
+
+A Module owns Parameters/Buffers (mutable handles on jax Arrays) and defines
+``forward(ctx, x)`` in terms of ``apex_tpu.nn.functional`` ops, reading every
+parameter through ``ctx.value(param)``.  The Ctx indirection is what makes the
+stateful API differentiable and jittable: the autograd tape (and the fused
+train-step builder) re-run ``forward`` with tracer arrays substituted for the
+stored values, while plain eager calls read ``param.data`` directly.
+
+This replaces the reference's reliance on torch.nn (Apex wraps/patches torch
+modules; we are standalone) — the API mirrors torch so Apex users can port
+models mechanically.
+"""
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from .parameter import Parameter
+
+_global_seed = [jax.random.PRNGKey(0)]
+
+
+def manual_seed(seed: int):
+    _global_seed[0] = jax.random.PRNGKey(seed)
+
+
+def _next_key():
+    _global_seed[0], sub = jax.random.split(_global_seed[0])
+    return sub
+
+
+class Buffer:
+    """Non-trainable module state (e.g. BN running stats)."""
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = jnp.asarray(data)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+
+class Ctx:
+    """Execution context threaded through forward passes.
+
+    env maps id(Parameter/Buffer) -> substituted array (autodiff/jit);
+    stats_out, when a dict, collects new buffer values instead of writing
+    them eagerly (pure mode); key supplies dropout randomness.
+    """
+    __slots__ = ("env", "stats_out", "training", "key", "_key_idx")
+
+    def __init__(self, env=None, stats_out=None, training=False, key=None):
+        self.env = env or {}
+        self.stats_out = stats_out
+        self.training = training
+        self.key = key
+        self._key_idx = 0
+
+    def value(self, p):
+        v = self.env.get(id(p))
+        return p.data if v is None else v
+
+    def write_stat(self, buf: Buffer, value):
+        if self.stats_out is None:
+            buf.data = value
+        else:
+            self.stats_out[id(buf)] = value
+
+    def next_key(self):
+        if self.key is None:
+            raise ValueError("this forward needs randomness (dropout); run "
+                             "in training mode via the tape or pass a key")
+        self._key_idx += 1
+        return jax.random.fold_in(self.key, self._key_idx)
+
+
+class Module:
+    def __init__(self):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_modules", OrderedDict())
+        object.__setattr__(self, "training", True)
+
+    # -- registration ------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self._parameters[name] = value
+        elif isinstance(value, Buffer):
+            self._buffers[name] = value
+        elif isinstance(value, Module):
+            self._modules[name] = value
+        object.__setattr__(self, name, value)
+
+    def register_parameter(self, name, param: Optional[Parameter]):
+        if param is None:
+            self._parameters.pop(name, None)
+            object.__setattr__(self, name, None)
+        else:
+            setattr(self, name, param)
+
+    def register_buffer(self, name, buf):
+        if buf is None:
+            self._buffers.pop(name, None)
+            object.__setattr__(self, name, None)
+        else:
+            setattr(self, name, buf if isinstance(buf, Buffer) else Buffer(buf))
+
+    # -- traversal ---------------------------------------------------------
+    def named_modules(self, prefix="") -> Iterator[Tuple[str, "Module"]]:
+        yield prefix, self
+        for name, child in self._modules.items():
+            sub = f"{prefix}.{name}" if prefix else name
+            yield from child.named_modules(sub)
+
+    def modules(self):
+        for _, m in self.named_modules():
+            yield m
+
+    def children(self):
+        return iter(self._modules.values())
+
+    def named_children(self):
+        return iter(self._modules.items())
+
+    def named_parameters(self, prefix="") -> Iterator[Tuple[str, Parameter]]:
+        for mod_name, mod in self.named_modules(prefix):
+            for p_name, p in mod._parameters.items():
+                yield (f"{mod_name}.{p_name}" if mod_name else p_name), p
+
+    def parameters(self):
+        for _, p in self.named_parameters():
+            yield p
+
+    def named_buffers(self, prefix=""):
+        for mod_name, mod in self.named_modules(prefix):
+            for b_name, b in mod._buffers.items():
+                yield (f"{mod_name}.{b_name}" if mod_name else b_name), b
+
+    def buffers(self):
+        for _, b in self.named_buffers():
+            yield b
+
+    def apply(self, fn):
+        for m in self.modules():
+            fn(m)
+        return self
+
+    # -- modes / casting ---------------------------------------------------
+    def train(self, mode=True):
+        for m in self.modules():
+            object.__setattr__(m, "training", mode)
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    def _cast_params(self, dtype, predicate=None):
+        # like torch Module.to/half: float params AND float buffers are cast
+        for m in self.modules():
+            if predicate is not None and not predicate(m):
+                continue
+            for name, p in m._parameters.items():
+                if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+                    p.data = p.data.astype(dtype)
+            for name, b in m._buffers.items():
+                if b is not None and jnp.issubdtype(b.dtype, jnp.floating):
+                    b.data = b.data.astype(dtype)
+        return self
+
+    def to(self, dtype):
+        return self._cast_params(dtype)
+
+    def half(self):
+        return self.to(jnp.float16)
+
+    def bfloat16(self):
+        return self.to(jnp.bfloat16)
+
+    def float(self):
+        return self.to(jnp.float32)
+
+    # -- checkpointing -----------------------------------------------------
+    def state_dict(self):
+        sd = OrderedDict()
+        for name, p in self.named_parameters():
+            sd[name] = p.data
+        for name, b in self.named_buffers():
+            sd[name] = b.data
+        return sd
+
+    def load_state_dict(self, sd, strict=True):
+        own = dict(self.named_parameters())
+        own_buf = dict(self.named_buffers())
+        missing = [k for k in list(own) + list(own_buf) if k not in sd]
+        unexpected = [k for k in sd if k not in own and k not in own_buf]
+        if strict and (missing or unexpected):
+            raise RuntimeError(
+                f"Error(s) in loading state_dict: missing {missing}, "
+                f"unexpected {unexpected}")
+        for k, v in sd.items():
+            if k in own:
+                own[k].data = jnp.asarray(v, own[k].dtype)
+            elif k in own_buf:
+                own_buf[k].data = jnp.asarray(v, own_buf[k].dtype)
+        return self
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, ctx: Ctx, *inputs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from ..autograd import record_module_call
+        return record_module_call(self, inputs)
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        lines = [f"{type(self).__name__}({self.extra_repr()}"]
+        for name, child in self._modules.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {child_repr}")
+        return "\n".join(lines) + ")" if len(lines) > 1 else lines[0] + ")"
+
+
+# ---------------------------------------------------------------------------
+# Leaf layers (torch init conventions: kaiming-uniform weights,
+# 1/sqrt(fan_in) bias bounds)
+# ---------------------------------------------------------------------------
+
+def _kaiming_uniform(key, shape, fan_in, a=math.sqrt(5)):
+    gain = math.sqrt(2.0 / (1 + a * a))
+    bound = gain * math.sqrt(3.0 / fan_in)
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
+
+
+class Linear(Module):
+    def __init__(self, in_features, out_features, bias=True):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = Parameter(
+            _kaiming_uniform(_next_key(), (out_features, in_features),
+                             in_features))
+        if bias:
+            bound = 1 / math.sqrt(in_features)
+            self.bias = Parameter(jax.random.uniform(
+                _next_key(), (out_features,), jnp.float32, -bound, bound))
+        else:
+            self.register_parameter("bias", None)
+
+    def forward(self, ctx, x):
+        b = ctx.value(self.bias) if self.bias is not None else None
+        return F.linear(x, ctx.value(self.weight), b)
+
+    def extra_repr(self):
+        return f"in={self.in_features}, out={self.out_features}"
+
+
+class _ConvNd(Module):
+    _fn = None
+    _ndim = 2
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias=True):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * self._ndim
+        self.in_channels, self.out_channels = in_channels, out_channels
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding, self.dilation, self.groups = padding, dilation, groups
+        fan_in = in_channels // groups
+        for k in kernel_size:
+            fan_in *= k
+        self.weight = Parameter(_kaiming_uniform(
+            _next_key(),
+            (out_channels, in_channels // groups) + kernel_size, fan_in))
+        if bias:
+            bound = 1 / math.sqrt(fan_in)
+            self.bias = Parameter(jax.random.uniform(
+                _next_key(), (out_channels,), jnp.float32, -bound, bound))
+        else:
+            self.register_parameter("bias", None)
+
+    def forward(self, ctx, x):
+        b = ctx.value(self.bias) if self.bias is not None else None
+        return type(self)._fn(
+            x, ctx.value(self.weight), b, stride=self.stride,
+            padding=self.padding, dilation=self.dilation, groups=self.groups)
+
+    def extra_repr(self):
+        return (f"{self.in_channels}, {self.out_channels}, "
+                f"kernel_size={self.kernel_size}, stride={self.stride}")
+
+
+class Conv1d(_ConvNd):
+    _fn = staticmethod(F.conv1d)
+    _ndim = 1
+
+
+class Conv2d(_ConvNd):
+    _fn = staticmethod(F.conv2d)
+    _ndim = 2
+
+
+class Conv3d(_ConvNd):
+    _fn = staticmethod(F.conv3d)
+    _ndim = 3
+
+
+class ConvTranspose2d(Module):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, bias=True):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self.stride, self.padding = stride, padding
+        self.output_padding = output_padding
+        fan_in = in_channels * kernel_size[0] * kernel_size[1]
+        self.weight = Parameter(_kaiming_uniform(
+            _next_key(), (in_channels, out_channels) + kernel_size, fan_in))
+        if bias:
+            bound = 1 / math.sqrt(fan_in)
+            self.bias = Parameter(jax.random.uniform(
+                _next_key(), (out_channels,), jnp.float32, -bound, bound))
+        else:
+            self.register_parameter("bias", None)
+
+    def forward(self, ctx, x):
+        b = ctx.value(self.bias) if self.bias is not None else None
+        return F.conv_transpose2d(
+            x, ctx.value(self.weight), b, stride=self.stride,
+            padding=self.padding, output_padding=self.output_padding)
+
+
+class _BatchNorm(Module):
+    """Shared core of BatchNorm1d/2d/3d (reference keeps BN fp32 under O2 —
+    amp's convert_network skips casting these, fp16util.py:60-70; our
+    _initialize uses the same predicate on isinstance(_BatchNorm))."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True):
+        super().__init__()
+        self.num_features = num_features
+        self.eps, self.momentum, self.affine = eps, momentum, affine
+        self.track_running_stats = track_running_stats
+        if affine:
+            self.weight = Parameter(jnp.ones((num_features,), jnp.float32))
+            self.bias = Parameter(jnp.zeros((num_features,), jnp.float32))
+        else:
+            self.register_parameter("weight", None)
+            self.register_parameter("bias", None)
+        if track_running_stats:
+            self.running_mean = Buffer(jnp.zeros((num_features,), jnp.float32))
+            self.running_var = Buffer(jnp.ones((num_features,), jnp.float32))
+            self.num_batches_tracked = Buffer(jnp.zeros((), jnp.int32))
+        else:
+            self.register_buffer("running_mean", None)
+            self.register_buffer("running_var", None)
+
+    # overridden by parallel.SyncBatchNorm
+    def _stats_args(self):
+        return dict(axis_name=None, axis_index_groups=None)
+
+    def forward(self, ctx, x):
+        training = ctx.training and self.training
+        rm = ctx.value(self.running_mean) if self.running_mean is not None \
+            else None
+        rv = ctx.value(self.running_var) if self.running_var is not None \
+            else None
+        w = ctx.value(self.weight) if self.weight is not None else None
+        b = ctx.value(self.bias) if self.bias is not None else None
+        y, new_rm, new_rv = F.batch_norm(
+            x, rm, rv, w, b, training=training or rm is None,
+            momentum=self.momentum, eps=self.eps, **self._stats_args())
+        if training and self.track_running_stats:
+            ctx.write_stat(self.running_mean, new_rm)
+            ctx.write_stat(self.running_var, new_rv)
+            ctx.write_stat(self.num_batches_tracked,
+                           ctx.value(self.num_batches_tracked) + 1)
+        return y
+
+    def extra_repr(self):
+        return f"{self.num_features}, eps={self.eps}, momentum={self.momentum}"
+
+
+class BatchNorm1d(_BatchNorm):
+    pass
+
+
+class BatchNorm2d(_BatchNorm):
+    pass
+
+
+class BatchNorm3d(_BatchNorm):
+    pass
+
+
+class LayerNorm(Module):
+    def __init__(self, normalized_shape, eps=1e-5, elementwise_affine=True):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        if elementwise_affine:
+            self.weight = Parameter(jnp.ones(self.normalized_shape, jnp.float32))
+            self.bias = Parameter(jnp.zeros(self.normalized_shape, jnp.float32))
+        else:
+            self.register_parameter("weight", None)
+            self.register_parameter("bias", None)
+
+    def forward(self, ctx, x):
+        w = ctx.value(self.weight) if self.weight is not None else None
+        b = ctx.value(self.bias) if self.bias is not None else None
+        return F.layer_norm(x, self.normalized_shape, w, b, self.eps)
+
+
+class Embedding(Module):
+    def __init__(self, num_embeddings, embedding_dim):
+        super().__init__()
+        self.weight = Parameter(jax.random.normal(
+            _next_key(), (num_embeddings, embedding_dim), jnp.float32))
+
+    def forward(self, ctx, ids):
+        return F.embedding(ids, ctx.value(self.weight))
+
+
+class Dropout(Module):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, ctx, x):
+        training = ctx.training and self.training
+        if not training or self.p == 0.0:
+            return x
+        return F.dropout(x, self.p, training=True, key=ctx.next_key())
+
+
+class ReLU(Module):
+    def __init__(self, inplace=False):
+        super().__init__()
+
+    def forward(self, ctx, x):
+        return F.relu(x)
+
+
+class LeakyReLU(Module):
+    def __init__(self, negative_slope=0.01, inplace=False):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, ctx, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class GELU(Module):
+    def forward(self, ctx, x):
+        return F.gelu(x)
+
+
+class Tanh(Module):
+    def forward(self, ctx, x):
+        return F.tanh(x)
+
+
+class Sigmoid(Module):
+    def forward(self, ctx, x):
+        return F.sigmoid(x)
+
+
+class Softmax(Module):
+    def __init__(self, dim=-1):
+        super().__init__()
+        self.dim = dim
+
+    def forward(self, ctx, x):
+        return F.softmax(x, axis=self.dim)
+
+
+class MaxPool2d(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def forward(self, ctx, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool2d(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def forward(self, ctx, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AdaptiveAvgPool2d(Module):
+    def __init__(self, output_size=(1, 1)):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, ctx, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+class Flatten(Module):
+    def __init__(self, start_dim=1):
+        super().__init__()
+        self.start_dim = start_dim
+
+    def forward(self, ctx, x):
+        return F.flatten(x, self.start_dim)
+
+
+class Identity(Module):
+    def forward(self, ctx, x):
+        return x
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+class CrossEntropyLoss(Module):
+    def __init__(self, weight=None, reduction="mean", label_smoothing=0.0):
+        super().__init__()
+        self.weight = None if weight is None else jnp.asarray(weight)
+        self.reduction = reduction
+        self.label_smoothing = label_smoothing
+
+    def forward(self, ctx, logits, target):
+        return F.cross_entropy(logits, target, self.weight, self.reduction,
+                               self.label_smoothing)
+
+
+class MSELoss(Module):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, ctx, input, target):
+        return F.mse_loss(input, target, self.reduction)
+
+
+class L1Loss(Module):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, ctx, input, target):
+        return F.l1_loss(input, target, self.reduction)
+
+
+class BCELoss(Module):
+    """Banned under O1 amp, as in the reference
+    (apex/amp/lists/functional_overrides.py:70-80)."""
+
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, ctx, input, target):
+        return F.binary_cross_entropy(input, target, self.reduction)
+
+
+class BCEWithLogitsLoss(Module):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, ctx, input, target):
+        return F.binary_cross_entropy_with_logits(input, target,
+                                                  self.reduction)
+
+
+class NLLLoss(Module):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, ctx, logp, target):
+        return F.nll_loss(logp, target, self.reduction)
+
+
+# ---------------------------------------------------------------------------
+# Containers
+# ---------------------------------------------------------------------------
+
+class Sequential(Module):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], OrderedDict):
+            for name, layer in layers[0].items():
+                setattr(self, name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                setattr(self, str(i), layer)
+
+    def forward(self, ctx, x):
+        for child in self._modules.values():
+            x = child.forward(ctx, x)
+        return x
+
+    def __iter__(self):
+        return iter(self._modules.values())
+
+    def __len__(self):
+        return len(self._modules)
+
+    def __getitem__(self, idx):
+        return list(self._modules.values())[idx]
+
+
+class ModuleList(Module):
+    def __init__(self, mods=()):
+        super().__init__()
+        for i, m in enumerate(mods):
+            setattr(self, str(i), m)
+
+    def append(self, m):
+        setattr(self, str(len(self._modules)), m)
+        return self
+
+    def __iter__(self):
+        return iter(self._modules.values())
+
+    def __len__(self):
+        return len(self._modules)
+
+    def __getitem__(self, idx):
+        return list(self._modules.values())[idx]

@@ -1,0 +1,1 @@
+from .step import StepState, TrainStep, make_train_step  # noqa: F401

@@ -1,0 +1,277 @@
+"""Fused train-step builder: the TPU-native fast path.
+
+Where the reference's hot loop is Python driving kernels (SURVEY.md §3.2),
+here the entire iteration — forward, backward, unscale + overflow check,
+conditional skip, optimizer update, loss-scale update, BN running stats —
+compiles into ONE XLA executable with zero host round-trips.  The stateful
+facade (model/optimizer/scaler objects) is synchronized from the returned
+device state, so the imperative API and the fused path are interchangeable.
+
+This is the path ``bench.py``, the examples and DistributedDataParallel use;
+``amp.scale_loss`` + ``loss.backward()`` (apex_tpu.autograd) is the
+API-parity path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..amp.scaler import ScalerState, update_scale_state
+from ..nn.modules import Ctx
+from ..nn.parameter import Parameter
+
+
+class StepState(NamedTuple):
+    """Device-side training state for the fused step."""
+    master_params: list          # fp32 masters (or the params themselves)
+    model_params: list           # half copies fed to forward (may be same)
+    opt_state: dict              # optimizer slots, name -> list
+    scaler: ScalerState
+    stats: list                  # module buffer values (BN running stats)
+    step: jax.Array              # i32
+
+
+class TrainStep:
+    """Built by :func:`make_train_step`; owns the compiled step and the
+    object<->state synchronization."""
+
+    def __init__(self, model, optimizer, loss_fn, step_fn, params, buffers,
+                 init_state):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self._step_fn = step_fn
+        self._params = params
+        self._buffers = buffers
+        self.state = init_state
+
+    def __call__(self, *batch):
+        self.state, loss = self._step_fn(self.state, *batch)
+        return loss
+
+    def sync_to_objects(self):
+        """Write device state back into the model/scaler objects.
+
+        The optimizer's param_groups reference the SAME Parameter objects as
+        the model (make_train_step never swaps masters in), so each param
+        gets its model-dtype value (half where cast, else the fp32 master);
+        the fp32 masters live in ``self.state.master_params``.
+        """
+        st = self.state
+        for i, (p, v) in enumerate(zip(self._params, st.model_params)):
+            p.data = st.master_params[i] if v is None else v
+        for b, v in zip(self._buffers, st.stats):
+            b.data = v
+        from ..amp._amp_state import _amp_state
+        if _amp_state.loss_scalers:
+            _amp_state.loss_scalers[0].state = st.scaler
+
+
+def make_train_step(model, optimizer, loss_fn: Callable,
+                    half_dtype=None,
+                    keep_batchnorm_fp32: bool = True,
+                    dynamic_loss_scale: bool = True,
+                    scale_window: int = 2000,
+                    min_loss_scale: Optional[float] = None,
+                    max_loss_scale: float = 2.0 ** 24,
+                    loss_scale: float | str = "dynamic",
+                    axis_name: Optional[str] = None,
+                    gradient_predivide_factor: float = 1.0,
+                    allreduce_always_fp32: bool = False,
+                    donate_state: bool = True):
+    """Build a fully-fused O2-style train step.
+
+    ``loss_fn(outputs..., *batch_tail) -> scalar``: called with the model
+    output.  The step signature is ``step(state, *batch) -> (state, loss)``
+    where ``batch[0]`` feeds the model and the full batch feeds ``loss_fn``.
+
+    When ``axis_name`` is given the step is meant to run under
+    ``shard_map``/``pjit`` over that mesh axis: gradients are psum-averaged
+    with the reference DDP's knobs honored (``gradient_predivide_factor``
+    splits the averaging before/after the all-reduce,
+    apex/parallel/distributed.py:445-454; ``allreduce_always_fp32`` casts
+    grads to fp32 for the collective, :417-421).
+    """
+    from ..optimizers import FusedAdam, FusedLAMB, FusedNovoGrad, FusedSGD
+    from .. import ops
+
+    params = [p for p in model.parameters() if p is not None]
+    buffers = [b for b in model.buffers()]
+    from ..nn.modules import _BatchNorm
+
+    bn_param_ids = set()
+    if keep_batchnorm_fp32:
+        for m in model.modules():
+            if isinstance(m, _BatchNorm):
+                for p in m._parameters.values():
+                    if p is not None:
+                        bn_param_ids.add(id(p))
+
+    if half_dtype is None:
+        model_dtypes = [p.data.dtype for p in params]
+    else:
+        model_dtypes = [
+            jnp.float32 if id(p) in bn_param_ids else jnp.dtype(half_dtype)
+            for p in params]
+
+    dynamic = loss_scale == "dynamic"
+    init_scale = (min(max_loss_scale, 2.0 ** 16) if dynamic
+                  else float(loss_scale))
+
+    # map optimizer type -> pure update over flat lists
+    opt = optimizer
+    if isinstance(opt, FusedSGD):
+        group = opt.param_groups[0]
+        mom = group["momentum"]
+
+        def opt_update(flag, grads, masters, slots, step):
+            flag, new_p, new_m = ops.multi_tensor_sgd(
+                flag, [grads, masters, slots["momentum"]],
+                group["weight_decay"], mom, group["dampening"], group["lr"],
+                group["nesterov"], False, opt.wd_after_momentum, 1.0)
+            return new_p, {"momentum": new_m}
+
+        def opt_init():
+            return {"momentum": [jnp.zeros(p.shape, jnp.float32)
+                                 for p in params]}
+    elif isinstance(opt, FusedAdam):
+        group = opt.param_groups[0]
+        b1, b2 = group["betas"]
+
+        def opt_update(flag, grads, masters, slots, step):
+            _, new_p, new_m, new_v = ops.multi_tensor_adam(
+                flag, [grads, masters, slots["m"], slots["v"]],
+                group["lr"], b1, b2, group["eps"], step, opt.adam_w_mode,
+                bool(group["bias_correction"]), group["weight_decay"])
+            return new_p, {"m": new_m, "v": new_v}
+
+        def opt_init():
+            z = [jnp.zeros(p.shape, jnp.float32) for p in params]
+            return {"m": z, "v": [jnp.zeros(p.shape, jnp.float32)
+                                  for p in params]}
+    elif isinstance(opt, FusedLAMB):
+        group = opt.param_groups[0]
+        b1, b2 = group["betas"]
+
+        def opt_update(flag, grads, masters, slots, step):
+            _, gnorm, _ = ops.multi_tensor_l2norm(flag, [grads])
+            _, new_p, new_m, new_v = ops.multi_tensor_lamb(
+                flag, [grads, masters, slots["m"], slots["v"]],
+                group["lr"], b1, b2, group["eps"], step,
+                bool(group["bias_correction"]), group["weight_decay"],
+                1 if group["grad_averaging"] else 0, opt.adam_w_mode,
+                gnorm, group["max_grad_norm"])
+            return new_p, {"m": new_m, "v": new_v}
+
+        def opt_init():
+            z = [jnp.zeros(p.shape, jnp.float32) for p in params]
+            return {"m": z, "v": [jnp.zeros(p.shape, jnp.float32)
+                                  for p in params]}
+    else:
+        raise TypeError(f"make_train_step does not support {type(opt)}")
+
+    def _model_vals(masters, model_params):
+        # model_params holds None where no cast is needed (sharing the master
+        # buffer would double-donate under buffer donation)
+        return [masters[i] if mp is None else mp
+                for i, mp in enumerate(model_params)]
+
+    def step_fn(state: StepState, *batch):
+        model_vals = _model_vals(state.master_params, state.model_params)
+
+        def forward(model_vals_in, *b):
+            env = {id(p): v for p, v in zip(params, model_vals_in)}
+            stats_env = {id(bf): v for bf, v in zip(buffers, state.stats)}
+            stats_out = {}
+            ctx = Ctx(env={**env, **stats_env}, stats_out=stats_out,
+                      training=True)
+            x = b[0]
+            if half_dtype is not None and jnp.issubdtype(x.dtype,
+                                                         jnp.floating):
+                # O2 input cast (reference patches model.forward to cast
+                # incoming data, _initialize.py:194-201)
+                x = x.astype(half_dtype)
+            out = model.forward(ctx, x)
+            loss = loss_fn(out, *b[1:])
+            new_stats = [stats_out.get(id(bf), sv)
+                         for bf, sv in zip(buffers, state.stats)]
+            return loss.astype(jnp.float32) * state.scaler.loss_scale, \
+                (loss, new_stats)
+
+        (scaled_loss, (loss, new_stats)), grads = jax.value_and_grad(
+            forward, has_aux=True)(model_vals, *batch)
+
+        # DP gradient exchange (psum over the mapped axis), with DDP knobs
+        if axis_name is not None:
+            n = jax.lax.axis_size(axis_name)
+            pre = gradient_predivide_factor
+            post = n / gradient_predivide_factor
+
+            def exchange(g):
+                gc = g.astype(jnp.float32) if allreduce_always_fp32 else g
+                gc = gc / pre if pre != 1.0 else gc
+                gc = jax.lax.psum(gc, axis_name)
+                gc = gc / post
+                return gc.astype(g.dtype) if allreduce_always_fp32 else gc
+            grads = [exchange(g) for g in grads]
+
+        # unscale into fp32 master grads + overflow flag
+        inv = 1.0 / state.scaler.loss_scale
+        flag = jnp.zeros((), jnp.int32)
+        master_grads = []
+        for g in grads:
+            gf = g.astype(jnp.float32) * inv
+            flag = jnp.maximum(flag, (~jnp.isfinite(gf)).any()
+                               .astype(jnp.int32))
+            master_grads.append(gf)
+
+        step_count = state.step + 1
+        new_masters, new_slots = opt_update(
+            flag, master_grads, state.master_params, state.opt_state,
+            step_count)
+
+        # skip-step on overflow: keep old state (lax.select keeps it fused)
+        skip = flag > 0
+        sel = functools.partial(jnp.where, skip)
+        masters = [sel(o, n) for o, n in zip(state.master_params, new_masters)]
+        slots = {k: [sel(o, n) for o, n in zip(state.opt_state[k],
+                                               new_slots[k])]
+                 for k in new_slots}
+        model_params = [
+            None if jnp.dtype(d) == jnp.dtype(jnp.float32) else m.astype(d)
+            for m, d in zip(masters, model_dtypes)]
+        step_count = jnp.where(skip, state.step, step_count)
+
+        scaler_state = ScalerState(state.scaler.loss_scale,
+                                   state.scaler.unskipped, flag)
+        new_scaler, _ = update_scale_state(
+            scaler_state, dynamic=dynamic, scale_window=scale_window,
+            min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale)
+
+        return StepState(masters, model_params, slots, new_scaler,
+                         new_stats, step_count), loss
+
+    masters0 = [p.data.astype(jnp.float32) for p in params]
+    init_state = StepState(
+        master_params=masters0,
+        model_params=[
+            None if jnp.dtype(d) == jnp.dtype(jnp.float32)
+            else m.astype(d) for m, d in zip(masters0, model_dtypes)],
+        opt_state=opt_init(),
+        scaler=ScalerState(jnp.asarray(init_scale, jnp.float32),
+                           jnp.zeros((), jnp.int32),
+                           jnp.zeros((), jnp.int32)),
+        stats=[b.data for b in buffers],
+        step=jnp.zeros((), jnp.int32))
+
+    if axis_name is None:
+        jit_step = jax.jit(step_fn,
+                           donate_argnums=(0,) if donate_state else ())
+    else:
+        jit_step = step_fn  # caller wraps in shard_map/pjit
+
+    return TrainStep(model, optimizer, loss_fn, jit_step, params, buffers,
+                     init_state)

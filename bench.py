@@ -1,0 +1,68 @@
+"""Headline benchmark: ImageNet ResNet-50, amp-O2-equivalent fused train step,
+images/sec on one chip (BASELINE.md config 2; measurement method mirrors
+examples/imagenet/main_amp.py:390-397 — world_size*batch/avg_step_time).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured against 800 img/s/chip — the commonly reported V100
+Apex-O2 ResNet-50 number (the reference repo itself publishes no figure,
+BASELINE.md).
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import apex_tpu.nn as nn  # noqa: E402
+from apex_tpu.models import resnet50  # noqa: E402
+from apex_tpu.nn import functional as F  # noqa: E402
+from apex_tpu.optimizers import FusedSGD  # noqa: E402
+from apex_tpu.training import make_train_step  # noqa: E402
+
+V100_APEX_O2_IMGS_PER_SEC = 800.0
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    nn.manual_seed(0)
+    model = resnet50(num_classes=1000)
+    opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9,
+                   weight_decay=1e-4)
+    step = make_train_step(
+        model, opt, lambda out, y: F.cross_entropy(out, y),
+        half_dtype=jnp.bfloat16, loss_scale=1.0)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 3, 224, 224)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, (batch,)))
+
+    # warmup / compile.  NOTE: jax.block_until_ready is a no-op on the
+    # experimental axon platform — only an actual device->host fetch
+    # synchronizes, so we time the loop against a trailing scalar fetch of
+    # the final state (which data-depends on every step).
+    for _ in range(3):
+        loss = step(x, y)
+    float(jnp.sum(step.state.master_params[0]))
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    float(jnp.sum(step.state.master_params[0]))
+    dt = (time.perf_counter() - t0) / iters
+
+    imgs_per_sec = batch / dt
+    print(json.dumps({
+        "metric": "resnet50_imagenet_images_per_sec_per_chip_ampO2",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(imgs_per_sec / V100_APEX_O2_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

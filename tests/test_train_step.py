@@ -1,0 +1,152 @@
+"""Fused train step (apex_tpu.training.make_train_step): parity with the
+imperative amp path, overflow skip, BN stats threading, and the shard_map DP
+path on the 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.nn import functional as F
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+from apex_tpu.training import make_train_step
+
+
+def _model():
+    nn.manual_seed(42)
+    return nn.Sequential(
+        nn.Conv2d(3, 8, 3, padding=1), nn.BatchNorm2d(8), nn.ReLU(),
+        nn.MaxPool2d(2), nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+
+
+def _data(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((n, 3, 16, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (n,)))
+    return x, y
+
+
+def test_fused_step_trains():
+    model = _model()
+    opt = FusedAdam(list(model.parameters()), lr=1e-2)
+    step = make_train_step(model, opt, lambda o, y: F.cross_entropy(o, y),
+                           half_dtype=jnp.float16)
+    x, y = _data()
+    losses = [float(step(x, y)) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(losses))
+
+
+def test_fused_matches_imperative_amp_O2():
+    """Same model/seed: the fused step and the scale_loss imperative path
+    must produce closely matching loss curves (the reference's L1 oracle —
+    extension vs python build, tests/L1/common/compare.py:34-40)."""
+    from apex_tpu import amp
+    from apex_tpu.amp._amp_state import _amp_state
+
+    x, y = _data()
+
+    # imperative path
+    _amp_state.opt_properties = None
+    model_a = _model()
+    opt_a = FusedSGD(list(model_a.parameters()), lr=0.05, momentum=0.9)
+    model_a, opt_a = amp.initialize(model_a, opt_a, opt_level="O2",
+                                    verbosity=0)
+    crit = nn.CrossEntropyLoss()
+    imp = []
+    for _ in range(5):
+        out = model_a(x)
+        loss = crit(out, y)
+        with amp.scale_loss(loss, opt_a) as sl:
+            sl.backward()
+        opt_a.step()
+        opt_a.zero_grad()
+        imp.append(float(loss))
+
+    # fused path (same init via same seed)
+    model_b = _model()
+    opt_b = FusedSGD(list(model_b.parameters()), lr=0.05, momentum=0.9)
+    step = make_train_step(model_b, opt_b,
+                           lambda o, yy: F.cross_entropy(o, yy),
+                           half_dtype=jnp.float16, loss_scale="dynamic")
+    fused = [float(step(x, y)) for _ in range(5)]
+    np.testing.assert_allclose(fused, imp, rtol=5e-3)
+
+
+def test_fused_step_overflow_skips():
+    model = _model()
+    opt = FusedSGD(list(model.parameters()), lr=0.05)
+    step = make_train_step(model, opt, lambda o, y: F.cross_entropy(o, y),
+                           half_dtype=jnp.float16, loss_scale="dynamic")
+    x, y = _data()
+    step(x, y)
+    masters_before = [np.asarray(m) for m in step.state.master_params]
+    scale_before = float(step.state.scaler.loss_scale)
+    bad = x.at[0, 0, 0, 0].set(np.inf)
+    step(bad, y)
+    for m, before in zip(step.state.master_params, masters_before):
+        np.testing.assert_array_equal(np.asarray(m), before)
+    assert float(step.state.scaler.loss_scale) == scale_before / 2
+
+
+def test_fused_step_updates_bn_stats():
+    model = _model()
+    opt = FusedSGD(list(model.parameters()), lr=0.05)
+    step = make_train_step(model, opt, lambda o, y: F.cross_entropy(o, y))
+    x, y = _data()
+    step(x, y)
+    step.sync_to_objects()
+    assert not np.allclose(np.asarray(model[1].running_mean.data), 0.0)
+    assert int(np.asarray(model[1].num_batches_tracked.data)) == 1
+
+
+def test_fused_step_ddp_on_mesh():
+    """shard_map DP over the 8-device CPU mesh: replicated state, sharded
+    batch; parity with single-device on the same global batch."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    n_dev = len(jax.devices())
+    assert n_dev == 8, f"test harness expects 8 CPU devices, got {n_dev}"
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    x, y = _data(16)
+
+    # BN-free model: plain (non-sync) BN computes local per-shard stats, so
+    # exact parity with a single-device run requires no BN (SyncBatchNorm is
+    # the cross-shard-stats variant — see parallel tests)
+    def _model():
+        nn.manual_seed(42)
+        return nn.Sequential(
+            nn.Conv2d(3, 8, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2d(2), nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+
+    model_a = _model()
+    opt_a = FusedSGD(list(model_a.parameters()), lr=0.05, momentum=0.9)
+    single = make_train_step(model_a, opt_a,
+                             lambda o, yy: F.cross_entropy(o, yy))
+    single_losses = [float(single(x, y)) for _ in range(3)]
+
+    model_b = _model()
+    opt_b = FusedSGD(list(model_b.parameters()), lr=0.05, momentum=0.9)
+    ddp = make_train_step(model_b, opt_b,
+                          lambda o, yy: F.cross_entropy(o, yy),
+                          axis_name="data")
+    sharded = jax.jit(shard_map(
+        ddp._step_fn, mesh=mesh,
+        in_specs=(P(), P("data"), P("data")), out_specs=(P(), P()),
+        check_rep=False))
+    ddp_losses = []
+    state = ddp.state
+    for _ in range(3):
+        state, loss = sharded(state, x, y)
+        # per-shard mean losses differ from global mean only through shard
+        # sizes here (equal) — loss is replicated mean of shard mean? No:
+        # out_specs P() replicates; value is the first shard's local loss.
+        ddp_losses.append(float(jnp.mean(loss)))
+    ddp.state = state
+
+    # parameters after 3 steps must match the single-device run closely
+    for a, b in zip(single.state.master_params, ddp.state.master_params):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
