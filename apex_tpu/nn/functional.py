@@ -126,6 +126,20 @@ def conv_transpose2d(x, weight, bias=None, stride=1, padding=0,
 # Normalization (float list)
 # ---------------------------------------------------------------------------
 
+_warned_bn_axes = set()
+
+
+def _warn_unbound_bn_axis(axis_name):
+    if axis_name not in _warned_bn_axes:
+        _warned_bn_axes.add(axis_name)
+        import warnings
+        warnings.warn(
+            f"SyncBatchNorm: mesh axis {axis_name!r} is not bound; falling "
+            "back to local-batch statistics. This is expected (and correct) "
+            "under jit with a sharded batch, but if you are inside shard_map "
+            "with a differently-named axis, pass that name via "
+            "SyncBatchNorm(axis_name=...).")
+
 @_policied("batch_norm")
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.1, eps=1e-5,
@@ -149,10 +163,20 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         sq = jnp.sum(xf * xf, axis=reduce_axes)
         count = jnp.asarray(local_count, jnp.float32)
         if axis_name is not None:
-            s = lax.psum(s, axis_name, axis_index_groups=axis_index_groups)
-            sq = lax.psum(sq, axis_name, axis_index_groups=axis_index_groups)
-            count = lax.psum(count, axis_name,
+            try:
+                s = lax.psum(s, axis_name,
                              axis_index_groups=axis_index_groups)
+                sq = lax.psum(sq, axis_name,
+                              axis_index_groups=axis_index_groups)
+                count = lax.psum(count, axis_name,
+                                 axis_index_groups=axis_index_groups)
+            except NameError:
+                # Axis not bound: not running under shard_map/pmap.  Under
+                # automatic SPMD (jit + sharded batch) local stats already
+                # ARE global-batch stats, so degrading is correct there —
+                # but under shard_map with a differently-named axis it would
+                # silently break sync, so say something.
+                _warn_unbound_bn_axis(axis_name)
         mean = s / count
         var = sq / count - mean * mean  # biased, used for normalization
         # unbiased variance feeds the running stats (reference

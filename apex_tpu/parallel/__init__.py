@@ -1,1 +1,71 @@
+"""Distributed layer (reference: apex/parallel/__init__.py).
+
+Exports DistributedDataParallel, Reducer, SyncBatchNorm, LARC, the
+convert_syncbn_model module-tree rewrite (reference :21-56) and
+create_syncbn_process_group (reference :58-95, returning axis_index_groups
+for the data axis instead of a torch process group).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..nn.modules import _BatchNorm
+from .distributed import (  # noqa: F401
+    DistributedDataParallel, Reducer, all_reduce_mean, flat_dist_call,
+    rank, world_size)
 from .LARC import LARC  # noqa: F401
+from .sync_batchnorm import SyncBatchNorm  # noqa: F401
+
+
+def convert_syncbn_model(module, process_group=None, channel_last=False):
+    """Recursively replace every BatchNorm module with SyncBatchNorm,
+    preserving parameters and running stats (reference
+    apex/parallel/__init__.py:21-56)."""
+    mod = module
+    if isinstance(module, _BatchNorm) and not isinstance(module,
+                                                         SyncBatchNorm):
+        mod = SyncBatchNorm(module.num_features, eps=module.eps,
+                            momentum=module.momentum, affine=module.affine,
+                            track_running_stats=module.track_running_stats,
+                            process_group=process_group,
+                            channel_last=channel_last)
+        if module.affine:
+            mod.weight.data = module.weight.data
+            mod.bias.data = module.bias.data
+        if module.track_running_stats:
+            mod.running_mean.data = module.running_mean.data
+            mod.running_var.data = module.running_var.data
+            mod.num_batches_tracked.data = module.num_batches_tracked.data
+    else:
+        for name, child in list(module._modules.items()):
+            setattr(module, name,
+                    convert_syncbn_model(child, process_group=process_group,
+                                         channel_last=channel_last))
+    return mod
+
+
+def create_syncbn_process_group(group_size, world_size=None):
+    """Partition the data axis into BN stat-sharing groups of ``group_size``
+    devices; returns ``axis_index_groups`` for SyncBatchNorm's psum
+    (reference :58-95 returns the torch group for the current rank; with
+    XLA's axis_index_groups every group is described at once).
+
+    ``world_size`` is the size of the *data mesh axis* the groups index —
+    pass it explicitly when training on a sub-mesh; defaults to the global
+    device count.  group_size == 0 (or == world size) means global sync
+    (None).
+    """
+    n = world_size if world_size is not None else jax.device_count()
+    if group_size == 0 or group_size == n:
+        return None
+    if group_size < 0:
+        raise ValueError(f"group_size must be non-negative, got {group_size}")
+    if group_size > n:
+        raise ValueError(
+            f"group_size {group_size} exceeds data-axis size {n}")
+    if n % group_size != 0:
+        raise ValueError(
+            f"data-axis size {n} must be divisible by group_size "
+            f"{group_size}")
+    return [list(range(i, i + group_size))
+            for i in range(0, n, group_size)]

@@ -1,0 +1,248 @@
+"""Data-parallel layer (reference: apex/parallel/distributed.py).
+
+TPU-native stance: the reference's DDP is ~640 lines of bucket management,
+grad-arrival hooks and NCCL stream choreography.  Under XLA the same job —
+exchange gradients, overlapped with backward — is the compiler's: params are
+replicated over a device mesh, the batch is sharded, and the partitioner
+inserts (and schedules) the all-reduces.  What remains API-surface:
+
+* ``DistributedDataParallel`` — wraps a module; shards incoming batches over
+  the mesh's data axis and keeps parameters replicated, so the tape's
+  compiled backward produces exchanged (replicated) gradients.  Knob parity
+  with the reference: ``message_size``/``delay_allreduce`` (bucketing hints —
+  accepted, validated, and recorded; XLA's all-reduce combiner plays the
+  bucket role), ``allreduce_always_fp32`` and ``gradient_predivide_factor``
+  (honored in the explicit shard_map path, apex_tpu.training.make_train_step),
+  ``num_allreduce_streams`` etc. validated like the reference
+  (distributed.py:176-213).
+* ``Reducer`` — the manual "allreduce on demand" helper (reference :89-126).
+* ``flat_dist_call``/``apply_flat_dist_call`` — coalesced collective
+  application (reference :36-70), expressed over jax arrays.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn.modules import Module
+from ..nn.parameter import Parameter
+
+
+def _default_mesh(devices=None, axis: str = "data") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def world_size() -> int:
+    return jax.device_count()
+
+
+def rank() -> int:
+    return jax.process_index()
+
+
+def apply_flat_dist_call(bucket, call, extra_args=None):
+    """Apply a collective to a coalesced bucket (reference
+    distributed.py:36-49).  XLA fuses the concatenation/split, so this is a
+    semantic no-copy."""
+    flat = jnp.concatenate([jnp.ravel(t) for t in bucket])
+    flat = call(flat) if extra_args is None else call(flat, *extra_args)
+    out, offset = [], 0
+    for t in bucket:
+        n = t.size
+        out.append(flat[offset:offset + n].reshape(t.shape))
+        offset += n
+    return out
+
+
+def split_by_type(tensors):
+    """Bucket tensors by dtype (reference split_half_float_double,
+    distributed.py:27-34 — extended with bfloat16)."""
+    buckets = {}
+    for t in tensors:
+        buckets.setdefault(jnp.dtype(t.dtype), []).append(t)
+    return list(buckets.values())
+
+
+def flat_dist_call(tensors, call, extra_args=None):
+    out = []
+    for bucket in split_by_type(tensors):
+        out.extend(apply_flat_dist_call(bucket, call, extra_args))
+    return out
+
+
+def _is_replicated(x) -> bool:
+    sh = getattr(x, "sharding", None)
+    return sh is None or sh.is_fully_replicated
+
+
+def all_reduce_mean(tensors, mesh: Optional[Mesh] = None,
+                    always_fp32: bool = False,
+                    predivide_factor: float = 1.0):
+    """Mean-all-reduce over the mesh's data axis, honoring the DDP
+    dtype/predivide knobs.
+
+    In the single-controller SPMD model a *replicated* array is by
+    definition already identical on every device — the exchange the
+    reference's NCCL allreduce performs happened inside the compiled
+    backward — so replicated inputs pass through unchanged.  Arrays sharded
+    on their leading dim over the data axis (one value per replica) are
+    psum-mean-combined via shard_map, which is the explicit-collective path.
+    """
+    mesh = mesh or _default_mesh()
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+
+    def exchange(g):
+        gc = g.astype(jnp.float32) if always_fp32 else g
+        if predivide_factor != 1.0:
+            gc = gc / predivide_factor
+        gc = jax.lax.psum(gc, axis)
+        gc = gc / (n / predivide_factor)
+        return gc.astype(g.dtype) if always_fp32 else gc
+
+    out = []
+    for t in tensors:
+        if _is_replicated(t):
+            out.append(t)
+        else:
+            fn = jax.shard_map(
+                exchange, mesh=mesh, in_specs=P(axis),
+                out_specs=P(axis), check_vma=False)
+            out.append(fn(t))
+    return out
+
+
+class Reducer:
+    """Manual gradient/param averaging helper (reference
+    apex/parallel/distributed.py:89-126): call ``reduce()`` whenever you want
+    the wrapped module's gradients averaged across replicas."""
+
+    def __init__(self, module_or_grads_list, mesh: Optional[Mesh] = None):
+        self.mesh = mesh or _default_mesh()
+        if isinstance(module_or_grads_list, Module):
+            self.module = module_or_grads_list
+            # parameter broadcast at construction (reference :253): in
+            # single-controller SPMD params are already identical; multihost
+            # sync happens through the jit replication below.
+        else:
+            self.module = None
+            self.grads = list(module_or_grads_list)
+
+    def reduce(self):
+        if self.module is not None:
+            params = [p for p in self.module.parameters()
+                      if p is not None and p.grad is not None]
+            grads = [p.grad for p in params]
+            new = all_reduce_mean(grads, self.mesh)
+            for p, g in zip(params, new):
+                p.grad = g
+        else:
+            self.grads[:] = all_reduce_mean(self.grads, self.mesh)
+
+
+class DistributedDataParallel(Module):
+    """Module wrapper for data-parallel training (reference
+    apex/parallel/distributed.py:129).
+
+    On TPU the wrapper's job is placement: incoming batches are sharded over
+    the mesh's data axis and parameters kept replicated; XLA's partitioner
+    then inserts the gradient all-reduce into the compiled backward and
+    overlaps it with computation (the latency-hiding scheduler replaces the
+    reference's hand-rolled bucket/stream machinery, :363-475).
+    """
+
+    def __init__(self, module: Module, message_size: int = 10000000,
+                 delay_allreduce: bool = False,
+                 shared_param: Optional[bool] = None,
+                 allreduce_trigger_params=None,
+                 retain_allreduce_buffers: bool = False,
+                 allreduce_always_fp32: bool = False,
+                 num_allreduce_streams: int = 1,
+                 allreduce_communicators=None,
+                 gradient_average: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 gradient_average_split_factor=None,
+                 prof: bool = False,
+                 mesh: Optional[Mesh] = None):
+        super().__init__()
+        # ---- option validation, mirroring distributed.py:145-213 ----
+        if shared_param is not None:
+            raise ValueError(
+                "shared_param is no longer supported as an option.  It was "
+                "misleadingly named and didn't do what it claimed to do.  "
+                "The new behavior is shared_param=True.")
+        if allreduce_communicators is not None:
+            if len(allreduce_communicators[0]) != num_allreduce_streams or \
+                    not isinstance(allreduce_communicators[1], (list, tuple)):
+                raise ValueError("allreduce_communicators must be a tuple "
+                                 "(groups, streams) matching "
+                                 "num_allreduce_streams")
+        if delay_allreduce and num_allreduce_streams > 1:
+            raise ValueError("Setting delay_allreduce=True makes "
+                             "num_allreduce_streams irrelevant.")
+        if allreduce_trigger_params is not None and delay_allreduce:
+            raise ValueError("Setting allreduce_trigger_params is only valid "
+                             "if delay_allreduce=False.")
+
+        self.module = module
+        self.message_size = message_size
+        self.delay_allreduce = delay_allreduce
+        self.allreduce_trigger_params = (
+            [id(p) for p in allreduce_trigger_params]
+            if allreduce_trigger_params is not None else None)
+        self.retain_allreduce_buffers = retain_allreduce_buffers
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.num_allreduce_streams = num_allreduce_streams
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.prof = prof
+        self.mesh = mesh or _default_mesh()
+        self._data_axis = self.mesh.axis_names[0]
+        self._batch_sharding = NamedSharding(self.mesh, P(self._data_axis))
+
+        # DDP is applied AFTER amp.initialize (reference order, simple/
+        # distributed example): the amp cast/policy tags live on the wrapped
+        # module, but calls enter through this wrapper — mirror them here so
+        # the tape applies the casts exactly once (inner module.forward is
+        # invoked directly, bypassing the inner tags).
+        for attr in ("_amp_input_cast_dtype", "_amp_output_cast_dtype",
+                     "_amp_policy"):
+            if hasattr(module, attr):
+                setattr(self, attr, getattr(module, attr))
+
+        # parameter broadcast from rank 0 (reference :253): replicate every
+        # param over the mesh so XLA sees them as shared across the data axis
+        self._replicate_params()
+
+    def _replicate_params(self):
+        rep = NamedSharding(self.mesh, P())
+        for p in self.module.parameters():
+            if p is not None:
+                p.data = jax.device_put(p.data, rep)
+        for b in self.module.buffers():
+            b.data = jax.device_put(b.data, rep)
+
+    def shard_batch(self, x):
+        """Place a global batch sharded over the data axis."""
+        return jax.device_put(x, self._batch_sharding)
+
+    # DDP delegates module protocol (parameters/state_dict/etc. come from
+    # Module via the registered child)
+    def forward(self, ctx, *inputs):
+        return self.module.forward(ctx, *inputs)
+
+    def __call__(self, *inputs):
+        placed = tuple(
+            self.shard_batch(x) if hasattr(x, "shape") and getattr(
+                x, "ndim", 0) > 0 else x
+            for x in inputs)
+        return super().__call__(*placed)
+
+    def train(self, mode=True):
+        self.module.train(mode)
+        return super().train(mode)

@@ -1,0 +1,51 @@
+"""Multi-process launcher (reference: apex/parallel/multiproc.py:12-35).
+
+The reference spawns one process per GPU appending --rank/--world-size.  The
+TPU analogue spawns one process per host-slice for multi-host jax.distributed
+runs (or N CPU processes for local testing), exporting the coordinator
+address and process ids that ``jax.distributed.initialize`` consumes.
+
+Usage:  python -m apex_tpu.parallel.multiproc [--nproc N] script.py args...
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def main():
+    argv = list(sys.argv[1:])
+    nproc = None
+    if argv and argv[0] == "--nproc":
+        nproc = int(argv[1])
+        argv = argv[2:]
+    if not argv:
+        print(__doc__)
+        sys.exit(1)
+    if nproc is None:
+        import jax
+        nproc = max(jax.local_device_count(), 1)
+
+    port = int(os.environ.get("APEX_TPU_COORD_PORT", "12355"))
+    coordinator = f"127.0.0.1:{port}"
+
+    procs = []
+    for local_rank in range(nproc):
+        env = dict(os.environ)
+        env["JAX_COORDINATOR_ADDRESS"] = coordinator
+        env["JAX_NUM_PROCESSES"] = str(nproc)
+        env["JAX_PROCESS_ID"] = str(local_rank)
+        cmd = [sys.executable, argv[0], *argv[1:],
+               f"--local_rank={local_rank}"]
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

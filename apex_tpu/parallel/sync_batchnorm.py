@@ -1,0 +1,57 @@
+"""SyncBatchNorm (reference: apex/parallel/sync_batchnorm.py +
+optimized_sync_batchnorm*.py).
+
+The reference computes local Welford stats, all-gathers per-rank mean/var and
+merges them (optimized_sync_batchnorm_kernel.py:20-45).  The TPU-native
+equivalent is one ``lax.psum`` of (sum, sqsum, count) over the mesh's data
+axis — mathematically identical to the Welford merge, and fused by XLA into
+the surrounding step.  ``process_group`` maps to ``axis_index_groups``
+(sub-groups of the data axis, reference create_syncbn_process_group,
+apex/parallel/__init__.py:58-95).
+
+Semantics notes, matching the reference:
+* under explicit per-shard execution (shard_map — the make_train_step path),
+  the psum is what synchronizes statistics;
+* under automatic SPMD (jit + sharded batch), a plain BatchNorm already has
+  global-batch semantics, so SyncBatchNorm degrades gracefully: if the axis
+  name is unbound at trace time, stats are computed over the (global) batch —
+  same observable result;
+* eval mode uses running stats with no collective
+  (reference sync_batchnorm.py:85-88).
+"""
+from __future__ import annotations
+
+from ..nn.modules import _BatchNorm
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Cross-replica BatchNorm.  ``channel_last`` accepted for reference API
+    parity (optimized_sync_batchnorm.py:58); layout is XLA's concern on TPU,
+    so it only changes the expected input layout NHWC->NCHW handling."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True, process_group=None,
+                 channel_last=False, fuse_relu=False,
+                 axis_name: str = "data"):
+        super().__init__(num_features, eps=eps, momentum=momentum,
+                         affine=affine,
+                         track_running_stats=track_running_stats)
+        self.process_group = process_group  # axis_index_groups
+        self.channel_last = channel_last
+        self.fuse_relu = fuse_relu
+        self.axis_name = axis_name
+
+    def _stats_args(self):
+        return dict(axis_name=self.axis_name,
+                    axis_index_groups=self.process_group)
+
+    def forward(self, ctx, x):
+        if self.channel_last:
+            x = x.swapaxes(1, -1)
+        y = super().forward(ctx, x)
+        if self.fuse_relu:
+            from ..nn import functional as F
+            y = F.relu(y)
+        if self.channel_last:
+            y = y.swapaxes(1, -1)
+        return y

@@ -104,7 +104,7 @@ def test_fused_step_ddp_on_mesh():
     """shard_map DP over the 8-device CPU mesh: replicated state, sharded
     batch; parity with single-device on the same global batch."""
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
 
     n_dev = len(jax.devices())
     assert n_dev == 8, f"test harness expects 8 CPU devices, got {n_dev}"
@@ -135,7 +135,7 @@ def test_fused_step_ddp_on_mesh():
     sharded = jax.jit(shard_map(
         ddp._step_fn, mesh=mesh,
         in_specs=(P(), P("data"), P("data")), out_specs=(P(), P()),
-        check_rep=False))
+        check_vma=False))
     ddp_losses = []
     state = ddp.state
     for _ in range(3):
