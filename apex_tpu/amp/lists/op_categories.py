@@ -25,6 +25,8 @@ FP16_FUNCS = [
     "conv_transpose1d", "conv_transpose2d", "conv_transpose3d",
     "linear", "matmul", "mm", "bmm", "addmm", "einsum", "dot_general",
     "prelu",
+    # apex modules registered via amp.half_function in the reference
+    "mlp",  # apex/mlp/mlp.py:22
 ]
 
 FP32_FUNCS = [

@@ -1,0 +1,2 @@
+"""apex.contrib.groupbn equivalent (reference apex/contrib/groupbn/__init__.py)."""
+from .batch_norm import BatchNorm2d_NHWC  # noqa: F401

@@ -1,0 +1,78 @@
+"""Group BatchNorm, NHWC — TPU-native equivalent of
+``apex.contrib.groupbn.BatchNorm2d_NHWC``
+(apex/contrib/groupbn/batch_norm.py:101-219 over the ``bnp`` extension,
+apex/contrib/csrc/groupbn/ — NHWC BN with optional add+ReLU fusion and
+cross-GPU group statistics over CUDA IPC peer memory).
+
+TPU stance: NHWC is just the channel-last layout XLA already prefers on TPU;
+the IPC peer-memory machinery disappears — ``bn_group`` maps to
+``axis_index_groups`` sub-groups of the mesh's data axis and the stat
+exchange is a sub-axis collective over ICI (SURVEY.md §2.2 bnp row).  The
+fused ``add+relu`` epilogue (bn_addrelu_*) is the ``z``/``fuse_relu``
+arguments; XLA fuses the elementwise tail into the surrounding step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.modules import Buffer, Module
+from ...nn.parameter import Parameter
+from ...parallel import create_syncbn_process_group
+
+
+class BatchNorm2d_NHWC(Module):
+    """BatchNorm over NHWC input (stats on the last axis).
+
+    ``bn_group`` > 1 synchronizes statistics across groups of ``bn_group``
+    devices along the mesh data axis (the reference's intra-node IPC group,
+    batch_norm.py:113-137); ``fuse_relu`` applies ReLU to the output and
+    ``forward(x, z)`` fuses a residual add first (bn_addrelu path).
+    """
+
+    def __init__(self, num_features, fuse_relu=False, bn_group=1,
+                 max_cta_per_sm=2, cta_launch_margin=12, multi_stream=False,
+                 eps=1e-5, momentum=0.1, axis_name="data",
+                 group_world_size=None):
+        super().__init__()
+        # max_cta_per_sm / cta_launch_margin / multi_stream are CUDA launch
+        # tuning knobs (batch_norm.py:103); accepted for API parity
+        self.num_features = num_features
+        self.fuse_relu = fuse_relu
+        self.bn_group = bn_group
+        self.eps = eps
+        self.momentum = momentum
+        self.axis_name = axis_name if bn_group > 1 else None
+        self.axis_index_groups = (
+            create_syncbn_process_group(bn_group, group_world_size)
+            if bn_group > 1 else None)
+        self.weight = Parameter(jnp.ones((num_features,), jnp.float32))
+        self.bias = Parameter(jnp.zeros((num_features,), jnp.float32))
+        self.running_mean = Buffer(jnp.zeros((num_features,), jnp.float32))
+        self.running_var = Buffer(jnp.ones((num_features,), jnp.float32))
+        self.minibatch_mean = Buffer(jnp.zeros((num_features,), jnp.float32))
+        self.minibatch_riv = Buffer(jnp.ones((num_features,), jnp.float32))
+
+    def forward(self, ctx, x, z=None):
+        training = ctx.training and self.training
+        # NHWC → NCHW for the shared stats core, back after
+        xc = jnp.moveaxis(x, -1, 1)
+        y, new_rm, new_rv = F.batch_norm(
+            xc, ctx.value(self.running_mean), ctx.value(self.running_var),
+            ctx.value(self.weight), ctx.value(self.bias),
+            training=training, momentum=self.momentum, eps=self.eps,
+            axis_name=self.axis_name,
+            axis_index_groups=self.axis_index_groups)
+        if training:
+            ctx.write_stat(self.running_mean, new_rm)
+            ctx.write_stat(self.running_var, new_rv)
+        y = jnp.moveaxis(y, 1, -1)
+        if z is not None:
+            y = y + z
+        if self.fuse_relu:
+            y = F.relu(y)
+        return y
+
+    def extra_repr(self):
+        return (f"{self.num_features}, fuse_relu={self.fuse_relu}, "
+                f"bn_group={self.bn_group}")

@@ -1,0 +1,9 @@
+"""apex.contrib.multihead_attn equivalent (reference
+apex/contrib/multihead_attn/__init__.py)."""
+from .attn_funcs import (  # noqa: F401
+    encdec_attn_func,
+    flash_attention,
+    self_attn_func,
+)
+from .encdec_multihead_attn import EncdecMultiheadAttn  # noqa: F401
+from .self_multihead_attn import SelfMultiheadAttn  # noqa: F401

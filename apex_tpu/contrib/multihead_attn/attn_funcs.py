@@ -1,0 +1,220 @@
+"""Attention functionals for contrib.multihead_attn.
+
+``self_attn_func``/``encdec_attn_func`` mirror the reference's pure-torch
+paths (apex/contrib/multihead_attn/self_multihead_attn_func.py:4-118,
+encdec_multihead_attn_func.py) in jnp: fused QKV projection with the
+reference's PER-HEAD INTERLEAVED weight layout (in_proj output reshaped to
+(T, B·H, 3, D) — self_multihead_attn_func.py:35-38, i.e. weight rows grouped
+[q_h, k_h, v_h] per head, NOT the torch [Q;K;V] block layout), batched
+attention GEMMs, mask fill, softmax, dropout, output projection.
+
+``flash_attention`` is the fast path (replacing the ``fast_*_multihead_attn``
+CUDA extensions): a Pallas flash kernel on TPU
+(apex_tpu/ops/pallas/attention.py), an equivalent jnp computation elsewhere.
+Dropout inside the attention matrix uses the materializing path (the
+reference's fast kernels materialize the full softmax too — csrc/
+multihead_attn/softmax.h); with dropout off the flash path is O(S) memory.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.pallas import pallas_mode
+from ...ops.pallas import attention as _k
+
+_f32 = jnp.float32
+_NEG = -1e30
+
+
+def attention_reference(q4, k4, v4, bias, causal, scale):
+    """Plain-XLA attention, (B, H, S, D) layout; the fallback/oracle path."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q4.astype(_f32),
+                   k4.astype(_f32)) * scale
+    if bias is not None:
+        s = s + bias[:, None].astype(_f32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(rows >= cols, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v4.astype(_f32)).astype(q4.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q4, k4, v4, bias, causal, scale, interpret):
+    out, _ = _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret)
+    return out
+
+
+def _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret):
+    b, h, sq, d = q4.shape
+    sk = k4.shape[2]
+    q3 = q4.reshape(b * h, sq, d)
+    k3 = k4.reshape(b * h, sk, d)
+    v3 = v4.reshape(b * h, sk, d)
+    bias3 = None
+    if bias is not None:
+        # kernel bias layout (B|1, Sq|1, Sk) broadcasts over heads by
+        # repeating per head in the leading dim when per-batch
+        bias3 = bias if bias.shape[0] == 1 else jnp.repeat(bias, h, axis=0)
+    out3, lse = _k.flash_attention_fwd(q3, k3, v3, bias3, scale, causal,
+                                       interpret=interpret)
+    return out3.reshape(b, h, sq, d), (q3, k3, v3, bias3, out3, lse)
+
+
+def _flash_vjp_fwd(q4, k4, v4, bias, causal, scale, interpret):
+    out, res = _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret)
+    return out, (res, q4.shape, k4.shape, bias)
+
+
+def _flash_vjp_bwd(causal, scale, interpret, saved, g):
+    (q3, k3, v3, bias3, out3, lse), qshape, kshape, bias = saved
+    b, h, sq, d = qshape
+    dq, dk, dv = _k.flash_attention_bwd(
+        q3, k3, v3, bias3, out3, lse, g.reshape(b * h, sq, d), scale, causal,
+        interpret=interpret)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return (dq.reshape(qshape), dk.reshape(kshape), dv.reshape(kshape),
+            dbias)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q4, k4, v4, bias=None, causal=False, scale=None):
+    """Fused scaled-dot-product attention, (B, H, S, D) layout.
+
+    ``bias`` is an additive mask, broadcastable (B|1, Sq|1, Sk) — carries
+    key-padding and attention masks; ``causal`` masks future timesteps
+    in-kernel.  Gradients flow to q/k/v only (masks are data).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q4.shape[-1])
+    mode = pallas_mode()
+    if mode is None:
+        if bias is not None:
+            bias = jax.lax.stop_gradient(bias)
+        return attention_reference(q4, k4, v4, bias, causal, scale)
+    return _flash(q4, k4, v4, bias, causal, scale, mode == "interpret")
+
+
+# ---------------------------------------------------------------------------
+# reference-parity functional paths (torch layout: inputs (T, B, E))
+# ---------------------------------------------------------------------------
+
+def _split_interleaved_qkv(lin, t, b, heads, head_dim):
+    """(T, B, 3E) → three (B·H, T, D), reference interleaved slicing
+    (self_multihead_attn_func.py:35-38)."""
+    lin = lin.reshape(t, b * heads, 3, head_dim)
+    q, k, v = lin[:, :, 0], lin[:, :, 1], lin[:, :, 2]
+    to_bhd = lambda x: jnp.swapaxes(x, 0, 1)  # (BH, T, D)
+    return to_bhd(q), to_bhd(k), to_bhd(v)
+
+
+def _masks_to_bias(mask, use_time_mask, b, heads, sq, sk, dtype=_f32):
+    """Reference mask semantics → additive bias (B|1, Sq|1, Sk).
+
+    Boolean/byte masks mark EXCLUDED positions with True
+    (self_multihead_attn_func.py:52-66); float masks are additive."""
+    if mask is None:
+        return None
+    mask = jnp.asarray(mask)
+    if use_time_mask:
+        assert mask.ndim == 2, "Timing mask is not 2D!"
+        if mask.dtype == jnp.bool_ or jnp.issubdtype(mask.dtype, jnp.integer):
+            return jnp.where(mask.astype(bool), _NEG, 0.0).astype(
+                _f32)[None, :, :]
+        return mask.astype(_f32)[None, :, :]
+    # key padding (B, Sk)
+    if mask.dtype == jnp.bool_ or jnp.issubdtype(mask.dtype, jnp.integer):
+        return jnp.where(mask.astype(bool), _NEG, 0.0).astype(
+            _f32)[:, None, :]
+    return mask.astype(_f32)[:, None, :]
+
+
+def _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout_prob, key,
+                       use_time_mask_causal=False):
+    """Materializing attention with dropout on the probabilities — the
+    default-impl math (self_multihead_attn_func.py:49-87)."""
+    bh, sq, d = q3.shape
+    b = bh // heads
+    s = jnp.einsum("btd,bsd->bts", q3.astype(_f32),
+                   k3.astype(_f32)) * scale
+    if bias is not None:
+        s = s.reshape(b, heads, sq, -1) + bias[:, None].astype(_f32)
+        s = s.reshape(bh, sq, -1)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_prob > 0.0:
+        if key is None:
+            raise ValueError("attention dropout requires a PRNG key")
+        keep = 1.0 - dropout_prob
+        m = jax.random.bernoulli(key, keep, p.shape)
+        p = jnp.where(m, p / keep, 0.0)
+    return jnp.einsum("bts,bsd->btd", p, v3.astype(_f32)).astype(q3.dtype)
+
+
+def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
+                   input_weights, output_weights, input_biases=None,
+                   output_biases=None, mask=None, dropout_prob=0.0,
+                   key=None, use_flash=False):
+    """Reference signature parity (self_multihead_attn_func.py:6-10);
+    ``use_flash`` selects the Pallas path (the fast_* extension analogue)."""
+    t, b, e = inputs.shape
+    head_dim = e // heads
+    lin = jnp.matmul(inputs, input_weights.T)
+    if input_biases is not None:
+        lin = lin + input_biases
+    q3, k3, v3 = _split_interleaved_qkv(lin, t, b, heads, head_dim)
+    bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)
+    dropout = dropout_prob if is_training else 0.0
+    if use_flash and dropout == 0.0:
+        q4 = q3.reshape(b, heads, t, head_dim)
+        k4 = k3.reshape(b, heads, t, head_dim)
+        v4 = v3.reshape(b, heads, t, head_dim)
+        ctx4 = flash_attention(q4, k4, v4, bias=bias, causal=False,
+                               scale=scale)
+        ctx3 = ctx4.reshape(b * heads, t, head_dim)
+    else:
+        ctx3 = _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout,
+                                  key)
+    ctx = jnp.swapaxes(ctx3, 0, 1).reshape(t, b, e)
+    out = jnp.matmul(ctx, output_weights.T)
+    if output_biases is not None:
+        out = out + output_biases
+    return out
+
+
+def encdec_attn_func(use_time_mask, is_training, heads, scale, inputs_q,
+                     inputs_kv, input_weights_q, input_weights_kv,
+                     output_weights, mask=None, dropout_prob=0.0,
+                     key=None, use_flash=False):
+    """Encoder-decoder attention (encdec_multihead_attn_func.py): q from the
+    decoder stream, interleaved (k, v) from the encoder stream."""
+    tq, b, e = inputs_q.shape
+    tk = inputs_kv.shape[0]
+    head_dim = e // heads
+    q = jnp.matmul(inputs_q, input_weights_q.T)
+    kv = jnp.matmul(inputs_kv, input_weights_kv.T)
+    q3 = jnp.swapaxes(q.reshape(tq, b * heads, head_dim), 0, 1)
+    kv = kv.reshape(tk, b * heads, 2, head_dim)
+    k3 = jnp.swapaxes(kv[:, :, 0], 0, 1)
+    v3 = jnp.swapaxes(kv[:, :, 1], 0, 1)
+    bias = _masks_to_bias(mask, use_time_mask, b, heads, tq, tk)
+    dropout = dropout_prob if is_training else 0.0
+    if use_flash and dropout == 0.0:
+        q4 = q3.reshape(b, heads, tq, head_dim)
+        k4 = k3.reshape(b, heads, tk, head_dim)
+        v4 = v3.reshape(b, heads, tk, head_dim)
+        ctx4 = flash_attention(q4, k4, v4, bias=bias, causal=False,
+                               scale=scale)
+        ctx3 = ctx4.reshape(b * heads, tq, head_dim)
+    else:
+        ctx3 = _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout,
+                                  key)
+    ctx = jnp.swapaxes(ctx3, 0, 1).reshape(tq, b, e)
+    return jnp.matmul(ctx, output_weights.T)

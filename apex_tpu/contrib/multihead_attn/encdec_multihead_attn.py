@@ -1,0 +1,87 @@
+"""EncdecMultiheadAttn (reference:
+apex/contrib/multihead_attn/encdec_multihead_attn.py): encoder-decoder
+attention with separate q and interleaved-kv projections.  Same impl
+selection as SelfMultiheadAttn; returns (outputs, None)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.modules import Module, _next_key
+from ...nn.parameter import Parameter
+from .attn_funcs import encdec_attn_func
+from .self_multihead_attn import _AttnModule, _xavier_uniform
+
+
+class EncdecMultiheadAttn(_AttnModule):
+    def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
+                 include_norm_add=False, impl="fast"):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim, \
+            "embed_dim must be divisible by num_heads"
+        assert not bias, \
+            "ERROR! encdec multihead attention does not support biases!"
+        self.bias = False
+        self.include_norm_add = include_norm_add
+        if impl not in ("fast", "default"):
+            raise AssertionError(f"Unsupported impl: {impl} !")
+        self.impl = impl
+        self.scaling = self.head_dim ** -0.5
+
+        self.in_proj_weight_q = Parameter(
+            _xavier_uniform(_next_key(), (embed_dim, embed_dim)))
+        self.in_proj_weight_kv = Parameter(
+            _xavier_uniform(_next_key(), (2 * embed_dim, embed_dim)))
+        self.out_proj_weight = Parameter(
+            _xavier_uniform(_next_key(), (embed_dim, embed_dim)))
+        if include_norm_add:
+            self.lyr_nrm_gamma_weights = Parameter(
+                jnp.ones((embed_dim,), jnp.float32))
+            self.lyr_nrm_beta_weights = Parameter(
+                jnp.zeros((embed_dim,), jnp.float32))
+
+    def forward(self, ctx, query, key, value=None, key_padding_mask=None,
+                need_weights=False, attn_mask=None, is_training=None):
+        if key_padding_mask is not None:
+            assert attn_mask is None, \
+                "ERROR attn_mask and key_padding_mask should not be both " \
+                "defined!"
+            mask, use_time_mask = key_padding_mask, False
+        elif attn_mask is not None:
+            mask, use_time_mask = attn_mask, True
+        else:
+            mask, use_time_mask = None, False
+
+        if is_training is None:
+            is_training = ctx.training and self.training
+        drop_key = ctx.next_key() if (is_training and self.dropout > 0.0) \
+            else None
+
+        x = query
+        if self.include_norm_add:
+            from ...normalization import fused_layer_norm_affine
+            x = fused_layer_norm_affine(
+                x, ctx.value(self.lyr_nrm_gamma_weights),
+                ctx.value(self.lyr_nrm_beta_weights),
+                (self.embed_dim,), 1e-5)
+
+        outputs = encdec_attn_func(
+            use_time_mask, is_training, self.num_heads, self.scaling, x,
+            key, ctx.value(self.in_proj_weight_q),
+            ctx.value(self.in_proj_weight_kv),
+            ctx.value(self.out_proj_weight), mask, self.dropout,
+            key=drop_key, use_flash=(self.impl == "fast"))
+
+        if self.include_norm_add:
+            if is_training and self.dropout > 0.0:
+                outputs = F.dropout(outputs, self.dropout, training=True,
+                                    key=ctx.next_key())
+            outputs = outputs + query
+        return outputs, None

@@ -1,0 +1,123 @@
+"""SelfMultiheadAttn (reference:
+apex/contrib/multihead_attn/self_multihead_attn.py:19-123).
+
+API parity: same constructor args and (T, B, E) input layout; ``impl='fast'``
+routes through the Pallas flash kernel (the ``fast_self_attn_func`` CUDA
+extension analogue), ``impl='default'`` through the jnp batched-GEMM path;
+``include_norm_add`` fuses a pre-LayerNorm and residual dropout-add
+(fast_self_attn_norm_add_func analogue, built on FusedLayerNorm).
+Returns ``(outputs, None)`` like the reference (:123).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.modules import Module, _next_key
+from ...nn.parameter import Parameter
+from .attn_funcs import self_attn_func
+
+
+def _xavier_uniform(key, shape):
+    fan_out, fan_in = shape[0], shape[1]
+    bound = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
+
+
+class _AttnModule(Module):
+    """Attention modules take keyword args (masks, flags) the tape's
+    positional replay can't carry, and return a (outputs, None) tuple —
+    their ``__call__`` therefore runs forward eagerly.  Differentiable use
+    goes through ``forward(ctx, ...)`` from a parent module or the fused
+    train step, which is also how the reference integrates them."""
+
+    def __call__(self, *args, **kwargs):
+        from ...nn.modules import Ctx, _next_key
+        key = _next_key() if (self.training and self.dropout > 0.0) else None
+        ctx = Ctx(env={}, stats_out=None, training=self.training, key=key)
+        return self.forward(ctx, *args, **kwargs)
+
+
+class SelfMultiheadAttn(_AttnModule):
+    def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
+                 include_norm_add=False, impl="fast"):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim, \
+            "embed_dim must be divisible by num_heads"
+        self.bias = bias
+        self.include_norm_add = include_norm_add
+        if impl not in ("fast", "default"):
+            raise AssertionError(f"Unsupported impl: {impl} !")
+        self.impl = impl
+        self.scaling = self.head_dim ** -0.5
+
+        self.in_proj_weight = Parameter(
+            _xavier_uniform(_next_key(), (3 * embed_dim, embed_dim)))
+        self.out_proj_weight = Parameter(
+            _xavier_uniform(_next_key(), (embed_dim, embed_dim)))
+        if bias:
+            assert impl != "fast", \
+                "ERROR! The Fast implementation does not support biases!"
+            self.in_proj_bias = Parameter(jnp.zeros((3 * embed_dim,),
+                                                    jnp.float32))
+            self.out_proj_bias = Parameter(jnp.zeros((embed_dim,),
+                                                     jnp.float32))
+        else:
+            self.register_parameter("in_proj_bias", None)
+            self.register_parameter("out_proj_bias", None)
+        if include_norm_add:
+            # both impls share the affine-LN parameter pair here (the
+            # reference keeps a separate nn.LayerNorm for 'default'; one
+            # parameterization keeps checkpoints interchangeable)
+            self.lyr_nrm_gamma_weights = Parameter(
+                jnp.ones((embed_dim,), jnp.float32))
+            self.lyr_nrm_beta_weights = Parameter(
+                jnp.zeros((embed_dim,), jnp.float32))
+
+    def forward(self, ctx, query, key=None, value=None,
+                key_padding_mask=None, need_weights=False, attn_mask=None,
+                is_training=None):
+        if key_padding_mask is not None:
+            assert attn_mask is None, \
+                "ERROR attn_mask and key_padding_mask should not be both " \
+                "defined!"
+            mask, use_time_mask = key_padding_mask, False
+        elif attn_mask is not None:
+            mask, use_time_mask = attn_mask, True
+        else:
+            mask, use_time_mask = None, False
+
+        if is_training is None:
+            is_training = ctx.training and self.training
+        drop_key = ctx.next_key() if (is_training and self.dropout > 0.0) \
+            else None
+
+        x = query
+        if self.include_norm_add:
+            from ...normalization import fused_layer_norm_affine
+            x = fused_layer_norm_affine(
+                x, ctx.value(self.lyr_nrm_gamma_weights),
+                ctx.value(self.lyr_nrm_beta_weights),
+                (self.embed_dim,), 1e-5)
+
+        outputs = self_attn_func(
+            use_time_mask, is_training, self.num_heads, self.scaling, x,
+            ctx.value(self.in_proj_weight), ctx.value(self.out_proj_weight),
+            ctx.value(self.in_proj_bias) if self.bias else None,
+            ctx.value(self.out_proj_bias) if self.bias else None,
+            mask, self.dropout, key=drop_key,
+            use_flash=(self.impl == "fast"))
+
+        if self.include_norm_add:
+            if is_training and self.dropout > 0.0:
+                outputs = F.dropout(outputs, self.dropout, training=True,
+                                    key=ctx.next_key())
+            outputs = outputs + query
+        return outputs, None

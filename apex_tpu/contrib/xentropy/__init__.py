@@ -1,0 +1,5 @@
+"""apex.contrib.xentropy equivalent (reference apex/contrib/xentropy/__init__.py)."""
+from .softmax_xentropy import (  # noqa: F401
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)

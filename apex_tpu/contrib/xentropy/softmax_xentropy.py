@@ -1,0 +1,78 @@
+"""Label-smoothed softmax cross-entropy with max_log_sum_exp residual —
+TPU-native equivalent of ``apex.contrib.xentropy.SoftmaxCrossEntropyLoss``
+(apex/contrib/xentropy/softmax_xentropy.py:4-28 over ``xentropy_cuda``,
+apex/contrib/csrc/xentropy/xentropy_kernel.cu).
+
+The extension's point is memory: the forward saves only the per-row
+``max_log_sum_exp`` (one scalar per sample) instead of the full softmax; the
+backward reconstructs probabilities as ``exp(logit - lse)``
+(xentropy_kernel.cu:428-432: grad = softmax - ((1-s)·onehot + s/C)).  The
+same residual contract here via ``jax.custom_vjp``.
+
+Loss semantics (xentropy_kernel.cu:404-410): with smoothing s and C classes,
+``loss_i = lse_i - (1-s)·logit_i[y_i] - s·mean_j(logit_ij)`` — i.e. cross
+entropy against ``q = (1-s)·onehot + s/C``.  Per-sample losses are returned
+(no reduction); rows with ``label == padding_idx`` contribute zero loss and
+zero gradient (softmax_xentropy.py:10,24).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, padding_idx=0,
+                               half_to_float=False):
+    losses, _ = _fwd_math(logits, labels, smoothing, padding_idx)
+    if not half_to_float:
+        losses = losses.astype(logits.dtype)
+    return losses
+
+
+def _fwd_math(logits, labels, smoothing, padding_idx):
+    lf = logits.astype(_f32)
+    m = jnp.max(lf, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1))
+    tgt_logit = jnp.take_along_axis(lf, labels[..., None], axis=-1)[..., 0]
+    losses = lse - (1.0 - smoothing) * tgt_logit \
+        - smoothing * jnp.mean(lf, axis=-1)
+    losses = jnp.where(labels == padding_idx, 0.0, losses)
+    return losses, lse
+
+
+def _fwd(logits, labels, smoothing, padding_idx, half_to_float):
+    losses, lse = _fwd_math(logits, labels, smoothing, padding_idx)
+    out = losses if half_to_float else losses.astype(logits.dtype)
+    # residual: logits + one scalar per row — NOT the softmax
+    return out, (logits, lse, labels)
+
+
+def _bwd(smoothing, padding_idx, half_to_float, res, g):
+    logits, lse, labels = res
+    c = logits.shape[-1]
+    probs = jnp.exp(logits.astype(_f32) - lse[..., None])
+    onehot = jax.nn.one_hot(labels, c, dtype=_f32)
+    q = (1.0 - smoothing) * onehot + smoothing / c
+    gmask = jnp.where(labels == padding_idx, 0.0, g.astype(_f32))
+    grad = gmask[..., None] * (probs - q)
+    return grad.astype(logits.dtype), None
+
+
+softmax_cross_entropy_loss.defvjp(_fwd, _bwd)
+
+
+class SoftmaxCrossEntropyLoss:
+    """Reference-parity callable surface: the reference exposes a
+    ``torch.autograd.Function`` used as ``SoftmaxCrossEntropyLoss.apply(...)``
+    (softmax_xentropy.py:4)."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=0,
+              half_to_float=False):
+        return softmax_cross_entropy_loss(logits, labels, smoothing,
+                                          padding_idx, half_to_float)

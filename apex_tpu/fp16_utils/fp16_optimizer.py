@@ -1,0 +1,176 @@
+"""FP16_Optimizer — legacy manual master-weight wrapper (reference:
+apex/fp16_utils/fp16_optimizer.py:13-270; deprecated there in favor of amp,
+:20-22, but still public API).
+
+Wraps any apex_tpu optimizer: half params get fp32 master copies swapped
+into the inner ``param_groups``; ``backward(loss)`` scales the loss,
+``update_master_grads`` unscales model grads into the masters (with
+overflow detection when dynamic), ``step`` skips on overflow then copies
+masters back into the model params.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..nn.parameter import Parameter
+from .fp16util import (clip_grad_norm, master_params_to_model_params,
+                       model_grads_to_master_grads)
+from .loss_scaler import DynamicLossScaler, LossScaler
+
+_HALF_DTYPES = (jnp.float16, jnp.bfloat16)
+
+
+def _is_half(p) -> bool:
+    return any(p.dtype == d for d in _HALF_DTYPES)
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=True):
+        self.optimizer = init_optimizer
+        self.verbose = verbose
+
+        # partition each group (reference fp16_optimizer.py:43-95)
+        self.fp16_groups: List[List[Parameter]] = []
+        self.fp32_from_fp16_groups: List[List[Parameter]] = []
+        self.fp32_from_fp32_groups: List[List[Parameter]] = []
+        for group in self.optimizer.param_groups:
+            fp16, fp32_from_fp16, fp32 = [], [], []
+            new_params = []
+            for p in group["params"]:
+                if _is_half(p):
+                    master = Parameter(p.data.astype(jnp.float32))
+                    master.requires_grad = True
+                    fp16.append(p)
+                    fp32_from_fp16.append(master)
+                    new_params.append(master)
+                    if p in self.optimizer.state:
+                        self.optimizer.state[master] = \
+                            self.optimizer.state.pop(p)
+                else:
+                    fp32.append(p)
+                    new_params.append(p)
+            group["params"] = new_params
+            self.fp16_groups.append(fp16)
+            self.fp32_from_fp16_groups.append(fp32_from_fp16)
+            self.fp32_from_fp32_groups.append(fp32)
+
+        if dynamic_loss_scale:
+            self.dynamic_loss_scale = True
+            args = dynamic_loss_args or {}
+            self.loss_scaler = DynamicLossScaler(**args)
+        else:
+            self.dynamic_loss_scale = False
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.overflow = False
+        self.first_closure_call_this_step = True
+
+    def maybe_print(self, msg):
+        if self.verbose:
+            print(msg)
+
+    # -- torch-optimizer protocol delegation -------------------------------
+    @property
+    def param_groups(self):
+        return self.optimizer.param_groups
+
+    @property
+    def state(self):
+        return self.optimizer.state
+
+    def zero_grad(self, set_grads_to_None=False):
+        for group in self.optimizer.param_groups:
+            for p in group["params"]:
+                p.grad = None if set_grads_to_None else (
+                    jnp.zeros_like(p.grad) if p.grad is not None else None)
+        for group in self.fp16_groups:
+            for p in group:
+                p.grad = None if set_grads_to_None else (
+                    jnp.zeros_like(p.grad) if p.grad is not None else None)
+
+    # -- the manual loop surface (reference :97-208) -----------------------
+    def backward(self, loss, update_master_grads=True, retain_graph=False):
+        scaled = loss * float(self.loss_scaler.loss_scale)
+        scaled.backward()
+        if update_master_grads:
+            self.update_master_grads()
+
+    def update_master_grads(self):
+        """Unscale model grads into master grads; detect overflow
+        (reference :160-185)."""
+        self.overflow = self.loss_scaler.has_overflow(
+            [p for g in self.fp16_groups for p in g])
+        self.loss_scaler.update_scale(self.overflow)
+        if self.overflow:
+            return
+        inv = 1.0 / float(self.loss_scaler.loss_scale)
+        for fp16_group, master_group in zip(self.fp16_groups,
+                                            self.fp32_from_fp16_groups):
+            model_grads_to_master_grads(fp16_group, master_group)
+            for m in master_group:
+                if m.grad is not None:
+                    m.grad = m.grad * inv
+        for fp32_group in self.fp32_from_fp32_groups:
+            for p in fp32_group:
+                if p.grad is not None and inv != 1.0:
+                    p.grad = p.grad * inv
+
+    def clip_master_grads(self, max_norm, norm_type=2):
+        """Returns the pre-clip grad norm, or -1 when this step overflowed
+        (reference :187-208)."""
+        if self.overflow:
+            return -1
+        masters = [p for g in self.optimizer.param_groups
+                   for p in g["params"]]
+        return clip_grad_norm(masters, max_norm, norm_type)
+
+    def step(self, closure=None):
+        if self.overflow:
+            self.maybe_print(
+                f"OVERFLOW! Skipping step. Attempted loss scale: "
+                f"{self.loss_scaler.loss_scale}")
+            return
+        if closure is not None:
+            raise NotImplementedError(
+                "closure-based step is not supported on the TPU build")
+        self.optimizer.step()
+        for fp16_group, master_group in zip(self.fp16_groups,
+                                            self.fp32_from_fp16_groups):
+            master_params_to_model_params(fp16_group, master_group)
+
+    # -- checkpointing (reference :209-270) --------------------------------
+    def state_dict(self):
+        return {
+            "loss_scaler": self.loss_scaler,
+            "dynamic_loss_scale": self.dynamic_loss_scale,
+            "overflow": self.overflow,
+            "first_closure_call_this_step":
+                self.first_closure_call_this_step,
+            "optimizer_state_dict": self.optimizer.state_dict(),
+            "fp32_from_fp16": [[p.data for p in g]
+                               for g in self.fp32_from_fp16_groups],
+        }
+
+    def load_state_dict(self, state_dict):
+        self.loss_scaler = state_dict["loss_scaler"]
+        self.dynamic_loss_scale = state_dict["dynamic_loss_scale"]
+        self.overflow = state_dict["overflow"]
+        self.first_closure_call_this_step = \
+            state_dict["first_closure_call_this_step"]
+        self.optimizer.load_state_dict(state_dict["optimizer_state_dict"])
+        for cur, saved in zip(self.fp32_from_fp16_groups,
+                              state_dict["fp32_from_fp16"]):
+            for p, data in zip(cur, saved):
+                p.data = jnp.asarray(data, jnp.float32)
+
+    # -- loss scale accessors (reference :272-286) -------------------------
+    def _get_loss_scale(self):
+        return self.loss_scaler.loss_scale
+
+    def _set_loss_scale(self, value):
+        self.loss_scaler.cur_scale = value
+
+    loss_scale = property(_get_loss_scale, _set_loss_scale)

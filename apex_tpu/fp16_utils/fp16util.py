@@ -1,0 +1,141 @@
+"""Manual mixed-precision helpers (reference: apex/fp16_utils/fp16util.py).
+
+These predate amp in the reference and remain public API.  Semantics kept:
+``network_to_half`` casts params/buffers to half but leaves batchnorm in
+fp32 (fp16util.py:35-58); ``convert_network`` is the dtype-general form
+(:60-70); ``prep_param_lists`` builds fp32 master copies, optionally
+flattened into one tensor (:90-134); the grad/param copy helpers move
+between model and master lists (:136-172).
+
+TPU notes: "half" defaults to bfloat16 (fp16 supported for parity testing);
+the flat-master path concatenates into a single fp32 array — the layout the
+fused optimizers prefer on TPU anyway.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..nn.modules import Module, _BatchNorm
+from ..nn.parameter import Parameter
+
+
+def tofp16(network: Module, dtype=jnp.bfloat16) -> Module:
+    """Cast the whole network to half (reference fp16util.py:35-43)."""
+    return network.to(dtype)
+
+
+def BN_convert_float(module: Module) -> Module:
+    """Cast batchnorm modules back to fp32 (reference fp16util.py:46-58)."""
+    for m in module.modules():
+        if isinstance(m, _BatchNorm):
+            m._cast_params(jnp.float32)
+    return module
+
+
+def network_to_half(network: Module, dtype=jnp.bfloat16) -> Module:
+    """Half network with fp32 batchnorm (reference fp16util.py:35-58 —
+    there a composition of tofp16 + BN_convert_float)."""
+    return BN_convert_float(tofp16(network, dtype))
+
+
+def convert_module(module: Module, dtype) -> Module:
+    """Cast ONE module's own params/buffers unless it's batchnorm
+    (reference fp16util.py:72-88)."""
+    if isinstance(module, _BatchNorm):
+        return module
+    for p in module._parameters.values():
+        if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+            p.data = p.data.astype(dtype)
+    for b in module._buffers.values():
+        if b is not None and jnp.issubdtype(b.dtype, jnp.floating):
+            b.data = b.data.astype(dtype)
+    return module
+
+
+def convert_network(network: Module, dtype) -> Module:
+    """Cast all non-BN modules to ``dtype`` (reference fp16util.py:60-70);
+    the predicate amp's O2 cast shares."""
+    for m in network.modules():
+        convert_module(m, dtype)
+    return network
+
+
+def prep_param_lists(model: Module, flat_master: bool = False
+                     ) -> Tuple[List[Parameter], List[Parameter]]:
+    """(model_params, master_params) with fp32 master copies (reference
+    fp16util.py:90-134).  ``flat_master=True`` returns a singleton list
+    holding one flattened fp32 master."""
+    model_params = [p for p in model.parameters()
+                    if getattr(p, "requires_grad", True)]
+    if flat_master:
+        flat = jnp.concatenate(
+            [jnp.ravel(p.data).astype(jnp.float32) for p in model_params])
+        master = Parameter(flat)
+        master.requires_grad = True
+        return model_params, [master]
+    masters = []
+    for p in model_params:
+        m = Parameter(p.data.astype(jnp.float32))
+        m.requires_grad = True
+        masters.append(m)
+    return model_params, masters
+
+
+def model_grads_to_master_grads(model_params, master_params,
+                                flat_master: bool = False):
+    """Copy model grads into master grads, upcasting (reference
+    fp16util.py:136-156)."""
+    if flat_master:
+        grads = [jnp.ravel(p.grad).astype(jnp.float32)
+                 if p.grad is not None else jnp.zeros((p.size,), jnp.float32)
+                 for p in model_params]
+        master_params[0].grad = jnp.concatenate(grads)
+    else:
+        for model, master in zip(model_params, master_params):
+            master.grad = (model.grad.astype(jnp.float32)
+                           if model.grad is not None else None)
+
+
+def master_params_to_model_params(model_params, master_params,
+                                  flat_master: bool = False):
+    """Copy master params back into the model, downcasting (reference
+    fp16util.py:158-172)."""
+    if flat_master:
+        offset = 0
+        flat = master_params[0].data
+        for p in model_params:
+            n = p.size
+            p.data = flat[offset:offset + n].reshape(p.shape).astype(p.dtype)
+            offset += n
+    else:
+        for model, master in zip(model_params, master_params):
+            model.data = master.data.astype(model.dtype)
+
+
+def to_python_float(t) -> float:
+    if hasattr(t, "item"):
+        return float(t.item())
+    return float(t)
+
+
+def clip_grad_norm(parameters, max_norm: float, norm_type: float = 2.0):
+    """Grad clipping over a param list; returns the pre-clip total norm
+    (the torch.nn.utils.clip_grad_norm the reference re-exports,
+    fp16util.py:17-33)."""
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return 0.0
+    if norm_type == float("inf"):
+        total = max(float(jnp.max(jnp.abs(p.grad))) for p in params)
+    else:
+        total = float(sum(jnp.sum(jnp.abs(p.grad.astype(jnp.float32))
+                                  ** norm_type) for p in params)
+                      ) ** (1.0 / norm_type)
+    clip_coef = max_norm / (total + 1e-6)
+    if clip_coef < 1.0:
+        for p in params:
+            p.grad = (p.grad.astype(jnp.float32) * clip_coef).astype(
+                p.grad.dtype)
+    return total

@@ -1,0 +1,90 @@
+"""Legacy loss scalers (reference: apex/fp16_utils/loss_scaler.py).
+
+``LossScaler`` is static; ``DynamicLossScaler`` starts at 2**32, halves on
+overflow and doubles after ``scale_window=1000`` clean iterations
+(loss_scaler.py:10,46,74-82,113-121 — note the legacy defaults differ from
+amp's scaler: init 2**32 vs 2**16, window 1000 vs 2000).  Kept as a separate
+small implementation because the legacy API is iteration-driven
+(``update_scale(overflow)``/``has_overflow(params)``) rather than
+state-threaded.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _params_have_overflow(params) -> bool:
+    for p in params:
+        if p.grad is not None and not bool(
+                jnp.isfinite(p.grad.astype(jnp.float32)).all()):
+            return True
+    return False
+
+
+class LossScaler:
+    """Static loss scaler (reference loss_scaler.py:10-44)."""
+
+    def __init__(self, scale=1.0):
+        self.cur_scale = scale
+
+    def has_overflow(self, params):
+        return False
+
+    def _has_inf_or_nan(x):
+        return False
+
+    def update_scale(self, overflow):
+        pass
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, module, grad_in, grad_out):
+        return tuple(self.loss_scale * g for g in grad_in)
+
+    def backward(self, loss, retain_graph=False):
+        scaled_loss = loss * self.loss_scale
+        scaled_loss.backward()
+
+
+class DynamicLossScaler:
+    """Dynamic loss scaler (reference loss_scaler.py:46-135)."""
+
+    def __init__(self, init_scale=2 ** 32, scale_factor=2.0,
+                 scale_window=1000):
+        self.cur_scale = init_scale
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+
+    def has_overflow(self, params):
+        return _params_have_overflow(params)
+
+    @staticmethod
+    def _has_inf_or_nan(x):
+        return not bool(jnp.isfinite(
+            jnp.asarray(x, jnp.float32)).all())
+
+    def update_scale(self, overflow):
+        # reference loss_scaler.py:113-121
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1)
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if (self.cur_iter - self.last_overflow_iter) % \
+                    self.scale_window == 0:
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, module, grad_in, grad_out):
+        return tuple(self.loss_scale * g for g in grad_in)
+
+    def backward(self, loss, retain_graph=False):
+        scaled_loss = loss * self.loss_scale
+        scaled_loss.backward()

@@ -1,0 +1,2 @@
+"""apex.mlp equivalent (reference apex/mlp/__init__.py)."""
+from .mlp import MLP, mlp_function  # noqa: F401

@@ -1,0 +1,83 @@
+"""Fused MLP — TPU-native equivalent of ``apex.mlp.MLP``
+(apex/mlp/mlp.py:24-71 over the ``mlp_cuda`` extension, csrc/mlp.cpp:137-138).
+
+The CUDA version exists to fuse N cublas GEMMs with bias/ReLU epilogues and a
+single reserved activation buffer.  On TPU the same chain expressed as plain
+``jnp.matmul`` + bias + relu is already fused by XLA into MXU GEMMs with
+elementwise epilogues — the idiomatic "fused MLP" is therefore the jitted
+composition itself; what we preserve from the reference is the API (flat
+weight/bias attribute list, the same init distribution, ``bias``/``relu``
+constructor contract, amp half_function registration) and the numerics
+(ReLU after every layer, including the last — tests/L0/run_mlp/test_mlp.py:23-32).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..amp.policy import apply_op_policy
+from ..nn import functional as F
+from ..nn.modules import Module, _next_key
+from ..nn.parameter import Parameter
+
+
+def mlp_function(x, *weights_and_biases):
+    """Functional fused MLP: alternating GEMM+bias+ReLU over the flat
+    ``(w0..wN-1, b0..bN-1)`` argument list, mirroring ``MlpFunction.apply``
+    (mlp.py:8-22).  Registered on the amp half list, as the reference wraps
+    it with ``amp.half_function`` (mlp.py:22)."""
+    (x, *weights_and_biases), _ = apply_op_policy(
+        "mlp", (x, *weights_and_biases), {})
+    num_layers = len(weights_and_biases) // 2
+    weights = weights_and_biases[:num_layers]
+    biases = weights_and_biases[num_layers:]
+    for w, b in zip(weights, biases):
+        x = F.relu(jnp.matmul(x, w.T) + b)
+    return x
+
+
+class MLP(Module):
+    """Multi-layer Linear+bias+ReLU block.
+
+    Args mirror the reference (mlp.py:30-35): ``mlp_sizes`` e.g.
+    ``[480, 1024, 1024, 1]`` creates 3 layers; ``bias`` and ``relu`` must both
+    be True (same constraint as mlp.py:33-34).
+    """
+
+    def __init__(self, mlp_sizes, bias=True, relu=True):
+        if not (bias and relu):
+            raise TypeError("bias and relu must be both true.")
+        super().__init__()
+        self.num_layers = len(mlp_sizes) - 1
+        self.mlp_sizes = list(mlp_sizes)
+        self.bias, self.relu = bias, relu
+        self.weights, self.biases = [], []
+        for i in range(self.num_layers):
+            w = Parameter(jnp.zeros((mlp_sizes[i + 1], mlp_sizes[i]),
+                                    jnp.float32))
+            self.weights.append(w)
+            setattr(self, f"weight_{i}", w)
+            b = Parameter(jnp.zeros((mlp_sizes[i + 1],), jnp.float32))
+            self.biases.append(b)
+            setattr(self, f"bias_{i}", b)
+        self.reset_parameters()
+
+    def reset_parameters(self):
+        # same distributions as the reference (mlp.py:55-62)
+        for w in self.weights:
+            std = math.sqrt(2.0 / float(w.shape[0] + w.shape[1]))
+            w.data = std * jax.random.normal(_next_key(), w.shape, jnp.float32)
+        for b in self.biases:
+            std = math.sqrt(1.0 / float(b.shape[0]))
+            b.data = std * jax.random.normal(_next_key(), b.shape, jnp.float32)
+
+    def forward(self, ctx, x):
+        vals = [ctx.value(w) for w in self.weights] + \
+               [ctx.value(b) for b in self.biases]
+        return mlp_function(x, *vals)
+
+    def extra_repr(self):
+        return (f"MLP sizes: {self.mlp_sizes}, Bias={self.bias}, "
+                f"ReLU={self.relu}")

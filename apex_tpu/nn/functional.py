@@ -159,17 +159,27 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         local_count = 1
         for a in reduce_axes:
             local_count *= x.shape[a]
-        s = jnp.sum(xf, axis=reduce_axes)
-        sq = jnp.sum(xf * xf, axis=reduce_axes)
+        # shifted two-pass locally (E[x^2]-mean^2 cancels catastrophically
+        # for large-mean activations), then a Welford merge of per-replica
+        # (mean, M2) — the same scheme as the reference's welford_parallel
+        # (csrc/welford.cu, optimized_sync_batchnorm_kernel.py:32-45)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        m2 = jnp.sum(jnp.square(xf - mean.reshape(shape)),
+                     axis=reduce_axes)
         count = jnp.asarray(local_count, jnp.float32)
         if axis_name is not None:
             try:
-                s = lax.psum(s, axis_name,
-                             axis_index_groups=axis_index_groups)
-                sq = lax.psum(sq, axis_name,
-                              axis_index_groups=axis_index_groups)
-                count = lax.psum(count, axis_name,
-                                 axis_index_groups=axis_index_groups)
+                # per-replica counts are equal under SPMD (same local
+                # shapes), so the uniform-count merge is exact
+                means = lax.all_gather(mean, axis_name,
+                                       axis_index_groups=axis_index_groups)
+                m2s = lax.all_gather(m2, axis_name,
+                                     axis_index_groups=axis_index_groups)
+                group = means.shape[0]
+                mean = jnp.mean(means, axis=0)
+                m2 = jnp.sum(m2s, axis=0) + local_count * jnp.sum(
+                    jnp.square(means - mean), axis=0)
+                count = count * group
             except NameError:
                 # Axis not bound: not running under shard_map/pmap.  Under
                 # automatic SPMD (jit + sharded batch) local stats already
@@ -177,8 +187,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                 # but under shard_map with a differently-named axis it would
                 # silently break sync, so say something.
                 _warn_unbound_bn_axis(axis_name)
-        mean = s / count
-        var = sq / count - mean * mean  # biased, used for normalization
+        var = m2 / count  # biased, used for normalization
         # unbiased variance feeds the running stats (reference
         # sync_batchnorm.py:114-121)
         unbiased = var * (count / jnp.maximum(count - 1.0, 1.0))

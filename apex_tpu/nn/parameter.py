@@ -30,6 +30,10 @@ class Parameter:
     def ndim(self):
         return self.data.ndim
 
+    @property
+    def size(self):
+        return self.data.size
+
     def numel(self) -> int:
         return int(self.data.size)
 

@@ -1,0 +1,6 @@
+"""apex.normalization equivalents (reference apex/normalization/__init__.py)."""
+from .fused_layer_norm import (  # noqa: F401
+    FusedLayerNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+)

@@ -1,0 +1,161 @@
+"""FusedLayerNorm — TPU-native equivalent of the reference's
+``apex.normalization.FusedLayerNorm`` (apex/normalization/fused_layer_norm.py:70,
+backed by the ``fused_layer_norm_cuda`` extension, csrc/layer_norm_cuda.cpp).
+
+The functional forms carry a ``jax.custom_vjp`` whose forward saves the fp32
+``(mean, invvar)`` residuals — exactly the extension's contract
+(layer_norm_cuda.cpp:133-155: fwd returns (out, mean, invvar), bwd consumes
+them).  On TPU the fwd/bwd run as Pallas kernels
+(apex_tpu/ops/pallas/layer_norm.py); elsewhere an equivalent jnp path is used
+(the reference's CPU fallback, fused_layer_norm.py:153-161).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.modules import Module
+from ..nn.parameter import Parameter
+from ..ops.pallas import pallas_mode
+from ..ops.pallas import layer_norm as _k
+
+_f32 = jnp.float32
+
+
+def _flatten(x, normalized_shape):
+    ns = tuple(normalized_shape)
+    if x.shape[x.ndim - len(ns):] != ns:
+        raise ValueError(
+            f"Expected input with trailing dims {ns}, got shape {x.shape} "
+            "(normalized_shape must match the input's last dimensions)")
+    n = 1
+    for d in ns:
+        n *= d
+    rows = x.size // n
+    return x.reshape(rows, n), rows, n
+
+
+# -- jnp fallback path (also the test oracle) -------------------------------
+
+def _ref_forward(x2d, weight, bias, eps):
+    xf = x2d.astype(_f32)
+    mean = jnp.mean(xf, axis=1, keepdims=True)
+    xc = xf - mean
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = xc * rstd
+    if weight is not None:
+        y = y * weight.astype(_f32) + bias.astype(_f32)
+    return y.astype(x2d.dtype), mean, rstd
+
+
+def _ref_backward(g2d, x2d, mean, rstd, weight):
+    g = g2d.astype(_f32)
+    xhat = (x2d.astype(_f32) - mean) * rstd
+    gh = g * weight.astype(_f32) if weight is not None else g
+    c1 = jnp.mean(gh, axis=1, keepdims=True)
+    c2 = jnp.mean(gh * xhat, axis=1, keepdims=True)
+    dx = ((gh - c1 - xhat * c2) * rstd).astype(x2d.dtype)
+    if weight is None:
+        return (dx,)
+    return dx, jnp.sum(g * xhat, axis=0), jnp.sum(g, axis=0)
+
+
+def _fwd_dispatch(x2d, weight, bias, eps):
+    mode = pallas_mode()
+    if mode is None:
+        return _ref_forward(x2d, weight, bias, eps)
+    return _k.ln_forward(x2d, weight, bias, eps,
+                         interpret=(mode == "interpret"))
+
+
+def _bwd_dispatch(g2d, x2d, mean, rstd, weight):
+    mode = pallas_mode()
+    if mode is None:
+        return _ref_backward(g2d, x2d, mean, rstd, weight)
+    return _k.ln_backward(g2d, x2d, mean, rstd, weight,
+                          interpret=(mode == "interpret"))
+
+
+# -- public functional API (reference fused_layer_norm.py:64-68) ------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_layer_norm_affine(input, weight, bias, normalized_shape, eps=1e-6):
+    x2d, rows, n = _flatten(input, normalized_shape)
+    y, _, _ = _fwd_dispatch(x2d, weight.reshape(n), bias.reshape(n), eps)
+    return y.reshape(input.shape)
+
+
+def _affine_fwd(input, weight, bias, normalized_shape, eps):
+    x2d, rows, n = _flatten(input, normalized_shape)
+    y, mean, rstd = _fwd_dispatch(x2d, weight.reshape(n), bias.reshape(n), eps)
+    return y.reshape(input.shape), (x2d, mean, rstd, weight)
+
+
+def _affine_bwd(normalized_shape, eps, res, g):
+    x2d, mean, rstd, weight = res
+    n = x2d.shape[1]
+    dx, dw, db = _bwd_dispatch(g.reshape(x2d.shape), x2d, mean, rstd,
+                               weight.reshape(n))
+    return (dx.reshape(g.shape).astype(g.dtype),
+            dw.reshape(weight.shape).astype(weight.dtype),
+            db.reshape(weight.shape).astype(weight.dtype))
+
+
+fused_layer_norm_affine.defvjp(_affine_fwd, _affine_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def fused_layer_norm(input, normalized_shape, eps=1e-6):
+    x2d, _, _ = _flatten(input, normalized_shape)
+    y, _, _ = _fwd_dispatch(x2d, None, None, eps)
+    return y.reshape(input.shape)
+
+
+def _plain_fwd(input, normalized_shape, eps):
+    x2d, _, _ = _flatten(input, normalized_shape)
+    y, mean, rstd = _fwd_dispatch(x2d, None, None, eps)
+    return y.reshape(input.shape), (x2d, mean, rstd)
+
+
+def _plain_bwd(normalized_shape, eps, res, g):
+    x2d, mean, rstd = res
+    (dx,) = _bwd_dispatch(g.reshape(x2d.shape), x2d, mean, rstd, None)
+    return (dx.reshape(g.shape).astype(g.dtype),)
+
+
+fused_layer_norm.defvjp(_plain_fwd, _plain_bwd)
+
+
+# -- module (reference fused_layer_norm.py:70-166) --------------------------
+
+class FusedLayerNorm(Module):
+    """Drop-in for nn.LayerNorm backed by the fused kernel; fp32 statistics
+    for half inputs (reference csrc/layer_norm_cuda.cpp:133,155)."""
+
+    def __init__(self, normalized_shape, eps=1e-5, elementwise_affine=True):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+        if elementwise_affine:
+            self.weight = Parameter(jnp.ones(self.normalized_shape, _f32))
+            self.bias = Parameter(jnp.zeros(self.normalized_shape, _f32))
+        else:
+            self.register_parameter("weight", None)
+            self.register_parameter("bias", None)
+
+    def forward(self, ctx, x):
+        if self.elementwise_affine:
+            return fused_layer_norm_affine(
+                x, ctx.value(self.weight), ctx.value(self.bias),
+                self.normalized_shape, self.eps)
+        return fused_layer_norm(x, self.normalized_shape, self.eps)
+
+    def extra_repr(self):
+        return (f"{self.normalized_shape}, eps={self.eps}, "
+                f"elementwise_affine={self.elementwise_affine}")

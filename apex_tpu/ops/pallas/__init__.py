@@ -1,0 +1,49 @@
+"""Pallas kernel substrate — backend selection for the fused TPU kernels.
+
+The reference ships each fused op twice: a CUDA extension and a pure-Python
+fallback chosen at import time (e.g. apex/parallel/__init__.py:14-19,
+apex/multi_tensor_apply/multi_tensor_apply.py:3-30 ``available``).  Our
+analogue is trace-time dispatch: on TPU the Pallas kernel compiles natively;
+elsewhere ops fall back to an equivalent pure-jnp path (same numerics — this
+duality is also the test oracle, mirroring tests/L1 "extension build vs
+python build" loss comparison).  ``interpret`` mode runs the actual Pallas
+kernels through the interpreter on CPU so kernel logic is testable without
+hardware.
+"""
+import contextlib
+import os
+
+import jax
+
+_forced = [None]
+
+
+def pallas_mode():
+    """Returns 'compiled' | 'interpret' | None (use the jnp fallback).
+
+    Priority: force_mode() context > APEX_TPU_PALLAS env var
+    ('off'/'0', 'interpret', 'compiled') > backend autodetect.
+    """
+    if _forced[0] is not None:
+        return None if _forced[0] == "off" else _forced[0]
+    env = os.environ.get("APEX_TPU_PALLAS", "").lower()
+    if env in ("0", "off"):
+        return None
+    if env in ("interpret", "compiled"):
+        return env
+    return "compiled" if jax.default_backend() == "tpu" else None
+
+
+@contextlib.contextmanager
+def force_mode(mode):
+    """Force kernel dispatch for a scope: 'compiled', 'interpret' or 'off'.
+
+    Note: dispatch happens at trace time, so already-jitted callables keep
+    the mode they were traced with.
+    """
+    prev = _forced[0]
+    _forced[0] = mode
+    try:
+        yield
+    finally:
+        _forced[0] = prev

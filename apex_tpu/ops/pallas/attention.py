@@ -1,0 +1,310 @@
+"""Pallas TPU fused attention kernels.
+
+TPU re-design of the reference's ``fast_*_multihead_attn`` extensions
+(apex/contrib/csrc/multihead_attn/, ~5900 LoC of fused QKV GEMM +
+strided-batched attention GEMMs + fused mask/softmax).  The reference kernel
+materializes the full (Sq, Sk) softmax; the modern TPU analogue is a
+flash-attention kernel — blockwise online softmax, O(S) memory, saving only
+the per-row logsumexp for the backward (SURVEY.md §2.2 maps
+fast_multihead_attn → "Pallas fused attention, flash-style").
+
+Layout: q (B, H, Sq, D), k/v (B, H, Sk, D), flattened to (B·H, S, D) for the
+kernels.  Grid (batch·head, q-blocks, k-blocks) with the k dimension
+innermost: TPU grids execute sequentially, so the running max / denominator /
+accumulator live in VMEM scratch across the k sweep (the canonical TPU flash
+pattern).  The backward recomputes attention blockwise from the saved
+logsumexp: one kernel accumulates dq over the k sweep, a second accumulates
+dk/dv over the q sweep.  All softmax/accumulation math in fp32.
+
+An additive ``bias`` (broadcastable (B|1, Sq|1, Sk)) carries both mask
+flavors of the reference API (key_padding_mask → 0/-inf per key,
+attn_mask → additive (Sq, Sk)); ``causal`` applies the in-kernel triangular
+mask the reference calls ``mask_future_timesteps``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_f32 = jnp.float32
+_NEG = -1e30  # finite "-inf": keeps exp(s - m) well-defined in masked blocks
+
+
+def _ceil_div(a, b):
+    return (a + b - 1) // b
+
+
+def _block_sizes(sq, sk):
+    bq = min(256, _round8(sq))
+    bk = min(512, _round8(sk))
+    return bq, bk
+
+
+def _round8(x):
+    return max(8, (x + 7) // 8 * 8)
+
+
+def _mask_block(s, i, j, bq, bk, causal):
+    if not causal:
+        return s
+    rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, _NEG)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
+                has_bias):
+    if has_bias:
+        bias_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(_f32)
+    k = k_ref[0].astype(_f32)
+    v = v_ref[0].astype(_f32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=_f32) * scale
+    if has_bias:
+        s = s + bias_ref[0].astype(_f32)
+    s = _mask_block(s, i, j, bq, bk, causal)
+
+    m_prev = m_scr[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
+        p, v, preferred_element_type=_f32)
+    m_scr[...] = m_new
+
+    @pl.when(j == nk - 1)
+    def _fin():
+        l = l_scr[...]
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked row → zeros
+        o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(safe_l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+               scale, causal, bq, bk, nk, has_bias):
+    if has_bias:
+        bias_ref, dq_ref, acc_scr = refs
+    else:
+        dq_ref, acc_scr = refs
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(_f32)
+    k = k_ref[0].astype(_f32)
+    v = v_ref[0].astype(_f32)
+    do = do_ref[0].astype(_f32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=_f32) * scale
+    if has_bias:
+        s = s + bias_ref[0].astype(_f32)
+    s = _mask_block(s, i, j, bq, bk, causal)
+    p = jnp.exp(s - lse_ref[0])
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=_f32)
+    ds = p * (dp - delta_ref[0])
+    acc_scr[...] += jax.lax.dot(ds, k, preferred_element_type=_f32)
+
+    @pl.when(j == nk - 1)
+    def _fin():
+        dq_ref[0] = (acc_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                scale, causal, bq, bk, nq, has_bias):
+    if has_bias:
+        bias_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
+    # grid is (bh, k-blocks, q-blocks): q innermost for the accumulation
+    j, i = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0].astype(_f32)
+    k = k_ref[0].astype(_f32)
+    v = v_ref[0].astype(_f32)
+    do = do_ref[0].astype(_f32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=_f32) * scale
+    if has_bias:
+        s = s + bias_ref[0].astype(_f32)
+    s = _mask_block(s, i, j, bq, bk, causal)
+    p = jnp.exp(s - lse_ref[0])  # (bq, bk)
+    dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=_f32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=_f32)
+    ds = p * (dp - delta_ref[0])  # (bq, bk)
+    dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=_f32)
+
+    @pl.when(i == nq - 1)
+    def _fin():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bias_spec(bias, bq, bk, for_dkv=False):
+    b_, sq_, _ = bias.shape
+    if for_dkv:
+        def idx(b, j, i):
+            return (b if b_ > 1 else 0, i if sq_ > 1 else 0, j)
+    else:
+        def idx(b, i, j):
+            return (b if b_ > 1 else 0, i if sq_ > 1 else 0, j)
+    return pl.BlockSpec((1, bq if sq_ > 1 else 1, bk), idx)
+
+
+def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False):
+    """q3 (BH, Sq, D), k3/v3 (BH, Sk, D), bias (B|1, Sq|1, Sk) or None.
+    Returns (out (BH, Sq, D), lse (BH, Sq) fp32)."""
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    bq, bk = _block_sizes(sq, sk)
+    sq_p, sk_p = _ceil_div(sq, bq) * bq, _ceil_div(sk, bk) * bk
+    q3 = jnp.pad(q3, ((0, 0), (0, sq_p - sq), (0, 0)))
+    k3 = jnp.pad(k3, ((0, 0), (0, sk_p - sk), (0, 0)))
+    v3 = jnp.pad(v3, ((0, 0), (0, sk_p - sk), (0, 0)))
+    has_bias = bias is not None
+    if not has_bias and sk_p != sk:
+        # mask the padded keys so they don't leak into the softmax
+        bias = jnp.zeros((1, 1, sk), _f32)
+        has_bias = True
+    if has_bias:
+        bias = jnp.pad(bias.astype(_f32),
+                       ((0, 0), (0, sq_p - bias.shape[1] if
+                                 bias.shape[1] > 1 else 0),
+                        (0, sk_p - bias.shape[2])),
+                       constant_values=_NEG)
+    nq, nk = sq_p // bq, sk_p // bk
+    grid = (bh, nq, nk)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q3, k3, v3]
+    if has_bias:
+        in_specs.append(_bias_spec(bias, bq, bk))
+        args.append(bias)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq,
+                          bk=bk, nk=nk, has_bias=has_bias),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_p, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq_p, 1), _f32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), _f32),
+            pltpu.VMEM((bq, 1), _f32),
+            pltpu.VMEM((bq, d), _f32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out[:, :sq], lse[:, :sq, 0]
+
+
+def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
+                        interpret=False):
+    """→ (dq, dk, dv) with the shapes/dtypes of q3/k3/v3."""
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    bq, bk = _block_sizes(sq, sk)
+    sq_p, sk_p = _ceil_div(sq, bq) * bq, _ceil_div(sk, bk) * bk
+    delta = jnp.sum(g.astype(_f32) * out.astype(_f32), axis=-1)  # (BH, Sq)
+    q3 = jnp.pad(q3, ((0, 0), (0, sq_p - sq), (0, 0)))
+    k3 = jnp.pad(k3, ((0, 0), (0, sk_p - sk), (0, 0)))
+    v3 = jnp.pad(v3, ((0, 0), (0, sk_p - sk), (0, 0)))
+    g = jnp.pad(g, ((0, 0), (0, sq_p - sq), (0, 0)))
+    # padded q rows: lse=0 → p=exp(s-0); keep them harmless with lse=+big
+    lse = jnp.pad(lse, ((0, 0), (0, sq_p - sq)),
+                  constant_values=-_NEG)[..., None]
+    delta = jnp.pad(delta, ((0, 0), (0, sq_p - sq)))[..., None]
+    has_bias = bias is not None
+    if not has_bias and sk_p != sk:
+        bias = jnp.zeros((1, 1, sk), _f32)
+        has_bias = True
+    if has_bias:
+        bias = jnp.pad(bias.astype(_f32),
+                       ((0, 0), (0, sq_p - bias.shape[1] if
+                                 bias.shape[1] > 1 else 0),
+                        (0, sk_p - bias.shape[2])),
+                       constant_values=_NEG)
+    nq, nk = sq_p // bq, sk_p // bk
+
+    common = [q3, k3, v3, g]
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    lse_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+
+    in_specs = [q_spec, k_spec, k_spec, q_spec, lse_spec, lse_spec]
+    args = common + [lse, delta]
+    if has_bias:
+        in_specs.append(_bias_spec(bias, bq, bk))
+        args.append(bias)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq,
+                          bk=bk, nk=nk, has_bias=has_bias),
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), _f32)],
+        interpret=interpret,
+    )(*args)
+
+    # dk/dv: swap loop order — k blocks in the middle, q innermost
+    q_spec2 = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    k_spec2 = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    lse_spec2 = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0))
+    in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, lse_spec2, lse_spec2]
+    args2 = common + [lse, delta]
+    if has_bias:
+        in_specs2.append(_bias_spec(bias, bq, bk, for_dkv=True))
+        args2.append(bias)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq,
+                          bk=bk, nq=nq, has_bias=has_bias),
+        grid=(bh, nk, nq),
+        in_specs=in_specs2,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk_p, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk_p, d), v3.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), _f32)] * 2,
+        interpret=interpret,
+    )(*args2)
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]

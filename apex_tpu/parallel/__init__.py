@@ -12,15 +12,17 @@ import jax
 from ..nn.modules import _BatchNorm
 from .distributed import (  # noqa: F401
     DistributedDataParallel, Reducer, all_reduce_mean, flat_dist_call,
-    rank, world_size)
+    init_distributed, rank, world_size)
 from .LARC import LARC  # noqa: F401
 from .sync_batchnorm import SyncBatchNorm  # noqa: F401
 
 
-def convert_syncbn_model(module, process_group=None, channel_last=False):
+def convert_syncbn_model(module, process_group=None, channel_last=False,
+                         axis_name="data"):
     """Recursively replace every BatchNorm module with SyncBatchNorm,
     preserving parameters and running stats (reference
-    apex/parallel/__init__.py:21-56)."""
+    apex/parallel/__init__.py:21-56).  ``axis_name`` must match the mesh
+    axis your shard_map/pmap binds (stats silently stay local otherwise)."""
     mod = module
     if isinstance(module, _BatchNorm) and not isinstance(module,
                                                          SyncBatchNorm):
@@ -28,7 +30,8 @@ def convert_syncbn_model(module, process_group=None, channel_last=False):
                             momentum=module.momentum, affine=module.affine,
                             track_running_stats=module.track_running_stats,
                             process_group=process_group,
-                            channel_last=channel_last)
+                            channel_last=channel_last,
+                            axis_name=axis_name)
         if module.affine:
             mod.weight.data = module.weight.data
             mod.bias.data = module.bias.data
@@ -40,7 +43,8 @@ def convert_syncbn_model(module, process_group=None, channel_last=False):
         for name, child in list(module._modules.items()):
             setattr(module, name,
                     convert_syncbn_model(child, process_group=process_group,
-                                         channel_last=channel_last))
+                                         channel_last=channel_last,
+                                         axis_name=axis_name))
     return mod
 
 

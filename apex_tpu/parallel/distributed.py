@@ -105,16 +105,41 @@ def all_reduce_mean(tensors, mesh: Optional[Mesh] = None,
         gc = gc / (n / predivide_factor)
         return gc.astype(g.dtype) if always_fp32 else gc
 
-    out = []
-    for t in tensors:
-        if _is_replicated(t):
-            out.append(t)
-        else:
-            fn = jax.shard_map(
-                exchange, mesh=mesh, in_specs=P(axis),
-                out_specs=P(axis), check_vma=False)
-            out.append(fn(t))
+    out = list(tensors)
+    todo = [i for i, t in enumerate(tensors) if not _is_replicated(t)]
+    if todo:
+        # one shard_map over the whole list: a single dispatch whose
+        # collectives XLA's combiner can coalesce (the reference's bucketing,
+        # distributed.py:425-475, done by the compiler)
+        fn = jax.shard_map(
+            lambda ts: [exchange(g) for g in ts], mesh=mesh,
+            in_specs=P(axis), out_specs=P(axis), check_vma=False)
+        for i, r in zip(todo, fn([tensors[i] for i in todo])):
+            out[i] = r
     return out
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None):
+    """Initialize ``jax.distributed`` from explicit args or the environment
+    the ``apex_tpu.parallel.multiproc`` launcher exports.
+
+    jax itself consumes only ``JAX_COORDINATOR_ADDRESS`` from the
+    environment (jax/_src/distributed.py); the process count/id must be
+    passed explicitly, which is what this helper does with the launcher's
+    ``APEX_TPU_NUM_PROCESSES``/``APEX_TPU_PROCESS_ID``.
+    """
+    import os
+    coordinator_address = coordinator_address or \
+        os.environ.get("APEX_TPU_COORDINATOR")
+    if num_processes is None and "APEX_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["APEX_TPU_NUM_PROCESSES"])
+    if process_id is None and "APEX_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["APEX_TPU_PROCESS_ID"])
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
 
 
 class Reducer:

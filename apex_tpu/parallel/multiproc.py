@@ -2,8 +2,12 @@
 
 The reference spawns one process per GPU appending --rank/--world-size.  The
 TPU analogue spawns one process per host-slice for multi-host jax.distributed
-runs (or N CPU processes for local testing), exporting the coordinator
-address and process ids that ``jax.distributed.initialize`` consumes.
+runs (or N CPU processes for local testing).  Children call
+``apex_tpu.parallel.init_distributed()``, which consumes the
+``APEX_TPU_COORDINATOR``/``APEX_TPU_NUM_PROCESSES``/``APEX_TPU_PROCESS_ID``
+variables exported here and passes them explicitly to
+``jax.distributed.initialize`` (jax reads only the coordinator address from
+the environment on its own).
 
 Usage:  python -m apex_tpu.parallel.multiproc [--nproc N] script.py args...
 """
@@ -12,6 +16,20 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+
+
+def _probe_local_device_count() -> int:
+    """Count devices in a throwaway child so the parent never initializes
+    the backend (libtpu admits one process per chip; a parent that holds it
+    would make every spawned worker fail at init)."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.local_device_count())"],
+        capture_output=True, text=True)
+    try:
+        return int(out.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return 1
 
 
 def main():
@@ -24,8 +42,7 @@ def main():
         print(__doc__)
         sys.exit(1)
     if nproc is None:
-        import jax
-        nproc = max(jax.local_device_count(), 1)
+        nproc = max(_probe_local_device_count(), 1)
 
     port = int(os.environ.get("APEX_TPU_COORD_PORT", "12355"))
     coordinator = f"127.0.0.1:{port}"
@@ -33,9 +50,9 @@ def main():
     procs = []
     for local_rank in range(nproc):
         env = dict(os.environ)
-        env["JAX_COORDINATOR_ADDRESS"] = coordinator
-        env["JAX_NUM_PROCESSES"] = str(nproc)
-        env["JAX_PROCESS_ID"] = str(local_rank)
+        env["APEX_TPU_COORDINATOR"] = coordinator
+        env["APEX_TPU_NUM_PROCESSES"] = str(nproc)
+        env["APEX_TPU_PROCESS_ID"] = str(local_rank)
         cmd = [sys.executable, argv[0], *argv[1:],
                f"--local_rank={local_rank}"]
         procs.append(subprocess.Popen(cmd, env=env))

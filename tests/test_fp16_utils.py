@@ -1,0 +1,102 @@
+"""Legacy fp16_utils surface — mirrors tests/L0/run_fp16util (network
+conversion, master/model param list round-trips) and the FP16_Optimizer
+manual loop."""
+import jax.numpy as jnp
+import numpy as np
+
+import apex_tpu.nn as nn
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer, DynamicLossScaler, convert_network,
+    master_params_to_model_params, model_grads_to_master_grads,
+    network_to_half, prep_param_lists)
+from apex_tpu.optimizers import FusedSGD
+
+
+def _model():
+    nn.manual_seed(0)
+    return nn.Sequential(
+        nn.Linear(8, 16), nn.BatchNorm1d(16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_network_to_half_keeps_bn_fp32():
+    m = network_to_half(_model())
+    dtypes = {name: p.dtype for name, p in m.named_parameters()}
+    assert dtypes["0.weight"] == jnp.bfloat16
+    assert dtypes["1.weight"] == jnp.float32  # batchnorm stays fp32
+    assert dtypes["3.weight"] == jnp.bfloat16
+    # running stats stay fp32 too
+    assert m[1].running_mean.dtype == jnp.float32
+
+
+def test_convert_network_dtype():
+    m = convert_network(_model(), jnp.float16)
+    assert m[0].weight.dtype == jnp.float16
+    assert m[1].weight.dtype == jnp.float32
+
+
+def test_prep_param_lists_roundtrip(rng):
+    m = network_to_half(_model())
+    model_params, master_params = prep_param_lists(m)
+    assert all(mp.dtype == jnp.float32 for mp in master_params)
+    for p in model_params:
+        p.grad = jnp.ones(p.shape, p.dtype)
+    model_grads_to_master_grads(model_params, master_params)
+    assert all(mp.grad.dtype == jnp.float32 for mp in master_params)
+    for mp in master_params:
+        mp.data = mp.data * 0.5
+    master_params_to_model_params(model_params, master_params)
+    for p, mp in zip(model_params, master_params):
+        np.testing.assert_allclose(
+            np.asarray(p.data, np.float32),
+            np.asarray(mp.data.astype(p.dtype), np.float32))
+
+
+def test_prep_param_lists_flat_master(rng):
+    m = network_to_half(_model())
+    model_params, master = prep_param_lists(m, flat_master=True)
+    assert len(master) == 1
+    total = sum(p.numel() for p in model_params)
+    assert master[0].numel() == total
+    master[0].data = master[0].data + 1.0
+    master_params_to_model_params(model_params, master, flat_master=True)
+
+
+def test_fp16_optimizer_step_and_overflow():
+    m = network_to_half(_model())
+    opt = FP16_Optimizer(FusedSGD(list(m.parameters()), lr=0.1),
+                         dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2 ** 8},
+                         verbose=False)
+    params = list(m.parameters())
+    before = [np.asarray(p.data, np.float32).copy() for p in params]
+    # healthy grads → step moves params
+    for p in params:
+        p.grad = jnp.ones(p.shape, p.dtype) * float(opt.loss_scale)
+    opt.update_master_grads()
+    assert not opt.overflow
+    norm = opt.clip_master_grads(1e9)
+    assert norm > 0
+    opt.step()
+    after = [np.asarray(p.data, np.float32) for p in params]
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+    # inf grads → overflow, skip, scale halves
+    scale0 = opt.loss_scale
+    for p in params:
+        p.grad = jnp.full(p.shape, jnp.inf, p.dtype)
+    opt.update_master_grads()
+    assert opt.overflow
+    assert opt.clip_master_grads(1.0) == -1
+    snap = [np.asarray(p.data, np.float32).copy() for p in params]
+    opt.step()  # skipped
+    for s, p in zip(snap, params):
+        np.testing.assert_array_equal(s, np.asarray(p.data, np.float32))
+    assert opt.loss_scale == scale0 / 2
+
+
+def test_dynamic_scaler_growth():
+    s = DynamicLossScaler(init_scale=4.0, scale_window=2)
+    s.update_scale(False)
+    s.update_scale(False)  # window hit → doubles
+    assert s.loss_scale >= 8.0
+    s.update_scale(True)
+    assert s.loss_scale == 4.0

@@ -1,0 +1,105 @@
+"""FusedLayerNorm vs nn.LayerNorm reference — mirrors
+tests/L0/run_fused_layer_norm/test_fused_layer_norm.py (fused == unfused
+numerics, fwd and bwd, affine and plain, half inputs), plus the
+pallas-interpret vs jnp-fallback cross-build oracle (tests/L1 analogue).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.nn import functional as F
+from apex_tpu.normalization import (FusedLayerNorm, fused_layer_norm,
+                                    fused_layer_norm_affine)
+from apex_tpu.ops.pallas import force_mode
+
+
+def _ref_ln(x, shape, w=None, b=None, eps=1e-5):
+    return F.layer_norm(x, shape, w, b, eps)
+
+
+@pytest.mark.parametrize("shape,norm_shape", [
+    ((8, 16, 32), (32,)),
+    ((4, 6, 8, 10), (8, 10)),
+    ((64, 96), (96,)),
+])
+def test_forward_matches_reference(rng, shape, norm_shape):
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    n = int(np.prod(norm_shape))
+    w = jnp.asarray(rng.standard_normal(norm_shape), jnp.float32)
+    b = jnp.asarray(rng.standard_normal(norm_shape), jnp.float32)
+    y = fused_layer_norm_affine(x, w, b, norm_shape, 1e-5)
+    y_ref = _ref_ln(x, norm_shape, w, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+    y2 = fused_layer_norm(x, norm_shape, 1e-5)
+    np.testing.assert_allclose(np.asarray(y2),
+                               np.asarray(_ref_ln(x, norm_shape)),
+                               rtol=1e-5, atol=1e-5)
+    assert n == w.size
+
+
+def test_backward_matches_autodiff_of_reference(rng):
+    x = jnp.asarray(rng.standard_normal((32, 48)), jnp.float32)
+    w = jnp.asarray(1 + 0.1 * rng.standard_normal((48,)), jnp.float32)
+    b = jnp.asarray(0.1 * rng.standard_normal((48,)), jnp.float32)
+
+    def fused_loss(x, w, b):
+        return jnp.sum(fused_layer_norm_affine(x, w, b, (48,), 1e-5) ** 2)
+
+    def ref_loss(x, w, b):
+        return jnp.sum(_ref_ln(x, (48,), w, b) ** 2)
+
+    gf = jax.grad(fused_loss, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(x, w, b)
+    for a, r in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_half_input_fp32_stats(rng):
+    # fp32 statistics for half inputs (csrc/layer_norm_cuda.cpp:133,155)
+    x = jnp.asarray(rng.standard_normal((16, 64)), jnp.bfloat16)
+    w = jnp.ones((64,), jnp.float32)
+    b = jnp.zeros((64,), jnp.float32)
+    y = fused_layer_norm_affine(x, w, b, (64,), 1e-5)
+    assert y.dtype == jnp.bfloat16
+    y_ref = _ref_ln(x, (64,), w, b)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(y_ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_pallas_interpret_matches_fallback(rng):
+    """Kernel logic vs jnp fallback — the 'extension build vs python build'
+    oracle of tests/L1/common/compare.py:34-40."""
+    x = jnp.asarray(rng.standard_normal((40, 136)), jnp.float32)  # row pad +
+    w = jnp.asarray(1 + 0.1 * rng.standard_normal((136,)), jnp.float32)
+    b = jnp.asarray(0.1 * rng.standard_normal((136,)), jnp.float32)
+
+    def loss(x, w, b):
+        return jnp.sum(jnp.sin(fused_layer_norm_affine(x, w, b, (136,))))
+
+    with force_mode("off"):
+        y0 = fused_layer_norm_affine(x, w, b, (136,))
+        g0 = jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
+    with force_mode("interpret"):
+        y1 = fused_layer_norm_affine(x, w, b, (136,))
+        g1 = jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=1e-5, atol=1e-6)
+    for a, r in zip(g1, g0):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_module_trains(rng):
+    nn.manual_seed(0)
+    m = FusedLayerNorm(24)
+    x = jnp.asarray(rng.standard_normal((8, 24)), jnp.float32)
+    y = m(x).value
+    assert y.shape == (8, 24)
+    # normalized output: ~zero mean, ~unit variance per row
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=1)), 0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(jnp.var(y, axis=1)), 1, atol=1e-3)

@@ -1,0 +1,80 @@
+"""MLP vs nn.Sequential(Linear, ReLU) reference — mirrors
+tests/L0/run_mlp/test_mlp.py:16-53 (numeric fwd/bwd equality, ReLU after
+every layer, constructor contract)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.mlp import MLP
+from apex_tpu.nn.modules import Ctx
+
+mlp_sizes = [80, 96, 64, 1]
+
+
+def _ref_forward(mlp, x):
+    for w, b in zip(mlp.weights, mlp.biases):
+        x = jnp.maximum(x @ w.data.T + b.data, 0)
+    return x
+
+
+def test_creation():
+    MLP(mlp_sizes)
+    with pytest.raises(TypeError):
+        MLP(mlp_sizes, bias=False)
+    with pytest.raises(TypeError):
+        MLP(mlp_sizes, relu=False)
+
+
+def test_numeric(rng):
+    nn.manual_seed(0)
+    mlp = MLP(mlp_sizes)
+    x = jnp.asarray(rng.uniform(-1, 1, (64, mlp_sizes[0])), jnp.float32)
+    out = mlp(x)
+    ref = _ref_forward(mlp, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_gradients_match_reference(rng):
+    nn.manual_seed(1)
+    mlp = MLP(mlp_sizes)
+    x = jnp.asarray(rng.uniform(-1, 1, (32, mlp_sizes[0])), jnp.float32)
+    params = list(mlp.parameters())
+
+    def fused_loss(vals, x):
+        ctx = Ctx(env={id(p): v for p, v in zip(params, vals)})
+        return jnp.mean(mlp.forward(ctx, x)) * 10.0
+
+    def ref_loss(vals, x):
+        n = len(vals) // 2
+        for w, b in zip(vals[:n], vals[n:]):
+            x = jnp.maximum(x @ w.T + b, 0)
+        return jnp.mean(x) * 10.0
+
+    vals = [p.data for p in params]
+    # parameters() yields weight_0, bias_0, weight_1 ... ; regroup to
+    # (all weights, all biases) for the reference closure
+    ws = [p.data for p in mlp.weights]
+    bs = [p.data for p in mlp.biases]
+    gf = jax.grad(fused_loss)(vals, x)
+    gr = jax.grad(ref_loss)(ws + bs, x)
+    named = {id(p): g for p, g in zip(params, gf)}
+    ordered = [named[id(p)] for p in mlp.weights] + \
+              [named[id(p)] for p in mlp.biases]
+    for a, r in zip(ordered, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_half_policy(rng):
+    """Under the amp half policy the GEMMs run in bf16 — reference registers
+    mlp_function via amp.half_function (apex/mlp/mlp.py:22)."""
+    from apex_tpu.amp.policy import CastPolicy, autocast
+    nn.manual_seed(2)
+    mlp = MLP(mlp_sizes)
+    x = jnp.asarray(rng.uniform(-1, 1, (16, mlp_sizes[0])), jnp.float32)
+    with autocast(CastPolicy(half_dtype=jnp.bfloat16)):
+        out = mlp(x)
+    assert out.dtype == jnp.bfloat16
