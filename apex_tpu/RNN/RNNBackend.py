@@ -1,0 +1,321 @@
+"""RNN containers and the generic RNNCell (reference:
+apex/RNN/RNNBackend.py).
+
+TPU-first restructuring: the reference drives a Python ``for seq: for
+layer:`` loop of per-timestep module calls (RNNBackend.py:122-148), which
+under XLA would unroll the graph over time.  Here each layer runs its whole
+sequence through ONE ``lax.scan`` (layer-major order — mathematically
+identical, since layer l at time t depends only on layer l-1 at t and layer
+l at t-1), so the compiled program is a compact loop whose body is two MXU
+GEMMs plus fused gate math, regardless of sequence length.
+
+Hidden-state statefulness (init_hidden/reset_hidden/detach_hidden,
+RNNBackend.py:309-351) is preserved: the final states of each forward are
+stored on the cells and seed the next call's carry.  Stored states are
+concrete arrays, so successive forward() calls are implicitly truncated-BPTT
+boundaries — equivalent to the reference with ``detach_hidden()`` between
+sequences (the documented usage pattern); in-sequence backprop-through-time
+is exact because the whole scan lives inside one taped forward.
+
+All containers assume input is NOT batch_first: (seq, batch, feature).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.modules import Buffer, Ctx, Module, ModuleList, _next_key
+from ..nn.parameter import Parameter
+
+
+def is_iterable(maybe_iterable):
+    return isinstance(maybe_iterable, (list, tuple))
+
+
+def flatten_list(tens_list):
+    """list of (bsz, feat) arrays -> (len, bsz, feat) array
+    (reference RNNBackend.py:14-21)."""
+    if not is_iterable(tens_list):
+        return tens_list
+    return jnp.stack(list(tens_list), axis=0)
+
+
+class RNNCell(Module):
+    """Generic recurrent cell: holds the gate weights and the persistent
+    hidden state, delegates the math to a pure ``cell`` function
+    (reference RNNBackend.py:232-351).
+
+    gate_multiplier: 4 for LSTM-like, 3 for GRU, 1 for vanilla.
+    n_hidden_states: 2 for (h, c) cells, 1 for h-only.
+    output_size != hidden_size adds a recurrent projection w_ho.
+    """
+
+    def __init__(self, gate_multiplier, input_size, hidden_size, cell,
+                 n_hidden_states=2, bias=False, output_size=None):
+        super().__init__()
+        self.gate_multiplier = gate_multiplier
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.cell = cell
+        self.bias = bias
+        self.output_size = hidden_size if output_size is None else output_size
+        self.gate_size = gate_multiplier * self.hidden_size
+        self.n_hidden_states = n_hidden_states
+
+        self.w_ih = Parameter(jnp.zeros((self.gate_size, self.input_size)))
+        self.w_hh = Parameter(jnp.zeros((self.gate_size, self.output_size)))
+        if self.output_size != self.hidden_size:
+            self.w_ho = Parameter(
+                jnp.zeros((self.output_size, self.hidden_size)))
+        self.b_ih = self.b_hh = None
+        if self.bias:
+            self.b_ih = Parameter(jnp.zeros((self.gate_size,)))
+            self.b_hh = Parameter(jnp.zeros((self.gate_size,)))
+
+        self.hidden = [None for _ in range(self.n_hidden_states)]
+        self.reset_parameters()
+
+    def new_like(self, new_input_size=None):
+        if new_input_size is None:
+            new_input_size = self.input_size
+        return type(self)(self.gate_multiplier, new_input_size,
+                          self.hidden_size, self.cell, self.n_hidden_states,
+                          self.bias, self.output_size)
+
+    def reset_parameters(self, gain=1):
+        stdev = 1.0 / math.sqrt(self.hidden_size)
+        for p in self.parameters():
+            p.data = jax.random.uniform(
+                _next_key(), p.shape, p.dtype, -stdev, stdev)
+
+    # -- persistent hidden state ------------------------------------------
+    def _state_size(self, i):
+        # state 0 is the (possibly projected) output, others are cell-internal
+        return self.output_size if i == 0 else self.hidden_size
+
+    def init_hidden(self, bsz):
+        dtype = self.w_ih.dtype
+        for i, h in enumerate(self.hidden):
+            if h is None or h.shape[0] != bsz:
+                self.hidden[i] = jnp.zeros((bsz, self._state_size(i)), dtype)
+
+    def reset_hidden(self, bsz):
+        self.hidden = [None for _ in range(self.n_hidden_states)]
+        self.init_hidden(bsz)
+
+    def detach_hidden(self):
+        # states are stored as concrete arrays (already detached); the call
+        # is kept for reference API parity and validates initialization
+        if any(h is None for h in self.hidden):
+            raise RuntimeError("Must initialize hidden state before you can "
+                               "detach it")
+
+    def init_inference(self, bsz):
+        self.init_hidden(bsz)
+
+    # -- math --------------------------------------------------------------
+    def _weights(self, ctx: Ctx):
+        w = {"w_ih": ctx.value(self.w_ih), "w_hh": ctx.value(self.w_hh)}
+        w["b_ih"] = ctx.value(self.b_ih) if self.b_ih is not None else None
+        w["b_hh"] = ctx.value(self.b_hh) if self.b_hh is not None else None
+        return w
+
+    def _step(self, ctx, w, x, hidden):
+        new = list(self.cell(x, hidden, **w))
+        if self.output_size != self.hidden_size:
+            new[0] = F.linear(new[0], ctx.value(self.w_ho))
+        return tuple(new)
+
+    def __call__(self, x):
+        # the persistent hidden state enters the tape as explicit inputs so
+        # backward's re-execution sees the SAME h0 the eager forward used
+        # (forward mutates self.hidden afterwards) and fresh values flow
+        # into cached compiled programs on every call
+        from ..autograd import record_module_call
+        self.init_hidden(x.shape[0])
+        return record_module_call(self, (x, *self.hidden))
+
+    def forward(self, ctx: Ctx, x, *h0):
+        """Single timestep; returns the tuple of new states
+        (reference RNNBackend.py: cell forward)."""
+        if not h0:
+            self.init_hidden(x.shape[0])
+            h0 = tuple(self.hidden)
+        w = self._weights(ctx)
+        new = self._step(ctx, w, x, tuple(h0))
+        if ctx.stats_out is None:
+            self.hidden = [jax.lax.stop_gradient(h) for h in new]
+        return new
+
+    def scan(self, ctx: Ctx, seq, h0, reverse=False):
+        """Run the whole (T, B, F) sequence through one lax.scan.
+
+        Returns (all_states, final_states): all_states[i] is (T, B, feat)
+        for hidden-state i (time index is original order even when
+        reverse=True), final_states is the carry after the scan.
+        """
+        w = self._weights(ctx)
+
+        def body(carry, x_t):
+            new = self._step(ctx, w, x_t, carry)
+            return new, new
+
+        final, ys = jax.lax.scan(body, h0, seq, reverse=reverse)
+        return ys, final
+
+
+class stackedRNN(Module):
+    """Stack of RNNCells run layer-major over the sequence
+    (reference RNNBackend.py:107-231)."""
+
+    def __init__(self, inputRNN, num_layers=1, dropout=0):
+        super().__init__()
+        self.dropout = dropout
+        if isinstance(inputRNN, RNNCell):
+            rnns = [inputRNN]
+            for _ in range(num_layers - 1):
+                rnns.append(inputRNN.new_like(inputRNN.output_size))
+        elif isinstance(inputRNN, list):
+            assert len(inputRNN) == num_layers, \
+                "RNN list length must be equal to num_layers"
+            rnns = inputRNN
+        else:
+            raise RuntimeError()
+        self.nLayers = len(rnns)
+        self.rnns = ModuleList(rnns)
+
+    def _flat_hidden(self, bsz):
+        self.init_hidden(bsz)
+        return [h for cell in self.rnns for h in cell.hidden]
+
+    def __call__(self, x, collect_hidden=False, reverse=False):
+        # h0 as explicit tape inputs — see RNNCell.__call__
+        from ..autograd import record_module_call
+        return record_module_call(
+            self, (x, *self._flat_hidden(x.shape[1])),
+            {"collect_hidden": collect_hidden, "reverse": reverse})
+
+    def forward(self, ctx: Ctx, x, *flat_h0, collect_hidden=False,
+                reverse=False):
+        """Returns (output, hiddens).
+
+        output: (T, B, out).  hiddens: tuple over n_hidden_states of
+        (layer, B, feat) final states — or, with collect_hidden, tuple over
+        n_hidden_states of per-timestep tuples of (layer, B, feat)
+        (reference output contract, RNNBackend.py:155-189).
+        """
+        bsz = x.shape[1]
+        if not flat_h0:
+            flat_h0 = self._flat_hidden(bsz)
+        all_states = []   # per layer: tuple of (T,B,feat) per hidden state
+        finals = []       # per layer: tuple of final states
+        out = x
+        it = iter(flat_h0)
+        for cell in self.rnns:
+            h0 = tuple(next(it) for _ in range(cell.n_hidden_states))
+            ys, final = cell.scan(ctx, out, h0, reverse=reverse)
+            out = ys[0]
+            all_states.append(ys)
+            finals.append(final)
+
+        if ctx.stats_out is None:
+            for cell, final in zip(self.rnns, finals):
+                cell.hidden = [jax.lax.stop_gradient(h) for h in final]
+
+        n_hid = self.rnns[0].n_hidden_states
+        if collect_hidden:
+            seq_len = x.shape[0]
+            hiddens = tuple(
+                tuple(jnp.stack([all_states[l][i][t] for l in
+                                 range(self.nLayers)], axis=0)
+                      for t in range(seq_len))
+                for i in range(n_hid))
+        else:
+            hiddens = tuple(
+                jnp.stack([finals[l][i] for l in range(self.nLayers)], axis=0)
+                for i in range(n_hid))
+        return out, hiddens
+
+    def reset_parameters(self):
+        for rnn in self.rnns:
+            rnn.reset_parameters()
+
+    def init_hidden(self, bsz):
+        for rnn in self.rnns:
+            rnn.init_hidden(bsz)
+
+    def detach_hidden(self):
+        for rnn in self.rnns:
+            rnn.detach_hidden()
+
+    def reset_hidden(self, bsz):
+        for rnn in self.rnns:
+            rnn.reset_hidden(bsz)
+
+    def init_inference(self, bsz):
+        for rnn in self.rnns:
+            rnn.init_inference(bsz)
+
+
+class bidirectionalRNN(Module):
+    """Forward + time-reversed stackedRNN with feature-concat outputs
+    (reference RNNBackend.py:24-86)."""
+
+    def __init__(self, inputRNN, num_layers=1, dropout=0):
+        super().__init__()
+        self.dropout = dropout
+        self.fwd = stackedRNN(inputRNN, num_layers=num_layers,
+                              dropout=dropout)
+        self.bckwrd = stackedRNN(inputRNN.new_like(), num_layers=num_layers,
+                                 dropout=dropout)
+
+    def __call__(self, x, collect_hidden=False):
+        from ..autograd import record_module_call
+        bsz = x.shape[1]
+        flat = (self.fwd._flat_hidden(bsz) + self.bckwrd._flat_hidden(bsz))
+        return record_module_call(self, (x, *flat),
+                                  {"collect_hidden": collect_hidden})
+
+    def forward(self, ctx: Ctx, x, *flat_h0, collect_hidden=False):
+        bsz = x.shape[1]
+        if not flat_h0:
+            flat_h0 = (self.fwd._flat_hidden(bsz)
+                       + self.bckwrd._flat_hidden(bsz))
+        k = len(flat_h0) // 2
+        fwd_out, fwd_hiddens = self.fwd.forward(
+            ctx, x, *flat_h0[:k], collect_hidden=collect_hidden)
+        bckwrd_out, bckwrd_hiddens = self.bckwrd.forward(
+            ctx, x, *flat_h0[k:], reverse=True, collect_hidden=collect_hidden)
+        output = jnp.concatenate([fwd_out, bckwrd_out], axis=-1)
+        if collect_hidden:
+            hiddens = tuple(
+                tuple(jnp.concatenate([f, b], axis=-1)
+                      for f, b in zip(fseq, bseq))
+                for fseq, bseq in zip(fwd_hiddens, bckwrd_hiddens))
+        else:
+            hiddens = tuple(jnp.concatenate([f, b], axis=-1)
+                            for f, b in zip(fwd_hiddens, bckwrd_hiddens))
+        return output, hiddens
+
+    def reset_parameters(self):
+        for rnn in (self.fwd, self.bckwrd):
+            rnn.reset_parameters()
+
+    def init_hidden(self, bsz):
+        for rnn in (self.fwd, self.bckwrd):
+            rnn.init_hidden(bsz)
+
+    def detach_hidden(self):
+        for rnn in (self.fwd, self.bckwrd):
+            rnn.detach_hidden()
+
+    def reset_hidden(self, bsz):
+        for rnn in (self.fwd, self.bckwrd):
+            rnn.reset_hidden(bsz)
+
+    def init_inference(self, bsz):
+        for rnn in (self.fwd, self.bckwrd):
+            rnn.init_inference(bsz)

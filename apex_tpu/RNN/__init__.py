@@ -1,0 +1,10 @@
+"""apex_tpu.RNN — pure-JAX RNN zoo (reference: apex/RNN/__init__.py).
+
+lax.scan-based LSTM/GRU/ReLU/Tanh/mLSTM with the reference's container API
+(stackedRNN, bidirectionalRNN, persistent hidden state)."""
+from .models import LSTM, GRU, ReLU, Tanh, mLSTM, mLSTMRNNCell
+from .RNNBackend import RNNCell, bidirectionalRNN, stackedRNN
+from . import cells
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM", "mLSTMRNNCell",
+           "RNNCell", "bidirectionalRNN", "stackedRNN", "cells"]

@@ -1,0 +1,84 @@
+"""RNN factory functions + mLSTM cell module (reference:
+apex/RNN/models.py, apex/RNN/cells.py:12-53).
+
+Factories return a stackedRNN (or bidirectionalRNN) whose per-layer time
+loop compiles to a single lax.scan — see RNNBackend module docstring.
+Input layout is (seq, batch, feature); batch_first is accepted for API
+parity but, as in the reference, not implemented by the backend.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.parameter import Parameter
+from . import cells
+from .RNNBackend import RNNCell, bidirectionalRNN, stackedRNN
+
+
+class mLSTMRNNCell(RNNCell):
+    """Multiplicative-LSTM cell module (reference apex/RNN/cells.py:12-53):
+    adds the m-state projections w_mih/w_mhh on top of the LSTM weights."""
+
+    def __init__(self, input_size, hidden_size, bias=False, output_size=None):
+        gate_multiplier = 4
+        super().__init__(gate_multiplier, input_size, hidden_size,
+                         cells.mlstm_cell, n_hidden_states=2, bias=bias,
+                         output_size=output_size)
+        self.w_mih = Parameter(
+            jnp.zeros((self.output_size, self.input_size)))
+        self.w_mhh = Parameter(
+            jnp.zeros((self.output_size, self.output_size)))
+        self.reset_parameters()
+
+    def _weights(self, ctx):
+        w = super()._weights(ctx)
+        w["w_mih"] = ctx.value(self.w_mih)
+        w["w_mhh"] = ctx.value(self.w_mhh)
+        return w
+
+    def new_like(self, new_input_size=None):
+        if new_input_size is None:
+            new_input_size = self.input_size
+        return type(self)(new_input_size, self.hidden_size, self.bias,
+                          self.output_size)
+
+
+def toRNNBackend(inputRNN, num_layers, bidirectional=False, dropout=0):
+    if bidirectional:
+        return bidirectionalRNN(inputRNN, num_layers, dropout=dropout)
+    return stackedRNN(inputRNN, num_layers, dropout=dropout)
+
+
+def LSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0, bidirectional=False, output_size=None):
+    inputRNN = RNNCell(4, input_size, hidden_size, cells.lstm_cell, 2, bias,
+                       output_size)
+    return toRNNBackend(inputRNN, num_layers, bidirectional, dropout=dropout)
+
+
+def GRU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+        dropout=0, bidirectional=False, output_size=None):
+    inputRNN = RNNCell(3, input_size, hidden_size, cells.gru_cell, 1, bias,
+                       output_size)
+    return toRNNBackend(inputRNN, num_layers, bidirectional, dropout=dropout)
+
+
+def ReLU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0, bidirectional=False, output_size=None):
+    inputRNN = RNNCell(1, input_size, hidden_size, cells.rnn_relu_cell, 1,
+                       bias, output_size)
+    return toRNNBackend(inputRNN, num_layers, bidirectional, dropout=dropout)
+
+
+def Tanh(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0, bidirectional=False, output_size=None):
+    inputRNN = RNNCell(1, input_size, hidden_size, cells.rnn_tanh_cell, 1,
+                       bias, output_size)
+    return toRNNBackend(inputRNN, num_layers, bidirectional, dropout=dropout)
+
+
+def mLSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+          dropout=0, bidirectional=False, output_size=None):
+    inputRNN = mLSTMRNNCell(input_size, hidden_size, bias=bias,
+                            output_size=output_size)
+    return toRNNBackend(inputRNN, num_layers, bidirectional, dropout=dropout)

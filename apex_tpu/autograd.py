@@ -231,6 +231,16 @@ class Tensor:
                              {"structure": structure})
         return record_op("getitem", (self,), {"idx": idx})
 
+    def __len__(self):
+        return len(self.value)
+
+    def __iter__(self):
+        # tuple-valued module outputs (e.g. RNN (output, hiddens)) unpack
+        # into per-element getitem records so gradients flow per element
+        if not isinstance(self.value, tuple):
+            raise TypeError("only tuple-valued Tensors are iterable")
+        return (self[i] for i in range(len(self.value)))
+
     # -- autograd ----------------------------------------------------------
     def backward(self):
         backward(self)
@@ -299,25 +309,28 @@ def _amp_tags(module):
     return in_cast, out_cast, pol
 
 
-def _run_module(module, ctx, in_vals, in_cast, out_cast, pol):
+def _run_module(module, ctx, in_vals, in_cast, out_cast, pol, static=()):
     if in_cast is not None:
         in_vals = tuple(
             v.astype(in_cast) if hasattr(v, "dtype")
             and jnp.issubdtype(v.dtype, jnp.floating) else v
             for v in in_vals)
+    kwargs = {k: _thaw(v) for k, v in static}
     scope = _policy.autocast(pol) if pol is not None \
         else contextlib.nullcontext()
     with scope:
-        value = module.forward(ctx, *in_vals)
+        value = module.forward(ctx, *in_vals, **kwargs)
     if out_cast is not None and hasattr(value, "dtype") and \
             jnp.issubdtype(value.dtype, jnp.floating):
         value = value.astype(out_cast)
     return value
 
 
-def record_module_call(module, inputs: Sequence):
+def record_module_call(module, inputs: Sequence, kwargs=None):
     """Module.__call__ entry: run eagerly (stats update now), record for
-    backward re-execution."""
+    backward re-execution.  kwargs are static (non-array) forward options
+    — e.g. RNN collect_hidden/reverse — and become part of the program
+    cache key."""
     from .nn.modules import Ctx
     needs_key = any(getattr(m, "p", None) is not None
                     and type(m).__name__ == "Dropout"
@@ -326,14 +339,22 @@ def record_module_call(module, inputs: Sequence):
     if needs_key:
         from .nn.modules import _next_key
         key = _next_key()
+    for k, v in (kwargs or {}).items():
+        if isinstance(v, (Tensor, Parameter)) or _is_arraylike(v):
+            raise TypeError(
+                f"module kwarg {k!r} is array-valued; forward kwargs are "
+                "static (hashed into the program cache key) — pass arrays "
+                "positionally")
+    static = tuple(sorted(
+        (k, _freeze(v)) for k, v in (kwargs or {}).items()))
     in_cast, out_cast, pol = _amp_tags(module)
     in_tensors = tuple(lift(x) for x in inputs)
     ctx = Ctx(env={}, stats_out=None, training=module.training, key=key)
     value = _run_module(module, ctx, tuple(t.value for t in in_tensors),
-                        in_cast, out_cast, pol)
+                        in_cast, out_cast, pol, static)
     if not is_grad_enabled():
         return Tensor(value, "const") if not isinstance(value, tuple) else value
-    t = Tensor(value, "module", in_tensors, module=module,
+    t = Tensor(value, "module", in_tensors, static=static, module=module,
                m_training=module.training, m_key=key)
     t.pol = pol
     return t
@@ -396,7 +417,8 @@ def _linearize(root: Tensor) -> _Program:
             instructions.append(
                 ("module", len(modules), in_idx, p_idx, t.m_training, key_id,
                  jnp.dtype(in_cast).name if in_cast is not None else None,
-                 jnp.dtype(out_cast).name if out_cast is not None else None))
+                 jnp.dtype(out_cast).name if out_cast is not None else None,
+                 t.static))
             modules.append((mod, t.pol))
         else:
             in_idx = tuple(visit(i) for i in t.inputs)
@@ -428,7 +450,8 @@ def _execute(program: _Program, param_vals, const_vals, key_vals):
         elif kind == "param":
             results.append(param_vals[ins[1]])
         elif kind == "module":
-            _, mod_i, in_idx, p_idx, training, key_id, in_cast, out_cast = ins
+            (_, mod_i, in_idx, p_idx, training, key_id, in_cast, out_cast,
+             static) = ins
             mod, pol = program.modules[mod_i]
             env = {id(program.params[pi]): param_vals[pi] for pi in p_idx}
             key = key_vals[key_id] if key_id is not None else None
@@ -436,7 +459,7 @@ def _execute(program: _Program, param_vals, const_vals, key_vals):
             results.append(_run_module(
                 mod, ctx, tuple(results[i] for i in in_idx),
                 jnp.dtype(in_cast) if in_cast else None,
-                jnp.dtype(out_cast) if out_cast else None, pol))
+                jnp.dtype(out_cast) if out_cast else None, pol, static))
         else:
             _, op_name, static, in_idx, mod_i = ins
             _, pol = program.modules[mod_i]

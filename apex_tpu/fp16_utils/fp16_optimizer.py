@@ -101,8 +101,11 @@ class FP16_Optimizer:
     def update_master_grads(self):
         """Unscale model grads into master grads; detect overflow
         (reference :160-185)."""
+        # fp32-kept params (e.g. BN after network_to_half) can overflow too
+        # (reference fp16_optimizer.py _check_overflow covers both groups)
         self.overflow = self.loss_scaler.has_overflow(
-            [p for g in self.fp16_groups for p in g])
+            [p for g in self.fp16_groups for p in g]
+            + [p for g in self.fp32_from_fp32_groups for p in g])
         self.loss_scaler.update_scale(self.overflow)
         if self.overflow:
             return

@@ -143,7 +143,7 @@ def _warn_unbound_bn_axis(axis_name):
 @_policied("batch_norm")
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.1, eps=1e-5,
-               axis_name=None, axis_index_groups=None):
+               axis_name=None, axis_index_groups=None, return_stats=False):
     """torch-semantics batch norm over axis 1 (NC...).
 
     When ``axis_name`` is given and we are inside a mapped axis, batch
@@ -204,6 +204,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         y = y * weight.reshape(shape)
     if bias is not None:
         y = y + bias.reshape(shape)
+    if return_stats:
+        # (group-)minibatch mean and 1/sqrt(var+eps), as the reference's
+        # groupbn kernels expose via minibatch_mean/minibatch_riv
+        return y.astype(x.dtype), new_rm, new_rv, mean, inv
     return y.astype(x.dtype), new_rm, new_rv
 
 

@@ -219,9 +219,9 @@ class Module:
     def forward(self, ctx: Ctx, *inputs):
         raise NotImplementedError
 
-    def __call__(self, *inputs):
+    def __call__(self, *inputs, **kwargs):
         from ..autograd import record_module_call
-        return record_module_call(self, inputs)
+        return record_module_call(self, inputs, kwargs)
 
     def extra_repr(self):
         return ""

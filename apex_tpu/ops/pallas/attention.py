@@ -92,7 +92,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
     @pl.when(j == nk - 1)
     def _fin():
         l = l_scr[...]
-        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked row → zeros
+        # defensive only: with finite -1e30 masking l >= 1 always, so a
+        # fully-masked row yields a uniform average over v (identical to
+        # the jnp fallback path), not zeros
+        safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
         lse_ref[0] = m_scr[...] + jnp.log(safe_l)
 

@@ -1,0 +1,211 @@
+"""apex_tpu.RNN vs torch.nn reference numerics (the reference has no RNN
+tests; we hold ourselves to the L0 standard anyway — fused/scan
+implementation vs unfused reference math, SURVEY.md §4.1)."""
+import jax
+import numpy as np
+import jax.numpy as jnp
+import pytest
+import torch
+
+import apex_tpu.RNN as RNN
+from apex_tpu import nn
+
+
+def _copy_lstm_weights(cell, t_rnn, layer):
+    """Write torch layer-l LSTM/GRU weights into our RNNCell."""
+    cell.w_ih.data = jnp.asarray(
+        getattr(t_rnn, f"weight_ih_l{layer}").detach().numpy())
+    cell.w_hh.data = jnp.asarray(
+        getattr(t_rnn, f"weight_hh_l{layer}").detach().numpy())
+    if cell.bias:
+        cell.b_ih.data = jnp.asarray(
+            getattr(t_rnn, f"bias_ih_l{layer}").detach().numpy())
+        cell.b_hh.data = jnp.asarray(
+            getattr(t_rnn, f"bias_hh_l{layer}").detach().numpy())
+
+
+@pytest.mark.parametrize("num_layers", [1, 2])
+def test_lstm_matches_torch(rng, num_layers):
+    T, B, I, H = 5, 3, 4, 6
+    model = RNN.LSTM(I, H, num_layers, bias=True)
+    t_rnn = torch.nn.LSTM(I, H, num_layers, bias=True)
+    for layer in range(num_layers):
+        _copy_lstm_weights(model.rnns[layer], t_rnn, layer)
+
+    x = rng.standard_normal((T, B, I)).astype(np.float32)
+    out, (h, c) = model(jnp.asarray(x))
+    t_out, (t_h, t_c) = t_rnn(torch.from_numpy(x))
+
+    np.testing.assert_allclose(np.asarray(out.value),
+                               t_out.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h.value),
+                               t_h.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c.value),
+                               t_c.detach().numpy(), atol=1e-5)
+
+
+def test_gru_matches_torch(rng):
+    T, B, I, H = 4, 2, 3, 5
+    model = RNN.GRU(I, H, 2, bias=True)
+    t_rnn = torch.nn.GRU(I, H, 2, bias=True)
+    for layer in range(2):
+        _copy_lstm_weights(model.rnns[layer], t_rnn, layer)
+
+    x = rng.standard_normal((T, B, I)).astype(np.float32)
+    out, (h,) = model(jnp.asarray(x))
+    t_out, t_h = t_rnn(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(out.value),
+                               t_out.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h.value),
+                               t_h.detach().numpy(), atol=1e-5)
+
+
+def test_bidirectional_lstm_output_matches_torch(rng):
+    T, B, I, H = 5, 3, 4, 6
+    model = RNN.LSTM(I, H, 1, bias=True, bidirectional=True)
+    t_rnn = torch.nn.LSTM(I, H, 1, bias=True, bidirectional=True)
+    _copy_lstm_weights(model.fwd.rnns[0], t_rnn, 0)
+    model.bckwrd.rnns[0].w_ih.data = jnp.asarray(
+        t_rnn.weight_ih_l0_reverse.detach().numpy())
+    model.bckwrd.rnns[0].w_hh.data = jnp.asarray(
+        t_rnn.weight_hh_l0_reverse.detach().numpy())
+    model.bckwrd.rnns[0].b_ih.data = jnp.asarray(
+        t_rnn.bias_ih_l0_reverse.detach().numpy())
+    model.bckwrd.rnns[0].b_hh.data = jnp.asarray(
+        t_rnn.bias_hh_l0_reverse.detach().numpy())
+
+    x = rng.standard_normal((T, B, I)).astype(np.float32)
+    out, _ = model(jnp.asarray(x))
+    t_out, _ = t_rnn(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(out.value),
+                               t_out.detach().numpy(), atol=1e-5)
+
+
+def test_mlstm_matches_reference_math(rng):
+    """mLSTM against a hand-rolled numpy step loop (reference cell math,
+    apex/RNN/cells.py:55-84)."""
+    T, B, I, H = 4, 2, 3, 5
+    model = RNN.mLSTM(I, H, 1, bias=True)
+    cell = model.rnns[0]
+    x = rng.standard_normal((T, B, I)).astype(np.float32)
+    out, (h_fin, c_fin) = model(jnp.asarray(x))
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    w_ih = np.asarray(cell.w_ih.data)
+    w_hh = np.asarray(cell.w_hh.data)
+    w_mih = np.asarray(cell.w_mih.data)
+    w_mhh = np.asarray(cell.w_mhh.data)
+    b_ih = np.asarray(cell.b_ih.data)
+    b_hh = np.asarray(cell.b_hh.data)
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    outs = []
+    for t in range(T):
+        m = (x[t] @ w_mih.T) * (h @ w_mhh.T)
+        gates = x[t] @ w_ih.T + b_ih + m @ w_hh.T + b_hh
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    np.testing.assert_allclose(np.asarray(out.value), np.stack(outs),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_fin.value)[0], h, atol=1e-5)
+
+
+def test_hidden_state_persists_and_resets(rng):
+    T, B, I, H = 3, 2, 4, 4
+    model = RNN.LSTM(I, H, 1)
+    x = jnp.asarray(rng.standard_normal((T, B, I)).astype(np.float32))
+    out1, _ = model(x)
+    h_after = model.rnns[0].hidden[0]
+    assert float(jnp.abs(h_after).sum()) > 0
+    out2, _ = model(x)  # different because carry persisted
+    assert not np.allclose(np.asarray(out1.value), np.asarray(out2.value))
+    model.reset_hidden(B)
+    out3, _ = model(x)
+    np.testing.assert_allclose(np.asarray(out1.value),
+                               np.asarray(out3.value), atol=1e-6)
+
+
+def test_collect_hidden_shapes(rng):
+    T, B, I, H, L = 4, 2, 3, 5, 2
+    model = RNN.LSTM(I, H, L)
+    x = jnp.asarray(rng.standard_normal((T, B, I)).astype(np.float32))
+    out, hiddens = model(x, collect_hidden=True)
+    h_states, c_states = hiddens
+    assert len(h_states.value) == T
+    assert h_states[0].shape == (L, B, H)
+    assert c_states[T - 1].shape == (L, B, H)
+
+
+def test_rnn_backward_fills_grads(rng):
+    T, B, I, H = 4, 2, 3, 5
+    model = RNN.GRU(I, H, 2, bias=True)
+    x = jnp.asarray(rng.standard_normal((T, B, I)).astype(np.float32))
+    out, _ = model(x)
+    loss = (out * out).mean()
+    loss.backward()
+    for p in model.parameters():
+        assert p.grad is not None
+        assert float(jnp.abs(p.grad).sum()) > 0
+
+
+def test_backward_uses_the_h0_of_its_own_forward(rng):
+    """Regression: forward mutates the stored hidden state; backward's
+    re-execution must see the PRE-forward h0 (threaded as tape inputs), not
+    the mutated finals — checked against jax.grad on the pure scan."""
+    T, B, I, H = 4, 2, 3, 5
+    model = RNN.LSTM(I, H, 1, bias=True)
+    cell = model.rnns[0]
+    x = jnp.asarray(rng.standard_normal((T, B, I)).astype(np.float32))
+
+    out1, _ = model(x)            # from zero state; mutates cell.hidden
+    out2, _ = model(x)            # from persisted state
+    h0 = [jnp.asarray(h) for h in cell.hidden]  # pre-third-call state
+    out3, _ = model(x)
+    loss = (out3 * out3).mean()
+    eager_loss = float(loss.value)
+    loss.backward()
+    got = np.asarray(cell.w_ih.grad)
+
+    def pure_loss(w_ih):
+        def body(carry, x_t):
+            hx, cx = carry
+            gates = x_t @ w_ih.T + cell.b_ih.data + \
+                hx @ cell.w_hh.data.T + cell.b_hh.data
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            cy = jax.nn.sigmoid(f) * cx + jax.nn.sigmoid(i) * jnp.tanh(g)
+            hy = jax.nn.sigmoid(o) * jnp.tanh(cy)
+            return (hy, cy), hy
+        _, ys = jax.lax.scan(body, (h0[0], h0[1]), x)
+        return (ys * ys).mean()
+
+    want_loss = float(pure_loss(cell.w_ih.data))
+    want = np.asarray(jax.grad(pure_loss)(cell.w_ih.data))
+    assert abs(eager_loss - want_loss) < 1e-6
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_rnn_training_converges(rng):
+    """Tiny seq task: predict next value of a noiseless sine — loss must
+    drop (end-to-end through scan + tape + optimizer)."""
+    from apex_tpu.optimizers import FusedAdam
+    T, B, H = 16, 8, 16
+    model = RNN.LSTM(1, H, 1, bias=True, output_size=1)
+    opt = FusedAdam(list(model.parameters()), lr=1e-2)
+    t = np.linspace(0, 2 * np.pi, T + 1)[:, None]
+    phases = rng.uniform(0, 2 * np.pi, (1, B))
+    sig = np.sin(t + phases).astype(np.float32)[:, :, None]
+    x, y = jnp.asarray(sig[:-1]), jnp.asarray(sig[1:])
+    losses = []
+    for i in range(30):
+        model.reset_hidden(B)
+        out, _ = model(x)
+        loss = ((out - y) * (out - y)).mean()
+        loss.backward()
+        opt.step()
+        opt.zero_grad()
+        losses.append(float(loss.value))
+    assert losses[-1] < 0.5 * losses[0]
