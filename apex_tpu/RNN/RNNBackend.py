@@ -27,20 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from ..nn import functional as F
-from ..nn.modules import Buffer, Ctx, Module, ModuleList, _next_key
+from ..nn.modules import Ctx, Module, ModuleList, _next_key
 from ..nn.parameter import Parameter
-
-
-def is_iterable(maybe_iterable):
-    return isinstance(maybe_iterable, (list, tuple))
-
-
-def flatten_list(tens_list):
-    """list of (bsz, feat) arrays -> (len, bsz, feat) array
-    (reference RNNBackend.py:14-21)."""
-    if not is_iterable(tens_list):
-        return tens_list
-    return jnp.stack(list(tens_list), axis=0)
 
 
 class RNNCell(Module):
@@ -228,11 +216,15 @@ class stackedRNN(Module):
         n_hid = self.rnns[0].n_hidden_states
         if collect_hidden:
             seq_len = x.shape[0]
+            # one (T, L, B, f) stack per hidden state, then cheap
+            # per-timestep slices for the reference's tuple-of-(L,B,f)
+            # output contract
             hiddens = tuple(
-                tuple(jnp.stack([all_states[l][i][t] for l in
-                                 range(self.nLayers)], axis=0)
-                      for t in range(seq_len))
-                for i in range(n_hid))
+                tuple(stacked[t] for t in range(seq_len))
+                for stacked in (
+                    jnp.stack([all_states[l][i]
+                               for l in range(self.nLayers)], axis=1)
+                    for i in range(n_hid)))
         else:
             hiddens = tuple(
                 jnp.stack([finals[l][i] for l in range(self.nLayers)], axis=0)

@@ -235,10 +235,10 @@ class Tensor:
         return len(self.value)
 
     def __iter__(self):
-        # tuple-valued module outputs (e.g. RNN (output, hiddens)) unpack
-        # into per-element getitem records so gradients flow per element
-        if not isinstance(self.value, tuple):
-            raise TypeError("only tuple-valued Tensors are iterable")
+        # element unpacking via per-element getitem records: tuple-valued
+        # module outputs (e.g. RNN (output, hiddens)) yield elements,
+        # array values yield rows (the pre-__iter__ sequence-protocol
+        # behavior, which defining __iter__ would otherwise disable)
         return (self[i] for i in range(len(self.value)))
 
     # -- autograd ----------------------------------------------------------

@@ -69,7 +69,14 @@ class Ctx:
 
     def value(self, p):
         v = self.env.get(id(p))
-        return p.data if v is None else v
+        if v is not None:
+            return v
+        d = getattr(p, "_derived", None)
+        if d is not None:
+            # derived (reparameterized) parameter: compute from its source
+            # parameters through this ctx so autodiff reaches them
+            return d(self)
+        return p.data
 
     def write_stat(self, buf: Buffer, value):
         if self.stats_out is None:

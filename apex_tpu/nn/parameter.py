@@ -9,13 +9,17 @@ import jax.numpy as jnp
 
 
 class Parameter:
-    __slots__ = ("data", "grad", "name", "requires_grad")
+    __slots__ = ("data", "grad", "name", "requires_grad", "_derived")
 
     def __init__(self, data, name: str | None = None, requires_grad: bool = True):
         self.data = jnp.asarray(data)
         self.grad = None
         self.name = name
         self.requires_grad = requires_grad
+        # reparameterization hook: when set, Ctx.value computes this
+        # parameter from other parameters (e.g. WeightNorm g*v/||v||)
+        # instead of reading .data (apex_tpu/reparameterization/)
+        self._derived = None
 
     # -- array-ish surface -------------------------------------------------
     @property

@@ -1,0 +1,133 @@
+"""Generalized weight reparameterization (reference:
+apex/reparameterization/reparameterization.py).
+
+TPU-first restructuring: the reference materializes the reparameterized
+weight with a forward-pre-hook and deletes it in a backward hook (a
+CUDA-memory bookkeeping dance, reparameterization.py:95-160).  Here the
+replaced parameter becomes a *derived parameter*: it stays attached to the
+module attribute so forward code is unchanged, but ``Ctx.value`` computes it
+from the reparameterization's source parameters at trace time
+(nn/parameter.py ``_derived``).  Gradients therefore flow to the source
+parameters, XLA fuses the recompute into the consumer op, and there is
+nothing to invalidate between steps — the hook machinery disappears while
+``apply``/``remove``/``get_module_and_name`` keep the reference contract.
+"""
+from __future__ import annotations
+
+from ..nn.modules import Embedding, Module
+from ..nn.parameter import Parameter
+
+
+class Reparameterization:
+    """Class interface for weight reparameterizations.
+
+    Attributes mirror the reference: ``reparameterization_names`` holds the
+    names of the source parameters; ``backward_hook_key`` is kept (always
+    None) for API parity — there is no backward hook to manage.
+    """
+
+    def __init__(self, name, dim, module, retain_forward=True):
+        self.name = name
+        self.dim = dim
+        self.evaluated = False
+        self.retain_forward = retain_forward
+        self.reparameterization_names = []
+        self.backward_hook_key = None
+        self.module = module
+
+    def compute_weight(self, ctx, module=None, name=None):
+        """Returns the reparameterized weight value, reading source
+        parameters through ``ctx`` (see WeightNorm for an example)."""
+        raise NotImplementedError
+
+    def reparameterize(self, name, weight, dim):
+        """Returns (names, params) of the source Parameters replacing
+        ``name`` (see WeightNorm for an example)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def apply(module, name, dim, reparameterization=None, hook_child=True):
+        """Applies reparameterization to module's `name` parameter.
+
+        `hook_child` attaches the instance to the direct parent of the
+        parameter rather than `module` (naming semantics only here — there
+        are no hooks to place)."""
+        if reparameterization is None:
+            reparameterization = Reparameterization
+        module2use, name2use = Reparameterization.get_module_and_name(
+            module, name)
+        # does not work on sparse/embedding lookups (reference :66-68)
+        if name2use is None or isinstance(module2use, Embedding):
+            return
+
+        weight = getattr(module2use, name2use, None)
+        if not isinstance(weight, Parameter) or weight._derived is not None \
+                or weight.data.ndim <= 1:
+            return
+
+        if hook_child:
+            fn = reparameterization(name2use, dim, module2use)
+        else:
+            fn = reparameterization(name, dim, module)
+
+        # remove weight from the parameter list, register sources
+        del module2use._parameters[name2use]
+        names, params = fn.reparameterize(name2use, weight, dim)
+        for n, p in zip(names, params):
+            module2use.register_parameter(n, p)
+        fn.reparameterization_names = names
+
+        # the attribute keeps a Parameter whose value is computed on read
+        derived = Parameter(weight.data, name=weight.name,
+                            requires_grad=False)
+        derived._derived = lambda ctx: fn.compute_weight(
+            ctx, module2use, name2use)
+        object.__setattr__(module2use, name2use, derived)
+
+        reparams = getattr(module2use, "_reparameterizations", None)
+        if reparams is None:
+            reparams = {}
+            object.__setattr__(module2use, "_reparameterizations", reparams)
+        reparams[name2use] = fn
+        return fn
+
+    @staticmethod
+    def get_module_and_name(module, name):
+        """Recursively fetches the owning (child) module and local name of a
+        possibly dotted parameter path."""
+        name2use = None
+        module2use = None
+        names = name.split(".")
+        if len(names) == 1 and names[0] != "":
+            name2use = names[0]
+            module2use = module
+        elif len(names) > 1:
+            module2use = module
+            name2use = names[0]
+            for i in range(len(names) - 1):
+                module2use = getattr(module2use, name2use)
+                name2use = names[i + 1]
+        return module2use, name2use
+
+    def get_params(self, module):
+        return [getattr(module, n) for n in self.reparameterization_names]
+
+    def remove(self, module=None):
+        """Bakes the current reparameterized value back into a plain
+        Parameter and drops the sources.  ``self.name`` is relative to
+        ``self.module`` (root when hook_child=False, owning child
+        otherwise), so resolution starts there, not from the caller's
+        module."""
+        from ..nn.modules import Ctx
+        module2use, name2use = Reparameterization.get_module_and_name(
+            self.module, self.name)
+        for p in self.get_params(module2use):
+            p.requires_grad = False
+        weight = self.compute_weight(Ctx(), module2use, name2use)
+        for n in self.reparameterization_names:
+            del module2use._parameters[n]
+            object.__setattr__(module2use, n, None)
+        module2use.register_parameter(name2use, Parameter(weight))
+        reparams = getattr(module2use, "_reparameterizations", None)
+        if reparams:
+            reparams.pop(name2use, None)

@@ -1,0 +1,47 @@
+"""WeightNorm: w = g * v/||v|| (reference:
+apex/reparameterization/weight_norm.py).
+
+The reference routes through the fused CUDA ``Fused_Weight_Norm`` kernel for
+fp16/fp32 speed; on TPU the norm+scale is a handful of elementwise/reduce
+ops that XLA fuses straight into the consuming GEMM, so the pure-jnp form IS
+the fused form.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .reparameterization import Reparameterization
+from ..nn.parameter import Parameter
+
+
+def _norm(p, dim):
+    """Norm over all dimensions except ``dim``, keepdims (reference
+    weight_norm.py:8-18)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(p)))
+    axes = tuple(i for i in range(p.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(p), axis=axes, keepdims=True))
+
+
+class WeightNorm(Reparameterization):
+    """Decouples a weight's magnitude (g) from its direction (v); the module
+    attribute `name` is recomputed as g * v/||v|| on every read through the
+    execution ctx.  With dim=0 the norm is per output channel; dim=None is a
+    single norm over the whole tensor."""
+
+    def compute_weight(self, ctx, module=None, name=None):
+        if module is None:
+            module = self.module
+        if name is None:
+            name = self.name
+        module, name = Reparameterization.get_module_and_name(module, name)
+        g = ctx.value(getattr(module, name + "_g"))
+        v = ctx.value(getattr(module, name + "_v"))
+        vf = v.astype(jnp.float32)
+        w = (g.astype(jnp.float32) * (vf / _norm(vf, self.dim)))
+        return w.astype(v.dtype)
+
+    def reparameterize(self, name, weight, dim):
+        names = [name + "_g", name + "_v"]
+        params = [Parameter(_norm(weight.data, dim)), Parameter(weight.data)]
+        return names, params

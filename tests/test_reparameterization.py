@@ -1,0 +1,142 @@
+"""WeightNorm / Reparameterization vs torch.nn.utils.weight_norm (the
+reference has no tests for this package; torch's implementation is the
+behavioral contract both share)."""
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+import apex_tpu.nn as nn
+from apex_tpu.reparameterization import (
+    Reparameterization, WeightNorm, apply_weight_norm, remove_weight_norm)
+
+
+def _torch_twin(lin):
+    t = torch.nn.Linear(lin.in_features, lin.out_features)
+    with torch.no_grad():
+        t.weight.copy_(torch.from_numpy(np.asarray(lin.weight.data)))
+        t.bias.copy_(torch.from_numpy(np.asarray(lin.bias.data)))
+    return t
+
+
+def test_weight_norm_matches_torch(rng):
+    lin = nn.Linear(6, 4)
+    t_lin = _torch_twin(lin)
+    apply_weight_norm(lin, name="weight", dim=0)
+    t_lin = torch.nn.utils.weight_norm(t_lin, name="weight", dim=0)
+
+    assert lin.weight_g.shape == tuple(t_lin.weight_g.shape)
+    assert lin.weight_v.shape == tuple(t_lin.weight_v.shape)
+    np.testing.assert_allclose(np.asarray(lin.weight_g.data),
+                               t_lin.weight_g.detach().numpy(), atol=1e-6)
+
+    x = rng.standard_normal((3, 6)).astype(np.float32)
+    out = lin(jnp.asarray(x))
+    t_out = t_lin(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(out.value),
+                               t_out.detach().numpy(), atol=1e-5)
+
+
+def test_weight_norm_grads_match_torch(rng):
+    lin = nn.Linear(5, 3)
+    t_lin = _torch_twin(lin)
+    apply_weight_norm(lin, name="weight", dim=0)
+    t_lin = torch.nn.utils.weight_norm(t_lin, name="weight", dim=0)
+
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    out = lin(jnp.asarray(x))
+    loss = (out * out).mean()
+    loss.backward()
+
+    t_out = t_lin(torch.from_numpy(x))
+    t_loss = (t_out * t_out).mean()
+    t_loss.backward()
+
+    np.testing.assert_allclose(np.asarray(lin.weight_g.grad),
+                               t_lin.weight_g.grad.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lin.weight_v.grad),
+                               t_lin.weight_v.grad.numpy(), atol=1e-5)
+    # the replaced weight itself is out of the parameter list
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight" not in names
+    assert set(names) == {"weight_g", "weight_v", "bias"}
+
+
+def test_weight_norm_training_updates_weight(rng):
+    lin = nn.Linear(4, 4)
+    apply_weight_norm(lin, name="weight", dim=0)
+    from apex_tpu.optimizers import FusedSGD
+    opt = FusedSGD(list(lin.parameters()), lr=0.5)
+    x = jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32))
+    out0 = np.asarray(lin(x).value)
+    loss = (lin(x) * lin(x)).mean()
+    loss.backward()
+    opt.step()
+    out1 = np.asarray(lin(x).value)
+    assert not np.allclose(out0, out1)
+
+
+def test_remove_weight_norm_bakes_weight(rng):
+    lin = nn.Linear(6, 4)
+    apply_weight_norm(lin, name="weight", dim=0)
+    x = jnp.asarray(rng.standard_normal((3, 6)).astype(np.float32))
+    before = np.asarray(lin(x).value)
+    remove_weight_norm(lin, name="weight")
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight" in names and "weight_g" not in names
+    after = np.asarray(lin(x).value)
+    np.testing.assert_allclose(before, after, atol=1e-6)
+
+
+def test_apply_to_whole_model_skips_1d_and_embeddings(rng):
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    emb = nn.Embedding(10, 4)
+    root = nn.Sequential(emb, model)
+    apply_weight_norm(root)
+    names = [n for n, _ in root.named_parameters()]
+    # embedding weight untouched, linear weights reparameterized, biases kept
+    assert any(n.endswith("weight_g") for n in names)
+    assert not any("0.weight_g" == n for n in names)  # embedding is '0'
+    assert "0.weight" in names
+    assert all(not n.endswith("bias_g") for n in names)
+
+
+def test_dim_none_whole_tensor_norm(rng):
+    lin = nn.Linear(6, 4)
+    t_lin = _torch_twin(lin)
+    apply_weight_norm(lin, name="weight", dim=None)
+    t_lin = torch.nn.utils.weight_norm(t_lin, name="weight", dim=None)
+    x = rng.standard_normal((3, 6)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(lin(jnp.asarray(x)).value),
+                               t_lin(torch.from_numpy(x)).detach().numpy(),
+                               atol=1e-5)
+
+
+def test_remove_with_hook_child_false_dotted_name(rng):
+    from apex_tpu.reparameterization import (
+        apply_reparameterization, remove_reparameterization)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    apply_reparameterization(model, WeightNorm, name="2.weight", dim=0,
+                             hook_child=False)
+    x = jnp.asarray(rng.standard_normal((3, 4)).astype(np.float32))
+    before = np.asarray(model(x).value)
+    remove_reparameterization(model, WeightNorm, remove_all=True)
+    names = [n for n, _ in model.named_parameters()]
+    assert "2.weight" in names and "2.weight_g" not in names
+    np.testing.assert_allclose(before, np.asarray(model(x).value), atol=1e-6)
+
+
+def test_tensor_row_unpacking_still_works(rng):
+    # regression: defining Tensor.__iter__ must not break row iteration
+    lin = nn.Linear(3, 3)
+    a, b = lin(jnp.ones((2, 3)))
+    assert a.shape == (3,) and b.shape == (3,)
+    loss = (a * a).sum() + (b * b).sum()
+    loss.backward()
+    assert lin.weight.grad is not None
+
+
+def test_dotted_name_application(rng):
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    apply_weight_norm(model, name="2.weight", dim=0)
+    names = [n for n, _ in model.named_parameters()]
+    assert "2.weight_g" in names and "0.weight" in names
