@@ -14,6 +14,8 @@ from .distributed import (  # noqa: F401
     DistributedDataParallel, Reducer, all_reduce_mean, flat_dist_call,
     init_distributed, rank, world_size)
 from .LARC import LARC  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention, ulysses_attention)
 from .sync_batchnorm import SyncBatchNorm  # noqa: F401
 
 

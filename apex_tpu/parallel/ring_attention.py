@@ -1,0 +1,226 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no long-context parallelism (SURVEY.md §5 — its only
+attention is the single-device fused MHA in apex/contrib/multihead_attn/);
+on TPU long-context is first-class, so this module provides the two standard
+sequence-parallel schemes over a mesh axis, both designed around ICI:
+
+* ``ring_attention`` — the sequence stays sharded; K/V blocks rotate around
+  the ring via ``lax.ppermute`` while each device folds one block per step
+  into a numerically-stable online-softmax accumulator (running logsumexp
+  merge, the same math as the Pallas flash kernel's k-sweep in
+  apex_tpu/ops/pallas/attention.py, lifted one level up to the mesh).  The
+  loop is unrolled over the (static) axis size so XLA's latency-hiding
+  scheduler overlaps each step's ppermute with the previous step's block
+  compute — the ring-attention trick, no hand-rolled double buffering.
+  Memory per device is O(S_local); sequence length scales linearly with the
+  ring size.  The backward is a second ring pass in which dK/dV accumulators
+  travel *with* their K/V blocks; after a full cycle each lands back on the
+  block's owner.
+
+* ``ulysses_attention`` — all-to-all sequence parallelism: heads are
+  scattered over the axis while the sequence is gathered
+  (``lax.all_to_all``), each device runs ordinary full-sequence attention on
+  H/n heads (the Pallas flash kernel when enabled), and a second all-to-all
+  restores the sequence sharding.  Differentiable for free (all_to_all has a
+  transpose); preferred when H ≥ axis size and the per-device full sequence
+  fits.
+
+Both are meant to be called *inside* ``shard_map``/``pjit`` with q/k/v
+sharded on the sequence axis, layout (B, H, S_local, D); both consume the
+per-chunk kernels of ops/pallas/attention.py under the same
+``pallas_mode()`` dispatch (compiled on TPU, interpret for kernel tests,
+jnp fallback otherwise).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.pallas import pallas_mode
+from ..ops.pallas import attention as _k
+
+_f32 = jnp.float32
+_NEG = -1e30
+
+
+def _chunk_bias(sq, sk, q_off, k_off, causal):
+    """Additive (1, sq, sk) bias masking global-causal order for a K/V chunk
+    at global key offset ``k_off`` against queries at ``q_off``."""
+    if not causal:
+        return None
+    rows = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    cols = k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    return jnp.where(rows >= cols, 0.0, _NEG).astype(_f32)[None]
+
+
+def _chunk_fwd(q3, k3, v3, bias, scale, mode):
+    """One attention block → (normalized out, logsumexp).  Finite masking
+    (-1e30) keeps every lse finite, which the merge relies on."""
+    if mode is not None:
+        return _k.flash_attention_fwd(q3, k3, v3, bias, scale, False,
+                                      interpret=(mode == "interpret"))
+    s = jnp.einsum("bqd,bkd->bqk", q3.astype(_f32),
+                   k3.astype(_f32)) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bqk,bkd->bqd", p, v3.astype(_f32)) / l
+    return out.astype(q3.dtype), (m + jnp.log(l))[..., 0]
+
+
+def _chunk_bwd(q3, k3, v3, bias, out, lse, g, scale, mode):
+    """Block gradients against the *global* (out, lse): p = exp(s - lse)
+    already carries the full-softmax normalization, so per-chunk calls sum
+    to the exact full-attention gradient."""
+    if mode is not None:
+        return _k.flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale,
+                                      False, interpret=(mode == "interpret"))
+    s = jnp.einsum("bqd,bkd->bqk", q3.astype(_f32),
+                   k3.astype(_f32)) * scale
+    if bias is not None:
+        s = s + bias
+    p = jnp.exp(s - lse[..., None])
+    gf = g.astype(_f32)
+    delta = jnp.sum(gf * out.astype(_f32), axis=-1, keepdims=True)
+    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+    dp = jnp.einsum("bqd,bkd->bqk", gf, v3.astype(_f32))
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k3.astype(_f32)) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q3.astype(_f32)) * scale
+    return dq, dk, dv
+
+
+def _merge(out, lse, o_r, lse_r):
+    """Fold a block's (normalized out, lse) into the running pair."""
+    lse_new = jnp.logaddexp(lse, lse_r)
+    w_old = jnp.exp(lse - lse_new)[..., None]
+    w_new = jnp.exp(lse_r - lse_new)[..., None]
+    return out * w_old + o_r.astype(_f32) * w_new, lse_new
+
+
+def _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode):
+    n = lax.psum(1, axis_name)          # static mesh-axis size
+    idx = lax.axis_index(axis_name)
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    out = jnp.zeros((bh, sq, d), _f32)
+    lse = jnp.full((bh, sq), -jnp.inf, _f32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = k3, v3
+    for r in range(n):
+        src = (idx - r) % n             # which global chunk we hold now
+        bias = _chunk_bias(sq, sk, idx * sq, src * sk, causal)
+        o_r, lse_r = _chunk_fwd(q3, k_cur, v_cur, bias, scale, mode)
+        out, lse = _merge(out, lse, o_r, lse_r)
+        if r != n - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring(q3, k3, v3, axis_name, causal, scale, mode):
+    out, _ = _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode)
+    return out
+
+
+def _ring_vjp_fwd(q3, k3, v3, axis_name, causal, scale, mode):
+    out, lse = _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, scale, mode, res, g):
+    q3, k3, v3, out, lse = res
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    sq, sk = q3.shape[1], k3.shape[1]
+    out_c = out.astype(q3.dtype)
+    g_c = g.astype(q3.dtype)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    dq = jnp.zeros(q3.shape, _f32)
+    dk_cur = jnp.zeros(k3.shape, _f32)
+    dv_cur = jnp.zeros(v3.shape, _f32)
+    k_cur, v_cur = k3, v3
+    for r in range(n):
+        src = (idx - r) % n
+        bias = _chunk_bias(sq, sk, idx * sq, src * sk, causal)
+        dq_r, dk_r, dv_r = _chunk_bwd(q3, k_cur, v_cur, bias, out_c, lse,
+                                      g_c, scale, mode)
+        dq = dq + dq_r.astype(_f32)
+        dk_cur = dk_cur + dk_r.astype(_f32)
+        dv_cur = dv_cur + dv_r.astype(_f32)
+        # dK/dV accumulators rotate WITH their chunk; n single-hop permutes
+        # return every accumulator to the chunk's owner.
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = lax.ppermute(dv_cur, axis_name, perm)
+    return (dq.astype(q3.dtype), dk_cur.astype(k3.dtype),
+            dv_cur.astype(v3.dtype))
+
+
+_ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Ring self/cross attention over a sequence-sharded mesh axis.
+
+    q (B, H, Sq_local, D); k/v (B, H, Sk_local, D), all sharded on the same
+    ``axis_name`` in rank-contiguous order (device i holds global rows
+    [i*S_local, (i+1)*S_local)).  Call inside shard_map/pjit.  Returns the
+    local output shard (B, H, Sq_local, D) in q's dtype.
+    """
+    b, h, s, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    mode = pallas_mode()
+    q3 = q.reshape(b * h, s, d)
+    k3 = k.reshape(b * h, k.shape[2], d)
+    v3 = v.reshape(b * h, v.shape[2], d)
+    out = _ring(q3, k3, v3, axis_name, causal, scale, mode)
+    return out.reshape(b, h, s, d).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
+                      bias=None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    q/k/v (B, H, S_local, D) sequence-sharded on ``axis_name``; H must be
+    divisible by the axis size.  Two tiled all-to-alls re-shard
+    heads↔sequence around an ordinary full-sequence attention (Pallas flash
+    kernel under ``pallas_mode()``), so each device computes H/n complete
+    heads.  Differentiable end-to-end (all_to_all transposes to itself).
+
+    ``bias`` applies to the gathered sequence, so it must be *global*-shape
+    (B|1, Sq_global|1, Sk_global) and replicated across the axis — a
+    sequence-local bias shard would silently mask out non-local keys.
+    """
+    from ..contrib.multihead_attn.attn_funcs import flash_attention
+    n = lax.psum(1, axis_name)
+    if q.shape[1] % n:
+        raise ValueError(
+            f"ulysses_attention: heads ({q.shape[1]}) not divisible by "
+            f"sequence-parallel axis size ({n})")
+    if bias is not None and bias.shape[-1] != k.shape[2] * n:
+        raise ValueError(
+            f"ulysses_attention: bias key dim ({bias.shape[-1]}) must equal "
+            f"the GLOBAL key length ({k.shape[2] * n}); pass the replicated "
+            "global-shape bias, not a sequence-local shard")
+    # (B, H, S_loc, D) → (B, H/n, S_global, D)
+    qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
+                        tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2,
+                        tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
+                        tiled=True)
+    out = flash_attention(qh, kh, vh, bias=bias, causal=causal, scale=scale)
+    # back to (B, H, S_loc, D)
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)

@@ -26,12 +26,12 @@ def apply_reparameterization(module, reparameterization=None, name="",
     assert reparameterization is not None
     if name != "":
         Reparameterization.apply(module, name, dim, reparameterization,
-                                 hook_child)
+                                 hook_child, strict=True)
     else:
         names = [n for n, _ in module.named_parameters()]
         for name in names:
-            apply_reparameterization(module, reparameterization, name, dim,
-                                     hook_child)
+            Reparameterization.apply(module, name, dim, reparameterization,
+                                     hook_child, strict=False)
     return module
 
 

@@ -46,23 +46,40 @@ class Reparameterization:
         raise NotImplementedError
 
     @staticmethod
-    def apply(module, name, dim, reparameterization=None, hook_child=True):
+    def apply(module, name, dim, reparameterization=None, hook_child=True,
+              strict=True):
         """Applies reparameterization to module's `name` parameter.
 
         `hook_child` attaches the instance to the direct parent of the
         parameter rather than `module` (naming semantics only here — there
-        are no hooks to place)."""
+        are no hooks to place).  With ``strict`` (the explicitly-named
+        path) a missing or ineligible parameter raises; the bulk ''-name
+        sweep passes strict=False and skips ineligible entries silently."""
         if reparameterization is None:
             reparameterization = Reparameterization
         module2use, name2use = Reparameterization.get_module_and_name(
             module, name)
         # does not work on sparse/embedding lookups (reference :66-68)
         if name2use is None or isinstance(module2use, Embedding):
+            if strict and name2use is None:
+                raise AttributeError(
+                    f"parameter '{name}' not found in {type(module).__name__}")
             return
 
         weight = getattr(module2use, name2use, None)
         if not isinstance(weight, Parameter) or weight._derived is not None \
                 or weight.data.ndim <= 1:
+            if strict:
+                if not isinstance(weight, Parameter):
+                    raise AttributeError(
+                        f"'{name}' of {type(module2use).__name__} is not a "
+                        "Parameter")
+                if weight._derived is not None:
+                    raise ValueError(
+                        f"'{name}' is already reparameterized")
+                raise ValueError(
+                    f"cannot reparameterize {weight.data.ndim}-d parameter "
+                    f"'{name}' (needs ndim > 1)")
             return
 
         if hook_child:

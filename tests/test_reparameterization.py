@@ -140,3 +140,18 @@ def test_dotted_name_application(rng):
     apply_weight_norm(model, name="2.weight", dim=0)
     names = [n for n, _ in model.named_parameters()]
     assert "2.weight_g" in names and "0.weight" in names
+
+
+def test_explicit_bad_name_raises():
+    import pytest
+    from apex_tpu.reparameterization import apply_weight_norm
+    import apex_tpu.nn as nn
+    nn.manual_seed(0)
+    lin = nn.Linear(4, 4)
+    with pytest.raises(AttributeError):
+        apply_weight_norm(lin, name="wieght")
+    apply_weight_norm(lin, name="weight")
+    with pytest.raises(ValueError):
+        apply_weight_norm(lin, name="weight")  # already reparameterized
+    with pytest.raises(ValueError):
+        apply_weight_norm(lin, name="bias")  # 1-d
