@@ -157,9 +157,11 @@ def _ring_vjp_bwd(axis_name, causal, scale, mode, res, g):
         dk_cur = dk_cur + dk_r.astype(_f32)
         dv_cur = dv_cur + dv_r.astype(_f32)
         # dK/dV accumulators rotate WITH their chunk; n single-hop permutes
-        # return every accumulator to the chunk's owner.
-        k_cur = lax.ppermute(k_cur, axis_name, perm)
-        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        # return every accumulator to the chunk's owner.  K/V themselves are
+        # dead after the last compute — only the accumulators take that hop.
+        if r != n - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
         dk_cur = lax.ppermute(dk_cur, axis_name, perm)
         dv_cur = lax.ppermute(dv_cur, axis_name, perm)
     return (dq.astype(q3.dtype), dk_cur.astype(k3.dtype),
@@ -208,11 +210,16 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
         raise ValueError(
             f"ulysses_attention: heads ({q.shape[1]}) not divisible by "
             f"sequence-parallel axis size ({n})")
-    if bias is not None and bias.shape[-1] != k.shape[2] * n:
-        raise ValueError(
-            f"ulysses_attention: bias key dim ({bias.shape[-1]}) must equal "
-            f"the GLOBAL key length ({k.shape[2] * n}); pass the replicated "
-            "global-shape bias, not a sequence-local shard")
+    if bias is not None:
+        if bias.shape[-1] != k.shape[2] * n:
+            raise ValueError(
+                f"ulysses_attention: bias key dim ({bias.shape[-1]}) must "
+                f"equal the GLOBAL key length ({k.shape[2] * n}); pass the "
+                "replicated global-shape bias, not a sequence-local shard")
+        if bias.ndim >= 2 and bias.shape[-2] not in (1, q.shape[2] * n):
+            raise ValueError(
+                f"ulysses_attention: bias query dim ({bias.shape[-2]}) must "
+                f"be 1 or the GLOBAL query length ({q.shape[2] * n})")
     # (B, H, S_loc, D) → (B, H/n, S_global, D)
     qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
                         tiled=True)

@@ -61,9 +61,14 @@ class Reparameterization:
             module, name)
         # does not work on sparse/embedding lookups (reference :66-68)
         if name2use is None or isinstance(module2use, Embedding):
-            if strict and name2use is None:
-                raise AttributeError(
-                    f"parameter '{name}' not found in {type(module).__name__}")
+            if strict:
+                if name2use is None:
+                    raise AttributeError(
+                        f"parameter '{name}' not found in "
+                        f"{type(module).__name__}")
+                raise ValueError(
+                    "reparameterization does not support Embedding "
+                    f"parameters ('{name}')")
             return
 
         weight = getattr(module2use, name2use, None)

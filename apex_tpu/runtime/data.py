@@ -1,0 +1,127 @@
+"""Input pipeline: overlapped host→device prefetch.
+
+TPU analogue of the reference examples' ``data_prefetcher``
+(examples/imagenet/main_amp.py:264-313): there, a side CUDA stream overlaps
+the H2D copy + normalize of batch N+1 with the compute of batch N.  Here the
+same overlap comes from a background thread doing the host byte-work (native
+normalize/cast, csrc/runtime.cpp) and issuing ``jax.device_put`` — JAX
+transfers are async, and the jitted step's dispatch is too, so compute and
+transfer pipeline naturally; the thread keeps the *host* work (decode,
+normalize, layout) off the training loop's critical path.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+
+class DataPrefetcher:
+    """Wrap a batch iterable; yields device-resident (input, target) pairs
+    one step ahead of consumption.
+
+    ``loader`` yields (images, target) with images uint8 NHWC (the raw
+    decode layout) or any float array.  uint8 NHWC input goes through the
+    fused native normalize→NCHW path; ``half_dtype`` additionally casts to
+    bf16/fp16 on host before transfer (halving H2D bytes).  Iteration
+    protocol matches the reference: ``next()`` returns (None, None) at end.
+    """
+
+    def __init__(self, loader, mean=None, std=None, half_dtype=None,
+                 device=None, depth: int = 2, threads: int = 0):
+        self.loader = iter(loader)
+        self.mean = np.asarray(
+            mean if mean is not None else [0.485, 0.456, 0.406], np.float32)
+        self.std = np.asarray(
+            std if std is not None else [0.229, 0.224, 0.225], np.float32)
+        self.half_dtype = half_dtype
+        self.device = device
+        self.threads = threads
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _prepare(self, images):
+        from . import f32_to_bf16, normalize_u8_nhwc_to_f32_nchw
+        images = np.asarray(images)
+        if images.dtype == np.uint8 and images.ndim == 4:
+            images = normalize_u8_nhwc_to_f32_nchw(
+                images, self.mean, self.std, self.threads)
+        if self.half_dtype is not None:
+            import jax.numpy as jnp
+            if jnp.dtype(self.half_dtype) == jnp.bfloat16 and \
+                    images.dtype == np.float32:
+                images = f32_to_bf16(images, self.threads)
+            else:
+                import ml_dtypes  # noqa: F401  (dtype registry)
+                images = images.astype(jnp.dtype(self.half_dtype))
+        return images
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up when the consumer closed us (so an
+        abandoned prefetcher never leaves the worker pinned on a full
+        queue holding device buffers)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self):
+        import jax
+        try:
+            for images, target in self.loader:
+                if self._stop.is_set():
+                    return
+                images = self._prepare(images)
+                images = jax.device_put(images, self.device)
+                target = jax.device_put(np.asarray(target), self.device)
+                if not self._put((images, target)):
+                    return
+        except Exception as e:  # surface in the consumer thread
+            self._put(e)
+        self._put(None)
+
+    def next(self):
+        # exhausted stays exhausted: repeated next() keeps returning
+        # (None, None) like the reference prefetcher, no deadlock
+        if self._done:
+            return None, None
+        item = self._q.get()
+        if item is None:
+            self._done = True
+            return None, None
+        if isinstance(item, Exception):
+            self._done = True
+            raise item
+        return item
+
+    def close(self):
+        """Release the worker and any queued device batches (safe to call
+        any time, including after partial consumption)."""
+        self._stop.set()
+        self._done = True
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._worker.join(timeout=5)
+
+    def __del__(self):
+        try:
+            self._stop.set()
+        except Exception:
+            pass
+
+    def __iter__(self):
+        while True:
+            inp, tgt = self.next()
+            if inp is None:
+                return
+            yield inp, tgt

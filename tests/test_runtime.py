@@ -1,0 +1,130 @@
+"""Native runtime (csrc/runtime.cpp via ctypes) vs numpy oracles.
+
+The native path must agree exactly with the numpy fallback — same oracle
+style as the reference's flatten/unflatten usage in DDP and the prefetcher
+normalize math (main_amp.py:287-301).
+"""
+import numpy as np
+import pytest
+
+from apex_tpu import runtime
+
+
+def test_native_lib_builds():
+    # toolchain is baked into the image; if this fails the fallback paths
+    # still work but the native mandate is unmet — fail loudly.
+    assert runtime.available()
+
+
+def test_flatten_unflatten_roundtrip(rng):
+    arrays = [rng.standard_normal(s).astype(np.float32)
+              for s in [(3, 4), (7,), (2, 5, 6), (1,)]]
+    flat = runtime.flatten(arrays)
+    ref = np.concatenate([a.ravel() for a in arrays])
+    np.testing.assert_array_equal(flat, ref)
+    back = runtime.unflatten(flat, arrays)
+    for a, b in zip(back, arrays):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_flatten_dtype_mismatch_raises(rng):
+    with pytest.raises(TypeError):
+        runtime.flatten([np.zeros(3, np.float32), np.zeros(3, np.float16)])
+
+
+def test_unflatten_size_mismatch_raises():
+    with pytest.raises(ValueError):
+        runtime.unflatten(np.zeros(5, np.float32), [np.zeros((2, 2))])
+
+
+def test_flatten_matches_python_fallback(rng, monkeypatch):
+    arrays = [rng.standard_normal((64, 64)).astype(np.float16)
+              for _ in range(5)]
+    native = runtime.flatten(arrays)
+    monkeypatch.setattr(runtime, "_lib", False)  # force fallback
+    fallback = runtime.flatten(arrays)
+    np.testing.assert_array_equal(native, fallback)
+
+
+def test_normalize_u8(rng):
+    batch = rng.integers(0, 256, (4, 10, 12, 3), dtype=np.uint8)
+    mean = np.array([0.485, 0.456, 0.406], np.float32)
+    std = np.array([0.229, 0.224, 0.225], np.float32)
+    out = runtime.normalize_u8_nhwc_to_f32_nchw(batch, mean, std)
+    ref = (batch.astype(np.float32) / 255.0 - mean) / std
+    ref = ref.transpose(0, 3, 1, 2)
+    assert out.shape == (4, 3, 10, 12)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_f32_to_bf16_rne(rng):
+    import ml_dtypes
+    x = rng.standard_normal(10000).astype(np.float32)
+    # include RNE tie cases and specials
+    x = np.concatenate([x, np.array([1.0, -1.0, 0.0, np.inf, -np.inf,
+                                     np.nan, 3.402823e38, 1e-40],
+                                    np.float32)])
+    out = runtime.f32_to_bf16(x)
+    ref = x.astype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out.view(np.uint16) & 0x7FFF != 0x7FC0,
+                                  ref.view(np.uint16) & 0x7FFF != 0x7FC0)
+    finite = np.isfinite(x)
+    np.testing.assert_array_equal(out[finite], ref[finite])
+
+
+def test_data_prefetcher_order_and_values(rng):
+    batches = [(rng.integers(0, 256, (2, 4, 4, 3), dtype=np.uint8),
+                np.array([i, i + 1])) for i in range(5)]
+    pf = runtime.DataPrefetcher(batches, depth=2)
+    seen = list(pf)
+    assert len(seen) == 5
+    for i, (inp, tgt) in enumerate(seen):
+        assert inp.shape == (2, 3, 4, 4)
+        np.testing.assert_array_equal(np.asarray(tgt), [i, i + 1])
+        ref = runtime.normalize_u8_nhwc_to_f32_nchw(
+            batches[i][0], pf.mean, pf.std)
+        np.testing.assert_allclose(np.asarray(inp), ref, rtol=1e-6)
+
+
+def test_data_prefetcher_propagates_errors():
+    def bad():
+        yield np.zeros((1, 2, 2, 3), np.uint8), np.zeros(1)
+        raise RuntimeError("loader died")
+    pf = runtime.DataPrefetcher(bad())
+    pf.next()
+    with pytest.raises(RuntimeError, match="loader died"):
+        pf.next()
+
+
+def test_data_prefetcher_bf16(rng):
+    import jax.numpy as jnp
+    batches = [(rng.integers(0, 256, (2, 4, 4, 3), dtype=np.uint8),
+                np.zeros(2))]
+    pf = runtime.DataPrefetcher(batches, half_dtype=jnp.bfloat16)
+    inp, _ = pf.next()
+    assert jnp.asarray(inp).dtype == jnp.bfloat16
+
+
+def test_data_prefetcher_exhausted_stays_exhausted(rng):
+    batches = [(rng.integers(0, 256, (1, 2, 2, 3), dtype=np.uint8),
+                np.zeros(1))]
+    pf = runtime.DataPrefetcher(batches)
+    assert pf.next()[0] is not None
+    assert pf.next() == (None, None)
+    assert pf.next() == (None, None)  # no deadlock on repeat
+
+
+def test_data_prefetcher_close_releases_worker(rng):
+    batches = [(rng.integers(0, 256, (1, 2, 2, 3), dtype=np.uint8),
+                np.zeros(1)) for _ in range(10)]
+    pf = runtime.DataPrefetcher(batches, depth=1)
+    pf.next()  # consume one, abandon the rest
+    pf.close()
+    assert not pf._worker.is_alive()
+    assert pf.next() == (None, None)
+
+
+def test_flatten_noncontiguous_out_raises(rng):
+    buf = np.zeros(8, np.float32)
+    with pytest.raises(ValueError, match="contiguous"):
+        runtime.flatten([np.ones(4, np.float32)], out=buf[::2])
