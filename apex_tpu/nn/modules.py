@@ -23,7 +23,10 @@ import jax.numpy as jnp
 from . import functional as F
 from .parameter import Parameter
 
-_global_seed = [jax.random.PRNGKey(0)]
+# lazy: creating a PRNGKey at import would initialize the device backend
+# (and open the TPU connection) for every process that merely imports the
+# package — e.g. the offline pyprof CLIs
+_global_seed = [None]
 
 
 def manual_seed(seed: int):
@@ -31,6 +34,8 @@ def manual_seed(seed: int):
 
 
 def _next_key():
+    if _global_seed[0] is None:
+        _global_seed[0] = jax.random.PRNGKey(0)
     _global_seed[0], sub = jax.random.split(_global_seed[0])
     return sub
 

@@ -1,0 +1,65 @@
+"""apex_tpu.pyprof — profiling & op-level performance analysis.
+
+TPU re-design of apex/pyprof (4981 LoC; SURVEY.md §3.5/§5).  The reference's
+three-process pipeline — (1) NVTX-annotated run under nvprof, (2)
+``python -m apex.pyprof.parse`` joining kernels to markers from the SQL
+dump, (3) ``python -m apex.pyprof.prof`` applying per-op FLOP/byte models —
+maps onto XLA's trace-once model as:
+
+1. ``pyprof.nvtx.init()`` + ``pyprof.capture()`` — annotate
+   apex_tpu.nn.functional at trace time (annotate.py); each op records
+   shapes/dtypes/params/callsite once per compiled trace and tags the HLO
+   with ``jax.named_scope`` so ``jax.profiler`` traces carry the same
+   labels (no SQL join needed — the correlation the reference reconstructs
+   from seq ids ships inside the HLO metadata).
+2. ``python -m apex_tpu.pyprof.parse run.jsonl > net.dict`` — enrich the
+   raw event log: stable seq ids, synthesized backward ops per autograd
+   rules (the reference recovers bwd kernels from nvprof; under jax.grad
+   the backward is derivable from the forward trace).
+3. ``python -m apex_tpu.pyprof.prof net.dict`` — per-op FLOPs / bytes /
+   arithmetic intensity / MXU-eligibility models and a roofline time
+   estimate (prof/models.py), columnar or CSV output.
+
+Programmatic one-shot: ``pyprof.analyze(events)`` → list of measured rows.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+
+from . import annotate
+from . import nvtx  # noqa: F401
+
+
+@contextlib.contextmanager
+def capture(clear: bool = True):
+    """Enable recording for a scope; yields the (live) event list."""
+    annotate.init()
+    if clear:
+        annotate.clear()
+    annotate.set_enabled(True)
+    try:
+        yield annotate.events()
+    finally:
+        annotate.set_enabled(False)
+
+
+def save(path: str, events=None):
+    """Write captured events as JSON lines (the 'nvprof sql dump' stand-in
+    consumed by ``python -m apex_tpu.pyprof.parse``)."""
+    events = events if events is not None else annotate.events()
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return path
+
+
+def analyze(events=None, with_backward: bool = True):
+    """events → analyzed rows (parse + prof stages fused, in process)."""
+    from .parse.parse import enrich
+    from .prof.prof import analyze_rows
+    events = events if events is not None else annotate.events()
+    return analyze_rows(enrich(events, with_backward=with_backward))
+
+
+__all__ = ["annotate", "nvtx", "capture", "save", "analyze"]

@@ -1,0 +1,272 @@
+"""Trace-time op annotation — the NVTX-marker analogue.
+
+The reference's ``pyprof.nvtx.init()`` (apex/pyprof/nvtx/nvmarker.py)
+monkey-patches every torch/Tensor/F entrypoint to push an NVTX range whose
+payload is a JSON dict {module, op, args shapes/dtypes, call trace}; nvprof
+later attributes GPU kernels to those ranges.  The TPU analogue exploits
+XLA's trace-once model: patching ``apex_tpu.nn.functional`` records each op
+exactly once per compiled trace — shapes, dtypes, layer params, call site,
+module scope — and simultaneously wraps the op in ``jax.named_scope`` so the
+same labels appear in ``jax.profiler`` traces (the XLA-side join the
+reference needed a SQL database for happens in the HLO metadata for free).
+
+``init()`` is idempotent; events accumulate in a global log drained by
+``apex_tpu.pyprof.capture()`` / ``save()``.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+
+import jax
+import numpy as np
+
+_state = threading.local()
+_installed = False
+
+
+def _log():
+    if not hasattr(_state, "events"):
+        _state.events = []
+        _state.enabled = False
+        _state.scopes = []
+    return _state
+
+
+def events():
+    return _log().events
+
+
+def enabled() -> bool:
+    return getattr(_log(), "enabled", False)
+
+
+def set_enabled(flag: bool):
+    _log().enabled = flag
+
+
+def clear():
+    _log().events.clear()
+
+
+def _shape_of(x):
+    try:
+        s = np.shape(x)
+        return list(s) if s or hasattr(x, "dtype") else None
+    except Exception:
+        return None
+
+
+def _dtype_of(x):
+    try:
+        return str(x.dtype) if hasattr(x, "dtype") else None
+    except Exception:
+        return None
+
+
+def _jsonable(v):
+    if isinstance(v, (int, float, bool, str)) or v is None:
+        return v
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    return repr(v)
+
+
+def _callsite():
+    """First stack frame outside apex_tpu/jax — the user line that issued
+    the op (reference nvmarker records the full call trace; one frame is
+    what its prof stage actually uses).  Walks raw frames — no
+    inspect.stack(), which materializes every FrameInfo + source context on
+    every recorded event."""
+    import sys
+    f = sys._getframe(2)
+    for _ in range(12):
+        if f is None:
+            break
+        fn = f.f_code.co_filename
+        if "apex_tpu" not in fn and "jax" not in fn and "<" not in fn:
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return None
+
+
+def _is_tensor(v):
+    return hasattr(v, "shape") and hasattr(v, "dtype")
+
+
+def _effective_dtypes(op, dtypes):
+    """Dtypes as the op will actually run them: _record fires before the
+    wrapped fn applies the amp cast policy, so consult the active policy
+    (amp/policy.py) — otherwise every op under O1/O2 reports its pre-cast
+    fp32 inputs and the MXU/roofline columns are wrong."""
+    try:
+        from ..amp.policy import current_policy
+        pol = current_policy()
+        if pol is None or not getattr(pol, "enabled", False):
+            return dtypes
+        cat = pol.category_of(op)
+    except Exception:
+        return dtypes
+    import jax.numpy as jnp
+    floats = {"float16", "bfloat16", "float32", "float64"}
+    if cat == "half":
+        tgt = str(jnp.dtype(pol.half_dtype))
+        return [tgt if d in floats else d for d in dtypes]
+    if cat == "float":
+        return ["float32" if d in floats else d for d in dtypes]
+    if cat in ("promote", "sequence"):
+        present = [d for d in dtypes if d in floats]
+        if present:
+            widest = "float32" if len(set(present)) > 1 else present[0]
+            return [widest if d in floats else d for d in dtypes]
+    return dtypes
+
+
+def _record(op, sig, args, kwargs):
+    """Bind args to the op's signature so positional layer params (a
+    positional kernel_size, tuple strides) land in ``params`` by name
+    instead of being dropped; tensors (anything with shape+dtype) feed the
+    shapes/dtypes lists in signature order."""
+    st = _log()
+    shapes, dtypes, params, tensors = [], [], {}, {}
+    if sig is not None:
+        try:
+            items = sig.bind(*args, **kwargs).arguments.items()
+        except TypeError:
+            items = [(f"arg{i}", a) for i, a in enumerate(args)] + \
+                list(kwargs.items())
+    else:
+        items = [(f"arg{i}", a) for i, a in enumerate(args)] + \
+            list(kwargs.items())
+    for name, v in items:
+        if _is_tensor(v):
+            shapes.append(_shape_of(v))
+            dtypes.append(_dtype_of(v))
+            tensors[name] = {"shape": _shape_of(v), "dtype": _dtype_of(v)}
+        elif v is not None:
+            params[name] = _jsonable(v)
+    st.events.append({
+        "seq": len(st.events),
+        "op": op,
+        "dir": "fwd",
+        "scope": "/".join(st.scopes) if st.scopes else "",
+        "shapes": shapes,
+        "dtypes": _effective_dtypes(op, dtypes),
+        "tensors": tensors,
+        "params": params,
+        "callsite": _callsite(),
+    })
+
+
+def _wrap_fn(op_name, fn):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        sig = None
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        st = _log()
+        if not st.enabled:
+            return fn(*args, **kwargs)
+        _record(op_name, sig, args, kwargs)
+        with jax.named_scope(op_name):
+            return fn(*args, **kwargs)
+    wrapper.__wrapped_pyprof__ = fn
+    return wrapper
+
+
+def _wrap_forward(cls):
+    """Scope tracking wraps ``forward`` because the module tree executes
+    through ``child.forward(ctx, x)`` (tape re-execution path), not
+    ``__call__`` (nn/modules.py Sequential.forward)."""
+    orig = vars(cls).get("forward")
+    if orig is None or getattr(orig, "__wrapped_pyprof__", None) is not None:
+        return
+
+    @functools.wraps(orig)
+    def forward(self, *args, **kwargs):
+        st = _log()
+        if not st.enabled:
+            return orig(self, *args, **kwargs)
+        label = type(self).__name__
+        st.scopes.append(label)
+        try:
+            with jax.named_scope(label):
+                return orig(self, *args, **kwargs)
+        finally:
+            st.scopes.pop()
+
+    forward.__wrapped_pyprof__ = orig
+    cls.forward = forward
+
+
+def _instrument_module_tree():
+    """Wrap forward on every Module subclass seen so far; re-run on each
+    init() so classes defined after the first call get covered too."""
+    from ..nn.modules import Module
+
+    def walk(cls):
+        _wrap_forward(cls)
+        for sub in cls.__subclasses__():
+            walk(sub)
+
+    walk(Module)
+
+
+def init():
+    """Install the annotator (idempotent) and enable recording — the
+    ``pyprof.nvtx.init()`` analogue (nvmarker.py docstring)."""
+    global _installed
+    if not _installed:
+        from ..nn import functional as F
+        from ..nn import modules as M
+
+        wrapped = {}
+        for name, fn in vars(F).items():
+            if callable(fn) and not name.startswith("_") and \
+                    inspect.isfunction(fn) and fn.__module__ == F.__name__:
+                w = _wrap_fn(name, fn)
+                setattr(F, name, w)
+                wrapped[fn] = w
+        # conv modules bind F.conv* as staticmethods at class-definition
+        # time; rebind any captured originals to the wrappers
+        for cls in vars(M).values():
+            if inspect.isclass(cls) and "_fn" in vars(cls):
+                raw = inspect.getattr_static(cls, "_fn")
+                orig = getattr(raw, "__func__", None)
+                if orig in wrapped:
+                    cls._fn = staticmethod(wrapped[orig])
+
+        # optimizer step annotation (pyprof's wrap_fused_adam analogue):
+        # record one event per step() with the total param element count
+        from .. import optimizers as opt_pkg
+        for cls in vars(opt_pkg).values():
+            if inspect.isclass(cls) and hasattr(cls, "step") and \
+                    not hasattr(cls.step, "__wrapped_pyprof__"):
+                cls.step = _wrap_opt_step(cls.__name__, cls.step)
+        _installed = True
+    _instrument_module_tree()
+    set_enabled(True)
+
+
+def _wrap_opt_step(name, step):
+    @functools.wraps(step)
+    def wrapper(self, *args, **kwargs):
+        st = _log()
+        if st.enabled:
+            numel = sum(int(np.prod(np.shape(p.data)))
+                        for g in getattr(self, "param_groups", [])
+                        for p in g["params"])
+            st.events.append({
+                "seq": len(st.events), "op": f"optimizer.{name}.step",
+                "dir": "fwd", "scope": "", "shapes": [[numel]],
+                "dtypes": ["float32"], "tensors": {}, "params": {},
+                "callsite": _callsite(),
+            })
+            with jax.named_scope(f"{name}.step"):
+                return step(self, *args, **kwargs)
+        return step(self, *args, **kwargs)
+    wrapper.__wrapped_pyprof__ = step
+    return wrapper

@@ -1,0 +1,8 @@
+"""Reference-API shim: ``pyprof.nvtx.init()`` (apex/pyprof/nvtx/nvmarker.py).
+
+The name is kept for drop-in parity; on TPU the "marker" is the trace-time
+annotator + ``jax.named_scope`` HLO tagging (see ..annotate).
+"""
+from ..annotate import init, set_enabled, events, clear  # noqa: F401
+
+__all__ = ["init", "set_enabled", "events", "clear"]

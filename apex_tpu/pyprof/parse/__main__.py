@@ -1,0 +1,3 @@
+from .parse import main
+
+main()

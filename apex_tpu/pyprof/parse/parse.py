@@ -1,0 +1,65 @@
+"""Parse stage: raw trace events → enriched op dict (reference:
+apex/pyprof/parse/{parse,nvvp}.py).
+
+The reference joins nvprof's kernel table with enclosing NVTX ranges and
+correlates backward kernels to forward ops through autograd seq ids
+(nvvp.py:149-173).  Here the forward op list *is* the trace, so the
+backward is synthesized analytically: every differentiable forward op
+contributes its reverse-mode ops in reverse program order, with the
+standard cost structure (matmul/conv → dgrad + wgrad, i.e. ~2× forward
+FLOPs; pointwise/norm → ~1×).  Ops are tagged with a ``corr`` id linking
+each bwd row to its fwd row — the seq-id correlation made explicit.
+"""
+from __future__ import annotations
+
+import json
+
+# ops with no gradient path (or none worth modeling); the per-family
+# backward cost factors live in prof/models.py model_row
+_NO_BWD = {"flatten", "pad"}
+
+
+def enrich(events, with_backward: bool = True):
+    """→ list of row dicts: fwd rows (trace order) then synthesized bwd rows
+    (reverse order), each carrying seq/dir/corr."""
+    rows = []
+    for i, e in enumerate(events):
+        r = dict(e)
+        r["seq"] = i
+        r["dir"] = "fwd"
+        r["corr"] = i
+        rows.append(r)
+    if with_backward:
+        nxt = len(rows)
+        for e in reversed(rows[:]):
+            op = e["op"]
+            if op in _NO_BWD or op.startswith("optimizer."):
+                continue
+            b = dict(e)
+            b["seq"] = nxt
+            b["dir"] = "bwd"
+            b["corr"] = e["seq"]
+            b["op"] = op
+            nxt += 1
+            rows.append(b)
+    return rows
+
+
+def main(argv=None):
+    import argparse
+    import sys
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.pyprof.parse",
+        description="raw capture (.jsonl) -> enriched op dict on stdout")
+    p.add_argument("file", help="event log written by apex_tpu.pyprof.save")
+    p.add_argument("--no-backward", action="store_true",
+                   help="forward ops only")
+    args = p.parse_args(argv)
+    with open(args.file) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    for row in enrich(events, with_backward=not args.no_backward):
+        sys.stdout.write(json.dumps(row) + "\n")
+
+
+if __name__ == "__main__":
+    main()

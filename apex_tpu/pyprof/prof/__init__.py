@@ -1,0 +1,1 @@
+from .prof import analyze_rows  # noqa: F401

@@ -1,0 +1,3 @@
+from .prof import main
+
+main()

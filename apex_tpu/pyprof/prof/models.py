@@ -1,0 +1,182 @@
+"""Per-op FLOP / byte / MXU models (reference: apex/pyprof/prof/*.py — one
+file per op family: blas.py, conv.py, pointwise.py, normalization.py,
+softmax.py, loss.py, optim.py, pooling.py, embedding.py ... collapsed here
+into one registry since the op metadata arrives uniformly from the trace).
+
+Each model maps an enriched row (shapes/dtypes/params) to
+(flops, bytes, mxu_info).  The Tensor-Core-eligibility column of the
+reference becomes MXU eligibility: matmul-shaped ops qualify, with a
+utilization estimate from padding the operand dims up to the (8, 128)
+sublane×lane tile and 128-deep MXU contraction.
+"""
+from __future__ import annotations
+
+import math
+
+_DSIZE = {"float32": 4, "bfloat16": 2, "float16": 2, "float64": 8,
+          "int32": 4, "int64": 8, "uint8": 1, "int8": 1, "bool": 1}
+
+
+def _ds(dtype):
+    return _DSIZE.get(dtype, 4)
+
+
+def _numel(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v) + [v[-1]] * (n - len(v))
+    return [v] * n
+
+
+def _mxu(m, k, n, dtype):
+    """MXU tiling model: operands padded to (8,128) tiles, contraction to
+    128.  util = useful MACs / padded MACs; 'eligible' mirrors the
+    reference's TC dtype gate (prof/blas.py) with bf16 in place of fp16."""
+    pm = max(8, math.ceil(m / 8) * 8)
+    pk = max(128, math.ceil(k / 128) * 128)
+    pn = max(128, math.ceil(n / 128) * 128)
+    util = (m * k * n) / (pm * pk * pn)
+    return {"eligible": dtype in ("bfloat16", "float16"),
+            "util": round(util, 3)}
+
+
+def _gemm_family(row):
+    shapes = row["shapes"]
+    dtype = (row["dtypes"] or ["float32"])[0]
+    op = row["op"]
+    if op == "linear":
+        x, w = shapes[0], shapes[1]
+        m = _numel(x[:-1])
+        k = x[-1]
+        n = w[0]
+        flops = 2 * m * k * n + (m * n if len(shapes) > 2 else 0)
+        bytes_ = (m * k + k * n + m * n) * _ds(dtype)
+        return flops, bytes_, _mxu(m, k, n, dtype)
+    # matmul: (..., M, K) @ (..., K, N)
+    a, b = shapes[0], shapes[1]
+    batch = _numel(a[:-2])
+    m, k, n = a[-2], a[-1], b[-1]
+    flops = 2 * batch * m * k * n
+    bytes_ = batch * (m * k + k * n + m * n) * _ds(dtype)
+    return flops, bytes_, _mxu(m, k, n, dtype)
+
+
+def _conv_out(sz, k, s, p, d):
+    return (sz + 2 * p - d * (k - 1) - 1) // s + 1
+
+
+def _conv_family(row):
+    shapes = row["shapes"]
+    dtype = (row["dtypes"] or ["float32"])[0]
+    x, w = shapes[0], shapes[1]
+    nd = len(x) - 2
+    params = row.get("params", {})
+    stride = _pair(params.get("stride", 1), nd)
+    pad = _pair(params.get("padding", 0), nd)
+    dil = _pair(params.get("dilation", 1), nd)
+    groups = int(params.get("groups", 1))
+    n = x[0]
+    if row["op"] == "conv_transpose2d":
+        cin, cout_g = w[0], w[1]
+        cout = cout_g * groups
+        spatial_out = [s_ * st for s_, st in zip(x[2:], stride)]
+        kprod = _numel(w[2:])
+        macs = n * cin * _numel(x[2:]) * cout_g * kprod
+    else:
+        cout, cin_g = w[0], w[1]
+        spatial_out = [_conv_out(s_, k_, st, p_, d_) for s_, k_, st, p_, d_
+                       in zip(x[2:], w[2:], stride, pad, dil)]
+        kprod = _numel(w[2:])
+        macs = n * cout * _numel(spatial_out) * cin_g * kprod
+        cout_g = cout // groups
+        cin = cin_g * groups
+    flops = 2 * macs
+    out_elems = n * cout * _numel(spatial_out)
+    bytes_ = (_numel(x) + _numel(w) + out_elems) * _ds(dtype)
+    # im2col view: M = N·prod(out), K = Cin/g·prod(kernel), N = Cout/g
+    k_dim = (cin_g if row["op"] != "conv_transpose2d" else cin) * kprod
+    n_dim = cout_g if row["op"] == "conv_transpose2d" else cout // groups
+    mxu = _mxu(n * _numel(spatial_out), k_dim, n_dim, dtype)
+    return flops, bytes_, mxu
+
+
+_POINTWISE_COST = {"relu": 1, "leaky_relu": 2, "tanh": 4, "sigmoid": 4,
+                   "gelu": 8, "dropout": 2, "pad": 1, "flatten": 0}
+_NORM_COST = {"batch_norm": 8, "layer_norm": 8}
+_SOFTMAX_COST = {"softmax": 5, "log_softmax": 6}
+_LOSS_COST = {"cross_entropy": 7, "nll_loss": 2, "mse_loss": 3,
+              "l1_loss": 3, "binary_cross_entropy": 6,
+              "binary_cross_entropy_with_logits": 8}
+_OPT_COST = {"FusedAdam": 12, "FusedLAMB": 16, "FusedNovoGrad": 12,
+             "FusedSGD": 4, "LARC": 6}
+
+
+def _first_shape(row):
+    return row["shapes"][0] if row["shapes"] else [0]
+
+
+def _elemwise(row, cost, passes=2):
+    x = _first_shape(row)
+    dtype = (row["dtypes"] or ["float32"])[0]
+    n = _numel(x)
+    return cost * n, passes * n * _ds(dtype), None
+
+
+def _pool_family(row):
+    x = _first_shape(row)
+    dtype = (row["dtypes"] or ["float32"])[0]
+    k = _pair(row.get("params", {}).get("kernel_size", 2), 2)
+    n = _numel(x)
+    return _numel(k) * n, 2 * n * _ds(dtype), None
+
+
+def _embedding(row):
+    ids, w = row["shapes"][0], row["shapes"][1]
+    dtype = (row["dtypes"] or [None, "float32"])[-1]
+    out = _numel(ids) * w[-1]
+    return 0, out * _ds(dtype) * 2, None
+
+
+def _optimizer(row):
+    name = row["op"].split(".")[1] if "." in row["op"] else row["op"]
+    cost = _OPT_COST.get(name, 10)
+    numel = _numel(_first_shape(row))
+    # read p/g/m(/v), write p/m(/v): ~5 array passes fp32
+    return cost * numel, 5 * numel * 4, None
+
+
+def model_row(row):
+    """→ (flops, bytes, mxu_info|None).  Backward rows get the family
+    factor: matmul/conv backward = dgrad + wgrad ≈ 2× forward."""
+    op = row["op"]
+    if op.startswith("optimizer."):
+        f, b, m = _optimizer(row)
+    elif op in ("linear", "matmul"):
+        f, b, m = _gemm_family(row)
+    elif op.startswith("conv"):
+        f, b, m = _conv_family(row)
+    elif op in _POINTWISE_COST:
+        f, b, m = _elemwise(row, _POINTWISE_COST[op])
+    elif op in _NORM_COST:
+        f, b, m = _elemwise(row, _NORM_COST[op], passes=3)
+    elif op in _SOFTMAX_COST:
+        f, b, m = _elemwise(row, _SOFTMAX_COST[op], passes=3)
+    elif op in _LOSS_COST:
+        f, b, m = _elemwise(row, _LOSS_COST[op], passes=2)
+    elif op.endswith("pool2d"):
+        f, b, m = _pool_family(row)
+    elif op == "embedding":
+        f, b, m = _embedding(row)
+    else:
+        f, b, m = _elemwise(row, 1)
+    if row.get("dir") == "bwd":
+        factor = 2 if (op in ("linear", "matmul") or op.startswith("conv")) \
+            else 1
+        f, b = f * factor, b * factor
+    return f, b, m

@@ -1,0 +1,119 @@
+"""Prof stage: enriched rows → per-op metrics + roofline estimate
+(reference: apex/pyprof/prof/prof.py driving the per-family handlers, with
+output.py's columnar/CSV writer).
+
+Adds the TPU-specific columns: MXU eligibility/utilization (the reference's
+Tensor-Core column) and a roofline time estimate
+``max(flops/peak, bytes/bw)`` from configurable chip numbers (defaults:
+v5e — 197 bf16 TFLOP/s, 819 GB/s HBM).
+"""
+from __future__ import annotations
+
+import json
+
+from .models import model_row
+
+V5E_BF16_TFLOPS = 197.0
+V5E_HBM_GBS = 819.0
+
+
+def analyze_rows(rows, peak_tflops: float = V5E_BF16_TFLOPS,
+                 hbm_gbs: float = V5E_HBM_GBS):
+    out = []
+    for row in rows:
+        flops, bytes_, mxu = model_row(row)
+        dtype = (row.get("dtypes") or ["float32"])[0]
+        peak = peak_tflops * 1e12
+        if dtype == "float32":
+            peak = peak / 2  # MXU f32 throughput is half of bf16
+        t_compute = flops / peak
+        t_memory = bytes_ / (hbm_gbs * 1e9)
+        est_us = max(t_compute, t_memory) * 1e6
+        out.append({
+            **row,
+            "flops": flops,
+            "bytes": bytes_,
+            "ai": round(flops / bytes_, 2) if bytes_ else 0.0,
+            "mxu": mxu,
+            "bound": "compute" if t_compute >= t_memory else "memory",
+            "est_us": round(est_us, 3),
+        })
+    return out
+
+
+def _shapes_str(row):
+    return ";".join("x".join(str(d) for d in s) for s in row["shapes"][:3])
+
+
+def write_columnar(rows, file, top=None):
+    from .output import Table
+    t = Table(["seq", "dir", "op", "scope", "shapes", "dtype", "flops",
+               "bytes", "AI", "MXU", "bound", "est_us"], file=file)
+    total_f = total_b = total_t = 0.0
+    body = rows if top is None else sorted(
+        rows, key=lambda r: -r["est_us"])[:top]
+    for r in body:
+        mxu = r["mxu"]
+        t.row([r["seq"], r["dir"], r["op"], r.get("scope", ""),
+               _shapes_str(r), (r.get("dtypes") or ["-"])[0],
+               _human(r["flops"]), _human(r["bytes"]), r["ai"],
+               "-" if mxu is None else
+               f"{'Y' if mxu['eligible'] else 'n'}:{mxu['util']:.2f}",
+               r["bound"], r["est_us"]])
+    for r in rows:
+        total_f += r["flops"]
+        total_b += r["bytes"]
+        total_t += r["est_us"]
+    t.row(["", "", "TOTAL", "", "", "", _human(total_f), _human(total_b),
+           round(total_f / total_b, 2) if total_b else 0, "", "",
+           round(total_t, 1)])
+    t.flush()
+
+
+def _human(n):
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(n) < 1000:
+            return f"{n:.1f}{unit}" if unit else f"{int(n)}"
+        n /= 1000.0
+    return f"{n:.1f}E"
+
+
+def write_csv(rows, file):
+    import csv
+    w = csv.writer(file)
+    w.writerow(["seq", "dir", "op", "scope", "shapes", "dtype", "flops",
+                "bytes", "ai", "mxu_eligible", "mxu_util", "bound",
+                "est_us", "callsite"])
+    for r in rows:
+        mxu = r["mxu"] or {}
+        w.writerow([r["seq"], r["dir"], r["op"], r.get("scope", ""),
+                    _shapes_str(r), (r.get("dtypes") or ["-"])[0],
+                    r["flops"], r["bytes"], r["ai"],
+                    mxu.get("eligible", ""), mxu.get("util", ""),
+                    r["bound"], r["est_us"], r.get("callsite") or ""])
+
+
+def main(argv=None):
+    import argparse
+    import sys
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.pyprof.prof",
+        description="enriched op dict -> FLOP/byte/MXU/roofline analysis")
+    p.add_argument("file", help="output of python -m apex_tpu.pyprof.parse")
+    p.add_argument("--csv", action="store_true")
+    p.add_argument("--top", type=int, default=None,
+                   help="only the N most expensive ops")
+    p.add_argument("--peak-tflops", type=float, default=V5E_BF16_TFLOPS)
+    p.add_argument("--hbm-gbs", type=float, default=V5E_HBM_GBS)
+    args = p.parse_args(argv)
+    with open(args.file) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    rows = analyze_rows(rows, args.peak_tflops, args.hbm_gbs)
+    if args.csv:
+        write_csv(rows, sys.stdout)
+    else:
+        write_columnar(rows, sys.stdout, top=args.top)
+
+
+if __name__ == "__main__":
+    main()

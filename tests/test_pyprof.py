@@ -1,0 +1,191 @@
+"""pyprof analogue: annotate → parse → prof pipeline (reference test model:
+tests/L0/run_pyprof_nvtx + run_pyprof_data — patching coverage and analysis
+correctness on known ops)."""
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu import pyprof
+from apex_tpu.nn import functional as F
+from apex_tpu.pyprof.parse.parse import enrich
+from apex_tpu.pyprof.prof.models import model_row
+from apex_tpu.pyprof.prof.prof import analyze_rows
+
+
+@pytest.fixture(autouse=True)
+def _disable_after():
+    yield
+    pyprof.annotate.set_enabled(False)
+
+
+def test_capture_records_functional_ops(rng):
+    x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
+    with pyprof.capture() as ev:
+        y = F.linear(x, w)
+        F.relu(y)
+    ops = [e["op"] for e in ev]
+    assert ops == ["linear", "relu"]
+    assert ev[0]["shapes"][0] == [4, 8] and ev[0]["shapes"][1] == [3, 8]
+    assert ev[0]["dtypes"][0] == "float32"
+
+
+def test_capture_inside_jit_records_once(rng):
+    x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+
+    @jax.jit
+    def f(x, w):
+        return F.relu(F.linear(x, w))
+
+    with pyprof.capture() as ev:
+        f(x, w)
+        f(x, w)  # cached trace: no re-record
+    assert [e["op"] for e in ev] == ["linear", "relu"]
+
+
+def test_module_scope_and_conv_staticmethod_rebind(rng):
+    nn.manual_seed(0)
+    model = nn.Sequential(nn.Conv2d(3, 4, 3, padding=1), nn.ReLU())
+    x = jnp.asarray(rng.standard_normal((2, 3, 8, 8)), jnp.float32)
+    with pyprof.capture() as ev:
+        model(x)
+    convs = [e for e in ev if e["op"] == "conv2d"]
+    assert len(convs) == 1, [e["op"] for e in ev]
+    assert "Conv2d" in convs[0]["scope"]
+
+
+def test_optimizer_step_annotated(rng):
+    from apex_tpu.optimizers import FusedSGD
+    nn.manual_seed(0)
+    lin = nn.Linear(4, 4)
+    opt = FusedSGD(list(lin.parameters()), lr=0.1)
+    for p in lin.parameters():
+        p.grad = jnp.zeros_like(p.data)
+    with pyprof.capture() as ev:
+        opt.step()
+    assert any(e["op"] == "optimizer.FusedSGD.step" for e in ev)
+    numel = sum(int(np.prod(p.data.shape)) for p in lin.parameters())
+    step_ev = next(e for e in ev if e["op"].endswith("step"))
+    assert step_ev["shapes"][0] == [numel]
+
+
+def test_parse_synthesizes_backward():
+    ev = [{"seq": 0, "op": "linear", "dir": "fwd", "scope": "",
+           "shapes": [[4, 8], [3, 8]], "dtypes": ["float32"], "tensors": {},
+           "params": {}, "callsite": None},
+          {"seq": 1, "op": "relu", "dir": "fwd", "scope": "",
+           "shapes": [[4, 3]], "dtypes": ["float32"], "tensors": {},
+           "params": {}, "callsite": None}]
+    rows = enrich(ev)
+    assert [(r["op"], r["dir"]) for r in rows] == [
+        ("linear", "fwd"), ("relu", "fwd"), ("relu", "bwd"),
+        ("linear", "bwd")]
+    assert rows[3]["corr"] == 0  # bwd linked to its fwd
+
+
+def test_flop_models_known_values():
+    linear = {"op": "linear", "dir": "fwd", "shapes": [[32, 64], [16, 64]],
+              "dtypes": ["bfloat16"], "params": {}}
+    f, b, mxu = model_row(linear)
+    assert f == 2 * 32 * 64 * 16
+    assert mxu["eligible"] is True
+    bwd = dict(linear, dir="bwd")
+    assert model_row(bwd)[0] == 2 * f
+
+    conv = {"op": "conv2d", "dir": "fwd",
+            "shapes": [[2, 3, 8, 8], [4, 3, 3, 3]], "dtypes": ["float32"],
+            "params": {"stride": 1, "padding": 1, "dilation": 1,
+                       "groups": 1}}
+    f, b, mxu = model_row(conv)
+    assert f == 2 * 2 * 4 * 8 * 8 * 3 * 3 * 3   # 2·N·Cout·H'·W'·Cin·Kh·Kw
+    assert mxu["eligible"] is False  # f32
+
+    # perfectly-tiled matmul → util 1.0
+    mm = {"op": "matmul", "dir": "fwd", "shapes": [[128, 256], [256, 128]],
+          "dtypes": ["bfloat16"], "params": {}}
+    assert model_row(mm)[2]["util"] == 1.0
+
+
+def test_analyze_roofline_bounds():
+    rows = enrich([
+        {"seq": 0, "op": "linear", "dir": "fwd",
+         "shapes": [[1024, 1024], [1024, 1024]], "dtypes": ["bfloat16"],
+         "tensors": {}, "params": {}, "callsite": None, "scope": ""},
+        {"seq": 1, "op": "relu", "dir": "fwd", "shapes": [[1024, 1024]],
+         "dtypes": ["bfloat16"], "tensors": {}, "params": {},
+         "callsite": None, "scope": ""}], with_backward=False)
+    out = analyze_rows(rows)
+    assert out[0]["bound"] == "compute"   # big matmul
+    assert out[1]["bound"] == "memory"    # pointwise
+    assert out[0]["est_us"] > 0
+
+
+def test_cli_pipeline(tmp_path, rng):
+    x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
+    with pyprof.capture() as ev:
+        F.relu(F.linear(x, w))
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    raw = tmp_path / "run.jsonl"
+    pyprof.save(str(raw), ev)
+    parsed = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.pyprof.parse", str(raw)],
+        capture_output=True, text=True, check=True, cwd=repo)
+    dict_file = tmp_path / "net.dict"
+    dict_file.write_text(parsed.stdout)
+    rows = [json.loads(l) for l in parsed.stdout.splitlines()]
+    assert len(rows) == 4  # 2 fwd + 2 bwd
+    prof = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.pyprof.prof", str(dict_file),
+         "--csv"],
+        capture_output=True, text=True, check=True, cwd=repo)
+    assert "linear" in prof.stdout and "est_us" in prof.stdout
+
+
+def test_conv_params_captured_positionally_and_as_tuples(rng):
+    x = jnp.asarray(rng.standard_normal((1, 3, 8, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((4, 3, 3, 3)), jnp.float32)
+    with pyprof.capture() as ev:
+        F.conv2d(x, w, None, (2, 2), (1, 1))   # positional tuple args
+        F.max_pool2d(x, 3)                     # positional int kernel
+    conv, pool = ev
+    assert conv["params"]["stride"] == [2, 2]
+    assert conv["params"]["padding"] == [1, 1]
+    assert pool["params"]["kernel_size"] == 3
+    rows = pyprof.analyze(ev, with_backward=False)
+    # stride-2/pad-1: out 4x4 -> 2*1*4*4*4*3*3*3 flops
+    assert rows[0]["flops"] == 2 * 1 * 4 * 4 * 4 * 3 * 3 * 3
+    # 3x3 pool costed as 9 flops/elem, not the default 2x2
+    assert rows[1]["flops"] == 9 * 3 * 8 * 8
+
+
+def test_amp_policy_effective_dtype_recorded(rng):
+    from apex_tpu.amp.policy import CastPolicy, autocast
+    x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
+    with pyprof.capture() as ev:
+        with autocast(CastPolicy(half_dtype=jnp.bfloat16)):
+            F.linear(x, w)      # half list -> bf16 on the MXU
+            F.softmax(x)        # float list -> stays f32
+    assert ev[0]["dtypes"][0] == "bfloat16"
+    assert ev[1]["dtypes"][0] == "float32"
+    rows = pyprof.analyze(ev, with_backward=False)
+    assert rows[0]["mxu"]["eligible"] is True
+
+
+def test_analyze_in_process(rng):
+    x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 8)), jnp.float32)
+    with pyprof.capture() as ev:
+        F.relu(F.linear(x, w))
+    rows = pyprof.analyze(ev)
+    assert len(rows) == 4
+    assert all("flops" in r and "est_us" in r for r in rows)
