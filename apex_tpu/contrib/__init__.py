@@ -1,6 +1,6 @@
 """apex.contrib equivalents.  Subpackages import lazily like the reference
 (extensions are opt-in there, setup.py:37-296):
 
-    from apex_tpu.contrib import xentropy, multihead_attn, groupbn
+    from apex_tpu.contrib import xentropy, multihead_attn, groupbn, optimizers
 """
-from . import groupbn, multihead_attn, xentropy  # noqa: F401
+from . import groupbn, multihead_attn, optimizers, xentropy  # noqa: F401

@@ -1,0 +1,9 @@
+"""apex_tpu.contrib.optimizers (reference: apex/contrib/optimizers/) —
+the deprecated fused-optimizer surface: legacy-API FusedAdam (explicit
+grads/output_params/scale step), two-stage FusedLAMB, and the cut-down
+FP16_Optimizer built for them."""
+from .fp16_optimizer import FP16_Optimizer  # noqa: F401
+from .fused_adam import FusedAdam  # noqa: F401
+from .fused_lamb import FusedLAMB  # noqa: F401
+
+__all__ = ["FP16_Optimizer", "FusedAdam", "FusedLAMB"]

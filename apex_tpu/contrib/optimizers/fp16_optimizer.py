@@ -1,0 +1,116 @@
+"""Contrib FP16_Optimizer — the cut-down master-weight wrapper (reference:
+apex/contrib/optimizers/fp16_optimizer.py) designed specifically for the
+contrib fused optimizers: it keeps fp32 masters swapped into the inner
+``param_groups`` and drives the legacy ``step(grads=…, output_params=…,
+scale=…)`` surface so the inner optimizer performs unscale + master update +
+half-weight write-out in one fused pass (the fp16_utils version instead
+copies grads/params around the step, fp16_optimizer.py:142-186 there).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+from ...nn.parameter import Parameter
+
+_HALF = (jnp.float16, jnp.bfloat16)
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=True):
+        self.optimizer = init_optimizer
+        self.verbose = verbose
+        self.fp16_groups = []   # model (half) params
+        self.fp32_groups = []   # master weights
+        for group in self.optimizer.param_groups:
+            fp16, fp32 = [], []
+            for p in group["params"]:
+                fp16.append(p)
+                master = Parameter(p.data.astype(jnp.float32))
+                master.requires_grad = True
+                fp32.append(master)
+            self.fp16_groups.append(fp16)
+            self.fp32_groups.append(fp32)
+            group["params"] = fp32
+
+        if dynamic_loss_scale:
+            self.dynamic_loss_scale = True
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.dynamic_loss_scale = False
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.overflow = False
+
+    # -- reference API ----------------------------------------------------
+    def zero_grad(self, set_grads_to_None=True):
+        for group in self.fp16_groups:
+            for p in group:
+                p.grad = None if set_grads_to_None else \
+                    jnp.zeros_like(p.data)
+
+    def backward(self, loss, update_master_grads=True):
+        """Scaled backward through the tape (reference :105-116 defers to
+        amp-era loss.backward with scale folded in); grads land on the fp16
+        model params."""
+        self.loss_scaler.backward(loss)
+
+    def step(self, closure=None):
+        if closure is not None:
+            raise RuntimeError(
+                "contrib FP16_Optimizer does not support closures")
+        model_params = [p for g in self.fp16_groups for p in g]
+        grads = [[p.grad for p in g] for g in self.fp16_groups]
+        self.overflow = bool(self.loss_scaler.has_overflow(model_params))
+        if self.overflow:
+            # overflow path updates the scale FIRST (halve) and skips
+            self.loss_scaler.update_scale(True)
+            if self.verbose:
+                print(f"OVERFLOW! Skipping step. Reducing loss scale to "
+                      f"{self.loss_scaler.loss_scale}")
+            return
+        # per-group norms of the (still-scaled) grads, forwarded so the
+        # inner optimizer's max_grad_norm clip works (the reference wrapper
+        # computes these in the same pass as its overflow check)
+        grad_norms = [
+            float(jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                               for g in gg if g is not None)))
+            if any(g is not None for g in gg) else None
+            for gg in grads]
+        self.optimizer.step(grads=grads,
+                            output_params=self.fp16_groups,
+                            scale=self.loss_scaler.loss_scale,
+                            grad_norms=grad_norms)
+        # grow-after-window happens AFTER the step so the unscale uses the
+        # same scale the backward applied
+        self.loss_scaler.update_scale(False)
+
+    @property
+    def loss_scale(self):
+        return self.loss_scaler.loss_scale
+
+    @property
+    def param_groups(self):
+        return self.optimizer.param_groups
+
+    def state_dict(self):
+        return {
+            "loss_scaler": self.loss_scaler,
+            "dynamic_loss_scale": self.dynamic_loss_scale,
+            "overflow": self.overflow,
+            "optimizer_state_dict": self.optimizer.state_dict(),
+            "fp32_groups": [[p.data for p in g] for g in self.fp32_groups],
+        }
+
+    def load_state_dict(self, state_dict):
+        self.loss_scaler = state_dict["loss_scaler"]
+        self.dynamic_loss_scale = state_dict["dynamic_loss_scale"]
+        self.overflow = state_dict["overflow"]
+        self.optimizer.load_state_dict(state_dict["optimizer_state_dict"])
+        for group, saved in zip(self.fp32_groups, state_dict["fp32_groups"]):
+            for p, d in zip(group, saved):
+                p.data = jnp.asarray(d)
+        for m_group, f_group in zip(self.fp16_groups, self.fp32_groups):
+            for m, f in zip(m_group, f_group):
+                m.data = f.data.astype(m.data.dtype)

@@ -1,0 +1,159 @@
+"""Deprecated-API FusedAdam (reference: apex/contrib/optimizers/fused_adam.py,
+backed by apex/contrib/csrc/optimizers/fused_adam_cuda_kernel.cu).
+
+The legacy surface the modern apex.optimizers.FusedAdam removed: ``step``
+accepts explicit ``grads`` / ``output_params`` / ``scale`` / ``grad_norms``,
+folds the amp unscale into the update (kernel takes the combined scale), and
+writes a reduced-precision copy of the fresh weights in the same pass (the
+``out_p`` the CUDA kernel fills).  Group-level ``max_grad_norm`` turns the
+scale into ``clip*scale`` when the reported grad norm exceeds it
+(fused_adam.py:118-124).  ``eps_inside_sqrt`` selects
+``sqrt(v + eps)`` denominators (eps_mode 0) vs ``sqrt(v) + eps``
+(fused_adam.py:27-29,63).
+
+TPU shape: one jitted update over each param group; fp32 math regardless of
+storage dtype; the half output copy is a cast in the same fused program, not
+a second kernel.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...optimizers.base import Optimizer
+
+_f32 = jnp.float32
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "beta1", "beta2", "eps", "eps_mode", "bias_correction", "weight_decay",
+    "out_dtypes"))
+def _adam_legacy_step(grads, params, ms, vs, steps, lr, combined_scale,
+                      beta1, beta2, eps, eps_mode, bias_correction,
+                      weight_decay, out_dtypes):
+    new_p, new_m, new_v, outs = [], [], [], []
+    for g, p, m, v, step, od in zip(grads, params, ms, vs, steps,
+                                    out_dtypes):
+        # bias correction is per-param: params can enter the live set at
+        # different iterations (grad=None freezing), and each carries its
+        # own state['step'] like the reference's per-tensor kernel calls
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** step.astype(_f32)
+            bc2 = 1.0 - beta2 ** step.astype(_f32)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, _f32)
+        gf = g.astype(_f32) / combined_scale
+        pf = p.astype(_f32)
+        m = beta1 * m.astype(_f32) + (1 - beta1) * gf
+        v = beta2 * v.astype(_f32) + (1 - beta2) * gf * gf
+        mhat = m / bc1
+        vhat = v / bc2
+        if eps_mode == 0:        # eps inside sqrt
+            denom = jnp.sqrt(vhat + eps)
+        else:
+            denom = jnp.sqrt(vhat) + eps
+        update = mhat / denom + weight_decay * pf
+        pf = pf - lr * update
+        new_p.append(pf.astype(p.dtype))
+        new_m.append(m)
+        new_v.append(v)
+        # half write-out casts straight from fp32 to the OUTPUT's dtype —
+        # no lossy f16 intermediate for bf16 outputs
+        outs.append(pf.astype(od) if od is not None else None)
+    return new_p, new_m, new_v, outs
+
+
+class FusedAdam(Optimizer):
+    """Legacy fused Adam with in-kernel unscale and half output copies."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False,
+                 weight_decay=0., max_grad_norm=0., amsgrad=False,
+                 use_mt=False, amp_scale_adjustment=1.0):
+        if amsgrad:
+            raise RuntimeError(
+                "FusedAdam does not support the AMSGrad variant.")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay,
+                        max_grad_norm=max_grad_norm)
+        super().__init__(params, defaults)
+        self.eps_mode = 0 if eps_inside_sqrt else 1
+        self._amp_scale_adjustment = amp_scale_adjustment
+        self._use_multi_tensor = use_mt  # recorded; batching is XLA's job
+
+    def step(self, closure=None, grads=None, output_params=None, scale=1.,
+             grad_norms=None):
+        loss = closure() if closure is not None else None
+
+        if hasattr(self, "_amp_stash"):
+            grads = self._amp_stash.grads
+            output_params = self._amp_stash.output_params
+            scale = self._amp_stash.scale * self._amp_scale_adjustment
+            grad_norms = self._amp_stash.grad_norms
+
+        def per_group(x):
+            if x is None:
+                return [None] * len(self.param_groups)
+            if not isinstance(x[0], (list, tuple)):
+                return [list(x)]
+            return [list(g) for g in x]
+
+        grads_group = per_group(grads)
+        output_group = per_group(output_params)
+        norms = grad_norms if grad_norms is not None else \
+            [None] * len(self.param_groups)
+
+        for group, g_this, out_this, gnorm in zip(
+                self.param_groups, grads_group, output_group, norms):
+            params = group["params"]
+            if g_this is None:
+                g_this = [p.grad for p in params]
+            if out_this is None:
+                out_this = [None] * len(params)
+
+            # combined scale: unscale + global-norm clip in one factor
+            # (fused_adam.py:118-124; norm arrives pre-unscale, i.e. ×scale)
+            combined_scale = scale
+            if group["max_grad_norm"] > 0 and gnorm is not None:
+                clip = ((float(gnorm) / scale) + 1e-6) / \
+                    group["max_grad_norm"]
+                if clip > 1:
+                    combined_scale = clip * scale
+
+            live = [(p, g, o) for p, g, o in zip(params, g_this, out_this)
+                    if g is not None]
+            if not live:
+                continue
+            for p, _, _ in live:
+                st = self.state[p]
+                if len(st) == 0:
+                    st["step"] = 0
+                    st["exp_avg"] = jnp.zeros(p.data.shape, _f32)
+                    st["exp_avg_sq"] = jnp.zeros(p.data.shape, _f32)
+                st["step"] += 1
+            beta1, beta2 = group["betas"]
+            out_dtypes = tuple(
+                str(jnp.dtype(o.data.dtype)) if o is not None else None
+                for _, _, o in live)
+            new_p, new_m, new_v, outs = _adam_legacy_step(
+                [g.data if hasattr(g, "data") else g for _, g, _ in live],
+                [p.data for p, _, _ in live],
+                [self.state[p]["exp_avg"] for p, _, _ in live],
+                [self.state[p]["exp_avg_sq"] for p, _, _ in live],
+                [jnp.asarray(self.state[p]["step"], jnp.int32)
+                 for p, _, _ in live],
+                jnp.asarray(group["lr"], _f32),
+                jnp.asarray(combined_scale, _f32),
+                beta1, beta2, group["eps"], self.eps_mode,
+                bool(group["bias_correction"]), group["weight_decay"],
+                out_dtypes)
+            for (p, _, o), np_, nm, nv, op_ in zip(live, new_p, new_m,
+                                                   new_v, outs):
+                p.data = np_
+                self.state[p]["exp_avg"] = nm
+                self.state[p]["exp_avg_sq"] = nv
+                if o is not None:
+                    o.data = op_
+        return loss

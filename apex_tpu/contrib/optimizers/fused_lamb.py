@@ -1,0 +1,132 @@
+"""Contrib FusedLAMB — the older two-stage LAMB pipeline (reference:
+apex/contrib/optimizers/fused_lamb.py driving
+csrc/multi_tensor_lamb_stage_1.cu and _stage_2.cu).
+
+Stage 1 per tensor: moment updates + Adam-style step direction ``u`` with
+the *per-tensor* grad norm divided out of the decay term and the global
+clip folded into the grad scale.  Stage 2: trust-ratio apply
+``p -= lr · (‖p‖/‖u‖) · u``.  Kept as two jitted passes (with the
+per-tensor norms between them) to mirror the observable two-call structure;
+XLA fuses each pass across the group.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ... import ops
+from ...multi_tensor_apply import multi_tensor_applier
+from ...optimizers.base import Optimizer, split_by_dtype
+
+_f32 = jnp.float32
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "beta1", "beta2", "eps", "bias_correction", "weight_decay",
+    "grad_averaging"))
+def _stage1(grads, params, ms, vs, step, clip_scale, beta1, beta2, eps,
+            bias_correction, weight_decay, grad_averaging):
+    """→ (new_m, new_v, updates u)."""
+    beta3 = (1 - beta1) if grad_averaging else 1.0
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** step.astype(_f32)
+        bc2 = 1.0 - beta2 ** step.astype(_f32)
+    else:
+        bc1 = bc2 = jnp.asarray(1.0, _f32)
+    new_m, new_v, us = [], [], []
+    for g, p, m, v in zip(grads, params, ms, vs):
+        gf = g.astype(_f32) * clip_scale
+        m = beta1 * m + beta3 * gf
+        v = beta2 * v + (1 - beta2) * gf * gf
+        u = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + \
+            weight_decay * p.astype(_f32)
+        new_m.append(m)
+        new_v.append(v)
+        us.append(u)
+    return new_m, new_v, us
+
+
+@jax.jit
+def _stage2(params, us, lr):
+    """Trust-ratio apply (csrc/multi_tensor_lamb_stage_2.cu): per-tensor
+    ``ratio = ‖p‖/‖u‖`` (1 where either norm is 0)."""
+    new_p = []
+    for p, u in zip(params, us):
+        pf = p.astype(_f32)
+        pn = jnp.sqrt(jnp.sum(pf * pf))
+        un = jnp.sqrt(jnp.sum(u * u))
+        ratio = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
+        new_p.append((pf - lr * ratio * u).astype(p.dtype))
+    return new_p
+
+
+class FusedLAMB(Optimizer):
+    """Two-stage LAMB (contrib surface; the modern single-call version is
+    apex_tpu.optimizers.FusedLAMB)."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 amsgrad=False, adam_w_mode=True, grad_averaging=True,
+                 set_grad_none=True, max_grad_norm=1.0):
+        if amsgrad:
+            raise RuntimeError(
+                "FusedLAMB does not support the AMSGrad variant.")
+        if not adam_w_mode:
+            raise RuntimeError(
+                "contrib FusedLAMB only supports adam_w_mode (decoupled "
+                "decay), matching the stage-1 kernel")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay,
+                        grad_averaging=grad_averaging,
+                        max_grad_norm=max_grad_norm)
+        super().__init__(params, defaults)
+        self.set_grad_none = set_grad_none
+        self._overflow_buf = ops.zero_flag()
+
+    def zero_grad(self, set_to_none=None):
+        super().zero_grad(self.set_grad_none if set_to_none is None
+                          else set_to_none)
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+
+        # global grad norm across every group/dtype (fused_lamb.py:106-125)
+        all_grads = [p.grad for g in self.param_groups for p in g["params"]
+                     if p.grad is not None]
+        if not all_grads:
+            return loss
+        _, gnorm, _ = multi_tensor_applier(
+            ops.multi_tensor_l2norm, self._overflow_buf, [all_grads], False)
+
+        for group in self.param_groups:
+            plist = [p for p in group["params"] if p.grad is not None]
+            if not plist:
+                continue
+            group["step"] = group.get("step", 0) + 1
+            beta1, beta2 = group["betas"]
+            max_norm = group["max_grad_norm"]
+            clip = jnp.where(gnorm > max_norm, max_norm / gnorm, 1.0) \
+                if max_norm > 0 else jnp.asarray(1.0, _f32)
+            for dtype, sub in split_by_dtype(plist).items():
+                for p in sub:
+                    st = self.state[p]
+                    if len(st) == 0:
+                        st["exp_avg"] = jnp.zeros(p.data.shape, _f32)
+                        st["exp_avg_sq"] = jnp.zeros(p.data.shape, _f32)
+                new_m, new_v, us = _stage1(
+                    [p.grad for p in sub], [p.data for p in sub],
+                    [self.state[p]["exp_avg"] for p in sub],
+                    [self.state[p]["exp_avg_sq"] for p in sub],
+                    jnp.asarray(group["step"], jnp.int32), clip,
+                    beta1, beta2, group["eps"],
+                    bool(group["bias_correction"]), group["weight_decay"],
+                    bool(group["grad_averaging"]))
+                new_p = _stage2([p.data for p in sub], us,
+                                jnp.asarray(group["lr"], _f32))
+                for p, np_, nm, nv in zip(sub, new_p, new_m, new_v):
+                    p.data = np_
+                    self.state[p]["exp_avg"] = nm
+                    self.state[p]["exp_avg_sq"] = nv
+        return loss

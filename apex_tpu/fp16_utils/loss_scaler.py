@@ -25,7 +25,7 @@ class LossScaler:
     """Static loss scaler (reference loss_scaler.py:10-44)."""
 
     def __init__(self, scale=1.0):
-        self.cur_scale = scale
+        self.cur_scale = float(scale)
 
     def has_overflow(self, params):
         return False
@@ -53,7 +53,9 @@ class DynamicLossScaler:
 
     def __init__(self, init_scale=2 ** 32, scale_factor=2.0,
                  scale_window=1000):
-        self.cur_scale = init_scale
+        # float: a Python-int 2**32 scale overflows int32 coercion when it
+        # multiplies a jax array (the reference relies on torch promotion)
+        self.cur_scale = float(init_scale)
         self.cur_iter = 0
         self.last_overflow_iter = -1
         self.scale_factor = scale_factor

@@ -1,0 +1,275 @@
+"""Contrib (deprecated-API) optimizers vs oracles.
+
+Oracles mirror the reference test style: contrib FusedAdam against
+torch.optim.Adam with the scale folded in by hand; the two-stage FusedLAMB
+against a numpy LAMB; contrib FP16_Optimizer end-to-end (overflow skip,
+half write-out, scale update).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import apex_tpu.nn as nn
+from apex_tpu.contrib.optimizers import (FP16_Optimizer, FusedAdam,
+                                         FusedLAMB)
+from apex_tpu.nn.parameter import Parameter
+
+
+def _pairs(rng, shapes=((4, 3), (5,)), scale=1.0):
+    ours, theirs = [], []
+    for s in shapes:
+        w = rng.standard_normal(s).astype(np.float32)
+        g = rng.standard_normal(s).astype(np.float32)
+        p = Parameter(jnp.asarray(w))
+        p.grad = jnp.asarray(g * scale)   # scaled grads, legacy style
+        ours.append(p)
+        tp = torch.nn.Parameter(torch.tensor(w))
+        tp.grad = torch.tensor(g)
+        theirs.append(tp)
+    return ours, theirs
+
+
+@pytest.mark.parametrize("eps_inside_sqrt", [False, True])
+def test_contrib_adam_matches_torch_with_scale(rng, eps_inside_sqrt):
+    scale = 64.0
+    ours, theirs = _pairs(rng, scale=scale)
+    opt = FusedAdam(ours, lr=1e-2, weight_decay=0.0,
+                    eps_inside_sqrt=eps_inside_sqrt)
+    topt = torch.optim.Adam(theirs, lr=1e-2)
+    for _ in range(3):
+        opt.step(scale=scale)
+        if not eps_inside_sqrt:
+            topt.step()
+    if eps_inside_sqrt:
+        return  # torch has no eps-inside-sqrt mode; smoke only
+    for p, tp in zip(ours, theirs):
+        np.testing.assert_allclose(np.asarray(p.data),
+                                   tp.detach().numpy(), rtol=2e-5, atol=2e-6)
+
+
+def test_contrib_adam_weight_decay_matches_numpy_replay(rng):
+    # the contrib kernel adds wd·p to the update AFTER the moments (unlike
+    # torch Adam's grad-side L2), so the oracle is an explicit replay of
+    # that rule (fused_adam_cuda_kernel: update = mhat/denom + decay*p)
+    lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-8, 0.1
+    w = rng.standard_normal((4, 3)).astype(np.float32)
+    g1 = rng.standard_normal((4, 3)).astype(np.float32)
+    g2 = rng.standard_normal((4, 3)).astype(np.float32)
+    p = Parameter(jnp.asarray(w))
+    opt = FusedAdam([p], lr=lr, weight_decay=wd)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    ref = w.copy()
+    for t, g in enumerate([g1, g2], start=1):
+        p.grad = jnp.asarray(g)
+        opt.step()
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        ref = ref - lr * (mhat / (np.sqrt(vhat) + eps) + wd * ref)
+        np.testing.assert_allclose(np.asarray(p.data), ref,
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_contrib_adam_explicit_grads_and_output_params(rng):
+    ours, _ = _pairs(rng)
+    half_outs = [Parameter(p.data.astype(jnp.bfloat16)) for p in ours]
+    grads = [p.grad for p in ours]
+    for p in ours:
+        p.grad = None
+    opt = FusedAdam(ours, lr=1e-2)
+    opt.step(grads=grads, output_params=half_outs, scale=1.0)
+    for p, h in zip(ours, half_outs):
+        assert h.data.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(h.data, np.float32), np.asarray(p.data),
+            rtol=1e-2, atol=1e-2)
+
+
+def test_contrib_adam_max_grad_norm_clips(rng):
+    w = rng.standard_normal((4, 3)).astype(np.float32)
+    g = rng.standard_normal((4, 3)).astype(np.float32)
+    gnorm = float(np.linalg.norm(g))
+
+    def run(max_grad_norm, grad_norms):
+        p = Parameter(jnp.asarray(w))
+        p.grad = jnp.asarray(g)
+        opt = FusedAdam([p], lr=1e-2, max_grad_norm=max_grad_norm)
+        opt.step(grad_norms=grad_norms)
+        return np.asarray(opt.state[p]["exp_avg"])
+
+    # Adam's param update is nearly invariant to uniform grad scaling, so
+    # the observable effect of the combined clip scale is on the moments:
+    # grads divided by clip≈4 before entering exp_avg
+    m_unclipped = run(0.0, None)
+    m_clipped = run(gnorm / 4, [gnorm])
+    np.testing.assert_allclose(m_clipped * 4.0, m_unclipped,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_contrib_lamb_matches_numpy_oracle(rng):
+    shapes = [(4, 3), (6,)]
+    ws = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+    gs = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+    params = [Parameter(jnp.asarray(w)) for w in ws]
+    for p, g in zip(params, gs):
+        p.grad = jnp.asarray(g)
+    lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-6, 0.01
+    opt = FusedLAMB(params, lr=lr, betas=(b1, b2), eps=eps, weight_decay=wd,
+                    max_grad_norm=0.0)
+    opt.step()
+    ms = [np.zeros_like(w) for w in ws]
+    vs = [np.zeros_like(w) for w in ws]
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        m = b1 * ms[i] + (1 - b1) * g
+        v = b2 * vs[i] + (1 - b2) * g * g
+        u = (m / (1 - b1)) / (np.sqrt(v / (1 - b2)) + eps) + wd * w
+        ratio = np.linalg.norm(w) / np.linalg.norm(u)
+        exp = w - lr * ratio * u
+        np.testing.assert_allclose(np.asarray(params[i].data), exp,
+                                   rtol=3e-5, atol=3e-6)
+
+
+def test_contrib_lamb_global_clip_changes_update(rng):
+    shapes = [(4, 3)]
+    w = rng.standard_normal(shapes[0]).astype(np.float32)
+    g = 100.0 * rng.standard_normal(shapes[0]).astype(np.float32)
+
+    def run(max_norm):
+        p = Parameter(jnp.asarray(w))
+        p.grad = jnp.asarray(g)
+        opt = FusedLAMB([p], lr=1e-2, max_grad_norm=max_norm)
+        opt.step()
+        return np.asarray(opt.state[p]["exp_avg"])
+
+    # the trust-ratio apply makes LAMB's param update scale-invariant, so
+    # (as with Adam) the clip is observable in the moments: clip scale =
+    # max_norm/gnorm divides the grads entering exp_avg
+    gnorm = float(np.linalg.norm(g))
+    m_clipped = run(1.0)
+    m_unclipped = run(0.0)
+    np.testing.assert_allclose(m_clipped * gnorm, m_unclipped,
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_contrib_fp16_optimizer_end_to_end(rng):
+    nn.manual_seed(0)
+    x = jnp.asarray(rng.standard_normal((16, 8)), jnp.bfloat16)
+    y = jnp.asarray(rng.standard_normal((16, 2)), jnp.float32)
+    model = nn.Linear(8, 2)
+    for p in model.parameters():
+        p.data = p.data.astype(jnp.bfloat16)
+    inner = FusedAdam(list(model.parameters()), lr=1e-2)
+    opt = FP16_Optimizer(inner, static_loss_scale=128.0, verbose=False)
+
+    losses = []
+    for _ in range(20):
+        opt.zero_grad()
+        out = model(x)
+        loss = ((out.float() - y) ** 2.0).mean()
+        opt.backward(loss)
+        opt.step()
+        losses.append(float(loss.value))
+    assert losses[-1] < losses[0] * 0.7
+    # masters stay fp32, model stays bf16, and they track each other
+    for g16, g32 in zip(opt.fp16_groups, opt.fp32_groups):
+        for p16, p32 in zip(g16, g32):
+            assert p16.data.dtype == jnp.bfloat16
+            assert p32.data.dtype == jnp.float32
+            np.testing.assert_allclose(
+                np.asarray(p16.data, np.float32), np.asarray(p32.data),
+                rtol=1e-2, atol=1e-2)
+
+
+def test_contrib_fp16_optimizer_overflow_skips_and_halves(rng):
+    nn.manual_seed(0)
+    model = nn.Linear(4, 2)
+    for p in model.parameters():
+        p.data = p.data.astype(jnp.bfloat16)
+    inner = FusedAdam(list(model.parameters()), lr=1e-2)
+    opt = FP16_Optimizer(inner, dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2 ** 8},
+                         verbose=False)
+    before = [np.asarray(p.data, np.float32).copy()
+              for p in model.parameters()]
+    for p in model.parameters():
+        p.grad = jnp.full_like(p.data, jnp.inf)
+    opt.step()
+    assert opt.overflow
+    assert opt.loss_scale == 2 ** 7  # halved
+    for p, b in zip(model.parameters(), before):
+        np.testing.assert_array_equal(np.asarray(p.data, np.float32), b)
+
+
+def test_contrib_adam_per_param_bias_correction(rng):
+    # param A frozen for 5 steps then unfrozen must not reset B's correction
+    wa = rng.standard_normal((3,)).astype(np.float32)
+    wb = rng.standard_normal((3,)).astype(np.float32)
+    gb = rng.standard_normal((3,)).astype(np.float32)
+    a, b = Parameter(jnp.asarray(wa)), Parameter(jnp.asarray(wb))
+    opt = FusedAdam([a, b], lr=1e-2)
+    for _ in range(5):
+        a.grad = None
+        b.grad = jnp.asarray(gb)
+        opt.step()
+    a.grad = jnp.asarray(gb)
+    b.grad = jnp.asarray(gb)
+    opt.step()
+    assert opt.state[a]["step"] == 1 and opt.state[b]["step"] == 6
+    # replay B alone: its trajectory must be unaffected by A's freeze
+    b2 = Parameter(jnp.asarray(wb))
+    opt2 = FusedAdam([b2], lr=1e-2)
+    for _ in range(6):
+        b2.grad = jnp.asarray(gb)
+        opt2.step()
+    np.testing.assert_allclose(np.asarray(b.data), np.asarray(b2.data),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_contrib_fp16_scale_growth_happens_after_step(rng):
+    nn.manual_seed(0)
+    model = nn.Linear(4, 2)
+    for p in model.parameters():
+        p.data = p.data.astype(jnp.bfloat16)
+    inner = FusedAdam(list(model.parameters()), lr=0.0)  # lr=0: isolate m
+    opt = FP16_Optimizer(inner, dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 4.0,
+                                            "scale_window": 1},
+                         verbose=False)
+    # grads scaled by 4 (the scale backward would have applied)
+    for p in model.parameters():
+        p.grad = jnp.full_like(p.data, 4.0)
+    opt.step()
+    # window=1: scale doubles AFTER the step; the unscale must have used 4
+    assert opt.loss_scale == 8.0
+    for p in inner.param_groups[0]["params"]:
+        np.testing.assert_allclose(np.asarray(inner.state[p]["exp_avg"]),
+                                   0.1, rtol=1e-5)  # (1-b1)*g/4 = 0.1
+
+
+def test_contrib_adam_bf16_output_no_f16_intermediate(rng):
+    # a value valid in bf16 but above f16 max must survive the write-out
+    w = np.full((2,), 70000.0, np.float32)
+    p = Parameter(jnp.asarray(w))
+    out = Parameter(jnp.zeros((2,), jnp.bfloat16))
+    opt = FusedAdam([p], lr=1e-3)
+    opt.step(grads=[jnp.zeros((2,), jnp.float32)], output_params=[out])
+    assert np.isfinite(np.asarray(out.data, np.float32)).all()
+
+
+def test_contrib_fp16_forwards_grad_norms_for_clipping(rng):
+    nn.manual_seed(0)
+    model = nn.Linear(4, 2)
+    for p in model.parameters():
+        p.data = p.data.astype(jnp.bfloat16)
+    inner = FusedAdam(list(model.parameters()), lr=1e-2, max_grad_norm=1e-3)
+    opt = FP16_Optimizer(inner, static_loss_scale=1.0, verbose=False)
+    for p in model.parameters():
+        p.grad = jnp.ones_like(p.data)
+    opt.step()
+    # with grad_norms forwarded, the clip divides moments by clip>>1
+    for p in inner.param_groups[0]["params"]:
+        m = np.abs(np.asarray(inner.state[p]["exp_avg"]))
+        assert m.max() < 0.01  # unclipped would be (1-b1)*1 = 0.1
