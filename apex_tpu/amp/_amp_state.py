@@ -24,6 +24,15 @@ class AmpState:
 _amp_state = AmpState()
 
 
+def reset():
+    """Clear the initialize-populated session state so a fresh
+    ``amp.initialize`` can run in the same process (tests, notebooks)."""
+    _amp_state.opt_properties = None
+    _amp_state.loss_scalers = []
+    _amp_state.handle = None
+    _amp_state.ambient_policy = None
+
+
 def warn_or_err(msg):
     if _amp_state.hard_override:
         print("Warning:  " + msg)

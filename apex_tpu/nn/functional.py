@@ -75,6 +75,10 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, ndim,
     spec = _conv_dn(ndim)
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, spec)
     if transposed:
+        if groups != 1:
+            raise NotImplementedError(
+                "transposed convolution with groups != 1 is not supported "
+                "(lax.conv_transpose has no feature_group_count)")
         if isinstance(output_padding, int):
             output_padding = (output_padding,) * ndim
         pads = []
@@ -116,8 +120,10 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
 @_policied("conv_transpose2d")
 def conv_transpose2d(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1):
-    # torch transposed-conv kernel layout is (in, out, kH, kW): swap to OIHW
-    weight = jnp.swapaxes(weight, 0, 1)
+    # torch transposed-conv kernel layout (in, out, kH, kW) is passed
+    # through unchanged: lax.conv_transpose(transpose_kernel=True) itself
+    # swaps I/O and flips the spatial dims (it computes the gradient of the
+    # forward conv whose OIHW kernel has O = our in_channels)
     return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
                  transposed=True, output_padding=output_padding)
 

@@ -1,0 +1,114 @@
+"""DCGAN with multi-model / multi-optimizer / multi-loss amp (reference:
+examples/dcgan/main_amp.py, 274 LoC — the example exercising
+``amp.initialize([netD, netG], [optD, optG], num_losses=3)`` with per-loss
+``loss_id`` 0/1/2, reference :214-253).
+
+Three scaled losses per iteration: D-real (loss_id 0), D-fake (1), G (2),
+each with its own LossScaler so one loss overflowing doesn't shrink the
+others' scales.  ``--synthetic`` (default) trains on noise images.
+"""
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+
+import apex_tpu.nn as nn
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--nz", type=int, default=64, help="latent dim")
+    p.add_argument("--ngf", type=int, default=32)
+    p.add_argument("--ndf", type=int, default=32)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--opt-level", default="O1",
+                   choices=["O0", "O1", "O2", "O3"])
+    return p.parse_args()
+
+
+def build_generator(nz, ngf):
+    # 4x4 -> 8x8 -> 16x16 -> 32x32
+    return nn.Sequential(
+        nn.ConvTranspose2d(nz, ngf * 4, 4, stride=1, padding=0),
+        nn.BatchNorm2d(ngf * 4), nn.ReLU(),
+        nn.ConvTranspose2d(ngf * 4, ngf * 2, 4, stride=2, padding=1),
+        nn.BatchNorm2d(ngf * 2), nn.ReLU(),
+        nn.ConvTranspose2d(ngf * 2, ngf, 4, stride=2, padding=1),
+        nn.BatchNorm2d(ngf), nn.ReLU(),
+        nn.ConvTranspose2d(ngf, 3, 4, stride=2, padding=1),
+        nn.Tanh())
+
+
+def build_discriminator(ndf):
+    return nn.Sequential(
+        nn.Conv2d(3, ndf, 4, stride=2, padding=1), nn.LeakyReLU(0.2),
+        nn.Conv2d(ndf, ndf * 2, 4, stride=2, padding=1),
+        nn.BatchNorm2d(ndf * 2), nn.LeakyReLU(0.2),
+        nn.Conv2d(ndf * 2, ndf * 4, 4, stride=2, padding=1),
+        nn.BatchNorm2d(ndf * 4), nn.LeakyReLU(0.2),
+        nn.Conv2d(ndf * 4, 1, 4, stride=1, padding=0),
+        nn.Flatten(0))
+
+
+def main():
+    args = parse_args()
+    nn.manual_seed(0)
+    netG = build_generator(args.nz, args.ngf)
+    netD = build_discriminator(args.ndf)
+    optG = FusedAdam(list(netG.parameters()), lr=args.lr, betas=(0.5, 0.999))
+    optD = FusedAdam(list(netD.parameters()), lr=args.lr, betas=(0.5, 0.999))
+
+    # the multi-model/multi-optimizer/multi-loss form (reference :214-215)
+    [netD, netG], [optD, optG] = amp.initialize(
+        [netD, netG], [optD, optG], opt_level=args.opt_level, num_losses=3)
+
+    criterion = nn.BCEWithLogitsLoss()
+    rng = np.random.default_rng(0)
+    real_label, fake_label = 1.0, 0.0
+
+    for it in range(args.iters):
+        real = jnp.asarray(
+            rng.standard_normal(
+                (args.batch_size, 3, args.image_size, args.image_size)),
+            jnp.float32)
+        noise = jnp.asarray(
+            rng.standard_normal((args.batch_size, args.nz, 1, 1)),
+            jnp.float32)
+
+        # --- D on real (loss_id 0, reference :230) ---
+        optD.zero_grad()
+        out = netD(real)
+        lbl = jnp.full((args.batch_size,), real_label, jnp.float32)
+        errD_real = criterion(out, lbl)
+        with amp.scale_loss(errD_real, optD, loss_id=0) as errD_real_scaled:
+            errD_real_scaled.backward()
+
+        # --- D on fake (loss_id 1, reference :240) ---
+        fake = netG(noise)
+        out = netD(fake.detach())
+        lbl = jnp.full((args.batch_size,), fake_label, jnp.float32)
+        errD_fake = criterion(out, lbl)
+        with amp.scale_loss(errD_fake, optD, loss_id=1) as errD_fake_scaled:
+            errD_fake_scaled.backward()
+        optD.step()
+
+        # --- G (loss_id 2, reference :253) ---
+        optG.zero_grad()
+        out = netD(fake)
+        lbl = jnp.full((args.batch_size,), real_label, jnp.float32)
+        errG = criterion(out, lbl)
+        with amp.scale_loss(errG, optG, loss_id=2) as errG_scaled:
+            errG_scaled.backward()
+        optG.step()
+
+        print(f"[{it}/{args.iters}] Loss_D {float(errD_real) + float(errD_fake):.4f} "
+              f"Loss_G {float(errG):.4f}")
+
+
+if __name__ == "__main__":
+    main()

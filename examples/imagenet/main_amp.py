@@ -1,0 +1,200 @@
+"""ImageNet training with apex_tpu amp + DDP (reference:
+examples/imagenet/main_amp.py, 542 LoC — same argparse surface:
+opt-level / loss-scale / keep-batchnorm-fp32 / sync_bn / prof, checkpoint
+resume, prefetcher, throughput meter printing
+world_size*batch/avg_step_time every --print-freq, reference :390-397).
+
+TPU differences: the data prefetcher is the native-runtime thread +
+device_put pipeline (apex_tpu/runtime/data.py) instead of a side CUDA
+stream; DDP places the batch over the mesh's data axis and XLA inserts the
+gradient all-reduce.  ``--synthetic`` trains on generated data so the
+example runs anywhere (no ImageFolder requirement).
+
+Usage (mirrors the reference README):
+    python main_amp.py -a resnet50 --b 224 --opt-level O2 --synthetic
+"""
+import argparse
+import os
+import pickle
+import time
+
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="ImageNet + apex_tpu amp")
+    p.add_argument("data", nargs="?", default=None,
+                   help="path to dataset (omit with --synthetic)")
+    p.add_argument("--arch", "-a", default="resnet50",
+                   choices=["resnet18", "resnet34", "resnet50", "resnet101"])
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--iters-per-epoch", type=int, default=20)
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--print-freq", type=int, default=10)
+    p.add_argument("--resume", default="", help="checkpoint to resume from")
+    p.add_argument("--checkpoint", default="checkpoint.pkl")
+    p.add_argument("--opt-level", default="O2",
+                   choices=["O0", "O1", "O2", "O3"])
+    p.add_argument("--loss-scale", default=None)
+    p.add_argument("--keep-batchnorm-fp32", default=None)
+    p.add_argument("--sync_bn", action="store_true",
+                   help="convert BatchNorm to SyncBatchNorm")
+    p.add_argument("--prof", action="store_true",
+                   help="pyprof op capture + analysis for one iteration")
+    p.add_argument("--synthetic", action="store_true",
+                   help="generated data instead of an ImageFolder tree")
+    p.add_argument("--image-size", type=int, default=224)
+    return p.parse_args()
+
+
+class AverageMeter:
+    """(reference main_amp.py AverageMeter)"""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.val = self.sum = self.count = 0.0
+        self.avg = 0.0
+
+    def update(self, val, n=1):
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count
+
+
+def synthetic_loader(args, n_classes=1000):
+    rng = np.random.default_rng(1234)
+    for _ in range(args.iters_per_epoch):
+        yield (rng.integers(0, 256,
+                            (args.batch_size, args.image_size,
+                             args.image_size, 3), dtype=np.uint8),
+               rng.integers(0, n_classes, (args.batch_size,)))
+
+
+def main():
+    args = parse_args()
+    import jax
+    import jax.numpy as jnp
+
+    import apex_tpu.nn as nn
+    from apex_tpu import amp, models, parallel, runtime
+    from apex_tpu.optimizers import FusedSGD
+
+    nn.manual_seed(0)
+    model = getattr(models, args.arch)(num_classes=1000)
+    if args.sync_bn:
+        model = parallel.convert_syncbn_model(model)
+    optimizer = FusedSGD(list(model.parameters()), lr=args.lr,
+                         momentum=args.momentum,
+                         weight_decay=args.weight_decay)
+    loss_scale = args.loss_scale
+    if loss_scale not in (None, "dynamic"):
+        loss_scale = float(loss_scale)
+    kbf = args.keep_batchnorm_fp32
+    if isinstance(kbf, str):
+        kbf = {"True": True, "False": False}.get(kbf, None)
+    model, optimizer = amp.initialize(
+        model, optimizer, opt_level=args.opt_level, loss_scale=loss_scale,
+        keep_batchnorm_fp32=kbf)
+    model = parallel.DistributedDataParallel(model)
+    criterion = nn.CrossEntropyLoss()
+
+    start_epoch = 0
+    if args.resume and os.path.exists(args.resume):
+        with open(args.resume, "rb") as f:
+            ck = pickle.load(f)
+        for p, d in zip(model.parameters(), ck["model"]):
+            p.data = jnp.asarray(d, p.data.dtype)
+        for b, d in zip(model.buffers(), ck["buffers"]):
+            b.data = jnp.asarray(d, b.data.dtype)
+        optimizer.load_state_dict(ck["optimizer"])
+        amp.load_state_dict(ck["amp"])
+        start_epoch = ck["epoch"]
+        print(f"=> resumed from {args.resume} (epoch {start_epoch})")
+
+    if args.prof:
+        from apex_tpu import pyprof
+        pyprof.nvtx.init()
+
+    half = jnp.bfloat16 if args.opt_level in ("O2", "O3") else None
+    for epoch in range(start_epoch, args.epochs):
+        batch_time, losses = AverageMeter(), AverageMeter()
+        loader = synthetic_loader(args) if args.synthetic else \
+            folder_loader(args)
+        prefetcher = runtime.DataPrefetcher(loader, half_dtype=half)
+        end = time.time()
+        i = 0
+        inp, target = prefetcher.next()
+        while inp is not None:
+            if args.prof and i == 1:
+                from apex_tpu import pyprof
+                cap = pyprof.capture()
+                cap.__enter__()
+            out = model(inp)
+            loss = criterion(out, target)
+            with amp.scale_loss(loss, optimizer) as scaled_loss:
+                scaled_loss.backward()
+            optimizer.step()
+            optimizer.zero_grad()
+            if args.prof and i == 1:
+                cap.__exit__(None, None, None)
+                rows = pyprof.analyze()
+                rows.sort(key=lambda r: -r["est_us"])
+                print("pyprof: top-5 ops by est time:")
+                for r in rows[:5]:
+                    print(f"  {r['dir']:>3} {r['op']:<12} "
+                          f"{r['flops'] / 1e9:8.2f} GFLOP  "
+                          f"{r['est_us']:8.1f} us  {r['scope']}")
+            losses.update(float(loss), n=args.batch_size)
+            batch_time.update(time.time() - end)
+            end = time.time()
+            if i % args.print_freq == 0:
+                ips = jax.device_count() * args.batch_size / \
+                    max(batch_time.avg, 1e-9)
+                print(f"Epoch [{epoch}][{i}] loss {losses.val:.4f} "
+                      f"({losses.avg:.4f})  {ips:.1f} img/s")
+            i += 1
+            inp, target = prefetcher.next()
+
+        ck = {
+            "epoch": epoch + 1,
+            "model": [np.asarray(p.data, np.float32)
+                      for p in model.parameters()],
+            "buffers": [np.asarray(b.data) for b in model.buffers()],
+            "optimizer": optimizer.state_dict(),
+            "amp": amp.state_dict(),
+        }
+        with open(args.checkpoint, "wb") as f:
+            pickle.dump(ck, f)
+        print(f"=> saved {args.checkpoint}")
+
+
+def folder_loader(args):
+    """Minimal ImageFolder reader (uint8 NHWC), mirroring the reference's
+    torchvision loader role without torchvision."""
+    import glob
+
+    from PIL import Image
+    classes = sorted(os.listdir(args.data))
+    files = [(f, ci) for ci, c in enumerate(classes)
+             for f in glob.glob(os.path.join(args.data, c, "*"))]
+    rng = np.random.default_rng(0)
+    rng.shuffle(files)
+    batch, labels = [], []
+    for f, ci in files:
+        img = Image.open(f).convert("RGB").resize(
+            (args.image_size, args.image_size))
+        batch.append(np.asarray(img, np.uint8))
+        labels.append(ci)
+        if len(batch) == args.batch_size:
+            yield np.stack(batch), np.asarray(labels)
+            batch, labels = [], []
+
+
+if __name__ == "__main__":
+    main()

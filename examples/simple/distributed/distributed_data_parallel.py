@@ -1,0 +1,44 @@
+"""Minimal distributed example (reference:
+examples/simple/distributed/distributed_data_parallel.py, 67 LoC — O1 amp +
+DDP on a toy model).
+
+On TPU the "launcher" is the mesh: a single process drives all local
+devices; multi-host runs add ``parallel.init_distributed()`` (the
+``apex.parallel.multiproc`` role).  Run: ``python
+distributed_data_parallel.py`` (uses every visible device).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+import apex_tpu.nn as nn
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.parallel import DistributedDataParallel
+
+
+def main():
+    nn.manual_seed(0)
+    model = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 2))
+    optimizer = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+    model, optimizer = amp.initialize(model, optimizer, opt_level="O1")
+    model = DistributedDataParallel(model)
+    criterion = nn.MSELoss()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((32, 10)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((32, 2)), jnp.float32)
+
+    for step in range(20):
+        out = model(x)
+        loss = criterion(out, y)
+        optimizer.zero_grad()
+        with amp.scale_loss(loss, optimizer) as scaled_loss:
+            scaled_loss.backward()
+        optimizer.step()
+        if step % 5 == 0:
+            print(f"step {step}: loss {float(loss):.5f}")
+    print("final loss:", float(loss))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Instrumented training loop for the L1 cross-product harness (reference:
+tests/L1/common/main_amp.py — a clone of the ImageNet example that saves
+per-iteration loss for cross-build comparison).
+
+``run_config`` trains a small conv+BN+linear net on deterministic synthetic
+data under a given (opt_level, loss_scale, keep_batchnorm_fp32, pallas
+build) and returns the loss trajectory.  The reference compares an
+extensions-installed run against a Python-only run
+(tests/L1/run_test.sh:22-110); the TPU analogue compares the Pallas-kernel
+build ('interpret' on CPU) against the pure-XLA fallback ('off'), same
+oracle: iteration-for-iteration loss agreement (compare.py:34-40).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+import apex_tpu.nn as nn
+from apex_tpu import amp
+from apex_tpu.ops.pallas import force_mode
+from apex_tpu.optimizers import FusedSGD
+
+
+def build_model():
+    nn.manual_seed(42)
+    return nn.Sequential(
+        nn.Conv2d(3, 8, 3, padding=1), nn.BatchNorm2d(8), nn.ReLU(),
+        nn.Conv2d(8, 16, 3, stride=2, padding=1), nn.BatchNorm2d(16),
+        nn.ReLU(), nn.Flatten(), nn.Linear(16 * 4 * 4, 10))
+
+
+def synthetic_batches(iters=3, batch=8):
+    rng = np.random.default_rng(1234)
+    return [(jnp.asarray(rng.standard_normal((batch, 3, 8, 8)),
+                         jnp.float32),
+             jnp.asarray(rng.integers(0, 10, (batch,))))
+            for _ in range(iters)]
+
+
+def _reset_amp():
+    from apex_tpu.amp._amp_state import reset as _r
+    _r()
+
+
+def run_config(opt_level, loss_scale=None, keep_batchnorm_fp32=None,
+               pallas="off", iters=3):
+    """→ list of per-iteration losses (floats)."""
+    with force_mode(pallas):
+        _reset_amp()
+        model = build_model()
+        opt = FusedSGD(list(model.parameters()), lr=0.05, momentum=0.9)
+        kwargs = {}
+        if loss_scale is not None:
+            kwargs["loss_scale"] = loss_scale
+        if keep_batchnorm_fp32 is not None:
+            kwargs["keep_batchnorm_fp32"] = keep_batchnorm_fp32
+        model, opt = amp.initialize(model, opt, opt_level=opt_level,
+                                    verbosity=0, **kwargs)
+        crit = nn.CrossEntropyLoss()
+        losses = []
+        for x, y in synthetic_batches(iters):
+            out = model(x)
+            loss = crit(out, y)
+            with amp.scale_loss(loss, opt) as scaled:
+                scaled.backward()
+            opt.step()
+            opt.zero_grad()
+            losses.append(float(loss))
+        return losses

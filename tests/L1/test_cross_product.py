@@ -1,0 +1,55 @@
+"""L1 cross-product: {O0..O3} × loss_scale × keep_batchnorm_fp32, Pallas
+build vs pure-XLA build (reference: tests/L1/run_test.sh:22-110 looping the
+same product over extensions-installed vs Python-only builds, with
+compare.py:34-40 asserting iteration-for-iteration loss equality).
+
+'interpret' runs the real Pallas kernel logic through the interpreter (the
+"extensions" build on CPU); 'off' is the jnp fallback ("Python-only").
+Both see identical data/init, so their loss curves must agree elementwise
+to float tolerance, every iteration, in every configuration.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
+from main_amp import run_config  # noqa: E402
+
+LOSS_SCALES = [None, 1.0, 128.0, "dynamic"]
+
+
+def _configs():
+    out = []
+    for opt_level in ("O0", "O1", "O2", "O3"):
+        for ls in LOSS_SCALES:
+            kbf_options = [None]
+            if opt_level in ("O2", "O3"):
+                kbf_options = [None, True, False]
+            for kbf in kbf_options:
+                out.append((opt_level, ls, kbf))
+    return out
+
+
+@pytest.mark.parametrize("opt_level,loss_scale,kbf", _configs())
+def test_pallas_vs_python_build_loss_parity(opt_level, loss_scale, kbf):
+    python_build = run_config(opt_level, loss_scale, kbf, pallas="off")
+    pallas_build = run_config(opt_level, loss_scale, kbf,
+                              pallas="interpret")
+    assert len(python_build) == len(pallas_build) == 3
+    assert all(np.isfinite(python_build)), (opt_level, loss_scale, kbf)
+    np.testing.assert_allclose(
+        pallas_build, python_build, rtol=2e-3, atol=2e-4,
+        err_msg=f"loss curves diverge for {(opt_level, loss_scale, kbf)}")
+
+
+def test_mixed_precision_tracks_fp32_baseline():
+    """All opt levels start from identical init/data, so iteration-0 loss
+    matches O0 closely and trajectories stay in the same neighborhood
+    (reference compare.py's cross-run check against stored baselines)."""
+    base = run_config("O0")
+    for opt_level in ("O1", "O2", "O3"):
+        got = run_config(opt_level)
+        np.testing.assert_allclose(got[0], base[0], rtol=5e-2)
+        assert abs(got[-1] - base[-1]) < 0.5 * max(1.0, abs(base[-1]))

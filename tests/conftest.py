@@ -28,3 +28,11 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture
+def reset_amp():
+    """Clear global amp state (shared by the e2e and L1 suites)."""
+    from apex_tpu.amp._amp_state import reset as _r
+    _r()
+    return _r

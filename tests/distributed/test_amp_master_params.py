@@ -1,0 +1,61 @@
+"""O2 + DDP master-param consistency (reference:
+tests/distributed/amp_master_params/ — after training, params must be equal
+across ranks and model halves must equal master fp32 within rtol .005,
+compare.py:12-26).
+
+On the SPMD mesh "cross-rank equality" is replication: every param/master
+must be fully-replicated (one logical value on all devices) after real
+training steps, and the bf16 model copy must track the fp32 masters.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+import apex_tpu.nn as nn
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.parallel import DistributedDataParallel
+
+
+def test_master_params_replicated_and_track_model():
+    nn.manual_seed(0)
+    model = nn.Sequential(nn.Linear(10, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+    model, opt = amp.initialize(model, opt, opt_level="O2",
+                                cast_model_type=jnp.bfloat16,
+                                loss_scale=128.0, verbosity=0)
+    ddp = DistributedDataParallel(model, mesh=Mesh(
+        np.array(jax.devices()), ("data",)))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((16, 10)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (16,)))
+    crit = nn.CrossEntropyLoss()
+    for _ in range(5):
+        out = ddp(x)
+        loss = crit(out, y)
+        with amp.scale_loss(loss, opt) as scaled:
+            scaled.backward()
+        opt.step()
+        opt.zero_grad()
+
+    masters = list(amp.master_params(opt))
+    assert masters, "O2 must expose fp32 masters"
+    model_params = [p for p in model.parameters()]
+    assert len(masters) == len(model_params)
+    for mp, p in zip(masters, model_params):
+        # cross-"rank" equality: fully replicated on the mesh
+        assert mp.data.sharding.is_fully_replicated
+        assert p.data.sharding.is_fully_replicated
+        assert mp.data.dtype == jnp.float32
+        assert p.data.dtype == jnp.bfloat16
+        # model == master.half() within the reference tolerance (0.005)
+        np.testing.assert_allclose(
+            np.asarray(p.data, np.float32), np.asarray(mp.data),
+            rtol=5e-3, atol=5e-3)
+        # and the halves are EXACTLY the cast of the masters (the step
+        # writes both in one pass)
+        np.testing.assert_array_equal(
+            np.asarray(p.data),
+            np.asarray(mp.data.astype(jnp.bfloat16)))

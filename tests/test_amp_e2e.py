@@ -13,9 +13,8 @@ from apex_tpu.optimizers import FusedAdam, FusedSGD
 
 
 def _reset_amp():
-    _amp_state.opt_properties = None
-    _amp_state.loss_scalers = []
-    _amp_state.ambient_policy = None
+    from apex_tpu.amp._amp_state import reset as _r
+    _r()
 
 
 def _small_model():

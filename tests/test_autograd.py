@@ -56,6 +56,9 @@ def test_program_cache_hit(rng):
     nn.manual_seed(3)
     lin = nn.Linear(5, 3)
     x = jnp.asarray(rng.standard_normal((7, 5)), jnp.float32)
+    # the LRU may be at capacity from earlier suites, which would evict on
+    # insert and break the +1 bookkeeping below
+    autograd._compiled_cache.clear()
     before = len(autograd._compiled_cache)
     for _ in range(4):
         (lin(x) ** 2.0).mean().backward()

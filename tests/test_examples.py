@@ -1,0 +1,25 @@
+"""Example scripts smoke tests (reference: examples/ are exercised by
+tests/L1 clones; here the fast one runs directly)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_simple_distributed_example_runs():
+    env = dict(os.environ, PYTHONPATH=REPO)
+    script = os.path.join(REPO, "examples", "simple", "distributed",
+                          "distributed_data_parallel.py")
+    # force the CPU backend inside the subprocess: the axon TPU plugin
+    # ignores the JAX_PLATFORMS env var (see tests/conftest.py)
+    code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import runpy; runpy.run_path({script!r}, "
+            f"run_name='__main__')")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "final loss:" in out.stdout
+    final = float(out.stdout.rsplit("final loss:", 1)[1].strip())
+    assert final < 0.5

@@ -1,0 +1,29 @@
+"""Functional-op numerics vs torch oracles (ops with no dedicated
+suite; the cast-policy behavior tests live in test_policy.py)."""
+import jax.numpy as jnp
+import numpy as np
+def test_conv_transpose2d_matches_torch(rng):
+    import torch
+    from apex_tpu.nn import functional as F
+    x = rng.standard_normal((2, 4, 5, 5)).astype(np.float32)
+    w = rng.standard_normal((4, 6, 3, 3)).astype(np.float32)
+    b = rng.standard_normal((6,)).astype(np.float32)
+    for stride, pad, opad in [(2, 1, 1), (1, 0, 0), (3, 2, 1)]:
+        ours = F.conv_transpose2d(jnp.asarray(x), jnp.asarray(w),
+                                  jnp.asarray(b), stride=stride,
+                                  padding=pad, output_padding=opad)
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b),
+            stride=stride, padding=pad, output_padding=opad)
+        assert ours.shape == tuple(ref.shape)
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose2d_groups_rejected(rng):
+    import pytest
+    from apex_tpu.nn import functional as F
+    x = jnp.asarray(rng.standard_normal((2, 4, 5, 5)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((4, 3, 3, 3)), jnp.float32)
+    with pytest.raises(NotImplementedError, match="groups"):
+        F.conv_transpose2d(x, w, groups=2)

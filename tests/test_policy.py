@@ -4,6 +4,8 @@ linear ALWAYS_HALF, softmax ALWAYS_FLOAT, promotion to widest, banned raises).
 """
 import types
 
+import numpy as np
+
 import jax.numpy as jnp
 import pytest
 
@@ -131,3 +133,4 @@ def test_decorators(pol):
     # inactive outside policy
     assert h(x32).dtype == jnp.float32
     assert f(x16).dtype == jnp.float16
+
