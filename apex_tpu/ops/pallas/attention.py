@@ -39,10 +39,36 @@ def _ceil_div(a, b):
     return (a + b - 1) // b
 
 
-def _block_sizes(sq, sk):
+_VMEM_BUDGET = 10 * 1024 * 1024  # conservative slice of the ~16 MiB/core VMEM
+
+
+def _vmem_estimate(bq, bk, d):
+    """Worst-case fp32 bytes resident per grid step across the three kernels
+    (input blocks + (bq, bk) score intermediates + scratch accumulators)."""
+    f = 4
+    fwd = (2 * bq * d + 2 * bk * d) * f + 3 * bq * bk * f + 2 * bq * f
+    dkv = (3 * bq * d + 2 * bk * d) * f + 4 * bq * bk * f + 2 * bk * d * f
+    return max(fwd, dkv)
+
+
+def _block_sizes(sq, sk, d):
     bq = min(256, _round8(sq))
     bk = min(512, _round8(sk))
+    # shrink blocks until the per-step working set fits the VMEM budget
+    # (large head dims would otherwise OOM VMEM at the default 256/512)
+    while _vmem_estimate(bq, bk, d) > _VMEM_BUDGET and bk > 128:
+        bk //= 2
+    while _vmem_estimate(bq, bk, d) > _VMEM_BUDGET and bq > 128:
+        bq //= 2
     return bq, bk
+
+
+def vmem_fit(sq, sk, d):
+    """VMEM-fit report for the chosen block sizes (bench --kernels guard)."""
+    bq, bk = _block_sizes(sq, sk, d)
+    est = _vmem_estimate(bq, bk, d)
+    return {"bq": bq, "bk": bk, "est_bytes": est,
+            "budget_bytes": _VMEM_BUDGET, "fits": est <= _VMEM_BUDGET}
 
 
 def _round8(x):
@@ -186,7 +212,7 @@ def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False):
     Returns (out (BH, Sq, D), lse (BH, Sq) fp32)."""
     bh, sq, d = q3.shape
     sk = k3.shape[1]
-    bq, bk = _block_sizes(sq, sk)
+    bq, bk = _block_sizes(sq, sk, d)
     sq_p, sk_p = _ceil_div(sq, bq) * bq, _ceil_div(sk, bk) * bk
     q3 = jnp.pad(q3, ((0, 0), (0, sq_p - sq), (0, 0)))
     k3 = jnp.pad(k3, ((0, 0), (0, sk_p - sk), (0, 0)))
@@ -241,7 +267,7 @@ def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
     """→ (dq, dk, dv) with the shapes/dtypes of q3/k3/v3."""
     bh, sq, d = q3.shape
     sk = k3.shape[1]
-    bq, bk = _block_sizes(sq, sk)
+    bq, bk = _block_sizes(sq, sk, d)
     sq_p, sk_p = _ceil_div(sq, bq) * bq, _ceil_div(sk, bk) * bk
     delta = jnp.sum(g.astype(_f32) * out.astype(_f32), axis=-1)  # (BH, Sq)
     q3 = jnp.pad(q3, ((0, 0), (0, sq_p - sq), (0, 0)))
