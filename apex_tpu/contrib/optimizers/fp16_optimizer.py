@@ -95,8 +95,12 @@ class FP16_Optimizer:
         return self.optimizer.param_groups
 
     def state_dict(self):
+        import copy
+        # snapshot, not a live reference: the reference stores the mutable
+        # scaler object itself, so a held checkpoint dict keeps mutating as
+        # training continues (cur_scale/cur_iter) until pickled
         return {
-            "loss_scaler": self.loss_scaler,
+            "loss_scaler": copy.deepcopy(self.loss_scaler),
             "dynamic_loss_scale": self.dynamic_loss_scale,
             "overflow": self.overflow,
             "optimizer_state_dict": self.optimizer.state_dict(),
@@ -104,7 +108,10 @@ class FP16_Optimizer:
         }
 
     def load_state_dict(self, state_dict):
-        self.loss_scaler = state_dict["loss_scaler"]
+        import copy
+        # adopt a copy, not the checkpoint's object (same aliasing bug as
+        # state_dict, on the load side)
+        self.loss_scaler = copy.deepcopy(state_dict["loss_scaler"])
         self.dynamic_loss_scale = state_dict["dynamic_loss_scale"]
         self.overflow = state_dict["overflow"]
         self.optimizer.load_state_dict(state_dict["optimizer_state_dict"])

@@ -82,7 +82,8 @@ def _is_replicated(x) -> bool:
 
 def all_reduce_mean(tensors, mesh: Optional[Mesh] = None,
                     always_fp32: bool = False,
-                    predivide_factor: float = 1.0):
+                    predivide_factor: float = 1.0,
+                    average: bool = True):
     """Mean-all-reduce over the mesh's data axis, honoring the DDP
     dtype/predivide knobs.
 
@@ -100,9 +101,14 @@ def all_reduce_mean(tensors, mesh: Optional[Mesh] = None,
     def exchange(g):
         gc = g.astype(jnp.float32) if always_fp32 else g
         if predivide_factor != 1.0:
+            # unconditional predivide before the collective: bounds the
+            # summed magnitude, which is what keeps low-precision grads
+            # finite; only the post-multiply is gated on gradient_average
+            # (reference distributed.py:445-454)
             gc = gc / predivide_factor
         gc = jax.lax.psum(gc, axis)
-        gc = gc / (n / predivide_factor)
+        if average:
+            gc = gc * (predivide_factor / n)
         return gc.astype(g.dtype) if always_fp32 else gc
 
     out = list(tensors)
@@ -147,8 +153,12 @@ class Reducer:
     apex/parallel/distributed.py:89-126): call ``reduce()`` whenever you want
     the wrapped module's gradients averaged across replicas."""
 
-    def __init__(self, module_or_grads_list, mesh: Optional[Mesh] = None):
+    def __init__(self, module_or_grads_list, mesh: Optional[Mesh] = None,
+                 allreduce_always_fp32: bool = False,
+                 gradient_predivide_factor: float = 1.0):
         self.mesh = mesh or _default_mesh()
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.gradient_predivide_factor = gradient_predivide_factor
         if isinstance(module_or_grads_list, Module):
             self.module = module_or_grads_list
             # parameter broadcast at construction (reference :253): in
@@ -163,11 +173,17 @@ class Reducer:
             params = [p for p in self.module.parameters()
                       if p is not None and p.grad is not None]
             grads = [p.grad for p in params]
-            new = all_reduce_mean(grads, self.mesh)
+            new = all_reduce_mean(
+                grads, self.mesh,
+                always_fp32=self.allreduce_always_fp32,
+                predivide_factor=self.gradient_predivide_factor)
             for p, g in zip(params, new):
                 p.grad = g
         else:
-            self.grads[:] = all_reduce_mean(self.grads, self.mesh)
+            self.grads[:] = all_reduce_mean(
+                self.grads, self.mesh,
+                always_fp32=self.allreduce_always_fp32,
+                predivide_factor=self.gradient_predivide_factor)
 
 
 class DistributedDataParallel(Module):
@@ -255,6 +271,27 @@ class DistributedDataParallel(Module):
     def shard_batch(self, x):
         """Place a global batch sharded over the data axis."""
         return jax.device_put(x, self._batch_sharding)
+
+    def allreduce_gradients(self):
+        """Explicitly exchange the wrapped module's ``.grad``s, honoring the
+        wrapper's knobs (``allreduce_always_fp32``,
+        ``gradient_predivide_factor``, ``gradient_average``) — the analogue
+        of the reference's end-of-backward fallback allreduce
+        (apex/parallel/distributed.py:491-510).
+
+        In the normal SPMD path grads come out of the compiled backward
+        already exchanged; this is for grads produced per-replica (sharded
+        on their leading axis), e.g. by a manual per-device loop.
+        """
+        params = [p for p in self.module.parameters()
+                  if p is not None and getattr(p, "grad", None) is not None]
+        new = all_reduce_mean(
+            [p.grad for p in params], self.mesh,
+            always_fp32=self.allreduce_always_fp32,
+            predivide_factor=self.gradient_predivide_factor,
+            average=self.gradient_average)
+        for p, g in zip(params, new):
+            p.grad = g
 
     # DDP delegates module protocol (parameters/state_dict/etc. come from
     # Module via the registered child)

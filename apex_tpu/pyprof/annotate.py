@@ -79,13 +79,18 @@ def _callsite():
     what its prof stage actually uses).  Walks raw frames — no
     inspect.stack(), which materializes every FrameInfo + source context on
     every recorded event."""
+    import os
     import sys
+    sep = os.sep
+    # match whole path components, not substrings: a user script under
+    # ~/jax-experiments/train.py must still be attributed
+    skip = (f"{sep}apex_tpu{sep}", f"{sep}jax{sep}", f"{sep}jaxlib{sep}")
     f = sys._getframe(2)
     for _ in range(12):
         if f is None:
             break
         fn = f.f_code.co_filename
-        if "apex_tpu" not in fn and "jax" not in fn and "<" not in fn:
+        if not any(s in fn for s in skip) and "<" not in fn:
             return f"{fn}:{f.f_lineno}"
         f = f.f_back
     return None
@@ -146,13 +151,19 @@ def _record(op, sig, args, kwargs):
             tensors[name] = {"shape": _shape_of(v), "dtype": _dtype_of(v)}
         elif v is not None:
             params[name] = _jsonable(v)
+    eff = _effective_dtypes(op, dtypes)
+    if eff is not dtypes:
+        # keep the per-tensor dict consistent with the policy-adjusted flat
+        # list — otherwise JSON/CSV rows report contradictory dtypes under O1
+        for name, d in zip(tensors, eff):
+            tensors[name]["dtype"] = d
     st.events.append({
         "seq": len(st.events),
         "op": op,
         "dir": "fwd",
         "scope": "/".join(st.scopes) if st.scopes else "",
         "shapes": shapes,
-        "dtypes": _effective_dtypes(op, dtypes),
+        "dtypes": eff,
         "tensors": tensors,
         "params": params,
         "callsite": _callsite(),

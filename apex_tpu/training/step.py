@@ -14,6 +14,7 @@ API-parity path.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, NamedTuple, Optional
 
 import jax
@@ -47,9 +48,16 @@ class TrainStep:
         self._params = params
         self._buffers = buffers
         self.state = init_state
+        #: wall seconds of the first call (≈ trace + XLA compile: jit
+        #: compilation is synchronous at dispatch, execution is async).
+        #: Round-1 lesson: compile cost was invisible until it timed out.
+        self.compile_s = None
 
     def __call__(self, *batch):
+        t0 = time.perf_counter() if self.compile_s is None else None
         self.state, loss = self._step_fn(self.state, *batch)
+        if t0 is not None:
+            self.compile_s = time.perf_counter() - t0
         return loss
 
     def sync_to_objects(self):
@@ -100,6 +108,31 @@ def make_train_step(model, optimizer, loss_fn: Callable,
 
     params = [p for p in model.parameters() if p is not None]
     buffers = [b for b in model.buffers()]
+
+    # Per-group bookkeeping: optimizer params are matched against the model's
+    # by identity; hyperparameters come from each param's own group (the
+    # round-1 version silently applied group 0 to everything).  Model params
+    # held by no group are frozen (torch semantics).
+    id2idx = {id(p): i for i, p in enumerate(params)}
+    group_idxs: list[list[int]] = []
+    for gi, group in enumerate(optimizer.param_groups):
+        idxs = []
+        for p in group["params"]:
+            if id(p) not in id2idx:
+                raise ValueError(
+                    f"make_train_step: optimizer param_groups[{gi}] holds a "
+                    f"parameter (shape {tuple(p.shape)}) that is not one of "
+                    f"model.parameters(); the fused step requires the "
+                    f"optimizer to optimize the model's own parameters")
+            idxs.append(id2idx[id(p)])
+        group_idxs.append(idxs)
+
+    def _gather(lst, idxs):
+        return [lst[i] for i in idxs]
+
+    def _scatter(dst, idxs, new):
+        for i, v in zip(idxs, new):
+            dst[i] = v
     from ..nn.modules import _BatchNorm
 
     bn_param_ids = set()
@@ -121,31 +154,46 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     init_scale = (min(max_loss_scale, 2.0 ** 16) if dynamic
                   else float(loss_scale))
 
-    # map optimizer type -> pure update over flat lists
+    # map optimizer type -> pure update over flat lists, applied per group
+    # (hyperparameters are read at trace time; mutate-and-recompile to change
+    # them mid-training, as with any jitted step)
     opt = optimizer
     if isinstance(opt, FusedSGD):
-        group = opt.param_groups[0]
-        mom = group["momentum"]
-
         def opt_update(flag, grads, masters, slots, step):
-            flag, new_p, new_m = ops.multi_tensor_sgd(
-                flag, [grads, masters, slots["momentum"]],
-                group["weight_decay"], mom, group["dampening"], group["lr"],
-                group["nesterov"], False, opt.wd_after_momentum, 1.0)
+            new_p, new_m = list(masters), list(slots["momentum"])
+            for group, idxs in zip(opt.param_groups, group_idxs):
+                if not idxs:
+                    continue
+                flag, g_p, g_m = ops.multi_tensor_sgd(
+                    flag, [_gather(grads, idxs), _gather(new_p, idxs),
+                           _gather(new_m, idxs)],
+                    group["weight_decay"], group["momentum"],
+                    group["dampening"], group["lr"], group["nesterov"],
+                    False, opt.wd_after_momentum, 1.0)
+                _scatter(new_p, idxs, g_p)
+                _scatter(new_m, idxs, g_m)
             return new_p, {"momentum": new_m}
 
         def opt_init():
             return {"momentum": [jnp.zeros(p.shape, jnp.float32)
                                  for p in params]}
     elif isinstance(opt, FusedAdam):
-        group = opt.param_groups[0]
-        b1, b2 = group["betas"]
-
         def opt_update(flag, grads, masters, slots, step):
-            _, new_p, new_m, new_v = ops.multi_tensor_adam(
-                flag, [grads, masters, slots["m"], slots["v"]],
-                group["lr"], b1, b2, group["eps"], step, opt.adam_w_mode,
-                bool(group["bias_correction"]), group["weight_decay"])
+            new_p = list(masters)
+            new_m, new_v = list(slots["m"]), list(slots["v"])
+            for group, idxs in zip(opt.param_groups, group_idxs):
+                if not idxs:
+                    continue
+                b1, b2 = group["betas"]
+                _, g_p, g_m, g_v = ops.multi_tensor_adam(
+                    flag, [_gather(grads, idxs), _gather(new_p, idxs),
+                           _gather(new_m, idxs), _gather(new_v, idxs)],
+                    group["lr"], b1, b2, group["eps"], step,
+                    opt.adam_w_mode, bool(group["bias_correction"]),
+                    group["weight_decay"])
+                _scatter(new_p, idxs, g_p)
+                _scatter(new_m, idxs, g_m)
+                _scatter(new_v, idxs, g_v)
             return new_p, {"m": new_m, "v": new_v}
 
         def opt_init():
@@ -153,25 +201,75 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             return {"m": z, "v": [jnp.zeros(p.shape, jnp.float32)
                                   for p in params]}
     elif isinstance(opt, FusedLAMB):
-        group = opt.param_groups[0]
-        b1, b2 = group["betas"]
-
         def opt_update(flag, grads, masters, slots, step):
-            _, gnorm, _ = ops.multi_tensor_l2norm(flag, [grads])
-            _, new_p, new_m, new_v = ops.multi_tensor_lamb(
-                flag, [grads, masters, slots["m"], slots["v"]],
-                group["lr"], b1, b2, group["eps"], step,
-                bool(group["bias_correction"]), group["weight_decay"],
-                1 if group["grad_averaging"] else 0, opt.adam_w_mode,
-                gnorm, group["max_grad_norm"])
+            new_p = list(masters)
+            new_m, new_v = list(slots["m"]), list(slots["v"])
+            for group, idxs in zip(opt.param_groups, group_idxs):
+                if not idxs:
+                    continue
+                b1, b2 = group["betas"]
+                # per-group global grad norm, matching the eager
+                # FusedLAMB.step's per-dtype-bucket l2norm (fused_lamb.py:26)
+                _, gnorm, _ = ops.multi_tensor_l2norm(
+                    flag, [_gather(grads, idxs)])
+                _, g_p, g_m, g_v = ops.multi_tensor_lamb(
+                    flag, [_gather(grads, idxs), _gather(new_p, idxs),
+                           _gather(new_m, idxs), _gather(new_v, idxs)],
+                    group["lr"], b1, b2, group["eps"], step,
+                    bool(group["bias_correction"]), group["weight_decay"],
+                    1 if group["grad_averaging"] else 0, opt.adam_w_mode,
+                    gnorm, group["max_grad_norm"])
+                _scatter(new_p, idxs, g_p)
+                _scatter(new_m, idxs, g_m)
+                _scatter(new_v, idxs, g_v)
             return new_p, {"m": new_m, "v": new_v}
 
         def opt_init():
             z = [jnp.zeros(p.shape, jnp.float32) for p in params]
             return {"m": z, "v": [jnp.zeros(p.shape, jnp.float32)
                                   for p in params]}
+    elif isinstance(opt, FusedNovoGrad):
+        def opt_update(flag, grads, masters, slots, step):
+            new_p = list(masters)
+            new_m, new_n = list(slots["m"]), list(slots["grad_norms"])
+            for group, idxs in zip(opt.param_groups, group_idxs):
+                if not idxs:
+                    continue
+                b1, b2 = group["betas"]
+                norm_type = group["norm_type"]
+                g_grads = _gather(grads, idxs)
+                # first-step norm init (reference fused_novograd.py:158-174):
+                # seed the running norm with ||g|| so the first blend is a
+                # no-op, unless init_zero
+                norms_in = _gather(new_n, idxs)
+                if not group["init_zero"]:
+                    def _local_norm(g):
+                        gf = g.astype(jnp.float32)
+                        return (jnp.max(jnp.abs(gf)) if norm_type == 0
+                                else jnp.sqrt(jnp.sum(gf * gf)))
+                    norms_in = [
+                        jnp.where(step == 1, _local_norm(g), n)
+                        for g, n in zip(g_grads, norms_in)]
+                _, g_p, g_m, g_n = ops.multi_tensor_novograd(
+                    flag, [g_grads, _gather(new_p, idxs),
+                           _gather(new_m, idxs), norms_in],
+                    group["lr"], b1, b2, group["eps"], step,
+                    bool(group["bias_correction"]), group["weight_decay"],
+                    1 if group["grad_averaging"] else 0, opt.moment_mode,
+                    norm_type)
+                _scatter(new_p, idxs, g_p)
+                _scatter(new_m, idxs, g_m)
+                _scatter(new_n, idxs, g_n)
+            return new_p, {"m": new_m, "grad_norms": new_n}
+
+        def opt_init():
+            return {"m": [jnp.zeros(p.shape, jnp.float32) for p in params],
+                    "grad_norms": [jnp.zeros((), jnp.float32)
+                                   for _ in params]}
     else:
-        raise TypeError(f"make_train_step does not support {type(opt)}")
+        raise TypeError(
+            f"make_train_step does not support {type(opt).__name__}; "
+            f"supported: FusedSGD, FusedAdam, FusedLAMB, FusedNovoGrad")
 
     def _model_vals(masters, model_params):
         # model_params holds None where no cast is needed (sharing the master
@@ -254,7 +352,11 @@ def make_train_step(model, optimizer, loss_fn: Callable,
         return StepState(masters, model_params, slots, new_scaler,
                          new_stats, step_count), loss
 
-    masters0 = [p.data.astype(jnp.float32) for p in params]
+    # copy=True: .astype is a no-op view for already-fp32 params, and the
+    # state is donated — without the copy the first step would delete the
+    # live Parameter.data / Buffer.data arrays out from under the model
+    masters0 = [jnp.array(p.data, dtype=jnp.float32, copy=True)
+                for p in params]
     init_state = StepState(
         master_params=masters0,
         model_params=[
@@ -264,7 +366,7 @@ def make_train_step(model, optimizer, loss_fn: Callable,
         scaler=ScalerState(jnp.asarray(init_scale, jnp.float32),
                            jnp.zeros((), jnp.int32),
                            jnp.zeros((), jnp.int32)),
-        stats=[b.data for b in buffers],
+        stats=[jnp.array(b.data, copy=True) for b in buffers],
         step=jnp.zeros((), jnp.int32))
 
     if axis_name is None:

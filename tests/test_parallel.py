@@ -224,6 +224,75 @@ def test_reducer_identity_on_replicated():
     np.testing.assert_array_equal(np.asarray(p.grad), 1.0)
 
 
+def test_all_reduce_predivide_keeps_fp16_finite():
+    """The predivide knob must observably change the collective's scaling
+    order: near-max fp16 grads summed over 8 replicas overflow without it,
+    and stay finite with predivide_factor=world_size (the knob's purpose,
+    reference distributed.py:445-454)."""
+    mesh = _mesh()
+    big = jnp.full((8, 4), 60000.0, jnp.float16)  # fp16 max is 65504
+    sharded = jax.device_put(big, jax.sharding.NamedSharding(mesh, P("data")))
+
+    (plain,) = parallel.all_reduce_mean([sharded], mesh)
+    assert not np.all(np.isfinite(np.asarray(plain, np.float32)))
+
+    (pre,) = parallel.all_reduce_mean([sharded], mesh, predivide_factor=8.0)
+    np.testing.assert_allclose(np.asarray(pre, np.float32), 60000.0,
+                               rtol=1e-3)
+
+
+def test_all_reduce_always_fp32_changes_collective_dtype():
+    """allreduce_always_fp32 must change the collective's dtype observably:
+    an fp16 psum whose sum exceeds fp16 max goes non-finite, while the fp32
+    collective (sum 480000 in fp32, mean 60000 cast back) stays finite."""
+    mesh = _mesh()
+    sharded = jax.device_put(jnp.full((8, 4), 60000.0, jnp.float16),
+                             jax.sharding.NamedSharding(mesh, P("data")))
+
+    (fp16,) = parallel.all_reduce_mean([sharded], mesh)
+    (fp32,) = parallel.all_reduce_mean([sharded], mesh, always_fp32=True)
+    assert fp32.dtype == jnp.float16  # cast back after the collective
+    assert not np.all(np.isfinite(np.asarray(fp16, np.float32)))
+    np.testing.assert_allclose(np.asarray(fp32, np.float32), 60000.0)
+
+
+def test_ddp_allreduce_gradients_honors_knobs():
+    """The DDP wrapper's recorded knobs must route into its explicit
+    gradient exchange (round 1: knobs were recorded, never exercised)."""
+    mesh = _mesh()
+    nn.manual_seed(0)
+    model = nn.Sequential(nn.Linear(4, 4, bias=False))
+    ddp = DistributedDataParallel(model, mesh=mesh,
+                                  gradient_predivide_factor=8.0,
+                                  allreduce_always_fp32=True)
+    p = list(model.parameters())[0]
+    per_replica = jnp.full((8, 4), 60000.0, jnp.float16)
+    p.grad = jax.device_put(per_replica,
+                            jax.sharding.NamedSharding(mesh, P("data")))
+    ddp.allreduce_gradients()
+    np.testing.assert_allclose(np.asarray(p.grad, np.float32), 60000.0,
+                               rtol=1e-3)
+
+    # and gradient_average=False → pure psum (sum, not mean)
+    model2 = nn.Sequential(nn.Linear(4, 4, bias=False))
+    ddp2 = DistributedDataParallel(model2, mesh=mesh, gradient_average=False)
+    p2 = list(model2.parameters())[0]
+    p2.grad = jax.device_put(jnp.ones((8, 4), jnp.float32),
+                             jax.sharding.NamedSharding(mesh, P("data")))
+    ddp2.allreduce_gradients()
+    np.testing.assert_allclose(np.asarray(p2.grad), 8.0)
+
+
+def test_reducer_honors_knobs():
+    mesh = _mesh()
+    grads = [jax.device_put(jnp.full((8, 2), 60000.0, jnp.float16),
+                            jax.sharding.NamedSharding(mesh, P("data")))]
+    red = Reducer(grads, mesh=mesh, gradient_predivide_factor=8.0)
+    red.reduce()
+    np.testing.assert_allclose(np.asarray(red.grads[0], np.float32), 60000.0,
+                               rtol=1e-3)
+
+
 def test_all_reduce_mean_sharded():
     mesh = _mesh()
     vals = jnp.arange(8.0).reshape(8, 1)

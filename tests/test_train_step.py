@@ -100,6 +100,158 @@ def test_fused_step_updates_bn_stats():
     assert int(np.asarray(model[1].num_batches_tracked.data)) == 1
 
 
+def test_fused_step_param_groups_match_eager():
+    """Two LR/WD groups: the fused step must apply each group's own
+    hyperparameters (round 1 silently used group 0 for everything) and match
+    the eager optimizer.step() path."""
+    def _grouped(model):
+        ps = list(model.parameters())
+        return [{"params": ps[:2], "lr": 0.05, "weight_decay": 1e-2},
+                {"params": ps[2:], "lr": 0.005}]
+
+    x, y = _data()
+    crit = nn.CrossEntropyLoss()
+
+    model_a = _model()
+    opt_a = FusedSGD(_grouped(model_a), lr=0.01, momentum=0.9)
+    for _ in range(4):
+        out = model_a(x)
+        loss = crit(out, y)
+        loss.backward()
+        opt_a.step()
+        opt_a.zero_grad()
+
+    model_b = _model()
+    opt_b = FusedSGD(_grouped(model_b), lr=0.01, momentum=0.9)
+    step = make_train_step(model_b, opt_b,
+                           lambda o, yy: F.cross_entropy(o, yy),
+                           loss_scale=1.0)
+    for _ in range(4):
+        step(x, y)
+
+    for pa, mb in zip(model_a.parameters(), step.state.master_params):
+        np.testing.assert_allclose(np.asarray(pa.data), np.asarray(mb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fused_step_adam_param_groups_match_eager():
+    x, y = _data()
+    crit = nn.CrossEntropyLoss()
+
+    def _grouped(model):
+        ps = list(model.parameters())
+        return [{"params": ps[:3], "lr": 1e-2, "betas": (0.8, 0.95)},
+                {"params": ps[3:], "lr": 1e-3, "weight_decay": 1e-2}]
+
+    model_a = _model()
+    opt_a = FusedAdam(_grouped(model_a), lr=1e-2)
+    for _ in range(3):
+        out = model_a(x)
+        loss = crit(out, y)
+        loss.backward()
+        opt_a.step()
+        opt_a.zero_grad()
+
+    model_b = _model()
+    opt_b = FusedAdam(_grouped(model_b), lr=1e-2)
+    step = make_train_step(model_b, opt_b,
+                           lambda o, yy: F.cross_entropy(o, yy),
+                           loss_scale=1.0)
+    for _ in range(3):
+        step(x, y)
+
+    for pa, mb in zip(model_a.parameters(), step.state.master_params):
+        np.testing.assert_allclose(np.asarray(pa.data), np.asarray(mb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fused_step_novograd():
+    """FusedNovoGrad in the fused path (raised TypeError in round 1),
+    including the first-step running-norm seeding, vs the eager step."""
+    from apex_tpu.optimizers import FusedNovoGrad
+
+    x, y = _data()
+    crit = nn.CrossEntropyLoss()
+
+    # bias=False: a conv bias feeding straight into BN has analytically-zero
+    # grad, and NovoGrad's per-tensor normalization g/||g|| turns float noise
+    # into O(1) update differences on such a param
+    def _model():
+        nn.manual_seed(42)
+        return nn.Sequential(
+            nn.Conv2d(3, 8, 3, padding=1, bias=False), nn.BatchNorm2d(8),
+            nn.ReLU(), nn.MaxPool2d(2), nn.Flatten(),
+            nn.Linear(8 * 8 * 8, 10))
+
+    model_a = _model()
+    opt_a = FusedNovoGrad(list(model_a.parameters()), lr=1e-2)
+    for _ in range(3):
+        out = model_a(x)
+        loss = crit(out, y)
+        loss.backward()
+        opt_a.step()
+        opt_a.zero_grad()
+
+    model_b = _model()
+    opt_b = FusedNovoGrad(list(model_b.parameters()), lr=1e-2)
+    step = make_train_step(model_b, opt_b,
+                           lambda o, yy: F.cross_entropy(o, yy),
+                           loss_scale=1.0)
+    for _ in range(3):
+        step(x, y)
+
+    for pa, mb in zip(model_a.parameters(), step.state.master_params):
+        np.testing.assert_allclose(np.asarray(pa.data), np.asarray(mb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fused_step_frozen_params_stay_fixed():
+    """Model params not held by the optimizer are frozen, torch-style."""
+    model = _model()
+    ps = list(model.parameters())
+    opt = FusedSGD(ps[2:], lr=0.05)
+    step = make_train_step(model, opt, lambda o, y: F.cross_entropy(o, y),
+                           loss_scale=1.0)
+    x, y = _data()
+    before = [np.asarray(m) for m in step.state.master_params[:2]]
+    step(x, y)
+    for b, a in zip(before, step.state.master_params[:2]):
+        np.testing.assert_array_equal(b, np.asarray(a))
+    assert not np.allclose(np.asarray(step.state.master_params[-1]),
+                           np.asarray(ps[-1].data))
+
+
+def test_fused_step_rejects_foreign_params():
+    model = _model()
+    other = _model()
+    opt = FusedSGD(list(other.parameters()), lr=0.05)
+    with pytest.raises(ValueError, match="not one of"):
+        make_train_step(model, opt, lambda o, y: F.cross_entropy(o, y))
+
+
+def test_fused_step_rejects_unsupported_optimizer():
+    from apex_tpu.parallel import LARC
+    model = _model()
+    opt = LARC(FusedSGD(list(model.parameters()), lr=0.05))
+    with pytest.raises(TypeError, match="supported:"):
+        make_train_step(model, opt, lambda o, y: F.cross_entropy(o, y))
+
+
+def test_fused_step_compile_time_recorded_and_bounded():
+    """Compile cost must be visible (VERDICT round 1: both gates died in
+    compile with no visibility) and small for a tiny model."""
+    model = _model()
+    opt = FusedSGD(list(model.parameters()), lr=0.05)
+    step = make_train_step(model, opt, lambda o, y: F.cross_entropy(o, y),
+                           half_dtype=jnp.bfloat16, loss_scale="dynamic")
+    assert step.compile_s is None
+    x, y = _data()
+    step(x, y)
+    assert step.compile_s is not None
+    assert step.compile_s < 60.0, (
+        f"tiny-model fused step took {step.compile_s:.1f}s to compile")
+
+
 def test_fused_step_ddp_on_mesh():
     """shard_map DP over the 8-device CPU mesh: replicated state, sharded
     batch; parity with single-device on the same global batch."""
