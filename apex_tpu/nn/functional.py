@@ -73,26 +73,33 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, ndim,
             isinstance(padding[0], int):
         padding = tuple((p, p) for p in padding)
     spec = _conv_dn(ndim)
-    dn = lax.conv_dimension_numbers(x.shape, weight.shape, spec)
     if transposed:
-        if groups != 1:
-            raise NotImplementedError(
-                "transposed convolution with groups != 1 is not supported "
-                "(lax.conv_transpose has no feature_group_count)")
+        # expressed as an input-dilated forward conv (lhs_dilation=stride),
+        # which unlike lax.conv_transpose supports feature groups.  torch
+        # transposed-conv weight is (C_in, C_out/g, *k); the equivalent
+        # forward conv needs (C_out, C_in/g, *k) with spatial flip: regroup
+        # (g, C_in/g, C_out/g) -> (g, C_out/g, C_in/g).
         if isinstance(output_padding, int):
             output_padding = (output_padding,) * ndim
-        pads = []
+        c_in, c_out_g = weight.shape[:2]
         k = weight.shape[2:]
+        w = weight.reshape((groups, c_in // groups, c_out_g) + k)
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((groups * c_out_g, c_in // groups) + k)
+        w = jnp.flip(w, axis=tuple(range(2, 2 + ndim)))
+        pads = []
         for i in range(ndim):
             eff_k = (k[i] - 1) * dilation[i] + 1
             lo = eff_k - 1 - padding[i][0]
             hi = eff_k - 1 - padding[i][1] + output_padding[i]
             pads.append((lo, hi))
-        y = lax.conv_transpose(
-            x, weight, strides=stride, padding=pads,
-            rhs_dilation=dilation, dimension_numbers=dn,
-            transpose_kernel=True)
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1,) * ndim, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
     else:
+        dn = lax.conv_dimension_numbers(x.shape, weight.shape, spec)
         y = lax.conv_general_dilated(
             x, weight, window_strides=stride, padding=padding,
             rhs_dilation=dilation, dimension_numbers=dn,
@@ -120,10 +127,8 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
 @_policied("conv_transpose2d")
 def conv_transpose2d(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1):
-    # torch transposed-conv kernel layout (in, out, kH, kW) is passed
-    # through unchanged: lax.conv_transpose(transpose_kernel=True) itself
-    # swaps I/O and flips the spatial dims (it computes the gradient of the
-    # forward conv whose OIHW kernel has O = our in_channels)
+    # torch transposed-conv kernel layout (in, out/g, kH, kW); _conv
+    # regroups/flips it into the equivalent input-dilated forward conv
     return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
                  transposed=True, output_padding=output_padding)
 
@@ -315,11 +320,32 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0):
     return (s / (kernel_size[0] * kernel_size[1])).astype(x.dtype)
 
 
+def _adaptive_pool_matrix(in_size, out_size):
+    """(out, in) row-stochastic averaging matrix with torch's adaptive
+    windows: bin i covers [floor(i*in/out), ceil((i+1)*in/out))."""
+    import numpy as np
+    m = np.zeros((out_size, in_size), np.float32)
+    for i in range(out_size):
+        s = (i * in_size) // out_size
+        e = -((-(i + 1) * in_size) // out_size)
+        m[i, s:e] = 1.0 / (e - s)
+    return jnp.asarray(m)
+
+
 def adaptive_avg_pool2d(x, output_size=(1, 1)):
-    if output_size not in ((1, 1), 1):
-        raise NotImplementedError("only global adaptive average pooling")
-    return jnp.mean(x.astype(jnp.float32), axis=(2, 3),
-                    keepdims=True).astype(x.dtype)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = x.shape[2], x.shape[3]
+    oh = h if output_size[0] is None else output_size[0]
+    ow = w if output_size[1] is None else output_size[1]
+    x32 = x.astype(jnp.float32)
+    if (oh, ow) == (1, 1):
+        return jnp.mean(x32, axis=(2, 3), keepdims=True).astype(x.dtype)
+    # non-uniform adaptive windows as two small matmuls (static shapes,
+    # MXU-friendly; uniform stride cases fuse to the same thing)
+    y = jnp.einsum("nchw,ph->ncpw", x32, _adaptive_pool_matrix(h, oh))
+    y = jnp.einsum("ncpw,qw->ncpq", y, _adaptive_pool_matrix(w, ow))
+    return y.astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------

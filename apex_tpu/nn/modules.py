@@ -333,15 +333,18 @@ class Conv3d(_ConvNd):
 
 class ConvTranspose2d(Module):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
-                 padding=0, output_padding=0, bias=True):
+                 padding=0, output_padding=0, groups=1, bias=True,
+                 dilation=1):
         super().__init__()
         if isinstance(kernel_size, int):
             kernel_size = (kernel_size, kernel_size)
         self.stride, self.padding = stride, padding
         self.output_padding = output_padding
+        self.groups, self.dilation = groups, dilation
         fan_in = in_channels * kernel_size[0] * kernel_size[1]
         self.weight = Parameter(_kaiming_uniform(
-            _next_key(), (in_channels, out_channels) + kernel_size, fan_in))
+            _next_key(), (in_channels, out_channels // groups) + kernel_size,
+            fan_in))
         if bias:
             bound = 1 / math.sqrt(fan_in)
             self.bias = Parameter(jax.random.uniform(
@@ -353,7 +356,8 @@ class ConvTranspose2d(Module):
         b = ctx.value(self.bias) if self.bias is not None else None
         return F.conv_transpose2d(
             x, ctx.value(self.weight), b, stride=self.stride,
-            padding=self.padding, output_padding=self.output_padding)
+            padding=self.padding, output_padding=self.output_padding,
+            groups=self.groups, dilation=self.dilation)
 
 
 class _BatchNorm(Module):

@@ -54,6 +54,24 @@ def save(path: str, events=None):
     return path
 
 
+def profile_step(fn, *args, trace_dir=None, executions: int = 3,
+                 with_backward: bool = True, analyze_output: bool = True):
+    """Measured profile of a jittable step: annotate, compile, execute under
+    ``jax.profiler.trace``, join thunk timings to ops through the HLO
+    metadata (parse/trace.py), and run the prof-stage models.
+
+    Returns ``(rows, report)``: rows carry both the analytic columns
+    (flops/bytes/roofline est_us) and measured ``meas_us``/achieved TFLOP/s;
+    report holds the join statistics."""
+    from .parse.trace import profile_step as _ps
+    rows, report = _ps(fn, *args, trace_dir=trace_dir,
+                       executions=executions, with_backward=with_backward)
+    if analyze_output:
+        from .prof.prof import analyze_rows
+        rows = analyze_rows(rows)
+    return rows, report
+
+
 def analyze(events=None, with_backward: bool = True):
     """events → analyzed rows (parse + prof stages fused, in process)."""
     from .parse.parse import enrich

@@ -181,8 +181,14 @@ def _wrap_fn(op_name, fn):
         st = _log()
         if not st.enabled:
             return fn(*args, **kwargs)
+        seq = len(st.events)
         _record(op_name, sig, args, kwargs)
-        with jax.named_scope(op_name):
+        # unique per-event label: survives into HLO metadata op_name
+        # (fwd "jvp(ppN_op)", bwd "transpose(jvp(ppN_op))"), which is what
+        # parse/trace.py joins measured thunk timings against — the
+        # nvvp.py:91-199 marker<->kernel correlation, done through HLO
+        # metadata instead of an NVTX SQL table
+        with jax.named_scope(f"pp{seq}_{op_name}"):
             return fn(*args, **kwargs)
     wrapper.__wrapped_pyprof__ = fn
     return wrapper

@@ -54,10 +54,32 @@ def main(argv=None):
     p.add_argument("file", help="event log written by apex_tpu.pyprof.save")
     p.add_argument("--no-backward", action="store_true",
                    help="forward ops only")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="jax.profiler.trace output dir: join measured thunk "
+                        "durations onto the rows (requires --hlo)")
+    p.add_argument("--hlo", default=None, metavar="FILE",
+                   help="compiled HLO text (jitted.lower(...).compile()"
+                        ".as_text()) for the scope<->instruction join")
+    p.add_argument("--executions", type=int, default=1,
+                   help="how many step executions the trace covers "
+                        "(durations are reported per execution)")
     args = p.parse_args(argv)
     with open(args.file) as f:
         events = [json.loads(line) for line in f if line.strip()]
-    for row in enrich(events, with_backward=not args.no_backward):
+    rows = enrich(events, with_backward=not args.no_backward)
+    if args.trace:
+        if not args.hlo:
+            p.error("--trace requires --hlo (the compiled program whose "
+                    "metadata carries the annotate scopes)")
+        from .trace import (correlate, load_thunk_events, merge_measurements,
+                            scope_map)
+        with open(args.hlo) as f:
+            smap = scope_map(f.read())
+        per_seq, unattributed = correlate(load_thunk_events(args.trace), smap)
+        rows = merge_measurements(rows, per_seq, executions=args.executions)
+        print(f"# matched {len(per_seq)} ops, "
+              f"unattributed {unattributed:.1f}us", file=sys.stderr)
+    for row in rows:
         sys.stdout.write(json.dumps(row) + "\n")
 
 

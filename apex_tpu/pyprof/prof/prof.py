@@ -29,6 +29,7 @@ def analyze_rows(rows, peak_tflops: float = V5E_BF16_TFLOPS,
         t_compute = flops / peak
         t_memory = bytes_ / (hbm_gbs * 1e9)
         est_us = max(t_compute, t_memory) * 1e6
+        dur = row.get("dur_us")
         out.append({
             **row,
             "flops": flops,
@@ -37,6 +38,12 @@ def analyze_rows(rows, peak_tflops: float = V5E_BF16_TFLOPS,
             "mxu": mxu,
             "bound": "compute" if t_compute >= t_memory else "memory",
             "est_us": round(est_us, 3),
+            # measured columns (present when parse joined a profiler trace):
+            # achieved TFLOP/s and fraction of the roofline estimate
+            "meas_us": dur,
+            "tflops": (round(flops / (dur * 1e-6) / 1e12, 3)
+                       if dur else None),
+            "eff": (round(est_us / dur, 3) if dur else None),
         })
     return out
 
@@ -47,26 +54,46 @@ def _shapes_str(row):
 
 def write_columnar(rows, file, top=None):
     from .output import Table
-    t = Table(["seq", "dir", "op", "scope", "shapes", "dtype", "flops",
-               "bytes", "AI", "MXU", "bound", "est_us"], file=file)
-    total_f = total_b = total_t = 0.0
+    measured = any(r.get("meas_us") is not None for r in rows)
+    cols = ["seq", "dir", "op", "scope", "shapes", "dtype", "flops",
+            "bytes", "AI", "MXU", "bound", "est_us"]
+    if measured:
+        cols += ["meas_us", "TFLOP/s"]
+    t = Table(cols, file=file)
+    total_f = total_b = total_t = total_m = 0.0
     body = rows if top is None else sorted(
-        rows, key=lambda r: -r["est_us"])[:top]
+        rows, key=lambda r: -(r["meas_us"] if measured and r.get("meas_us")
+                              else r["est_us"]))[:top]
     for r in body:
         mxu = r["mxu"]
-        t.row([r["seq"], r["dir"], r["op"], r.get("scope", ""),
-               _shapes_str(r), (r.get("dtypes") or ["-"])[0],
-               _human(r["flops"]), _human(r["bytes"]), r["ai"],
-               "-" if mxu is None else
-               f"{'Y' if mxu['eligible'] else 'n'}:{mxu['util']:.2f}",
-               r["bound"], r["est_us"]])
+        vals = [r["seq"], r["dir"], r["op"], r.get("scope", ""),
+                _shapes_str(r), (r.get("dtypes") or ["-"])[0],
+                _human(r["flops"]), _human(r["bytes"]), r["ai"],
+                "-" if mxu is None else
+                f"{'Y' if mxu['eligible'] else 'n'}:{mxu['util']:.2f}",
+                r["bound"], r["est_us"]]
+        if measured:
+            vals += [r.get("meas_us") if r.get("meas_us") is not None
+                     else "-",
+                     r.get("tflops") if r.get("tflops") is not None else "-"]
+        t.row(vals)
+    n_meas = 0
     for r in rows:
         total_f += r["flops"]
         total_b += r["bytes"]
         total_t += r["est_us"]
-    t.row(["", "", "TOTAL", "", "", "", _human(total_f), _human(total_b),
-           round(total_f / total_b, 2) if total_b else 0, "", "",
-           round(total_t, 1)])
+        if r.get("meas_us") is not None:
+            total_m += r["meas_us"]
+            n_meas += 1
+    totals = ["", "", "TOTAL", "", "", "", _human(total_f), _human(total_b),
+              round(total_f / total_b, 2) if total_b else 0, "", "",
+              round(total_t, 1)]
+    if measured:
+        # mark coverage so a partial join isn't read as "faster than
+        # roofline": meas total only spans the measured rows
+        cov = "" if n_meas == len(rows) else f" ({n_meas}/{len(rows)} rows)"
+        totals += [f"{round(total_m, 1)}{cov}", ""]
+    t.row(totals)
     t.flush()
 
 
@@ -83,14 +110,16 @@ def write_csv(rows, file):
     w = csv.writer(file)
     w.writerow(["seq", "dir", "op", "scope", "shapes", "dtype", "flops",
                 "bytes", "ai", "mxu_eligible", "mxu_util", "bound",
-                "est_us", "callsite"])
+                "est_us", "meas_us", "tflops", "eff", "callsite"])
     for r in rows:
         mxu = r["mxu"] or {}
         w.writerow([r["seq"], r["dir"], r["op"], r.get("scope", ""),
                     _shapes_str(r), (r.get("dtypes") or ["-"])[0],
                     r["flops"], r["bytes"], r["ai"],
                     mxu.get("eligible", ""), mxu.get("util", ""),
-                    r["bound"], r["est_us"], r.get("callsite") or ""])
+                    r["bound"], r["est_us"], r.get("meas_us", ""),
+                    r.get("tflops", ""), r.get("eff", ""),
+                    r.get("callsite") or ""])
 
 
 def main(argv=None):

@@ -20,10 +20,35 @@ def test_conv_transpose2d_matches_torch(rng):
                                    rtol=1e-4, atol=1e-5)
 
 
-def test_conv_transpose2d_groups_rejected(rng):
-    import pytest
+def test_conv_transpose2d_groups_matches_torch(rng):
+    """Grouped transposed conv (a round-1 NotImplementedError hole)."""
+    import torch
     from apex_tpu.nn import functional as F
-    x = jnp.asarray(rng.standard_normal((2, 4, 5, 5)), jnp.float32)
-    w = jnp.asarray(rng.standard_normal((4, 3, 3, 3)), jnp.float32)
-    with pytest.raises(NotImplementedError, match="groups"):
-        F.conv_transpose2d(x, w, groups=2)
+    x = rng.standard_normal((2, 4, 5, 5)).astype(np.float32)
+    w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)  # g=2: 4->6
+    b = rng.standard_normal((6,)).astype(np.float32)
+    for stride, pad, opad, dil in [(2, 1, 1, 1), (1, 0, 0, 1), (2, 1, 0, 2)]:
+        ours = F.conv_transpose2d(jnp.asarray(x), jnp.asarray(w),
+                                  jnp.asarray(b), stride=stride, padding=pad,
+                                  output_padding=opad, groups=2,
+                                  dilation=dil)
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b),
+            stride=stride, padding=pad, output_padding=opad, groups=2,
+            dilation=dil)
+        assert ours.shape == tuple(ref.shape)
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_adaptive_avg_pool2d_matches_torch(rng):
+    """Arbitrary (incl. non-divisible) output sizes (round-1: global only)."""
+    import torch
+    from apex_tpu.nn import functional as F
+    x = rng.standard_normal((2, 3, 11, 7)).astype(np.float32)
+    for out in [(1, 1), (4, 4), (5, 3), (11, 7), (3, 5), 2]:
+        ours = F.adaptive_avg_pool2d(jnp.asarray(x), out)
+        ref = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), out)
+        assert ours.shape == tuple(ref.shape)
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
