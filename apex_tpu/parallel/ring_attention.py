@@ -10,9 +10,12 @@ sequence-parallel schemes over a mesh axis, both designed around ICI:
   into a numerically-stable online-softmax accumulator (running logsumexp
   merge, the same math as the Pallas flash kernel's k-sweep in
   apex_tpu/ops/pallas/attention.py, lifted one level up to the mesh).  The
-  loop is unrolled over the (static) axis size so XLA's latency-hiding
-  scheduler overlaps each step's ppermute with the previous step's block
-  compute — the ring-attention trick, no hand-rolled double buffering.
+  loop is unrolled over the (static) axis size for rings up to
+  ``UNROLL_LIMIT`` (env ``APEX_TPU_RING_UNROLL_LIMIT``, default 8) so XLA's
+  latency-hiding scheduler overlaps each step's ppermute with the previous
+  step's block compute — the ring-attention trick, no hand-rolled double
+  buffering.  Larger rings fall back to ``lax.fori_loop`` to keep the HLO
+  O(1) per pass (an unrolled 256-ring would emit O(n^2) comm ops).
   Memory per device is O(S_local); sequence length scales linearly with the
   ring size.  The backward is a second ring pass in which dK/dV accumulators
   travel *with* their K/V blocks; after a full cycle each lands back on the
@@ -36,6 +39,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp

@@ -128,6 +128,14 @@ def run_kernel_checks():
     results = {"mode": mode}
     rng = np.random.default_rng(0)
 
+    # Pin matmuls to f32-exact (6-pass) so the comparison isolates kernel
+    # correctness from MXU bf16 rounding: under default precision the Pallas
+    # and jnp paths each do bf16-blocked matmuls with different blockings and
+    # legitimately disagree at ~1e-3.  Production runs keep default (fast)
+    # precision; this context only governs the parity check.
+    def prec():
+        return jax.default_matmul_precision("highest")
+
     # --- fused layer norm fwd + bwd ---
     try:
         from apex_tpu.normalization import fused_layer_norm_affine
@@ -138,10 +146,10 @@ def run_kernel_checks():
         def loss(x, w, b):
             return jnp.sum(fused_layer_norm_affine(x, w, b, (512,)) ** 2)
 
-        with pal.force_mode(mode):
+        with prec(), pal.force_mode(mode):
             out_k = fused_layer_norm_affine(x, w, b, (512,))
             g_k = jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
-        with pal.force_mode("off"):
+        with prec(), pal.force_mode("off"):
             out_r = fused_layer_norm_affine(x, w, b, (512,))
             g_r = jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
         err = max(_rel_err(out_k, out_r),
@@ -162,10 +170,10 @@ def run_kernel_checks():
         def loss(q, k, v):
             return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
 
-        with pal.force_mode(mode):
+        with prec(), pal.force_mode(mode):
             out_k = flash_attention(q, k, v, causal=True)
             g_k = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-        with pal.force_mode("off"):
+        with prec(), pal.force_mode("off"):
             out_r = flash_attention(q, k, v, causal=True)
             g_r = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
         err = max(_rel_err(out_k, out_r),

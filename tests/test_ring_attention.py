@@ -160,3 +160,70 @@ def test_ring_bf16_tolerance(rng):
     assert out.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
                                rtol=0.05, atol=0.05)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_pallas_interpret_grads(rng, causal):
+    """Gradients with the Pallas flash kernels (interpreted) per chunk:
+    covers the _ring_vjp_bwd -> flash_attention_bwd path (global lse/out,
+    rotating dk/dv accumulators) that the jnp fallback tests miss."""
+    from apex_tpu.ops.pallas import force_mode
+    mesh = _mesh(4)
+    q, k, v = _inputs(rng)
+    w = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    scale = 1.0 / np.sqrt(D)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, None, causal, scale) * w)
+
+    def ring_loss(q, k, v):
+        fn = functools.partial(ring_attention, axis_name="sp", causal=causal)
+        shard = jax.shard_map(fn, mesh=mesh,
+                              in_specs=P(None, None, "sp", None),
+                              out_specs=P(None, None, "sp", None),
+                              check_vma=False)
+        return jnp.sum(shard(q, k, v) * w)
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    with force_mode("interpret"):
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_fori_loop_path(rng, causal, monkeypatch):
+    """Large-ring fallback: with UNROLL_LIMIT forced to 0 the fwd and bwd
+    ring loops run as lax.fori_loop (O(1) HLO per pass) and must match the
+    reference exactly like the unrolled path does."""
+    import importlib
+    ra_mod = importlib.import_module("apex_tpu.parallel.ring_attention")
+    monkeypatch.setattr(ra_mod, "UNROLL_LIMIT", 0)
+    mesh = _mesh(8)
+    q, k, v = _inputs(rng)
+    w = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    scale = 1.0 / np.sqrt(D)
+    ref = attention_reference(q, k, v, None, causal, scale)
+    out = _run_sharded(
+        functools.partial(ring_attention, axis_name="sp", causal=causal),
+        mesh, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, None, causal, scale) * w)
+
+    def ring_loss(q, k, v):
+        fn = functools.partial(ring_attention, axis_name="sp", causal=causal)
+        shard = jax.shard_map(fn, mesh=mesh,
+                              in_specs=P(None, None, "sp", None),
+                              out_specs=P(None, None, "sp", None),
+                              check_vma=False)
+        return jnp.sum(shard(q, k, v) * w)
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
