@@ -12,7 +12,8 @@ from .policy import (  # noqa: F401
     CastPolicy, apply_op_policy, autocast, current_policy, disable_casts,
     float_function, half_function, promote_function, register_float_function,
     register_half_function, register_promote_function)
-from .handle import scale_loss  # noqa: F401
+from .handle import AmpHandle, NoOpHandle, init, scale_loss  # noqa: F401
+from .opt import OptimWrapper  # noqa: F401
 from .scaler import (  # noqa: F401
     LossScaler, ScalerState, init_scaler_state, unscale_grads,
     unscale_with_stashed_grads, update_scale_state)

@@ -97,3 +97,125 @@ def scale_loss(loss, optimizers, loss_id=0, model=None, delay_unscale=False,
 
 # Free-function cast-disable scope (reference handle.py:163-167).
 disable_casts = _policy.disable_casts
+
+
+class AmpHandle:
+    """Legacy old-API handle (reference handle.py:170-252), returned by
+    :func:`init`.  Activation = installing an ambient O1 CastPolicy (the
+    trace-time analogue of the reference's global torch patching); the
+    cast-cache plumbing (``has_cache``/``cache``/``remove_cache``) is kept
+    for API parity but is inert — there is no weight-cast cache to
+    invalidate at trace time.
+    """
+
+    def __init__(self, loss_scale="dynamic", enable_caching=True,
+                 verbose=False, allow_banned=False):
+        from .frontend import get_default_half_dtype
+        from .scaler import LossScaler
+        self._enable_caching = enable_caching
+        self._verbose = verbose
+        self._cache = {}
+        self._loss_scale = loss_scale
+        self._default_scaler = LossScaler(loss_scale)
+        self._is_active = True
+        self._policy = _policy.CastPolicy(
+            half_dtype=get_default_half_dtype(), enabled=True,
+            allow_banned=allow_banned, verbose=verbose)
+        _policy.replay_registrations(self._policy)
+        _amp_state.handle = self._policy
+        _amp_state.ambient_policy = self._policy
+
+    def is_active(self):
+        return self._is_active and _amp_state.ambient_policy is self._policy
+
+    @contextlib.contextmanager
+    def _disable_casts(self):
+        self._is_active = False
+        try:
+            with _policy.disable_casts():
+                yield
+        finally:
+            self._is_active = True
+
+    def wrap_optimizer(self, optimizer, num_loss=1):
+        from .opt import OptimWrapper
+        self._default_scaler = None
+        return OptimWrapper(optimizer, self, num_loss,
+                            loss_scale=self._loss_scale)
+
+    def scale_loss(self, loss, optimizer):
+        raise RuntimeError(
+            "The old Amp API's handle.scale_loss is no longer supported.  "
+            "Use handle.wrap_optimizer(optimizer).scale_loss(loss), or move "
+            "to the amp.initialize API.")
+
+    def _clear_cache(self):
+        self._cache.clear()
+
+    def _deactivate(self):
+        """Uninstall the ambient policy (reference handle.py:233-236
+        restores the patched torch functions)."""
+        if _amp_state.ambient_policy is self._policy:
+            _amp_state.ambient_policy = None
+            _amp_state.handle = None
+
+    @property
+    def has_cache(self):
+        return self._enable_caching
+
+    @property
+    def cache(self):
+        return self._cache
+
+    def remove_cache(self, param):
+        if self.has_cache and param in self.cache:
+            del self.cache[param]
+
+    @property
+    def verbose(self):
+        return self._verbose
+
+
+class NoOpHandle:
+    """Returned by ``init(enabled=False)`` (reference handle.py:254-281)."""
+
+    def is_active(self):
+        return False
+
+    @contextlib.contextmanager
+    def _disable_casts(self):
+        yield
+
+    def wrap_optimizer(self, optimizer, num_loss=1):
+        from .opt import OptimWrapper
+        return OptimWrapper(optimizer, self, num_loss)
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss, optimizer):
+        yield loss
+
+    @property
+    def has_cache(self):
+        return False
+
+    @property
+    def verbose(self):
+        return False
+
+    def _clear_cache(self):
+        pass
+
+    def _deactivate(self):
+        pass
+
+
+def init(enabled=True, loss_scale="dynamic", enable_caching=True,
+         verbose=False, allow_banned=False):
+    """Legacy old-API entry point (reference amp.py:68-177): returns a
+    handle whose construction activates autocasting globally.  The modern
+    path is ``amp.initialize``; this exists for scripts written against the
+    pre-initialize API (``handle.wrap_optimizer`` + per-loss scalers).
+    """
+    if not enabled:
+        return NoOpHandle()
+    return AmpHandle(loss_scale, enable_caching, verbose, allow_banned)

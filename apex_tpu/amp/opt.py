@@ -1,0 +1,113 @@
+"""Legacy old-API optimizer wrapper (reference: apex/amp/opt.py:9-103).
+
+``handle = amp.init(...); optimizer = handle.wrap_optimizer(opt, num_loss=N)``
+— the pre-``amp.initialize`` multi-loss API.  Each loss gets its own dynamic
+scaler; ``with optimizer.scale_loss(loss) as scaled: scaled.backward()`` per
+loss, then one ``optimizer.step()`` which is skipped if ANY loss overflowed.
+
+Mechanics differ from the reference only where the array model forces it:
+grads are immutable jnp arrays hanging off ``Parameter.grad`` (filled by the
+tape's ``backward``), so "save out current grad accumulation" is a list copy
+of references rather than ``.detach().clone()``, and the in-place unscale is
+a functional rebind of ``p.grad``.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ._amp_state import master_params, maybe_print
+from .scaler import LossScaler
+
+
+class OptimWrapper:
+    def __init__(self, optimizer, amp_handle, num_loss, loss_scale="dynamic"):
+        self._optimizer = optimizer
+        self._amp_handle = amp_handle
+        self._num_loss = num_loss
+        self._loss_idx = 0
+        self._skip_next = [False] * num_loss
+        # per-loss scalers honor the handle's loss_scale (the reference
+        # hardcodes 'dynamic' here, opt.py:16, silently ignoring a static
+        # scale passed to amp.init)
+        self._loss_scaler = [LossScaler(loss_scale) for _ in range(num_loss)]
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss):
+        if not self._amp_handle.is_active():
+            yield loss
+            return
+
+        # With multiple losses per optimizer the running grad accumulation
+        # must be saved out before this loss's backward: once the grads mix
+        # we can no longer unscale this particular loss
+        # (reference opt.py:24-35).
+        cached_grads = []
+        if self._loss_idx > 0:
+            for p in master_params(self._optimizer):
+                cached_grads.append(p.grad)
+                p.grad = None
+
+        loss_scale = self._cur_loss_scaler().loss_scale()
+        yield loss.float() * loss_scale
+
+        self._cur_loss_scaler().clear_overflow_state()
+        params = [p for p in master_params(self._optimizer)]
+        live = [p for p in params if p.grad is not None]
+        if live:
+            new_grads = self._cur_loss_scaler().unscale(
+                [p.grad for p in live], [p.grad for p in live],
+                loss_scale, models_are_masters=True)
+            for p, g in zip(live, new_grads):
+                p.grad = g
+        self._skip_next[self._loss_idx] = \
+            self._cur_loss_scaler().update_scale()
+        self._loss_idx += 1
+
+        if len(cached_grads) > 0:
+            for p, cached in zip(params, cached_grads):
+                if cached is not None:
+                    p.grad = cached if p.grad is None else p.grad + cached
+
+    def _cur_loss_scaler(self):
+        assert 0 <= self._loss_idx < self._num_loss
+        return self._loss_scaler[self._loss_idx]
+
+    def step(self, closure=None):
+        if not self._amp_handle.is_active():
+            return self._optimizer.step(closure=closure)
+
+        self._loss_idx = 0
+
+        for group in self._optimizer.param_groups:
+            for p in group["params"]:
+                self._amp_handle.remove_cache(p)
+
+        if closure is not None:
+            raise NotImplementedError(
+                "The `closure` argument is unsupported by the amp "
+                "optimizer wrapper.")
+        if any(self._skip_next):
+            maybe_print("Gradient overflow, skipping update")
+            self._skip_next = [False] * self._num_loss
+        else:
+            return self._optimizer.step()
+
+    # Forward any attribute lookups to the wrapped optimizer
+    # (reference opt.py:79-103).
+    def __getattr__(self, attr):
+        return getattr(self._optimizer, attr)
+
+    def __repr__(self):
+        return self._optimizer.__repr__()
+
+    def state_dict(self):
+        return self._optimizer.state_dict()
+
+    def load_state_dict(self, state_dict):
+        return self._optimizer.load_state_dict(state_dict)
+
+    def zero_grad(self):
+        return self._optimizer.zero_grad()
+
+    def add_param_group(self, param_group):
+        return self._optimizer.add_param_group(param_group)

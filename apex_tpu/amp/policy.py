@@ -136,6 +136,13 @@ def current_policy() -> Optional[CastPolicy]:
     return _policy_stack[-1] if _policy_stack else None
 
 
+def casts_disabled() -> bool:
+    """True inside an explicit ``disable_casts`` scope (stack top is None).
+    Distinct from an *empty* stack (no scope at all): the ambient-policy
+    fallback must honor the former but not the latter."""
+    return bool(_policy_stack) and _policy_stack[-1] is None
+
+
 @contextlib.contextmanager
 def autocast(policy: Optional[CastPolicy]):
     """Activate ``policy`` for the duration (used by amp-initialized model

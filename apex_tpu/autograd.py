@@ -306,6 +306,11 @@ def _amp_tags(module):
     pol = getattr(module, "_amp_policy", None)
     if pol is None and in_cast is None:
         pol = _amp_state.ambient_policy
+    # an explicit disable_casts scope beats both the module tag and the
+    # ambient fallback (reference: handle inactive -> wrappers passthrough);
+    # O2's input/output dtype casts are part of the patched forward and stay
+    if pol is not None and _policy.casts_disabled():
+        pol = None
     return in_cast, out_cast, pol
 
 

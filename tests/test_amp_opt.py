@@ -1,0 +1,199 @@
+"""Legacy old-API handle + OptimWrapper (reference apex/amp/opt.py:9-103,
+handle.py:170-281): amp.init() -> handle.wrap_optimizer(opt, num_loss=N),
+per-loss dynamic scalers, grad caching across multiple losses, any-loss
+overflow skipping the shared step."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu import amp
+from apex_tpu.amp.opt import OptimWrapper
+from apex_tpu.optimizers import FusedSGD
+
+
+@pytest.fixture(autouse=True)
+def _fresh_amp_state():
+    from apex_tpu.amp._amp_state import reset
+    reset()
+    yield
+    reset()
+
+
+def _model():
+    nn.manual_seed(7)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (8,)))
+    return x, y
+
+
+def test_wrap_optimizer_trains():
+    handle = amp.init(verbose=False)
+    model = _model()
+    opt = handle.wrap_optimizer(FusedSGD(list(model.parameters()), lr=0.1))
+    assert isinstance(opt, OptimWrapper)
+    crit = nn.CrossEntropyLoss()
+    x, y = _data()
+    losses = []
+    for _ in range(5):
+        out = model(x)
+        loss = crit(out, y)
+        with opt.scale_loss(loss) as scaled:
+            scaled.backward()
+        opt.step()
+        opt.zero_grad()
+        losses.append(float(loss))
+    handle._deactivate()
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_scale_loss_scales_by_scaler():
+    handle = amp.init()
+    model = _model()
+    opt = handle.wrap_optimizer(FusedSGD(list(model.parameters()), lr=0.1))
+    crit = nn.CrossEntropyLoss()
+    x, y = _data()
+    loss = crit(model(x), y)
+    with opt.scale_loss(loss) as scaled:
+        np.testing.assert_allclose(float(scaled), float(loss) * 2.0 ** 16,
+                                   rtol=1e-6)
+        scaled.backward()
+    handle._deactivate()
+
+
+def test_multi_loss_grads_accumulate():
+    """Two losses through num_loss=2 must equal the grads of (loss1+loss2)
+    computed without amp — the cache/restore path of opt.py:24-53."""
+    handle = amp.init()
+    model = _model()
+    params = list(model.parameters())
+    opt = handle.wrap_optimizer(FusedSGD(params, lr=0.1), num_loss=2)
+    crit = nn.CrossEntropyLoss()
+    x1, y1 = _data(1)
+    x2, y2 = _data(2)
+
+    with opt.scale_loss(crit(model(x1), y1)) as scaled:
+        scaled.backward()
+    with opt.scale_loss(crit(model(x2), y2)) as scaled:
+        scaled.backward()
+    amp_grads = [p.grad for p in params]
+    opt.zero_grad()
+    handle._deactivate()
+
+    # reference grads, no amp in the picture
+    model2 = _model()
+    params2 = list(model2.parameters())
+    loss = nn.CrossEntropyLoss()(model2(x1), y1) \
+        + nn.CrossEntropyLoss()(model2(x2), y2)
+    loss.backward()
+    # the amp path runs the model in fp16 under the ambient policy; the
+    # oracle is fp32, so tolerances are fp16-sized
+    for a, b in zip(amp_grads, [p.grad for p in params2]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=3e-4)
+
+
+def test_overflow_skips_step_and_halves_scale():
+    handle = amp.init()
+    model = _model()
+    params = list(model.parameters())
+    opt = handle.wrap_optimizer(FusedSGD(params, lr=0.1))
+    crit = nn.CrossEntropyLoss()
+    x, y = _data()
+    before = [np.asarray(p.data) for p in params]
+    scale0 = opt._loss_scaler[0].loss_scale()
+
+    loss = crit(model(x), y) * 1.0e38  # scaled grads overflow to inf
+    with opt.scale_loss(loss) as scaled:
+        scaled.backward()
+    assert opt._skip_next[0] is True
+    opt.step()          # must be skipped
+    opt.zero_grad()
+    handle._deactivate()
+
+    for p, b in zip(params, before):
+        np.testing.assert_array_equal(np.asarray(p.data), b)
+    assert opt._loss_scaler[0].loss_scale() == scale0 / 2.0
+    assert opt._skip_next[0] is False  # reset by step()
+
+
+def test_disabled_handle_is_passthrough():
+    handle = amp.init(enabled=False)
+    assert not handle.is_active()
+    model = _model()
+    opt = handle.wrap_optimizer(FusedSGD(list(model.parameters()), lr=0.1))
+    crit = nn.CrossEntropyLoss()
+    x, y = _data()
+    loss = crit(model(x), y)
+    with opt.scale_loss(loss) as scaled:
+        assert scaled is loss
+        scaled.backward()
+    opt.step()
+
+
+def test_attribute_forwarding():
+    handle = amp.init(enabled=False)
+    inner = FusedSGD([nn.Parameter(jnp.zeros((2, 2)))], lr=0.25)
+    opt = handle.wrap_optimizer(inner)
+    assert opt.param_groups is inner.param_groups
+    assert opt.param_groups[0]["lr"] == 0.25
+
+
+def test_closure_rejected():
+    handle = amp.init()
+    opt = handle.wrap_optimizer(
+        FusedSGD([nn.Parameter(jnp.zeros((2, 2)))], lr=0.1))
+    with pytest.raises(NotImplementedError):
+        opt.step(closure=lambda: None)
+    handle._deactivate()
+
+
+def test_disable_casts_suppresses_ambient_policy():
+    """Inside handle._disable_casts (and the free amp.disable_casts) module
+    forwards must NOT be cast by the ambient O1 policy."""
+    handle = amp.init()
+    model = _model()
+    x, _ = _data()
+    out = model(x)
+    assert out.dtype == jnp.float16  # ambient policy casts the linears
+    with handle._disable_casts():
+        out_fp32 = model(x)
+    assert out_fp32.dtype == jnp.float32
+    with amp.disable_casts():
+        out_fp32 = model(x)
+    assert out_fp32.dtype == jnp.float32
+    out = model(x)
+    assert out.dtype == jnp.float16  # restored after the scopes
+    handle._deactivate()
+
+
+def test_disable_casts_exception_safe():
+    handle = amp.init()
+    with pytest.raises(ValueError):
+        with handle._disable_casts():
+            raise ValueError("boom")
+    assert handle.is_active()
+    handle._deactivate()
+
+
+def test_static_loss_scale_threads_through():
+    handle = amp.init(loss_scale=128.0)
+    model = _model()
+    opt = handle.wrap_optimizer(FusedSGD(list(model.parameters()), lr=0.1))
+    assert opt._loss_scaler[0].dynamic is False
+    assert opt._loss_scaler[0].loss_scale() == 128.0
+    crit = nn.CrossEntropyLoss()
+    x, y = _data()
+    loss = crit(model(x), y)
+    with opt.scale_loss(loss) as scaled:
+        np.testing.assert_allclose(float(scaled), float(loss) * 128.0,
+                                   rtol=1e-6)
+        scaled.backward()
+    opt.step()
+    handle._deactivate()
