@@ -252,6 +252,10 @@ def lift(x) -> Tensor:
         return x
     if isinstance(x, Parameter):
         return Tensor(x.data, "param", static=(), module=x)
+    if x is None:
+        # optional array-slot left empty (e.g. attention_mask=None passed
+        # positionally); replays as a literal None, takes no gradient
+        return Tensor(None, "none")
     return Tensor(jnp.asarray(x), "const")
 
 
@@ -396,7 +400,9 @@ def _linearize(root: Tensor) -> _Program:
     def visit(t: Tensor) -> int:
         if id(t) in index:
             return index[id(t)]
-        if t.op == "const":
+        if t.op == "none":
+            instructions.append(("none",))
+        elif t.op == "const":
             instructions.append(("const", len(consts)))
             consts.append(t.value)
         elif t.op == "param":
@@ -450,7 +456,9 @@ def _execute(program: _Program, param_vals, const_vals, key_vals):
     results: List[Any] = []
     for ins in program.instructions:
         kind = ins[0]
-        if kind == "const":
+        if kind == "none":
+            results.append(None)
+        elif kind == "const":
             results.append(const_vals[ins[1]])
         elif kind == "param":
             results.append(param_vals[ins[1]])

@@ -1,0 +1,134 @@
+"""BERT encoder family — the transformer model for BASELINE.md config 4
+(BERT-base pretrain with FusedLAMB + FusedLayerNorm under amp O2).
+
+The reference repo carries no BERT model of its own (it provides the pieces —
+FusedLAMB, FusedLayerNorm, fast_self_multihead_attn — that NVIDIA's BERT
+scripts consume), so this is the standalone equivalent: a post-LN BERT
+encoder built from this framework's fused components:
+
+* ``SelfMultiheadAttn(impl="fast")`` — the Pallas flash-attention path
+  (apex_tpu/contrib/multihead_attn/), the fast_* extension analogue;
+* ``FusedLayerNorm`` — Pallas LN with fp32 statistics;
+* GELU feed-forward sized ``4*hidden`` (XLA fuses matmul+bias+gelu).
+
+Layout: the public API is batch-first ``(B, S)`` token ids like BERT
+checkpoints expect; internally the encoder runs ``(S, B, E)`` to feed the
+attention module's reference layout.  The masked-LM head ties its decoder to
+the token embedding matrix.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..normalization import FusedLayerNorm
+from ..contrib.multihead_attn import SelfMultiheadAttn
+
+
+class BertLayer(nn.Module):
+    """One post-LN encoder block: MHA + residual + LN, GELU FFN + residual
+    + LN."""
+
+    def __init__(self, hidden, heads, intermediate, dropout=0.1,
+                 attn_dropout=0.1):
+        super().__init__()
+        self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
+                                      impl="fast")
+        self.attn_ln = FusedLayerNorm(hidden)
+        self.fc1 = nn.Linear(hidden, intermediate)
+        self.fc2 = nn.Linear(intermediate, hidden)
+        self.out_ln = FusedLayerNorm(hidden)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, ctx, x, key_padding_mask=None):
+        h, _ = self.attn.forward(ctx, x, key_padding_mask=key_padding_mask)
+        x = self.attn_ln.forward(ctx, x + self.dropout.forward(ctx, h))
+        h = F.gelu(self.fc1.forward(ctx, x))
+        h = self.fc2.forward(ctx, h)
+        x = self.out_ln.forward(ctx, x + self.dropout.forward(ctx, h))
+        return x
+
+
+class BertModel(nn.Module):
+    """Token/position/segment embeddings + N encoder layers.
+
+    ``forward(input_ids[B,S], token_type_ids=None, attention_mask=None)``
+    returns the sequence output ``(B, S, H)``.  ``attention_mask`` follows
+    the BERT convention: 1 for real tokens, 0 for padding.
+    """
+
+    def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
+                 intermediate=3072, max_positions=512, type_vocab=2,
+                 dropout=0.1, attn_dropout=0.1):
+        super().__init__()
+        self.hidden = hidden
+        self.tok_emb = nn.Embedding(vocab_size, hidden)
+        self.pos_emb = nn.Embedding(max_positions, hidden)
+        self.type_emb = nn.Embedding(type_vocab, hidden)
+        # BERT initializer_range=0.02; nn.Embedding draws std-1 normals, and
+        # through the tied MLM decoder std-1 embeddings give logits of std
+        # ~sqrt(hidden) (useless initial loss)
+        for emb in (self.tok_emb, self.pos_emb, self.type_emb):
+            emb.weight.data = emb.weight.data * 0.02
+        self.emb_ln = FusedLayerNorm(hidden)
+        self.emb_drop = nn.Dropout(dropout)
+        self.layers = nn.ModuleList([
+            BertLayer(hidden, heads, intermediate, dropout, attn_dropout)
+            for _ in range(layers)])
+
+    def forward(self, ctx, input_ids, token_type_ids=None,
+                attention_mask=None):
+        b, s = input_ids.shape
+        pos = jnp.arange(s, dtype=jnp.int32)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (self.tok_emb.forward(ctx, input_ids)
+             + self.pos_emb.forward(ctx, pos)
+             + self.type_emb.forward(ctx, token_type_ids))
+        x = self.emb_drop.forward(ctx, self.emb_ln.forward(ctx, x))
+        # encoder runs (S, B, E); attention's key_padding_mask is (B, S)
+        # additive-bool with True = masked, so invert the BERT convention
+        x = jnp.swapaxes(x, 0, 1)
+        kpm = None
+        if attention_mask is not None:
+            kpm = (attention_mask == 0)
+        for layer in self.layers:
+            x = layer.forward(ctx, x, key_padding_mask=kpm)
+        return jnp.swapaxes(x, 0, 1)
+
+
+class BertForMaskedLM(nn.Module):
+    """BertModel + MLM transform head with the decoder tied to the token
+    embedding (standard BERT pretraining head)."""
+
+    def __init__(self, **kw):
+        super().__init__()
+        self.bert = BertModel(**kw)
+        hidden = self.bert.hidden
+        self.transform = nn.Linear(hidden, hidden)
+        self.transform_ln = FusedLayerNorm(hidden)
+        vocab = self.bert.tok_emb.weight.shape[0]
+        self.decoder_bias = nn.Parameter(jnp.zeros((vocab,), jnp.float32))
+
+    def forward(self, ctx, input_ids, token_type_ids=None,
+                attention_mask=None):
+        seq = self.bert.forward(ctx, input_ids, token_type_ids,
+                                attention_mask)
+        h = F.gelu(self.transform.forward(ctx, seq))
+        h = self.transform_ln.forward(ctx, h)
+        emb = ctx.value(self.bert.tok_emb.weight)
+        logits = jnp.matmul(h, jnp.swapaxes(emb, 0, 1).astype(h.dtype))
+        return logits + ctx.value(self.decoder_bias).astype(logits.dtype)
+
+
+def bert_base(**kw):
+    """BERT-base: 12 layers, hidden 768, 12 heads (110M params)."""
+    return BertForMaskedLM(**{**dict(hidden=768, layers=12, heads=12,
+                                     intermediate=3072), **kw})
+
+
+def bert_large(**kw):
+    """BERT-large: 24 layers, hidden 1024, 16 heads (340M params)."""
+    return BertForMaskedLM(**{**dict(hidden=1024, layers=24, heads=16,
+                                     intermediate=4096), **kw})

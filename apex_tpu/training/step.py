@@ -89,7 +89,8 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     axis_name: Optional[str] = None,
                     gradient_predivide_factor: float = 1.0,
                     allreduce_always_fp32: bool = False,
-                    donate_state: bool = True):
+                    donate_state: bool = True,
+                    rng_seed: int = 0):
     """Build a fully-fused O2-style train step.
 
     ``loss_fn(outputs..., *batch_tail) -> scalar``: called with the model
@@ -284,8 +285,15 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             env = {id(p): v for p, v in zip(params, model_vals_in)}
             stats_env = {id(bf): v for bf, v in zip(buffers, state.stats)}
             stats_out = {}
+            # per-step dropout randomness, derived from the step counter so
+            # the state shape stays fixed (and steps are reproducible);
+            # under DP also fold in the replica index so shards draw
+            # independent masks (matching per-device RNG in the reference)
+            key = jax.random.fold_in(jax.random.PRNGKey(rng_seed), state.step)
+            if axis_name is not None:
+                key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
             ctx = Ctx(env={**env, **stats_env}, stats_out=stats_out,
-                      training=True)
+                      training=True, key=key)
             x = b[0]
             if half_dtype is not None and jnp.issubdtype(x.dtype,
                                                          jnp.floating):

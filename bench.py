@@ -197,8 +197,93 @@ def run_kernel_checks():
     return results
 
 
+def time_compiled_step(step, batch_arrays, iters, warmup, analytic_flops):
+    """Compile + time a fused train step: returns (dt, compile_s, flops,
+    flops_source).  FLOPs come from XLA cost analysis with
+    ``analytic_flops()`` as the fallback."""
+    import jax.numpy as jnp
+
+    tc = time.perf_counter()
+    compiled = step._step_fn.lower(step.state, *batch_arrays).compile()
+    compile_s = time.perf_counter() - tc
+    log(f"compiled in {compile_s:.1f}s")
+
+    flops, flops_source = None, "none"
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        if ca and ca.get("flops", 0) > 0:
+            flops, flops_source = float(ca["flops"]), "xla_cost_analysis"
+    except Exception as e:
+        log(f"cost_analysis unavailable: {e}")
+    if flops is None:
+        flops, flops_source = analytic_flops(), "analytic"
+
+    stage("warmup", f"{warmup} iters")
+    state = step.state
+    for _ in range(warmup):
+        state, loss = compiled(state, *batch_arrays)
+    # NOTE: jax.block_until_ready is a no-op on the experimental axon
+    # platform — only an actual device->host fetch synchronizes, so sync
+    # against a scalar fetch that data-depends on the whole step chain.
+    float(jnp.sum(state.master_params[0]))
+    log(f"warm, loss={float(loss):.4f}")
+
+    stage("timing", f"{iters} iters")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = compiled(state, *batch_arrays)
+    float(jnp.sum(state.master_params[0]))
+    dt = (time.perf_counter() - t0) / iters
+    return dt, compile_s, flops, flops_source
+
+
+def run_bert_throughput(batch, seq_len, iters, warmup):
+    """BASELINE.md config 4: BERT-base pretrain (masked-LM) with FusedLAMB +
+    FusedLayerNorm + Pallas flash attention under the bf16 fused step."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.models import bert_base
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.training import make_train_step
+
+    stage("model_build", f"bert_base batch={batch} seq={seq_len}")
+    nn.manual_seed(0)
+    vocab = 30522
+    model = bert_base(max_positions=seq_len)
+    opt = FusedLAMB(list(model.parameters()), lr=1e-3, weight_decay=0.01)
+
+    def mlm_loss(logits, labels):
+        # standard MLM: only ~15% of positions carry labels (-100 = ignore)
+        flat = logits.reshape((-1, vocab))
+        lab = labels.reshape((-1,))
+        mask = (lab >= 0).astype(jnp.float32)
+        lab_safe = jnp.maximum(lab, 0)
+        losses = F.cross_entropy(flat, lab_safe, reduction="none")
+        return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    step = make_train_step(model, opt, mlm_loss,
+                           half_dtype=jnp.bfloat16, loss_scale=1.0)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq_len)))
+    labels = np.full((batch, seq_len), -100, np.int32)
+    pick = rng.random((batch, seq_len)) < 0.15
+    labels[pick] = rng.integers(0, vocab, int(pick.sum()))
+    labels = jnp.asarray(labels)
+
+    stage("compile", f"bert batch={batch}")
+    # 6 * params * tokens per fwd+bwd step (the standard transformer
+    # estimate), params ~110M
+    return time_compiled_step(step, (ids, labels), iters, warmup,
+                              lambda: 6.0 * 110e6 * batch * seq_len)
+
+
 def run_throughput(batch, iters, warmup):
-    import jax
     import jax.numpy as jnp
     import numpy as np
 
@@ -222,50 +307,21 @@ def run_throughput(batch, iters, warmup):
     y = jnp.asarray(rng.integers(0, 1000, (batch,)))
 
     stage("compile", f"batch={batch}")
-    tc = time.perf_counter()
-    lowered = step._step_fn.lower(step.state, x, y)
-    compiled = lowered.compile()
-    compile_s = time.perf_counter() - tc
-    log(f"compiled in {compile_s:.1f}s")
-
-    flops, flops_source = None, "none"
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, list):
-            ca = ca[0]
-        if ca and ca.get("flops", 0) > 0:
-            flops, flops_source = float(ca["flops"]), "xla_cost_analysis"
-    except Exception as e:
-        log(f"cost_analysis unavailable: {e}")
-    if flops is None:
-        flops, flops_source = resnet50_step_flops(batch), "analytic"
-
-    stage("warmup", f"{warmup} iters")
-    state = step.state
-    for _ in range(warmup):
-        state, loss = compiled(state, x, y)
-    # NOTE: jax.block_until_ready is a no-op on the experimental axon
-    # platform — only an actual device->host fetch synchronizes, so sync
-    # against a scalar fetch that data-depends on the whole step chain.
-    float(jnp.sum(state.master_params[0]))
-    log(f"warm, loss={float(loss):.4f}")
-
-    stage("timing", f"{iters} iters")
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = compiled(state, x, y)
-    float(jnp.sum(state.master_params[0]))
-    dt = (time.perf_counter() - t0) / iters
-    return dt, compile_s, flops, flops_source
+    return time_compiled_step(step, (x, y), iters, warmup,
+                              lambda: resnet50_step_flops(batch))
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("batch", nargs="?", type=int, default=128)
+    ap.add_argument("batch", nargs="?", type=int, default=None)
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--kernels", action="store_true",
                     help="run only the Pallas kernel parity checks")
+    ap.add_argument("--bert", action="store_true",
+                    help="run the BERT-base pretrain config (BASELINE.md 4) "
+                         "instead of ResNet-50")
+    ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--no-kernels", action="store_true",
                     help="skip the kernel parity checks")
     ap.add_argument("--budget-s", type=float,
@@ -295,12 +351,21 @@ def main():
     dt = compile_s = flops = None
     flops_source = "none"
     err = None
-    for batch in [args.batch, args.batch // 2, args.batch // 4]:
+    # per-config default batch; an explicitly requested batch is honored
+    first_batch = args.batch
+    if first_batch is None:
+        first_batch = 64 if args.bert else 128
+        log(f"default batch: {first_batch}")
+    for batch in [first_batch, first_batch // 2, first_batch // 4]:
         if batch < 1:
             break
         try:
-            dt, compile_s, flops, flops_source = run_throughput(
-                batch, args.iters, args.warmup)
+            if args.bert:
+                dt, compile_s, flops, flops_source = run_bert_throughput(
+                    batch, args.seq_len, args.iters, args.warmup)
+            else:
+                dt, compile_s, flops, flops_source = run_throughput(
+                    batch, args.iters, args.warmup)
             break
         except Exception as e:
             err = e
@@ -326,11 +391,19 @@ def main():
             kernels = {"error": f"{type(e).__name__}: {e}"}
 
     stage("report")
+    if args.bert:
+        metric = (f"bert_base_mlm_seq{args.seq_len}_"
+                  "sequences_per_sec_per_chip_ampO2")
+        unit, vs_baseline = "sequences/sec/chip", None
+    else:
+        metric = "resnet50_imagenet_images_per_sec_per_chip_ampO2"
+        unit = "images/sec/chip"
+        vs_baseline = round(imgs_per_sec / V100_APEX_O2_IMGS_PER_SEC, 3)
     emit({
-        "metric": "resnet50_imagenet_images_per_sec_per_chip_ampO2",
+        "metric": metric,
         "value": round(imgs_per_sec, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(imgs_per_sec / V100_APEX_O2_IMGS_PER_SEC, 3),
+        "unit": unit,
+        "vs_baseline": vs_baseline,
         "batch": batch,
         "step_time_ms": round(dt * 1e3, 2),
         "compile_s": round(compile_s, 1),

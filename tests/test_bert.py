@@ -1,0 +1,107 @@
+"""BERT encoder family (models/bert.py): shapes, padding-mask semantics,
+gradient flow incl. the tied MLM decoder, and a FusedLAMB train-step
+convergence check (BASELINE.md config 4 in miniature)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.models import BertModel, BertForMaskedLM
+
+V, H, L, HEADS, I, S = 97, 32, 2, 4, 64, 16
+
+
+def _tiny_bert(**kw):
+    nn.manual_seed(3)
+    return BertModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                     intermediate=I, max_positions=64, dropout=0.0,
+                     attn_dropout=0.0, **kw)
+
+
+def _tiny_mlm():
+    nn.manual_seed(3)
+    return BertForMaskedLM(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                           intermediate=I, max_positions=64, dropout=0.0,
+                           attn_dropout=0.0)
+
+
+def _ids(rng, b=2, s=S):
+    return jnp.asarray(rng.integers(0, V, (b, s)))
+
+
+def test_encoder_shapes(rng):
+    m = _tiny_bert()
+    out = m(_ids(rng))
+    assert out.shape == (2, S, H)
+    assert out.dtype == jnp.float32
+
+
+def test_token_type_changes_output(rng):
+    m = _tiny_bert()
+    ids = _ids(rng)
+    out0 = np.asarray(m(ids))
+    tt = jnp.ones_like(ids)
+    out1 = np.asarray(m(ids, tt))
+    assert np.abs(out0 - out1).max() > 1e-4
+
+
+def test_padding_mask_isolates_real_tokens(rng):
+    """Outputs at real positions must not depend on what the padding
+    token ids are, when the padding is masked out."""
+    m = _tiny_bert()
+    m.eval()
+    ids = np.asarray(_ids(rng))
+    mask = np.ones_like(ids)
+    mask[:, 10:] = 0  # positions 10+ are padding
+    ids2 = ids.copy()
+    ids2[:, 10:] = (ids2[:, 10:] + 7) % V  # different padding content
+    out1 = np.asarray(m(jnp.asarray(ids), None, jnp.asarray(mask)))
+    out2 = np.asarray(m(jnp.asarray(ids2), None, jnp.asarray(mask)))
+    np.testing.assert_allclose(out1[:, :10], out2[:, :10],
+                               rtol=1e-5, atol=1e-5)
+    assert np.abs(out1[:, 10:] - out2[:, 10:]).max() > 1e-4
+
+
+def test_mlm_logits_and_tied_decoder_grads(rng):
+    mlm = _tiny_mlm()
+    ids = _ids(rng)
+    logits = mlm(ids)
+    assert logits.shape == (2, S, V)
+    labels = jnp.asarray(rng.integers(0, V, (2 * S,)))
+    loss = nn.CrossEntropyLoss()(logits.reshape((-1, V)), labels)
+    loss.backward()
+    grads = [p.grad for p in mlm.parameters()]
+    assert all(g is not None for g in grads)
+    # the tied embedding gets gradient from BOTH the input lookup and the
+    # output projection; it must be finite and nonzero
+    emb_grad = mlm.bert.tok_emb.weight.grad
+    assert np.isfinite(np.asarray(emb_grad)).all()
+    assert float(jnp.abs(emb_grad).max()) > 0
+
+
+def test_fused_lamb_train_step_converges(rng):
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.training import make_train_step
+
+    mlm = _tiny_mlm()
+    opt = FusedLAMB(list(mlm.parameters()), lr=1e-2, weight_decay=0.01)
+
+    def mlm_loss(logits, labels):
+        flat = logits.reshape((-1, V))
+        lab = labels.reshape((-1,))
+        m = (lab >= 0).astype(jnp.float32)
+        losses = F.cross_entropy(flat, jnp.maximum(lab, 0),
+                                 reduction="none")
+        return jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+    step = make_train_step(mlm, opt, mlm_loss, half_dtype=jnp.bfloat16,
+                           loss_scale=1.0)
+    ids = _ids(rng, b=4)
+    labels = np.full((4, S), -100, np.int32)
+    pick = np.random.default_rng(0).random((4, S)) < 0.3
+    labels[pick] = np.random.default_rng(1).integers(0, V, int(pick.sum()))
+    labels = jnp.asarray(labels)
+    losses = [float(step(ids, labels)) for _ in range(8)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

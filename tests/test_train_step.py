@@ -302,3 +302,52 @@ def test_fused_step_ddp_on_mesh():
     for a, b in zip(single.state.master_params, ddp.state.master_params):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-5)
+
+
+def test_fused_step_with_dropout():
+    """Models containing Dropout must train through the fused step: the
+    step derives a per-step PRNG key from the step counter (regression —
+    the Ctx used to be built keyless and dropout raised)."""
+    nn.manual_seed(5)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Dropout(0.5),
+                          nn.Linear(32, 4))
+    opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+    step = make_train_step(model, opt,
+                           lambda o, yy: F.cross_entropy(o, yy),
+                           loss_scale=1.0)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (8,)))
+    losses = [float(step(x, y)) for _ in range(6)]
+    assert all(np.isfinite(losses))
+    # different steps see different dropout masks: with lr>0 the loss
+    # sequence must not be constant
+    assert len({round(l, 6) for l in losses}) > 1
+
+
+def test_fused_step_dropout_under_dp():
+    """Dropout under shard_map DP: the step folds the replica index into the
+    dropout key, so shards draw independent masks and the step compiles
+    (axis_index is only valid inside the mapped context)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    shard_map = jax.shard_map
+
+    nn.manual_seed(5)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Dropout(0.5),
+                          nn.Linear(32, 4))
+    opt = FusedSGD(list(model.parameters()), lr=0.05, momentum=0.9)
+    step = make_train_step(model, opt,
+                           lambda o, yy: F.cross_entropy(o, yy),
+                           loss_scale=1.0, axis_name="data")
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    sharded = jax.jit(shard_map(
+        step._step_fn, mesh=mesh,
+        in_specs=(P(), P("data"), P("data")), out_specs=(P(), P()),
+        check_vma=False))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (16,)))
+    state, loss = sharded(step.state, x, y)
+    state, loss2 = sharded(state, x, y)
+    assert np.isfinite(float(jnp.mean(loss)))
+    assert np.isfinite(float(jnp.mean(loss2)))
