@@ -324,14 +324,21 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 return gc.astype(g.dtype) if allreduce_always_fp32 else gc
             grads = [exchange(g) for g in grads]
 
-        # unscale into fp32 master grads + overflow flag
+        # unscale into fp32 master grads + overflow flag.  bf16-style runs
+        # (static scale 1.0) skip the non-finite reduction: no scaling means
+        # no scaled-overflow to detect, and the extra full pass over every
+        # gradient costs real step time (the reference likewise early-outs
+        # in unscale for scale==1.0 non-dynamic, apex/amp/scaler.py:102-103)
+        check_overflow = dynamic or init_scale != 1.0
         inv = 1.0 / state.scaler.loss_scale
         flag = jnp.zeros((), jnp.int32)
         master_grads = []
         for g in grads:
-            gf = g.astype(jnp.float32) * inv
-            flag = jnp.maximum(flag, (~jnp.isfinite(gf)).any()
-                               .astype(jnp.int32))
+            gf = g.astype(jnp.float32)
+            if check_overflow:
+                gf = gf * inv
+                flag = jnp.maximum(flag, (~jnp.isfinite(gf)).any()
+                                   .astype(jnp.int32))
             master_grads.append(gf)
 
         step_count = state.step + 1
