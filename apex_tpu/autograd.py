@@ -194,6 +194,21 @@ class Tensor:
     def max(self, axis=None, keepdims=False):
         return record_op("max", (self,), {"axis": axis, "keepdims": keepdims})
 
+    def min(self, axis=None, keepdims=False):
+        return record_op("min", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def log(self):
+        return record_op("log", (self,), {})
+
+    def exp(self):
+        return record_op("exp", (self,), {})
+
+    def sqrt(self):
+        return record_op("sqrt", (self,), {})
+
+    def abs(self):
+        return record_op("abs", (self,), {})
+
     def reshape(self, *shape):
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])

@@ -1,1 +1,2 @@
 from .step import StepState, TrainStep, make_train_step  # noqa: F401
+from .gan import GanStepState, GanTrainStep, make_gan_train_step  # noqa: F401

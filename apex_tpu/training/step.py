@@ -78,62 +78,36 @@ class TrainStep:
             _amp_state.loss_scalers[0].state = st.scaler
 
 
-def make_train_step(model, optimizer, loss_fn: Callable,
-                    half_dtype=None,
-                    keep_batchnorm_fp32: bool = True,
-                    dynamic_loss_scale: bool = True,
-                    scale_window: int = 2000,
-                    min_loss_scale: Optional[float] = None,
-                    max_loss_scale: float = 2.0 ** 24,
-                    loss_scale: float | str = "dynamic",
-                    axis_name: Optional[str] = None,
-                    gradient_predivide_factor: float = 1.0,
-                    allreduce_always_fp32: bool = False,
-                    donate_state: bool = True,
-                    rng_seed: int = 0):
-    """Build a fully-fused O2-style train step.
-
-    ``loss_fn(outputs..., *batch_tail) -> scalar``: called with the model
-    output.  The step signature is ``step(state, *batch) -> (state, loss)``
-    where ``batch[0]`` feeds the model and the full batch feeds ``loss_fn``.
-
-    When ``axis_name`` is given the step is meant to run under
-    ``shard_map``/``pjit`` over that mesh axis: gradients are psum-averaged
-    with the reference DDP's knobs honored (``gradient_predivide_factor``
-    splits the averaging before/after the all-reduce,
-    apex/parallel/distributed.py:445-454; ``allreduce_always_fp32`` casts
-    grads to fp32 for the collective, :417-421).
-    """
-    from ..optimizers import FusedAdam, FusedLAMB, FusedNovoGrad, FusedSGD
-    from .. import ops
-
-    params = [p for p in model.parameters() if p is not None]
-    buffers = [b for b in model.buffers()]
-
-    # Per-group bookkeeping: optimizer params are matched against the model's
-    # by identity; hyperparameters come from each param's own group (the
-    # round-1 version silently applied group 0 to everything).  Model params
-    # held by no group are frozen (torch semantics).
+def match_param_groups(optimizer, params, caller="make_train_step"):
+    """Match optimizer param_groups to ``params`` by identity → per-group
+    index lists.  Hyperparameters come from each param's own group; model
+    params held by no group are frozen (torch semantics)."""
     id2idx = {id(p): i for i, p in enumerate(params)}
-    group_idxs: list[list[int]] = []
+    group_idxs: list = []
     for gi, group in enumerate(optimizer.param_groups):
         idxs = []
         for p in group["params"]:
             if id(p) not in id2idx:
                 raise ValueError(
-                    f"make_train_step: optimizer param_groups[{gi}] holds a "
+                    f"{caller}: optimizer param_groups[{gi}] holds a "
                     f"parameter (shape {tuple(p.shape)}) that is not one of "
                     f"model.parameters(); the fused step requires the "
                     f"optimizer to optimize the model's own parameters")
             idxs.append(id2idx[id(p)])
         group_idxs.append(idxs)
+    return group_idxs
 
-    def _gather(lst, idxs):
-        return [lst[i] for i in idxs]
 
-    def _scatter(dst, idxs, new):
-        for i, v in zip(idxs, new):
-            dst[i] = v
+def _gather(lst, idxs):
+    return [lst[i] for i in idxs]
+
+
+def _scatter(dst, idxs, new):
+    for i, v in zip(idxs, new):
+        dst[i] = v
+
+
+def _model_dtypes(model, params, half_dtype, keep_batchnorm_fp32):
     from ..nn.modules import _BatchNorm
 
     bn_param_ids = set()
@@ -143,21 +117,97 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 for p in m._parameters.values():
                     if p is not None:
                         bn_param_ids.add(id(p))
-
     if half_dtype is None:
-        model_dtypes = [p.data.dtype for p in params]
-    else:
-        model_dtypes = [
-            jnp.float32 if id(p) in bn_param_ids else jnp.dtype(half_dtype)
+        return [p.data.dtype for p in params]
+    return [jnp.float32 if id(p) in bn_param_ids else jnp.dtype(half_dtype)
             for p in params]
 
-    dynamic = loss_scale == "dynamic"
-    init_scale = (min(max_loss_scale, 2.0 ** 16) if dynamic
-                  else float(loss_scale))
 
-    # map optimizer type -> pure update over flat lists, applied per group
-    # (hyperparameters are read at trace time; mutate-and-recompile to change
-    # them mid-training, as with any jitted step)
+def apply_fused_update(sub: StepState, grads, opt_update, model_dtypes, *,
+                       dynamic, init_scale, scale_window,
+                       min_loss_scale, max_loss_scale):
+    """The post-gradient half of a fused step: unscale into fp32 master
+    grads + overflow flag, fused optimizer update, skip-on-overflow
+    (lax.select keeps it fused), model-dtype re-cast, loss-scale update.
+    Returns the new sub-state with ``sub.stats`` passed through.
+
+    bf16-style runs (static scale 1.0) skip the non-finite reduction: no
+    scaling means no scaled-overflow to detect, and the extra full pass over
+    every gradient costs real step time (the reference likewise early-outs
+    in unscale for scale==1.0 non-dynamic, apex/amp/scaler.py:102-103).
+    """
+    check_overflow = dynamic or init_scale != 1.0
+    flag = jnp.zeros((), jnp.int32)
+    master_grads = []
+    if check_overflow:
+        inv = 1.0 / sub.scaler.loss_scale
+    for g in grads:
+        gf = g.astype(jnp.float32)
+        if check_overflow:
+            gf = gf * inv
+            flag = jnp.maximum(flag, (~jnp.isfinite(gf)).any()
+                               .astype(jnp.int32))
+        master_grads.append(gf)
+
+    step_count = sub.step + 1
+    new_masters, new_slots = opt_update(
+        flag, master_grads, sub.master_params, sub.opt_state, step_count)
+
+    skip = flag > 0
+    sel = functools.partial(jnp.where, skip)
+    masters = [sel(o, n) for o, n in zip(sub.master_params, new_masters)]
+    slots = {k: [sel(o, n) for o, n in zip(sub.opt_state[k], new_slots[k])]
+             for k in new_slots}
+    model_params = [
+        None if jnp.dtype(d) == jnp.dtype(jnp.float32) else m.astype(d)
+        for m, d in zip(masters, model_dtypes)]
+    step_count = jnp.where(skip, sub.step, step_count)
+
+    scaler_state = ScalerState(sub.scaler.loss_scale, sub.scaler.unskipped,
+                               flag)
+    new_scaler, _ = update_scale_state(
+        scaler_state, dynamic=dynamic, scale_window=scale_window,
+        min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale)
+    return StepState(masters, model_params, slots, new_scaler, sub.stats,
+                     step_count)
+
+
+def init_step_state(params, buffers, model_dtypes, opt_init, init_scale):
+    """Initial device state for a fused step.  copy=True: .astype is a
+    no-op view for already-fp32 params, and the state is donated — without
+    the copy the first step would delete the live Parameter.data /
+    Buffer.data arrays out from under the model."""
+    masters0 = [jnp.array(p.data, dtype=jnp.float32, copy=True)
+                for p in params]
+    return StepState(
+        master_params=masters0,
+        model_params=[
+            None if jnp.dtype(d) == jnp.dtype(jnp.float32)
+            else m.astype(d) for m, d in zip(masters0, model_dtypes)],
+        opt_state=opt_init(),
+        scaler=ScalerState(jnp.asarray(init_scale, jnp.float32),
+                           jnp.zeros((), jnp.int32),
+                           jnp.zeros((), jnp.int32)),
+        stats=[jnp.array(b.data, copy=True) for b in buffers],
+        step=jnp.zeros((), jnp.int32))
+
+
+def model_vals_of(sub: StepState):
+    """Forward-pass param values: the half copy where cast, else the fp32
+    master (model_params holds None where no cast is needed — sharing the
+    master buffer would double-donate under buffer donation)."""
+    return [sub.master_params[i] if mp is None else mp
+            for i, mp in enumerate(sub.model_params)]
+
+
+def build_opt_update(optimizer, params, group_idxs):
+    """Map a fused optimizer instance to a pure update over flat lists,
+    applied per group (hyperparameters are read at trace time;
+    mutate-and-recompile to change them mid-training, as with any jitted
+    step).  Returns ``(opt_update, opt_init)``."""
+    from ..optimizers import FusedAdam, FusedLAMB, FusedNovoGrad, FusedSGD
+    from .. import ops
+
     opt = optimizer
     if isinstance(opt, FusedSGD):
         def opt_update(flag, grads, masters, slots, step):
@@ -271,15 +321,48 @@ def make_train_step(model, optimizer, loss_fn: Callable,
         raise TypeError(
             f"make_train_step does not support {type(opt).__name__}; "
             f"supported: FusedSGD, FusedAdam, FusedLAMB, FusedNovoGrad")
+    return opt_update, opt_init
 
-    def _model_vals(masters, model_params):
-        # model_params holds None where no cast is needed (sharing the master
-        # buffer would double-donate under buffer donation)
-        return [masters[i] if mp is None else mp
-                for i, mp in enumerate(model_params)]
+
+def make_train_step(model, optimizer, loss_fn: Callable,
+                    half_dtype=None,
+                    keep_batchnorm_fp32: bool = True,
+                    dynamic_loss_scale: bool = True,
+                    scale_window: int = 2000,
+                    min_loss_scale: Optional[float] = None,
+                    max_loss_scale: float = 2.0 ** 24,
+                    loss_scale: float | str = "dynamic",
+                    axis_name: Optional[str] = None,
+                    gradient_predivide_factor: float = 1.0,
+                    allreduce_always_fp32: bool = False,
+                    donate_state: bool = True,
+                    rng_seed: int = 0):
+    """Build a fully-fused O2-style train step.
+
+    ``loss_fn(outputs..., *batch_tail) -> scalar``: called with the model
+    output.  The step signature is ``step(state, *batch) -> (state, loss)``
+    where ``batch[0]`` feeds the model and the full batch feeds ``loss_fn``.
+
+    When ``axis_name`` is given the step is meant to run under
+    ``shard_map``/``pjit`` over that mesh axis: gradients are psum-averaged
+    with the reference DDP's knobs honored (``gradient_predivide_factor``
+    splits the averaging before/after the all-reduce,
+    apex/parallel/distributed.py:445-454; ``allreduce_always_fp32`` casts
+    grads to fp32 for the collective, :417-421).
+    """
+    params = [p for p in model.parameters() if p is not None]
+    buffers = [b for b in model.buffers()]
+    group_idxs = match_param_groups(optimizer, params)
+    model_dtypes = _model_dtypes(model, params, half_dtype,
+                                 keep_batchnorm_fp32)
+    opt_update, opt_init = build_opt_update(optimizer, params, group_idxs)
+
+    dynamic = loss_scale == "dynamic"
+    init_scale = (min(max_loss_scale, 2.0 ** 16) if dynamic
+                  else float(loss_scale))
 
     def step_fn(state: StepState, *batch):
-        model_vals = _model_vals(state.master_params, state.model_params)
+        model_vals = model_vals_of(state)
 
         def forward(model_vals_in, *b):
             env = {id(p): v for p, v in zip(params, model_vals_in)}
@@ -324,65 +407,15 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 return gc.astype(g.dtype) if allreduce_always_fp32 else gc
             grads = [exchange(g) for g in grads]
 
-        # unscale into fp32 master grads + overflow flag.  bf16-style runs
-        # (static scale 1.0) skip the non-finite reduction: no scaling means
-        # no scaled-overflow to detect, and the extra full pass over every
-        # gradient costs real step time (the reference likewise early-outs
-        # in unscale for scale==1.0 non-dynamic, apex/amp/scaler.py:102-103)
-        check_overflow = dynamic or init_scale != 1.0
-        inv = 1.0 / state.scaler.loss_scale
-        flag = jnp.zeros((), jnp.int32)
-        master_grads = []
-        for g in grads:
-            gf = g.astype(jnp.float32)
-            if check_overflow:
-                gf = gf * inv
-                flag = jnp.maximum(flag, (~jnp.isfinite(gf)).any()
-                                   .astype(jnp.int32))
-            master_grads.append(gf)
+        new_state = apply_fused_update(
+            state._replace(stats=new_stats), grads, opt_update, model_dtypes,
+            dynamic=dynamic, init_scale=init_scale,
+            scale_window=scale_window, min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale)
+        return new_state, loss
 
-        step_count = state.step + 1
-        new_masters, new_slots = opt_update(
-            flag, master_grads, state.master_params, state.opt_state,
-            step_count)
-
-        # skip-step on overflow: keep old state (lax.select keeps it fused)
-        skip = flag > 0
-        sel = functools.partial(jnp.where, skip)
-        masters = [sel(o, n) for o, n in zip(state.master_params, new_masters)]
-        slots = {k: [sel(o, n) for o, n in zip(state.opt_state[k],
-                                               new_slots[k])]
-                 for k in new_slots}
-        model_params = [
-            None if jnp.dtype(d) == jnp.dtype(jnp.float32) else m.astype(d)
-            for m, d in zip(masters, model_dtypes)]
-        step_count = jnp.where(skip, state.step, step_count)
-
-        scaler_state = ScalerState(state.scaler.loss_scale,
-                                   state.scaler.unskipped, flag)
-        new_scaler, _ = update_scale_state(
-            scaler_state, dynamic=dynamic, scale_window=scale_window,
-            min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale)
-
-        return StepState(masters, model_params, slots, new_scaler,
-                         new_stats, step_count), loss
-
-    # copy=True: .astype is a no-op view for already-fp32 params, and the
-    # state is donated — without the copy the first step would delete the
-    # live Parameter.data / Buffer.data arrays out from under the model
-    masters0 = [jnp.array(p.data, dtype=jnp.float32, copy=True)
-                for p in params]
-    init_state = StepState(
-        master_params=masters0,
-        model_params=[
-            None if jnp.dtype(d) == jnp.dtype(jnp.float32)
-            else m.astype(d) for m, d in zip(masters0, model_dtypes)],
-        opt_state=opt_init(),
-        scaler=ScalerState(jnp.asarray(init_scale, jnp.float32),
-                           jnp.zeros((), jnp.int32),
-                           jnp.zeros((), jnp.int32)),
-        stats=[jnp.array(b.data, copy=True) for b in buffers],
-        step=jnp.zeros((), jnp.int32))
+    init_state = init_step_state(params, buffers, model_dtypes, opt_init,
+                                 init_scale)
 
     if axis_name is None:
         jit_step = jax.jit(step_fn,

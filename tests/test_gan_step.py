@@ -1,0 +1,186 @@
+"""Fused GAN train step (training/gan.py) vs the imperative multi-model
+path — the fused-path analogue of the reference's DCGAN multi-model /
+multi-loss amp config (examples/dcgan/main_amp.py:214-253)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.nn import functional as F
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.training import make_gan_train_step
+
+ZDIM = 8
+
+
+class _Reshape(nn.Module):
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = shape
+
+    def forward(self, ctx, x):
+        return x.reshape((x.shape[0],) + self.shape)
+
+
+def _gan():
+    nn.manual_seed(11)
+    netD = nn.Sequential(
+        nn.Conv2d(1, 8, 3, stride=2, padding=1, bias=False),
+        nn.BatchNorm2d(8), nn.LeakyReLU(0.2),
+        nn.Flatten(), nn.Linear(8 * 4 * 4, 1), nn.Sigmoid())
+    netG = nn.Sequential(
+        nn.Linear(ZDIM, 64), nn.ReLU(), nn.Linear(64, 64), nn.Tanh(),
+        _Reshape((1, 8, 8)))
+    return netD, netG
+
+
+def _losses():
+    """BCE-style GAN losses, written against the common Tensor/array math
+    surface so the same fns drive both the fused step (raw jnp arrays) and
+    the imperative tape path (autograd Tensors)."""
+    eps = 1e-6
+
+    def _mean_log(x):
+        return x.log().mean() if hasattr(x, "backward") \
+            else jnp.mean(jnp.log(x))
+
+    def d_loss(out_r, out_f):
+        return -(_mean_log(out_r + eps) + _mean_log(1.0 - out_f + eps))
+
+    def g_loss(out_f):
+        return -_mean_log(out_f + eps)
+    return d_loss, g_loss
+
+
+def _data(rng, n=8):
+    real = jnp.asarray(rng.standard_normal((n, 1, 8, 8)), jnp.float32)
+    z = jnp.asarray(rng.standard_normal((n, ZDIM)), jnp.float32)
+    return real, z
+
+
+def test_gan_step_runs_and_updates_both_nets(rng):
+    netD, netG = _gan()
+    d_loss, g_loss = _losses()
+    optD = FusedAdam(list(netD.parameters()), lr=2e-3, betas=(0.5, 0.999))
+    optG = FusedAdam(list(netG.parameters()), lr=2e-3, betas=(0.5, 0.999))
+    step = make_gan_train_step(netD, netG, optD, optG, d_loss, g_loss,
+                               loss_scale=1.0)
+    d0 = [np.asarray(m) for m in step.state.d.master_params]
+    g0 = [np.asarray(m) for m in step.state.g.master_params]
+    real, z = _data(rng)
+    for _ in range(3):
+        errD, errG = step(real, z)
+        assert np.isfinite(float(errD)) and np.isfinite(float(errG))
+    assert any(not np.allclose(a, np.asarray(b))
+               for a, b in zip(d0, step.state.d.master_params))
+    assert any(not np.allclose(a, np.asarray(b))
+               for a, b in zip(g0, step.state.g.master_params))
+    assert int(step.state.d.step) == 3 and int(step.state.g.step) == 3
+
+
+def test_gan_step_matches_imperative(rng):
+    """The fused GAN iteration must match the tape-driven loop exactly
+    (same ordering: errG computed through the post-step discriminator)."""
+    real, z = _data(rng)
+    d_loss, g_loss = _losses()
+
+    # imperative path
+    netD_a, netG_a = _gan()
+    optD_a = FusedAdam(list(netD_a.parameters()), lr=2e-3)
+    optG_a = FusedAdam(list(netG_a.parameters()), lr=2e-3)
+    errD_hist, errG_hist = [], []
+    for _ in range(3):
+        # reference DCGAN ordering: zero D at iteration start (errG.backward
+        # deposits grads through D as well; they must be discarded)
+        optD_a.zero_grad()
+        fake = netG_a(z)
+        errD = d_loss(netD_a(real), netD_a(fake.detach()))
+        errD.backward()
+        optD_a.step()
+        optG_a.zero_grad()
+        errG = g_loss(netD_a(fake))
+        errG.backward()
+        optG_a.step()
+        errD_hist.append(float(errD))
+        errG_hist.append(float(errG))
+
+    # fused path
+    netD_b, netG_b = _gan()
+    optD_b = FusedAdam(list(netD_b.parameters()), lr=2e-3)
+    optG_b = FusedAdam(list(netG_b.parameters()), lr=2e-3)
+    step = make_gan_train_step(netD_b, netG_b, optD_b, optG_b,
+                               d_loss, g_loss, loss_scale=1.0)
+    for i in range(3):
+        errD, errG = step(real, z)
+        np.testing.assert_allclose(float(errD), errD_hist[i],
+                                   rtol=2e-4, atol=1e-6)
+        np.testing.assert_allclose(float(errG), errG_hist[i],
+                                   rtol=2e-4, atol=1e-6)
+
+    for pa, mb in zip(netD_a.parameters(), step.state.d.master_params):
+        np.testing.assert_allclose(np.asarray(pa.data), np.asarray(mb),
+                                   rtol=2e-4, atol=2e-6)
+    for pa, mb in zip(netG_a.parameters(), step.state.g.master_params):
+        np.testing.assert_allclose(np.asarray(pa.data), np.asarray(mb),
+                                   rtol=2e-4, atol=2e-6)
+
+
+def test_gan_step_overflow_skips_only_that_net(rng):
+    """A D overflow must leave D untouched while G still updates (per-loss
+    scalers, reference dcgan loss_id semantics)."""
+    netD, netG = _gan()
+    d_loss, g_loss = _losses()
+
+    def d_loss_inf(out_r, out_f):
+        return d_loss(out_r, out_f) * jnp.float32(1e38) * jnp.float32(1e38)
+
+    optD = FusedAdam(list(netD.parameters()), lr=2e-3)
+    optG = FusedAdam(list(netG.parameters()), lr=2e-3)
+    step = make_gan_train_step(netD, netG, optD, optG, d_loss_inf, g_loss,
+                               loss_scale="dynamic")
+    real, z = _data(rng)
+    d0 = [np.asarray(m) for m in step.state.d.master_params]
+    scale0 = float(step.state.d.scaler.loss_scale)
+    step(real, z)
+    for a, b in zip(d0, step.state.d.master_params):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    assert float(step.state.d.scaler.loss_scale) == scale0 / 2
+    assert int(step.state.d.step) == 0
+    assert int(step.state.g.step) == 1
+
+
+def test_gan_step_sync_to_objects(rng):
+    netD, netG = _gan()
+    d_loss, g_loss = _losses()
+    optD = FusedAdam(list(netD.parameters()), lr=2e-3)
+    optG = FusedAdam(list(netG.parameters()), lr=2e-3)
+    step = make_gan_train_step(netD, netG, optD, optG, d_loss, g_loss,
+                               loss_scale=1.0, half_dtype=jnp.bfloat16)
+    real, z = _data(rng)
+    step(real, z)
+    step.sync_to_objects()
+    # non-BN params got the half value; BN stayed fp32
+    assert netD[0].weight.dtype == jnp.bfloat16
+    assert netD[1].weight.dtype == jnp.float32
+    # BN running stats advanced
+    assert not np.allclose(np.asarray(netD[1].running_mean.data), 0.0)
+
+
+def test_gan_step_with_dropout_discriminator(rng):
+    """A D containing Dropout must train through the fused GAN step, each
+    of the three D forwards drawing its own mask (per-forward keys)."""
+    nn.manual_seed(13)
+    netD = nn.Sequential(
+        nn.Flatten(), nn.Linear(64, 32), nn.LeakyReLU(0.2), nn.Dropout(0.5),
+        nn.Linear(32, 1), nn.Sigmoid())
+    netG = nn.Sequential(nn.Linear(ZDIM, 64), nn.Tanh(), _Reshape((1, 8, 8)))
+    d_loss, g_loss = _losses()
+    optD = FusedAdam(list(netD.parameters()), lr=2e-3)
+    optG = FusedAdam(list(netG.parameters()), lr=2e-3)
+    step = make_gan_train_step(netD, netG, optD, optG, d_loss, g_loss,
+                               loss_scale=1.0)
+    real, z = _data(rng)
+    for _ in range(3):
+        errD, errG = step(real, z)
+        assert np.isfinite(float(errD)) and np.isfinite(float(errG))
+    assert int(step.state.d.step) == 3 and int(step.state.g.step) == 3
