@@ -71,7 +71,8 @@ def _net_parts(model, optimizer, half_dtype, keep_batchnorm_fp32, caller):
     buffers = [b for b in model.buffers()]
     group_idxs = match_param_groups(optimizer, params, caller=caller)
     dtypes = _model_dtypes(model, params, half_dtype, keep_batchnorm_fp32)
-    opt_update, opt_init = build_opt_update(optimizer, params, group_idxs)
+    opt_update, opt_init = build_opt_update(optimizer, params, group_idxs,
+                                            caller=caller)
     return params, buffers, dtypes, opt_update, opt_init
 
 

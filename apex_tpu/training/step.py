@@ -200,7 +200,8 @@ def model_vals_of(sub: StepState):
             for i, mp in enumerate(sub.model_params)]
 
 
-def build_opt_update(optimizer, params, group_idxs):
+def build_opt_update(optimizer, params, group_idxs,
+                     caller="make_train_step"):
     """Map a fused optimizer instance to a pure update over flat lists,
     applied per group (hyperparameters are read at trace time;
     mutate-and-recompile to change them mid-training, as with any jitted
@@ -319,7 +320,7 @@ def build_opt_update(optimizer, params, group_idxs):
                                    for _ in params]}
     else:
         raise TypeError(
-            f"make_train_step does not support {type(opt).__name__}; "
+            f"{caller} does not support {type(opt).__name__}; "
             f"supported: FusedSGD, FusedAdam, FusedLAMB, FusedNovoGrad")
     return opt_update, opt_init
 

@@ -1,0 +1,113 @@
+"""BERT pretraining example — BASELINE config 4: BERT-base masked-LM with
+FusedLAMB + FusedLayerNorm under mixed precision.
+
+The reference repo has no BERT example of its own (its FusedLAMB/
+FusedLayerNorm/fast-MHA pieces were consumed by NVIDIA's external BERT
+scripts); this is the standalone equivalent on the TPU-first fused step.
+Argparse surface follows the other examples (opt-level/loss-scale knobs).
+
+Run: ``python main_amp.py --steps 50 --batch 32 --seq-len 128``
+(synthetic data; there is no dataset plumbing in the reference baseline
+configs either).
+"""
+import argparse
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+import apex_tpu.nn as nn
+from apex_tpu.models import BertForMaskedLM
+from apex_tpu.nn import functional as F
+from apex_tpu.optimizers import FusedLAMB
+from apex_tpu.training import make_train_step
+
+VOCAB = 30522
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="BERT pretrain + apex_tpu amp")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--weight-decay", type=float, default=0.01)
+    p.add_argument("--loss-scale", default="1.0",
+                   help="'dynamic' or a float; bf16 default needs none")
+    p.add_argument("--half-dtype", default="bfloat16",
+                   choices=["bfloat16", "float16", "none"])
+    p.add_argument("--layers", type=int, default=12)
+    p.add_argument("--hidden", type=int, default=768)
+    p.add_argument("--heads", type=int, default=12)
+    p.add_argument("--print-freq", type=int, default=10)
+    p.add_argument("--mask-prob", type=float, default=0.15)
+    return p.parse_args()
+
+
+def mlm_batch(rng, batch, seq_len, mask_prob):
+    """Synthetic MLM batch: random token ids, ~mask_prob positions carry
+    labels (-100 = ignore, matching the usual MLM convention)."""
+    ids = rng.integers(0, VOCAB, (batch, seq_len))
+    labels = np.full((batch, seq_len), -100, np.int64)
+    pick = rng.random((batch, seq_len)) < mask_prob
+    labels[pick] = ids[pick]          # predict the original token
+    ids = ids.copy()
+    ids[pick] = 103                   # [MASK]
+    return jnp.asarray(ids), jnp.asarray(labels)
+
+
+def mlm_loss(logits, labels):
+    flat = logits.reshape((-1, VOCAB))
+    lab = labels.reshape((-1,))
+    mask = (lab >= 0).astype(jnp.float32)
+    losses = F.cross_entropy(flat, jnp.maximum(lab, 0), reduction="none")
+    return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def main():
+    args = parse_args()
+    nn.manual_seed(0)
+    model = BertForMaskedLM(
+        vocab_size=VOCAB, hidden=args.hidden, layers=args.layers,
+        heads=args.heads, intermediate=4 * args.hidden,
+        max_positions=args.seq_len)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    print(f"model: {args.layers}L/{args.hidden}H "
+          f"({n_params / 1e6:.1f}M params)")
+
+    opt = FusedLAMB(list(model.parameters()), lr=args.lr,
+                    weight_decay=args.weight_decay)
+    half = None if args.half_dtype == "none" else \
+        jnp.dtype(args.half_dtype).type
+    loss_scale = args.loss_scale if args.loss_scale == "dynamic" \
+        else float(args.loss_scale)
+    step = make_train_step(model, opt, mlm_loss, half_dtype=half,
+                           loss_scale=loss_scale)
+
+    rng = np.random.default_rng(0)
+    ids, labels = mlm_batch(rng, args.batch, args.seq_len, args.mask_prob)
+
+    t0 = time.perf_counter()
+    loss = step(ids, labels)
+    print(f"compile+first step: {time.perf_counter() - t0:.1f}s "
+          f"loss {float(loss):.4f}")
+
+    seen, t_mark = 0, time.perf_counter()
+    final = None
+    for i in range(1, args.steps):
+        ids, labels = mlm_batch(rng, args.batch, args.seq_len,
+                                args.mask_prob)
+        loss = step(ids, labels)
+        seen += args.batch
+        if i % args.print_freq == 0:
+            lv = float(loss)   # fetch = device sync on this platform
+            dt = time.perf_counter() - t_mark
+            print(f"step {i}: loss {lv:.4f}  {seen / dt:.1f} seq/s")
+            seen, t_mark = 0, time.perf_counter()
+        final = loss
+    print("final loss:", float(final if final is not None else loss))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

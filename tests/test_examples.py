@@ -23,3 +23,20 @@ def test_simple_distributed_example_runs():
     assert "final loss:" in out.stdout
     final = float(out.stdout.rsplit("final loss:", 1)[1].strip())
     assert final < 0.5
+
+
+def test_bert_example_runs():
+    env = dict(os.environ, PYTHONPATH=REPO)
+    script = os.path.join(REPO, "examples", "bert", "main_amp.py")
+    code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.argv = ['main_amp.py', '--steps', '6', "
+            f"'--batch', '4', '--seq-len', '32', '--layers', '2', "
+            f"'--hidden', '64', '--heads', '4', '--print-freq', '2']; "
+            f"import runpy; runpy.run_path({script!r}, "
+            f"run_name='__main__')")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "final loss:" in out.stdout
+    assert "seq/s" in out.stdout
