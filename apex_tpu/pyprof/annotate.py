@@ -256,6 +256,35 @@ def init():
                 if orig in wrapped:
                     cls._fn = staticmethod(wrapped[orig])
 
+        # tensor-method ops (the reference wraps torch.Tensor methods via
+        # tensor_overrides, nvmarker.py): the tape analogue is one hook on
+        # autograd.record_op, through which every Tensor arithmetic /
+        # reduction / view op flows exactly once per trace.  The ppN scope
+        # labels the *forward* dispatch only; the tape's backward replay
+        # calls _OPS[name] directly, so tape-op bwd rows stay analytic in
+        # measured mode (unlike the F.* wrappers, whose jvp/transpose
+        # metadata carries the label into the compiled backward).
+        from .. import autograd as _ag
+        if not hasattr(_ag.record_op, "__wrapped_pyprof__"):
+            _orig_record_op = _ag.record_op
+
+            @functools.wraps(_orig_record_op)
+            def _record_op(name, array_args, static_kwargs):
+                st = _log()
+                if not st.enabled:
+                    return _orig_record_op(name, array_args, static_kwargs)
+                ev_idx = len(st.events)
+                _record(name, None, tuple(array_args), dict(static_kwargs))
+                with jax.named_scope(f"pp{ev_idx}_{name}"):
+                    out = _orig_record_op(name, array_args, static_kwargs)
+                # the output shape sizes data-movement ops (a getitem of
+                # one row moves the row, not the whole input)
+                st.events[ev_idx]["out_shape"] = _shape_of(out)
+                return out
+
+            _record_op.__wrapped_pyprof__ = _orig_record_op
+            _ag.record_op = _record_op
+
         # optimizer step annotation (pyprof's wrap_fused_adam analogue):
         # record one event per step() with the total param element count
         from .. import optimizers as opt_pkg

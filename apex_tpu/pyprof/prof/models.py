@@ -58,8 +58,14 @@ def _gemm_family(row):
         flops = 2 * m * k * n + (m * n if len(shapes) > 2 else 0)
         bytes_ = (m * k + k * n + m * n) * _ds(dtype)
         return flops, bytes_, _mxu(m, k, n, dtype)
-    # matmul: (..., M, K) @ (..., K, N)
-    a, b = shapes[0], shapes[1]
+    # matmul: (..., M, K) @ (..., K, N), with jnp.matmul's 1-D promotion
+    # rules (vector operands gain/drop a unit dim) — reachable with
+    # arbitrary ranks now that Tensor.__matmul__ flows through the hook
+    a, b = list(shapes[0]), list(shapes[1])
+    if len(a) == 1:
+        a = [1] + a
+    if len(b) == 1:
+        b = b + [1]
     batch = _numel(a[:-2])
     m, k, n = a[-2], a[-1], b[-1]
     flops = 2 * batch * m * k * n
@@ -115,6 +121,44 @@ _LOSS_COST = {"cross_entropy": 7, "nll_loss": 2, "mse_loss": 3,
               "binary_cross_entropy_with_logits": 8}
 _OPT_COST = {"FusedAdam": 12, "FusedLAMB": 16, "FusedNovoGrad": 12,
              "FusedSGD": 4, "LARC": 6}
+
+# tape-level Tensor ops (reference prof/{pointwise,reduction,convert,
+# index_slice_join_mutate}.py): elementwise arithmetic by cost, reductions
+# read-dominated, views free under XLA, data movement at two passes
+_ARITH_COST = {"add": 1, "sub": 1, "rsub": 1, "mul": 1, "div": 1,
+               "rdiv": 1, "neg": 1, "abs": 1, "pow": 10, "exp": 8,
+               "log": 8, "sqrt": 2}
+_REDUCTION_OPS = ("sum", "mean", "max", "min")
+_VIEW_OPS = ("reshape", "squeeze")              # XLA bitcast: free
+_MOVE_OPS = ("transpose", "getitem", "getitem_dyn", "astype")
+
+
+def _broadcast_shape(row):
+    """Largest-numel operand shape: binary tape ops broadcast, and the
+    result (and work) follows the larger side."""
+    best = [0]
+    for s in row["shapes"]:
+        if s and _numel(s) > _numel(best):
+            best = s
+    return best
+
+
+def _binary_elemwise(row, cost, passes=3):
+    n = _numel(_broadcast_shape(row))
+    dtype = (row["dtypes"] or ["float32"])[0]
+    return cost * n, passes * n * _ds(dtype), None
+
+
+def _movement(row):
+    # bytes follow what actually moves: the output when recorded (getitem
+    # of one row out of a big tensor moves the row, not the tensor)
+    dtype = (row["dtypes"] or ["float32"])[0]
+    out = row.get("out_shape")
+    n = _numel(out) if out is not None else _numel(_first_shape(row))
+    if row["op"] == "astype":
+        ds_out = _ds(row.get("params", {}).get("dtype", dtype))
+        return 0, n * (_ds(dtype) + ds_out), None
+    return 0, 2 * n * _ds(dtype), None
 
 
 def _first_shape(row):
@@ -173,6 +217,14 @@ def model_row(row):
         f, b, m = _pool_family(row)
     elif op == "embedding":
         f, b, m = _embedding(row)
+    elif op in _ARITH_COST:
+        f, b, m = _binary_elemwise(row, _ARITH_COST[op])
+    elif op in _REDUCTION_OPS:
+        f, b, m = _elemwise(row, 1, passes=1)
+    elif op in _VIEW_OPS:
+        f, b, m = 0, 0, None
+    elif op in _MOVE_OPS:
+        f, b, m = _movement(row)
     else:
         f, b, m = _elemwise(row, 1)
     if row.get("dir") == "bwd":

@@ -267,3 +267,72 @@ def test_parse_cli_with_trace(tmp_path, rng):
         sys.stdout = old
     rows = [_json.loads(ln) for ln in lines]
     assert any(r.get("dur_us") for r in rows)
+
+
+def test_tensor_method_ops_captured(rng):
+    """Tape-level Tensor ops (add/mul/mean/log...) are recorded through the
+    record_op hook — the analogue of the reference wrapping torch.Tensor
+    methods via tensor_overrides (nvmarker.py)."""
+    import apex_tpu.nn as nn
+    from apex_tpu import pyprof
+
+    nn.manual_seed(0)
+    model = nn.Linear(8, 4)
+    x = jnp.asarray(rng.standard_normal((2, 8)), jnp.float32)
+    with pyprof.capture() as events:
+        out = model(x)
+        y = ((out * 2.0 + 1.0).abs() + 1e-3).log().mean()
+        float(y)
+    ops = [e["op"] for e in events]
+    assert "linear" in ops
+    for expected in ("mul", "add", "abs", "log", "mean"):
+        assert expected in ops, f"{expected} not captured: {ops}"
+    add_ev = next(e for e in events if e["op"] == "mul")
+    assert add_ev["shapes"][0] == [2, 4]
+
+
+def test_tape_op_flop_models():
+    from apex_tpu.pyprof.prof.models import model_row
+
+    row = {"op": "add", "dir": "fwd", "shapes": [[4, 8], [4, 8]],
+           "dtypes": ["float32", "float32"], "params": {}}
+    f, b, m = model_row(row)
+    assert f == 32 and b == 3 * 32 * 4 and m is None
+
+    # broadcasting: work follows the larger operand, not shapes[0]
+    row = {"op": "mul", "dir": "fwd", "shapes": [[1, 8], [4096, 8]],
+           "dtypes": ["float32", "float32"], "params": {}}
+    f, b, _ = model_row(row)
+    assert f == 4096 * 8
+
+    row = {"op": "mean", "dir": "fwd", "shapes": [[4, 8]],
+           "dtypes": ["float32"], "params": {}}
+    f, b, _ = model_row(row)
+    assert f == 32 and b == 32 * 4
+
+    row = {"op": "reshape", "dir": "fwd", "shapes": [[4, 8]],
+           "dtypes": ["float32"], "params": {}}
+    assert model_row(row)[:2] == (0, 0)  # XLA view: free
+
+    # movement sized by the output: one row out of a big tensor
+    row = {"op": "getitem", "dir": "fwd", "shapes": [[1024, 1024]],
+           "dtypes": ["float32"], "params": {}, "out_shape": [1024]}
+    f, b, _ = model_row(row)
+    assert f == 0 and b == 2 * 1024 * 4
+
+    # cast bytes use both dtypes
+    row = {"op": "astype", "dir": "fwd", "shapes": [[4, 8]],
+           "dtypes": ["bfloat16"], "params": {"dtype": "float32"},
+           "out_shape": [4, 8]}
+    f, b, _ = model_row(row)
+    assert f == 0 and b == 32 * (2 + 4)
+
+    # matmul rank promotion: vector dot and matvec must not crash
+    row = {"op": "matmul", "dir": "fwd", "shapes": [[8], [8]],
+           "dtypes": ["float32", "float32"], "params": {}}
+    f, b, _ = model_row(row)
+    assert f == 2 * 8
+    row = {"op": "matmul", "dir": "fwd", "shapes": [[4, 8], [8]],
+           "dtypes": ["float32", "float32"], "params": {}}
+    f, b, _ = model_row(row)
+    assert f == 2 * 4 * 8
