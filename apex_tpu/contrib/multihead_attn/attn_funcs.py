@@ -148,6 +148,10 @@ def _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout_prob, key,
     if bias is not None:
         s = s.reshape(b, heads, sq, -1) + bias[:, None].astype(_f32)
         s = s.reshape(bh, sq, -1)
+    if use_time_mask_causal:
+        rows = jnp.arange(sq)[:, None]
+        cols = jnp.arange(s.shape[-1])[None, :]
+        s = jnp.where(rows >= cols, s, jnp.float32(-1e30))
     p = jax.nn.softmax(s, axis=-1)
     if dropout_prob > 0.0:
         if key is None:
@@ -161,9 +165,11 @@ def _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout_prob, key,
 def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
                    input_weights, output_weights, input_biases=None,
                    output_biases=None, mask=None, dropout_prob=0.0,
-                   key=None, use_flash=False):
+                   key=None, use_flash=False, causal=False):
     """Reference signature parity (self_multihead_attn_func.py:6-10);
-    ``use_flash`` selects the Pallas path (the fast_* extension analogue)."""
+    ``use_flash`` selects the Pallas path (the fast_* extension analogue).
+    ``causal`` applies the triangle in-kernel (no O(S^2) mask operand) —
+    beyond the reference signature, for decoder models."""
     t, b, e = inputs.shape
     head_dim = e // heads
     lin = jnp.matmul(inputs, input_weights.T)
@@ -176,12 +182,12 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
         q4 = q3.reshape(b, heads, t, head_dim)
         k4 = k3.reshape(b, heads, t, head_dim)
         v4 = v3.reshape(b, heads, t, head_dim)
-        ctx4 = flash_attention(q4, k4, v4, bias=bias, causal=False,
+        ctx4 = flash_attention(q4, k4, v4, bias=bias, causal=causal,
                                scale=scale)
         ctx3 = ctx4.reshape(b * heads, t, head_dim)
     else:
         ctx3 = _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout,
-                                  key)
+                                  key, use_time_mask_causal=causal)
     ctx = jnp.swapaxes(ctx3, 0, 1).reshape(t, b, e)
     out = jnp.matmul(ctx, output_weights.T)
     if output_biases is not None:

@@ -43,11 +43,14 @@ class _AttnModule(Module):
 
 class SelfMultiheadAttn(_AttnModule):
     def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
-                 include_norm_add=False, impl="fast"):
+                 include_norm_add=False, impl="fast", causal=False):
         super().__init__()
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.dropout = dropout
+        # causal=True applies the triangle in-kernel (decoder models) —
+        # no O(S^2) mask operand; beyond the reference's surface
+        self.causal = causal
         self.head_dim = embed_dim // num_heads
         assert self.head_dim * num_heads == embed_dim, \
             "embed_dim must be divisible by num_heads"
@@ -113,7 +116,7 @@ class SelfMultiheadAttn(_AttnModule):
             ctx.value(self.in_proj_bias) if self.bias else None,
             ctx.value(self.out_proj_bias) if self.bias else None,
             mask, self.dropout, key=drop_key,
-            use_flash=(self.impl == "fast"))
+            use_flash=(self.impl == "fast"), causal=self.causal)
 
         if self.include_norm_add:
             if is_training and self.dropout > 0.0:

@@ -1,0 +1,100 @@
+"""GPT-style causal decoder family — the autoregressive counterpart to
+models/bert.py, built from the same fused components.
+
+The reference repo carries no language models of its own (SURVEY.md §2 —
+its fused pieces were consumed by external scripts); this standalone
+decoder completes the transformer story: pre-LN blocks, causal Pallas
+flash attention (``SelfMultiheadAttn`` with a time mask), FusedLayerNorm,
+GELU FFN, weight-tied LM head.
+
+Layout: public API is batch-first ``(B, S)`` token ids; internally the
+decoder runs ``(S, B, E)`` for the attention module's reference layout.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..normalization import FusedLayerNorm
+from ..contrib.multihead_attn import SelfMultiheadAttn
+
+
+class GptBlock(nn.Module):
+    """Pre-LN decoder block: LN → causal MHA → residual, LN → GELU FFN →
+    residual."""
+
+    def __init__(self, hidden, heads, intermediate, dropout=0.1,
+                 attn_dropout=0.1):
+        super().__init__()
+        self.ln1 = FusedLayerNorm(hidden)
+        # causal=True: the flash kernel masks the triangle in-kernel, so
+        # no O(S^2) mask operand is materialized or streamed per layer
+        self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
+                                      impl="fast", causal=True)
+        self.ln2 = FusedLayerNorm(hidden)
+        self.fc1 = nn.Linear(hidden, intermediate)
+        self.fc2 = nn.Linear(intermediate, hidden)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, ctx, x):
+        h, _ = self.attn.forward(ctx, self.ln1.forward(ctx, x))
+        x = x + self.dropout.forward(ctx, h)
+        h = F.gelu(self.fc1.forward(ctx, self.ln2.forward(ctx, x)))
+        h = self.fc2.forward(ctx, h)
+        return x + self.dropout.forward(ctx, h)
+
+
+class GptModel(nn.Module):
+    """Token+position embeddings → N pre-LN causal blocks → final LN →
+    weight-tied LM head.  ``forward(input_ids[B,S]) -> logits (B,S,V)``."""
+
+    def __init__(self, vocab_size=50257, hidden=768, layers=12, heads=12,
+                 intermediate=None, max_positions=1024, dropout=0.1,
+                 attn_dropout=0.1):
+        super().__init__()
+        intermediate = intermediate or 4 * hidden
+        self.hidden = hidden
+        self.max_positions = max_positions
+        self.tok_emb = nn.Embedding(vocab_size, hidden)
+        self.pos_emb = nn.Embedding(max_positions, hidden)
+        # GPT initializer_range=0.02 (nn.Embedding draws std-1 normals; the
+        # tied head would otherwise see logits of std ~sqrt(hidden))
+        for emb in (self.tok_emb, self.pos_emb):
+            emb.weight.data = emb.weight.data * 0.02
+        self.drop = nn.Dropout(dropout)
+        self.blocks = nn.ModuleList([
+            GptBlock(hidden, heads, intermediate, dropout, attn_dropout)
+            for _ in range(layers)])
+        self.ln_f = FusedLayerNorm(hidden)
+
+    def forward(self, ctx, input_ids):
+        b, s = input_ids.shape
+        if s > self.max_positions:
+            # jax gather clamps out-of-range indices, so oversized inputs
+            # would silently reuse the last position embedding (torch
+            # errors here)
+            raise ValueError(
+                f"sequence length {s} exceeds max_positions "
+                f"{self.max_positions}")
+        pos = jnp.arange(s, dtype=jnp.int32)[None, :]
+        x = self.tok_emb.forward(ctx, input_ids) \
+            + self.pos_emb.forward(ctx, pos)
+        x = self.drop.forward(ctx, x)
+        x = jnp.swapaxes(x, 0, 1)          # (S, B, E)
+        for blk in self.blocks:
+            x = blk.forward(ctx, x)
+        x = self.ln_f.forward(ctx, x)
+        x = jnp.swapaxes(x, 0, 1)          # (B, S, E)
+        emb = ctx.value(self.tok_emb.weight)
+        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype))
+
+
+def gpt2_small(**kw):
+    """GPT-2 small geometry: 12 layers, hidden 768, 12 heads (124M)."""
+    return GptModel(**{**dict(hidden=768, layers=12, heads=12), **kw})
+
+
+def gpt2_medium(**kw):
+    """GPT-2 medium geometry: 24 layers, hidden 1024, 16 heads (350M)."""
+    return GptModel(**{**dict(hidden=1024, layers=24, heads=16), **kw})

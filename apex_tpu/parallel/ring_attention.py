@@ -125,31 +125,31 @@ def _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode):
     out = jnp.zeros((bh, sq, d), _f32)
     lse = jnp.full((bh, sq), -jnp.inf, _f32)
     perm = [(i, (i + 1) % n) for i in range(n)]
-    if n <= UNROLL_LIMIT:
-        k_cur, v_cur = k3, v3
-        for r in range(n):
-            src = (idx - r) % n         # which global chunk we hold now
-            bias = _chunk_bias(sq, sk, idx * sq, src * sk, causal)
-            o_r, lse_r = _chunk_fwd(q3, k_cur, v_cur, bias, scale, mode)
-            out, lse = _merge(out, lse, o_r, lse_r)
-            if r != n - 1:
-                k_cur = lax.ppermute(k_cur, axis_name, perm)
-                v_cur = lax.ppermute(v_cur, axis_name, perm)
-        return out, lse
 
-    def body(r, carry):
-        out, lse, k_cur, v_cur = carry
-        src = (idx - r) % n
+    def step(r, out, lse, k_cur, v_cur, rotate):
+        """One ring step, shared by the unrolled and fori paths; ``rotate``
+        controls the trailing hop (the unrolled path elides the last one)."""
+        src = (idx - r) % n             # which global chunk we hold now
         bias = _chunk_bias(sq, sk, idx * sq, src * sk, causal)
         o_r, lse_r = _chunk_fwd(q3, k_cur, v_cur, bias, scale, mode)
         out, lse = _merge(out, lse, o_r, lse_r)
-        # unconditional rotate (one extra hop total vs the unrolled path;
-        # n hops return k/v to their owners, so the carry stays consistent)
-        k_cur = lax.ppermute(k_cur, axis_name, perm)
-        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        if rotate:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
         return out, lse, k_cur, v_cur
 
-    out, lse, _, _ = lax.fori_loop(0, n, body, (out, lse, k3, v3))
+    if n <= UNROLL_LIMIT:
+        k_cur, v_cur = k3, v3
+        for r in range(n):
+            out, lse, k_cur, v_cur = step(r, out, lse, k_cur, v_cur,
+                                          rotate=(r != n - 1))
+        return out, lse
+
+    # fori body rotates unconditionally (one extra hop total vs the
+    # unrolled path; n hops return k/v to their owners, so the carry
+    # stays consistent)
+    out, lse, _, _ = lax.fori_loop(
+        0, n, lambda r, c: step(r, *c, rotate=True), (out, lse, k3, v3))
     return out, lse
 
 
@@ -175,30 +175,13 @@ def _ring_vjp_bwd(axis_name, causal, scale, mode, res, g):
     dq = jnp.zeros(q3.shape, _f32)
     dk_cur = jnp.zeros(k3.shape, _f32)
     dv_cur = jnp.zeros(v3.shape, _f32)
-    if n <= UNROLL_LIMIT:
-        k_cur, v_cur = k3, v3
-        for r in range(n):
-            src = (idx - r) % n
-            bias = _chunk_bias(sq, sk, idx * sq, src * sk, causal)
-            dq_r, dk_r, dv_r = _chunk_bwd(q3, k_cur, v_cur, bias, out_c,
-                                          lse, g_c, scale, mode)
-            dq = dq + dq_r.astype(_f32)
-            dk_cur = dk_cur + dk_r.astype(_f32)
-            dv_cur = dv_cur + dv_r.astype(_f32)
-            # dK/dV accumulators rotate WITH their chunk; n single-hop
-            # permutes return every accumulator to the chunk's owner.  K/V
-            # themselves are dead after the last compute — only the
-            # accumulators take that hop.
-            if r != n - 1:
-                k_cur = lax.ppermute(k_cur, axis_name, perm)
-                v_cur = lax.ppermute(v_cur, axis_name, perm)
-            dk_cur = lax.ppermute(dk_cur, axis_name, perm)
-            dv_cur = lax.ppermute(dv_cur, axis_name, perm)
-        return (dq.astype(q3.dtype), dk_cur.astype(k3.dtype),
-                dv_cur.astype(v3.dtype))
 
-    def body(r, carry):
-        dq, dk_cur, dv_cur, k_cur, v_cur = carry
+    def step(r, dq, dk_cur, dv_cur, k_cur, v_cur, rotate_kv):
+        """One backward ring step (shared unrolled/fori).  dK/dV
+        accumulators rotate WITH their chunk; n single-hop permutes return
+        every accumulator to the chunk's owner.  K/V themselves are dead
+        after the last compute — only the accumulators must take that hop,
+        so the unrolled path elides the final K/V rotate (``rotate_kv``)."""
         src = (idx - r) % n
         bias = _chunk_bias(sq, sk, idx * sq, src * sk, causal)
         dq_r, dk_r, dv_r = _chunk_bwd(q3, k_cur, v_cur, bias, out_c, lse,
@@ -206,14 +189,23 @@ def _ring_vjp_bwd(axis_name, causal, scale, mode, res, g):
         dq = dq + dq_r.astype(_f32)
         dk_cur = dk_cur + dk_r.astype(_f32)
         dv_cur = dv_cur + dv_r.astype(_f32)
-        k_cur = lax.ppermute(k_cur, axis_name, perm)
-        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        if rotate_kv:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
         dk_cur = lax.ppermute(dk_cur, axis_name, perm)
         dv_cur = lax.ppermute(dv_cur, axis_name, perm)
         return dq, dk_cur, dv_cur, k_cur, v_cur
 
-    dq, dk_cur, dv_cur, _, _ = lax.fori_loop(
-        0, n, body, (dq, dk_cur, dv_cur, k3, v3))
+    if n <= UNROLL_LIMIT:
+        k_cur, v_cur = k3, v3
+        for r in range(n):
+            dq, dk_cur, dv_cur, k_cur, v_cur = step(
+                r, dq, dk_cur, dv_cur, k_cur, v_cur,
+                rotate_kv=(r != n - 1))
+    else:
+        dq, dk_cur, dv_cur, _, _ = lax.fori_loop(
+            0, n, lambda r, c: step(r, *c, rotate_kv=True),
+            (dq, dk_cur, dv_cur, k3, v3))
     return (dq.astype(q3.dtype), dk_cur.astype(k3.dtype),
             dv_cur.astype(v3.dtype))
 

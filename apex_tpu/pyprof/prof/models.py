@@ -50,6 +50,11 @@ def _gemm_family(row):
     shapes = row["shapes"]
     dtype = (row["dtypes"] or ["float32"])[0]
     op = row["op"]
+    if len(shapes) < 2:
+        # tape matmul against a non-array operand (plain list rhs lifted by
+        # the tape): the rhs shape was not recorded, so no gemm dims exist
+        # — degrade to a coarse elementwise estimate instead of crashing
+        return _elemwise(row, 2)
     if op == "linear":
         x, w = shapes[0], shapes[1]
         m = _numel(x[:-1])
@@ -66,7 +71,12 @@ def _gemm_family(row):
         a = [1] + a
     if len(b) == 1:
         b = b + [1]
-    batch = _numel(a[:-2])
+    # batch dims broadcast between the operands; either side may carry them
+    try:
+        import numpy as _np
+        batch = _numel(_np.broadcast_shapes(tuple(a[:-2]), tuple(b[:-2])))
+    except ValueError:
+        batch = max(_numel(a[:-2]), _numel(b[:-2]))
     m, k, n = a[-2], a[-1], b[-1]
     flops = 2 * batch * m * k * n
     bytes_ = batch * (m * k + k * n + m * n) * _ds(dtype)
@@ -134,13 +144,18 @@ _MOVE_OPS = ("transpose", "getitem", "getitem_dyn", "astype")
 
 
 def _broadcast_shape(row):
-    """Largest-numel operand shape: binary tape ops broadcast, and the
-    result (and work) follows the larger side."""
-    best = [0]
-    for s in row["shapes"]:
-        if s and _numel(s) > _numel(best):
-            best = s
-    return best
+    """Elementwise broadcast of all recorded operand shapes — the result
+    (and the work) follows the broadcast, not either single operand
+    (outer-product-style [N,1]*[1,M] produces N*M elements).  Scalars
+    broadcast to shape ()."""
+    import numpy as _np
+    shapes = [tuple(s) for s in row["shapes"] if s is not None]
+    if not shapes:
+        return []
+    try:
+        return list(_np.broadcast_shapes(*shapes))
+    except ValueError:           # incompatible (shouldn't happen): max side
+        return max(shapes, key=_numel)
 
 
 def _binary_elemwise(row, cost, passes=3):

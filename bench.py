@@ -283,6 +283,41 @@ def run_bert_throughput(batch, seq_len, iters, warmup):
                               lambda: 6.0 * 110e6 * batch * seq_len)
 
 
+def run_gpt_throughput(batch, seq_len, iters, warmup):
+    """GPT-2-small causal-LM train step: next-token loss with FusedAdam
+    under the bf16 fused step (the autoregressive counterpart of the BERT
+    config; no reference analogue — the reference ships no LMs)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.models import gpt2_small
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    stage("model_build", f"gpt2_small batch={batch} seq={seq_len}")
+    nn.manual_seed(0)
+    vocab = 50257
+    model = gpt2_small(max_positions=seq_len)
+    opt = FusedAdam(list(model.parameters()), lr=6e-4, weight_decay=0.1)
+
+    def lm_loss(logits, ids):
+        flat = logits[:, :-1].reshape((-1, vocab))
+        tgt = ids[:, 1:].reshape((-1,))
+        return F.cross_entropy(flat, tgt)
+
+    step = make_train_step(model, opt, lm_loss,
+                           half_dtype=jnp.bfloat16, loss_scale=1.0)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq_len)))
+
+    stage("compile", f"gpt batch={batch}")
+    # 6 * params * tokens (fwd+bwd), params ~124M
+    return time_compiled_step(step, (ids, ids), iters, warmup,
+                              lambda: 6.0 * 124e6 * batch * seq_len)
+
+
 def run_throughput(batch, iters, warmup):
     import jax.numpy as jnp
     import numpy as np
@@ -321,6 +356,8 @@ def main():
     ap.add_argument("--bert", action="store_true",
                     help="run the BERT-base pretrain config (BASELINE.md 4) "
                          "instead of ResNet-50")
+    ap.add_argument("--gpt", action="store_true",
+                    help="run the GPT-2-small causal-LM config")
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--no-kernels", action="store_true",
                     help="skip the kernel parity checks")
@@ -354,7 +391,7 @@ def main():
     # per-config default batch; an explicitly requested batch is honored
     first_batch = args.batch
     if first_batch is None:
-        first_batch = 64 if args.bert else 128
+        first_batch = 64 if (args.bert or args.gpt) else 128
         log(f"default batch: {first_batch}")
     for batch in [first_batch, first_batch // 2, first_batch // 4]:
         if batch < 1:
@@ -362,6 +399,9 @@ def main():
         try:
             if args.bert:
                 dt, compile_s, flops, flops_source = run_bert_throughput(
+                    batch, args.seq_len, args.iters, args.warmup)
+            elif args.gpt:
+                dt, compile_s, flops, flops_source = run_gpt_throughput(
                     batch, args.seq_len, args.iters, args.warmup)
             else:
                 dt, compile_s, flops, flops_source = run_throughput(
@@ -393,6 +433,10 @@ def main():
     stage("report")
     if args.bert:
         metric = (f"bert_base_mlm_seq{args.seq_len}_"
+                  "sequences_per_sec_per_chip_ampO2")
+        unit, vs_baseline = "sequences/sec/chip", None
+    elif args.gpt:
+        metric = (f"gpt2_small_causal_lm_seq{args.seq_len}_"
                   "sequences_per_sec_per_chip_ampO2")
         unit, vs_baseline = "sequences/sec/chip", None
     else:

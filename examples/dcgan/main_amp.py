@@ -6,6 +6,10 @@ examples/dcgan/main_amp.py, 274 LoC — the example exercising
 Three scaled losses per iteration: D-real (loss_id 0), D-fake (1), G (2),
 each with its own LossScaler so one loss overflowing doesn't shrink the
 others' scales.  ``--synthetic`` (default) trains on noise images.
+
+``--fused`` runs the same iteration through ``make_gan_train_step``
+instead: the whole alternating D/G update compiles into one XLA
+executable (per-network scalers, same reference ordering).
 """
 import argparse
 
@@ -28,6 +32,8 @@ def parse_args():
     p.add_argument("--lr", type=float, default=2e-4)
     p.add_argument("--opt-level", default="O1",
                    choices=["O0", "O1", "O2", "O3"])
+    p.add_argument("--fused", action="store_true",
+                   help="one-executable GAN iteration (make_gan_train_step)")
     return p.parse_args()
 
 
@@ -55,6 +61,39 @@ def build_discriminator(ndf):
         nn.Flatten(0))
 
 
+def run_fused(args, netD, netG, optD, optG):
+    """The same three-loss iteration as one compiled executable."""
+    from apex_tpu.nn import functional as F
+    from apex_tpu.training import make_gan_train_step
+
+    def d_loss(out_r, out_f):
+        ones = jnp.ones_like(out_r)
+        zeros = jnp.zeros_like(out_f)
+        return (F.binary_cross_entropy_with_logits(out_r, ones)
+                + F.binary_cross_entropy_with_logits(out_f, zeros))
+
+    def g_loss(out_f):
+        return F.binary_cross_entropy_with_logits(
+            out_f, jnp.ones_like(out_f))
+
+    half = jnp.bfloat16 if args.opt_level in ("O2", "O3") else None
+    scale = 1.0 if args.opt_level in ("O0", "O3") else "dynamic"
+    step = make_gan_train_step(netD, netG, optD, optG, d_loss, g_loss,
+                               half_dtype=half, loss_scale=scale)
+    rng = np.random.default_rng(0)
+    for it in range(args.iters):
+        real = jnp.asarray(
+            rng.standard_normal(
+                (args.batch_size, 3, args.image_size, args.image_size)),
+            jnp.float32)
+        noise = jnp.asarray(
+            rng.standard_normal((args.batch_size, args.nz, 1, 1)),
+            jnp.float32)
+        errD, errG = step(real, noise)
+        print(f"[{it}/{args.iters}] Loss_D {float(errD):.4f} "
+              f"Loss_G {float(errG):.4f}")
+
+
 def main():
     args = parse_args()
     nn.manual_seed(0)
@@ -62,6 +101,9 @@ def main():
     netD = build_discriminator(args.ndf)
     optG = FusedAdam(list(netG.parameters()), lr=args.lr, betas=(0.5, 0.999))
     optD = FusedAdam(list(netD.parameters()), lr=args.lr, betas=(0.5, 0.999))
+
+    if args.fused:
+        return run_fused(args, netD, netG, optD, optG)
 
     # the multi-model/multi-optimizer/multi-loss form (reference :214-215)
     [netD, netG], [optD, optG] = amp.initialize(

@@ -40,3 +40,18 @@ def test_bert_example_runs():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "final loss:" in out.stdout
     assert "seq/s" in out.stdout
+
+
+def test_dcgan_fused_example_runs():
+    env = dict(os.environ, PYTHONPATH=REPO)
+    script = os.path.join(REPO, "examples", "dcgan", "main_amp.py")
+    code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.argv = ['main_amp.py', '--fused', "
+            f"'--iters', '3', '--batch-size', '4', '--opt-level', 'O2']; "
+            f"import runpy; runpy.run_path({script!r}, "
+            f"run_name='__main__')")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Loss_D" in out.stdout and "Loss_G" in out.stdout

@@ -124,3 +124,23 @@ def test_dropout_path_runs(rng):
     x = jnp.asarray(rng.standard_normal((t, b, e)), jnp.float32)
     out, _ = m(x, x, x)
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("impl", ["default", "fast"])
+def test_causal_flag_matches_explicit_time_mask(rng, impl):
+    """SelfMultiheadAttn(causal=True) must equal the same module fed an
+    explicit upper-triangle time mask (the in-kernel triangle vs the
+    materialized O(S^2) operand)."""
+    t, b, e = 16, 2, 32
+    nn.manual_seed(9)
+    m_causal = SelfMultiheadAttn(e, 4, dropout=0.0, impl=impl,
+                                 causal=True).eval()
+    nn.manual_seed(9)
+    m_masked = SelfMultiheadAttn(e, 4, dropout=0.0, impl=impl).eval()
+    x = jnp.asarray(rng.standard_normal((t, b, e)), jnp.float32)
+    tri = np.triu(np.ones((t, t), bool), k=1)  # True = excluded
+    with force_mode("interpret"):
+        out_c, _ = m_causal(x)
+        out_m, _ = m_masked(x, attn_mask=jnp.asarray(tri))
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_m),
+                               rtol=2e-5, atol=2e-5)
