@@ -35,8 +35,8 @@ FP32_FUNCS = [
     "acos", "asin", "cosh", "erfinv", "exp", "expm1",
     "log", "log10", "log2", "reciprocal", "rsqrt", "sinh", "tan", "pow",
     # normalization
-    "layer_norm", "group_norm", "batch_norm", "local_response_norm",
-    "normalize", "cosine_similarity",
+    "layer_norm", "group_norm", "instance_norm", "batch_norm",
+    "local_response_norm", "normalize", "cosine_similarity",
     # losses
     "cross_entropy", "nll_loss", "l1_loss", "mse_loss", "smooth_l1_loss",
     "kl_div", "poisson_nll_loss", "cosine_embedding_loss",

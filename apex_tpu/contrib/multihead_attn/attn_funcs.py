@@ -151,7 +151,7 @@ def _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout_prob, key,
     if use_time_mask_causal:
         rows = jnp.arange(sq)[:, None]
         cols = jnp.arange(s.shape[-1])[None, :]
-        s = jnp.where(rows >= cols, s, jnp.float32(-1e30))
+        s = jnp.where(rows >= cols, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     if dropout_prob > 0.0:
         if key is None:

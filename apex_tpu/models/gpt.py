@@ -28,8 +28,10 @@ class GptBlock(nn.Module):
                  attn_dropout=0.1):
         super().__init__()
         self.ln1 = FusedLayerNorm(hidden)
-        # causal=True: the flash kernel masks the triangle in-kernel, so
-        # no O(S^2) mask operand is materialized or streamed per layer
+        # causal=True: when the flash path applies (attn_dropout == 0 in
+        # training, or eval) the kernel masks the triangle in-kernel with
+        # no O(S^2) mask operand; with attention dropout active the
+        # materializing fallback runs (the Pallas kernel has no dropout)
         self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
                                       impl="fast", causal=True)
         self.ln2 = FusedLayerNorm(hidden)

@@ -4,6 +4,7 @@ from .modules import (  # noqa: F401
     AdaptiveAvgPool2d, AvgPool2d, BatchNorm1d, BatchNorm2d, BatchNorm3d,
     BCELoss, BCEWithLogitsLoss, Buffer, Conv1d, Conv2d, Conv3d,
     ConvTranspose2d, CrossEntropyLoss, Ctx, Dropout, Embedding, Flatten,
-    GELU, Identity, L1Loss, LayerNorm, LeakyReLU, Linear, MaxPool2d,
+    GELU, GroupNorm, Identity, InstanceNorm1d, InstanceNorm2d,
+    InstanceNorm3d, L1Loss, LayerNorm, LeakyReLU, Linear, MaxPool2d,
     Module, ModuleList, MSELoss, NLLLoss, ReLU, Sequential, Sigmoid,
     Softmax, Tanh, _BatchNorm, manual_seed)

@@ -222,6 +222,74 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     return y.astype(x.dtype), new_rm, new_rv
 
 
+@_policied("group_norm")
+def group_norm(x, num_groups, weight=None, bias=None, eps=1e-5):
+    """torch.nn.functional.group_norm semantics: x (N, C, *spatial),
+    statistics over each group's channels+spatial, per-channel affine."""
+    n, c = x.shape[0], x.shape[1]
+    if c % num_groups:
+        raise ValueError(
+            f"group_norm: channels ({c}) not divisible by num_groups "
+            f"({num_groups})")
+    xf = x.astype(jnp.float32).reshape((n, num_groups, c // num_groups)
+                                       + x.shape[2:])
+    axes = tuple(range(2, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = ((xf - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    pshape = (1, c) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32).reshape(pshape)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(pshape)
+    return y.astype(x.dtype)
+
+
+@_policied("instance_norm")
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.1, eps=1e-5):
+    """torch.nn.functional.instance_norm semantics: per-sample per-channel
+    statistics over spatial dims.  Returns (y, new_rm, new_rv) — running
+    stats (when tracked) average instance stats over the batch, matching
+    torch's train-mode bookkeeping."""
+    axes = tuple(range(2, x.ndim))
+    spatial = 1
+    for a in axes:
+        spatial *= x.shape[a]
+    if use_input_stats and spatial <= 1:
+        # per-instance variance over <=1 element is 0: the output would
+        # silently collapse to the bias (torch raises the same way)
+        raise ValueError(
+            f"instance_norm: expected more than 1 spatial element when "
+            f"computing input stats, got input shape {tuple(x.shape)}")
+    xf = x.astype(jnp.float32)
+    if use_input_stats:
+        mean = jnp.mean(xf, axis=axes, keepdims=True)       # (N, C, 1...)
+        var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+        new_rm = new_rv = None
+        if running_mean is not None:
+            count = 1.0
+            for a in axes:
+                count *= x.shape[a]
+            unbiased = var * (count / max(count - 1.0, 1.0))
+            new_rm = (1 - momentum) * running_mean \
+                + momentum * jnp.mean(mean, axis=0).reshape(-1)
+            new_rv = (1 - momentum) * running_var \
+                + momentum * jnp.mean(unbiased, axis=0).reshape(-1)
+    else:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        mean = running_mean.reshape(shape)
+        var = running_var.reshape(shape)
+        new_rm, new_rv = running_mean, running_var
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    pshape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32).reshape(pshape)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(pshape)
+    return y.astype(x.dtype), new_rm, new_rv
+
+
 @_policied("layer_norm")
 def layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5):
     n = len(normalized_shape) if isinstance(normalized_shape, (tuple, list)) \

@@ -443,6 +443,81 @@ class LayerNorm(Module):
         return F.layer_norm(x, self.normalized_shape, w, b, self.eps)
 
 
+class GroupNorm(Module):
+    def __init__(self, num_groups, num_channels, eps=1e-5, affine=True):
+        super().__init__()
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.eps = eps
+        if affine:
+            self.weight = Parameter(jnp.ones((num_channels,), jnp.float32))
+            self.bias = Parameter(jnp.zeros((num_channels,), jnp.float32))
+        else:
+            self.register_parameter("weight", None)
+            self.register_parameter("bias", None)
+
+    def forward(self, ctx, x):
+        w = ctx.value(self.weight) if self.weight is not None else None
+        b = ctx.value(self.bias) if self.bias is not None else None
+        return F.group_norm(x, self.num_groups, w, b, self.eps)
+
+
+class _InstanceNorm(Module):
+    """torch defaults: affine=False, track_running_stats=False (unlike
+    BatchNorm); eval with tracked stats normalizes by the running pair."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=False,
+                 track_running_stats=False):
+        super().__init__()
+        self.num_features = num_features
+        self.eps = eps
+        self.momentum = momentum
+        self.track_running_stats = track_running_stats
+        if affine:
+            self.weight = Parameter(jnp.ones((num_features,), jnp.float32))
+            self.bias = Parameter(jnp.zeros((num_features,), jnp.float32))
+        else:
+            self.register_parameter("weight", None)
+            self.register_parameter("bias", None)
+        if track_running_stats:
+            self.register_buffer("running_mean",
+                                 jnp.zeros((num_features,), jnp.float32))
+            self.register_buffer("running_var",
+                                 jnp.ones((num_features,), jnp.float32))
+        else:
+            self.register_buffer("running_mean", None)
+            self.register_buffer("running_var", None)
+
+    def forward(self, ctx, x):
+        training = ctx.training and self.training
+        w = ctx.value(self.weight) if self.weight is not None else None
+        b = ctx.value(self.bias) if self.bias is not None else None
+        rm = ctx.value(self.running_mean) if self.track_running_stats \
+            else None
+        rv = ctx.value(self.running_var) if self.track_running_stats \
+            else None
+        use_input_stats = training or not self.track_running_stats
+        y, new_rm, new_rv = F.instance_norm(
+            x, rm, rv, w, b, use_input_stats=use_input_stats,
+            momentum=self.momentum, eps=self.eps)
+        if training and self.track_running_stats and new_rm is not None:
+            ctx.write_stat(self.running_mean, new_rm)
+            ctx.write_stat(self.running_var, new_rv)
+        return y
+
+
+class InstanceNorm1d(_InstanceNorm):
+    pass
+
+
+class InstanceNorm2d(_InstanceNorm):
+    pass
+
+
+class InstanceNorm3d(_InstanceNorm):
+    pass
+
+
 class Embedding(Module):
     def __init__(self, num_embeddings, embedding_dim):
         super().__init__()

@@ -52,3 +52,105 @@ def test_adaptive_avg_pool2d_matches_torch(rng):
         assert ours.shape == tuple(ref.shape)
         np.testing.assert_allclose(np.asarray(ours), ref.numpy(),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_group_norm_matches_torch(rng):
+    import torch
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+
+    x = rng.standard_normal((4, 12, 5, 7)).astype(np.float32)
+    w = rng.standard_normal((12,)).astype(np.float32)
+    b = rng.standard_normal((12,)).astype(np.float32)
+    want = torch.nn.functional.group_norm(
+        torch.from_numpy(x), 3, torch.from_numpy(w), torch.from_numpy(b),
+        eps=1e-5).numpy()
+    got = F.group_norm(jnp.asarray(x), 3, jnp.asarray(w), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+    # module form
+    m = nn.GroupNorm(3, 12)
+    m.weight.data = jnp.asarray(w)
+    m.bias.data = jnp.asarray(b)
+    got = m(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
+def test_group_norm_rejects_indivisible():
+    import pytest
+    from apex_tpu.nn import functional as F
+    with pytest.raises(ValueError, match="divisible"):
+        F.group_norm(jnp.zeros((2, 10, 4, 4)), 3)
+
+
+def test_instance_norm_matches_torch(rng):
+    import torch
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+
+    x = rng.standard_normal((4, 6, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((6,)).astype(np.float32)
+    b = rng.standard_normal((6,)).astype(np.float32)
+    want = torch.nn.functional.instance_norm(
+        torch.from_numpy(x), weight=torch.from_numpy(w),
+        bias=torch.from_numpy(b), eps=1e-5).numpy()
+    got, _, _ = F.instance_norm(jnp.asarray(x), weight=jnp.asarray(w),
+                                bias=jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+    m = nn.InstanceNorm2d(6, affine=True)
+    m.weight.data = jnp.asarray(w)
+    m.bias.data = jnp.asarray(b)
+    got = m(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
+def test_instance_norm_running_stats_match_torch(rng):
+    import torch
+    import apex_tpu.nn as nn
+
+    x1 = rng.standard_normal((4, 6, 8, 8)).astype(np.float32)
+    x2 = rng.standard_normal((4, 6, 8, 8)).astype(np.float32)
+
+    tm = torch.nn.InstanceNorm2d(6, track_running_stats=True)
+    tm.train()
+    tm(torch.from_numpy(x1))
+    tm(torch.from_numpy(x2))
+    tm.eval()
+    x3 = rng.standard_normal((2, 6, 8, 8)).astype(np.float32)
+    want = tm(torch.from_numpy(x3)).numpy()
+
+    m = nn.InstanceNorm2d(6, track_running_stats=True)
+    m.train()
+    m(jnp.asarray(x1))
+    m(jnp.asarray(x2))
+    np.testing.assert_allclose(np.asarray(m.running_mean.data),
+                               tm.running_mean.numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m.running_var.data),
+                               tm.running_var.numpy(), rtol=1e-5,
+                               atol=1e-6)
+    m.eval()
+    got = m(jnp.asarray(x3))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
+def test_group_norm_grads_flow(rng):
+    import apex_tpu.nn as nn
+    model = nn.Sequential(nn.Conv2d(3, 8, 3, padding=1), nn.GroupNorm(2, 8),
+                          nn.ReLU(), nn.Flatten(), nn.Linear(8 * 64, 4))
+    x = jnp.asarray(rng.standard_normal((2, 3, 8, 8)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (2,)))
+    loss = nn.CrossEntropyLoss()(model(x), y)
+    loss.backward()
+    assert all(p.grad is not None for p in model.parameters())
+
+
+def test_instance_norm_rejects_degenerate_spatial():
+    import pytest
+    import apex_tpu.nn as nn
+    with pytest.raises(ValueError, match="spatial"):
+        nn.InstanceNorm2d(6)(jnp.zeros((4, 6, 1, 1)))
+    with pytest.raises(ValueError, match="spatial"):
+        from apex_tpu.nn import functional as F
+        F.instance_norm(jnp.zeros((4, 6)))
