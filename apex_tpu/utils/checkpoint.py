@@ -1,0 +1,47 @@
+"""Checkpoint save/load — the ``torch.save``/``torch.load`` role for the
+three-part {model, optimizer, amp} checkpoint the reference documents
+(README.md:59-99 there; amp state restore after ``amp.initialize`` with the
+same opt_level for bitwise-accurate resume).
+
+Device arrays are fetched to host numpy at save time (one sync, like
+torch.save) and the container is pickled; loaders re-device through the
+existing ``load_state_dict`` paths which call ``jnp.asarray``.
+
+Resume exactness: scaler state, fp32 model weights (O2's fp32 state-dict
+hook) and optimizer slots round-trip exactly; O2 *master* weights are
+lazily re-derived from the fp16 model params after restore, so post-resume
+trajectories can drift at fp16 rounding scale — same property as the
+reference's documented O2 workflow.  For exact fp32-master checkpoints use
+the legacy ``fp16_utils.FP16_Optimizer.state_dict``, which stores the
+fp32 groups explicitly.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import numpy as np
+
+
+def _to_host(tree):
+    def conv(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def save_checkpoint(path: str, **components):
+    """``save_checkpoint(path, model=model.state_dict(), optimizer=
+    opt.state_dict(), amp=amp.state_dict(), epoch=...)`` — any picklable
+    values; jax arrays anywhere in the trees are fetched to host first."""
+    with open(path, "wb") as f:
+        pickle.dump({k: _to_host(v) for k, v in components.items()}, f)
+
+
+def load_checkpoint(path: str) -> dict:
+    """Load a checkpoint written by :func:`save_checkpoint`.  Arrays come
+    back as host numpy; feed the sub-dicts to the matching
+    ``load_state_dict`` (model / optimizer / amp), which re-device them."""
+    with open(path, "rb") as f:
+        return pickle.load(f)

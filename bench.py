@@ -69,27 +69,80 @@ def start_watchdog(budget_s):
     return t
 
 
-def init_backend(retries=4):
+def _run_with_timeout(fn, timeout_s, wedge_msg):
+    """Run ``fn`` in a daemon thread; on timeout emit the named diagnostic
+    JSON and hard-exit (a wedged axon tunnel hangs uninterruptibly — both
+    PJRT client creation and the first compute have been observed to block
+    for hours when the remote end holds a dead client's claim)."""
+    done = {}
+
+    def _target():
+        try:
+            done["val"] = fn()
+        except Exception as e:          # noqa: BLE001 — re-raised below
+            done["err"] = e
+
+    t = threading.Thread(target=_target, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "err" in done:
+        raise done["err"]
+    if t.is_alive() or "val" not in done:
+        fail(wedge_msg)
+        os._exit(4)
+    return done["val"]
+
+
+def init_backend(retries=4, probe_timeout_s=75):
     import jax
     last = None
     for attempt in range(retries):
         try:
-            ds = jax.devices()
+            ds = _run_with_timeout(
+                jax.devices, probe_timeout_s,
+                "backend_wedged: PJRT client creation (jax.devices) did "
+                f"not complete within {probe_timeout_s}s — the axon tunnel "
+                "is likely holding a dead client's claim")
             log(f"backend up: {len(ds)}x {ds[0].device_kind or ds[0].platform}")
-            return ds
+            break
         except Exception as e:  # backend init failures cache; clear + retry
             last = e
             wait = 10 * (attempt + 1)
             log(f"backend init failed: {type(e).__name__}: {e}; "
                 f"retry {attempt + 1}/{retries - 1} in {wait}s")
             if attempt == retries - 1:
-                break
+                raise RuntimeError(
+                    f"backend init failed after {retries} attempts: {last}")
             time.sleep(wait)
             try:
                 jax.extend.backend.clear_backends()
             except Exception:
                 pass
-    raise RuntimeError(f"backend init failed after {retries} attempts: {last}")
+
+    # device LISTING is local and succeeds even when the tunnel is wedged
+    # (observed: a client killed mid-step can wedge the remote end for
+    # hours); prove the backend actually computes before spending the
+    # whole watchdog budget on a doomed model compile
+    stage("backend_probe", f"{probe_timeout_s}s limit")
+    import jax.numpy as jnp
+
+    def _probe():
+        x = jnp.ones((128, 128))
+        return float(jnp.sum(x @ x))
+
+    try:
+        val = _run_with_timeout(
+            _probe, probe_timeout_s,
+            "backend_wedged: device listing works but a trivial compute "
+            f"did not complete within {probe_timeout_s}s — the axon "
+            "tunnel is likely holding a dead client's claim")
+    except Exception as e:
+        # a raising probe is a normal backend error, not a wedge;
+        # retrying won't help (jax caches the initialized backend)
+        raise RuntimeError(
+            f"backend compute probe failed: {type(e).__name__}: {e}")
+    log(f"backend probe ok ({val:.0f})")
+    return ds
 
 
 def peak_tflops(device):
