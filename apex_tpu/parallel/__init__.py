@@ -17,6 +17,9 @@ from .LARC import LARC  # noqa: F401
 from .ring_attention import (  # noqa: F401
     ring_attention, ulysses_attention)
 from .sync_batchnorm import SyncBatchNorm  # noqa: F401
+from .tensor_parallel import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, column_parallel_linear,
+    row_parallel_linear)
 
 
 def convert_syncbn_model(module, process_group=None, channel_last=False,

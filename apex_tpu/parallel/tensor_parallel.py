@@ -1,0 +1,126 @@
+"""Tensor (model) parallelism over a mesh axis — Megatron-style sharded
+linears.
+
+The reference has no model parallelism (SURVEY.md §2.3 — data parallelism
+is its only strategy); on TPU the pattern is a first-class citizen of the
+mesh, so the framework provides the two canonical building blocks.  Both
+are meant to run inside ``shard_map``/``pjit`` with the weight shards
+resident per device:
+
+* ``column_parallel_linear`` — W is split along the OUTPUT features: each
+  device computes ``x @ W_i^T`` for its slice, producing the output's
+  feature shard.  No communication on the forward; an optional
+  ``all_gather`` returns the full output.
+* ``row_parallel_linear`` — W is split along the INPUT features: each
+  device contracts its input shard against its weight slice and the
+  partial products are ``psum``'d.  The bias is added once, after the
+  reduction.
+
+Chained column→row (the transformer MLP/attention pattern) needs exactly
+one collective per pair: the column layer's sharded output feeds the row
+layer's sharded input directly, and only the row layer reduces.  Gradients
+need no extra hand-written collectives — ``psum``/``all_gather`` are
+differentiable and the transpose collectives are inserted by JAX.
+
+Module forms (``ColumnParallelLinear`` / ``RowParallelLinear``) hold the
+LOCAL shard as their parameter, constructed from a deterministic full-size
+init so the sharded pair reproduces the unsharded ``nn.Linear`` with the
+same seed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..nn.parameter import Parameter
+
+
+def column_parallel_linear(x, weight_shard, bias_shard=None,
+                           axis_name=None, gather_output=False):
+    """x (..., in); weight_shard (out/n, in); bias_shard (out/n,).
+    Returns (..., out/n), or (..., out) when ``gather_output``."""
+    y = jnp.matmul(x, weight_shard.T)
+    if bias_shard is not None:
+        y = y + bias_shard
+    if gather_output:
+        y = lax.all_gather(y, axis_name, axis=y.ndim - 1, tiled=True)
+    return y
+
+
+def row_parallel_linear(x_shard, weight_shard, bias=None, axis_name=None):
+    """x_shard (..., in/n); weight_shard (out, in/n); bias (out,), added
+    once after the psum.  Returns the full (..., out), replicated."""
+    y = lax.psum(jnp.matmul(x_shard, weight_shard.T), axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _shard_dim(full, axis_name, dim):
+    n = lax.psum(1, axis_name)           # static mesh-axis size
+    if full.shape[dim] % n:
+        # dynamic_slice would silently clamp, dropping trailing features
+        raise ValueError(
+            f"tensor-parallel shard: dimension {dim} of size "
+            f"{full.shape[dim]} is not divisible by the '{axis_name}' "
+            f"axis size {n}")
+    i = lax.axis_index(axis_name)
+    size = full.shape[dim] // n
+    return lax.dynamic_slice_in_dim(full, i * size, size, axis=dim)
+
+
+def _shard_rows(full, axis_name):
+    return _shard_dim(full, axis_name, 0)
+
+
+def _shard_cols(full, axis_name):
+    return _shard_dim(full, axis_name, 1)
+
+
+class ColumnParallelLinear(nn.Module):
+    """nn.Linear with the weight split along output features.  Holds the
+    FULL parameter (so init/checkpoints match the unsharded layer) and
+    slices its own shard per device at forward time; under jit the slice
+    is a static gather XLA folds into the weight layout."""
+
+    def __init__(self, in_features, out_features, axis_name,
+                 bias=True, gather_output=False):
+        super().__init__()
+        ref = nn.Linear(in_features, out_features, bias=bias)
+        self.weight = Parameter(ref.weight.data)
+        if bias:
+            self.bias = Parameter(ref.bias.data)
+        else:
+            self.register_parameter("bias", None)
+        self.axis_name = axis_name
+        self.gather_output = gather_output
+
+    def forward(self, ctx, x):
+        w = _shard_rows(ctx.value(self.weight), self.axis_name)
+        b = None
+        if self.bias is not None:
+            b = _shard_rows(ctx.value(self.bias), self.axis_name)
+        return column_parallel_linear(x, w, b, self.axis_name,
+                                      self.gather_output)
+
+
+class RowParallelLinear(nn.Module):
+    """nn.Linear with the weight split along input features; expects its
+    input already feature-sharded (a column layer's output)."""
+
+    def __init__(self, in_features, out_features, axis_name, bias=True):
+        super().__init__()
+        ref = nn.Linear(in_features, out_features, bias=bias)
+        self.weight = Parameter(ref.weight.data)
+        if bias:
+            self.bias = Parameter(ref.bias.data)
+        else:
+            self.register_parameter("bias", None)
+        self.axis_name = axis_name
+
+    def forward(self, ctx, x_shard):
+        w = _shard_cols(ctx.value(self.weight), self.axis_name)
+        b = ctx.value(self.bias) if self.bias is not None else None
+        return row_parallel_linear(x_shard, w, b, self.axis_name)
