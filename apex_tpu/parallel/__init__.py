@@ -20,6 +20,7 @@ from .sync_batchnorm import SyncBatchNorm  # noqa: F401
 from .tensor_parallel import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, column_parallel_linear,
     row_parallel_linear)
+from .pipeline import pipeline_apply  # noqa: F401
 
 
 def convert_syncbn_model(module, process_group=None, channel_last=False,

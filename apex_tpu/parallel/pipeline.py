@@ -1,0 +1,76 @@
+"""Pipeline parallelism over a mesh axis — GPipe-style microbatch schedule.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3); on TPU the
+mesh-native formulation is compact: each device along the ``pp`` axis owns
+one STAGE's parameters, activations hop stage-to-stage via
+``lax.ppermute``, and the classic fill/drain schedule is a ``lax.scan``
+over ``n_micro + n_stages - 1`` ticks.  Because ppermute is differentiable
+(its transpose is the reverse permute), ``jax.grad`` through the schedule
+yields exact pipeline-parallel gradients with no hand-written backward.
+
+Design notes:
+
+* All devices run the SAME ``stage_fn`` on their own parameter shard —
+  the SPMD formulation (stages must share a structure; width can differ
+  only via padding).  Each device processes whichever microbatch is
+  currently resident; edge ticks process garbage that is masked out of
+  the final gather (the pipeline bubble, priced exactly as in GPipe:
+  (n_stages - 1) bubble ticks).
+* Inputs arrive batch-major ``(n_micro, micro, ...)`` replicated (or
+  sharded on a separate data axis — the two composes); outputs are the
+  last stage's activations for each microbatch, replicated to all
+  stages of the pp axis via the closing gather.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn, stage_params, xs, axis_name):
+    """Run ``n_micro`` microbatches through an ``n_stage`` pipeline.
+
+    ``stage_fn(params, x) -> y`` — one stage's computation; activations
+    must keep one shape across stages.  ``stage_params`` — this device's
+    stage parameters (any pytree).  ``xs`` — ``(n_micro, micro, ...)``,
+    same value on every pp device.  Returns ``(n_micro, micro, ...)``:
+    stage ``n-1``'s output per microbatch, replicated along the axis.
+
+    Call inside ``shard_map``/``pjit`` with ``axis_name`` bound.
+    """
+    n = lax.psum(1, axis_name)              # static stage count
+    idx = lax.axis_index(axis_name)
+    n_micro = xs.shape[0]
+    ticks = n_micro + n - 1
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+    state0 = jnp.zeros_like(xs[0])          # resident activation
+    out0 = jnp.zeros_like(xs)               # collected last-stage outputs
+
+    def tick(carry, t):
+        state, outs = carry
+        # stage 0 ingests microbatch t while t < n_micro (garbage after;
+        # masked below by the collection window)
+        feed = xs[jnp.minimum(t, n_micro - 1)]
+        x_in = jnp.where(idx == 0, feed, state)
+        y = stage_fn(stage_params, x_in)
+        # the last stage emits microbatch (t - n + 1) at tick t
+        m = t - (n - 1)
+        emit = jnp.logical_and(idx == n - 1,
+                               jnp.logical_and(m >= 0, m < n_micro))
+        slot = jnp.clip(m, 0, n_micro - 1)
+        # mask the slice VALUE, not a whole-buffer select: keeps the scan
+        # carry updated in place (O(micro) per tick, not O(n_micro*micro))
+        prev = lax.dynamic_index_in_dim(outs, slot, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(emit, y, prev), slot, 0)
+        # activations advance one stage per tick
+        state = lax.ppermute(y, axis_name, fwd_perm)
+        return (state, outs), None
+
+    (_, outs), _ = lax.scan(tick, (state0, out0), jnp.arange(ticks))
+    # outs is populated only on the last stage; replicate along the axis
+    # (psum of one-hot contribution — every other stage holds zeros)
+    return lax.psum(jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)),
+                    axis_name)
