@@ -21,6 +21,7 @@ from .tensor_parallel import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, column_parallel_linear,
     row_parallel_linear)
 from .pipeline import pipeline_apply  # noqa: F401
+from .expert_parallel import switch_moe  # noqa: F401
 
 
 def convert_syncbn_model(module, process_group=None, channel_last=False,

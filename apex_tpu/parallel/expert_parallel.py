@@ -1,0 +1,77 @@
+"""Expert parallelism over a mesh axis — Switch-style top-1 MoE.
+
+The reference has no MoE/expert parallelism (SURVEY.md §2.3); the
+TPU-native formulation is the canonical one: one expert per device along
+the ``ep`` axis, tokens exchanged with their expert's owner by a pair of
+``lax.all_to_all``s around the expert computation.
+
+Routing math (Switch Transformer):
+
+* top-1 expert per token from a replicated router, gate = that expert's
+  softmax probability;
+* per (source device, expert) capacity ``C = ceil(T_local/E *
+  capacity_factor)``; tokens beyond capacity are DROPPED (contribute
+  zero output — the standard Switch overflow behavior, callers keep the
+  residual path);
+* dispatch/combine are einsums against a (T, E, C) one-hot, so the whole
+  layer is differentiable — gradients flow through the gate (router
+  learns) and through the expert weights; the all_to_alls transpose to
+  themselves.
+
+``expert_fn(params, x)`` runs THIS device's expert on ``(n*C, d)`` — its
+own expert's bucket gathered from every source device.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def switch_moe(x, router_w, expert_params, expert_fn, axis_name,
+               capacity_factor=1.25):
+    """x (T_local, d); router_w (d, E) replicated; expert_params — this
+    device's expert (any pytree).  E must equal the axis size (one expert
+    per device).  Returns (T_local, d): gated expert outputs, zeros for
+    dropped tokens.
+    """
+    n = lax.psum(1, axis_name)              # static: devices == experts
+    t_loc, d = x.shape
+    logits = x @ router_w                   # (T, E)
+    e = logits.shape[-1]
+    if e != n:
+        raise ValueError(
+            f"switch_moe: router has {e} experts but the '{axis_name}' "
+            f"axis has {n} devices; expert parallelism is one expert per "
+            f"device")
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)             # (T,)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    cap = max(1, math.ceil(t_loc / e * capacity_factor))
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)      # (T, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1                # (T, E)
+    pos_t = jnp.max(pos, axis=-1)                        # position, (T,)
+    keep = pos_t < cap
+    # (T, E, C) dispatch one-hot; dropped tokens are all-zero rows
+    disp = (onehot.astype(jnp.float32)[:, :, None]
+            * jax.nn.one_hot(jnp.clip(pos_t, 0, cap - 1), cap,
+                             dtype=jnp.float32)[:, None, :]
+            * keep[:, None, None].astype(jnp.float32))
+
+    buckets = jnp.einsum("tec,td->ecd", disp, x.astype(jnp.float32))
+    # ship bucket e to device e; receive my expert's bucket from every
+    # source: (E, C, d) -> (n_src, C, d), slot i = source device i
+    recv = lax.all_to_all(buckets, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    out = expert_fn(expert_params,
+                    recv.reshape(n * cap, d).astype(x.dtype))
+    out = out.astype(jnp.float32).reshape(n, cap, d)
+    # return results to their sources: slot e = my tokens' outputs from
+    # expert e, aligned with disp's expert axis
+    back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    y = jnp.einsum("tec,ecd->td", disp, back)
+    return (y * gate[:, None].astype(jnp.float32)).astype(x.dtype)
