@@ -55,3 +55,19 @@ def test_dcgan_fused_example_runs():
         capture_output=True, text=True, timeout=300, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "Loss_D" in out.stdout and "Loss_G" in out.stdout
+
+
+def test_gpt_example_runs():
+    env = dict(os.environ, PYTHONPATH=REPO)
+    script = os.path.join(REPO, "examples", "gpt", "main_amp.py")
+    code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.argv = ['main_amp.py', '--steps', '6', "
+            f"'--batch', '2', '--seq-len', '32', '--layers', '2', "
+            f"'--hidden', '64', '--heads', '4', '--print-freq', '2']; "
+            f"import runpy; runpy.run_path({script!r}, "
+            f"run_name='__main__')")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "final loss:" in out.stdout
