@@ -23,3 +23,4 @@ from . import normalization  # noqa: F401,E402
 from . import parallel  # noqa: F401,E402
 from . import fp16_utils  # noqa: F401,E402
 from . import mlp  # noqa: F401,E402
+from . import pyprof  # noqa: F401,E402

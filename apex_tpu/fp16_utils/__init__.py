@@ -1,6 +1,7 @@
 """apex.fp16_utils equivalent (reference apex/fp16_utils/__init__.py)."""
 from .fp16util import (  # noqa: F401
     BN_convert_float,
+    FP16Model,
     clip_grad_norm,
     convert_module,
     convert_network,

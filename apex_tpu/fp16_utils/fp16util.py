@@ -40,6 +40,24 @@ def network_to_half(network: Module, dtype=jnp.bfloat16) -> Module:
     return BN_convert_float(tofp16(network, dtype))
 
 
+class FP16Model(Module):
+    """Module wrapper converting a network to half in a batchnorm-safe way
+    and casting its inputs to half per forward (reference
+    fp16util.py:73-84; default dtype is bf16, the TPU-native half)."""
+
+    def __init__(self, network: Module, dtype=jnp.bfloat16):
+        super().__init__()
+        self.dtype = jnp.dtype(dtype)
+        self.network = convert_network(network, dtype)
+
+    def forward(self, ctx, *inputs):
+        cast = tuple(
+            x.astype(self.dtype) if hasattr(x, "dtype")
+            and jnp.issubdtype(x.dtype, jnp.floating) else x
+            for x in inputs)
+        return self.network.forward(ctx, *cast)
+
+
 def convert_module(module: Module, dtype) -> Module:
     """Cast ONE module's own params/buffers unless it's batchnorm
     (reference fp16util.py:72-88)."""

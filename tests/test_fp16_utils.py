@@ -100,3 +100,20 @@ def test_dynamic_scaler_growth():
     assert s.loss_scale >= 8.0
     s.update_scale(True)
     assert s.loss_scale == 4.0
+
+
+def test_fp16model_wraps_batchnorm_safely(rng):
+    from apex_tpu.fp16_utils import FP16Model
+
+    nn.manual_seed(2)
+    net = nn.Sequential(nn.Conv2d(3, 8, 3, padding=1), nn.BatchNorm2d(8),
+                        nn.ReLU(), nn.Flatten(), nn.Linear(8 * 16, 4))
+    wrapped = FP16Model(net)
+    # conv/linear half, BN stays fp32 (reference fp16util.py:73-84)
+    assert net[0].weight.dtype == jnp.bfloat16
+    assert net[4].weight.dtype == jnp.bfloat16
+    assert net[1].weight.dtype == jnp.float32
+    x = jnp.asarray(rng.standard_normal((2, 3, 4, 4)), jnp.float32)
+    out = wrapped(x)
+    assert out.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(out, np.float32)).all()
