@@ -23,6 +23,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ...nn.functional import dropout_mask
 from ...ops.pallas import pallas_mode
 from ...ops.pallas import attention as _k
 
@@ -157,7 +158,7 @@ def _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout_prob, key,
         if key is None:
             raise ValueError("attention dropout requires a PRNG key")
         keep = 1.0 - dropout_prob
-        m = jax.random.bernoulli(key, keep, p.shape)
+        m = dropout_mask(key, keep, p.shape)
         p = jnp.where(m, p / keep, 0.0)
     return jnp.einsum("bts,bsd->btd", p, v3.astype(_f32)).astype(q3.dtype)
 

@@ -154,3 +154,97 @@ def test_instance_norm_rejects_degenerate_spatial():
     with pytest.raises(ValueError, match="spatial"):
         from apex_tpu.nn import functional as F
         F.instance_norm(jnp.zeros((4, 6)))
+
+
+def test_dropout_mask_rbg_semantics(rng, monkeypatch):
+    """The fast (RngBitGenerator) mask path: deterministic per key,
+    independent across keys, keep-fraction ~ keep, zeros where dropped and
+    exact 1/keep scaling where kept."""
+    import jax
+    from apex_tpu.nn import functional as F
+    monkeypatch.setenv("APEX_TPU_DROPOUT_IMPL", "rbg")
+    key = jax.random.PRNGKey(3)
+    x = jnp.asarray(rng.standard_normal((64, 256)), jnp.float32)
+    out1 = F.dropout(x, p=0.3, training=True, key=key)
+    out2 = F.dropout(x, p=0.3, training=True, key=key)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    out3 = F.dropout(x, p=0.3, training=True, key=jax.random.PRNGKey(4))
+    assert (np.asarray(out1) != np.asarray(out3)).any()
+    kept = np.asarray(out1) != 0
+    assert abs(kept.mean() - 0.7) < 0.02
+    np.testing.assert_allclose(np.asarray(out1)[kept],
+                               (np.asarray(x) / 0.7)[kept], rtol=1e-6)
+
+
+def test_dropout_mask_impl_switch(monkeypatch):
+    """APEX_TPU_DROPOUT_IMPL=threefry restores jax.random.bernoulli masks
+    bit-for-bit; both impls accept typed keys."""
+    import jax
+    from apex_tpu.nn import functional as F
+    key = jax.random.PRNGKey(9)
+    monkeypatch.setenv("APEX_TPU_DROPOUT_IMPL", "threefry")
+    m = F.dropout_mask(key, 0.8, (32, 32))
+    want = jax.random.bernoulli(key, 0.8, (32, 32))
+    np.testing.assert_array_equal(np.asarray(m), np.asarray(want))
+    monkeypatch.setenv("APEX_TPU_DROPOUT_IMPL", "rbg")
+    typed = jax.random.key(9)
+    m_raw = F.dropout_mask(key, 0.8, (32, 32))
+    m_typed = F.dropout_mask(typed, 0.8, (32, 32))
+    # typed and raw forms of the same key seed the generator identically
+    np.testing.assert_array_equal(np.asarray(m_raw), np.asarray(m_typed))
+
+
+def test_dropout_mask_under_jit_and_grad(rng):
+    """The mask is a non-differentiable residual: grad is 1/keep on kept
+    elements, 0 on dropped, and fwd/bwd agree on the mask under jit."""
+    import jax
+    from apex_tpu.nn import functional as F
+    key = jax.random.PRNGKey(11)
+    x = jnp.asarray(rng.standard_normal((16, 64)), jnp.float32)
+
+    def loss(x):
+        return jnp.sum(F.dropout(x, p=0.5, training=True, key=key))
+
+    g = jax.jit(jax.grad(loss))(x)
+    out = F.dropout(x, p=0.5, training=True, key=key)
+    kept = np.asarray(out) != 0
+    np.testing.assert_allclose(np.asarray(g)[kept], 2.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g)[~kept], 0.0)
+
+
+def test_dropout_mask_rejects_stacked_keys(monkeypatch):
+    """A stacked key array must fail fast under both impls (the rbg path
+    used to silently collapse it into one seed)."""
+    import jax
+    import pytest
+    from apex_tpu.nn import functional as F
+    stacked_raw = jnp.stack(jax.random.split(jax.random.PRNGKey(0)))
+    stacked_typed = jax.random.split(jax.random.key(0))
+    monkeypatch.setenv("APEX_TPU_DROPOUT_IMPL", "rbg")
+    with pytest.raises(ValueError, match="vmap"):
+        F.dropout_mask(stacked_raw, 0.5, (8, 8))
+    with pytest.raises(ValueError, match="vmap"):
+        F.dropout_mask(stacked_typed, 0.5, (8, 8))
+
+
+def test_dropout_mask_edge_cases(monkeypatch):
+    """keep endpoints are exact, traced keep works under jit (bernoulli
+    parity), and a bad impl env value fails fast."""
+    import jax
+    import pytest
+    from apex_tpu.nn import functional as F
+    key = jax.random.PRNGKey(0)
+    monkeypatch.setenv("APEX_TPU_DROPOUT_IMPL", "rbg")
+    assert np.asarray(F.dropout_mask(key, 1.0, (64, 64))).all()
+    assert not np.asarray(F.dropout_mask(key, 0.0, (64, 64))).any()
+
+    # traced keep probability (bernoulli accepted a tracer here too)
+    f = jax.jit(lambda p, k: F.dropout_mask(k, 1.0 - p, (128, 128)))
+    m = np.asarray(f(jnp.float32(0.3), key))
+    assert abs(m.mean() - 0.7) < 0.03
+    assert np.asarray(f(jnp.float32(0.0), key)).all()
+    assert not np.asarray(f(jnp.float32(1.0), key)).any()
+
+    monkeypatch.setenv("APEX_TPU_DROPOUT_IMPL", "threefy")
+    with pytest.raises(ValueError, match="APEX_TPU_DROPOUT_IMPL"):
+        F.dropout_mask(key, 0.5, (8, 8))
