@@ -125,11 +125,13 @@ def _conv_family(row):
 _POINTWISE_COST = {"relu": 1, "leaky_relu": 2, "tanh": 4, "sigmoid": 4,
                    "gelu": 8, "dropout": 2, "pad": 1, "flatten": 0}
 _NORM_COST = {"batch_norm": 8, "layer_norm": 8, "group_norm": 8,
-              "instance_norm": 8}
+              "instance_norm": 8, "fused_layer_norm": 8,
+              "fused_layer_norm_affine": 8}
 _SOFTMAX_COST = {"softmax": 5, "log_softmax": 6}
 _LOSS_COST = {"cross_entropy": 7, "nll_loss": 2, "mse_loss": 3,
               "l1_loss": 3, "binary_cross_entropy": 6,
-              "binary_cross_entropy_with_logits": 8}
+              "binary_cross_entropy_with_logits": 8,
+              "softmax_cross_entropy_loss": 7}
 _OPT_COST = {"FusedAdam": 12, "FusedLAMB": 16, "FusedNovoGrad": 12,
              "FusedSGD": 4, "LARC": 6}
 
@@ -203,6 +205,22 @@ def _embedding(row):
     return 0, out * _ds(dtype) * 2, None
 
 
+def _attention_family(row):
+    """Fused (flash) attention, (B, H, S, D) operands: QK^T and PV matmuls
+    dominate; causal halves the useful area.  Bytes model the flash
+    property — q/k/v/out move through HBM, the S^2 score matrix never
+    does (ops/pallas/attention.py streams it through VMEM)."""
+    q, k = row["shapes"][0], row["shapes"][1]
+    dtype = (row["dtypes"] or ["float32"])[0]
+    b, h, sq, d = q[-4], q[-3], q[-2], q[-1]
+    sk = k[-2]
+    area = b * h * sq * sk * (0.5 if row.get("params", {}).get("causal")
+                              else 1.0)
+    flops = 2 * 2 * area * d + 5 * area          # two matmuls + softmax
+    bytes_ = b * h * (2 * sq + 2 * sk) * d * _ds(dtype)
+    return flops, bytes_, _mxu(sq, d, sk, dtype)
+
+
 def _optimizer(row):
     name = row["op"].split(".")[1] if "." in row["op"] else row["op"]
     cost = _OPT_COST.get(name, 10)
@@ -219,6 +237,8 @@ def model_row(row):
         f, b, m = _optimizer(row)
     elif op in ("linear", "matmul"):
         f, b, m = _gemm_family(row)
+    elif op == "flash_attention":
+        f, b, m = _attention_family(row)
     elif op.startswith("conv"):
         f, b, m = _conv_family(row)
     elif op in _POINTWISE_COST:
@@ -244,7 +264,12 @@ def model_row(row):
     else:
         f, b, m = _elemwise(row, 1)
     if row.get("dir") == "bwd":
-        factor = 2 if (op in ("linear", "matmul") or op.startswith("conv")) \
-            else 1
+        if op == "flash_attention":
+            # dq + dk + dv plus the in-kernel score recompute
+            factor = 2.5
+        elif op in ("linear", "matmul") or op.startswith("conv"):
+            factor = 2
+        else:
+            factor = 1
         f, b = f * factor, b * factor
     return f, b, m

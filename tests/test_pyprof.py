@@ -336,3 +336,86 @@ def test_tape_op_flop_models():
            "dtypes": ["float32", "float32"], "params": {}}
     f, b, _ = model_row(row)
     assert f == 2 * 4 * 8
+
+
+def test_fused_ops_annotated(rng):
+    """Flash attention, FusedLayerNorm and contrib xentropy live outside
+    nn.functional; init() wraps their defining-module bindings so module
+    classes that call them produce profile rows."""
+    from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+    from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss
+    from apex_tpu.normalization import FusedLayerNorm
+
+    nn.manual_seed(0)
+    attn = SelfMultiheadAttn(16, 2, dropout=0.0, impl="fast", causal=True)
+    ln = FusedLayerNorm(16)
+    x = jnp.asarray(rng.standard_normal((8, 2, 16)), jnp.float32)
+    logits = jnp.asarray(rng.standard_normal((4, 11)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 11, (4,)))
+    with pyprof.capture() as ev:
+        out, _ = attn(x)
+        ln(out)
+        SoftmaxCrossEntropyLoss.apply(logits, labels)
+    ops = [e["op"] for e in ev]
+    assert "flash_attention" in ops
+    assert "fused_layer_norm_affine" in ops
+    assert "softmax_cross_entropy_loss" in ops
+    fa = ev[ops.index("flash_attention")]
+    assert fa["params"].get("causal") is True
+    assert len(fa["shapes"][0]) == 4  # (B, H, S, D)
+
+
+def test_fused_op_flop_models():
+    """Known-value cost models for the fused families, incl. the causal
+    halving, the flash bytes model (no S^2 traffic) and bwd factors."""
+    row = {"op": "flash_attention", "dir": "fwd",
+           "shapes": [[2, 4, 64, 32], [2, 4, 64, 32], [2, 4, 64, 32]],
+           "dtypes": ["bfloat16"], "params": {"causal": False}}
+    f, b, m = model_row(row)
+    area = 2 * 4 * 64 * 64
+    assert f == 2 * 2 * area * 32 + 5 * area
+    assert b == 2 * 4 * (2 * 64 + 2 * 64) * 32 * 2  # qkvo only, bf16
+    assert m["eligible"]
+    f_causal, _, _ = model_row({**row, "params": {"causal": True}})
+    assert f_causal == f / 2
+    f_bwd, _, _ = model_row({**row, "dir": "bwd"})
+    assert f_bwd == 2.5 * f
+
+    row = {"op": "fused_layer_norm_affine", "dir": "fwd",
+           "shapes": [[8, 16], [16], [16]], "dtypes": ["float32"],
+           "params": {"normalized_shape": [16]}}
+    f, b, _ = model_row(row)
+    assert f == 8 * 8 * 16 and b == 3 * 8 * 16 * 4
+
+    row = {"op": "softmax_cross_entropy_loss", "dir": "fwd",
+           "shapes": [[4, 11], [4]], "dtypes": ["float32"], "params": {}}
+    f, b, _ = model_row(row)
+    assert f == 7 * 4 * 11 and b == 2 * 4 * 11 * 4
+
+
+def test_fused_ops_grads_flow_after_annotation(rng):
+    """Wrapping must not break the custom-vjp gradient paths."""
+    from apex_tpu import normalization
+    pyprof.annotate.init()
+    pyprof.annotate.set_enabled(False)
+    x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    w = jnp.ones((16,), jnp.float32)
+    bias = jnp.zeros((16,), jnp.float32)
+
+    def loss(x, w, bias):
+        return jnp.sum(normalization.fused_layer_norm_affine(
+            x, w, bias, (16,)) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(x, w, bias)
+    assert all(np.isfinite(np.asarray(gi)).all() for gi in g)
+    assert float(jnp.abs(g[0]).max()) > 0
+
+
+def test_flash_attention_package_reexport_annotated(rng):
+    """The multihead_attn package re-export must be wrapped too, not just
+    the defining module."""
+    from apex_tpu.contrib import multihead_attn as pkg
+    q = jnp.asarray(rng.standard_normal((1, 2, 8, 4)), jnp.float32)
+    with pyprof.capture() as ev:
+        pkg.flash_attention(q, q, q, causal=True)
+    assert [e["op"] for e in ev] == ["flash_attention"]
