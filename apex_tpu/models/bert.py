@@ -60,9 +60,13 @@ class BertModel(nn.Module):
 
     def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
                  intermediate=3072, max_positions=512, type_vocab=2,
-                 dropout=0.1, attn_dropout=0.1):
+                 dropout=0.1, attn_dropout=0.1, remat=False):
         super().__init__()
         self.hidden = hidden
+        # remat: rematerialize each layer's activations in backward
+        # (jax.checkpoint via nn.checkpoint_forward) — the long-sequence
+        # HBM saver
+        self.remat = remat
         self.tok_emb = nn.Embedding(vocab_size, hidden)
         self.pos_emb = nn.Embedding(max_positions, hidden)
         self.type_emb = nn.Embedding(type_vocab, hidden)
@@ -94,7 +98,10 @@ class BertModel(nn.Module):
         if attention_mask is not None:
             kpm = (attention_mask == 0)
         for layer in self.layers:
-            x = layer.forward(ctx, x, key_padding_mask=kpm)
+            if self.remat:
+                x = nn.checkpoint_forward(layer, ctx, x, kpm)
+            else:
+                x = layer.forward(ctx, x, key_padding_mask=kpm)
         return jnp.swapaxes(x, 0, 1)
 
 

@@ -53,11 +53,15 @@ class GptModel(nn.Module):
 
     def __init__(self, vocab_size=50257, hidden=768, layers=12, heads=12,
                  intermediate=None, max_positions=1024, dropout=0.1,
-                 attn_dropout=0.1):
+                 attn_dropout=0.1, remat=False):
         super().__init__()
         intermediate = intermediate or 4 * hidden
         self.hidden = hidden
         self.max_positions = max_positions
+        # remat: rematerialize each block's activations in backward
+        # (jax.checkpoint) — HBM drops from O(layers * S * E) residuals to
+        # O(layers) block boundaries, the long-sequence enabler
+        self.remat = remat
         self.tok_emb = nn.Embedding(vocab_size, hidden)
         self.pos_emb = nn.Embedding(max_positions, hidden)
         # GPT initializer_range=0.02 (nn.Embedding draws std-1 normals; the
@@ -85,7 +89,10 @@ class GptModel(nn.Module):
         x = self.drop.forward(ctx, x)
         x = jnp.swapaxes(x, 0, 1)          # (S, B, E)
         for blk in self.blocks:
-            x = blk.forward(ctx, x)
+            if self.remat:
+                x = nn.checkpoint_forward(blk, ctx, x)
+            else:
+                x = blk.forward(ctx, x)
         x = self.ln_f.forward(ctx, x)
         x = jnp.swapaxes(x, 0, 1)          # (B, S, E)
         emb = ctx.value(self.tok_emb.weight)

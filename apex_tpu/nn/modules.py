@@ -734,3 +734,45 @@ class ModuleList(Module):
 
     def __getitem__(self, idx):
         return list(self._modules.values())[idx]
+
+
+def checkpoint_forward(module, ctx, *inputs):
+    """Run ``module.forward(ctx, *inputs)`` under ``jax.checkpoint``:
+    activations inside the module are rematerialized in backward instead of
+    saved, trading FLOPs for HBM (the standard long-sequence recipe; the
+    reference has no analogue — CUDA Apex leans on torch.utils.checkpoint).
+
+    The module tree executes through a Ctx whose env carries substituted
+    parameter values; jax.checkpoint needs a pure array->array function, so
+    this bridges by passing the module's parameter values (and the dropout
+    key) as explicit arguments and rebuilding a local Ctx inside.  The
+    dropout key counter is snapshotted and replayed so the rematerialized
+    backward trace draws identical masks, and advanced on the outer ctx so
+    later modules keep drawing fresh keys.  Running-stat modules
+    (BatchNorm) are rejected: their stat writes would leak tracers across
+    the checkpoint boundary.
+    """
+    ps = [p for p in module.parameters() if p is not None]
+    ps += list(module.buffers())   # buffer READS (eval BN stats,
+    # env-substituted constants) must cross the boundary too, not fall
+    # back to stale eager .data
+    vals = [ctx.value(p) for p in ps]
+    idx0 = ctx._key_idx
+    consumed = [idx0]
+
+    def fn(key, x, *vals):
+        inner = Ctx(env={id(p): v for p, v in zip(ps, vals)},
+                    stats_out={}, training=ctx.training, key=key)
+        inner._key_idx = idx0
+        out = module.forward(inner, *x)
+        if inner.stats_out:
+            raise ValueError(
+                "checkpoint_forward: module writes running statistics "
+                "(BatchNorm?) — stat updates cannot cross the remat "
+                "boundary; exclude such modules from checkpointing")
+        consumed[0] = inner._key_idx
+        return out
+
+    out = jax.checkpoint(fn, static_argnums=())(ctx.key, inputs, *vals)
+    ctx._key_idx = max(ctx._key_idx, consumed[0])
+    return out

@@ -81,3 +81,102 @@ def test_rejects_oversized_sequence(rng):
     import pytest
     with pytest.raises(ValueError, match="max_positions"):
         m(ids)
+
+
+def test_remat_grads_match_no_remat(rng):
+    """remat=True must be a pure memory/compute tradeoff: losses and
+    gradients identical to the non-remat model, including dropout masks
+    (the checkpoint bridge replays the same fold_in keys)."""
+    import jax
+
+    def build(remat):
+        nn.manual_seed(5)
+        return GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                        max_positions=64, dropout=0.1, attn_dropout=0.0,
+                        remat=remat)
+
+    ids = _ids(rng)
+    key = jax.random.PRNGKey(3)
+    outs = {}
+    for remat in (False, True):
+        m = build(remat)
+        params = [p for p in m.parameters()]
+
+        def loss_fn(vals):
+            from apex_tpu.nn.modules import Ctx
+            ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                      stats_out={}, training=True, key=key)
+            logits = m.forward(ctx, ids)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        vals = [p.data for p in params]
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda v: loss_fn(v)))(vals)
+        outs[remat] = (float(loss), [np.asarray(g) for g in grads])
+
+    assert outs[False][0] == outs[True][0]
+    for a, b in zip(outs[False][1], outs[True][1]):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_remat_through_fused_step(rng):
+    """A remat GPT trains through make_train_step and the loss decreases."""
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    nn.manual_seed(0)
+    m = GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                 max_positions=64, dropout=0.1, attn_dropout=0.0, remat=True)
+    opt = FusedAdam(list(m.parameters()), lr=1e-3)
+
+    def lm_loss(logits, ids):
+        return F.cross_entropy(logits[:, :-1].reshape((-1, V)),
+                               ids[:, 1:].reshape((-1,)))
+
+    step = make_train_step(m, opt, lm_loss, half_dtype=jnp.bfloat16,
+                           loss_scale=1.0)
+    ids = _ids(rng, b=4)
+    l0 = float(step(ids, ids))
+    for _ in range(20):
+        l = float(step(ids, ids))
+    assert np.isfinite(l) and l < l0
+
+
+def test_checkpoint_forward_rejects_batchnorm(rng):
+    """Running-stat writes cannot cross the remat boundary."""
+    import pytest
+    from apex_tpu.nn.modules import Ctx
+
+    nn.manual_seed(0)
+    bn = nn.BatchNorm1d(8)
+    x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+    ctx = Ctx(env={id(p): p.data for p in bn.parameters()},
+              stats_out={}, training=True)
+    for b in bn.buffers():
+        ctx.env[id(b)] = b.data
+    with pytest.raises(ValueError, match="remat"):
+        nn.checkpoint_forward(bn, ctx, x)
+
+
+def test_checkpoint_forward_reads_env_buffers(rng):
+    """Buffer reads (eval-mode BN running stats substituted through the
+    ctx env) must cross the checkpoint boundary — not fall back to the
+    stale eager .data values."""
+    from apex_tpu.nn.modules import Ctx
+
+    nn.manual_seed(0)
+    bn = nn.BatchNorm1d(8)
+    bn.eval()
+    x = jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)
+    env = {id(p): p.data for p in bn.parameters()}
+    bufs = list(bn.buffers())
+    # substitute non-trivial running stats through the env
+    subst = {id(b): b.data + (i + 1) * 0.5 for i, b in enumerate(bufs)}
+    env.update(subst)
+    ctx = Ctx(env=dict(env), stats_out={}, training=False)
+    want = bn.forward(ctx, x)
+    ctx2 = Ctx(env=dict(env), stats_out={}, training=False)
+    got = nn.checkpoint_forward(bn, ctx2, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-7)
