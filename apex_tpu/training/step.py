@@ -337,12 +337,30 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     gradient_predivide_factor: float = 1.0,
                     allreduce_always_fp32: bool = False,
                     donate_state: bool = True,
+                    grad_accum_steps: int = 1,
                     rng_seed: int = 0):
     """Build a fully-fused O2-style train step.
 
     ``loss_fn(outputs..., *batch_tail) -> scalar``: called with the model
     output.  The step signature is ``step(state, *batch) -> (state, loss)``
     where ``batch[0]`` feeds the model and the full batch feeds ``loss_fn``.
+
+    ``grad_accum_steps=K`` runs the batch as K sequential microbatches
+    inside the SAME compiled step (a ``lax.scan``), accumulating gradients
+    in fp32 and applying one optimizer update — peak activation memory is
+    that of one microbatch.  Reported loss is the microbatch mean.  Batch
+    elements sharing the model input's leading dim are split; anything
+    else (scalars, per-step constants, custom containers) is broadcast to
+    every microbatch.  The step matches the full-batch step up to
+    summation order PROVIDED ``loss_fn`` computes a per-sample mean (the
+    default reductions): gradients are (1/K)·Σ microbatch grads.  A
+    sum-reduction or weight-normalized loss does not decompose that way —
+    its accumulated gradients are 1/K of the full-batch run's, exactly as
+    when a torch user accumulates ``loss / K`` manually.  (BatchNorm
+    normalizes within each microbatch, as everywhere.)  Under DP the
+    gradient all-reduce happens once per step, after accumulation — the
+    reference's ``delay_unscale=True`` grad-accumulation pattern
+    (docs/advanced.md), fused.
 
     When ``axis_name`` is given the step is meant to run under
     ``shard_map``/``pjit`` over that mesh axis: gradients are psum-averaged
@@ -362,20 +380,27 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     init_scale = (min(max_loss_scale, 2.0 ** 16) if dynamic
                   else float(loss_scale))
 
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, "
+                         f"got {grad_accum_steps}")
+
     def step_fn(state: StepState, *batch):
         model_vals = model_vals_of(state)
 
-        def forward(model_vals_in, *b):
+        def forward(model_vals_in, stats_in, mb_idx, *b):
             env = {id(p): v for p, v in zip(params, model_vals_in)}
-            stats_env = {id(bf): v for bf, v in zip(buffers, state.stats)}
+            stats_env = {id(bf): v for bf, v in zip(buffers, stats_in)}
             stats_out = {}
             # per-step dropout randomness, derived from the step counter so
             # the state shape stays fixed (and steps are reproducible);
             # under DP also fold in the replica index so shards draw
-            # independent masks (matching per-device RNG in the reference)
+            # independent masks (matching per-device RNG in the reference);
+            # under accumulation fold in the microbatch index likewise
             key = jax.random.fold_in(jax.random.PRNGKey(rng_seed), state.step)
             if axis_name is not None:
                 key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+            if grad_accum_steps > 1:
+                key = jax.random.fold_in(key, mb_idx)
             ctx = Ctx(env={**env, **stats_env}, stats_out=stats_out,
                       training=True, key=key)
             x = b[0]
@@ -387,12 +412,60 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             out = model.forward(ctx, x)
             loss = loss_fn(out, *b[1:])
             new_stats = [stats_out.get(id(bf), sv)
-                         for bf, sv in zip(buffers, state.stats)]
+                         for bf, sv in zip(buffers, stats_in)]
             return loss.astype(jnp.float32) * state.scaler.loss_scale, \
                 (loss, new_stats)
 
-        (scaled_loss, (loss, new_stats)), grads = jax.value_and_grad(
-            forward, has_aux=True)(model_vals, *batch)
+        if grad_accum_steps == 1:
+            (_, (loss, new_stats)), grads = jax.value_and_grad(
+                forward, has_aux=True)(
+                    model_vals, list(state.stats), jnp.zeros((), jnp.int32),
+                    *batch)
+        else:
+            def split(b):
+                n = b.shape[0]
+                if n % grad_accum_steps:
+                    raise ValueError(
+                        f"grad_accum_steps={grad_accum_steps}: batch "
+                        f"leading dim {n} is not divisible "
+                        f"into microbatches")
+                return b.reshape(
+                    (grad_accum_steps, n // grad_accum_steps) + b.shape[1:])
+
+            if not hasattr(batch[0], "ndim") or batch[0].ndim < 1:
+                raise ValueError(
+                    f"grad_accum_steps={grad_accum_steps}: the model input "
+                    f"(batch[0]) has no leading batch dimension to split")
+            n0 = batch[0].shape[0]
+            # elements sharing the model input's batch dim split into
+            # microbatches; anything else (scalars, per-step constants,
+            # custom containers) is broadcast to every microbatch
+            splits = [i == 0 or (getattr(b, "ndim", 0) >= 1
+                                 and b.shape[0] == n0)
+                      for i, b in enumerate(batch)]
+            micro = tuple(split(b) for b, s in zip(batch, splits) if s)
+
+            def micro_step(carry, mb):
+                acc, stats_in, loss_sum, i = carry
+                mb_it = iter(mb)
+                full = tuple(next(mb_it) if s else b
+                             for b, s in zip(batch, splits))
+                (_, (l, ns)), g = jax.value_and_grad(
+                    forward, has_aux=True)(model_vals, stats_in, i, *full)
+                acc = [a + gi.astype(jnp.float32)
+                       for a, gi in zip(acc, g)]
+                return (acc, ns, loss_sum + l.astype(jnp.float32),
+                        i + 1), None
+
+            carry0 = ([jnp.zeros(v.shape, jnp.float32)
+                       for v in model_vals],
+                      list(state.stats),
+                      jnp.zeros((), jnp.float32),
+                      jnp.zeros((), jnp.int32))
+            (acc, new_stats, loss_sum, _), _ = jax.lax.scan(
+                micro_step, carry0, micro)
+            grads = [a / grad_accum_steps for a in acc]
+            loss = loss_sum / grad_accum_steps
 
         # DP gradient exchange (psum over the mapped axis), with DDP knobs
         if axis_name is not None:

@@ -357,3 +357,151 @@ def test_fused_step_dropout_under_dp():
     state, loss2 = sharded(state, x, y)
     assert np.isfinite(float(jnp.mean(loss)))
     assert np.isfinite(float(jnp.mean(loss2)))
+
+
+def test_grad_accum_matches_full_batch():
+    """K microbatches inside the step == the full-batch step: same params
+    after N steps (fp32 model, dropout off — exact up to summation order)."""
+    import numpy as np
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((16, 12)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 5, (16,)))
+
+    results = {}
+    for accum in (1, 4):
+        nn.manual_seed(7)
+        model = nn.Sequential(nn.Linear(12, 32), nn.ReLU(),
+                              nn.Linear(32, 5))
+        opt = FusedAdam(list(model.parameters()), lr=1e-2)
+        step = make_train_step(model, opt,
+                               lambda o, t: F.cross_entropy(o, t),
+                               half_dtype=None, loss_scale=1.0,
+                               grad_accum_steps=accum)
+        for _ in range(5):
+            loss = step(x, y)
+        step.sync_to_objects()
+        results[accum] = ([np.asarray(p.data) for p in model.parameters()],
+                          float(loss))
+
+    assert abs(results[1][1] - results[4][1]) < 1e-5
+    for a, b in zip(results[1][0], results[4][0]):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_grad_accum_bn_stats_thread_sequentially():
+    """BatchNorm running stats under accumulation see K sequential
+    microbatch updates per step (the semantics of K separate forwards)."""
+    import numpy as np
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.training import make_train_step
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 6)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 3, (8,)))
+
+    def build():
+        nn.manual_seed(2)
+        m = nn.Sequential(nn.Linear(6, 6), nn.BatchNorm1d(6),
+                          nn.Linear(6, 3))
+        return m, FusedSGD(list(m.parameters()), lr=0.0)  # stats only
+
+    # accumulated: one step of K=2 microbatches
+    m_acc, opt = build()
+    step = make_train_step(m_acc, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=None, loss_scale=1.0,
+                           grad_accum_steps=2)
+    step(x, y)
+    step.sync_to_objects()
+
+    # reference: two eager forwards on the two halves (lr=0, same params)
+    m_ref, _ = build()
+    m_ref.train()
+    m_ref(x[:4])
+    m_ref(x[4:])
+
+    bn_acc = [m for m in m_acc.modules()
+              if isinstance(m, nn.BatchNorm1d)][0]
+    bn_ref = [m for m in m_ref.modules()
+              if isinstance(m, nn.BatchNorm1d)][0]
+    np.testing.assert_allclose(np.asarray(bn_acc.running_mean.data),
+                               np.asarray(bn_ref.running_mean.data),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(bn_acc.running_var.data),
+                               np.asarray(bn_ref.running_var.data),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    import pytest
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.training import make_train_step
+
+    nn.manual_seed(0)
+    m = nn.Linear(4, 2)
+    opt = FusedSGD(list(m.parameters()), lr=0.1)
+    step = make_train_step(m, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=None, loss_scale=1.0,
+                           grad_accum_steps=3)
+    with pytest.raises(ValueError, match="divisible"):
+        step(jnp.zeros((8, 4)), jnp.zeros((8,), jnp.int32))
+
+
+def test_grad_accum_under_dp():
+    """Accumulation composes with shard_map DP: the psum happens once per
+    step after the scan, and replicas stay in sync."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.training import make_train_step
+
+    nn.manual_seed(4)
+    m = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 3))
+    opt = FusedSGD(list(m.parameters()), lr=0.05)
+    step = make_train_step(m, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=None, loss_scale=1.0,
+                           axis_name="data", grad_accum_steps=2)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((16, 6)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 3, (16,)))
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    sharded = jax.jit(jax.shard_map(
+        step._step_fn, mesh=mesh,
+        in_specs=(P(), P("data"), P("data")), out_specs=(P(), P()),
+        check_vma=False))
+    state, loss0 = sharded(step.state, x, y)
+    state, loss1 = sharded(state, x, y)
+    assert np.isfinite(float(loss1)) and float(loss1) < float(loss0)
+    # replicated state leaves must be identical across shards (psum'd once)
+    assert int(state.step) == 2
+
+
+def test_grad_accum_broadcasts_non_batch_elements():
+    """Scalars / per-step constants in the batch are broadcast to every
+    microbatch instead of rejected."""
+    import numpy as np
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.training import make_train_step
+
+    nn.manual_seed(0)
+    m = nn.Linear(4, 3)
+    opt = FusedSGD(list(m.parameters()), lr=0.1)
+
+    def weighted_loss(out, t, w):
+        return F.cross_entropy(out, t) * w
+
+    step = make_train_step(m, opt, weighted_loss, half_dtype=None,
+                           loss_scale=1.0, grad_accum_steps=2)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 4)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 3, (8,)))
+    w = jnp.asarray(0.5, jnp.float32)      # scalar: broadcast, not split
+    loss = step(x, y, w)
+    assert np.isfinite(float(loss))
