@@ -22,6 +22,7 @@ from .tensor_parallel import (  # noqa: F401
     row_parallel_linear)
 from .pipeline import pipeline_apply  # noqa: F401
 from .expert_parallel import switch_moe  # noqa: F401
+from .zero import ZeroTrainStep, zero_state_sharding  # noqa: F401
 
 
 def convert_syncbn_model(module, process_group=None, channel_last=False,

@@ -497,5 +497,9 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     else:
         jit_step = step_fn  # caller wraps in shard_map/pjit
 
-    return TrainStep(model, optimizer, loss_fn, jit_step, params, buffers,
-                     init_state)
+    ts = TrainStep(model, optimizer, loss_fn, jit_step, params, buffers,
+                   init_state)
+    # the un-jitted step for wrappers that jit with their own shardings /
+    # donation (parallel/zero.py)
+    ts._raw_step_fn = step_fn
+    return ts
