@@ -3,3 +3,9 @@ from .fused_adam import FusedAdam  # noqa: F401
 from .fused_lamb import FusedLAMB  # noqa: F401
 from .fused_novograd import FusedNovoGrad  # noqa: F401
 from .fused_sgd import FusedSGD  # noqa: F401
+from .schedules import (  # noqa: F401
+    step_decay,
+    warmup_cosine,
+    warmup_linear,
+    warmup_poly,
+)

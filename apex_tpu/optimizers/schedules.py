@@ -1,0 +1,88 @@
+"""Jit-safe learning-rate schedules for the fused train step.
+
+Each factory returns ``schedule(step) -> multiplier`` on the optimizer
+groups' base lr, evaluated on-device from the traced 1-based step counter
+(``make_train_step(lr_schedule=...)``) — the lr changes every step with
+zero recompiles, where mutating ``group["lr"]`` (the eager torch pattern)
+would re-trace.  Schedules also accept plain ints for logging/plotting.
+The reference ships no schedulers (its users pulled them from torch);
+these cover the standard pretraining recipes (BERT's warmup+linear-decay,
+GPT/Chinchilla-style warmup+cosine).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _check_warmup(warmup_steps, total_steps):
+    if not 0 < warmup_steps < total_steps:
+        raise ValueError(
+            f"need 0 < warmup_steps < total_steps, got "
+            f"{warmup_steps}, {total_steps}")
+
+
+def _as_f32(step):
+    return jnp.asarray(step).astype(jnp.float32)
+
+
+def warmup_poly(warmup_steps: int, total_steps: int, power: float = 1.0,
+                min_ratio: float = 0.0):
+    """Linear warmup 0→1 over ``warmup_steps``, then polynomial decay to
+    ``min_ratio`` at ``total_steps`` (clamped past the end)."""
+    _check_warmup(warmup_steps, total_steps)
+
+    def schedule(step):
+        s = _as_f32(step)
+        warm = s / warmup_steps
+        frac = jnp.clip((total_steps - s)
+                        / float(total_steps - warmup_steps), 0.0, 1.0)
+        decay = min_ratio + (1.0 - min_ratio) * frac ** power
+        return jnp.where(s < warmup_steps, warm, decay)
+
+    return schedule
+
+
+def warmup_linear(warmup_steps: int, total_steps: int,
+                  min_ratio: float = 0.0):
+    """Linear warmup then linear decay (BERT pretraining shape) —
+    ``warmup_poly`` with ``power=1``."""
+    return warmup_poly(warmup_steps, total_steps, power=1.0,
+                       min_ratio=min_ratio)
+
+
+def warmup_cosine(warmup_steps: int, total_steps: int,
+                  min_ratio: float = 0.0):
+    """Linear warmup then cosine decay to ``min_ratio`` (GPT shape)."""
+    _check_warmup(warmup_steps, total_steps)
+
+    def schedule(step):
+        s = _as_f32(step)
+        warm = s / warmup_steps
+        prog = jnp.clip((s - warmup_steps)
+                        / float(total_steps - warmup_steps), 0.0, 1.0)
+        decay = min_ratio + (1.0 - min_ratio) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * prog))
+        return jnp.where(s < warmup_steps, warm, decay)
+
+    return schedule
+
+
+def step_decay(boundaries, factors):
+    """Piecewise-constant multiplier: after ``boundaries[i]`` steps the
+    multiplier becomes ``factors[i]`` (the classic /10-at-epoch-N imagenet
+    recipe, expressed in steps).  Boundaries must ascend — the pairing
+    with factors depends on it."""
+    boundaries = list(boundaries)
+    if len(boundaries) != len(factors):
+        raise ValueError("boundaries and factors must align")
+    if boundaries != sorted(boundaries):
+        raise ValueError(
+            f"boundaries must be ascending, got {boundaries}")
+    bs = jnp.asarray(boundaries, jnp.float32)
+    fs = jnp.asarray([1.0] + list(factors), jnp.float32)
+
+    def schedule(step):
+        idx = jnp.sum(_as_f32(step) >= bs)
+        return fs[idx]
+
+    return schedule

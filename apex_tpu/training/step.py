@@ -125,7 +125,7 @@ def _model_dtypes(model, params, half_dtype, keep_batchnorm_fp32):
 
 def apply_fused_update(sub: StepState, grads, opt_update, model_dtypes, *,
                        dynamic, init_scale, scale_window,
-                       min_loss_scale, max_loss_scale):
+                       min_loss_scale, max_loss_scale, lr_schedule=None):
     """The post-gradient half of a fused step: unscale into fp32 master
     grads + overflow flag, fused optimizer update, skip-on-overflow
     (lax.select keeps it fused), model-dtype re-cast, loss-scale update.
@@ -150,8 +150,15 @@ def apply_fused_update(sub: StepState, grads, opt_update, model_dtypes, *,
         master_grads.append(gf)
 
     step_count = sub.step + 1
-    new_masters, new_slots = opt_update(
-        flag, master_grads, sub.master_params, sub.opt_state, step_count)
+    if lr_schedule is None:
+        new_masters, new_slots = opt_update(
+            flag, master_grads, sub.master_params, sub.opt_state, step_count)
+    else:
+        # schedules see the 1-based step as a traced scalar and return a
+        # multiplier on each group's base lr — on-device, no recompiles
+        new_masters, new_slots = opt_update(
+            flag, master_grads, sub.master_params, sub.opt_state, step_count,
+            lr_scale=lr_schedule(step_count))
 
     skip = flag > 0
     sel = functools.partial(jnp.where, skip)
@@ -211,7 +218,7 @@ def build_opt_update(optimizer, params, group_idxs,
 
     opt = optimizer
     if isinstance(opt, FusedSGD):
-        def opt_update(flag, grads, masters, slots, step):
+        def opt_update(flag, grads, masters, slots, step, lr_scale=1.0):
             new_p, new_m = list(masters), list(slots["momentum"])
             for group, idxs in zip(opt.param_groups, group_idxs):
                 if not idxs:
@@ -220,7 +227,8 @@ def build_opt_update(optimizer, params, group_idxs,
                     flag, [_gather(grads, idxs), _gather(new_p, idxs),
                            _gather(new_m, idxs)],
                     group["weight_decay"], group["momentum"],
-                    group["dampening"], group["lr"], group["nesterov"],
+                    group["dampening"], group["lr"] * lr_scale,
+                    group["nesterov"],
                     False, opt.wd_after_momentum, 1.0)
                 _scatter(new_p, idxs, g_p)
                 _scatter(new_m, idxs, g_m)
@@ -230,7 +238,7 @@ def build_opt_update(optimizer, params, group_idxs,
             return {"momentum": [jnp.zeros(p.shape, jnp.float32)
                                  for p in params]}
     elif isinstance(opt, FusedAdam):
-        def opt_update(flag, grads, masters, slots, step):
+        def opt_update(flag, grads, masters, slots, step, lr_scale=1.0):
             new_p = list(masters)
             new_m, new_v = list(slots["m"]), list(slots["v"])
             for group, idxs in zip(opt.param_groups, group_idxs):
@@ -240,7 +248,7 @@ def build_opt_update(optimizer, params, group_idxs,
                 _, g_p, g_m, g_v = ops.multi_tensor_adam(
                     flag, [_gather(grads, idxs), _gather(new_p, idxs),
                            _gather(new_m, idxs), _gather(new_v, idxs)],
-                    group["lr"], b1, b2, group["eps"], step,
+                    group["lr"] * lr_scale, b1, b2, group["eps"], step,
                     opt.adam_w_mode, bool(group["bias_correction"]),
                     group["weight_decay"])
                 _scatter(new_p, idxs, g_p)
@@ -253,7 +261,7 @@ def build_opt_update(optimizer, params, group_idxs,
             return {"m": z, "v": [jnp.zeros(p.shape, jnp.float32)
                                   for p in params]}
     elif isinstance(opt, FusedLAMB):
-        def opt_update(flag, grads, masters, slots, step):
+        def opt_update(flag, grads, masters, slots, step, lr_scale=1.0):
             new_p = list(masters)
             new_m, new_v = list(slots["m"]), list(slots["v"])
             for group, idxs in zip(opt.param_groups, group_idxs):
@@ -267,7 +275,7 @@ def build_opt_update(optimizer, params, group_idxs,
                 _, g_p, g_m, g_v = ops.multi_tensor_lamb(
                     flag, [_gather(grads, idxs), _gather(new_p, idxs),
                            _gather(new_m, idxs), _gather(new_v, idxs)],
-                    group["lr"], b1, b2, group["eps"], step,
+                    group["lr"] * lr_scale, b1, b2, group["eps"], step,
                     bool(group["bias_correction"]), group["weight_decay"],
                     1 if group["grad_averaging"] else 0, opt.adam_w_mode,
                     gnorm, group["max_grad_norm"])
@@ -281,7 +289,7 @@ def build_opt_update(optimizer, params, group_idxs,
             return {"m": z, "v": [jnp.zeros(p.shape, jnp.float32)
                                   for p in params]}
     elif isinstance(opt, FusedNovoGrad):
-        def opt_update(flag, grads, masters, slots, step):
+        def opt_update(flag, grads, masters, slots, step, lr_scale=1.0):
             new_p = list(masters)
             new_m, new_n = list(slots["m"]), list(slots["grad_norms"])
             for group, idxs in zip(opt.param_groups, group_idxs):
@@ -305,7 +313,7 @@ def build_opt_update(optimizer, params, group_idxs,
                 _, g_p, g_m, g_n = ops.multi_tensor_novograd(
                     flag, [g_grads, _gather(new_p, idxs),
                            _gather(new_m, idxs), norms_in],
-                    group["lr"], b1, b2, group["eps"], step,
+                    group["lr"] * lr_scale, b1, b2, group["eps"], step,
                     bool(group["bias_correction"]), group["weight_decay"],
                     1 if group["grad_averaging"] else 0, opt.moment_mode,
                     norm_type)
@@ -338,6 +346,7 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     allreduce_always_fp32: bool = False,
                     donate_state: bool = True,
                     grad_accum_steps: int = 1,
+                    lr_schedule: Optional[Callable] = None,
                     rng_seed: int = 0):
     """Build a fully-fused O2-style train step.
 
@@ -485,7 +494,7 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             state._replace(stats=new_stats), grads, opt_update, model_dtypes,
             dynamic=dynamic, init_scale=init_scale,
             scale_window=scale_window, min_loss_scale=min_loss_scale,
-            max_loss_scale=max_loss_scale)
+            max_loss_scale=max_loss_scale, lr_schedule=lr_schedule)
         return new_state, loss
 
     init_state = init_step_state(params, buffers, model_dtypes, opt_init,
