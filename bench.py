@@ -336,7 +336,7 @@ def run_bert_throughput(batch, seq_len, iters, warmup):
                               lambda: 6.0 * 110e6 * batch * seq_len)
 
 
-def run_gpt_throughput(batch, seq_len, iters, warmup):
+def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False):
     """GPT-2-small causal-LM train step: next-token loss with FusedAdam
     under the bf16 fused step (the autoregressive counterpart of the BERT
     config; no reference analogue — the reference ships no LMs)."""
@@ -355,7 +355,8 @@ def run_gpt_throughput(batch, seq_len, iters, warmup):
     # attention dropout off so every layer takes the causal flash-kernel
     # path (the Pallas kernel has no dropout; modern LM recipes train
     # without it anyway); residual/embedding dropout stays on
-    model = gpt2_small(max_positions=seq_len, attn_dropout=0.0)
+    model = gpt2_small(max_positions=seq_len, attn_dropout=0.0,
+                       remat=remat)
     opt = FusedAdam(list(model.parameters()), lr=6e-4, weight_decay=0.1)
 
     def lm_loss(logits, ids):
@@ -415,6 +416,9 @@ def main():
     ap.add_argument("--gpt", action="store_true",
                     help="run the GPT-2-small causal-LM config")
     ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--remat", action="store_true",
+                    help="with --gpt: rematerialize block activations "
+                         "(long-sequence configs)")
     ap.add_argument("--no-kernels", action="store_true",
                     help="skip the kernel parity checks")
     ap.add_argument("--budget-s", type=float,
@@ -458,7 +462,8 @@ def main():
                     batch, args.seq_len, args.iters, args.warmup)
             elif args.gpt:
                 dt, compile_s, flops, flops_source = run_gpt_throughput(
-                    batch, args.seq_len, args.iters, args.warmup)
+                    batch, args.seq_len, args.iters, args.warmup,
+                    remat=args.remat)
             else:
                 dt, compile_s, flops, flops_source = run_throughput(
                     batch, args.iters, args.warmup)

@@ -35,6 +35,15 @@ def parse_args():
     p.add_argument("--hidden", type=int, default=768)
     p.add_argument("--heads", type=int, default=12)
     p.add_argument("--print-freq", type=int, default=10)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize block activations in backward "
+                        "(long-sequence HBM saver)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatch accumulation steps inside the "
+                        "compiled step")
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="with --total-steps: on-device warmup+cosine lr")
+    p.add_argument("--total-steps", type=int, default=0)
     return p.parse_args()
 
 
@@ -50,7 +59,8 @@ def main():
     model = GptModel(vocab_size=VOCAB, hidden=args.hidden,
                      layers=args.layers, heads=args.heads,
                      max_positions=args.seq_len,
-                     attn_dropout=0.0)  # flash path; LM recipes skip it
+                     attn_dropout=0.0,  # flash path; LM recipes skip it
+                     remat=args.remat)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     print(f"model: {args.layers}L/{args.hidden}H "
           f"({n_params / 1e6:.1f}M params)")
@@ -61,8 +71,14 @@ def main():
         jnp.dtype(args.half_dtype).type
     loss_scale = args.loss_scale if args.loss_scale == "dynamic" \
         else float(args.loss_scale)
+    sched = None
+    if args.warmup_steps and args.total_steps:
+        from apex_tpu.optimizers import warmup_cosine
+        sched = warmup_cosine(args.warmup_steps, args.total_steps)
     step = make_train_step(model, opt, lm_loss, half_dtype=half,
-                           loss_scale=loss_scale)
+                           loss_scale=loss_scale,
+                           grad_accum_steps=args.grad_accum,
+                           lr_schedule=sched)
 
     rng = np.random.default_rng(0)
 
