@@ -42,6 +42,13 @@ def parse_args():
     p.add_argument("--heads", type=int, default=12)
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--mask-prob", type=float, default=0.15)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize layer activations in backward")
+    p.add_argument("--grad-accum", type=int, default=1)
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="with --total-steps: on-device warmup+linear lr "
+                        "(the BERT pretraining shape)")
+    p.add_argument("--total-steps", type=int, default=0)
     return p.parse_args()
 
 
@@ -71,7 +78,7 @@ def main():
     model = BertForMaskedLM(
         vocab_size=VOCAB, hidden=args.hidden, layers=args.layers,
         heads=args.heads, intermediate=4 * args.hidden,
-        max_positions=args.seq_len)
+        max_positions=args.seq_len, remat=args.remat)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     print(f"model: {args.layers}L/{args.hidden}H "
           f"({n_params / 1e6:.1f}M params)")
@@ -82,8 +89,14 @@ def main():
         jnp.dtype(args.half_dtype).type
     loss_scale = args.loss_scale if args.loss_scale == "dynamic" \
         else float(args.loss_scale)
+    sched = None
+    if args.warmup_steps and args.total_steps:
+        from apex_tpu.optimizers import warmup_linear
+        sched = warmup_linear(args.warmup_steps, args.total_steps)
     step = make_train_step(model, opt, mlm_loss, half_dtype=half,
-                           loss_scale=loss_scale)
+                           loss_scale=loss_scale,
+                           grad_accum_steps=args.grad_accum,
+                           lr_schedule=sched)
 
     rng = np.random.default_rng(0)
     ids, labels = mlm_batch(rng, args.batch, args.seq_len, args.mask_prob)

@@ -76,6 +76,16 @@ def synthetic_loader(args, n_classes=1000):
                rng.integers(0, n_classes, (args.batch_size,)))
 
 
+def adjust_learning_rate(optimizer, epoch, args):
+    """The reference recipe (examples/imagenet/main_amp.py there): /10
+    every 30 epochs.  Eager-path lr mutation is free — group["lr"] is read
+    live by the imperative optimizer.step(); the fused path uses
+    make_train_step(lr_schedule=step_decay(...)) instead."""
+    lr = args.lr * (0.1 ** (epoch // 30))
+    for group in optimizer.param_groups:
+        group["lr"] = lr
+
+
 def main():
     args = parse_args()
     import jax
@@ -123,6 +133,7 @@ def main():
 
     half = jnp.bfloat16 if args.opt_level in ("O2", "O3") else None
     for epoch in range(start_epoch, args.epochs):
+        adjust_learning_rate(optimizer, epoch, args)
         batch_time, losses = AverageMeter(), AverageMeter()
         loader = synthetic_loader(args) if args.synthetic else \
             folder_loader(args)
