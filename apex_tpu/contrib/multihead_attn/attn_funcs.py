@@ -166,20 +166,50 @@ def _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout_prob, key,
 def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
                    input_weights, output_weights, input_biases=None,
                    output_biases=None, mask=None, dropout_prob=0.0,
-                   key=None, use_flash=False, causal=False):
+                   key=None, use_flash=False, causal=False,
+                   seq_parallel_axis=None, seq_parallel_impl="ring"):
     """Reference signature parity (self_multihead_attn_func.py:6-10);
     ``use_flash`` selects the Pallas path (the fast_* extension analogue).
     ``causal`` applies the triangle in-kernel (no O(S^2) mask operand) —
-    beyond the reference signature, for decoder models."""
+    beyond the reference signature, for decoder models.
+
+    ``seq_parallel_axis``: run inside shard_map with the time dim sharded
+    on that mesh axis — attention rides the ring (or Ulysses all-to-all,
+    per ``seq_parallel_impl``) while projections stay local.  Masks and
+    attention dropout are not supported on that path (the causal triangle
+    is handled globally by the SP kernels)."""
     t, b, e = inputs.shape
     head_dim = e // heads
     lin = jnp.matmul(inputs, input_weights.T)
     if input_biases is not None:
         lin = lin + input_biases
     q3, k3, v3 = _split_interleaved_qkv(lin, t, b, heads, head_dim)
-    bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)
     dropout = dropout_prob if is_training else 0.0
-    if use_flash and dropout == 0.0:
+    if seq_parallel_axis is not None:
+        from ...parallel.ring_attention import (ring_attention,
+                                                ulysses_attention)
+        if mask is not None:
+            raise NotImplementedError(
+                "masks are not supported under sequence parallelism "
+                "(causal is; key-padding masks would need global offsets)")
+        if dropout > 0.0:
+            raise NotImplementedError(
+                "attention dropout is not supported under sequence "
+                "parallelism (the SP kernels have no dropout, like flash)")
+        if seq_parallel_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"seq_parallel_impl must be 'ring' or 'ulysses', got "
+                f"{seq_parallel_impl!r}")
+        sp_fn = (ring_attention if seq_parallel_impl == "ring"
+                 else ulysses_attention)
+        q4 = q3.reshape(b, heads, t, head_dim)
+        k4 = k3.reshape(b, heads, t, head_dim)
+        v4 = v3.reshape(b, heads, t, head_dim)
+        ctx4 = sp_fn(q4, k4, v4, axis_name=seq_parallel_axis,
+                     causal=causal, scale=scale)
+        ctx3 = ctx4.reshape(b * heads, t, head_dim)
+    elif use_flash and dropout == 0.0:
+        bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)
         q4 = q3.reshape(b, heads, t, head_dim)
         k4 = k3.reshape(b, heads, t, head_dim)
         v4 = v3.reshape(b, heads, t, head_dim)
@@ -187,6 +217,7 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
                                scale=scale)
         ctx3 = ctx4.reshape(b * heads, t, head_dim)
     else:
+        bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)
         ctx3 = _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout,
                                   key, use_time_mask_causal=causal)
     ctx = jnp.swapaxes(ctx3, 0, 1).reshape(t, b, e)

@@ -43,7 +43,8 @@ class _AttnModule(Module):
 
 class SelfMultiheadAttn(_AttnModule):
     def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
-                 include_norm_add=False, impl="fast", causal=False):
+                 include_norm_add=False, impl="fast", causal=False,
+                 seq_parallel_axis=None, seq_parallel_impl="ring"):
         super().__init__()
         self.embed_dim = embed_dim
         self.num_heads = num_heads
@@ -51,6 +52,11 @@ class SelfMultiheadAttn(_AttnModule):
         # causal=True applies the triangle in-kernel (decoder models) —
         # no O(S^2) mask operand; beyond the reference's surface
         self.causal = causal
+        # sequence parallelism: when set, forward must run inside
+        # shard_map with the time dim sharded on this mesh axis; attention
+        # rides the ring (or Ulysses all-to-all) across devices
+        self.seq_parallel_axis = seq_parallel_axis
+        self.seq_parallel_impl = seq_parallel_impl
         self.head_dim = embed_dim // num_heads
         assert self.head_dim * num_heads == embed_dim, \
             "embed_dim must be divisible by num_heads"
@@ -116,7 +122,9 @@ class SelfMultiheadAttn(_AttnModule):
             ctx.value(self.in_proj_bias) if self.bias else None,
             ctx.value(self.out_proj_bias) if self.bias else None,
             mask, self.dropout, key=drop_key,
-            use_flash=(self.impl == "fast"), causal=self.causal)
+            use_flash=(self.impl == "fast"), causal=self.causal,
+            seq_parallel_axis=self.seq_parallel_axis,
+            seq_parallel_impl=self.seq_parallel_impl)
 
         if self.include_norm_add:
             if is_training and self.dropout > 0.0:

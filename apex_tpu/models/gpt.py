@@ -12,6 +12,7 @@ decoder runs ``(S, B, E)`` for the attention module's reference layout.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -25,7 +26,7 @@ class GptBlock(nn.Module):
     residual."""
 
     def __init__(self, hidden, heads, intermediate, dropout=0.1,
-                 attn_dropout=0.1):
+                 attn_dropout=0.1, sp_axis=None):
         super().__init__()
         self.ln1 = FusedLayerNorm(hidden)
         # causal=True: when the flash path applies (attn_dropout == 0 in
@@ -33,7 +34,8 @@ class GptBlock(nn.Module):
         # no O(S^2) mask operand; with attention dropout active the
         # materializing fallback runs (the Pallas kernel has no dropout)
         self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
-                                      impl="fast", causal=True)
+                                      impl="fast", causal=True,
+                                      seq_parallel_axis=sp_axis)
         self.ln2 = FusedLayerNorm(hidden)
         self.fc1 = nn.Linear(hidden, intermediate)
         self.fc2 = nn.Linear(intermediate, hidden)
@@ -53,7 +55,7 @@ class GptModel(nn.Module):
 
     def __init__(self, vocab_size=50257, hidden=768, layers=12, heads=12,
                  intermediate=None, max_positions=1024, dropout=0.1,
-                 attn_dropout=0.1, remat=False):
+                 attn_dropout=0.1, remat=False, sp_axis=None):
         super().__init__()
         intermediate = intermediate or 4 * hidden
         self.hidden = hidden
@@ -62,6 +64,19 @@ class GptModel(nn.Module):
         # (jax.checkpoint) — HBM drops from O(layers * S * E) residuals to
         # O(layers) block boundaries, the long-sequence enabler
         self.remat = remat
+        # sp_axis: sequence parallelism — forward must run inside
+        # shard_map with input_ids sharded on dim 1 over this mesh axis;
+        # attention rides the ring (parallel/ring_attention.py), position
+        # embeddings use global offsets, everything else is local.
+        # max_positions caps the GLOBAL sequence length.  Composes with
+        # remat for the long-context recipe.
+        self.sp_axis = sp_axis
+        if sp_axis is not None and attn_dropout > 0.0:
+            # fail where the config is written, not deep inside
+            # shard_map tracing on the first training step
+            raise ValueError(
+                "sp_axis requires attn_dropout=0.0 — the sequence-"
+                "parallel kernels have no attention dropout (like flash)")
         self.tok_emb = nn.Embedding(vocab_size, hidden)
         self.pos_emb = nn.Embedding(max_positions, hidden)
         # GPT initializer_range=0.02 (nn.Embedding draws std-1 normals; the
@@ -70,20 +85,31 @@ class GptModel(nn.Module):
             emb.weight.data = emb.weight.data * 0.02
         self.drop = nn.Dropout(dropout)
         self.blocks = nn.ModuleList([
-            GptBlock(hidden, heads, intermediate, dropout, attn_dropout)
+            GptBlock(hidden, heads, intermediate, dropout, attn_dropout,
+                     sp_axis=sp_axis)
             for _ in range(layers)])
         self.ln_f = FusedLayerNorm(hidden)
 
     def forward(self, ctx, input_ids):
         b, s = input_ids.shape
-        if s > self.max_positions:
+        if self.sp_axis is not None:
+            # s is the LOCAL shard; global position = shard offset + local
+            n = jax.lax.axis_size(self.sp_axis)
+            if s * n > self.max_positions:
+                raise ValueError(
+                    f"global sequence length {s * n} exceeds "
+                    f"max_positions {self.max_positions}")
+            off = jax.lax.axis_index(self.sp_axis) * s
+            pos = (off + jnp.arange(s, dtype=jnp.int32))[None, :]
+        elif s > self.max_positions:
             # jax gather clamps out-of-range indices, so oversized inputs
             # would silently reuse the last position embedding (torch
             # errors here)
             raise ValueError(
                 f"sequence length {s} exceeds max_positions "
                 f"{self.max_positions}")
-        pos = jnp.arange(s, dtype=jnp.int32)[None, :]
+        else:
+            pos = jnp.arange(s, dtype=jnp.int32)[None, :]
         x = self.tok_emb.forward(ctx, input_ids) \
             + self.pos_emb.forward(ctx, pos)
         x = self.drop.forward(ctx, x)
