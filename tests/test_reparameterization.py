@@ -155,3 +155,41 @@ def test_explicit_bad_name_raises():
         apply_weight_norm(lin, name="weight")  # already reparameterized
     with pytest.raises(ValueError):
         apply_weight_norm(lin, name="bias")  # 1-d
+
+
+def test_weight_norm_through_fused_step(rng):
+    """A weight-normed model trains through make_train_step: the derived
+    weight recomputes from (g, v) inside the compiled step and the
+    normalization invariant holds after updates."""
+    import jax.numpy as jnp
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.reparameterization import apply_weight_norm
+    from apex_tpu.training import make_train_step
+
+    nn.manual_seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    apply_weight_norm(model[0], "weight")
+    opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+    step = make_train_step(model, opt,
+                           lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=None, loss_scale=1.0)
+    x = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (8,)))
+    l0 = float(step(x, y))
+    for _ in range(10):
+        l = float(step(x, y))
+    assert np.isfinite(l) and l < l0
+    step.sync_to_objects()
+    # derived weight == g * v / ||v|| row-wise after training
+    import numpy as np_
+    g = np_.asarray(model[0].weight_g.data)
+    v = np_.asarray(model[0].weight_v.data)
+    w = model[0].weight
+    from apex_tpu.nn.modules import Ctx
+    w_val = np_.asarray(Ctx().value(w))
+    norm = np_.linalg.norm(v.reshape(v.shape[0], -1), axis=1,
+                           keepdims=True)
+    want = (g.reshape(v.shape[0], -1) / norm) * v.reshape(v.shape[0], -1)
+    np_.testing.assert_allclose(w_val.reshape(v.shape[0], -1), want,
+                                rtol=1e-5, atol=1e-6)
