@@ -156,3 +156,23 @@ def test_zero_broadcasts_scalar_tail_args(rng):
     x, y = _batch(rng)
     loss = zstep(x, y, jnp.asarray(0.5, jnp.float32))
     assert np.isfinite(float(loss))
+
+
+def test_zero_hlo_contains_sharded_update_collectives(rng):
+    """The compiled ZeRO step must actually partition the update: params
+    all-gather for the forward, and the gradient reduction lands in
+    shards (true reduce-scatter on TPU; the CPU backend lowers it as
+    all-reduce + dynamic-slice)."""
+    model, opt = _build()
+    step = make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=None, loss_scale=1.0,
+                           donate_state=False)
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    zstep = ZeroTrainStep(step, mesh)
+    x, y = _batch(rng)
+    shs = zstep._batch_shardings((x, y))
+    hlo = zstep._jitted(shs).lower(zstep.state, x, y).compile().as_text()
+    assert hlo.count("all-gather") > 0, "no param all-gather in ZeRO HLO"
+    scattered = hlo.count("reduce-scatter") > 0 or (
+        hlo.count("all-reduce") > 0 and hlo.count("dynamic-slice") > 0)
+    assert scattered, "gradient reduction is not sharded in ZeRO HLO"
