@@ -336,7 +336,8 @@ def run_bert_throughput(batch, seq_len, iters, warmup):
                               lambda: 6.0 * 110e6 * batch * seq_len)
 
 
-def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False):
+def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False,
+                       size="small"):
     """GPT-2-small causal-LM train step: next-token loss with FusedAdam
     under the bf16 fused step (the autoregressive counterpart of the BERT
     config; no reference analogue — the reference ships no LMs)."""
@@ -344,19 +345,21 @@ def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False):
     import numpy as np
 
     import apex_tpu.nn as nn
-    from apex_tpu.models import gpt2_small
+    from apex_tpu.models import gpt2_medium, gpt2_small
     from apex_tpu.nn import functional as F
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.training import make_train_step
 
-    stage("model_build", f"gpt2_small batch={batch} seq={seq_len}")
+    factory, n_params = ((gpt2_medium, 355e6) if size == "medium"
+                         else (gpt2_small, 124e6))
+    stage("model_build", f"gpt2_{size} batch={batch} seq={seq_len}")
     nn.manual_seed(0)
     vocab = 50257
     # attention dropout off so every layer takes the causal flash-kernel
     # path (the Pallas kernel has no dropout; modern LM recipes train
     # without it anyway); residual/embedding dropout stays on
-    model = gpt2_small(max_positions=seq_len, attn_dropout=0.0,
-                       remat=remat)
+    model = factory(max_positions=seq_len, attn_dropout=0.0,
+                    remat=remat)
     opt = FusedAdam(list(model.parameters()), lr=6e-4, weight_decay=0.1)
 
     def lm_loss(logits, ids):
@@ -370,9 +373,9 @@ def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False):
     ids = jnp.asarray(rng.integers(0, vocab, (batch, seq_len)))
 
     stage("compile", f"gpt batch={batch}")
-    # 6 * params * tokens (fwd+bwd), params ~124M
+    # 6 * params * tokens (fwd+bwd)
     return time_compiled_step(step, (ids, ids), iters, warmup,
-                              lambda: 6.0 * 124e6 * batch * seq_len)
+                              lambda: 6.0 * n_params * batch * seq_len)
 
 
 def run_throughput(batch, iters, warmup):
@@ -416,6 +419,9 @@ def main():
     ap.add_argument("--gpt", action="store_true",
                     help="run the GPT-2-small causal-LM config")
     ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--gpt-size", default="small",
+                    choices=["small", "medium"],
+                    help="with --gpt: GPT-2 geometry")
     ap.add_argument("--remat", action="store_true",
                     help="with --gpt: rematerialize block activations "
                          "(long-sequence configs)")
@@ -463,7 +469,7 @@ def main():
             elif args.gpt:
                 dt, compile_s, flops, flops_source = run_gpt_throughput(
                     batch, args.seq_len, args.iters, args.warmup,
-                    remat=args.remat)
+                    remat=args.remat, size=args.gpt_size)
             else:
                 dt, compile_s, flops, flops_source = run_throughput(
                     batch, args.iters, args.warmup)
@@ -497,7 +503,7 @@ def main():
                   "sequences_per_sec_per_chip_ampO2")
         unit, vs_baseline = "sequences/sec/chip", None
     elif args.gpt:
-        metric = (f"gpt2_small_causal_lm_seq{args.seq_len}_"
+        metric = (f"gpt2_{args.gpt_size}_causal_lm_seq{args.seq_len}_"
                   "sequences_per_sec_per_chip_ampO2")
         unit, vs_baseline = "sequences/sec/chip", None
     else:
