@@ -2,4 +2,5 @@ from .resnet import (ResNet, BasicBlock, Bottleneck, resnet18, resnet34,
                      resnet50, resnet101)  # noqa: F401
 from .bert import (BertForMaskedLM, BertLayer, BertModel, bert_base,
                    bert_large)  # noqa: F401
-from .gpt import GptBlock, GptModel, gpt2_small, gpt2_medium  # noqa: F401
+from .gpt import (  # noqa: F401
+    GptBlock, GptModel, generate, gpt2_small, gpt2_medium)

@@ -48,6 +48,40 @@ class GptBlock(nn.Module):
         h = self.fc2.forward(ctx, h)
         return x + self.dropout.forward(ctx, h)
 
+    def decode(self, ctx, x, kcache, vcache, t):
+        """One-token decode with a KV cache: ``x (B, E)`` at global
+        position ``t`` (traced i32), caches ``(B, H, S_max, D)``.
+        Mirrors the training projection exactly (the interleaved QKV
+        layout of attn_funcs._split_interleaved_qkv) so a cache filled by
+        decode reproduces the training forward's attention."""
+        attn = self.attn
+        heads, d = attn.num_heads, attn.head_dim
+        b = x.shape[0]
+        h = self.ln1.forward(ctx, x)
+        qkv = jnp.matmul(h, ctx.value(attn.in_proj_weight).T.astype(h.dtype))
+        qkv = qkv.reshape(b, heads, 3, d)
+        q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        kcache = jax.lax.dynamic_update_slice(
+            kcache, k_new[:, :, None, :].astype(kcache.dtype),
+            (0, 0, t, 0))
+        vcache = jax.lax.dynamic_update_slice(
+            vcache, v_new[:, :, None, :].astype(vcache.dtype),
+            (0, 0, t, 0))
+        s_max = kcache.shape[2]
+        scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                            kcache.astype(jnp.float32)) * attn.scaling
+        # cache slots beyond t are unwritten (or stale): mask them out
+        valid = jnp.arange(s_max) <= t
+        scores = jnp.where(valid[None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhs,bhsd->bhd", probs,
+                       vcache.astype(jnp.float32)).astype(x.dtype)
+        o = o.reshape(b, heads * d)
+        o = jnp.matmul(o, ctx.value(attn.out_proj_weight).T.astype(o.dtype))
+        x = x + o
+        hh = F.gelu(self.fc1.forward(ctx, self.ln2.forward(ctx, x)))
+        return x + self.fc2.forward(ctx, hh), kcache, vcache
+
 
 class GptModel(nn.Module):
     """Token+position embeddings → N pre-LN causal blocks → final LN →
@@ -123,6 +157,120 @@ class GptModel(nn.Module):
         x = jnp.swapaxes(x, 0, 1)          # (B, S, E)
         emb = ctx.value(self.tok_emb.weight)
         return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype))
+
+
+    def init_caches(self, batch, s_max, dtype=jnp.float32):
+        """Per-layer (k, v) caches of shape (B, H, S_max, D)."""
+        blk0 = self.blocks[0]
+        h, d = blk0.attn.num_heads, blk0.attn.head_dim
+        return [(jnp.zeros((batch, h, s_max, d), dtype),
+                 jnp.zeros((batch, h, s_max, d), dtype))
+                for _ in self.blocks]
+
+    def decode_step(self, ctx, tok, caches, t):
+        """Logits for one token: ``tok (B,)`` ids at global position
+        ``t`` (traced i32).  Returns ``(logits (B, V), new_caches)``."""
+        if self.sp_axis is not None:
+            raise NotImplementedError(
+                "decode_step is single-shard; build the model without "
+                "sp_axis for inference")
+        emb = ctx.value(self.tok_emb.weight)
+        pos = ctx.value(self.pos_emb.weight)
+        x = emb[tok] + jax.lax.dynamic_index_in_dim(pos, t, keepdims=False)
+        new_caches = []
+        for blk, (kc, vc) in zip(self.blocks, caches):
+            x, kc, vc = blk.decode(ctx, x, kc, vc, t)
+            new_caches.append((kc, vc))
+        x = self.ln_f.forward(ctx, x)
+        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype)), \
+            new_caches
+
+
+def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
+             top_k=None, key=None, cache_dtype=None):
+    """Autoregressive sampling with a KV cache, compiled as one
+    ``lax.scan`` over positions (prefill and generation share the same
+    per-token decode, so there is exactly one compiled step; the
+    compiled program is cached per model instance and config, so repeated
+    calls pay compile once).
+
+    ``prompt_ids (B, P)``; returns ``(B, P + max_new_tokens)``.
+    ``temperature=0`` is greedy; ``top_k`` restricts sampling;
+    ``cache_dtype`` defaults to the token-embedding dtype (use
+    ``jnp.bfloat16`` to halve cache HBM for fp32 checkpoints).  The
+    reference has no inference path (it is a training-side library); this
+    is the decode half of the GPT family.
+    """
+    from ..nn.modules import Ctx
+
+    b, p = prompt_ids.shape
+    s_total = p + max_new_tokens
+    if s_total > model.max_positions:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_positions {model.max_positions}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    vocab = model.tok_emb.weight.shape[0]
+    if top_k is not None and not 1 <= top_k <= vocab:
+        raise ValueError(
+            f"top_k must be in [1, vocab={vocab}], got {top_k}")
+
+    params = [q for q in model.parameters()]
+    buffers = list(model.buffers())
+    vals = [q.data for q in params] + [bu.data for bu in buffers]
+    if cache_dtype is None:
+        cache_dtype = model.tok_emb.weight.data.dtype
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(k, logits, axis=-1)
+
+    prompt_padded = jnp.concatenate(
+        [prompt_ids, jnp.zeros((b, max_new_tokens), prompt_ids.dtype)],
+        axis=1)
+
+    def run(vals, prompt_padded, key):
+        env = {id(o): v for o, v in zip(params + buffers, vals)}
+        ctx = Ctx(env=env, stats_out={}, training=False)
+        caches = model.init_caches(b, s_total, dtype=cache_dtype)
+
+        def step(carry, t):
+            tok, caches, key = carry
+            logits, caches = model.decode_step(ctx, tok, caches, t)
+            key, sub = jax.random.split(key)
+            sampled = sample(logits, sub)
+            # teacher-force inside the prompt, sample past it (the scan
+            # covers t < s_total - 1, so t + 1 is always in bounds)
+            nxt = jnp.where(t + 1 < p, prompt_padded[:, t + 1], sampled)
+            return (nxt, caches, key), nxt
+
+        (_, _, _), toks = jax.lax.scan(
+            step, (prompt_padded[:, 0], caches, key),
+            jnp.arange(s_total - 1))
+        return jnp.concatenate(
+            [prompt_padded[:, :1], jnp.swapaxes(toks, 0, 1)], axis=1)
+
+    # jit caches by function identity: memoize the compiled run per
+    # model instance + config so repeated generate() calls reuse it
+    cache = getattr(model, "_generate_jit_cache", None)
+    if cache is None:
+        cache = model._generate_jit_cache = {}
+    cfg = (b, p, max_new_tokens, float(temperature), top_k,
+           jnp.dtype(cache_dtype).name)
+    jitted = cache.get(cfg)
+    if jitted is None:
+        jitted = cache[cfg] = jax.jit(run)
+    return jitted(vals, prompt_padded, key)
 
 
 def gpt2_small(**kw):
