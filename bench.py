@@ -378,6 +378,41 @@ def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False,
                               lambda: 6.0 * n_params * batch * seq_len)
 
 
+def run_decode_throughput(batch, seq_len, new_tokens=128):
+    """Greedy KV-cache decode tokens/s (gpt2-small): one warm compiled
+    call timed via value fetch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.models import generate, gpt2_small
+
+    stage("model_build", f"gpt2_small decode batch={batch}")
+    nn.manual_seed(0)
+    model = gpt2_small(max_positions=seq_len + new_tokens,
+                       attn_dropout=0.0, dropout=0.0)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 50257, (batch, seq_len)))
+
+    stage("compile", f"decode scan over {seq_len + new_tokens} positions")
+    tc = time.perf_counter()
+    out = generate(model, prompt, new_tokens)
+    int(jnp.sum(out))                       # fetch = sync
+    compile_s = time.perf_counter() - tc
+    log(f"compiled in {compile_s:.1f}s")
+
+    stage("timing", "3 decode calls")
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = generate(model, prompt, new_tokens)
+        int(jnp.sum(out))
+    dt = (time.perf_counter() - t0) / 3
+    toks_per_sec = batch * new_tokens / dt
+    return toks_per_sec, dt, compile_s
+
+
 def run_throughput(batch, iters, warmup):
     import jax.numpy as jnp
     import numpy as np
@@ -418,6 +453,8 @@ def main():
                          "instead of ResNet-50")
     ap.add_argument("--gpt", action="store_true",
                     help="run the GPT-2-small causal-LM config")
+    ap.add_argument("--gpt-decode", action="store_true",
+                    help="measure greedy KV-cache decode tokens/s")
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--gpt-size", default="small",
                     choices=["small", "medium"],
@@ -449,6 +486,24 @@ def main():
               and res.get("vmem_guard") == "pass")
         emit({"metric": "pallas_kernel_parity", "value": 1.0 if ok else 0.0,
               "unit": "pass", "vs_baseline": None, "kernels": res})
+        return 0
+
+    if args.gpt_decode:
+        batch = args.batch or 8
+        try:
+            toks, dt, compile_s = run_decode_throughput(
+                batch, args.seq_len)
+        except Exception as e:
+            fail(f"decode_failed: {type(e).__name__}: {e}")
+            return 1
+        emit({"metric": "gpt2_small_greedy_decode_tokens_per_sec_per_chip",
+              "value": round(toks, 1), "unit": "tokens/sec/chip",
+              "vs_baseline": None, "batch": batch,
+              "prompt_len": args.seq_len, "new_tokens": 128,
+              "call_time_s": round(dt, 3),
+              "compile_s": round(compile_s, 1),
+              "device_kind": (devices[0].device_kind or "").lower(),
+              "kernels": None})
         return 0
 
     dt = compile_s = flops = None
