@@ -4,3 +4,5 @@ from .bert import (BertForMaskedLM, BertLayer, BertModel, bert_base,
                    bert_large)  # noqa: F401
 from .gpt import (  # noqa: F401
     GptBlock, GptModel, generate, gpt2_small, gpt2_medium)
+from .seq2seq import (  # noqa: F401
+    Seq2SeqDecoderLayer, TransformerSeq2Seq, transformer_seq2seq)

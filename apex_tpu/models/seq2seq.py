@@ -1,0 +1,130 @@
+"""Transformer encoder-decoder (seq2seq) family — completes the
+transformer trio (BERT encoder, GPT decoder, this cross-attending pair)
+and is the model-level consumer of ``EncdecMultiheadAttn`` (reference
+apex/contrib/multihead_attn/encdec_multihead_attn.py, which the reference
+only ever shipped as a bare module).
+
+The encoder reuses ``BertLayer`` (post-LN, the BERT convention — each
+layer ends normalized, so no extra final LN); the decoder is pre-LN:
+causal self-attention → cross-attention over the encoder memory → GELU
+FFN, with a final LN before the head.  Layout: public API is batch-first
+``(B, S)`` ids; internals run ``(S, B, E)``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..normalization import FusedLayerNorm
+from ..contrib.multihead_attn import EncdecMultiheadAttn, SelfMultiheadAttn
+from .bert import BertLayer
+
+
+class Seq2SeqDecoderLayer(nn.Module):
+    """LN → causal self-MHA → residual, LN → cross-MHA(memory) →
+    residual, LN → GELU FFN → residual."""
+
+    def __init__(self, hidden, heads, intermediate, dropout=0.1,
+                 attn_dropout=0.1):
+        super().__init__()
+        self.ln1 = FusedLayerNorm(hidden)
+        self.self_attn = SelfMultiheadAttn(
+            hidden, heads, dropout=attn_dropout, impl="fast", causal=True)
+        self.ln2 = FusedLayerNorm(hidden)
+        self.cross_attn = EncdecMultiheadAttn(
+            hidden, heads, dropout=attn_dropout, impl="fast")
+        self.ln3 = FusedLayerNorm(hidden)
+        self.fc1 = nn.Linear(hidden, intermediate)
+        self.fc2 = nn.Linear(intermediate, hidden)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, ctx, x, memory, memory_kpm=None):
+        h, _ = self.self_attn.forward(ctx, self.ln1.forward(ctx, x))
+        x = x + self.dropout.forward(ctx, h)
+        h, _ = self.cross_attn.forward(ctx, self.ln2.forward(ctx, x),
+                                       memory, key_padding_mask=memory_kpm)
+        x = x + self.dropout.forward(ctx, h)
+        h = F.gelu(self.fc1.forward(ctx, self.ln3.forward(ctx, x)))
+        h = self.fc2.forward(ctx, h)
+        return x + self.dropout.forward(ctx, h)
+
+
+class TransformerSeq2Seq(nn.Module):
+    """Shared-vocab encoder-decoder with a weight-tied output head.
+
+    ``forward(src_ids (B, S_src), tgt_ids (B, S_tgt),
+    src_attention_mask=None) -> logits (B, S_tgt, V)``.
+    ``src_attention_mask`` follows the BERT convention (1 = real token,
+    0 = padding) and masks encoder self-attention AND decoder
+    cross-attention.
+    """
+
+    def __init__(self, vocab_size=32000, hidden=512, enc_layers=6,
+                 dec_layers=6, heads=8, intermediate=None,
+                 max_positions=512, dropout=0.1, attn_dropout=0.1):
+        super().__init__()
+        intermediate = intermediate or 4 * hidden
+        self.hidden = hidden
+        self.max_positions = max_positions
+        self.tok_emb = nn.Embedding(vocab_size, hidden)
+        self.pos_emb = nn.Embedding(max_positions, hidden)
+        for emb in (self.tok_emb, self.pos_emb):
+            emb.weight.data = emb.weight.data * 0.02
+        self.drop = nn.Dropout(dropout)
+        self.enc_layers = nn.ModuleList([
+            BertLayer(hidden, heads, intermediate, dropout, attn_dropout)
+            for _ in range(enc_layers)])
+        self.dec_layers = nn.ModuleList([
+            Seq2SeqDecoderLayer(hidden, heads, intermediate, dropout,
+                                attn_dropout)
+            for _ in range(dec_layers)])
+        self.dec_ln = FusedLayerNorm(hidden)
+
+    def _embed(self, ctx, ids):
+        s = ids.shape[1]
+        if s > self.max_positions:
+            raise ValueError(
+                f"sequence length {s} exceeds max_positions "
+                f"{self.max_positions}")
+        pos = jnp.arange(s, dtype=jnp.int32)[None, :]
+        x = self.tok_emb.forward(ctx, ids) + self.pos_emb.forward(ctx, pos)
+        x = self.drop.forward(ctx, x)
+        return jnp.swapaxes(x, 0, 1)            # (S, B, E)
+
+    def forward(self, ctx, src_ids, tgt_ids=None, src_attention_mask=None):
+        # packed form: forward(ctx, (src_ids, tgt_ids[, mask])) — lets the
+        # fused step feed both streams as batch[0] (training/step.py casts
+        # and microbatches pytree inputs)
+        if tgt_ids is None:
+            if not isinstance(src_ids, (tuple, list)) or \
+                    len(src_ids) not in (2, 3):
+                raise TypeError(
+                    "seq2seq forward needs (src_ids, tgt_ids[, mask]) — "
+                    "either as positional args or packed in one tuple")
+            src_ids, tgt_ids, *rest = src_ids
+            if rest:
+                src_attention_mask = rest[0]
+        kpm = None
+        if src_attention_mask is not None:
+            kpm = (src_attention_mask == 0)
+        mem = self._embed(ctx, src_ids)
+        for layer in self.enc_layers:
+            mem = layer.forward(ctx, mem, key_padding_mask=kpm)
+        # BertLayer is post-LN: the last layer's output is already
+        # normalized, no extra encoder LN needed
+
+        x = self._embed(ctx, tgt_ids)
+        for layer in self.dec_layers:
+            x = layer.forward(ctx, x, mem, memory_kpm=kpm)
+        x = self.dec_ln.forward(ctx, x)
+        x = jnp.swapaxes(x, 0, 1)               # (B, S_tgt, E)
+        emb = ctx.value(self.tok_emb.weight)
+        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype))
+
+
+def transformer_seq2seq(**kw):
+    """Base geometry: 6+6 layers, hidden 512, 8 heads (transformer-base
+    shape)."""
+    return TransformerSeq2Seq(**{**dict(hidden=512, enc_layers=6,
+                                        dec_layers=6, heads=8), **kw})

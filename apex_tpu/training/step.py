@@ -413,11 +413,13 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             ctx = Ctx(env={**env, **stats_env}, stats_out=stats_out,
                       training=True, key=key)
             x = b[0]
-            if half_dtype is not None and jnp.issubdtype(x.dtype,
-                                                         jnp.floating):
+            if half_dtype is not None:
                 # O2 input cast (reference patches model.forward to cast
-                # incoming data, _initialize.py:194-201)
-                x = x.astype(half_dtype)
+                # incoming data, _initialize.py:194-201); tree-mapped so
+                # multi-input models (tuples/dicts of arrays, e.g. a
+                # seq2seq's (src, tgt) pair) cast every floating leaf
+                from ..amp.policy import _cast_tree
+                x = _cast_tree(x, jnp.dtype(half_dtype))
             out = model.forward(ctx, x)
             loss = loss_fn(out, *b[1:])
             new_stats = [stats_out.get(id(bf), sv)
@@ -432,25 +434,36 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     *batch)
         else:
             def split(b):
-                n = b.shape[0]
-                if n % grad_accum_steps:
-                    raise ValueError(
-                        f"grad_accum_steps={grad_accum_steps}: batch "
-                        f"leading dim {n} is not divisible "
-                        f"into microbatches")
-                return b.reshape(
-                    (grad_accum_steps, n // grad_accum_steps) + b.shape[1:])
+                def leaf(a):
+                    n = a.shape[0]
+                    if n % grad_accum_steps:
+                        raise ValueError(
+                            f"grad_accum_steps={grad_accum_steps}: batch "
+                            f"leading dim {n} is not divisible "
+                            f"into microbatches")
+                    return a.reshape(
+                        (grad_accum_steps, n // grad_accum_steps)
+                        + a.shape[1:])
+                return jax.tree.map(leaf, b)
 
-            if not hasattr(batch[0], "ndim") or batch[0].ndim < 1:
+            leaves0 = [a for a in jax.tree.leaves(batch[0])
+                       if getattr(a, "ndim", 0) >= 1]
+            if not leaves0:
                 raise ValueError(
                     f"grad_accum_steps={grad_accum_steps}: the model input "
                     f"(batch[0]) has no leading batch dimension to split")
-            n0 = batch[0].shape[0]
-            # elements sharing the model input's batch dim split into
-            # microbatches; anything else (scalars, per-step constants,
-            # custom containers) is broadcast to every microbatch
-            splits = [i == 0 or (getattr(b, "ndim", 0) >= 1
-                                 and b.shape[0] == n0)
+            n0 = leaves0[0].shape[0]
+
+            def splittable(b):
+                leaves = jax.tree.leaves(b)
+                return bool(leaves) and all(
+                    getattr(a, "ndim", 0) >= 1 and a.shape[0] == n0
+                    for a in leaves)
+
+            # elements (pytrees) whose every leaf shares the model
+            # input's batch dim split into microbatches; anything else
+            # (scalars, per-step constants) is broadcast
+            splits = [i == 0 or splittable(b)
                       for i, b in enumerate(batch)]
             micro = tuple(split(b) for b, s in zip(batch, splits) if s)
 
