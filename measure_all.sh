@@ -1,0 +1,33 @@
+#!/bin/bash
+# One-shot measurement matrix for a healthy TPU tunnel: each config runs
+# as its own bench.py process (own watchdog, own diagnostic JSON line on
+# failure).  Appends raw JSON lines to MEASURE_LOG (default
+# measurements.jsonl) for transfer into BENCH_HISTORY.md.
+set -u
+LOG="${MEASURE_LOG:-measurements.jsonl}"
+cd "$(dirname "$0")"
+
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((64, 64)); print('probe ok:', float(jnp.sum(x @ x)))
+" 2>/dev/null
+}
+
+if ! probe; then
+  echo "tunnel not healthy; aborting" >&2
+  exit 1
+fi
+
+run() {
+  echo "=== $* ===" >&2
+  timeout 700 python bench.py "$@" 2>>"$LOG.err" | tee -a "$LOG"
+}
+
+run                                   # resnet50 headline + kernels
+run --bert
+run --gpt
+run 16 --gpt --seq-len 1024
+run 8 --gpt --seq-len 2048 --remat
+run --gpt-decode
+echo "done; results in $LOG" >&2
