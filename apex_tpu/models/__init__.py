@@ -5,4 +5,5 @@ from .bert import (BertForMaskedLM, BertLayer, BertModel, bert_base,
 from .gpt import (  # noqa: F401
     GptBlock, GptModel, generate, gpt2_small, gpt2_medium)
 from .seq2seq import (  # noqa: F401
-    Seq2SeqDecoderLayer, TransformerSeq2Seq, transformer_seq2seq)
+    Seq2SeqDecoderLayer, TransformerSeq2Seq, seq2seq_generate,
+    transformer_seq2seq)

@@ -128,3 +128,74 @@ def transformer_seq2seq(**kw):
     shape)."""
     return TransformerSeq2Seq(**{**dict(hidden=512, enc_layers=6,
                                         dec_layers=6, heads=8), **kw})
+
+
+def seq2seq_generate(model: TransformerSeq2Seq, src_ids, max_new_tokens,
+                     bos_id=0, src_attention_mask=None):
+    """Greedy decoding: encode the source once, then extend the target
+    one token per step.  The decoder runs over a fixed-size padded target
+    buffer every step (causal attention makes positions > t inert), so
+    the whole loop is ONE compiled ``lax.scan`` — simple and
+    compile-once; a decoder KV cache (as in ``gpt.generate``) is the
+    next optimization if decode throughput ever matters here.
+
+    ``src_ids (B, S_src)`` → ``(B, max_new_tokens)`` generated ids
+    (BOS not included).  Compiled programs are cached per model + shapes.
+    """
+    import jax
+
+    from ..nn.modules import Ctx
+
+    b, _ = src_ids.shape
+    if max_new_tokens + 1 > model.max_positions:
+        raise ValueError(
+            f"max_new_tokens {max_new_tokens} exceeds max_positions "
+            f"{model.max_positions} - 1")
+
+    params = [q for q in model.parameters()]
+    buffers = list(model.buffers())
+    vals = [q.data for q in params] + [bu.data for bu in buffers]
+
+    def run(vals, src_ids, mask):
+        env = {id(o): v for o, v in zip(params + buffers, vals)}
+        ctx = Ctx(env=env, stats_out={}, training=False)
+        kpm = None if mask is None else (mask == 0)
+
+        mem = model._embed(ctx, src_ids)
+        for layer in model.enc_layers:
+            mem = layer.forward(ctx, mem, key_padding_mask=kpm)
+
+        def decode(tgt_buf):
+            x = model._embed(ctx, tgt_buf)
+            for layer in model.dec_layers:
+                x = layer.forward(ctx, x, mem, memory_kpm=kpm)
+            x = model.dec_ln.forward(ctx, x)
+            x = jnp.swapaxes(x, 0, 1)
+            emb = ctx.value(model.tok_emb.weight)
+            return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype))
+
+        buf0 = jnp.full((b, max_new_tokens + 1), bos_id, src_ids.dtype)
+
+        def step(buf, t):
+            logits = decode(buf)
+            # causal decoder: position t's logits depend only on <= t
+            row = jax.lax.dynamic_index_in_dim(logits, t, axis=1,
+                                               keepdims=False)
+            tok_t = row.argmax(axis=-1).astype(buf.dtype)
+            buf = jax.lax.dynamic_update_slice(
+                buf, tok_t[:, None], (0, t + 1))
+            return buf, tok_t
+
+        buf, toks = jax.lax.scan(step, buf0,
+                                 jnp.arange(max_new_tokens))
+        return jnp.swapaxes(toks, 0, 1)
+
+    cache = getattr(model, "_s2s_gen_cache", None)
+    if cache is None:
+        cache = model._s2s_gen_cache = {}
+    cfg = (b, src_ids.shape[1], max_new_tokens, int(bos_id),
+           src_attention_mask is not None)
+    jitted = cache.get(cfg)
+    if jitted is None:
+        jitted = cache[cfg] = jax.jit(run)
+    return jitted(vals, src_ids, src_attention_mask)
