@@ -336,6 +336,43 @@ def run_bert_throughput(batch, seq_len, iters, warmup):
                               lambda: 6.0 * 110e6 * batch * seq_len)
 
 
+def run_seq2seq_throughput(batch, seq_len, iters, warmup):
+    """Transformer-base seq2seq train step (copy-style synthetic pairs):
+    sequences/sec through the fused bf16 step."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.models import transformer_seq2seq
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    stage("model_build", f"seq2seq-base batch={batch} seq={seq_len}")
+    nn.manual_seed(0)
+    vocab = 32000
+    model = transformer_seq2seq(vocab_size=vocab, max_positions=seq_len,
+                                attn_dropout=0.0)
+    opt = FusedAdam(list(model.parameters()), lr=1e-3)
+
+    def loss_fn(logits, tgt_out):
+        return F.cross_entropy(logits.reshape((-1, vocab)),
+                               tgt_out.reshape((-1,)))
+
+    step = make_train_step(model, opt, loss_fn, half_dtype=jnp.bfloat16,
+                           loss_scale=1.0)
+    rng = np.random.default_rng(0)
+    src_ids = jnp.asarray(rng.integers(1, vocab, (batch, seq_len)))
+    tgt_in = jnp.concatenate(
+        [jnp.zeros((batch, 1), src_ids.dtype), src_ids[:, :-1]], axis=1)
+
+    stage("compile", f"seq2seq batch={batch}")
+    # ~60M params transformer-base, 6 * params * (src+tgt) tokens
+    return time_compiled_step(step, ((src_ids, tgt_in), src_ids), iters,
+                              warmup,
+                              lambda: 6.0 * 60e6 * batch * 2 * seq_len)
+
+
 def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False,
                        size="small"):
     """GPT-2-small causal-LM train step: next-token loss with FusedAdam
@@ -455,6 +492,8 @@ def main():
                     help="run the GPT-2-small causal-LM config")
     ap.add_argument("--gpt-decode", action="store_true",
                     help="measure greedy KV-cache decode tokens/s")
+    ap.add_argument("--seq2seq", action="store_true",
+                    help="run the transformer-base seq2seq config")
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--gpt-size", default="small",
                     choices=["small", "medium"],
@@ -512,7 +551,8 @@ def main():
     # per-config default batch; an explicitly requested batch is honored
     first_batch = args.batch
     if first_batch is None:
-        first_batch = 64 if (args.bert or args.gpt) else 128
+        first_batch = 64 if (args.bert or args.gpt or args.seq2seq) \
+            else 128
         log(f"default batch: {first_batch}")
     for batch in [first_batch, first_batch // 2, first_batch // 4]:
         if batch < 1:
@@ -520,6 +560,9 @@ def main():
         try:
             if args.bert:
                 dt, compile_s, flops, flops_source = run_bert_throughput(
+                    batch, args.seq_len, args.iters, args.warmup)
+            elif args.seq2seq:
+                dt, compile_s, flops, flops_source = run_seq2seq_throughput(
                     batch, args.seq_len, args.iters, args.warmup)
             elif args.gpt:
                 dt, compile_s, flops, flops_source = run_gpt_throughput(
@@ -559,6 +602,10 @@ def main():
         unit, vs_baseline = "sequences/sec/chip", None
     elif args.gpt:
         metric = (f"gpt2_{args.gpt_size}_causal_lm_seq{args.seq_len}_"
+                  "sequences_per_sec_per_chip_ampO2")
+        unit, vs_baseline = "sequences/sec/chip", None
+    elif args.seq2seq:
+        metric = (f"seq2seq_base_seq{args.seq_len}_"
                   "sequences_per_sec_per_chip_ampO2")
         unit, vs_baseline = "sequences/sec/chip", None
     else:

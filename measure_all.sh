@@ -30,4 +30,5 @@ run --gpt
 run 16 --gpt --seq-len 1024
 run 8 --gpt --seq-len 2048 --remat
 run --gpt-decode
+run --seq2seq
 echo "done; results in $LOG" >&2
