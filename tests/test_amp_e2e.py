@@ -202,3 +202,48 @@ def test_fused_adam_O2():
     _, _, losses = _train(
         "O2", make_opt=lambda ps: FusedAdam(ps, lr=1e-3))
     assert losses[-1] < losses[0]
+
+
+def test_transformer_through_imperative_amp_O2():
+    """The imperative path (amp.initialize O2 + scale_loss + FusedLAMB)
+    trains a transformer — flash attention and FusedLayerNorm under the
+    tape, fp32 masters behind bf16 model params."""
+    _reset_amp()
+    from apex_tpu.models import BertModel
+
+    nn.manual_seed(7)
+    V = 67
+    bert = BertModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                     intermediate=64, max_positions=16, dropout=0.0,
+                     attn_dropout=0.0)
+    head = nn.Linear(32, V)
+
+    class WithHead(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.bert = bert
+            self.head = head
+
+        def forward(self, ctx, ids):
+            return self.head.forward(ctx, self.bert.forward(ctx, ids))
+
+    model = WithHead()
+    from apex_tpu.optimizers import FusedLAMB
+    opt = FusedLAMB(list(model.parameters()), lr=5e-3)
+    model, opt = amp.initialize(model, opt, opt_level="O2", verbosity=0,
+                                cast_model_type=jnp.bfloat16,
+                                loss_scale=1.0)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, V, (4, 16)))
+    crit = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(10):
+        out = model(ids)
+        loss = crit(out.reshape((-1, V)), ids.reshape((-1,)))
+        with amp.scale_loss(loss, opt) as scaled:
+            scaled.backward()
+        opt.step()
+        opt.zero_grad()
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
