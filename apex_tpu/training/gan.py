@@ -85,13 +85,15 @@ def make_gan_train_step(netD, netG, optD, optG,
                         min_loss_scale: Optional[float] = None,
                         max_loss_scale: float = 2.0 ** 24,
                         donate_state: bool = True,
+                        lr_schedule: Optional[Callable] = None,
                         rng_seed: int = 0):
     """Build the fused GAN iteration.
 
     ``d_loss_fn(d_real_out, d_fake_out) -> scalar`` and
     ``g_loss_fn(d_fake_out) -> scalar`` (e.g. BCE against real/fake labels).
     The step signature is ``step(state, real_batch, z) -> (state,
-    (errD, errG))``.
+    (errD, errG))``.  ``lr_schedule`` applies to both optimizers from
+    each network's own step counter (as in make_train_step).
     """
     d_parts = _net_parts(netD, optD, half_dtype, keep_batchnorm_fp32,
                          "make_gan_train_step(netD)")
@@ -120,7 +122,8 @@ def make_gan_train_step(netD, netG, optD, optG,
         return apply_fused_update(
             sub, grads, opt_update, dtypes, dynamic=dynamic,
             init_scale=init_scale, scale_window=scale_window,
-            min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale)
+            min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale,
+            lr_schedule=lr_schedule)
 
     def step_fn(state: GanStepState, real, z):
         d, g = state.d, state.g

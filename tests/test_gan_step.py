@@ -184,3 +184,43 @@ def test_gan_step_with_dropout_discriminator(rng):
         errD, errG = step(real, z)
         assert np.isfinite(float(errD)) and np.isfinite(float(errG))
     assert int(step.state.d.step) == 3 and int(step.state.g.step) == 3
+
+
+def test_gan_step_lr_schedule_applies(rng):
+    """A 0.1x schedule multiplier shrinks both networks' first-step
+    updates vs the unscheduled run."""
+    import jax.numpy as jnp
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_gan_train_step
+
+    def build(sched):
+        nn.manual_seed(0)
+        netD = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        netG = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 8))
+        optD = FusedAdam(list(netD.parameters()), lr=1e-2)
+        optG = FusedAdam(list(netG.parameters()), lr=1e-2)
+
+        def d_loss(dr, df):
+            return jnp.mean((dr - 1.0) ** 2) + jnp.mean(df ** 2)
+
+        def g_loss(df):
+            return jnp.mean((df - 1.0) ** 2)
+
+        return make_gan_train_step(netD, netG, optD, optG, d_loss, g_loss,
+                                   half_dtype=None, loss_scale=1.0,
+                                   donate_state=False, lr_schedule=sched)
+
+    real = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+    z = jnp.asarray(rng.standard_normal((8, 4)), jnp.float32)
+
+    def first_deltas(sched):
+        step = build(sched)
+        d0 = np.asarray(step.state.d.master_params[0])
+        g0 = np.asarray(step.state.g.master_params[0])
+        state, _ = step._step_fn(step.state, real, z)
+        return (np.abs(np.asarray(state.d.master_params[0]) - d0).max(),
+                np.abs(np.asarray(state.g.master_params[0]) - g0).max())
+
+    full_d, full_g = first_deltas(None)
+    s_d, s_g = first_deltas(lambda s: jnp.asarray(0.1, jnp.float32))
+    assert s_d < full_d * 0.5 and s_g < full_g * 0.5
