@@ -175,9 +175,10 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
 
     ``seq_parallel_axis``: run inside shard_map with the time dim sharded
     on that mesh axis — attention rides the ring (or Ulysses all-to-all,
-    per ``seq_parallel_impl``) while projections stay local.  Masks and
-    attention dropout are not supported on that path (the causal triangle
-    is handled globally by the SP kernels)."""
+    per ``seq_parallel_impl``) while projections stay local.  The causal
+    triangle is handled globally by the SP kernels; masks are supported
+    under 'ulysses' only (pass them GLOBAL-shape and replicated), and
+    attention dropout not at all."""
     t, b, e = inputs.shape
     head_dim = e // heads
     lin = jnp.matmul(inputs, input_weights.T)
@@ -188,25 +189,38 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
     if seq_parallel_axis is not None:
         from ...parallel.ring_attention import (ring_attention,
                                                 ulysses_attention)
-        if mask is not None:
-            raise NotImplementedError(
-                "masks are not supported under sequence parallelism "
-                "(causal is; key-padding masks would need global offsets)")
-        if dropout > 0.0:
-            raise NotImplementedError(
-                "attention dropout is not supported under sequence "
-                "parallelism (the SP kernels have no dropout, like flash)")
         if seq_parallel_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"seq_parallel_impl must be 'ring' or 'ulysses', got "
                 f"{seq_parallel_impl!r}")
-        sp_fn = (ring_attention if seq_parallel_impl == "ring"
-                 else ulysses_attention)
+        sp_bias = None
+        if mask is not None:
+            if seq_parallel_impl != "ulysses":
+                raise NotImplementedError(
+                    "masks under sequence parallelism require the "
+                    "'ulysses' impl (each device sees the gathered global "
+                    "sequence there; the ring carries no mask operand)")
+            # the mask must be GLOBAL (key_padding (B, S_global) or time
+            # (S_g, S_g)) and replicated across the axis; the bias derives
+            # from the mask's own (global) shape, and ulysses_attention
+            # validates it against the gathered lengths
+            sp_bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)
+        if dropout > 0.0:
+            raise NotImplementedError(
+                "attention dropout is not supported under sequence "
+                "parallelism (the SP kernels have no dropout, like flash)")
         q4 = q3.reshape(b, heads, t, head_dim)
         k4 = k3.reshape(b, heads, t, head_dim)
         v4 = v3.reshape(b, heads, t, head_dim)
-        ctx4 = sp_fn(q4, k4, v4, axis_name=seq_parallel_axis,
-                     causal=causal, scale=scale)
+        if seq_parallel_impl == "ring":
+            ctx4 = ring_attention(q4, k4, v4,
+                                  axis_name=seq_parallel_axis,
+                                  causal=causal, scale=scale)
+        else:
+            ctx4 = ulysses_attention(q4, k4, v4,
+                                     axis_name=seq_parallel_axis,
+                                     causal=causal, scale=scale,
+                                     bias=sp_bias)
         ctx3 = ctx4.reshape(b * heads, t, head_dim)
     elif use_flash and dropout == 0.0:
         bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)

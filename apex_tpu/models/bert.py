@@ -18,12 +18,14 @@ the token embedding matrix.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
 from ..nn import functional as F
 from ..normalization import FusedLayerNorm
 from ..contrib.multihead_attn import SelfMultiheadAttn
+from ..nn.modules import fold_shard_into_key as _fold_shard_into_key
 
 
 class BertLayer(nn.Module):
@@ -31,10 +33,14 @@ class BertLayer(nn.Module):
     + LN."""
 
     def __init__(self, hidden, heads, intermediate, dropout=0.1,
-                 attn_dropout=0.1):
+                 attn_dropout=0.1, sp_axis=None):
         super().__init__()
+        # encoder SP uses the Ulysses (all-to-all) impl: non-causal
+        # attention with a key-padding mask needs the gathered global
+        # sequence per device (the ring carries no mask operand)
         self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
-                                      impl="fast")
+                                      impl="fast", seq_parallel_axis=sp_axis,
+                                      seq_parallel_impl="ulysses")
         self.attn_ln = FusedLayerNorm(hidden)
         self.fc1 = nn.Linear(hidden, intermediate)
         self.fc2 = nn.Linear(intermediate, hidden)
@@ -60,13 +66,23 @@ class BertModel(nn.Module):
 
     def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
                  intermediate=3072, max_positions=512, type_vocab=2,
-                 dropout=0.1, attn_dropout=0.1, remat=False):
+                 dropout=0.1, attn_dropout=0.1, remat=False, sp_axis=None):
         super().__init__()
         self.hidden = hidden
         # remat: rematerialize each layer's activations in backward
         # (jax.checkpoint via nn.checkpoint_forward) — the long-sequence
         # HBM saver
         self.remat = remat
+        # sp_axis: Ulysses sequence parallelism — forward must run inside
+        # shard_map with input_ids sharded on dim 1 over this mesh axis
+        # and heads divisible by the axis size; the attention_mask stays
+        # GLOBAL (B, S_global) and replicated.  Position embeddings use
+        # global shard offsets; max_positions caps the GLOBAL length.
+        self.sp_axis = sp_axis
+        if sp_axis is not None and attn_dropout > 0.0:
+            raise ValueError(
+                "sp_axis requires attn_dropout=0.0 — the sequence-"
+                "parallel kernels have no attention dropout (like flash)")
         self.tok_emb = nn.Embedding(vocab_size, hidden)
         self.pos_emb = nn.Embedding(max_positions, hidden)
         self.type_emb = nn.Embedding(type_vocab, hidden)
@@ -78,13 +94,19 @@ class BertModel(nn.Module):
         self.emb_ln = FusedLayerNorm(hidden)
         self.emb_drop = nn.Dropout(dropout)
         self.layers = nn.ModuleList([
-            BertLayer(hidden, heads, intermediate, dropout, attn_dropout)
+            BertLayer(hidden, heads, intermediate, dropout, attn_dropout,
+                      sp_axis=sp_axis)
             for _ in range(layers)])
 
     def forward(self, ctx, input_ids, token_type_ids=None,
                 attention_mask=None):
         b, s = input_ids.shape
-        pos = jnp.arange(s, dtype=jnp.int32)[None, :]
+        if self.sp_axis is not None:
+            ctx = _fold_shard_into_key(ctx, self.sp_axis)
+            off = jax.lax.axis_index(self.sp_axis) * s
+            pos = (off + jnp.arange(s, dtype=jnp.int32))[None, :]
+        else:
+            pos = jnp.arange(s, dtype=jnp.int32)[None, :]
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         x = (self.tok_emb.forward(ctx, input_ids)

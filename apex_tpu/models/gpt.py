@@ -19,6 +19,7 @@ from .. import nn
 from ..nn import functional as F
 from ..normalization import FusedLayerNorm
 from ..contrib.multihead_attn import SelfMultiheadAttn
+from ..nn.modules import fold_shard_into_key as _fold_shard_into_key
 
 
 class GptBlock(nn.Module):
@@ -127,6 +128,7 @@ class GptModel(nn.Module):
     def forward(self, ctx, input_ids):
         b, s = input_ids.shape
         if self.sp_axis is not None:
+            ctx = _fold_shard_into_key(ctx, self.sp_axis)
             # s is the LOCAL shard; global position = shard offset + local
             n = jax.lax.axis_size(self.sp_axis)
             if s * n > self.max_positions:

@@ -7,4 +7,5 @@ from .modules import (  # noqa: F401
     GELU, GroupNorm, Identity, InstanceNorm1d, InstanceNorm2d,
     InstanceNorm3d, L1Loss, LayerNorm, LeakyReLU, Linear, MaxPool2d,
     Module, ModuleList, MSELoss, NLLLoss, ReLU, Sequential, Sigmoid,
-    Softmax, Tanh, _BatchNorm, checkpoint_forward, manual_seed)
+    Softmax, Tanh, _BatchNorm, checkpoint_forward, fold_shard_into_key,
+    manual_seed)

@@ -776,3 +776,20 @@ def checkpoint_forward(module, ctx, *inputs):
     out = jax.checkpoint(fn, static_argnums=())(ctx.key, inputs, *vals)
     ctx._key_idx = max(ctx._key_idx, consumed[0])
     return out
+
+
+def fold_shard_into_key(ctx, axis_name):
+    """A Ctx whose dropout key differs per shard of ``axis_name`` (fold in
+    the axis index) — sequence-sharded activations must draw independent
+    masks, not the replicated key's identical pattern on every shard.
+    Key-counter continuity is preserved; no-op when the ctx carries no
+    key.  Idempotent-enough: an outer fold (e.g. make_train_step's
+    axis_name fold) composes harmlessly."""
+    if ctx.key is None:
+        return ctx
+    inner = Ctx(env=ctx.env, stats_out=ctx.stats_out,
+                training=ctx.training,
+                key=jax.random.fold_in(ctx.key,
+                                       jax.lax.axis_index(axis_name)))
+    inner._key_idx = ctx._key_idx
+    return inner

@@ -532,3 +532,29 @@ def test_sp_training_bf16():
     for _ in range(12):
         state, l = sharded(state, ids, tgt)
     assert np.isfinite(float(l)) and float(l) < float(l0)
+
+
+def test_fold_shard_into_key_gives_per_shard_masks():
+    """Under shard_map with a replicated key, fold_shard_into_key makes
+    each shard draw a different dropout mask (identical masks would
+    repeat the drop pattern every S_local positions globally)."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.nn import functional as F
+    from apex_tpu.nn.modules import Ctx, fold_shard_into_key
+
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+
+    def f():
+        ctx = Ctx(training=True, key=jax.random.PRNGKey(0))
+        ctx = fold_shard_into_key(ctx, "sp")
+        return F.dropout(jnp.ones((16,)), 0.5, training=True,
+                         key=ctx.next_key())
+
+    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(),
+                                out_specs=P("sp"), check_vma=False))()
+    chunks = np.asarray(out).reshape(8, 16)
+    assert any((chunks[0] != chunks[i]).any() for i in range(1, 8))
+    # no-op without a key
+    ctx = Ctx(training=False)
+    assert fold_shard_into_key(ctx, "sp") is ctx
