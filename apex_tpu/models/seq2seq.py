@@ -131,16 +131,19 @@ def transformer_seq2seq(**kw):
 
 
 def seq2seq_generate(model: TransformerSeq2Seq, src_ids, max_new_tokens,
-                     bos_id=0, src_attention_mask=None):
-    """Greedy decoding: encode the source once, then extend the target
-    one token per step.  The decoder runs over a fixed-size padded target
-    buffer every step (causal attention makes positions > t inert), so
-    the whole loop is ONE compiled ``lax.scan`` — simple and
-    compile-once; a decoder KV cache (as in ``gpt.generate``) is the
-    next optimization if decode throughput ever matters here.
+                     bos_id=0, src_attention_mask=None, temperature=0.0,
+                     top_k=None, key=None):
+    """Decoding: encode the source once, then extend the target one token
+    per step.  The decoder runs over a fixed-size padded target buffer
+    every step (causal attention makes positions > t inert), so the whole
+    loop is ONE compiled ``lax.scan`` — simple and compile-once; a
+    decoder KV cache (as in ``gpt.generate``) is the next optimization if
+    decode throughput ever matters here.
 
-    ``src_ids (B, S_src)`` → ``(B, max_new_tokens)`` generated ids
-    (BOS not included).  Compiled programs are cached per model + shapes.
+    ``temperature=0`` (default) is greedy; ``top_k`` restricts sampling —
+    the same sampling surface as ``gpt.generate``.  ``src_ids (B, S_src)``
+    → ``(B, max_new_tokens)`` generated ids (BOS not included).  Compiled
+    programs are cached per model + shapes + sampling config.
     """
     import jax
 
@@ -151,12 +154,31 @@ def seq2seq_generate(model: TransformerSeq2Seq, src_ids, max_new_tokens,
         raise ValueError(
             f"max_new_tokens {max_new_tokens} exceeds max_positions "
             f"{model.max_positions} - 1")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    vocab = model.tok_emb.weight.shape[0]
+    if top_k is not None and not 1 <= top_k <= vocab:
+        raise ValueError(
+            f"top_k must be in [1, vocab={vocab}], got {top_k}")
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return logits.argmax(axis=-1)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(k, logits, axis=-1)
 
     params = [q for q in model.parameters()]
     buffers = list(model.buffers())
     vals = [q.data for q in params] + [bu.data for bu in buffers]
 
-    def run(vals, src_ids, mask):
+    def run(vals, src_ids, mask, key):
         env = {id(o): v for o, v in zip(params + buffers, vals)}
         ctx = Ctx(env=env, stats_out={}, training=False)
         kpm = None if mask is None else (mask == 0)
@@ -176,26 +198,28 @@ def seq2seq_generate(model: TransformerSeq2Seq, src_ids, max_new_tokens,
 
         buf0 = jnp.full((b, max_new_tokens + 1), bos_id, src_ids.dtype)
 
-        def step(buf, t):
+        def step(carry, t):
+            buf, k = carry
             logits = decode(buf)
             # causal decoder: position t's logits depend only on <= t
             row = jax.lax.dynamic_index_in_dim(logits, t, axis=1,
                                                keepdims=False)
-            tok_t = row.argmax(axis=-1).astype(buf.dtype)
+            k, sub = jax.random.split(k)
+            tok_t = sample(row, sub).astype(buf.dtype)
             buf = jax.lax.dynamic_update_slice(
                 buf, tok_t[:, None], (0, t + 1))
-            return buf, tok_t
+            return (buf, k), tok_t
 
-        buf, toks = jax.lax.scan(step, buf0,
-                                 jnp.arange(max_new_tokens))
+        (_, _), toks = jax.lax.scan(step, (buf0, key),
+                                    jnp.arange(max_new_tokens))
         return jnp.swapaxes(toks, 0, 1)
 
     cache = getattr(model, "_s2s_gen_cache", None)
     if cache is None:
         cache = model._s2s_gen_cache = {}
     cfg = (b, src_ids.shape[1], max_new_tokens, int(bos_id),
-           src_attention_mask is not None)
+           src_attention_mask is not None, float(temperature), top_k)
     jitted = cache.get(cfg)
     if jitted is None:
         jitted = cache[cfg] = jax.jit(run)
-    return jitted(vals, src_ids, src_attention_mask)
+    return jitted(vals, src_ids, src_attention_mask, key)

@@ -151,3 +151,31 @@ def test_greedy_generate_matches_manual_loop(rng):
     out_m = seq2seq_generate(m, src, n_new,
                              src_attention_mask=jnp.asarray(mask))
     assert out_m.shape == (2, n_new)
+
+
+def test_generate_sampling_surface(rng):
+    """Temperature/top-k sampling on seq2seq_generate: in-vocab tokens,
+    key-dependent variation, validated params."""
+    import jax
+    import pytest
+    from apex_tpu.models import seq2seq_generate
+
+    m = _tiny()
+    m.eval()
+    src = jnp.asarray(rng.integers(1, V, (2, 8)))
+    s1 = seq2seq_generate(m, src, 5, temperature=1.0,
+                          key=jax.random.PRNGKey(1))
+    s2 = seq2seq_generate(m, src, 5, temperature=1.0,
+                          key=jax.random.PRNGKey(2))
+    assert (np.asarray(s1) != np.asarray(s2)).any()
+    assert int(jnp.max(s1)) < V and int(jnp.min(s1)) >= 0
+    s3 = seq2seq_generate(m, src, 5, temperature=0.8, top_k=7,
+                          key=jax.random.PRNGKey(1))
+    assert s3.shape == (2, 5)
+    with pytest.raises(ValueError, match="temperature"):
+        seq2seq_generate(m, src, 2, temperature=-0.5)
+    with pytest.raises(ValueError, match="top_k"):
+        seq2seq_generate(m, src, 2, temperature=1.0, top_k=0,
+                         key=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="PRNG"):
+        seq2seq_generate(m, src, 2, temperature=0.5)
