@@ -69,6 +69,7 @@ class BertModel(nn.Module):
                  dropout=0.1, attn_dropout=0.1, remat=False, sp_axis=None):
         super().__init__()
         self.hidden = hidden
+        self.max_positions = max_positions
         # remat: rematerialize each layer's activations in backward
         # (jax.checkpoint via nn.checkpoint_forward) — the long-sequence
         # HBM saver
@@ -103,8 +104,20 @@ class BertModel(nn.Module):
         b, s = input_ids.shape
         if self.sp_axis is not None:
             ctx = _fold_shard_into_key(ctx, self.sp_axis)
+            # s is the LOCAL shard; guard the GLOBAL length — jax gather
+            # clamps out-of-range indices, so an oversized sequence would
+            # silently reuse the last position embedding (mirrors gpt.py)
+            n = jax.lax.axis_size(self.sp_axis)
+            if s * n > self.max_positions:
+                raise ValueError(
+                    f"global sequence length {s * n} exceeds "
+                    f"max_positions {self.max_positions}")
             off = jax.lax.axis_index(self.sp_axis) * s
             pos = (off + jnp.arange(s, dtype=jnp.int32))[None, :]
+        elif s > self.max_positions:
+            raise ValueError(
+                f"sequence length {s} exceeds max_positions "
+                f"{self.max_positions}")
         else:
             pos = jnp.arange(s, dtype=jnp.int32)[None, :]
         if token_type_ids is None:

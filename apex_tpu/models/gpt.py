@@ -60,6 +60,8 @@ class GptBlock(nn.Module):
         b = x.shape[0]
         h = self.ln1.forward(ctx, x)
         qkv = jnp.matmul(h, ctx.value(attn.in_proj_weight).T.astype(h.dtype))
+        if attn.bias:
+            qkv = qkv + ctx.value(attn.in_proj_bias).astype(qkv.dtype)
         qkv = qkv.reshape(b, heads, 3, d)
         q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         kcache = jax.lax.dynamic_update_slice(
@@ -79,6 +81,8 @@ class GptBlock(nn.Module):
                        vcache.astype(jnp.float32)).astype(x.dtype)
         o = o.reshape(b, heads * d)
         o = jnp.matmul(o, ctx.value(attn.out_proj_weight).T.astype(o.dtype))
+        if attn.bias:
+            o = o + ctx.value(attn.out_proj_bias).astype(o.dtype)
         x = x + o
         hh = F.gelu(self.fc1.forward(ctx, self.ln2.forward(ctx, x)))
         return x + self.fc2.forward(ctx, hh), kcache, vcache

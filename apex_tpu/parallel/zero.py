@@ -82,6 +82,12 @@ class ZeroTrainStep:
             raise ValueError(
                 "ZeroTrainStep needs a step built WITHOUT axis_name — "
                 "data parallelism is implicit in the global-view program")
+        if getattr(step, "_donate_state", False):
+            # a donating base step invoked directly alongside this wrapper
+            # would hand XLA buffers the wrapper still references
+            raise ValueError(
+                "ZeroTrainStep needs a step built with donate_state=False "
+                "— this wrapper owns donation")
         self._base = step
         self.mesh = mesh
         self.axis = axis

@@ -524,4 +524,5 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     # the un-jitted step for wrappers that jit with their own shardings /
     # donation (parallel/zero.py)
     ts._raw_step_fn = step_fn
+    ts._donate_state = donate_state and axis_name is None
     return ts

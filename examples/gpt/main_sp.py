@@ -87,8 +87,15 @@ def main():
     step = make_train_step(model, opt, lm_loss,
                            half_dtype=jnp.bfloat16, loss_scale=1.0,
                            axis_name="sp")
+    def global_loss_step(state, ids, tgt):
+        # each shard's loss covers its local sequence slice; pmean makes
+        # the printed number the global mean (grads are already
+        # psum-averaged inside the step, so this only fixes monitoring)
+        state, loss = step._step_fn(state, ids, tgt)
+        return state, jax.lax.pmean(loss, "sp")
+
     sharded = jax.jit(jax.shard_map(
-        step._step_fn, mesh=mesh,
+        global_loss_step, mesh=mesh,
         in_specs=(P(), P(None, "sp"), P(None, "sp")),
         out_specs=(P(), P()), check_vma=False))
 

@@ -36,6 +36,12 @@ def test_encoder_shapes(rng):
     assert out.dtype == jnp.float32
 
 
+def test_oversized_sequence_raises(rng):
+    m = _tiny_bert()
+    with pytest.raises(ValueError, match="max_positions"):
+        m(_ids(rng, s=65))  # max_positions=64
+
+
 def test_token_type_changes_output(rng):
     m = _tiny_bert()
     ids = _ids(rng)

@@ -427,6 +427,40 @@ def test_decode_with_kv_cache_matches_full_forward(rng):
     np.testing.assert_allclose(got, full, rtol=2e-4, atol=2e-4)
 
 
+def test_decode_applies_attention_biases(rng):
+    """A block carrying a biased (default-impl) attention must decode the
+    same logits as its training forward — decode applies in/out projection
+    biases when present instead of silently dropping them."""
+    import jax
+    from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+    from apex_tpu.nn.modules import Ctx
+
+    m = _tiny_gpt()
+    for blk in m.blocks:
+        attn = SelfMultiheadAttn(H, HEADS, dropout=0.0, bias=True,
+                                 impl="default", causal=True)
+        # nonzero biases so a dropped bias is a loud mismatch
+        attn.in_proj_bias.data = jnp.asarray(
+            rng.normal(size=(3 * H,)), jnp.float32) * 0.1
+        attn.out_proj_bias.data = jnp.asarray(
+            rng.normal(size=(H,)), jnp.float32) * 0.1
+        blk.attn = attn
+    m.eval()
+    ids = _ids(rng)
+    full = np.asarray(m(ids).value)
+
+    params = list(m.parameters())
+    ctx = Ctx(env={id(p): p.data for p in params}, training=False)
+    caches = m.init_caches(2, S)
+    got = []
+    for t in range(S):
+        logits, caches = m.decode_step(ctx, ids[:, t],
+                                       caches, jnp.asarray(t))
+        got.append(np.asarray(logits))
+    got = np.stack(got, axis=1)
+    np.testing.assert_allclose(got, full, rtol=2e-4, atol=2e-4)
+
+
 def test_generate_greedy_and_sampling(rng):
     """generate(): prompt is preserved, greedy decode is deterministic
     and matches step-by-step argmax; temperature sampling stays in-vocab

@@ -130,6 +130,14 @@ def test_zero_with_half_and_dynamic_scale(rng):
     assert mp and all(v.sharding.is_fully_replicated for v in mp)
 
 
+def test_zero_rejects_donating_step():
+    model, opt = _build()
+    step = make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=None, loss_scale=1.0)  # donates
+    with pytest.raises(ValueError, match="donate_state=False"):
+        ZeroTrainStep(step, Mesh(np.array(jax.devices()), ("data",)))
+
+
 def test_zero_rejects_axis_name_step():
     model, opt = _build()
     step = make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t),
