@@ -159,6 +159,28 @@ def resnet50_step_flops(batch):
     return 3 * 4.089e9 * batch
 
 
+def flash_attn_step_flops(attn_shapes):
+    """Model FLOPs of the attention-score matmuls for one fwd+bwd step.
+
+    XLA cost analysis cannot see inside Pallas custom calls, so when the
+    flash kernel carries the attention a step's reported FLOPs are missing
+    the QK^T and PV matmuls entirely — the reported MFU is a floor
+    (VERDICT round 2 missing #2).  This is the analytic complement, the
+    same counting as pyprof's `_attention_family` model
+    (pyprof/prof/models.py): per (layers, b, h, sq, sk, d, causal) entry,
+    fwd = 2 matmuls = 4·area·d FLOPs with area = b·h·sq·sk (halved for
+    causal), bwd = 2× fwd.  MFU convention counts MODEL FLOPs, so the
+    flash backward's in-kernel recompute is deliberately NOT counted.
+    Softmax (≈5·area) and the Pallas LayerNorm (O(b·s·e)) are noise at
+    these shapes and left out.
+    """
+    total = 0.0
+    for layers, b, h, sq, sk, d, causal in attn_shapes:
+        area = b * h * sq * sk * (0.5 if causal else 1.0)
+        total += layers * 12.0 * area * d
+    return total
+
+
 def _rel_err(a, b):
     import jax.numpy as jnp
     denom = float(jnp.max(jnp.abs(b))) + 1e-6
@@ -250,10 +272,112 @@ def run_kernel_checks():
     return results
 
 
-def time_compiled_step(step, batch_arrays, iters, warmup, analytic_flops):
+def run_kernel_timing(iters=30):
+    """A/B-time the Pallas kernels against their plain-XLA (jnp fallback)
+    lowerings on the attached backend: fwd+bwd step time per shape, with
+    the speedup the fused kernel delivers.  This is the TPU analogue of
+    the reference justifying its fused CUDA kernels by beating the unfused
+    path (apex/contrib/multihead_attn/README.md:6-14) — if a Pallas kernel
+    does not beat XLA's own fusion on a shape, that shows up here as
+    speedup < 1.  Only meaningful when mode == 'compiled' (real TPU);
+    elsewhere the jnp path runs in both arms and the numbers are noise.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from apex_tpu.ops import pallas as pal
+    from apex_tpu.contrib.multihead_attn.attn_funcs import flash_attention
+    from apex_tpu.normalization import fused_layer_norm_affine
+
+    on_tpu = jax.default_backend() == "tpu"
+    # off-TPU there is nothing honest to time: interpret mode is a Python
+    # emulation (1000x off), and a fallback-vs-fallback "A/B" is noise —
+    # return immediately rather than burn minutes on meaningless arms
+    if not on_tpu:
+        log("kernel timing skipped: no TPU backend")
+        return {"mode": "skipped (no TPU)",
+                "layer_norm": {}, "attention": {}}, None
+    mode = "compiled"
+    results = {"mode": mode, "layer_norm": {}, "attention": {}}
+    rng = np.random.default_rng(0)
+
+    def _sync(tree):
+        for leaf in jax.tree.leaves(tree):
+            float(jnp.sum(leaf).astype(jnp.float32))  # fetch = sync on axon
+
+    def _time(fn, args):
+        _sync(fn(*args))                 # compile + warm inside the mode ctx
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        _sync(out)
+        return (time.perf_counter() - t0) / iters
+
+    def _ab(build_fn, args, label, bucket):
+        row = {}
+        for arm, m in (("pallas", mode), ("xla", "off")):
+            with pal.force_mode(m):
+                try:
+                    row[f"{arm}_ms"] = round(_time(build_fn(), args) * 1e3, 4)
+                except Exception as e:
+                    row[f"{arm}_ms"] = None
+                    row[f"{arm}_error"] = f"{type(e).__name__}: {e}"
+        if row.get("pallas_ms") and row.get("xla_ms"):
+            row["speedup"] = round(row["xla_ms"] / row["pallas_ms"], 3)
+        results[bucket][label] = row
+        log(f"kernel timing {bucket} {label}: {row}")
+
+    # --- fused layer norm, training shapes (tokens x hidden), fwd+bwd ---
+    for (n, e), dtype in [((8192, 768), jnp.float32),
+                          ((16384, 1024), jnp.float32),
+                          ((16384, 1024), jnp.bfloat16)]:
+        x = jnp.asarray(rng.standard_normal((n, e)), dtype)
+        w = jnp.ones((e,), jnp.float32)
+        b = jnp.zeros((e,), jnp.float32)
+
+        def build(e=e):
+            def loss(x, w, b):
+                out = fused_layer_norm_affine(x, w, b, (e,))
+                return jnp.sum(out.astype(jnp.float32) ** 2)
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        _ab(build, (x, w, b), f"N{n}_E{e}_{jnp.dtype(dtype).name}",
+            "layer_norm")
+
+    # --- flash attention, VMEM-guard shapes, fwd+bwd ---
+    for b_, h, s, d, causal, dtype in [
+            (8, 12, 256, 64, True, jnp.bfloat16),
+            (4, 12, 1024, 64, True, jnp.bfloat16),
+            (1, 8, 2048, 128, True, jnp.bfloat16),
+            (4, 12, 1024, 64, False, jnp.bfloat16)]:
+        q = jnp.asarray(rng.standard_normal((b_, h, s, d)), dtype)
+        k = jnp.asarray(rng.standard_normal((b_, h, s, d)), dtype)
+        v = jnp.asarray(rng.standard_normal((b_, h, s, d)), dtype)
+
+        def build(causal=causal):
+            def loss(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, causal=causal)
+                    .astype(jnp.float32) ** 2)
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        _ab(build, (q, k, v),
+            f"B{b_}_H{h}_S{s}_D{d}{'_causal' if causal else ''}"
+            f"_{jnp.dtype(dtype).name}", "attention")
+
+    ups = [r["speedup"] for bkt in ("layer_norm", "attention")
+           for r in results[bkt].values() if r.get("speedup")]
+    gmean = float(np.exp(np.mean(np.log(ups)))) if ups else None
+    return results, gmean
+
+
+def time_compiled_step(step, batch_arrays, iters, warmup, analytic_flops,
+                       pallas_attn_flops=0.0):
     """Compile + time a fused train step: returns (dt, compile_s, flops,
     flops_source).  FLOPs come from XLA cost analysis with
-    ``analytic_flops()`` as the fallback."""
+    ``analytic_flops()`` as the fallback; ``pallas_attn_flops`` is the
+    analytic attention-matmul complement added on top of cost analysis
+    when the compiled program actually contains Pallas custom calls
+    (cost analysis reports 0 FLOPs for them, so without the complement
+    flash-attention configs understate MFU)."""
     import jax.numpy as jnp
 
     tc = time.perf_counter()
@@ -272,6 +396,20 @@ def time_compiled_step(step, batch_arrays, iters, warmup, analytic_flops):
         log(f"cost_analysis unavailable: {e}")
     if flops is None:
         flops, flops_source = analytic_flops(), "analytic"
+    elif pallas_attn_flops > 0:
+        # Whether flash actually carried the attention is a trace-time
+        # fact, and pallas_mode() is exactly the predicate the kernel
+        # dispatch used while this step was traced: 'compiled' on TPU
+        # (unless APEX_TPU_PALLAS=off forces the jnp path, where XLA
+        # already counts the attention matmuls).  The callers only pass
+        # pallas_attn_flops for configs whose attention takes the flash
+        # path when the kernel substrate is on (attn_dropout == 0).
+        from apex_tpu.ops import pallas as pal
+        if pal.pallas_mode() == "compiled":
+            flops += pallas_attn_flops
+            flops_source = "xla_cost_analysis+flash_analytic"
+            log(f"flash attention FLOP complement: "
+                f"+{pallas_attn_flops / 1e12:.3f} TFLOP/step")
 
     stage("warmup", f"{warmup} iters")
     state = step.state
@@ -307,7 +445,11 @@ def run_bert_throughput(batch, seq_len, iters, warmup):
     stage("model_build", f"bert_base batch={batch} seq={seq_len}")
     nn.manual_seed(0)
     vocab = 30522
-    model = bert_base(max_positions=seq_len)
+    # attn_dropout=0 so attention takes the Pallas flash path (the kernel
+    # has no dropout; bert_base's default 0.1 would silently fall back to
+    # the materializing jnp attention — and double-count FLOPs once the
+    # flash complement is added).  Residual/embedding dropout stays on.
+    model = bert_base(max_positions=seq_len, attn_dropout=0.0)
     opt = FusedLAMB(list(model.parameters()), lr=1e-3, weight_decay=0.01)
 
     def mlm_loss(logits, labels):
@@ -332,8 +474,11 @@ def run_bert_throughput(batch, seq_len, iters, warmup):
     stage("compile", f"bert batch={batch}")
     # 6 * params * tokens per fwd+bwd step (the standard transformer
     # estimate), params ~110M
-    return time_compiled_step(step, (ids, labels), iters, warmup,
-                              lambda: 6.0 * 110e6 * batch * seq_len)
+    return time_compiled_step(
+        step, (ids, labels), iters, warmup,
+        lambda: 6.0 * 110e6 * batch * seq_len,
+        pallas_attn_flops=flash_attn_step_flops(
+            [(12, batch, 12, seq_len, seq_len, 64, False)]))
 
 
 def run_seq2seq_throughput(batch, seq_len, iters, warmup):
@@ -368,9 +513,14 @@ def run_seq2seq_throughput(batch, seq_len, iters, warmup):
 
     stage("compile", f"seq2seq batch={batch}")
     # ~60M params transformer-base, 6 * params * (src+tgt) tokens
-    return time_compiled_step(step, ((src_ids, tgt_in), src_ids), iters,
-                              warmup,
-                              lambda: 6.0 * 60e6 * batch * 2 * seq_len)
+    return time_compiled_step(
+        step, ((src_ids, tgt_in), src_ids), iters, warmup,
+        lambda: 6.0 * 60e6 * batch * 2 * seq_len,
+        # 6 enc self (full) + 6 dec self (causal) + 6 cross (full), h=8 d=64
+        pallas_attn_flops=flash_attn_step_flops(
+            [(6, batch, 8, seq_len, seq_len, 64, False),
+             (6, batch, 8, seq_len, seq_len, 64, True),
+             (6, batch, 8, seq_len, seq_len, 64, False)]))
 
 
 def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False,
@@ -410,9 +560,13 @@ def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False,
     ids = jnp.asarray(rng.integers(0, vocab, (batch, seq_len)))
 
     stage("compile", f"gpt batch={batch}")
+    layers, heads = (24, 16) if size == "medium" else (12, 12)
     # 6 * params * tokens (fwd+bwd)
-    return time_compiled_step(step, (ids, ids), iters, warmup,
-                              lambda: 6.0 * n_params * batch * seq_len)
+    return time_compiled_step(
+        step, (ids, ids), iters, warmup,
+        lambda: 6.0 * n_params * batch * seq_len,
+        pallas_attn_flops=flash_attn_step_flops(
+            [(layers, batch, heads, seq_len, seq_len, 64, True)]))
 
 
 def run_decode_throughput(batch, seq_len, new_tokens=128):
@@ -485,6 +639,9 @@ def main():
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--kernels", action="store_true",
                     help="run only the Pallas kernel parity checks")
+    ap.add_argument("--kernels-timing", action="store_true",
+                    help="A/B-time Pallas kernels vs their plain-XLA "
+                         "fallbacks (meaningful on real TPU)")
     ap.add_argument("--bert", action="store_true",
                     help="run the BERT-base pretrain config (BASELINE.md 4) "
                          "instead of ResNet-50")
@@ -516,6 +673,18 @@ def main():
     except Exception as e:
         fail(f"backend_init_failed: {type(e).__name__}: {e}")
         return 1
+
+    if args.kernels_timing:
+        stage("kernel_timing")
+        try:
+            res, gmean = run_kernel_timing()
+        except Exception as e:
+            fail(f"kernel_timing_failed: {type(e).__name__}: {e}")
+            return 1
+        emit({"metric": "pallas_kernel_speedup_vs_xla",
+              "value": round(gmean, 3) if gmean else None,
+              "unit": "x_geomean", "vs_baseline": None, "kernels": res})
+        return 0
 
     if args.kernels:
         stage("kernel_checks")

@@ -31,4 +31,5 @@ run 16 --gpt --seq-len 1024
 run 8 --gpt --seq-len 2048 --remat
 run --gpt-decode
 run --seq2seq
+run --kernels-timing                  # Pallas vs XLA A/B per shape
 echo "done; results in $LOG" >&2

@@ -1,0 +1,41 @@
+"""bench.py analytic helpers: the flash-attention FLOP complement that
+keeps MFU honest when Pallas custom calls hide attention matmuls from XLA
+cost analysis (VERDICT round 2, missing #2)."""
+import bench
+
+
+def test_flash_attn_flops_closed_form():
+    # one layer, b=2, h=4, s=8, d=16, non-causal:
+    # area = 2*4*8*8 = 512; fwd+bwd = 12 * area * d
+    assert bench.flash_attn_step_flops([(1, 2, 4, 8, 8, 16, False)]) \
+        == 12.0 * 512 * 16
+
+
+def test_causal_halves_flops():
+    full = bench.flash_attn_step_flops([(3, 2, 4, 64, 64, 16, False)])
+    causal = bench.flash_attn_step_flops([(3, 2, 4, 64, 64, 16, True)])
+    assert causal == full / 2
+
+
+def test_flops_scale_quadratically_in_seq():
+    s1 = bench.flash_attn_step_flops([(1, 1, 1, 128, 128, 64, False)])
+    s2 = bench.flash_attn_step_flops([(1, 1, 1, 256, 256, 64, False)])
+    assert s2 == 4 * s1
+
+
+def test_multiple_entries_sum():
+    a = [(6, 4, 8, 128, 128, 64, False)]
+    b = [(6, 4, 8, 128, 128, 64, True)]
+    assert bench.flash_attn_step_flops(a + b) == \
+        bench.flash_attn_step_flops(a) + bench.flash_attn_step_flops(b)
+
+
+def test_gpt2_small_magnitude():
+    """The complement for GPT-2-small B=16 S=1024 (the BENCH_HISTORY
+    long-sequence config) is ~8% of the 6ND param FLOPs — the scale at
+    which the round-2 MFU floor was understated; at S=128 it is ~1%."""
+    attn = bench.flash_attn_step_flops([(12, 16, 12, 1024, 1024, 64, True)])
+    param = 6.0 * 124e6 * 16 * 1024
+    assert 0.05 < attn / param < 0.12
+    short = bench.flash_attn_step_flops([(12, 64, 12, 128, 128, 64, True)])
+    assert 0.005 < short / (6.0 * 124e6 * 64 * 128) < 0.02
