@@ -167,7 +167,8 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
                    input_weights, output_weights, input_biases=None,
                    output_biases=None, mask=None, dropout_prob=0.0,
                    key=None, use_flash=False, causal=False,
-                   seq_parallel_axis=None, seq_parallel_impl="ring"):
+                   seq_parallel_axis=None, seq_parallel_impl="ring",
+                   tensor_parallel_axis=None):
     """Reference signature parity (self_multihead_attn_func.py:6-10);
     ``use_flash`` selects the Pallas path (the fast_* extension analogue).
     ``causal`` applies the triangle in-kernel (no O(S^2) mask operand) —
@@ -178,12 +179,54 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
     per ``seq_parallel_impl``) while projections stay local.  The causal
     triangle is handled globally by the SP kernels; masks are supported
     under 'ulysses' only (pass them GLOBAL-shape and replicated), and
-    attention dropout not at all."""
+    attention dropout not at all.
+
+    ``tensor_parallel_axis``: Megatron-style head sharding over a mesh
+    axis.  The QKV projection is column-parallel — the interleaved weight
+    layout groups rows per head, so a contiguous row block IS a head
+    block — each device attends over ``heads / n_tp`` local heads, and the
+    output projection is row-parallel with the single psum of the
+    column→row pattern (parallel/tensor_parallel.py).  Weights stay FULL
+    (replicated); each device slices its block at trace time, which XLA
+    folds into the weight layout.  Composes with ``seq_parallel_axis``
+    (TP shards heads, SP shards time).  Attention dropout is unsupported
+    under TP (all devices share the PRNG key, so per-head-block masks
+    would be correlated; the model families require attn_dropout=0).
+    """
     t, b, e = inputs.shape
     head_dim = e // heads
-    lin = jnp.matmul(inputs, input_weights.T)
-    if input_biases is not None:
-        lin = lin + input_biases
+    iw, ow, ib = input_weights, output_weights, input_biases
+    if tensor_parallel_axis is not None:
+        if is_training and dropout_prob > 0.0:
+            raise NotImplementedError(
+                "attention dropout is not supported under tensor "
+                "parallelism (per-head-block masks would be drawn from "
+                "one shared key); set attn_dropout=0.0")
+        from ...parallel.tensor_parallel import (_shard_dim,
+                                                 copy_to_tp_region)
+        # Megatron's f operator: identity fwd, psum bwd — without it the
+        # gradient of everything upstream (embeddings, LNs, prior layers)
+        # is a per-device partial (each device backward only carries its
+        # own head block's contribution)
+        inputs = copy_to_tp_region(inputs, tensor_parallel_axis)
+        n_tp = jax.lax.psum(1, tensor_parallel_axis)
+        if heads % n_tp:
+            raise ValueError(
+                f"tensor parallelism: heads ({heads}) not divisible by "
+                f"the '{tensor_parallel_axis}' axis size ({n_tp})")
+        heads = heads // n_tp
+        # rows of in_proj group [q_h, k_h, v_h] per head (module
+        # docstring) — a contiguous 3*D*heads_local block is a head block
+        iw = _shard_dim(iw, tensor_parallel_axis, 0)
+        if ib is not None:
+            ib = _shard_dim(ib, tensor_parallel_axis, 0)
+        # out_proj contracts the heads-major context: column block i of
+        # the weight multiplies exactly head block i
+        ow = _shard_dim(ow, tensor_parallel_axis, 1)
+        e = heads * head_dim
+    lin = jnp.matmul(inputs, iw.T)
+    if ib is not None:
+        lin = lin + ib
     q3, k3, v3 = _split_interleaved_qkv(lin, t, b, heads, head_dim)
     dropout = dropout_prob if is_training else 0.0
     if seq_parallel_axis is not None:
@@ -235,7 +278,13 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
         ctx3 = _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout,
                                   key, use_time_mask_causal=causal)
     ctx = jnp.swapaxes(ctx3, 0, 1).reshape(t, b, e)
-    out = jnp.matmul(ctx, output_weights.T)
+    out = jnp.matmul(ctx, ow.T)
+    if tensor_parallel_axis is not None:
+        # the row-parallel reduction (Megatron g: psum fwd, identity
+        # bwd): one collective for the whole column→row attention pair;
+        # bias added once, after the reduction
+        from ...parallel.tensor_parallel import reduce_from_tp_region
+        out = reduce_from_tp_region(out, tensor_parallel_axis)
     if output_biases is not None:
         out = out + output_biases
     return out

@@ -44,7 +44,8 @@ class _AttnModule(Module):
 class SelfMultiheadAttn(_AttnModule):
     def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
                  include_norm_add=False, impl="fast", causal=False,
-                 seq_parallel_axis=None, seq_parallel_impl="ring"):
+                 seq_parallel_axis=None, seq_parallel_impl="ring",
+                 tensor_parallel_axis=None):
         super().__init__()
         self.embed_dim = embed_dim
         self.num_heads = num_heads
@@ -57,6 +58,10 @@ class SelfMultiheadAttn(_AttnModule):
         # rides the ring (or Ulysses all-to-all) across devices
         self.seq_parallel_axis = seq_parallel_axis
         self.seq_parallel_impl = seq_parallel_impl
+        # tensor parallelism: Megatron head sharding over this mesh axis;
+        # parameters stay FULL (replicated) and each device slices its
+        # head block at trace time (attn_funcs.self_attn_func)
+        self.tensor_parallel_axis = tensor_parallel_axis
         self.head_dim = embed_dim // num_heads
         assert self.head_dim * num_heads == embed_dim, \
             "embed_dim must be divisible by num_heads"
@@ -89,6 +94,19 @@ class SelfMultiheadAttn(_AttnModule):
                 jnp.ones((embed_dim,), jnp.float32))
             self.lyr_nrm_beta_weights = Parameter(
                 jnp.zeros((embed_dim,), jnp.float32))
+
+    def tp_sharded_params(self):
+        """This module's parameters whose per-device gradients are
+        block-sparse under tensor parallelism (the contract
+        make_train_step(tp_axis=...) assembles by psum): the head-sharded
+        QKV projection (rows) and the output projection (columns).  The
+        model-family blocks extend this with their sharded MLP entries —
+        keeping the attention subset HERE means a future layout change
+        cannot desynchronize the GPT and BERT families."""
+        ps = [self.in_proj_weight, self.out_proj_weight]
+        if self.in_proj_bias is not None:
+            ps.append(self.in_proj_bias)
+        return ps
 
     def forward(self, ctx, query, key=None, value=None,
                 key_padding_mask=None, need_weights=False, attn_mask=None,
@@ -124,7 +142,8 @@ class SelfMultiheadAttn(_AttnModule):
             mask, self.dropout, key=drop_key,
             use_flash=(self.impl == "fast"), causal=self.causal,
             seq_parallel_axis=self.seq_parallel_axis,
-            seq_parallel_impl=self.seq_parallel_impl)
+            seq_parallel_impl=self.seq_parallel_impl,
+            tensor_parallel_axis=self.tensor_parallel_axis)
 
         if self.include_norm_add:
             if is_training and self.dropout > 0.0:

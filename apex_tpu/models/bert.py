@@ -33,27 +33,45 @@ class BertLayer(nn.Module):
     + LN."""
 
     def __init__(self, hidden, heads, intermediate, dropout=0.1,
-                 attn_dropout=0.1, sp_axis=None):
+                 attn_dropout=0.1, sp_axis=None, tp_axis=None):
         super().__init__()
         # encoder SP uses the Ulysses (all-to-all) impl: non-causal
         # attention with a key-padding mask needs the gathered global
         # sequence per device (the ring carries no mask operand)
         self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
                                       impl="fast", seq_parallel_axis=sp_axis,
-                                      seq_parallel_impl="ulysses")
+                                      seq_parallel_impl="ulysses",
+                                      tensor_parallel_axis=tp_axis)
         self.attn_ln = FusedLayerNorm(hidden)
         self.fc1 = nn.Linear(hidden, intermediate)
         self.fc2 = nn.Linear(intermediate, hidden)
         self.out_ln = FusedLayerNorm(hidden)
         self.dropout = nn.Dropout(dropout)
+        self.tp_axis = tp_axis
 
     def forward(self, ctx, x, key_padding_mask=None):
         h, _ = self.attn.forward(ctx, x, key_padding_mask=key_padding_mask)
         x = self.attn_ln.forward(ctx, x + self.dropout.forward(ctx, h))
-        h = F.gelu(self.fc1.forward(ctx, x))
-        h = self.fc2.forward(ctx, h)
+        if self.tp_axis is not None:
+            # Megatron MLP: column → gelu → row, one psum per pair
+            from ..parallel.tensor_parallel import tp_ffn
+            h = tp_ffn(x, ctx.value(self.fc1.weight),
+                       ctx.value(self.fc1.bias),
+                       ctx.value(self.fc2.weight),
+                       ctx.value(self.fc2.bias),
+                       self.tp_axis, activation=F.gelu)
+        else:
+            h = F.gelu(self.fc1.forward(ctx, x))
+            h = self.fc2.forward(ctx, h)
         x = self.out_ln.forward(ctx, x + self.dropout.forward(ctx, h))
         return x
+
+    def tp_sharded_params(self):
+        """Parameters with TP-block-sparse gradients (models/gpt.py has
+        the full story); the train step psums these over ``tp_axis``.
+        The attention subset comes from the module itself."""
+        return self.attn.tp_sharded_params() + [
+            self.fc1.weight, self.fc1.bias, self.fc2.weight]
 
 
 class BertModel(nn.Module):
@@ -66,10 +84,19 @@ class BertModel(nn.Module):
 
     def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
                  intermediate=3072, max_positions=512, type_vocab=2,
-                 dropout=0.1, attn_dropout=0.1, remat=False, sp_axis=None):
+                 dropout=0.1, attn_dropout=0.1, remat=False, sp_axis=None,
+                 tp_axis=None):
         super().__init__()
         self.hidden = hidden
         self.max_positions = max_positions
+        # tp_axis: Megatron tensor parallelism (see models/gpt.py — same
+        # design: heads + MLP hidden shard, everything else replicated,
+        # full weights sliced at trace time); composes with sp_axis
+        self.tp_axis = tp_axis
+        if tp_axis is not None and attn_dropout > 0.0:
+            raise ValueError(
+                "tp_axis requires attn_dropout=0.0 — attention dropout "
+                "is unsupported under tensor parallelism")
         # remat: rematerialize each layer's activations in backward
         # (jax.checkpoint via nn.checkpoint_forward) — the long-sequence
         # HBM saver
@@ -96,8 +123,12 @@ class BertModel(nn.Module):
         self.emb_drop = nn.Dropout(dropout)
         self.layers = nn.ModuleList([
             BertLayer(hidden, heads, intermediate, dropout, attn_dropout,
-                      sp_axis=sp_axis)
+                      sp_axis=sp_axis, tp_axis=tp_axis)
             for _ in range(layers)])
+
+    def tp_sharded_params(self):
+        """All layers' TP-block-sparse parameters (see BertLayer)."""
+        return [p for ly in self.layers for p in ly.tp_sharded_params()]
 
     def forward(self, ctx, input_ids, token_type_ids=None,
                 attention_mask=None):
@@ -152,6 +183,11 @@ class BertForMaskedLM(nn.Module):
         self.transform_ln = FusedLayerNorm(hidden)
         vocab = self.bert.tok_emb.weight.shape[0]
         self.decoder_bias = nn.Parameter(jnp.zeros((vocab,), jnp.float32))
+
+    def tp_sharded_params(self):
+        """The encoder's TP-block-sparse parameters (the MLM head stays
+        replicated)."""
+        return self.bert.tp_sharded_params()
 
     def forward(self, ctx, input_ids, token_type_ids=None,
                 attention_mask=None):

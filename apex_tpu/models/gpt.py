@@ -27,7 +27,7 @@ class GptBlock(nn.Module):
     residual."""
 
     def __init__(self, hidden, heads, intermediate, dropout=0.1,
-                 attn_dropout=0.1, sp_axis=None):
+                 attn_dropout=0.1, sp_axis=None, tp_axis=None):
         super().__init__()
         self.ln1 = FusedLayerNorm(hidden)
         # causal=True: when the flash path applies (attn_dropout == 0 in
@@ -36,18 +36,40 @@ class GptBlock(nn.Module):
         # materializing fallback runs (the Pallas kernel has no dropout)
         self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
                                       impl="fast", causal=True,
-                                      seq_parallel_axis=sp_axis)
+                                      seq_parallel_axis=sp_axis,
+                                      tensor_parallel_axis=tp_axis)
         self.ln2 = FusedLayerNorm(hidden)
         self.fc1 = nn.Linear(hidden, intermediate)
         self.fc2 = nn.Linear(intermediate, hidden)
         self.dropout = nn.Dropout(dropout)
+        self.tp_axis = tp_axis
 
     def forward(self, ctx, x):
         h, _ = self.attn.forward(ctx, self.ln1.forward(ctx, x))
         x = x + self.dropout.forward(ctx, h)
-        h = F.gelu(self.fc1.forward(ctx, self.ln2.forward(ctx, x)))
-        h = self.fc2.forward(ctx, h)
+        if self.tp_axis is not None:
+            # Megatron MLP: fc1 column-parallel, gelu on the sharded
+            # hidden, fc2 row-parallel — one psum for the pair; weights
+            # stay full, the shard slice happens at trace time
+            from ..parallel.tensor_parallel import tp_ffn
+            h = tp_ffn(self.ln2.forward(ctx, x),
+                       ctx.value(self.fc1.weight), ctx.value(self.fc1.bias),
+                       ctx.value(self.fc2.weight), ctx.value(self.fc2.bias),
+                       self.tp_axis, activation=F.gelu)
+        else:
+            h = F.gelu(self.fc1.forward(ctx, self.ln2.forward(ctx, x)))
+            h = self.fc2.forward(ctx, h)
         return x + self.dropout.forward(ctx, h)
+
+    def tp_sharded_params(self):
+        """Parameters whose per-device gradients are block-sparse under
+        ``tp_axis`` (each device's slice sees only its block): their grads
+        must be psum'd over the TP axis to keep the replicated full
+        parameters consistent (training/step.py handles this when built
+        with ``tp_axis``).  The attention subset lives on the attention
+        module itself; this block adds its column/row MLP entries."""
+        return self.attn.tp_sharded_params() + [
+            self.fc1.weight, self.fc1.bias, self.fc2.weight]
 
     def decode(self, ctx, x, kcache, vcache, t):
         """One-token decode with a KV cache: ``x (B, E)`` at global
@@ -88,17 +110,136 @@ class GptBlock(nn.Module):
         return x + self.fc2.forward(ctx, hh), kcache, vcache
 
 
+class MoeGptBlock(nn.Module):
+    """Pre-LN decoder block with a Switch-MoE feed-forward: LN → causal
+    MHA → residual, LN → top-k routed expert FFN → residual.
+
+    One expert per device along ``moe_axis`` (which the model typically
+    shares with the data axis — experts then ride the same mesh dimension
+    the batch shards over, the canonical Switch/GShard layout).  Expert
+    weights are held STACKED and full-size ``(E, ...)`` on every device —
+    same philosophy as the TP families: checkpoints are mesh-independent,
+    each device slices its expert at trace time.  Their gradients are
+    exact under the train step's psum-MEAN over the axis: device ``i``'s
+    grad is nonzero only in its expert's slice and the global loss is the
+    mean of per-device means, so mean-of-blocks IS the true gradient —
+    no extra collectives needed (contrast parallel/tensor_parallel.py's
+    f/g pair).
+
+    The Switch load-balancing aux loss (weighted by ``aux_weight``) is
+    recorded via ``Ctx.add_aux_loss``; ``make_train_step`` folds it into
+    the optimized loss.  Tokens over capacity are dropped by the MoE —
+    the residual connection carries them through unchanged.
+    """
+
+    def __init__(self, hidden, heads, intermediate, num_experts,
+                 dropout=0.1, attn_dropout=0.1, sp_axis=None,
+                 moe_axis="data", capacity_factor=1.25, top_k=1,
+                 aux_weight=0.01):
+        super().__init__()
+        from ..nn.parameter import Parameter
+        self.ln1 = FusedLayerNorm(hidden)
+        self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
+                                      impl="fast", causal=True,
+                                      seq_parallel_axis=sp_axis)
+        self.ln2 = FusedLayerNorm(hidden)
+        self.dropout = nn.Dropout(dropout)
+        self.moe_axis = moe_axis
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.top_k = top_k
+        self.aux_weight = aux_weight
+        # router: (H, E), Switch init — small scale keeps early routing
+        # near-uniform so the aux loss can act before collapse
+        self.router = nn.Linear(hidden, num_experts, bias=False)
+        self.router.weight.data = self.router.weight.data * 0.1
+        # stacked per-expert FFN weights, nn.Linear layout (out, in) per
+        # expert; drawn through throwaway Linears so each expert gets the
+        # standard init distribution
+        w1, b1, w2, b2 = [], [], [], []
+        for _ in range(num_experts):
+            l1 = nn.Linear(hidden, intermediate)
+            l2 = nn.Linear(intermediate, hidden)
+            w1.append(l1.weight.data)
+            b1.append(l1.bias.data)
+            w2.append(l2.weight.data)
+            b2.append(l2.bias.data)
+        self.w1 = Parameter(jnp.stack(w1))    # (E, I, H)
+        self.b1 = Parameter(jnp.stack(b1))    # (E, I)
+        self.w2 = Parameter(jnp.stack(w2))    # (E, H, I)
+        self.b2 = Parameter(jnp.stack(b2))    # (E, H)
+
+    def forward(self, ctx, x):
+        from ..parallel.expert_parallel import switch_moe
+
+        h, _ = self.attn.forward(ctx, self.ln1.forward(ctx, x))
+        x = x + self.dropout.forward(ctx, h)
+        s, b, e = x.shape
+        toks = self.ln2.forward(ctx, x).reshape(s * b, e)
+        i = jax.lax.axis_index(self.moe_axis)
+        params = tuple(
+            jax.lax.dynamic_index_in_dim(ctx.value(p), i, 0,
+                                         keepdims=False)
+            for p in (self.w1, self.b1, self.w2, self.b2))
+
+        def expert_fn(params, xe):
+            w1l, b1l, w2l, b2l = params
+            hh = F.gelu(jnp.matmul(xe, w1l.T.astype(xe.dtype))
+                        + b1l.astype(xe.dtype))
+            return jnp.matmul(hh, w2l.T.astype(xe.dtype)) \
+                + b2l.astype(xe.dtype)
+
+        y, aux = switch_moe(toks, ctx.value(self.router.weight).T,
+                            params, expert_fn, self.moe_axis,
+                            capacity_factor=self.capacity_factor,
+                            top_k=self.top_k)
+        ctx.add_aux_loss(self.aux_weight * aux)
+        return x + self.dropout.forward(ctx, y.reshape(s, b, e))
+
+    def tp_sharded_params(self):
+        return []    # MoE blocks carry no TP-sharded params
+
+
 class GptModel(nn.Module):
     """Token+position embeddings → N pre-LN causal blocks → final LN →
     weight-tied LM head.  ``forward(input_ids[B,S]) -> logits (B,S,V)``."""
 
     def __init__(self, vocab_size=50257, hidden=768, layers=12, heads=12,
                  intermediate=None, max_positions=1024, dropout=0.1,
-                 attn_dropout=0.1, remat=False, sp_axis=None):
+                 attn_dropout=0.1, remat=False, sp_axis=None, tp_axis=None,
+                 moe_axis=None, moe_num_experts=None, moe_every=2,
+                 moe_capacity_factor=1.25, moe_top_k=1,
+                 moe_aux_weight=0.01):
         super().__init__()
         intermediate = intermediate or 4 * hidden
         self.hidden = hidden
         self.max_positions = max_positions
+        # moe_axis: Switch-MoE — every ``moe_every``-th block (Switch's
+        # every-other-layer default) swaps its dense FFN for a top-k
+        # routed expert FFN with one expert per device along this mesh
+        # axis (usually the data axis).  ``moe_num_experts`` must equal
+        # that axis's size at run time (validated by switch_moe).
+        self.moe_axis = moe_axis
+        if moe_axis is not None:
+            if moe_num_experts is None:
+                raise ValueError(
+                    "moe_axis requires moe_num_experts (= the mesh axis "
+                    "size: one expert per device)")
+            if tp_axis is not None:
+                raise ValueError(
+                    "moe_axis and tp_axis are mutually exclusive for now "
+                    "(the MoE FFN replaces the dense FFN that TP shards)")
+        # tp_axis: Megatron tensor parallelism — forward must run inside
+        # shard_map over a mesh with this axis; attention heads and the
+        # MLP hidden shard over it, embeddings/LNs/head stay replicated.
+        # Composes with sp_axis (TP shards heads, SP shards time) and
+        # with a data axis for 2-D/3-D meshes.  Requires attn_dropout=0
+        # (see attn_funcs.self_attn_func).
+        self.tp_axis = tp_axis
+        if tp_axis is not None and attn_dropout > 0.0:
+            raise ValueError(
+                "tp_axis requires attn_dropout=0.0 — attention dropout "
+                "is unsupported under tensor parallelism")
         # remat: rematerialize each block's activations in backward
         # (jax.checkpoint) — HBM drops from O(layers * S * E) residuals to
         # O(layers) block boundaries, the long-sequence enabler
@@ -123,11 +264,23 @@ class GptModel(nn.Module):
         for emb in (self.tok_emb, self.pos_emb):
             emb.weight.data = emb.weight.data * 0.02
         self.drop = nn.Dropout(dropout)
-        self.blocks = nn.ModuleList([
-            GptBlock(hidden, heads, intermediate, dropout, attn_dropout,
-                     sp_axis=sp_axis)
-            for _ in range(layers)])
+        def _block(idx):
+            if moe_axis is not None and idx % moe_every == moe_every - 1:
+                return MoeGptBlock(
+                    hidden, heads, intermediate, moe_num_experts,
+                    dropout, attn_dropout, sp_axis=sp_axis,
+                    moe_axis=moe_axis,
+                    capacity_factor=moe_capacity_factor,
+                    top_k=moe_top_k, aux_weight=moe_aux_weight)
+            return GptBlock(hidden, heads, intermediate, dropout,
+                            attn_dropout, sp_axis=sp_axis, tp_axis=tp_axis)
+
+        self.blocks = nn.ModuleList([_block(i) for i in range(layers)])
         self.ln_f = FusedLayerNorm(hidden)
+
+    def tp_sharded_params(self):
+        """All blocks' TP-block-sparse parameters (see GptBlock)."""
+        return [p for blk in self.blocks for p in blk.tp_sharded_params()]
 
     def forward(self, ctx, input_ids):
         b, s = input_ids.shape
@@ -176,10 +329,11 @@ class GptModel(nn.Module):
     def decode_step(self, ctx, tok, caches, t):
         """Logits for one token: ``tok (B,)`` ids at global position
         ``t`` (traced i32).  Returns ``(logits (B, V), new_caches)``."""
-        if self.sp_axis is not None:
+        if self.sp_axis is not None or self.tp_axis is not None \
+                or self.moe_axis is not None:
             raise NotImplementedError(
                 "decode_step is single-shard; build the model without "
-                "sp_axis for inference")
+                "sp_axis/tp_axis/moe_axis for inference")
         emb = ctx.value(self.tok_emb.weight)
         pos = ctx.value(self.pos_emb.weight)
         x = emb[tok] + jax.lax.dynamic_index_in_dim(pos, t, keepdims=False)

@@ -61,9 +61,13 @@ class Ctx:
 
     env maps id(Parameter/Buffer) -> substituted array (autodiff/jit);
     stats_out, when a dict, collects new buffer values instead of writing
-    them eagerly (pure mode); key supplies dropout randomness.
+    them eagerly (pure mode); key supplies dropout randomness; aux_losses
+    collects scalar auxiliary objectives modules add during forward (e.g.
+    the Switch-MoE load-balancing loss) — the fused train step sums them
+    into the optimized loss (training/step.py).
     """
-    __slots__ = ("env", "stats_out", "training", "key", "_key_idx")
+    __slots__ = ("env", "stats_out", "training", "key", "_key_idx",
+                 "aux_losses")
 
     def __init__(self, env=None, stats_out=None, training=False, key=None):
         self.env = env or {}
@@ -71,6 +75,13 @@ class Ctx:
         self.training = training
         self.key = key
         self._key_idx = 0
+        self.aux_losses = []
+
+    def add_aux_loss(self, value):
+        """Record a scalar auxiliary loss term (differentiable; gradients
+        flow to whatever produced it when the step adds it to the task
+        loss)."""
+        self.aux_losses.append(value)
 
     def value(self, p):
         v = self.env.get(id(p))
@@ -771,10 +782,15 @@ def checkpoint_forward(module, ctx, *inputs):
                 "(BatchNorm?) — stat updates cannot cross the remat "
                 "boundary; exclude such modules from checkpointing")
         consumed[0] = inner._key_idx
-        return out
+        # aux losses must cross the remat boundary as an explicit output
+        # (appending a traced value to the outer ctx's list would leak
+        # the tracer); summed here, re-added outside
+        aux = sum(inner.aux_losses) if inner.aux_losses else jnp.zeros(())
+        return out, aux
 
-    out = jax.checkpoint(fn, static_argnums=())(ctx.key, inputs, *vals)
+    out, aux = jax.checkpoint(fn, static_argnums=())(ctx.key, inputs, *vals)
     ctx._key_idx = max(ctx._key_idx, consumed[0])
+    ctx.add_aux_loss(aux)
     return out
 
 
@@ -792,4 +808,5 @@ def fold_shard_into_key(ctx, axis_name):
                 key=jax.random.fold_in(ctx.key,
                                        jax.lax.axis_index(axis_name)))
     inner._key_idx = ctx._key_idx
+    inner.aux_losses = ctx.aux_losses   # shared list: aux terms propagate
     return inner

@@ -1,22 +1,33 @@
-"""Expert parallelism over a mesh axis — Switch-style top-1 MoE.
+"""Expert parallelism over a mesh axis — Switch/GShard-style top-k MoE.
 
 The reference has no MoE/expert parallelism (SURVEY.md §2.3); the
 TPU-native formulation is the canonical one: one expert per device along
 the ``ep`` axis, tokens exchanged with their expert's owner by a pair of
 ``lax.all_to_all``s around the expert computation.
 
-Routing math (Switch Transformer):
+Routing math:
 
-* top-1 expert per token from a replicated router, gate = that expert's
-  softmax probability;
+* top-1 (Switch Transformer, arXiv:2101.03961) or top-2 (GShard,
+  arXiv:2006.16668) experts per token from a replicated router; gates are
+  the selected experts' softmax probabilities, normalized over the
+  selection for top-2;
 * per (source device, expert) capacity ``C = ceil(T_local/E *
   capacity_factor)``; tokens beyond capacity are DROPPED (contribute
   zero output — the standard Switch overflow behavior, callers keep the
-  residual path);
-* dispatch/combine are einsums against a (T, E, C) one-hot, so the whole
+  residual path).  For top-2 the capacity is counted jointly: first
+  choices claim slots before second choices (GShard's ordering);
+* dispatch/combine are einsums against a (T, E, C) tensor, so the whole
   layer is differentiable — gradients flow through the gate (router
   learns) and through the expert weights; the all_to_alls transpose to
-  themselves.
+  themselves (exact per-device gradients, no conjugate operators
+  needed — unlike the TP psum pair, parallel/tensor_parallel.py);
+* the load-balancing auxiliary loss (Switch eq. 4): ``aux = E * Σ_e
+  f_e · P_e`` with ``f_e`` the fraction of tokens whose FIRST choice is
+  expert ``e`` and ``P_e`` the mean router probability, both averaged
+  over the axis (global batch).  Minimized at uniform routing (aux = 1);
+  without it a learned top-1 router collapses onto one expert.  Callers
+  add ``aux_weight * aux`` to their loss — the model families route it
+  through ``Ctx.add_aux_loss`` (models/gpt.py MoE blocks).
 
 ``expert_fn(params, x)`` runs THIS device's expert on ``(n*C, d)`` — its
 own expert's bucket gathered from every source device.
@@ -31,12 +42,17 @@ from jax import lax
 
 
 def switch_moe(x, router_w, expert_params, expert_fn, axis_name,
-               capacity_factor=1.25):
+               capacity_factor=1.25, top_k=1):
     """x (T_local, d); router_w (d, E) replicated; expert_params — this
     device's expert (any pytree).  E must equal the axis size (one expert
-    per device).  Returns (T_local, d): gated expert outputs, zeros for
-    dropped tokens.
+    per device).  ``top_k`` in (1, 2): experts consulted per token.
+
+    Returns ``(y, aux)``: ``y (T_local, d)`` gated expert outputs (zeros
+    for dropped tokens) and ``aux`` — the scalar load-balancing loss,
+    replicated over the axis.
     """
+    if top_k not in (1, 2):
+        raise ValueError(f"switch_moe: top_k must be 1 or 2, got {top_k}")
     n = lax.psum(1, axis_name)              # static: devices == experts
     t_loc, d = x.shape
     logits = x @ router_w                   # (T, E)
@@ -46,20 +62,44 @@ def switch_moe(x, router_w, expert_params, expert_fn, axis_name,
             f"switch_moe: router has {e} experts but the '{axis_name}' "
             f"axis has {n} devices; expert parallelism is one expert per "
             f"device")
+    if top_k > e:
+        raise ValueError(
+            f"switch_moe: top_k={top_k} exceeds the expert count {e}")
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)             # (T,)
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
 
     cap = max(1, math.ceil(t_loc / e * capacity_factor))
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)      # (T, E)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1                # (T, E)
-    pos_t = jnp.max(pos, axis=-1)                        # position, (T,)
-    keep = pos_t < cap
-    # (T, E, C) dispatch one-hot; dropped tokens are all-zero rows
-    disp = (onehot.astype(jnp.float32)[:, :, None]
-            * jax.nn.one_hot(jnp.clip(pos_t, 0, cap - 1), cap,
-                             dtype=jnp.float32)[:, None, :]
-            * keep[:, None, None].astype(jnp.float32))
+
+    # k-th choice per token, k = 0..top_k-1 (argsort of -probs)
+    top_idx = jnp.argsort(-probs, axis=-1)[:, :top_k]      # (T, K)
+    top_gate = jnp.take_along_axis(probs, top_idx, axis=-1)  # (T, K)
+    if top_k == 2:
+        # GShard gate normalization over the selected pair
+        top_gate = top_gate / jnp.maximum(
+            jnp.sum(top_gate, axis=-1, keepdims=True), 1e-9)
+
+    # joint capacity counting, first choices before second (GShard):
+    # running per-expert occupancy carries across the k sweep.  Only the
+    # gate-weighted combine tensor is accumulated; the 0/1 dispatch mask
+    # derives from it below (gates are strictly positive), halving the
+    # (T, E, C) routing memory held for backward
+    counts = jnp.zeros((e,), jnp.int32)
+    comb = jnp.zeros((t_loc, e, cap), jnp.float32)
+    for k in range(top_k):
+        oh = jax.nn.one_hot(top_idx[:, k], e, dtype=jnp.int32)   # (T, E)
+        pos = (jnp.cumsum(oh, axis=0) - oh) + counts[None, :]    # (T, E)
+        pos_t = jnp.sum(pos * oh, axis=-1)                       # (T,)
+        keep = pos_t < cap
+        d_k = (oh.astype(jnp.float32)[:, :, None]
+               * jax.nn.one_hot(jnp.clip(pos_t, 0, cap - 1), cap,
+                                dtype=jnp.float32)[:, None, :]
+               * keep[:, None, None].astype(jnp.float32))
+        comb = comb + d_k * top_gate[:, k, None, None]
+        counts = counts + jnp.sum(oh * keep[:, None].astype(jnp.int32),
+                                  axis=0)
+    # softmax probs are > 0, so comb > 0 exactly where a token occupies a
+    # slot; stop_gradient pins the dispatch mask as routing data (the old
+    # one-hot was equally gradient-free)
+    disp = jax.lax.stop_gradient((comb > 0).astype(jnp.float32))
 
     buckets = jnp.einsum("tec,td->ecd", disp, x.astype(jnp.float32))
     # ship bucket e to device e; receive my expert's bucket from every
@@ -73,5 +113,15 @@ def switch_moe(x, router_w, expert_params, expert_fn, axis_name,
     # expert e, aligned with disp's expert axis
     back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                           tiled=True)
-    y = jnp.einsum("tec,ecd->td", disp, back)
-    return (y * gate[:, None].astype(jnp.float32)).astype(x.dtype)
+    y = jnp.einsum("tec,ecd->td", comb, back).astype(x.dtype)
+
+    # load-balancing aux (Switch eq. 4), over the GLOBAL batch: f_e from
+    # first choices (pre-drop — the assignment the router asked for),
+    # P_e the mean router probability; pmean makes both global and the
+    # scalar replicated
+    f_e = lax.pmean(jnp.mean(
+        jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32), axis=0),
+        axis_name)
+    p_e = lax.pmean(jnp.mean(probs, axis=0), axis_name)
+    aux = e * jnp.sum(f_e * p_e)
+    return y, aux

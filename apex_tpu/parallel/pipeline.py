@@ -28,16 +28,35 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def pipeline_apply(stage_fn, stage_params, xs, axis_name):
+def pipeline_apply(stage_fn, stage_params, xs, axis_name,
+                   remat_stage=False):
     """Run ``n_micro`` microbatches through an ``n_stage`` pipeline.
 
     ``stage_fn(params, x) -> y`` — one stage's computation; activations
-    must keep one shape across stages.  ``stage_params`` — this device's
-    stage parameters (any pytree).  ``xs`` — ``(n_micro, micro, ...)``,
-    same value on every pp device.  Returns ``(n_micro, micro, ...)``:
-    stage ``n-1``'s output per microbatch, replicated along the axis.
+    must keep ONE shape and dtype across stages (the SPMD formulation —
+    every device runs the same program on its own parameter shard; pad
+    narrower stages up if widths differ).  A ``stage_fn`` that changes
+    the activation shape fails loudly at trace time.  ``stage_params`` —
+    this device's stage parameters (any pytree).  ``xs`` —
+    ``(n_micro, micro, ...)``, same value on every pp device.  Returns
+    ``(n_micro, micro, ...)``: stage ``n-1``'s output per microbatch,
+    replicated along the axis by a closing psum (costs one collective of
+    the full output).
 
-    Call inside ``shard_map``/``pjit`` with ``axis_name`` bound.
+    ``remat_stage=True`` wraps each tick's stage in ``jax.checkpoint``:
+    backward recomputes the stage instead of saving its internals — peak
+    activation memory drops from O(ticks · stage_internals) to
+    O(ticks · activation) + one stage's internals, the GPipe recipe.
+
+    Under ``jax.grad`` the microbatch axis IS the gradient-accumulation
+    unit: each microbatch's backward contribution accumulates through the
+    scan transpose, so a mean-reduction loss over all microbatches
+    reproduces the full-batch gradients exactly
+    (tests/test_pipeline.py::test_pipelined_stack_step_matches_dense_oracle).
+
+    Call inside ``shard_map``/``pjit`` with ``axis_name`` bound.  Bubble
+    cost: (n_stages - 1) edge ticks compute garbage that the collection
+    window masks out, exactly GPipe's price.
     """
     n = lax.psum(1, axis_name)              # static stage count
     idx = lax.axis_index(axis_name)
@@ -48,13 +67,21 @@ def pipeline_apply(stage_fn, stage_params, xs, axis_name):
     state0 = jnp.zeros_like(xs[0])          # resident activation
     out0 = jnp.zeros_like(xs)               # collected last-stage outputs
 
+    run_stage = jax.checkpoint(stage_fn) if remat_stage else stage_fn
+
     def tick(carry, t):
         state, outs = carry
         # stage 0 ingests microbatch t while t < n_micro (garbage after;
         # masked below by the collection window)
         feed = xs[jnp.minimum(t, n_micro - 1)]
         x_in = jnp.where(idx == 0, feed, state)
-        y = stage_fn(stage_params, x_in)
+        y = run_stage(stage_params, x_in)
+        if y.shape != x_in.shape or y.dtype != x_in.dtype:
+            raise ValueError(
+                f"pipeline_apply: stage_fn changed the activation from "
+                f"{x_in.shape}/{x_in.dtype} to {y.shape}/{y.dtype} — "
+                f"pipeline stages must share one activation "
+                f"shape/dtype (pad narrower stages)")
         # the last stage emits microbatch (t - n + 1) at tick t
         m = t - (n - 1)
         emit = jnp.logical_and(idx == n - 1,
@@ -74,3 +101,83 @@ def pipeline_apply(stage_fn, stage_params, xs, axis_name):
     # (psum of one-hot contribution — every other stage holds zeros)
     return lax.psum(jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)),
                     axis_name)
+
+
+class PipelinedStack:
+    """An ``nn.Module`` pipelining N structurally-identical stages over a
+    mesh axis, integrated with the fused train step.
+
+    Holds the stage parameters STACKED ``(n_stages, ...)`` full-size and
+    replicated (the same philosophy as TP/MoE: checkpoints are
+    mesh-independent); each device slices its stage at trace time.
+    ``forward`` reshapes the batch into ``n_micro`` microbatches and runs
+    the GPipe schedule — the microbatch axis is the gradient-accumulation
+    unit, so a mean-reduction loss reproduces full-batch gradients.
+
+    Per-device stage gradients are nonzero only in the device's own stage
+    slice (disjoint blocks), so the stack exposes them via
+    ``tp_sharded_params()`` — build the step with ``tp_axis=<pp axis>``
+    and the psum assembly keeps the replicated stacks consistent, exactly
+    as for tensor parallelism::
+
+        stack = PipelinedStack(stage_fn, stacked_params, "pp", n_micro=4)
+        step = make_train_step(stack, opt, loss_fn, tp_axis="pp")
+        # run step._step_fn under shard_map over a ("pp",) mesh with the
+        # batch replicated (P()) — or a ("data", "pp") mesh with the
+        # batch sharded over "data" and axis_name="data"
+    """
+
+    def __init__(self, stage_fn, stacked_params, axis_name, n_micro,
+                 remat_stage=False):
+        from ..nn.parameter import Parameter
+
+        self.stage_fn = stage_fn
+        self.axis_name = axis_name
+        self.n_micro = n_micro
+        self.remat_stage = remat_stage
+        leaves, self._treedef = jax.tree.flatten(stacked_params)
+        self._params = [Parameter(jnp.asarray(a)) for a in leaves]
+        self.training = True
+
+    def parameters(self):
+        return list(self._params)
+
+    def buffers(self):
+        return []
+
+    def modules(self):
+        return []
+
+    def named_parameters(self):
+        return [(f"stage_stack.{i}", p)
+                for i, p in enumerate(self._params)]
+
+    def tp_sharded_params(self):
+        """Every stacked stage parameter: per-device grads live only in
+        the device's stage slice, assembled by the step's tp psum."""
+        return list(self._params)
+
+    def train(self):
+        self.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def forward(self, ctx, x):
+        vals = [ctx.value(p) for p in self._params]
+        stacked = jax.tree.unflatten(self._treedef, vals)
+        i = lax.axis_index(self.axis_name)
+        local = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            stacked)
+        b = x.shape[0]
+        if b % self.n_micro:
+            raise ValueError(
+                f"PipelinedStack: batch {b} does not divide into "
+                f"n_micro={self.n_micro} microbatches")
+        xs = x.reshape((self.n_micro, b // self.n_micro) + x.shape[1:])
+        ys = pipeline_apply(self.stage_fn, local, xs, self.axis_name,
+                            remat_stage=self.remat_stage)
+        return ys.reshape((b,) + ys.shape[2:])

@@ -18,9 +18,19 @@ resident per device:
 
 Chained column→row (the transformer MLP/attention pattern) needs exactly
 one collective per pair: the column layer's sharded output feeds the row
-layer's sharded input directly, and only the row layer reduces.  Gradients
-need no extra hand-written collectives — ``psum``/``all_gather`` are
-differentiable and the transpose collectives are inserted by JAX.
+layer's sharded input directly, and only the row layer reduces.
+
+Gradient convention: differentiation happens INSIDE shard_map (per-device
+AD — how the fused train step computes grads, training/step.py), with the
+Megatron conjugate pair pinning the collective transposes explicitly:
+``copy_to_tp_region`` (identity fwd / psum bwd) enters a region,
+``reduce_from_tp_region`` (psum fwd / identity bwd) exits it.  Sharded
+parameters then carry disjoint per-device gradient blocks (psum
+assembles the full gradient — ``make_train_step(tp_axis=...)`` does
+this), and replicated parameters carry full identical gradients.
+Differentiating *through* an outer ``shard_map`` instead relies on
+JAX's default collective-transpose chain and is not supported for these
+ops.
 
 Module forms (``ColumnParallelLinear`` / ``RowParallelLinear``) hold the
 LOCAL shard as their parameter, constructed from a deterministic full-size
@@ -37,6 +47,62 @@ from .. import nn
 from ..nn.parameter import Parameter
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp_region(x, axis_name):
+    """Megatron's ``f`` operator: identity forward, psum backward.
+
+    A replicated activation entering a column-parallel region is consumed
+    by a different weight shard on each device, so each device's backward
+    computes only its own shard's contribution to ``d loss / d x``.  The
+    psum on the backward pass assembles the full input gradient — without
+    it every parameter UPSTREAM of the region (embeddings, LayerNorms,
+    previous layers) silently gets a per-device partial gradient.  The
+    conjugate ``g`` operator (psum forward, identity backward) is the
+    row-parallel layer's reduction, which psum's own VJP already
+    provides."""
+    return x
+
+
+def _copy_to_tp_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_to_tp_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+copy_to_tp_region.defvjp(_copy_to_tp_fwd, _copy_to_tp_bwd)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp_region(x, axis_name):
+    """Megatron's ``g`` operator: psum forward, IDENTITY backward.
+
+    The backward must be pinned explicitly: under shard_map the default
+    transpose of ``psum`` applied to an already-replicated cotangent is
+    another psum — an ×n_shards overcount per region traversed (verified
+    against the unsharded oracle in tests/test_tp_models.py).  With ``f``
+    (identity fwd / psum bwd) at region entry and this ``g`` at region
+    exit, gradients of replicated parameters come out exactly full and
+    identical on every device, and sharded parameters' gradients stay
+    disjoint blocks."""
+    return lax.psum(x, axis_name)
+
+
+def _reduce_from_tp_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_from_tp_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tp_region.defvjp(_reduce_from_tp_fwd, _reduce_from_tp_bwd)
+
+
 def column_parallel_linear(x, weight_shard, bias_shard=None,
                            axis_name=None, gather_output=False):
     """x (..., in); weight_shard (out/n, in); bias_shard (out/n,).
@@ -51,8 +117,12 @@ def column_parallel_linear(x, weight_shard, bias_shard=None,
 
 def row_parallel_linear(x_shard, weight_shard, bias=None, axis_name=None):
     """x_shard (..., in/n); weight_shard (out, in/n); bias (out,), added
-    once after the psum.  Returns the full (..., out), replicated."""
-    y = lax.psum(jnp.matmul(x_shard, weight_shard.T), axis_name)
+    once after the reduction.  Returns the full (..., out), replicated.
+    The reduction is the ``g`` operator (psum fwd, identity bwd) so the
+    replicated cotangent passes through unscaled — see
+    ``reduce_from_tp_region``."""
+    y = reduce_from_tp_region(jnp.matmul(x_shard, weight_shard.T),
+                              axis_name)
     if bias is not None:
         y = y + bias
     return y
@@ -77,6 +147,23 @@ def _shard_rows(full, axis_name):
 
 def _shard_cols(full, axis_name):
     return _shard_dim(full, axis_name, 1)
+
+
+def tp_ffn(x, w1, b1, w2, b2, axis_name, activation=None):
+    """Column→row feed-forward over FULL (replicated) weights: each device
+    slices its shard at trace time (XLA folds the static slice into the
+    weight layout), applies ``activation`` on the feature-sharded hidden,
+    and the row layer's psum is the pair's single collective.  This is the
+    building block the model families (models/gpt.py, models/bert.py) use
+    for their ``tp_axis`` MLPs — weights stay full-size so checkpoints
+    and init are shard-count-independent."""
+    x = copy_to_tp_region(x, axis_name)
+    h = column_parallel_linear(
+        x, _shard_rows(w1, axis_name),
+        None if b1 is None else _shard_rows(b1, axis_name))
+    if activation is not None:
+        h = activation(h)
+    return row_parallel_linear(h, _shard_cols(w2, axis_name), b2, axis_name)
 
 
 class ColumnParallelLinear(nn.Module):

@@ -342,6 +342,7 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     max_loss_scale: float = 2.0 ** 24,
                     loss_scale: float | str = "dynamic",
                     axis_name: Optional[str] = None,
+                    tp_axis: Optional[str] = None,
                     gradient_predivide_factor: float = 1.0,
                     allreduce_always_fp32: bool = False,
                     donate_state: bool = True,
@@ -377,6 +378,19 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     splits the averaging before/after the all-reduce,
     apex/parallel/distributed.py:445-454; ``allreduce_always_fp32`` casts
     grads to fp32 for the collective, :417-421).
+
+    ``tp_axis``: the model was built with Megatron tensor parallelism over
+    this mesh axis (``tp_axis=`` on the GPT/BERT families).  Each TP
+    device's gradient for a sharded parameter is block-sparse — only its
+    own head/feature block is nonzero — so those gradients are psum'd
+    (NOT averaged: the blocks are disjoint, the psum assembles the full
+    gradient) over the axis, keeping the replicated full parameters and
+    optimizer state consistent across TP devices.  The model must expose
+    ``tp_sharded_params()``; all other gradients are already identical
+    across the axis (the row-parallel psums replicate every activation
+    the replicated parameters touch) and are left alone.  Composes with
+    ``axis_name`` for DP×TP meshes — batch sharded over ``axis_name``,
+    replicated over ``tp_axis``.
     """
     params = [p for p in model.parameters() if p is not None]
     buffers = [b for b in model.buffers()]
@@ -392,6 +406,17 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, "
                          f"got {grad_accum_steps}")
+
+    tp_ids = frozenset()
+    if tp_axis is not None:
+        getter = getattr(model, "tp_sharded_params", None)
+        if getter is None:
+            raise ValueError(
+                "tp_axis given but the model has no tp_sharded_params() — "
+                "build the model with its tp_axis= option (models/gpt.py, "
+                "models/bert.py) so the step knows which gradients are "
+                "block-sparse")
+        tp_ids = frozenset(id(p) for p in getter())
 
     def step_fn(state: StepState, *batch):
         model_vals = model_vals_of(state)
@@ -422,6 +447,11 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 x = _cast_tree(x, jnp.dtype(half_dtype))
             out = model.forward(ctx, x)
             loss = loss_fn(out, *b[1:])
+            # auxiliary objectives modules recorded during forward (e.g.
+            # the Switch-MoE load-balancing loss, models/gpt.py): part of
+            # the optimized (and reported) loss, scaled with it
+            if ctx.aux_losses:
+                loss = loss + sum(ctx.aux_losses)
             new_stats = [stats_out.get(id(bf), sv)
                          for bf, sv in zip(buffers, stats_in)]
             return loss.astype(jnp.float32) * state.scaler.loss_scale, \
@@ -503,6 +533,13 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 return gc.astype(g.dtype) if allreduce_always_fp32 else gc
             grads = [exchange(g) for g in grads]
 
+        # TP gradient assembly: sharded params' grads are block-sparse per
+        # device (disjoint blocks), psum = the full gradient; everything
+        # else is already replicated across the axis
+        if tp_axis is not None:
+            grads = [jax.lax.psum(g, tp_axis) if id(p) in tp_ids else g
+                     for p, g in zip(params, grads)]
+
         new_state = apply_fused_update(
             state._replace(stats=new_stats), grads, opt_update, model_dtypes,
             dynamic=dynamic, init_scale=init_scale,
@@ -513,7 +550,7 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     init_state = init_step_state(params, buffers, model_dtypes, opt_init,
                                  init_scale)
 
-    if axis_name is None:
+    if axis_name is None and tp_axis is None:
         jit_step = jax.jit(step_fn,
                            donate_argnums=(0,) if donate_state else ())
     else:
@@ -524,5 +561,5 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     # the un-jitted step for wrappers that jit with their own shardings /
     # donation (parallel/zero.py)
     ts._raw_step_fn = step_fn
-    ts._donate_state = donate_state and axis_name is None
+    ts._donate_state = donate_state and axis_name is None and tp_axis is None
     return ts

@@ -92,3 +92,22 @@ def test_gpt_sp_example_runs():
     # fresh random tokens each step: loss hovers near ln(vocab); just
     # prove the ring step runs and stays numerically sane
     assert math.isfinite(final) and final < math.log(97) + 1.0
+
+
+def test_gpt_tp_example_runs():
+    """The data x tensor parallel example: (2, 4) mesh on the virtual CPU
+    backend, Megatron head/MLP sharding, loss finite and sane."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)   # the script pins its own virtual mesh
+    script = os.path.join(REPO, "examples", "gpt", "main_tp.py")
+    out = subprocess.run(
+        [sys.executable, script, "--dp", "2", "--tp", "4", "--steps", "12",
+         "--seq-len", "32", "--layers", "2", "--hidden", "64", "--heads",
+         "4", "--vocab", "97", "--batch", "4", "--lr", "1e-2",
+         "--print-freq", "5"],
+        capture_output=True, text=True, timeout=500, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "mesh 2x4 (data x tp)" in out.stdout
+    final = float(out.stdout.rsplit("final loss:", 1)[1].strip())
+    import math
+    assert math.isfinite(final) and final < math.log(97) + 1.0

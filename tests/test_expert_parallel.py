@@ -63,8 +63,9 @@ def test_switch_moe_matches_dense_oracle(rng, n, capacity_factor):
     x = jnp.asarray(rng.standard_normal((n * TLOC, D)), jnp.float32)
 
     def f(x, router, w1, w2):
-        return switch_moe(x, router, (w1, w2), _expert_fn, "ep",
-                          capacity_factor=capacity_factor)
+        y, _aux = switch_moe(x, router, (w1, w2), _expert_fn, "ep",
+                             capacity_factor=capacity_factor)
+        return y
 
     got = jax.jit(jax.shard_map(
         f, mesh=mesh, in_specs=(P("ep"), P(), P("ep"), P("ep")),
@@ -84,8 +85,9 @@ def test_switch_moe_grads_flow_to_router_and_experts(rng):
 
     def loss(router, w1, w2):
         def f(x, router, w1, w2):
-            return switch_moe(x, router, (w1, w2), _expert_fn, "ep",
-                              capacity_factor=4.0)
+            y, _aux = switch_moe(x, router, (w1, w2), _expert_fn, "ep",
+                                 capacity_factor=4.0)
+            return y
         shard = jax.shard_map(
             f, mesh=mesh, in_specs=(P("ep"), P(), P("ep"), P("ep")),
             out_specs=P("ep"), check_vma=False)
@@ -106,7 +108,7 @@ def test_switch_moe_rejects_mismatched_expert_count(rng):
     x = jnp.asarray(rng.standard_normal((4 * TLOC, D)), jnp.float32)
 
     def f(x, router, w1, w2):
-        return switch_moe(x, router, (w1, w2), _expert_fn, "ep")
+        return switch_moe(x, router, (w1, w2), _expert_fn, "ep")[0]
 
     with pytest.raises(Exception):
         jax.jit(jax.shard_map(

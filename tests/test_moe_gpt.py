@@ -1,0 +1,206 @@
+"""Switch-MoE integration: top-2 routing vs a dense oracle, the
+load-balancing aux loss keeping expert occupancy balanced on a toy
+mixture task (VERDICT r2: a top-1 router with no balance term collapses),
+and the MoE GPT family training through the fused step with the aux loss
+routed via Ctx.add_aux_loss — including across the remat boundary."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import apex_tpu.nn as nn
+from apex_tpu.nn import functional as F
+from apex_tpu.models import GptModel
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.parallel import switch_moe
+from apex_tpu.training import make_train_step
+
+V, H, HEADS, S = 97, 32, 4, 16
+D, DFF, TLOC = 8, 16, 12
+
+
+def _mesh(n, name="ep"):
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def _expert_fn(params, x):
+    w1, w2 = params
+    return jnp.maximum(x @ w1[0], 0) @ w2[0]
+
+
+def test_top2_matches_dense_oracle(rng):
+    """top_k=2 with generous capacity: y = g1*E1(x) + g2*E2(x), gates
+    normalized over the selected pair (GShard)."""
+    n = 4
+    router = jnp.asarray(rng.standard_normal((D, n)), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((n, D, DFF)) * 0.3, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((n, DFF, D)) * 0.3, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((n * TLOC, D)), jnp.float32)
+
+    def f(x, router, w1, w2):
+        y, aux = switch_moe(x, router, (w1, w2), _expert_fn, "ep",
+                            capacity_factor=8.0, top_k=2)
+        return y, aux
+
+    got, aux = jax.jit(jax.shard_map(
+        f, mesh=_mesh(n), in_specs=(P("ep"), P(), P("ep"), P("ep")),
+        out_specs=(P("ep"), P()), check_vma=False))(x, router, w1, w2)
+
+    probs = np.asarray(jax.nn.softmax(x @ router, axis=-1))
+    order = np.argsort(-probs, axis=-1)
+    want = np.zeros((n * TLOC, D), np.float32)
+    for t in range(n * TLOC):
+        e1, e2 = int(order[t, 0]), int(order[t, 1])
+        g1, g2 = probs[t, e1], probs[t, e2]
+        zn = g1 + g2
+        xt = np.asarray(x[t])
+        h1 = np.maximum(xt @ np.asarray(w1[e1]), 0) @ np.asarray(w2[e1])
+        h2 = np.maximum(xt @ np.asarray(w1[e2]), 0) @ np.asarray(w2[e2])
+        want[t] = (g1 / zn) * h1 + (g2 / zn) * h2
+    np.testing.assert_allclose(np.asarray(got), want, rtol=3e-5, atol=3e-5)
+    assert np.isfinite(float(aux)) and float(aux) >= 1.0 - 1e-5
+
+
+def test_aux_loss_uniform_is_one(rng):
+    """With a zero router every expert is equally probable and f_e is
+    whatever argmax ties give — but P_e is uniform, so aux = E * sum(f_e
+    / E) = 1 exactly: the minimum of the Switch balance loss."""
+    n = 4
+    router = jnp.zeros((D, n), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((n, D, DFF)) * 0.3, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((n, DFF, D)) * 0.3, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((n * TLOC, D)), jnp.float32)
+
+    def f(x, router, w1, w2):
+        return switch_moe(x, router, (w1, w2), _expert_fn, "ep")[1]
+
+    aux = jax.jit(jax.shard_map(
+        f, mesh=_mesh(n), in_specs=(P("ep"), P(), P("ep"), P("ep")),
+        out_specs=P(), check_vma=False))(x, router, w1, w2)
+    np.testing.assert_allclose(float(aux), 1.0, rtol=1e-6)
+
+
+def test_aux_loss_keeps_experts_balanced_on_mixture_task(rng):
+    """Train router + experts on a 4-cluster mixture regression with the
+    aux loss: after training, every expert keeps a meaningful share of
+    the tokens (no collapse) while the task loss drops."""
+    n = 4
+    mesh = _mesh(n)
+    centers = rng.standard_normal((n, D)).astype(np.float32) * 3.0
+    xs = np.concatenate([
+        centers[i] + 0.3 * rng.standard_normal((TLOC * 2, D))
+        for i in range(n)]).astype(np.float32)
+    perm = rng.permutation(len(xs))
+    xs = xs[perm]
+    ys = np.tanh(xs @ rng.standard_normal((D, D)).astype(np.float32))
+    x, y = jnp.asarray(xs), jnp.asarray(ys)
+
+    router = jnp.asarray(rng.standard_normal((D, n)) * 0.01, jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((n, D, DFF)) * 0.3, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((n, DFF, D)) * 0.3, jnp.float32)
+
+    def step(router, w1, w2, x, y):
+        def local(router, w1, w2, x, y):
+            def loss_fn(router, w1, w2):
+                out, aux = switch_moe(x, router, (w1, w2), _expert_fn,
+                                      "ep", capacity_factor=2.0)
+                task = jnp.mean((out - y) ** 2)
+                # aux weight 0.5: the toy run is ~300 steps, so the
+                # balance term needs more pressure than Switch's 0.01
+                # (which acts over hundreds of thousands of steps) to
+                # un-stick a cluster->expert assignment that starves one
+                # expert
+                return jax.lax.pmean(task, "ep") + 0.5 * aux
+            l, g = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+                router, w1, w2)
+            # replicated router: grads identical-ish per device (token
+            # shards differ) -> pmean; expert blocks: psum/n = true mean
+            gr = jax.lax.pmean(g[0], "ep")
+            g1 = jax.lax.psum(g[1], "ep") / n
+            g2 = jax.lax.psum(g[2], "ep") / n
+            return l, gr, g1, g2
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P("ep"), P("ep"), P("ep"), P("ep")),
+            out_specs=(P(), P(), P("ep"), P("ep")), check_vma=False)(
+            router, w1, w2, x, y)
+
+    jstep = jax.jit(step)
+    l0 = None
+    for i in range(300):
+        l, gr, g1, g2 = jstep(router, w1, w2, x, y)
+        if l0 is None:
+            l0 = float(l)
+        router = router - 0.05 * gr
+        w1 = w1 - 0.05 * g1
+        w2 = w2 - 0.05 * g2
+    assert float(l) < l0
+
+    probs = np.asarray(jax.nn.softmax(x @ router, axis=-1))
+    occupancy = np.bincount(probs.argmax(-1), minlength=n) / len(xs)
+    # balanced: no expert starves, none dominates
+    assert occupancy.max() < 0.6, occupancy
+    assert occupancy.min() > 0.05, occupancy
+    # router entropy has not collapsed to a point mass
+    ent = -(probs * np.log(probs + 1e-9)).sum(-1).mean()
+    assert ent > 0.1, ent
+
+
+def _moe_gpt(**kw):
+    nn.manual_seed(5)
+    return GptModel(vocab_size=V, hidden=H, layers=2, heads=HEADS,
+                    max_positions=32, dropout=0.0, attn_dropout=0.0,
+                    moe_axis="data", moe_num_experts=4, **kw)
+
+
+def _run_moe_step(model, n_steps=15):
+    opt = FusedAdam(list(model.parameters()), lr=1e-2)
+
+    def lm_loss(logits, tgt):
+        return F.cross_entropy(logits.reshape((-1, V)),
+                               tgt.reshape((-1,)))
+
+    step = make_train_step(model, opt, lm_loss, half_dtype=None,
+                           loss_scale=1.0, axis_name="data")
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, V, (8, S)))
+    tgt = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1))
+    mesh = _mesh(4, "data")
+    sharded = jax.jit(jax.shard_map(
+        step._step_fn, mesh=mesh,
+        in_specs=(P(), P("data"), P("data")),
+        out_specs=(P(), P()), check_vma=False))
+    state, l0 = sharded(step.state, ids, tgt)
+    for _ in range(n_steps):
+        state, l = sharded(state, ids, tgt)
+    return float(l0), float(l)
+
+
+def test_moe_gpt_trains_through_fused_step():
+    """GptModel(moe_axis="data"): every second block routes its FFN over
+    4 experts on the data axis; the fused step folds the aux loss in and
+    the loss decreases."""
+    l0, l = _run_moe_step(_moe_gpt())
+    assert np.isfinite(l) and l < l0
+
+
+def test_moe_gpt_trains_with_remat():
+    """The aux loss crosses the jax.checkpoint boundary as an explicit
+    output (nn.checkpoint_forward), so MoE composes with remat."""
+    l0, l = _run_moe_step(_moe_gpt(remat=True))
+    assert np.isfinite(l) and l < l0
+
+
+def test_moe_config_validation():
+    with pytest.raises(ValueError, match="moe_num_experts"):
+        GptModel(vocab_size=V, hidden=H, layers=2, heads=HEADS,
+                 attn_dropout=0.0, moe_axis="data")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        GptModel(vocab_size=V, hidden=H, layers=2, heads=HEADS,
+                 attn_dropout=0.0, moe_axis="data", moe_num_experts=4,
+                 tp_axis="tp")
+    with pytest.raises(ValueError, match="top_k"):
+        from apex_tpu.parallel.expert_parallel import switch_moe as sm
+        sm(jnp.zeros((4, D)), jnp.zeros((D, 2)), None, _expert_fn,
+           "ep", top_k=3)

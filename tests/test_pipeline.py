@@ -24,6 +24,14 @@ def _stage_fn(params, x):
     return jnp.tanh(x @ w[0] + b[0])
 
 
+def _stack_stage_fn(params, x):
+    # PipelinedStack hands each device its UNWRAPPED stage params (the
+    # stack slices with keepdims=False), unlike the shard_map-sharded
+    # convention of _stage_fn where the leading dim survives as size 1
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
 def _params(rng, n_stages):
     w = jnp.asarray(rng.standard_normal((n_stages, D, D)) * 0.5, jnp.float32)
     b = jnp.asarray(rng.standard_normal((n_stages, D)) * 0.1, jnp.float32)
@@ -80,3 +88,136 @@ def test_pipeline_grads_match_sequential(rng):
     for a, bb in zip(g_pipe, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
                                    rtol=3e-5, atol=3e-5)
+
+
+def test_pipelined_stack_step_matches_dense_oracle(rng):
+    """PipelinedStack through make_train_step(tp_axis="pp"): the pipeline's
+    microbatch axis is the gradient-accumulation unit — per-step losses
+    and parameters track a dense sequential run of the same stages on the
+    full batch (mean-reduction loss decomposes over microbatches)."""
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import PipelinedStack
+    from apex_tpu.training import make_train_step
+
+    n_stages, n_micro, b = 4, 4, 16
+    mesh = _mesh(n_stages)
+    w, bias = _params(rng, n_stages)
+    x = jnp.asarray(rng.standard_normal((b, D)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((b, D)), jnp.float32)
+
+    def loss_fn(out, y):
+        return jnp.mean((out - y) ** 2)
+
+    # dense oracle: same stacked params trained sequentially
+    class Dense:
+        def __init__(self):
+            from apex_tpu.nn.parameter import Parameter
+            self._w = Parameter(w)
+            self._b = Parameter(bias)
+            self.training = True
+
+        def parameters(self):
+            return [self._w, self._b]
+
+        def buffers(self):
+            return []
+
+        def modules(self):
+            return []
+
+        def forward(self, ctx, x):
+            wv, bv = ctx.value(self._w), ctx.value(self._b)
+            for i in range(n_stages):
+                x = jnp.tanh(x @ wv[i] + bv[i])
+            return x
+
+    dense = Dense()
+    opt_d = FusedAdam(dense.parameters(), lr=1e-2)
+    step_d = make_train_step(dense, opt_d, loss_fn, half_dtype=None,
+                             loss_scale=1.0)
+    ref_losses = [float(step_d(x, y)) for _ in range(8)]
+
+    stack = PipelinedStack(_stack_stage_fn, (w, bias), "pp",
+                           n_micro=n_micro)
+    opt_p = FusedAdam(stack.parameters(), lr=1e-2)
+    step_p = make_train_step(stack, opt_p, loss_fn, half_dtype=None,
+                             loss_scale=1.0, tp_axis="pp")
+    sharded = jax.jit(jax.shard_map(
+        step_p._step_fn, mesh=mesh, in_specs=(P(), P(), P()),
+        out_specs=(P(), P()), check_vma=False))
+    state, losses = step_p.state, []
+    for _ in range(8):
+        state, l = sharded(state, x, y)
+        losses.append(float(l))
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-4)
+
+
+def test_pipelined_stack_remat_matches_no_remat(rng):
+    """remat_stage=True recomputes stage internals in backward without
+    changing the numbers."""
+    from apex_tpu.parallel import PipelinedStack
+
+    n_stages, n_micro, b = 4, 4, 8
+    mesh = _mesh(n_stages)
+    w, bias = _params(rng, n_stages)
+    x = jnp.asarray(rng.standard_normal((b, D)), jnp.float32)
+    w_out = jnp.asarray(rng.standard_normal((b, D)), jnp.float32)
+
+    from apex_tpu.nn.modules import Ctx
+
+    outs = []
+    for remat in (False, True):
+        stack = PipelinedStack(_stack_stage_fn, (w, bias), "pp",
+                               n_micro=n_micro, remat_stage=remat)
+        ps = stack.parameters()
+
+        def f(vals, x):
+            def loss(vals):
+                ctx = Ctx(env={id(p): v for p, v in zip(ps, vals)})
+                return jnp.sum(stack.forward(ctx, x) * w_out)
+            l, g = jax.value_and_grad(loss)(vals)
+            return l, [jax.lax.psum(gi, "pp") for gi in g]
+
+        l, g = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))([p.data for p in ps], x)
+        outs.append((float(l), [np.asarray(gi) for gi in g]))
+    (l0, g0), (l1, g1) = outs
+    np.testing.assert_allclose(l1, l0, rtol=1e-6)
+    for a, bb in zip(g0, g1):
+        np.testing.assert_allclose(a, bb, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_rejects_shape_changing_stage(rng):
+    mesh = _mesh(4)
+    w, bias = _params(rng, 4)
+    xs = jnp.asarray(rng.standard_normal((4, MICRO, D)), jnp.float32)
+
+    def bad_stage(params, x):
+        return jnp.concatenate([x, x], axis=-1)   # widens the activation
+
+    def f(w, b, xs):
+        return pipeline_apply(bad_stage, (w, b), xs, "pp")
+
+    with pytest.raises(ValueError, match="share one activation"):
+        jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
+            out_specs=P(), check_vma=False))(w, bias, xs)
+
+
+def test_pipelined_stack_rejects_indivisible_batch(rng):
+    from apex_tpu.nn.modules import Ctx
+    from apex_tpu.parallel import PipelinedStack
+
+    mesh = _mesh(4)
+    w, bias = _params(rng, 4)
+    stack = PipelinedStack(_stack_stage_fn, (w, bias), "pp", n_micro=3)
+    x = jnp.asarray(rng.standard_normal((8, D)), jnp.float32)  # 8 % 3
+
+    def f(x):
+        return stack.forward(Ctx(), x)
+
+    with pytest.raises(ValueError, match="n_micro"):
+        jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False))(x)

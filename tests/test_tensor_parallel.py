@@ -55,25 +55,36 @@ def test_tp_mlp_matches_unsharded(rng):
 
 
 def test_tp_grads_match_unsharded(rng):
+    """Gradients computed INSIDE shard_map (the fused train step's
+    convention, training/step.py): the f/g operator pair makes the
+    column weight/bias and row weight grads disjoint per-device blocks
+    that psum-assemble to the unsharded oracle, while the row bias (added
+    after the reduction) gets the full replicated grad on every device."""
     mesh = _mesh()
     (col, row), (lin1, lin2) = _oracle_and_tp()
     x = jnp.asarray(rng.standard_normal((B, IN)), jnp.float32)
     w_out = jnp.asarray(rng.standard_normal((B, OUT)), jnp.float32)
 
-    def tp_loss(cw, cb, rw, rb, x):
-        def f(cw, cb, rw, rb, x):
-            from apex_tpu.nn.modules import Ctx
+    def f(cw, cb, rw, rb, x):
+        from apex_tpu.nn.modules import Ctx
+        from apex_tpu.parallel.tensor_parallel import copy_to_tp_region
+
+        def loss(cw, cb, rw, rb):
             ctx = Ctx(env={id(col.weight): cw, id(col.bias): cb,
                            id(row.weight): rw, id(row.bias): rb})
-            h = F.relu(col.forward(ctx, x))
-            return row.forward(ctx, h)
+            h = F.relu(col.forward(ctx, copy_to_tp_region(x, "tp")))
+            return jnp.sum(row.forward(ctx, h) * w_out)
 
-        shard = jax.shard_map(f, mesh=mesh,
-                              in_specs=(P(), P(), P(), P(), P()),
-                              out_specs=P(), check_vma=False)
-        return jnp.sum(shard(cw, cb, rw, rb, x) * w_out)
+        gcw, gcb, grw, grb = jax.grad(loss, argnums=(0, 1, 2, 3))(
+            cw, cb, rw, rb)
+        # sharded-param grads are disjoint blocks: assemble by psum (what
+        # make_train_step(tp_axis=...) does); row bias is already full
+        return (jax.lax.psum(gcw, "tp"), jax.lax.psum(gcb, "tp"),
+                jax.lax.psum(grw, "tp"), grb)
 
-    g_tp = jax.jit(jax.grad(tp_loss, argnums=(0, 1, 2, 3)))(
+    g_tp = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(),) * 5, out_specs=P(),
+        check_vma=False))(
         col.weight.data, col.bias.data, row.weight.data, row.bias.data, x)
 
     # oracle grads through the tape

@@ -1,0 +1,281 @@
+"""Megatron-style tensor parallelism integrated into the model families
+(models/gpt.py, models/bert.py ``tp_axis``): head-sharded attention +
+column→row MLPs against the unsharded oracle, gradient assembly through
+the fused train step, and composition with data/sequence parallelism.
+
+Reference analogue: none (SURVEY.md §2.3 — the reference's only strategy
+is data parallelism); oracle methodology mirrors tests/L1 (sharded vs
+unsharded build must agree)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import apex_tpu.nn as nn
+from apex_tpu.nn import functional as F
+from apex_tpu.nn.modules import Ctx
+from apex_tpu.models import BertModel, GptModel
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.training import make_train_step
+
+V, H, L, HEADS, S = 97, 32, 2, 4, 16
+
+
+def _gpt(**kw):
+    nn.manual_seed(5)
+    return GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                    max_positions=64, dropout=0.0, attn_dropout=0.0, **kw)
+
+
+def test_tp_gpt_forward_and_grads_match_unsharded(rng):
+    """4-way TP: logits match, and the step's gradient contract holds —
+    after psum'ing the tp_sharded_params' block-sparse grads over the
+    axis, every device holds the unsharded model's full gradients."""
+    ids = jnp.asarray(rng.integers(0, V, (2, S)))
+    w = jnp.asarray(rng.standard_normal((2, S, V)), jnp.float32)
+
+    m_ref = _gpt()
+    params_ref = list(m_ref.parameters())
+
+    def ref_loss(vals):
+        ctx = Ctx(env={id(p): v for p, v in zip(params_ref, vals)},
+                  training=False)
+        return jnp.sum(m_ref.forward(ctx, ids) * w)
+
+    vals = [p.data for p in params_ref]
+    ref_out = m_ref(ids).value
+    ref_grads = jax.grad(ref_loss)(vals)
+
+    m_tp = _gpt(tp_axis="tp")
+    params_tp = list(m_tp.parameters())
+    tp_ids_set = {id(p) for p in m_tp.tp_sharded_params()}
+    mesh = Mesh(np.array(jax.devices())[:4].reshape(4), ("tp",))
+
+    def tp_fwd(vals, ids):
+        ctx = Ctx(env={id(p): v for p, v in zip(params_tp, vals)},
+                  training=False)
+        return m_tp.forward(ctx, ids)
+
+    shard_fwd = jax.jit(jax.shard_map(
+        tp_fwd, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        check_vma=False))
+    np.testing.assert_allclose(np.asarray(shard_fwd(vals, ids)),
+                               np.asarray(ref_out), rtol=2e-4, atol=2e-4)
+
+    # gradients, assembled the way training/step.py assembles them
+    def tp_grads(vals, ids, w):
+        def f(vals, ids, w):
+            def loss(vals):
+                return jnp.sum(tp_fwd(vals, ids) * w)
+            gs = jax.grad(loss)(vals)
+            return [jax.lax.psum(g, "tp") if id(p) in tp_ids_set else g
+                    for p, g in zip(params_tp, gs)]
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
+            check_vma=False))(vals, ids, w)
+
+    for a, b in zip(ref_grads, tp_grads(vals, ids, w)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_tp_gpt_fused_step_matches_unsharded():
+    """Pure-TP training through make_train_step(tp_axis="tp"): the
+    per-step losses track the unsharded run (same seed, same data)."""
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, V, (2, S)))
+    tgt = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1))
+
+    def lm_loss(logits, tgt):
+        return F.cross_entropy(logits.reshape((-1, V)),
+                               tgt.reshape((-1,)))
+
+    def run_ref(n):
+        m = _gpt()
+        opt = FusedAdam(list(m.parameters()), lr=1e-2)
+        step = make_train_step(m, opt, lm_loss, half_dtype=None,
+                               loss_scale=1.0)
+        return [float(step(ids, tgt)) for _ in range(n)]
+
+    def run_tp(n):
+        m = _gpt(tp_axis="tp")
+        opt = FusedAdam(list(m.parameters()), lr=1e-2)
+        step = make_train_step(m, opt, lm_loss, half_dtype=None,
+                               loss_scale=1.0, tp_axis="tp")
+        mesh = Mesh(np.array(jax.devices())[:4].reshape(4), ("tp",))
+        sharded = jax.jit(jax.shard_map(
+            step._step_fn, mesh=mesh, in_specs=(P(), P(), P()),
+            out_specs=(P(), P()), check_vma=False))
+        state, losses = step.state, []
+        for _ in range(n):
+            state, l = sharded(state, ids, tgt)
+            losses.append(float(l))
+        return losses
+
+    ref, tp = run_ref(8), run_tp(8)
+    np.testing.assert_allclose(tp, ref, rtol=2e-3, atol=2e-3)
+    assert tp[-1] < tp[0]
+
+
+def test_dp_x_tp_2d_mesh_training():
+    """2-D composition on a (2, 4) mesh: batch sharded over 'data',
+    heads/MLP sharded over 'tp'; per-step losses track the single-device
+    oracle on the same global batch."""
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, V, (4, S)))
+    tgt = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1))
+
+    def lm_loss(logits, tgt):
+        return F.cross_entropy(logits.reshape((-1, V)),
+                               tgt.reshape((-1,)))
+
+    def run_ref(n):
+        m = _gpt()
+        opt = FusedAdam(list(m.parameters()), lr=1e-2)
+        step = make_train_step(m, opt, lm_loss, half_dtype=None,
+                               loss_scale=1.0)
+        return [float(step(ids, tgt)) for _ in range(n)]
+
+    def run_dp_tp(n):
+        m = _gpt(tp_axis="tp")
+        opt = FusedAdam(list(m.parameters()), lr=1e-2)
+        step = make_train_step(m, opt, lm_loss, half_dtype=None,
+                               loss_scale=1.0, axis_name="data",
+                               tp_axis="tp")
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "tp"))
+        sharded = jax.jit(jax.shard_map(
+            step._step_fn, mesh=mesh,
+            in_specs=(P(), P("data"), P("data")),
+            out_specs=(P(), P()), check_vma=False))
+        state, losses = step.state, []
+        for _ in range(n):
+            state, l = sharded(state, ids, tgt)
+            # the reported loss is one data-shard's half-batch mean; the
+            # grads are exact (psum-mean over 'data' + tp assembly), so
+            # compare the global mean
+            losses.append(float(jax.jit(jax.shard_map(
+                lambda s, i, t: jax.lax.pmean(
+                    lm_loss(_fwd_eval(m, s, i), t), "data"),
+                mesh=mesh, in_specs=(P(), P("data"), P("data")),
+                out_specs=P(), check_vma=False))(state, ids, tgt)))
+        return losses
+
+    def _fwd_eval(m, state, ids):
+        params = [p for p in m.parameters() if p is not None]
+        env = {id(p): v for p, v in zip(params, state.master_params)}
+        ctx = Ctx(env=env, training=False)
+        return m.forward(ctx, ids)
+
+    # compare the post-update eval losses instead of the in-step training
+    # losses (those are per-shard); oracle does the same eval
+    def run_ref_eval(n):
+        m = _gpt()
+        opt = FusedAdam(list(m.parameters()), lr=1e-2)
+        step = make_train_step(m, opt, lm_loss, half_dtype=None,
+                               loss_scale=1.0)
+        losses = []
+        for _ in range(n):
+            step(ids, tgt)
+            losses.append(float(lm_loss(_fwd_eval(m, step.state, ids), tgt)))
+        return losses
+
+    ref, dptp = run_ref_eval(5), run_dp_tp(5)
+    np.testing.assert_allclose(dptp, ref, rtol=3e-3, atol=3e-3)
+
+
+def test_sp_x_tp_composition_matches_unsharded(rng):
+    """Ring-SP × TP on a (2, 4) mesh: sequence sharded over 'sp', heads
+    over 'tp' — forward logits match the unsharded oracle."""
+    S_G = 32
+    ids = jnp.asarray(rng.integers(0, V, (2, S_G)))
+
+    nn.manual_seed(5)
+    m_ref = GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                     max_positions=S_G, dropout=0.0, attn_dropout=0.0)
+    ref_out = m_ref(ids).value
+
+    nn.manual_seed(5)
+    m = GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                 max_positions=S_G, dropout=0.0, attn_dropout=0.0,
+                 sp_axis="sp", tp_axis="tp")
+    params = list(m.parameters())
+    vals = [p.data for p in params]
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("sp", "tp"))
+
+    def fwd(vals, ids_l):
+        ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                  training=False)
+        return m.forward(ctx, ids_l)
+
+    shard_fwd = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp", None), check_vma=False))
+    np.testing.assert_allclose(np.asarray(shard_fwd(vals, ids)),
+                               np.asarray(ref_out), rtol=3e-4, atol=3e-4)
+
+
+def test_tp_bert_forward_matches_unsharded(rng):
+    """BERT encoder under 4-way TP with a padding mask: sequence output
+    matches unsharded."""
+    def build(tp_axis):
+        nn.manual_seed(3)
+        return BertModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                         intermediate=64, max_positions=64, dropout=0.0,
+                         attn_dropout=0.0, tp_axis=tp_axis)
+
+    ids = jnp.asarray(rng.integers(0, V, (2, S)))
+    mask = np.ones((2, S), np.int32)
+    mask[:, 10:] = 0
+    mask = jnp.asarray(mask)
+
+    m_ref = build(None)
+    ref_out = m_ref(ids, None, mask).value
+
+    m_tp = build("tp")
+    params = list(m_tp.parameters())
+    vals = [p.data for p in params]
+    mesh = Mesh(np.array(jax.devices())[:4].reshape(4), ("tp",))
+
+    def fwd(vals, ids, mask):
+        ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                  training=False)
+        return m_tp.forward(ctx, ids, None, mask)
+
+    out = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
+        check_vma=False))(vals, ids, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_tp_config_validation():
+    with pytest.raises(ValueError, match="attn_dropout"):
+        GptModel(vocab_size=V, hidden=H, layers=1, heads=HEADS,
+                 tp_axis="tp")  # default attn_dropout=0.1
+    with pytest.raises(ValueError, match="attn_dropout"):
+        BertModel(vocab_size=V, hidden=H, layers=1, heads=HEADS,
+                  intermediate=64, tp_axis="tp")
+    # heads not divisible by the axis size fails loudly at trace time
+    m = _gpt(tp_axis="tp")
+    params = list(m.parameters())
+    vals = [p.data for p in params]
+    mesh = Mesh(np.array(jax.devices()), ("tp",))  # 8 devices, 4 heads
+
+    def fwd(vals, ids):
+        ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                  training=False)
+        return m.forward(ctx, ids)
+
+    with pytest.raises(ValueError, match="not divisible"):
+        jax.jit(jax.shard_map(
+            fwd, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))(vals, jnp.zeros((2, S), jnp.int32))
+
+
+def test_tp_step_requires_model_support():
+    nn.manual_seed(0)
+    m = nn.Sequential(nn.Linear(8, 8))
+    opt = FusedAdam(list(m.parameters()), lr=1e-3)
+    with pytest.raises(ValueError, match="tp_sharded_params"):
+        make_train_step(m, opt, lambda o, t: jnp.sum(o), tp_axis="tp")
