@@ -348,7 +348,10 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     donate_state: bool = True,
                     grad_accum_steps: int = 1,
                     lr_schedule: Optional[Callable] = None,
-                    rng_seed: int = 0):
+                    rng_seed: int = 0,
+                    zero_sharding: bool = False,
+                    zero_mesh=None,
+                    zero_axis: str = "data"):
     """Build a fully-fused O2-style train step.
 
     ``loss_fn(outputs..., *batch_tail) -> scalar``: called with the model
@@ -391,7 +394,48 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     the replicated parameters touch) and are left alone.  Composes with
     ``axis_name`` for DP×TP meshes — batch sharded over ``axis_name``,
     replicated over ``tp_axis``.
+
+    ``zero_sharding=True``: ZeRO stage-1 — fp32 masters and optimizer
+    slots shard over ``zero_axis`` of ``zero_mesh`` (default: a 1-D mesh
+    over all devices), the bf16/fp32 model copies stay replicated, and
+    XLA's GSPMD partitioner derives the reduce-scatter (gradients into
+    master shards) / all-gather (updated masters back out) pair itself.
+    Returns a :class:`~apex_tpu.parallel.zero.ZeroTrainStep` (same
+    calling surface: ``step(x, y) -> loss``, ``.state``,
+    ``.sync_to_objects()``).  Data parallelism is implicit — the batch
+    shards over the axis in the global-view program — so ``axis_name``
+    must not also be given.  Stage-1 ONLY: gradients themselves and the
+    model copies are not sharded (stage-2/3 are out of scope; the
+    per-device win is optimizer memory, ~1/n for every tensor whose
+    leading dim divides the axis).
     """
+    if zero_sharding:
+        if axis_name is not None or tp_axis is not None:
+            raise ValueError(
+                "zero_sharding=True excludes axis_name/tp_axis — ZeRO "
+                "data parallelism is implicit in the global-view jitted "
+                "program (no shard_map/psum); TP's explicit mesh axes "
+                "belong to the shard_map path")
+        from ..parallel.zero import ZeroTrainStep
+        base = make_train_step(
+            model, optimizer, loss_fn, half_dtype=half_dtype,
+            keep_batchnorm_fp32=keep_batchnorm_fp32,
+            dynamic_loss_scale=dynamic_loss_scale,
+            scale_window=scale_window, min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale, loss_scale=loss_scale,
+            donate_state=False,
+            grad_accum_steps=grad_accum_steps, lr_schedule=lr_schedule,
+            rng_seed=rng_seed)
+        if zero_mesh is None:
+            import numpy as _np
+            from jax.sharding import Mesh as _Mesh
+            zero_mesh = _Mesh(_np.array(jax.devices()), (zero_axis,))
+        elif zero_axis not in zero_mesh.shape:
+            raise ValueError(
+                f"zero_axis {zero_axis!r} is not an axis of zero_mesh "
+                f"(axes: {tuple(zero_mesh.shape)})")
+        return ZeroTrainStep(base, zero_mesh, zero_axis,
+                             donate=donate_state)
     params = [p for p in model.parameters() if p is not None]
     buffers = [b for b in model.buffers()]
     group_idxs = match_param_groups(optimizer, params)

@@ -430,7 +430,33 @@ def time_compiled_step(step, batch_arrays, iters, warmup, analytic_flops,
     return dt, compile_s, flops, flops_source
 
 
-def run_bert_throughput(batch, seq_len, iters, warmup):
+def _lm_loss_fns(plain=False):
+    """Token-level loss for the LM configs.  Default: the fused xentropy
+    (contrib/xentropy) — forward saves logits + one lse scalar per row
+    and backward reconstructs the softmax, instead of the plain path's
+    materialized (T, V) log-softmax residual plus a (T, V) one-hot; at
+    GPT vocab 50257 that residual is the single largest tensor in the
+    step.  ``--plain-loss`` keeps the old path for A/B."""
+    import jax.numpy as jnp
+    from apex_tpu.nn import functional as F
+
+    if plain:
+        def token_losses(flat_logits, flat_labels):
+            return F.cross_entropy(flat_logits, flat_labels,
+                                   reduction="none")
+    else:
+        from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+        def token_losses(flat_logits, flat_labels):
+            # padding_idx=-1: no label id is ever -1, so nothing is
+            # silently zeroed (the contrib default of 0 would mask a
+            # real token id)
+            return softmax_cross_entropy_loss(
+                flat_logits, flat_labels, 0.0, -1, True)
+    return token_losses
+
+
+def run_bert_throughput(batch, seq_len, iters, warmup, plain_loss=False):
     """BASELINE.md config 4: BERT-base pretrain (masked-LM) with FusedLAMB +
     FusedLayerNorm + Pallas flash attention under the bf16 fused step."""
     import jax.numpy as jnp
@@ -450,6 +476,7 @@ def run_bert_throughput(batch, seq_len, iters, warmup):
     # the materializing jnp attention — and double-count FLOPs once the
     # flash complement is added).  Residual/embedding dropout stays on.
     model = bert_base(max_positions=seq_len, attn_dropout=0.0)
+    token_losses = _lm_loss_fns(plain_loss)
     opt = FusedLAMB(list(model.parameters()), lr=1e-3, weight_decay=0.01)
 
     def mlm_loss(logits, labels):
@@ -458,7 +485,7 @@ def run_bert_throughput(batch, seq_len, iters, warmup):
         lab = labels.reshape((-1,))
         mask = (lab >= 0).astype(jnp.float32)
         lab_safe = jnp.maximum(lab, 0)
-        losses = F.cross_entropy(flat, lab_safe, reduction="none")
+        losses = token_losses(flat, lab_safe)
         return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
     step = make_train_step(model, opt, mlm_loss,
@@ -481,7 +508,8 @@ def run_bert_throughput(batch, seq_len, iters, warmup):
             [(12, batch, 12, seq_len, seq_len, 64, False)]))
 
 
-def run_seq2seq_throughput(batch, seq_len, iters, warmup):
+def run_seq2seq_throughput(batch, seq_len, iters, warmup,
+                           plain_loss=False):
     """Transformer-base seq2seq train step (copy-style synthetic pairs):
     sequences/sec through the fused bf16 step."""
     import jax.numpy as jnp
@@ -500,9 +528,11 @@ def run_seq2seq_throughput(batch, seq_len, iters, warmup):
                                 attn_dropout=0.0)
     opt = FusedAdam(list(model.parameters()), lr=1e-3)
 
+    token_losses = _lm_loss_fns(plain_loss)
+
     def loss_fn(logits, tgt_out):
-        return F.cross_entropy(logits.reshape((-1, vocab)),
-                               tgt_out.reshape((-1,)))
+        return jnp.mean(token_losses(logits.reshape((-1, vocab)),
+                                     tgt_out.reshape((-1,))))
 
     step = make_train_step(model, opt, loss_fn, half_dtype=jnp.bfloat16,
                            loss_scale=1.0)
@@ -524,7 +554,7 @@ def run_seq2seq_throughput(batch, seq_len, iters, warmup):
 
 
 def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False,
-                       size="small"):
+                       size="small", plain_loss=False):
     """GPT-2-small causal-LM train step: next-token loss with FusedAdam
     under the bf16 fused step (the autoregressive counterpart of the BERT
     config; no reference analogue — the reference ships no LMs)."""
@@ -549,10 +579,12 @@ def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False,
                     remat=remat)
     opt = FusedAdam(list(model.parameters()), lr=6e-4, weight_decay=0.1)
 
+    token_losses = _lm_loss_fns(plain_loss)
+
     def lm_loss(logits, ids):
         flat = logits[:, :-1].reshape((-1, vocab))
         tgt = ids[:, 1:].reshape((-1,))
-        return F.cross_entropy(flat, tgt)
+        return jnp.mean(token_losses(flat, tgt))
 
     step = make_train_step(model, opt, lm_loss,
                            half_dtype=jnp.bfloat16, loss_scale=1.0)
@@ -658,6 +690,10 @@ def main():
     ap.add_argument("--remat", action="store_true",
                     help="with --gpt: rematerialize block activations "
                          "(long-sequence configs)")
+    ap.add_argument("--plain-loss", action="store_true",
+                    help="LM configs: plain log-softmax cross-entropy "
+                         "instead of the fused lse-residual xentropy "
+                         "(A/B the backward-memory win)")
     ap.add_argument("--no-kernels", action="store_true",
                     help="skip the kernel parity checks")
     ap.add_argument("--budget-s", type=float,
@@ -729,14 +765,17 @@ def main():
         try:
             if args.bert:
                 dt, compile_s, flops, flops_source = run_bert_throughput(
-                    batch, args.seq_len, args.iters, args.warmup)
+                    batch, args.seq_len, args.iters, args.warmup,
+                    plain_loss=args.plain_loss)
             elif args.seq2seq:
                 dt, compile_s, flops, flops_source = run_seq2seq_throughput(
-                    batch, args.seq_len, args.iters, args.warmup)
+                    batch, args.seq_len, args.iters, args.warmup,
+                    plain_loss=args.plain_loss)
             elif args.gpt:
                 dt, compile_s, flops, flops_source = run_gpt_throughput(
                     batch, args.seq_len, args.iters, args.warmup,
-                    remat=args.remat, size=args.gpt_size)
+                    remat=args.remat, size=args.gpt_size,
+                    plain_loss=args.plain_loss)
             else:
                 dt, compile_s, flops, flops_source = run_throughput(
                     batch, args.iters, args.warmup)

@@ -1,0 +1,124 @@
+"""BASELINE.md config 1 convergence run (VERDICT r2 #5): ResNet-18
+(CIFAR stem) on the synthetic CIFAR stand-in, trained under both fp32
+and amp O1, curves + final held-out accuracy written to ``curves.json``.
+
+The amp-O1 arm uses the TRUE imperative path (``amp.initialize`` +
+``scale_loss``/``backward`` — config 1's semantics, reference
+examples/simple); the fp32 arm uses the fused step.  Both must reach the
+accuracy target and their loss curves must track each other — the
+reference's cross-build oracle (tests/L1/common/compare.py:34-40)
+applied to precision modes.
+
+Run (CPU, ~30-60 min):  python run_convergence.py [--steps 150]
+The committed ``curves.json`` is validated by ``test_convergence.py``.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--eval-n", type=int, default=512)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "curves.json"))
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu import amp
+    from apex_tpu.models import resnet18
+    from apex_tpu.nn import functional as F
+    from apex_tpu.nn.modules import Ctx
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.training import make_train_step
+    from synth_cifar import make_split
+
+    xtr, ytr = make_split(args.steps * args.batch, seed=1)
+    xte, yte = make_split(args.eval_n, seed=2)
+
+    def batches():
+        for i in range(args.steps):
+            s = slice(i * args.batch, (i + 1) * args.batch)
+            yield jnp.asarray(xtr[s]), jnp.asarray(ytr[s])
+
+    def accuracy(model):
+        model.eval()
+        params = [p for p in model.parameters() if p is not None]
+        buffers = list(model.buffers())
+        env = {id(p): p.data for p in params}
+        env.update({id(b): b.data for b in buffers})
+        correct = 0
+        for i in range(0, args.eval_n, 128):
+            ctx = Ctx(env=env, training=False)
+            logits = model.forward(ctx, jnp.asarray(xte[i:i + 128]))
+            correct += int(jnp.sum(jnp.argmax(logits, -1)
+                                   == jnp.asarray(yte[i:i + 128])))
+        model.train()
+        return correct / args.eval_n
+
+    results = {"steps": args.steps, "batch": args.batch,
+               "eval_n": args.eval_n, "arms": {}}
+
+    # --- fp32 arm: fused step ---
+    t0 = time.perf_counter()
+    nn.manual_seed(0)
+    m = resnet18(num_classes=10, small_input=True)
+    opt = FusedSGD(list(m.parameters()), lr=0.05, momentum=0.9,
+                   weight_decay=5e-4)
+    step = make_train_step(m, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=None, loss_scale=1.0)
+    losses = []
+    for x, y in batches():
+        losses.append(float(step(x, y)))
+    step.sync_to_objects()
+    acc = accuracy(m)
+    results["arms"]["fp32"] = {
+        "losses": losses, "final_acc": acc,
+        "wall_s": round(time.perf_counter() - t0, 1)}
+    print(f"fp32: final loss {losses[-1]:.4f}, acc {acc:.3f}", flush=True)
+
+    # --- amp O1 arm: the imperative reference path ---
+    t0 = time.perf_counter()
+    from apex_tpu.amp._amp_state import reset as _amp_reset
+    _amp_reset()
+    nn.manual_seed(0)
+    m1 = resnet18(num_classes=10, small_input=True)
+    opt1 = FusedSGD(list(m1.parameters()), lr=0.05, momentum=0.9,
+                    weight_decay=5e-4)
+    m1, opt1 = amp.initialize(m1, opt1, opt_level="O1", verbosity=0)
+    crit = nn.CrossEntropyLoss()
+    losses1 = []
+    for x, y in batches():
+        out = m1(x)
+        loss = crit(out, y)
+        opt1.zero_grad()
+        with amp.scale_loss(loss, opt1) as scaled:
+            scaled.backward()
+        opt1.step()
+        losses1.append(float(loss))
+    acc1 = accuracy(m1)
+    results["arms"]["amp_o1"] = {
+        "losses": losses1, "final_acc": acc1,
+        "wall_s": round(time.perf_counter() - t0, 1)}
+    print(f"amp O1: final loss {losses1[-1]:.4f}, acc {acc1:.3f}",
+          flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump(results, f)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Synthetic CIFAR-10 stand-in for the convergence runs (BASELINE.md
+config 1).  The environment has no egress, so the real CIFAR archive
+cannot be fetched; this generator produces a 10-class 32x32x3 image task
+that still requires learned (not linearly separable) conv features:
+
+* each class is a fixed low-frequency prototype (4x4 noise, bilinearly
+  upsampled to 32x32) drawn once from a pinned seed;
+* each sample applies a random circular shift of up to +-6 px in both
+  spatial dims (so per-pixel linear classifiers fail — the decision
+  needs shift-tolerant features) plus N(0, 0.6) pixel noise.
+
+Deterministic given (seed, n): the test and the artifact script see the
+same data.  Reference analogue: tests/L1/common/compare.py trains real
+CIFAR/ImageNet epochs; the oracle here is the same — a stated accuracy
+reached — with the dataset swapped for lack of egress.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _prototypes(n_classes=10, size=32, seed=7):
+    rng = np.random.default_rng(seed)
+    coarse = rng.standard_normal((n_classes, 3, 4, 4)).astype(np.float32)
+    # bilinear upsample 4x4 -> 32x32 per channel
+    xs = np.linspace(0, 3, size)
+    x0 = np.clip(np.floor(xs).astype(int), 0, 2)
+    frac = (xs - x0).astype(np.float32)
+    rows = (coarse[:, :, x0, :] * (1 - frac)[None, None, :, None]
+            + coarse[:, :, x0 + 1, :] * frac[None, None, :, None])
+    protos = (rows[:, :, :, x0] * (1 - frac)[None, None, None, :]
+              + rows[:, :, :, x0 + 1] * frac[None, None, None, :])
+    return protos * 2.0
+
+
+def make_split(n, seed):
+    """→ (images (n, 3, 32, 32) float32, labels (n,) int32)."""
+    protos = _prototypes()
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, (n,)).astype(np.int32)
+    imgs = protos[labels]
+    sh = rng.integers(-6, 7, (n, 2))
+    out = np.empty_like(imgs)
+    for i in range(n):
+        out[i] = np.roll(imgs[i], (sh[i, 0], sh[i, 1]), axis=(1, 2))
+    out += rng.standard_normal(out.shape).astype(np.float32) * 0.6
+    return out, labels

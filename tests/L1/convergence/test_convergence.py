@@ -1,0 +1,99 @@
+"""Convergence oracle for BASELINE.md config 1 (VERDICT r2 #5): training
+must reach a STATED accuracy, not merely run steps.
+
+Two layers of evidence:
+1. the committed ``curves.json`` artifact — ResNet-18 (CIFAR stem),
+   150 steps of batch 64 on the synthetic CIFAR stand-in, fp32 and
+   imperative amp-O1 arms (``run_convergence.py``) — is validated
+   against the accuracy target and the fp32/amp agreement oracle
+   (reference: tests/L1/common/compare.py:34-40 compares builds; here
+   the same check compares precision modes);
+2. a LIVE reduced-scale run (narrow ResNet stem) re-proves in-suite
+   that the pipeline trains to accuracy from scratch in ~a minute.
+"""
+import json
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from synth_cifar import make_split  # noqa: E402
+
+ART = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "curves.json")
+
+# the stated target: both arms must classify >= 85% of held-out samples
+# (observed ~0.95+ at 150 steps; 10-class chance is 10%)
+TARGET_ACC = 0.85
+
+
+@pytest.fixture(scope="module")
+def artifact():
+    if not os.path.exists(ART):
+        pytest.skip("curves.json not generated yet (run "
+                    "run_convergence.py)")
+    with open(ART) as f:
+        return json.load(f)
+
+
+def test_artifact_reaches_accuracy_target(artifact):
+    for arm in ("fp32", "amp_o1"):
+        acc = artifact["arms"][arm]["final_acc"]
+        assert acc >= TARGET_ACC, (arm, acc)
+
+
+def test_artifact_amp_tracks_fp32(artifact):
+    """The amp-O1 loss curve must track fp32 — same oracle the reference
+    applies across builds, applied across precision modes.  Identical
+    data/seeds, so curves stay close in the mean."""
+    f32 = np.asarray(artifact["arms"]["fp32"]["losses"])
+    o1 = np.asarray(artifact["arms"]["amp_o1"]["losses"])
+    assert f32.shape == o1.shape
+    # fp16 arithmetic drifts the trajectories; the mean gap over the run
+    # and the final values must stay small
+    assert np.abs(f32 - o1).mean() < 0.15, np.abs(f32 - o1).mean()
+    assert abs(f32[-1] - o1[-1]) < 0.3, (f32[-1], o1[-1])
+
+
+def test_live_convergence_smoke():
+    """From-scratch mini run: a narrow conv net on the same data
+    pipeline trains to >= 70% held-out accuracy in-suite."""
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+    from apex_tpu.nn.modules import Ctx
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.training import make_train_step
+
+    nn.manual_seed(0)
+    model = nn.Sequential(
+        nn.Conv2d(3, 16, 3, padding=1), nn.BatchNorm2d(16), nn.ReLU(),
+        nn.MaxPool2d(2),
+        nn.Conv2d(16, 32, 3, padding=1), nn.BatchNorm2d(32), nn.ReLU(),
+        nn.AdaptiveAvgPool2d((1, 1)), nn.Flatten(), nn.Linear(32, 10))
+    opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+    step = make_train_step(model, opt,
+                           lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=jnp.bfloat16, loss_scale=1.0)
+
+    xtr, ytr = make_split(40 * 64, seed=11)
+    for i in range(40):
+        s = slice(i * 64, (i + 1) * 64)
+        step(jnp.asarray(xtr[s]), jnp.asarray(ytr[s]))
+    step.sync_to_objects()
+
+    xte, yte = make_split(256, seed=12)
+    model.eval()
+    params = [p for p in model.parameters() if p is not None]
+    env = {id(p): p.data for p in params}
+    env.update({id(b): b.data for b in model.buffers()})
+    ctx = Ctx(env=env, training=False)
+    # the O2-style step keeps model copies in bf16; cast eval inputs the
+    # way the step casts training inputs
+    logits = model.forward(ctx, jnp.asarray(xte, jnp.bfloat16))
+    acc = float(jnp.mean((jnp.argmax(logits, -1)
+                          == jnp.asarray(yte)).astype(jnp.float32)))
+    assert acc >= 0.70, acc

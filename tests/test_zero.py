@@ -219,3 +219,43 @@ def test_zero_composes_with_accum_and_schedule(rng):
     assert abs(float(ref_loss) - float(z_loss)) < 1e-5
     for a, b in zip(ref_params, z_params):
         np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_make_train_step_zero_sharding_api(rng):
+    """One API path (VERDICT r2 #8): make_train_step(zero_sharding=True)
+    returns the ZeRO-wrapped step directly — trains, masters sharded,
+    and the compiled HLO carries the GSPMD-derived ZeRO collective
+    pattern: reduce-scatter where the backend forms it, otherwise its
+    unfused equivalent (all-reduce + dynamic-slice into the shard-shaped
+    masters — the CPU partitioner does not run the reduce-scatter
+    creator pass)."""
+    nn.manual_seed(0)
+    model = nn.Sequential(nn.Linear(16, 64), nn.GELU(), nn.Linear(64, 8))
+    opt = FusedAdam(list(model.parameters()), lr=5e-3)
+    step = make_train_step(model, opt,
+                           lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=jnp.bfloat16, loss_scale="dynamic",
+                           zero_sharding=True)
+    x, y = _batch(rng, n=64)
+    l0 = float(step(x, y))
+    for _ in range(10):
+        l = float(step(x, y))
+    assert np.isfinite(l) and l < l0
+
+    n = len(jax.devices())
+    w0 = step.state.master_params[0]
+    assert w0.sharding.shard_shape(w0.shape)[0] == w0.shape[0] // n
+
+    shs = step._batch_shardings((x, y))
+    txt = step._jitted(shs).lower(step.state, x, y).compile().as_text()
+    has_rs = "reduce-scatter" in txt
+    has_unfused = "all-reduce" in txt and "dynamic-slice" in txt
+    assert has_rs or has_unfused, "no sharded gradient exchange in HLO"
+    assert "all-gather" in txt, "updated masters never gather back"
+
+
+def test_zero_sharding_rejects_axis_name():
+    model, opt = _build()
+    with pytest.raises(ValueError, match="excludes axis_name"):
+        make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t),
+                        axis_name="data", zero_sharding=True)
