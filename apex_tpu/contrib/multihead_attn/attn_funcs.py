@@ -197,32 +197,18 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
     head_dim = e // heads
     iw, ow, ib = input_weights, output_weights, input_biases
     if tensor_parallel_axis is not None:
-        if is_training and dropout_prob > 0.0:
-            raise NotImplementedError(
-                "attention dropout is not supported under tensor "
-                "parallelism (per-head-block masks would be drawn from "
-                "one shared key); set attn_dropout=0.0")
-        from ...parallel.tensor_parallel import (_shard_dim,
-                                                 copy_to_tp_region)
-        # Megatron's f operator: identity fwd, psum bwd — without it the
-        # gradient of everything upstream (embeddings, LNs, prior layers)
-        # is a per-device partial (each device backward only carries its
-        # own head block's contribution)
-        inputs = copy_to_tp_region(inputs, tensor_parallel_axis)
-        n_tp = jax.lax.psum(1, tensor_parallel_axis)
-        if heads % n_tp:
-            raise ValueError(
-                f"tensor parallelism: heads ({heads}) not divisible by "
-                f"the '{tensor_parallel_axis}' axis size ({n_tp})")
-        heads = heads // n_tp
-        # rows of in_proj group [q_h, k_h, v_h] per head (module
-        # docstring) — a contiguous 3*D*heads_local block is a head block
-        iw = _shard_dim(iw, tensor_parallel_axis, 0)
+        # shared entry protocol (f operator on the stream, head check,
+        # block slicing): rows of in_proj group [q_h, k_h, v_h] per head
+        # (module docstring) so a contiguous row block is a head block;
+        # out_proj contracts the heads-major context so column block i
+        # multiplies exactly head block i
+        from ...parallel.tensor_parallel import tp_attn_begin
+        (inputs,), heads, rows, (ow,) = tp_attn_begin(
+            tensor_parallel_axis, heads, is_training, dropout_prob,
+            [inputs], [iw] + ([ib] if ib is not None else []), [ow])
+        iw = rows[0]
         if ib is not None:
-            ib = _shard_dim(ib, tensor_parallel_axis, 0)
-        # out_proj contracts the heads-major context: column block i of
-        # the weight multiplies exactly head block i
-        ow = _shard_dim(ow, tensor_parallel_axis, 1)
+            ib = rows[1]
         e = heads * head_dim
     lin = jnp.matmul(inputs, iw.T)
     if ib is not None:
@@ -293,14 +279,31 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
 def encdec_attn_func(use_time_mask, is_training, heads, scale, inputs_q,
                      inputs_kv, input_weights_q, input_weights_kv,
                      output_weights, mask=None, dropout_prob=0.0,
-                     key=None, use_flash=False):
+                     key=None, use_flash=False,
+                     tensor_parallel_axis=None):
     """Encoder-decoder attention (encdec_multihead_attn_func.py): q from the
-    decoder stream, interleaved (k, v) from the encoder stream."""
+    decoder stream, interleaved (k, v) from the encoder stream.
+
+    ``tensor_parallel_axis``: Megatron head sharding, same design as
+    ``self_attn_func`` — q rows group per head and kv rows per head as
+    ``[k_h, v_h]`` pairs, so contiguous row blocks are head blocks; the
+    output projection is row-parallel with one reduction.  Both streams
+    pass through the f operator (their gradients feed the encoder AND
+    decoder stacks)."""
     tq, b, e = inputs_q.shape
     tk = inputs_kv.shape[0]
     head_dim = e // heads
-    q = jnp.matmul(inputs_q, input_weights_q.T)
-    kv = jnp.matmul(inputs_kv, input_weights_kv.T)
+    wq, wkv, ow = input_weights_q, input_weights_kv, output_weights
+    if tensor_parallel_axis is not None:
+        # shared entry protocol; q rows group per head, kv rows per head
+        # as [k_h, v_h] pairs — contiguous row blocks are head blocks
+        from ...parallel.tensor_parallel import tp_attn_begin
+        (inputs_q, inputs_kv), heads, (wq, wkv), (ow,) = tp_attn_begin(
+            tensor_parallel_axis, heads, is_training, dropout_prob,
+            [inputs_q, inputs_kv], [wq, wkv], [ow])
+        e = heads * head_dim
+    q = jnp.matmul(inputs_q, wq.T)
+    kv = jnp.matmul(inputs_kv, wkv.T)
     q3 = jnp.swapaxes(q.reshape(tq, b * heads, head_dim), 0, 1)
     kv = kv.reshape(tk, b * heads, 2, head_dim)
     k3 = jnp.swapaxes(kv[:, :, 0], 0, 1)
@@ -318,4 +321,8 @@ def encdec_attn_func(use_time_mask, is_training, heads, scale, inputs_q,
         ctx3 = _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout,
                                   key)
     ctx = jnp.swapaxes(ctx3, 0, 1).reshape(tq, b, e)
-    return jnp.matmul(ctx, output_weights.T)
+    out = jnp.matmul(ctx, ow.T)
+    if tensor_parallel_axis is not None:
+        from ...parallel.tensor_parallel import reduce_from_tp_region
+        out = reduce_from_tp_region(out, tensor_parallel_axis)
+    return out

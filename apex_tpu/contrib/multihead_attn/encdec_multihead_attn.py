@@ -18,11 +18,16 @@ from .self_multihead_attn import _AttnModule, _xavier_uniform
 
 class EncdecMultiheadAttn(_AttnModule):
     def __init__(self, embed_dim, num_heads, dropout=0.0, bias=False,
-                 include_norm_add=False, impl="fast"):
+                 include_norm_add=False, impl="fast",
+                 tensor_parallel_axis=None):
         super().__init__()
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.dropout = dropout
+        # Megatron head sharding over this mesh axis (same design as
+        # SelfMultiheadAttn: full replicated weights, head-block slices
+        # at trace time, f/g operators at the region edges)
+        self.tensor_parallel_axis = tensor_parallel_axis
         self.head_dim = embed_dim // num_heads
         assert self.head_dim * num_heads == embed_dim, \
             "embed_dim must be divisible by num_heads"
@@ -46,6 +51,13 @@ class EncdecMultiheadAttn(_AttnModule):
                 jnp.ones((embed_dim,), jnp.float32))
             self.lyr_nrm_beta_weights = Parameter(
                 jnp.zeros((embed_dim,), jnp.float32))
+
+    def tp_sharded_params(self):
+        """Block-sparse-gradient parameters under tensor parallelism
+        (see SelfMultiheadAttn.tp_sharded_params): q/kv projections shard
+        rows per head, the output projection shards columns."""
+        return [self.in_proj_weight_q, self.in_proj_weight_kv,
+                self.out_proj_weight]
 
     def forward(self, ctx, query, key, value=None, key_padding_mask=None,
                 need_weights=False, attn_mask=None, is_training=None):
@@ -77,7 +89,8 @@ class EncdecMultiheadAttn(_AttnModule):
             key, ctx.value(self.in_proj_weight_q),
             ctx.value(self.in_proj_weight_kv),
             ctx.value(self.out_proj_weight), mask, self.dropout,
-            key=drop_key, use_flash=(self.impl == "fast"))
+            key=drop_key, use_flash=(self.impl == "fast"),
+            tensor_parallel_axis=self.tensor_parallel_axis)
 
         if self.include_norm_add:
             if is_training and self.dropout > 0.0:

@@ -26,18 +26,21 @@ class Seq2SeqDecoderLayer(nn.Module):
     residual, LN → GELU FFN → residual."""
 
     def __init__(self, hidden, heads, intermediate, dropout=0.1,
-                 attn_dropout=0.1):
+                 attn_dropout=0.1, tp_axis=None):
         super().__init__()
         self.ln1 = FusedLayerNorm(hidden)
         self.self_attn = SelfMultiheadAttn(
-            hidden, heads, dropout=attn_dropout, impl="fast", causal=True)
+            hidden, heads, dropout=attn_dropout, impl="fast", causal=True,
+            tensor_parallel_axis=tp_axis)
         self.ln2 = FusedLayerNorm(hidden)
         self.cross_attn = EncdecMultiheadAttn(
-            hidden, heads, dropout=attn_dropout, impl="fast")
+            hidden, heads, dropout=attn_dropout, impl="fast",
+            tensor_parallel_axis=tp_axis)
         self.ln3 = FusedLayerNorm(hidden)
         self.fc1 = nn.Linear(hidden, intermediate)
         self.fc2 = nn.Linear(intermediate, hidden)
         self.dropout = nn.Dropout(dropout)
+        self.tp_axis = tp_axis
 
     def forward(self, ctx, x, memory, memory_kpm=None):
         h, _ = self.self_attn.forward(ctx, self.ln1.forward(ctx, x))
@@ -45,9 +48,23 @@ class Seq2SeqDecoderLayer(nn.Module):
         h, _ = self.cross_attn.forward(ctx, self.ln2.forward(ctx, x),
                                        memory, key_padding_mask=memory_kpm)
         x = x + self.dropout.forward(ctx, h)
-        h = F.gelu(self.fc1.forward(ctx, self.ln3.forward(ctx, x)))
-        h = self.fc2.forward(ctx, h)
+        if self.tp_axis is not None:
+            from ..parallel.tensor_parallel import tp_ffn
+            h = tp_ffn(self.ln3.forward(ctx, x),
+                       ctx.value(self.fc1.weight), ctx.value(self.fc1.bias),
+                       ctx.value(self.fc2.weight), ctx.value(self.fc2.bias),
+                       self.tp_axis, activation=F.gelu)
+        else:
+            h = F.gelu(self.fc1.forward(ctx, self.ln3.forward(ctx, x)))
+            h = self.fc2.forward(ctx, h)
         return x + self.dropout.forward(ctx, h)
+
+    def tp_sharded_params(self):
+        """Self + cross attention head blocks and the column/row MLP
+        entries (the contract make_train_step(tp_axis=...) assembles)."""
+        return (self.self_attn.tp_sharded_params()
+                + self.cross_attn.tp_sharded_params()
+                + [self.fc1.weight, self.fc1.bias, self.fc2.weight])
 
 
 class TransformerSeq2Seq(nn.Module):
@@ -62,24 +79,39 @@ class TransformerSeq2Seq(nn.Module):
 
     def __init__(self, vocab_size=32000, hidden=512, enc_layers=6,
                  dec_layers=6, heads=8, intermediate=None,
-                 max_positions=512, dropout=0.1, attn_dropout=0.1):
+                 max_positions=512, dropout=0.1, attn_dropout=0.1,
+                 tp_axis=None):
         super().__init__()
         intermediate = intermediate or 4 * hidden
         self.hidden = hidden
         self.max_positions = max_positions
+        # tp_axis: Megatron tensor parallelism across BOTH stacks (see
+        # models/gpt.py — same full-weight/trace-time-slice design);
+        # requires attn_dropout=0 like the other families
+        self.tp_axis = tp_axis
+        if tp_axis is not None and attn_dropout > 0.0:
+            raise ValueError(
+                "tp_axis requires attn_dropout=0.0 — attention dropout "
+                "is unsupported under tensor parallelism")
         self.tok_emb = nn.Embedding(vocab_size, hidden)
         self.pos_emb = nn.Embedding(max_positions, hidden)
         for emb in (self.tok_emb, self.pos_emb):
             emb.weight.data = emb.weight.data * 0.02
         self.drop = nn.Dropout(dropout)
         self.enc_layers = nn.ModuleList([
-            BertLayer(hidden, heads, intermediate, dropout, attn_dropout)
+            BertLayer(hidden, heads, intermediate, dropout, attn_dropout,
+                      tp_axis=tp_axis)
             for _ in range(enc_layers)])
         self.dec_layers = nn.ModuleList([
             Seq2SeqDecoderLayer(hidden, heads, intermediate, dropout,
-                                attn_dropout)
+                                attn_dropout, tp_axis=tp_axis)
             for _ in range(dec_layers)])
         self.dec_ln = FusedLayerNorm(hidden)
+
+    def tp_sharded_params(self):
+        """Both stacks' TP-block-sparse parameters."""
+        return [p for ly in list(self.enc_layers) + list(self.dec_layers)
+                for p in ly.tp_sharded_params()]
 
     def _embed(self, ctx, ids):
         s = ids.shape[1]
@@ -145,6 +177,10 @@ def seq2seq_generate(model: TransformerSeq2Seq, src_ids, max_new_tokens,
     → ``(B, max_new_tokens)`` generated ids (BOS not included).  Compiled
     programs are cached per model + shapes + sampling config.
     """
+    if model.tp_axis is not None:
+        raise NotImplementedError(
+            "seq2seq_generate is single-shard; build the model without "
+            "tp_axis for inference")
     import jax
 
     from ..nn.modules import Ctx

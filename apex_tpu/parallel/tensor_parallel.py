@@ -149,6 +149,34 @@ def _shard_cols(full, axis_name):
     return _shard_dim(full, axis_name, 1)
 
 
+def tp_attn_begin(axis_name, heads, is_training, dropout_prob,
+                  inputs, row_weights, col_weights):
+    """Shared TP entry protocol for the attention functionals
+    (contrib/multihead_attn/attn_funcs.py) — one place for the dropout
+    guard, the f-operator application to every input stream, the head
+    divisibility check, and the weight-block slicing, so the self and
+    encdec paths cannot desynchronize.
+
+    Returns ``(inputs, heads_local, row_shards, col_shards)`` where
+    ``row_weights`` slice dim 0 (head-major projection rows) and
+    ``col_weights`` slice dim 1 (the row-parallel output projections);
+    exit is ``reduce_from_tp_region`` on the projected output."""
+    if is_training and dropout_prob > 0.0:
+        raise NotImplementedError(
+            "attention dropout is not supported under tensor "
+            "parallelism (per-head-block masks would be drawn from "
+            "one shared key); set attn_dropout=0.0")
+    inputs = [copy_to_tp_region(x, axis_name) for x in inputs]
+    n = lax.psum(1, axis_name)
+    if heads % n:
+        raise ValueError(
+            f"tensor parallelism: heads ({heads}) not divisible by "
+            f"the '{axis_name}' axis size ({n})")
+    rows = [_shard_dim(w, axis_name, 0) for w in row_weights]
+    cols = [_shard_dim(w, axis_name, 1) for w in col_weights]
+    return inputs, heads // n, rows, cols
+
+
 def tp_ffn(x, w1, b1, w2, b2, axis_name, activation=None):
     """Column→row feed-forward over FULL (replicated) weights: each device
     slices its shard at trace time (XLA folds the static slice into the
