@@ -32,4 +32,6 @@ run 8 --gpt --seq-len 2048 --remat
 run --gpt-decode
 run --seq2seq
 run --kernels-timing                  # Pallas vs XLA A/B per shape
+run --profile                         # resnet per-op time attribution
+run --profile --gpt                   # gpt per-op time attribution
 echo "done; results in $LOG" >&2
