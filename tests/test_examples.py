@@ -94,6 +94,26 @@ def test_gpt_sp_example_runs():
     assert math.isfinite(final) and final < math.log(97) + 1.0
 
 
+def test_gpt_moe_example_runs():
+    """The Switch-MoE example: 4 experts on the data axis of a virtual
+    CPU mesh, top-2 routing, aux loss in the optimized loss."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)   # the script pins its own virtual mesh
+    script = os.path.join(REPO, "examples", "gpt", "main_moe.py")
+    out = subprocess.run(
+        [sys.executable, script, "--devices", "4", "--steps", "10",
+         "--seq-len", "32", "--layers", "2", "--hidden", "64", "--heads",
+         "4", "--vocab", "97", "--batch", "4", "--lr", "1e-2",
+         "--top-k", "2", "--print-freq", "5"],
+        capture_output=True, text=True, timeout=500, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "MoE blocks, top-2" in out.stdout
+    final = float(out.stdout.rsplit("final loss:", 1)[1].strip())
+    import math
+    # loss includes the aux term (~aux_weight above the task loss)
+    assert math.isfinite(final) and final < math.log(97) + 1.0
+
+
 def test_gpt_tp_example_runs():
     """The data x tensor parallel example: (2, 4) mesh on the virtual CPU
     backend, Megatron head/MLP sharding, loss finite and sane."""
