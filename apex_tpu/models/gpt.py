@@ -207,8 +207,8 @@ class GptModel(nn.Module):
     def __init__(self, vocab_size=50257, hidden=768, layers=12, heads=12,
                  intermediate=None, max_positions=1024, dropout=0.1,
                  attn_dropout=0.1, remat=False, sp_axis=None, tp_axis=None,
-                 moe_axis=None, moe_num_experts=None, moe_every=2,
-                 moe_capacity_factor=1.25, moe_top_k=1,
+                 tp_vocab=False, moe_axis=None, moe_num_experts=None,
+                 moe_every=2, moe_capacity_factor=1.25, moe_top_k=1,
                  moe_aux_weight=0.01):
         super().__init__()
         intermediate = intermediate or 4 * hidden
@@ -240,6 +240,16 @@ class GptModel(nn.Module):
             raise ValueError(
                 "tp_axis requires attn_dropout=0.0 — attention dropout "
                 "is unsupported under tensor parallelism")
+        # tp_vocab: Megatron vocab parallelism — the tied embedding table
+        # row-shards over tp_axis, the input lookup combines partial rows,
+        # and forward returns VOCAB-SHARDED logits (B, S, V/n_tp): the
+        # full logits tensor (the largest activation of an LM step) never
+        # materializes.  Train with
+        # parallel.vocab_parallel_cross_entropy(logits, targets, tp_axis)
+        # as the loss.
+        self.tp_vocab = tp_vocab
+        if tp_vocab and tp_axis is None:
+            raise ValueError("tp_vocab requires tp_axis")
         # remat: rematerialize each block's activations in backward
         # (jax.checkpoint) — HBM drops from O(layers * S * E) residuals to
         # O(layers) block boundaries, the long-sequence enabler
@@ -279,8 +289,13 @@ class GptModel(nn.Module):
         self.ln_f = FusedLayerNorm(hidden)
 
     def tp_sharded_params(self):
-        """All blocks' TP-block-sparse parameters (see GptBlock)."""
-        return [p for blk in self.blocks for p in blk.tp_sharded_params()]
+        """All blocks' TP-block-sparse parameters (see GptBlock), plus
+        the vocab-sharded embedding table under ``tp_vocab`` (its
+        gradient is a scatter into the device's own vocab rows)."""
+        ps = [p for blk in self.blocks for p in blk.tp_sharded_params()]
+        if self.tp_vocab:
+            ps.append(self.tok_emb.weight)
+        return ps
 
     def forward(self, ctx, input_ids):
         b, s = input_ids.shape
@@ -303,8 +318,14 @@ class GptModel(nn.Module):
                 f"{self.max_positions}")
         else:
             pos = jnp.arange(s, dtype=jnp.int32)[None, :]
-        x = self.tok_emb.forward(ctx, input_ids) \
-            + self.pos_emb.forward(ctx, pos)
+        if self.tp_vocab:
+            from ..parallel.tensor_parallel import vocab_parallel_embedding
+            x = vocab_parallel_embedding(
+                input_ids, ctx.value(self.tok_emb.weight), self.tp_axis) \
+                + self.pos_emb.forward(ctx, pos)
+        else:
+            x = self.tok_emb.forward(ctx, input_ids) \
+                + self.pos_emb.forward(ctx, pos)
         x = self.drop.forward(ctx, x)
         x = jnp.swapaxes(x, 0, 1)          # (S, B, E)
         for blk in self.blocks:
@@ -315,6 +336,9 @@ class GptModel(nn.Module):
         x = self.ln_f.forward(ctx, x)
         x = jnp.swapaxes(x, 0, 1)          # (B, S, E)
         emb = ctx.value(self.tok_emb.weight)
+        if self.tp_vocab:
+            from ..parallel.tensor_parallel import vocab_parallel_logits
+            return vocab_parallel_logits(x, emb, self.tp_axis)
         return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype))
 
 

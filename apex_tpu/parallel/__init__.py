@@ -19,7 +19,8 @@ from .ring_attention import (  # noqa: F401
 from .sync_batchnorm import SyncBatchNorm  # noqa: F401
 from .tensor_parallel import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, column_parallel_linear,
-    row_parallel_linear)
+    row_parallel_linear, vocab_parallel_cross_entropy,
+    vocab_parallel_embedding, vocab_parallel_logits)
 from .pipeline import PipelinedStack, pipeline_apply  # noqa: F401
 from .expert_parallel import switch_moe  # noqa: F401
 from .zero import ZeroTrainStep, zero_state_sharding  # noqa: F401

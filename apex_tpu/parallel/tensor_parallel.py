@@ -149,6 +149,70 @@ def _shard_cols(full, axis_name):
     return _shard_dim(full, axis_name, 1)
 
 
+def vocab_parallel_embedding(ids, emb_full, axis_name):
+    """Megatron vocab-parallel embedding lookup: the ``(V, E)`` table is
+    row-sharded over ``axis_name`` (full replicated parameter, sliced at
+    trace time like every TP weight here); each device gathers only ids
+    in its vocab range and the partial rows combine through the g
+    operator.  The gradient is a scatter into the device's own vocab
+    block — disjoint per device, so the table belongs in
+    ``tp_sharded_params()``."""
+    shard = _shard_rows(emb_full, axis_name)   # validates divisibility
+    v_loc = shard.shape[0]
+    off = lax.axis_index(axis_name) * v_loc
+    local = ids - off
+    valid = (local >= 0) & (local < v_loc)
+    rows = jnp.take(shard, jnp.clip(local, 0, v_loc - 1), axis=0)
+    rows = jnp.where(valid[..., None], rows, jnp.zeros_like(rows))
+    return reduce_from_tp_region(rows, axis_name)
+
+
+def vocab_parallel_logits(x, emb_full, axis_name):
+    """The tied LM head under vocab parallelism: ``x (..., E)`` against
+    the row-sharded table gives VOCAB-SHARDED logits ``(..., V/n)`` —
+    the full ``(..., V)`` logits tensor (usually the largest activation
+    in an LM step) never materializes on any device.  Feed the result to
+    :func:`vocab_parallel_cross_entropy`.  ``x`` passes the f operator
+    (each device consumes it against a different weight block)."""
+    x = copy_to_tp_region(x, axis_name)
+    shard = _shard_rows(emb_full, axis_name)
+    return jnp.matmul(x, jnp.swapaxes(shard, 0, 1).astype(x.dtype))
+
+
+def vocab_parallel_cross_entropy(logits_shard, targets, axis_name,
+                                 reduction="mean"):
+    """Cross entropy over vocab-sharded logits (Megatron's parallel
+    cross-entropy): per-device max → pmax for stability, per-device
+    sum-exp and target-logit partials combined through g operators, so
+    the backward is exactly ``softmax_local - onehot_local`` on each
+    device with no full-vocab gather in either direction.
+
+    ``logits_shard (..., V/n)``, integer ``targets (...)`` GLOBAL ids.
+    """
+    v_loc = logits_shard.shape[-1]
+    off = lax.axis_index(axis_name) * v_loc
+    lf = logits_shard.astype(jnp.float32)
+    # global max, constant w.r.t. the grad (standard LSE stabilization);
+    # stop_gradient BEFORE the collective — pmax has no differentiation
+    # rule, so it must only ever see a non-tangent-carrying value
+    m = lax.pmax(lax.stop_gradient(jnp.max(lf, axis=-1)), axis_name)
+    sumexp = reduce_from_tp_region(
+        jnp.sum(jnp.exp(lf - m[..., None]), axis=-1), axis_name)
+    lse = jnp.log(sumexp) + m
+    local = targets - off
+    valid = (local >= 0) & (local < v_loc)
+    tl = jnp.take_along_axis(
+        lf, jnp.clip(local, 0, v_loc - 1)[..., None], axis=-1)[..., 0]
+    tl = reduce_from_tp_region(
+        jnp.where(valid, tl, jnp.zeros_like(tl)), axis_name)
+    losses = lse - tl
+    if reduction == "mean":
+        return jnp.mean(losses)
+    if reduction == "sum":
+        return jnp.sum(losses)
+    return losses
+
+
 def tp_attn_begin(axis_name, heads, is_training, dropout_prob,
                   inputs, row_weights, col_weights):
     """Shared TP entry protocol for the attention functionals
