@@ -1,1 +1,3 @@
-from .checkpoint import load_checkpoint, save_checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    load_checkpoint, restore_train_state, save_checkpoint,
+    save_train_state)

@@ -93,3 +93,47 @@ def test_arrays_come_back_as_host_numpy(tmp_path):
     assert isinstance(out["a"], np.ndarray)
     assert out["n"] == 7
     assert isinstance(out["nested"][0], np.ndarray)
+
+
+def _fused_step(zero=False):
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    nn.manual_seed(1)
+    m = nn.Sequential(nn.Linear(16, 64), nn.GELU(), nn.Linear(64, 8))
+    opt = FusedAdam(list(m.parameters()), lr=5e-3)
+    return make_train_step(m, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=jnp.bfloat16, loss_scale="dynamic",
+                           zero_sharding=zero)
+
+
+@pytest.mark.parametrize("zero", [False, True])
+def test_train_state_checkpoint_exact_resume(tmp_path, zero):
+    """save_train_state/restore_train_state (orbax): the fused step's
+    full device state round-trips and resume losses are bit-identical —
+    incl. the ZeRO case, where the sharded masters restore SHARDED (no
+    gather/re-scatter)."""
+    import jax
+    from apex_tpu.utils import restore_train_state, save_train_state
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 8, (64,)))
+
+    s1 = _fused_step(zero)
+    for _ in range(5):
+        s1(x, y)
+    path = str(tmp_path / "ckpt")
+    save_train_state(path, s1)
+    ref = [float(s1(x, y)) for _ in range(3)]
+
+    s2 = _fused_step(zero)
+    restore_train_state(path, s2)
+    if zero:
+        w0 = s2.state.master_params[0]
+        n = len(jax.devices())
+        assert w0.sharding.shard_shape(w0.shape)[0] == w0.shape[0] // n
+    got = [float(s2(x, y)) for _ in range(3)]
+    np.testing.assert_array_equal(got, ref)
