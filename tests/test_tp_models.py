@@ -215,6 +215,40 @@ def test_sp_x_tp_composition_matches_unsharded(rng):
                                np.asarray(ref_out), rtol=3e-4, atol=3e-4)
 
 
+def test_sp_x_tp_bert_ulysses_composition(rng):
+    """Ulysses-SP × TP on a (2, 2) mesh for the BERT encoder: TP slices
+    the head blocks first (2 local heads), then Ulysses scatters those
+    over the sp axis while gathering the sequence — output matches the
+    unsharded oracle."""
+    S_G = 16
+
+    def build(sp, tp):
+        nn.manual_seed(3)
+        return BertModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                         intermediate=64, max_positions=S_G, dropout=0.0,
+                         attn_dropout=0.0, sp_axis=sp, tp_axis=tp)
+
+    ids = jnp.asarray(rng.integers(0, V, (2, S_G)))
+    m_ref = build(None, None)
+    ref_out = m_ref(ids).value
+
+    m = build("sp", "tp")
+    params = list(m.parameters())
+    vals = [p.data for p in params]
+    mesh = Mesh(np.array(jax.devices())[:4].reshape(2, 2), ("sp", "tp"))
+
+    def fwd(vals, ids_l):
+        ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                  training=False)
+        return m.forward(ctx, ids_l)
+
+    out = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp", None), check_vma=False))(vals, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=3e-4, atol=3e-4)
+
+
 def test_tp_bert_forward_matches_unsharded(rng):
     """BERT encoder under 4-way TP with a padding mask: sequence output
     matches unsharded."""
