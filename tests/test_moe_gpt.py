@@ -147,22 +147,22 @@ def test_aux_loss_keeps_experts_balanced_on_mixture_task(rng):
     assert ent > 0.1, ent
 
 
-def _moe_gpt(**kw):
+def _moe_gpt(dropout=0.0, **kw):
     nn.manual_seed(5)
     return GptModel(vocab_size=V, hidden=H, layers=2, heads=HEADS,
-                    max_positions=32, dropout=0.0, attn_dropout=0.0,
+                    max_positions=32, dropout=dropout, attn_dropout=0.0,
                     moe_axis="data", moe_num_experts=4, **kw)
 
 
-def _run_moe_step(model, n_steps=15):
+def _run_moe_step(model, n_steps=15, half_dtype=None, loss_scale=1.0):
     opt = FusedAdam(list(model.parameters()), lr=1e-2)
 
     def lm_loss(logits, tgt):
         return F.cross_entropy(logits.reshape((-1, V)),
                                tgt.reshape((-1,)))
 
-    step = make_train_step(model, opt, lm_loss, half_dtype=None,
-                           loss_scale=1.0, axis_name="data")
+    step = make_train_step(model, opt, lm_loss, half_dtype=half_dtype,
+                           loss_scale=loss_scale, axis_name="data")
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, V, (8, S)))
     tgt = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1))
@@ -196,31 +196,10 @@ def test_moe_bf16_dynamic_scale_remat_dropout():
     """The harshest MoE composition: bf16 half copies + dynamic loss
     scaling + remat boundaries (aux crossing them) + residual dropout +
     top-2 routing, through the DP fused step — trains and converges."""
-    nn.manual_seed(5)
-    m = GptModel(vocab_size=V, hidden=H, layers=2, heads=HEADS,
-                 max_positions=32, dropout=0.1, attn_dropout=0.0,
-                 moe_axis="data", moe_num_experts=4, moe_top_k=2,
-                 remat=True)
-    opt = FusedAdam(list(m.parameters()), lr=1e-2)
-
-    def lm_loss(logits, tgt):
-        return F.cross_entropy(logits.reshape((-1, V)),
-                               tgt.reshape((-1,)))
-
-    step = make_train_step(m, opt, lm_loss, half_dtype=jnp.bfloat16,
-                           loss_scale="dynamic", axis_name="data")
-    rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, V, (8, S)))
-    tgt = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1))
-    mesh = _mesh(4, "data")
-    sharded = jax.jit(jax.shard_map(
-        step._step_fn, mesh=mesh,
-        in_specs=(P(), P("data"), P("data")),
-        out_specs=(P(), P()), check_vma=False))
-    state, l0 = sharded(step.state, ids, tgt)
-    for _ in range(12):
-        state, l = sharded(state, ids, tgt)
-    assert np.isfinite(float(l)) and float(l) < float(l0)
+    l0, l = _run_moe_step(
+        _moe_gpt(dropout=0.1, moe_top_k=2, remat=True), n_steps=12,
+        half_dtype=jnp.bfloat16, loss_scale="dynamic")
+    assert np.isfinite(l) and l < l0
 
 
 def test_moe_config_validation():
