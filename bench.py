@@ -764,6 +764,10 @@ def main():
     ap.add_argument("--remat", action="store_true",
                     help="with --gpt: rematerialize block activations "
                          "(long-sequence configs)")
+    ap.add_argument("--sweep", type=str, default=None,
+                    help="comma-separated batch list, e.g. 64,128,256: "
+                         "one JSON line per batch in one warm process "
+                         "(find the throughput/MFU sweet spot)")
     ap.add_argument("--plain-loss", action="store_true",
                     help="LM configs: plain log-softmax cross-entropy "
                          "instead of the fused lse-residual xentropy "
@@ -776,6 +780,24 @@ def main():
 
     start_watchdog(args.budget_s)
     log(f"start (watchdog {args.budget_s:.0f}s)")
+
+    # validate cheap config errors BEFORE spending the backend-init
+    # budget on the tunnel (and emit the promised diagnostic JSON line)
+    sweep_batches = None
+    if args.sweep:
+        if args.profile or args.kernels or args.kernels_timing \
+                or args.gpt_decode:
+            fail("sweep_unsupported_config: --sweep applies to the "
+                 "throughput configs (resnet/--gpt/--bert/--seq2seq)")
+            return 1
+        try:
+            sweep_batches = [int(b) for b in args.sweep.split(",")]
+            if not sweep_batches or min(sweep_batches) < 1:
+                raise ValueError(args.sweep)
+        except ValueError:
+            fail(f"sweep_parse_failed: --sweep must be a comma-separated "
+                 f"list of positive ints, got {args.sweep!r}")
+            return 1
 
     try:
         stage("backend_init")
@@ -843,6 +865,55 @@ def main():
               "kernels": None})
         return 0
 
+    def run_one(batch):
+        """One throughput measurement at ``batch`` for the selected
+        config.  Returns (dt, compile_s, flops, flops_source)."""
+        if args.bert:
+            return run_bert_throughput(batch, args.seq_len, args.iters,
+                                       args.warmup,
+                                       plain_loss=args.plain_loss)
+        if args.seq2seq:
+            return run_seq2seq_throughput(batch, args.seq_len, args.iters,
+                                          args.warmup,
+                                          plain_loss=args.plain_loss)
+        if args.gpt:
+            return run_gpt_throughput(batch, args.seq_len, args.iters,
+                                      args.warmup, remat=args.remat,
+                                      size=args.gpt_size,
+                                      plain_loss=args.plain_loss)
+        return run_throughput(batch, args.iters, args.warmup)
+
+    if args.sweep:
+        # batch sweep in ONE process (warm backend shared): one JSON line
+        # per batch, no kernel checks, no fallback — a failed batch
+        # reports and the sweep continues; exit 1 if NO point succeeds
+        cfg = ("bert" if args.bert else
+               f"gpt2_{args.gpt_size}" if args.gpt else
+               "seq2seq" if args.seq2seq else "resnet50")
+        peak, kind = peak_tflops(devices[0])
+        ok = 0
+        for batch in sweep_batches:
+            base = {"metric": f"{cfg}_batch_sweep_point",
+                    "unit": "items/sec/chip", "vs_baseline": None,
+                    "config": cfg, "seq_len": args.seq_len,
+                    "plain_loss": bool(args.plain_loss), "batch": batch}
+            try:
+                dt, compile_s, flops, flops_source = run_one(batch)
+            except Exception as e:
+                emit({**base, "value": None,
+                      "error": f"{type(e).__name__}: {e}"})
+                continue
+            ok += 1
+            tfl = flops / dt / 1e12
+            emit({**base, "value": round(batch / dt, 1),
+                  "step_time_ms": round(dt * 1e3, 2),
+                  "compile_s": round(compile_s, 1),
+                  "tflops": round(tfl, 2),
+                  "mfu": round(tfl / peak, 4) if peak else None,
+                  "device_kind": kind, "flops_source": flops_source,
+                  "kernels": None})
+        return 0 if ok else 1
+
     dt = compile_s = flops = None
     flops_source = "none"
     err = None
@@ -856,22 +927,7 @@ def main():
         if batch < 1:
             break
         try:
-            if args.bert:
-                dt, compile_s, flops, flops_source = run_bert_throughput(
-                    batch, args.seq_len, args.iters, args.warmup,
-                    plain_loss=args.plain_loss)
-            elif args.seq2seq:
-                dt, compile_s, flops, flops_source = run_seq2seq_throughput(
-                    batch, args.seq_len, args.iters, args.warmup,
-                    plain_loss=args.plain_loss)
-            elif args.gpt:
-                dt, compile_s, flops, flops_source = run_gpt_throughput(
-                    batch, args.seq_len, args.iters, args.warmup,
-                    remat=args.remat, size=args.gpt_size,
-                    plain_loss=args.plain_loss)
-            else:
-                dt, compile_s, flops, flops_source = run_throughput(
-                    batch, args.iters, args.warmup)
+            dt, compile_s, flops, flops_source = run_one(batch)
             break
         except Exception as e:
             err = e

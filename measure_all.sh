@@ -34,4 +34,6 @@ run --seq2seq
 run --kernels-timing                  # Pallas vs XLA A/B per shape
 run --profile                         # resnet per-op time attribution
 run --profile --gpt                   # gpt per-op time attribution
+run --sweep 96,128,192,256            # resnet batch/MFU sweet spot
+run --gpt --sweep 32,64,128           # gpt batch/MFU sweet spot
 echo "done; results in $LOG" >&2
