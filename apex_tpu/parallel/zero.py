@@ -15,6 +15,17 @@ collectives" recipe this framework uses everywhere.
 Per-device optimizer memory drops from O(P) to O(P / n_shards) for every
 tensor whose leading dim divides the axis size (others stay replicated).
 
+``param_shard=True`` is the stage-3 (FSDP-style) extension: the half
+model copies are annotated sharded as well, so no device ever holds a
+full persistent parameter copy — GSPMD all-gathers each parameter just
+ahead of its use in the forward/backward (XLA's latency-hiding
+scheduler overlaps the gathers with compute) and the freshly-updated
+master shards cast straight into half shards at the end of the step.
+Stage-2 (gradient sharding) has no separate switch because the fused
+step never holds a persistent gradient buffer: gradients are
+intermediates of the one jitted program, and with sharded masters the
+partitioner already reduce-scatters them into shards at the update.
+
 Usage::
 
     step = make_train_step(model, opt, loss_fn, half_dtype=jnp.bfloat16,
@@ -44,16 +55,20 @@ def _leaf_sharding(x, mesh, axis, n):
     return NamedSharding(mesh, P())
 
 
-def zero_state_sharding(state, mesh: Mesh, axis: str = "data"):
+def zero_state_sharding(state, mesh: Mesh, axis: str = "data",
+                        param_shard: bool = False):
     """A StepState-shaped pytree of ``NamedSharding``s: fp32 masters and
-    optimizer slots shard on dim 0 over ``axis`` where divisible, the half
-    model copies / buffers / scaler scalars replicate."""
+    optimizer slots shard on dim 0 over ``axis`` where divisible; the half
+    model copies replicate (stage 1) or shard the same way
+    (``param_shard=True``, stage 3); buffers / scaler scalars replicate."""
     n = mesh.shape[axis]
     rep = NamedSharding(mesh, P())
     return state._replace(
         master_params=[_leaf_sharding(m, mesh, axis, n)
                        for m in state.master_params],
-        model_params=[None if mp is None else rep
+        model_params=[None if mp is None
+                      else (_leaf_sharding(mp, mesh, axis, n)
+                            if param_shard else rep)
                       for mp in state.model_params],
         opt_state={k: [_leaf_sharding(s, mesh, axis, n) for s in v]
                    for k, v in state.opt_state.items()},
@@ -66,10 +81,12 @@ class ZeroTrainStep:
     """Wrap a :class:`~apex_tpu.training.TrainStep` built WITHOUT
     ``axis_name`` (and with ``donate_state=False`` — this wrapper owns
     donation): jits the step with ZeRO shardings over ``mesh``/``axis``
-    and keeps the sharded state."""
+    and keeps the sharded state.  ``param_shard=True`` additionally
+    shards the half model copies (stage 3 / FSDP: parameters are
+    all-gathered at use, never stored whole)."""
 
     def __init__(self, step, mesh: Mesh, axis: str = "data",
-                 donate: bool = True):
+                 donate: bool = True, param_shard: bool = False):
         raw = getattr(step, "_raw_step_fn", None)
         if raw is None:
             raise ValueError(
@@ -91,7 +108,9 @@ class ZeroTrainStep:
         self._base = step
         self.mesh = mesh
         self.axis = axis
-        self.shardings = zero_state_sharding(step.state, mesh, axis)
+        self.param_shard = param_shard
+        self.shardings = zero_state_sharding(step.state, mesh, axis,
+                                             param_shard)
         self.state = jax.device_put(step.state, self.shardings)
         self._rep = NamedSharding(mesh, P())
         self._jits = {}
@@ -134,12 +153,14 @@ class ZeroTrainStep:
         self._base.sync_to_objects()
 
     def shard_sizes(self):
-        """Per-device byte footprint of masters + optimizer slots
-        (diagnostic: the ZeRO memory win, ~1/n_shards of the replicated
-        footprint for shardable tensors)."""
+        """Per-device byte footprint of masters + optimizer slots + half
+        model copies (diagnostic: the ZeRO memory win — ~1/n_shards of
+        the replicated footprint for shardable tensors; the half copies
+        only shrink under ``param_shard=True``)."""
         total = 0
+        halves = [mp for mp in self.state.model_params if mp is not None]
         for leaf in jax.tree.leaves(
-                (self.state.master_params, self.state.opt_state)):
+                (self.state.master_params, self.state.opt_state, halves)):
             shard = leaf.sharding.shard_shape(leaf.shape)
             total += int(np.prod(shard)) * leaf.dtype.itemsize
         return total

@@ -351,7 +351,8 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     rng_seed: int = 0,
                     zero_sharding: bool = False,
                     zero_mesh=None,
-                    zero_axis: str = "data"):
+                    zero_axis: str = "data",
+                    zero_stage: int = 1):
     """Build a fully-fused O2-style train step.
 
     ``loss_fn(outputs..., *batch_tail) -> scalar``: called with the model
@@ -395,21 +396,32 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     ``axis_name`` for DP×TP meshes — batch sharded over ``axis_name``,
     replicated over ``tp_axis``.
 
-    ``zero_sharding=True``: ZeRO stage-1 — fp32 masters and optimizer
+    ``zero_sharding=True``: ZeRO sharding — fp32 masters and optimizer
     slots shard over ``zero_axis`` of ``zero_mesh`` (default: a 1-D mesh
-    over all devices), the bf16/fp32 model copies stay replicated, and
-    XLA's GSPMD partitioner derives the reduce-scatter (gradients into
-    master shards) / all-gather (updated masters back out) pair itself.
-    Returns a :class:`~apex_tpu.parallel.zero.ZeroTrainStep` (same
-    calling surface: ``step(x, y) -> loss``, ``.state``,
-    ``.sync_to_objects()``).  Data parallelism is implicit — the batch
-    shards over the axis in the global-view program — so ``axis_name``
-    must not also be given.  Stage-1 ONLY: gradients themselves and the
-    model copies are not sharded (stage-2/3 are out of scope; the
-    per-device win is optimizer memory, ~1/n for every tensor whose
-    leading dim divides the axis).
+    over all devices) and XLA's GSPMD partitioner derives the
+    reduce-scatter (gradients into master shards) / all-gather (updated
+    masters back out) pair itself.  Returns a
+    :class:`~apex_tpu.parallel.zero.ZeroTrainStep` (same calling
+    surface: ``step(x, y) -> loss``, ``.state``, ``.sync_to_objects()``).
+    Data parallelism is implicit — the batch shards over the axis in the
+    global-view program — so ``axis_name`` must not also be given.
+    ``zero_stage`` picks the scope: 1 (default) keeps the half model
+    copies replicated (the win is optimizer+master memory, ~1/n per
+    shardable tensor); 3 shards the half copies too (FSDP-style: each
+    parameter is all-gathered just ahead of use and never stored whole —
+    activation-sized gather traffic traded for O(P/n) parameter
+    residency).  There is no stage 2 switch: the fused step holds no
+    persistent gradient buffer — gradients are intermediates of the one
+    jitted program and already land reduce-scattered into master shards.
     """
     if zero_sharding:
+        if zero_stage not in (1, 3):
+            raise ValueError(
+                f"zero_stage must be 1 (optimizer-state sharding) or 3 "
+                f"(+ parameter sharding); got {zero_stage!r}.  Stage 2 "
+                f"has no separate switch: the fused step never holds a "
+                f"persistent gradient buffer, so sharded masters already "
+                f"imply reduce-scattered gradients")
         if axis_name is not None or tp_axis is not None:
             raise ValueError(
                 "zero_sharding=True excludes axis_name/tp_axis — ZeRO "
@@ -435,7 +447,8 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 f"zero_axis {zero_axis!r} is not an axis of zero_mesh "
                 f"(axes: {tuple(zero_mesh.shape)})")
         return ZeroTrainStep(base, zero_mesh, zero_axis,
-                             donate=donate_state)
+                             donate=donate_state,
+                             param_shard=(zero_stage == 3))
     params = [p for p in model.parameters() if p is not None]
     buffers = [b for b in model.buffers()]
     group_idxs = match_param_groups(optimizer, params)

@@ -1,0 +1,79 @@
+#!/bin/bash
+# Remaining measurement matrix after the first healthy-tunnel window of
+# round 3 (which captured resnet/bert/gpt-128 before the gpt seq-1024
+# warmup hang re-wedged the tunnel).  Ordered low-risk-first so a single
+# wedge cannot block the whole matrix; the risky long-sequence configs
+# run LAST, with an automatic A/B bisect (threefry dropout / plain loss)
+# if seq-1024 hangs again, to identify which round-3 change (if any) is
+# responsible vs. plain tunnel flakiness.
+set -u
+LOG="${MEASURE_LOG:-measurements.jsonl}"
+cd "$(dirname "$0")"
+
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((64, 64)); print('probe ok:', float(jnp.sum(x @ x)))
+" 2>/dev/null
+}
+
+if ! probe; then
+  echo "tunnel not healthy; aborting" >&2
+  exit 1
+fi
+
+run() {
+  echo "=== $* ===" >&2
+  timeout 700 env "${ENVV[@]:-IGNORE=1}" python bench.py "$@" \
+    2>>"$LOG.err" | tee -a "$LOG"
+}
+
+# value (not null) present in the LAST line of the log?
+last_ok() {
+  tail -1 "$LOG" | grep -q '"value": [0-9]'
+}
+
+ENVV=()
+run --gpt-decode
+probe || exit 1
+run --seq2seq
+probe || exit 1
+run --kernels-timing
+probe || exit 1
+run --profile
+probe || exit 1
+run --profile --gpt
+probe || exit 1
+run --sweep 96,128,192,256
+probe || exit 1
+run --gpt --sweep 32,64,128
+probe || exit 1
+
+# ---- risky: long-sequence configs ----
+run 16 --gpt --seq-len 1024
+if last_ok; then
+  probe || exit 1
+  run 8 --gpt --seq-len 2048 --remat
+  echo "done (full)" >&2
+  exit 0
+fi
+
+# seq-1024 failed: bisect.  Each variant needs a healthy tunnel first.
+echo "seq-1024 failed; bisecting (waiting for tunnel between variants)" >&2
+wait_healthy() {
+  local n=0
+  until probe; do
+    n=$((n + 1)); [ "$n" -gt 60 ] && return 1   # give up after ~5h
+    sleep 240
+  done
+}
+
+wait_healthy || exit 1
+ENVV=(APEX_TPU_DROPOUT_IMPL=threefry)
+run 16 --gpt --seq-len 1024          # variant A: threefry dropout
+ENVV=()
+last_a=$(tail -1 "$LOG")
+
+wait_healthy || exit 1
+run 16 --gpt --seq-len 1024 --plain-loss   # variant B: plain loss path
+echo "bisect done: threefry=[$last_a] plain-loss=[$(tail -1 "$LOG")]" >&2

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Poll the axon tunnel; exit 0 the moment a 64x64 matmul fetch succeeds.
+# One probe every ~5 min (each failed probe holds a client for <=75s).
+while true; do
+  if timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((64, 64)); print('probe ok:', float(jnp.sum(x @ x)))
+" 2>/dev/null; then
+    date -u +"tunnel healthy at %H:%M:%S UTC"
+    exit 0
+  fi
+  date -u +"probe failed at %H:%M:%S UTC; sleeping 240s"
+  sleep 240
+done
