@@ -27,15 +27,23 @@ class GptBlock(nn.Module):
     residual."""
 
     def __init__(self, hidden, heads, intermediate, dropout=0.1,
-                 attn_dropout=0.1, sp_axis=None, tp_axis=None):
+                 attn_dropout=0.1, sp_axis=None, tp_axis=None,
+                 attn_bias=False):
         super().__init__()
         self.ln1 = FusedLayerNorm(hidden)
         # causal=True: when the flash path applies (attn_dropout == 0 in
         # training, or eval) the kernel masks the triangle in-kernel with
         # no O(S^2) mask operand; with attention dropout active the
-        # materializing fallback runs (the Pallas kernel has no dropout)
+        # materializing fallback runs (the Pallas kernel has no dropout).
+        # attn_bias=True (GPT-2 checkpoints carry QKV/out-proj biases)
+        # selects the reference's 'default' impl, which is the one that
+        # supports biases (reference contrib/multihead_attn/
+        # self_multihead_attn.py fast-impl assert) — the materializing
+        # attention path, priced in docs/models.md
         self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
-                                      impl="fast", causal=True,
+                                      bias=attn_bias,
+                                      impl="default" if attn_bias
+                                      else "fast", causal=True,
                                       seq_parallel_axis=sp_axis,
                                       tensor_parallel_axis=tp_axis)
         self.ln2 = FusedLayerNorm(hidden)
@@ -209,9 +217,17 @@ class GptModel(nn.Module):
                  attn_dropout=0.1, remat=False, sp_axis=None, tp_axis=None,
                  tp_vocab=False, moe_axis=None, moe_num_experts=None,
                  moe_every=2, moe_capacity_factor=1.25, moe_top_k=1,
-                 moe_aux_weight=0.01):
+                 moe_aux_weight=0.01, attn_bias=False):
         super().__init__()
         intermediate = intermediate or 4 * hidden
+        # attn_bias: QKV/out-proj biases on every block's attention (what
+        # GPT-2 checkpoints carry — models/hf.py loads into this config);
+        # selects the bias-capable 'default' attention impl per block
+        if attn_bias and moe_axis is not None:
+            raise ValueError(
+                "attn_bias is not supported with moe_axis (MoE blocks "
+                "are this framework's own architecture; imported "
+                "checkpoints are dense)")
         self.hidden = hidden
         self.max_positions = max_positions
         # moe_axis: Switch-MoE — every ``moe_every``-th block (Switch's
@@ -283,7 +299,8 @@ class GptModel(nn.Module):
                     capacity_factor=moe_capacity_factor,
                     top_k=moe_top_k, aux_weight=moe_aux_weight)
             return GptBlock(hidden, heads, intermediate, dropout,
-                            attn_dropout, sp_axis=sp_axis, tp_axis=tp_axis)
+                            attn_dropout, sp_axis=sp_axis, tp_axis=tp_axis,
+                            attn_bias=attn_bias)
 
         self.blocks = nn.ModuleList([_block(i) for i in range(layers)])
         self.ln_f = FusedLayerNorm(hidden)

@@ -1,0 +1,146 @@
+"""HuggingFace checkpoint interop: load ``transformers`` GPT-2 weights
+into the :mod:`apex_tpu.models.gpt` family.
+
+The reference repo has no model zoo of its own — its users bring
+torch models (BERT/GPT scripts) and apply the fused pieces.  The
+equivalent migration story here is loading the checkpoints those users
+already have.  ``gpt2_from_hf`` accepts a ``transformers``
+``GPT2LMHeadModel`` (or its ``state_dict()``) and returns a
+:class:`~apex_tpu.models.gpt.GptModel` with identical logits.
+
+Layout notes (why the permutations below exist):
+
+* HF GPT-2 linears are ``Conv1D``: weight ``(in, out)``, ``y = x W + b``
+  — transposed relative to this framework's torch-layout
+  ``Linear.weight (out, in)``.
+* HF packs QKV type-major: ``c_attn`` columns are ``[Q(E) | K(E) | V(E)]``
+  with head-major features inside each.  The attention module here uses
+  the reference's INTERLEAVED head-major layout — rows grouped
+  ``[q_h | k_h | v_h]`` per head (contrib/multihead_attn/
+  attn_funcs._split_interleaved_qkv; reference
+  self_multihead_attn_func.py:35-38) — so the loaded tensor is
+  ``W.T`` reshaped ``(3, H, D, E)`` → transposed to ``(H, 3, D, E)``.
+* GPT-2 architecture facts that already match this family 1:1: pre-LN
+  blocks, learned positions, tanh-approximate GELU (``gelu_new`` ==
+  ``jax.nn.gelu(approximate=True)``), LayerNorm eps 1e-5, weight-tied
+  LM head.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _to_numpy(t):
+    """torch tensor / numpy array -> float32 numpy (no torch import
+    required unless the value is a torch tensor)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, np.float32)
+
+
+def _interleave_qkv(w_t, heads, head_dim):
+    """HF type-major ``(3E, E)`` (already transposed from Conv1D) ->
+    reference interleaved head-major ``(3E, E)``."""
+    e = heads * head_dim
+    return w_t.reshape(3, heads, head_dim, e).transpose(1, 0, 2, 3) \
+              .reshape(3 * e, e)
+
+
+def _interleave_qkv_bias(b, heads, head_dim):
+    return b.reshape(3, heads, head_dim).transpose(1, 0, 2).reshape(-1)
+
+
+def gpt2_from_hf(src, dropout=0.1, attn_dropout=0.0, **model_kw):
+    """Build a :class:`GptModel` carrying the weights of an HF GPT-2.
+
+    ``src``: a ``transformers.GPT2LMHeadModel`` (or any module whose
+    ``state_dict()`` matches it), or a ready state-dict mapping.  Keys
+    may carry the ``transformer.`` prefix or not.  Geometry (vocab,
+    hidden, layers, heads, max positions) is inferred from the tensors.
+    Dropout probabilities are training-time knobs, not weights — they
+    default to GPT-2's 0.1 residual/embedding dropout with attention
+    dropout OFF (attention biases already force the materializing
+    attention path; see ``attn_bias`` in models/gpt.py).
+
+    Returns the model in ``eval()`` mode; call ``.train()`` to
+    fine-tune.
+    """
+    from .gpt import GptModel
+
+    sd = src.state_dict() if hasattr(src, "state_dict") else dict(src)
+    # normalize: strip "transformer.", drop the causal-mask buffers
+    # ("attn.bias" is HF's triangle constant, not a parameter); hold the
+    # head weight aside for the tie check below
+    norm, lm_head = {}, None
+    for k, v in sd.items():
+        if k.startswith("transformer."):
+            k = k[len("transformer."):]
+        if k == "lm_head.weight":
+            lm_head = _to_numpy(v)
+            continue
+        if k.endswith(".attn.bias") or k.endswith(".attn.masked_bias"):
+            continue
+        norm[k] = _to_numpy(v)
+
+    wte = norm["wte.weight"]
+    wpe = norm["wpe.weight"]
+    if lm_head is not None and (lm_head.shape != wte.shape
+                                or not np.array_equal(lm_head, wte)):
+        # this family's head is weight-tied (as GPT-2's is); silently
+        # dropping a genuinely untied head would change every logit
+        raise ValueError(
+            "checkpoint's lm_head.weight is not tied to wte.weight — "
+            "this GPT family has a weight-tied head and cannot represent "
+            "an untied checkpoint")
+    vocab, hidden = wte.shape
+    layers = 1 + max(int(k.split(".")[1]) for k in norm if k.startswith("h."))
+    inter = norm["h.0.mlp.c_fc.weight"].shape[1]
+    # head count is not recoverable from the tensors alone: read it from
+    # the module's config when given one, else accept an override, else
+    # GPT-2's hidden/64 rule (all published GPT-2 sizes use head_dim 64)
+    heads = model_kw.pop("heads", None)
+    if heads is None:
+        heads = getattr(getattr(src, "config", None), "n_head", None)
+    if heads is None:
+        heads = hidden // 64
+    head_dim = hidden // heads
+
+    model = GptModel(vocab_size=vocab, hidden=hidden, layers=layers,
+                     heads=heads, intermediate=inter,
+                     max_positions=wpe.shape[0], dropout=dropout,
+                     attn_dropout=attn_dropout, attn_bias=True,
+                     **model_kw)
+
+    def put(param, value):
+        value = np.asarray(value, np.float32)
+        if tuple(param.data.shape) != value.shape:
+            raise ValueError(
+                f"shape mismatch loading HF weights: model "
+                f"{tuple(param.data.shape)} vs checkpoint {value.shape}")
+        param.data = jnp.asarray(value)
+
+    put(model.tok_emb.weight, wte)
+    put(model.pos_emb.weight, wpe)
+    put(model.ln_f.weight, norm["ln_f.weight"])
+    put(model.ln_f.bias, norm["ln_f.bias"])
+    for i, blk in enumerate(model.blocks):
+        p = f"h.{i}."
+        put(blk.ln1.weight, norm[p + "ln_1.weight"])
+        put(blk.ln1.bias, norm[p + "ln_1.bias"])
+        put(blk.ln2.weight, norm[p + "ln_2.weight"])
+        put(blk.ln2.bias, norm[p + "ln_2.bias"])
+        put(blk.attn.in_proj_weight,
+            _interleave_qkv(norm[p + "attn.c_attn.weight"].T, heads,
+                            head_dim))
+        put(blk.attn.in_proj_bias,
+            _interleave_qkv_bias(norm[p + "attn.c_attn.bias"], heads,
+                                 head_dim))
+        put(blk.attn.out_proj_weight, norm[p + "attn.c_proj.weight"].T)
+        put(blk.attn.out_proj_bias, norm[p + "attn.c_proj.bias"])
+        put(blk.fc1.weight, norm[p + "mlp.c_fc.weight"].T)
+        put(blk.fc1.bias, norm[p + "mlp.c_fc.bias"])
+        put(blk.fc2.weight, norm[p + "mlp.c_proj.weight"].T)
+        put(blk.fc2.bias, norm[p + "mlp.c_proj.bias"])
+    model.eval()
+    return model

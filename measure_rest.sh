@@ -10,14 +10,7 @@ set -u
 LOG="${MEASURE_LOG:-measurements.jsonl}"
 cd "$(dirname "$0")"
 
-probe() {
-  timeout 75 python -c "
-import jax, jax.numpy as jnp
-x = jnp.ones((64, 64)); print('probe ok:', float(jnp.sum(x @ x)))
-" 2>/dev/null
-}
-
-if ! probe; then
+if ! ./probe_tunnel.sh; then
   echo "tunnel not healthy; aborting" >&2
   exit 1
 fi
@@ -28,52 +21,53 @@ run() {
     2>>"$LOG.err" | tee -a "$LOG"
 }
 
-# value (not null) present in the LAST line of the log?
-last_ok() {
-  tail -1 "$LOG" | grep -q '"value": [0-9]'
+# Did the MOST RECENT run() emit a fresh non-null JSON line?  A hung run
+# is killed before it writes anything, so judging by the log's last line
+# alone would credit it with the PREVIOUS config's success — count lines
+# before/after instead.
+lines() { [ -f "$LOG" ] && wc -l < "$LOG" || echo 0; }
+run_ok() {  # usage: pre=$(lines); run ...; run_ok "$pre"
+  [ "$(lines)" -gt "$1" ] && tail -1 "$LOG" | grep -q '"value": [0-9]'
 }
 
 ENVV=()
 run --gpt-decode
-probe || exit 1
+./probe_tunnel.sh || exit 1
 run --seq2seq
-probe || exit 1
+./probe_tunnel.sh || exit 1
 run --kernels-timing
-probe || exit 1
+./probe_tunnel.sh || exit 1
 run --profile
-probe || exit 1
+./probe_tunnel.sh || exit 1
 run --profile --gpt
-probe || exit 1
+./probe_tunnel.sh || exit 1
 run --sweep 96,128,192,256
-probe || exit 1
+./probe_tunnel.sh || exit 1
 run --gpt --sweep 32,64,128
-probe || exit 1
+./probe_tunnel.sh || exit 1
 
 # ---- risky: long-sequence configs ----
+pre=$(lines)
 run 16 --gpt --seq-len 1024
-if last_ok; then
-  probe || exit 1
+if run_ok "$pre"; then
+  ./probe_tunnel.sh || exit 1
   run 8 --gpt --seq-len 2048 --remat
   echo "done (full)" >&2
   exit 0
 fi
 
-# seq-1024 failed: bisect.  Each variant needs a healthy tunnel first.
+# seq-1024 failed: bisect.  Each variant needs a healthy tunnel first
+# (wait up to ~4h per variant — wedges have lasted hours).
 echo "seq-1024 failed; bisecting (waiting for tunnel between variants)" >&2
-wait_healthy() {
-  local n=0
-  until probe; do
-    n=$((n + 1)); [ "$n" -gt 60 ] && return 1   # give up after ~5h
-    sleep 240
-  done
-}
-
-wait_healthy || exit 1
+./probe_tunnel.sh -w 60 || exit 1
 ENVV=(APEX_TPU_DROPOUT_IMPL=threefry)
+pre=$(lines)
 run 16 --gpt --seq-len 1024          # variant A: threefry dropout
+a_ok=$(run_ok "$pre" && echo yes || echo no)
 ENVV=()
-last_a=$(tail -1 "$LOG")
 
-wait_healthy || exit 1
+./probe_tunnel.sh -w 60 || exit 1
+pre=$(lines)
 run 16 --gpt --seq-len 1024 --plain-loss   # variant B: plain loss path
-echo "bisect done: threefry=[$last_a] plain-loss=[$(tail -1 "$LOG")]" >&2
+b_ok=$(run_ok "$pre" && echo yes || echo no)
+echo "bisect done: threefry_ok=$a_ok plain_loss_ok=$b_ok" >&2

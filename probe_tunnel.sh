@@ -1,14 +1,33 @@
 #!/bin/bash
-# Poll the axon tunnel; exit 0 the moment a 64x64 matmul fetch succeeds.
-# One probe every ~5 min (each failed probe holds a client for <=75s).
-while true; do
-  if timeout 75 python -c "
+# Tunnel health for the axon TPU: a 64x64 matmul value fetch (the
+# round-2/3 wedge signature is this fetch hanging).  THE one probe
+# implementation — bench orchestration scripts call this rather than
+# carrying their own copies.
+#
+#   probe_tunnel.sh          probe once; exit 0 healthy / 1 wedged
+#   probe_tunnel.sh -w [N]   poll every ~4 min until healthy (exit 0)
+#                            or N attempts exhausted (exit 1; default
+#                            unlimited)
+probe_once() {
+  timeout 75 python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((64, 64)); print('probe ok:', float(jnp.sum(x @ x)))
-" 2>/dev/null; then
+" 2>/dev/null
+}
+
+if [ "${1:-}" != "-w" ]; then
+  probe_once
+  exit $?
+fi
+
+max="${2:-0}"; n=0
+while true; do
+  if probe_once; then
     date -u +"tunnel healthy at %H:%M:%S UTC"
     exit 0
   fi
+  n=$((n + 1))
+  [ "$max" -gt 0 ] && [ "$n" -ge "$max" ] && exit 1
   date -u +"probe failed at %H:%M:%S UTC; sleeping 240s"
   sleep 240
 done

@@ -1,0 +1,157 @@
+"""HuggingFace GPT-2 checkpoint interop (models/hf.py): logit parity
+of the converted GptModel against transformers' own torch forward on a
+randomly-initialized (no-download) GPT2LMHeadModel — proving a user's
+existing GPT-2 checkpoint produces identical predictions here."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp
+
+from apex_tpu.models import gpt2_from_hf
+from apex_tpu.models.hf import _interleave_qkv, _interleave_qkv_bias
+
+
+VOCAB, HIDDEN, LAYERS, HEADS, POS = 97, 64, 2, 4, 32
+
+
+def _hf_model(seed=0):
+    cfg = transformers.GPT2Config(
+        vocab_size=VOCAB, n_embd=HIDDEN, n_layer=LAYERS, n_head=HEADS,
+        n_positions=POS, activation_function="gelu_new",
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(seed)
+    m = transformers.GPT2LMHeadModel(cfg)
+    m.eval()
+    return m
+
+
+def _ids(rng, b=3, s=17):
+    return rng.integers(0, VOCAB, (b, s))
+
+
+def test_gpt2_logit_parity(rng):
+    hf = _hf_model()
+    ids = _ids(rng)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+
+    model = gpt2_from_hf(hf)
+    got = np.asarray(model(jnp.asarray(ids)).value)
+    # fp32 end-to-end; differences are pure op-order noise
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_from_state_dict_numpy(rng):
+    """Conversion accepts a plain state-dict (incl. numpy values and the
+    lm_head/causal-mask buffers HF serializes), not just a live module."""
+    hf = _hf_model(seed=1)
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    ids = _ids(rng, b=2, s=9)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+    # a bare dict carries no config: nonstandard head_dim (16 here, not
+    # GPT-2's 64) must be stated by the caller
+    model = gpt2_from_hf(sd, heads=HEADS)
+    got = np.asarray(model(jnp.asarray(ids)).value)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_geometry_inferred():
+    model = gpt2_from_hf(_hf_model())
+    assert model.hidden == HIDDEN
+    assert model.max_positions == POS
+    assert len(model.blocks) == LAYERS
+    assert model.blocks[0].attn.num_heads == HEADS
+    assert model.tok_emb.weight.data.shape == (VOCAB, HIDDEN)
+    # eval mode by default (imported checkpoints serve before they train)
+    assert not model.training
+
+
+def test_gpt2_converted_decodes(rng):
+    """The KV-cache decode path reproduces the converted model's full
+    forward — biases included (the interop config exercises exactly the
+    biased-attention decode the advisor flagged in round 2)."""
+    import jax
+    from apex_tpu.nn.modules import Ctx
+
+    model = gpt2_from_hf(_hf_model())
+    ids = jnp.asarray(_ids(rng, b=2, s=11))
+    full = np.asarray(model(ids).value)
+
+    params = list(model.parameters())
+    ctx = Ctx(env={id(p): p.data for p in params}, training=False)
+    caches = model.init_caches(2, 11)
+    got = []
+    for t in range(11):
+        logits, caches = model.decode_step(ctx, ids[:, t], caches,
+                                           jnp.asarray(t))
+        got.append(np.asarray(logits))
+    np.testing.assert_allclose(np.stack(got, axis=1), full,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_interleave_roundtrip():
+    """The QKV permutation maps HF's type-major packing onto the
+    reference interleaved layout exactly (spot-check one head/type)."""
+    heads, d = 4, 8
+    e = heads * d
+    rng = np.random.default_rng(0)
+    w_t = rng.standard_normal((3 * e, e)).astype(np.float32)  # [Q|K|V] rows
+    out = _interleave_qkv(w_t, heads, d)
+    # head h, type k (0=q), feature f lives at HF row k*e + h*d + f
+    for h in (0, 3):
+        for k in (0, 2):
+            np.testing.assert_array_equal(
+                out[h * 3 * d + k * d: h * 3 * d + (k + 1) * d],
+                w_t[k * e + h * d: k * e + h * d + d])
+    b = rng.standard_normal((3 * e,)).astype(np.float32)
+    ob = _interleave_qkv_bias(b, heads, d)
+    assert ob[0 * 3 * d + 1 * d] == b[1 * e + 0]  # head0, k-bias, feat0
+
+
+def test_shape_mismatch_raises():
+    hf = _hf_model()
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    sd["transformer.ln_f.weight"] = np.ones((HIDDEN + 1,), np.float32)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        gpt2_from_hf(sd)
+
+
+def test_converted_model_trains(rng):
+    """Fine-tuning the imported model under the fused step: loss on a
+    fixed batch decreases (biased default-impl attention through
+    make_train_step)."""
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    model = gpt2_from_hf(_hf_model(), dropout=0.0)
+    model.train()
+    opt = FusedAdam(list(model.parameters()), lr=1e-4)
+
+    def lm_loss(logits, ids):
+        flat = logits[:, :-1].reshape((-1, VOCAB))
+        tgt = ids[:, 1:].reshape((-1,))
+        return jnp.mean(F.cross_entropy(flat, tgt))
+
+    step = make_train_step(model, opt, lm_loss, half_dtype=None,
+                           loss_scale=1.0)
+    ids = jnp.asarray(_ids(rng, b=4, s=16))
+    l0 = float(step(ids, ids))
+    for _ in range(10):
+        l = float(step(ids, ids))
+    assert np.isfinite(l) and l < l0
+
+
+def test_untied_head_rejected():
+    """A checkpoint whose lm_head is genuinely untied from wte cannot be
+    represented by the weight-tied family — it must refuse, not silently
+    emit different logits."""
+    hf = _hf_model()
+    sd = {k: v.numpy().copy() for k, v in hf.state_dict().items()}
+    sd["lm_head.weight"] = sd["lm_head.weight"] + 1.0
+    with pytest.raises(ValueError, match="not tied"):
+        gpt2_from_hf(sd, heads=HEADS)
