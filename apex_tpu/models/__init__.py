@@ -4,7 +4,9 @@ from .bert import (BertForMaskedLM, BertLayer, BertModel, bert_base,
                    bert_large)  # noqa: F401
 from .gpt import (  # noqa: F401
     GptBlock, GptModel, generate, gpt2_small, gpt2_medium)
-from .hf import gpt2_from_hf  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaBlock, LlamaModel, llama_tiny)
+from .hf import gpt2_from_hf, llama_from_hf  # noqa: F401
 from .seq2seq import (  # noqa: F401
     Seq2SeqDecoderLayer, TransformerSeq2Seq, seq2seq_generate,
     transformer_seq2seq)

@@ -1,12 +1,16 @@
 """HuggingFace checkpoint interop: load ``transformers`` GPT-2 weights
-into the :mod:`apex_tpu.models.gpt` family.
+into the :mod:`apex_tpu.models.gpt` family and Llama/Mistral weights
+into :mod:`apex_tpu.models.llama`.
 
 The reference repo has no model zoo of its own — its users bring
 torch models (BERT/GPT scripts) and apply the fused pieces.  The
 equivalent migration story here is loading the checkpoints those users
 already have.  ``gpt2_from_hf`` accepts a ``transformers``
 ``GPT2LMHeadModel`` (or its ``state_dict()``) and returns a
-:class:`~apex_tpu.models.gpt.GptModel` with identical logits.
+:class:`~apex_tpu.models.gpt.GptModel` with identical logits;
+``llama_from_hf`` does the same for ``LlamaForCausalLM``-shaped
+checkpoints (Llama, Mistral, and friends — anything with RoPE +
+RMSNorm + SwiGLU + optional GQA).
 
 Layout notes (why the permutations below exist):
 
@@ -49,6 +53,16 @@ def _interleave_qkv(w_t, heads, head_dim):
 
 def _interleave_qkv_bias(b, heads, head_dim):
     return b.reshape(3, heads, head_dim).transpose(1, 0, 2).reshape(-1)
+
+
+def _put(param, value):
+    """Load a checkpoint tensor into a Parameter, shape-checked."""
+    value = np.asarray(value, np.float32)
+    if tuple(param.data.shape) != value.shape:
+        raise ValueError(
+            f"shape mismatch loading HF weights: model "
+            f"{tuple(param.data.shape)} vs checkpoint {value.shape}")
+    param.data = jnp.asarray(value)
 
 
 def gpt2_from_hf(src, dropout=0.1, attn_dropout=0.0, **model_kw):
@@ -112,35 +126,112 @@ def gpt2_from_hf(src, dropout=0.1, attn_dropout=0.0, **model_kw):
                      attn_dropout=attn_dropout, attn_bias=True,
                      **model_kw)
 
-    def put(param, value):
-        value = np.asarray(value, np.float32)
-        if tuple(param.data.shape) != value.shape:
-            raise ValueError(
-                f"shape mismatch loading HF weights: model "
-                f"{tuple(param.data.shape)} vs checkpoint {value.shape}")
-        param.data = jnp.asarray(value)
-
-    put(model.tok_emb.weight, wte)
-    put(model.pos_emb.weight, wpe)
-    put(model.ln_f.weight, norm["ln_f.weight"])
-    put(model.ln_f.bias, norm["ln_f.bias"])
+    _put(model.tok_emb.weight, wte)
+    _put(model.pos_emb.weight, wpe)
+    _put(model.ln_f.weight, norm["ln_f.weight"])
+    _put(model.ln_f.bias, norm["ln_f.bias"])
     for i, blk in enumerate(model.blocks):
         p = f"h.{i}."
-        put(blk.ln1.weight, norm[p + "ln_1.weight"])
-        put(blk.ln1.bias, norm[p + "ln_1.bias"])
-        put(blk.ln2.weight, norm[p + "ln_2.weight"])
-        put(blk.ln2.bias, norm[p + "ln_2.bias"])
-        put(blk.attn.in_proj_weight,
+        _put(blk.ln1.weight, norm[p + "ln_1.weight"])
+        _put(blk.ln1.bias, norm[p + "ln_1.bias"])
+        _put(blk.ln2.weight, norm[p + "ln_2.weight"])
+        _put(blk.ln2.bias, norm[p + "ln_2.bias"])
+        _put(blk.attn.in_proj_weight,
             _interleave_qkv(norm[p + "attn.c_attn.weight"].T, heads,
                             head_dim))
-        put(blk.attn.in_proj_bias,
+        _put(blk.attn.in_proj_bias,
             _interleave_qkv_bias(norm[p + "attn.c_attn.bias"], heads,
                                  head_dim))
-        put(blk.attn.out_proj_weight, norm[p + "attn.c_proj.weight"].T)
-        put(blk.attn.out_proj_bias, norm[p + "attn.c_proj.bias"])
-        put(blk.fc1.weight, norm[p + "mlp.c_fc.weight"].T)
-        put(blk.fc1.bias, norm[p + "mlp.c_fc.bias"])
-        put(blk.fc2.weight, norm[p + "mlp.c_proj.weight"].T)
-        put(blk.fc2.bias, norm[p + "mlp.c_proj.bias"])
+        _put(blk.attn.out_proj_weight, norm[p + "attn.c_proj.weight"].T)
+        _put(blk.attn.out_proj_bias, norm[p + "attn.c_proj.bias"])
+        _put(blk.fc1.weight, norm[p + "mlp.c_fc.weight"].T)
+        _put(blk.fc1.bias, norm[p + "mlp.c_fc.bias"])
+        _put(blk.fc2.weight, norm[p + "mlp.c_proj.weight"].T)
+        _put(blk.fc2.bias, norm[p + "mlp.c_proj.bias"])
+    model.eval()
+    return model
+
+
+def llama_from_hf(src, **model_kw):
+    """Build a :class:`~apex_tpu.models.llama.LlamaModel` carrying the
+    weights of an HF ``LlamaForCausalLM`` / ``MistralForCausalLM``.
+
+    ``src``: the transformers module (geometry read from ``.config``) or
+    a bare state-dict — head counts are not recoverable from the tensors
+    then, so pass ``heads=`` (and ``kv_heads=`` if grouped) along with
+    any of ``rope_theta``/``eps``/``max_positions`` that differ from the
+    Llama defaults.  All linears are plain ``nn.Linear`` (out, in) on
+    both sides — no transposition, unlike GPT-2's Conv1D.  A tied
+    checkpoint (``tie_word_embeddings``, no ``lm_head.weight`` in the
+    dict) loads the embedding into the (untied here) head, which is
+    exactly the tied forward.
+    """
+    from .llama import LlamaModel
+
+    sd = src.state_dict() if hasattr(src, "state_dict") else dict(src)
+    norm = {}
+    for k, v in sd.items():
+        if k.startswith("model."):
+            k = k[len("model."):]
+        if k.endswith("rotary_emb.inv_freq"):
+            continue
+        norm[k] = _to_numpy(v)
+
+    emb = norm["embed_tokens.weight"]
+    vocab, hidden = emb.shape
+    layers = 1 + max(int(k.split(".")[1]) for k in norm
+                     if k.startswith("layers."))
+    inter = norm["layers.0.mlp.gate_proj.weight"].shape[0]
+
+    cfg = getattr(src, "config", None)
+    heads = model_kw.pop("heads", None) \
+        or getattr(cfg, "num_attention_heads", None)
+    if heads is None:
+        raise ValueError(
+            "head count is not recoverable from a bare state dict — "
+            "pass heads= (and kv_heads= for GQA checkpoints)")
+    # head_dim IS recoverable from the tensors: q_proj has heads*head_dim
+    # rows (decoupled from hidden/heads in e.g. Mistral-Nemo)
+    q_rows = norm["layers.0.self_attn.q_proj.weight"].shape[0]
+    if q_rows % heads:
+        raise ValueError(
+            f"q_proj rows {q_rows} are not divisible by heads={heads} — "
+            f"wrong heads=?")
+    head_dim = q_rows // heads
+    kv_rows = norm["layers.0.self_attn.k_proj.weight"].shape[0]
+    kv_heads = model_kw.pop("kv_heads", None) or kv_rows // head_dim
+    if kv_heads * head_dim != kv_rows:
+        raise ValueError(
+            f"k_proj rows {kv_rows} are not kv_heads*head_dim with "
+            f"heads={heads} (head_dim {head_dim}) — wrong heads=?")
+
+    def dflt(key, attr, fallback):
+        v = model_kw.pop(key, None)
+        if v is None:
+            v = getattr(cfg, attr, None)
+        return fallback if v is None else v
+
+    model = LlamaModel(
+        vocab_size=vocab, hidden=hidden, layers=layers, heads=heads,
+        kv_heads=kv_heads, intermediate=inter,
+        max_positions=dflt("max_positions", "max_position_embeddings",
+                           2048),
+        rope_theta=dflt("rope_theta", "rope_theta", 10000.0),
+        eps=dflt("eps", "rms_norm_eps", 1e-6), head_dim=head_dim,
+        **model_kw)
+
+    _put(model.tok_emb.weight, emb)
+    _put(model.norm.weight, norm["norm.weight"])
+    _put(model.lm_head.weight, norm.get("lm_head.weight", emb))
+    for i, blk in enumerate(model.blocks):
+        p = f"layers.{i}."
+        _put(blk.ln1.weight, norm[p + "input_layernorm.weight"])
+        _put(blk.ln2.weight, norm[p + "post_attention_layernorm.weight"])
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            _put(getattr(blk, name).weight,
+                norm[p + "self_attn." + name + ".weight"])
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            _put(getattr(blk, name).weight,
+                norm[p + "mlp." + name + ".weight"])
     model.eval()
     return model

@@ -1,0 +1,237 @@
+"""Llama-style decoder family: RoPE + RMSNorm + SwiGLU + grouped-query
+attention — the modern long-context LM shape, on the same fused
+substrate as the GPT family (Pallas flash attention, FusedRMSNorm,
+fused step, remat, KV-cache decode).
+
+The reference repo carries no language models (SURVEY.md §2); the GPT
+family covers the GPT-2-era architecture, this one covers the
+Llama/Mistral era: no biases anywhere, rotary position embeddings
+instead of learned positions (so ``max_positions`` only sizes caches,
+not a table), RMSNorm pre-norm, gated SiLU FFN, optional
+``kv_heads < heads`` (GQA — K/V heads shared across query-head groups,
+the standard KV-cache shrink), and an UNTIED LM head (Llama convention;
+contrast GptModel's tied head).
+
+Layout: public API is batch-first ``(B, S)`` ids; attention runs the
+flash kernel directly in its native ``(B, H, S, D)`` layout (the GPT
+family's ``(S, B, E)`` interior exists for reference-parity with the
+torch MHA module; nothing here has a reference analogue, so the model
+keeps the kernel's own layout throughout).
+
+``llama_from_hf`` (models/hf.py) loads ``transformers`` Llama/Mistral
+checkpoints with logit parity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..normalization import FusedRMSNorm
+from ..contrib.multihead_attn.attn_funcs import flash_attention
+
+
+def rope_tables(positions, head_dim, theta=10000.0):
+    """cos/sin tables for rotary embeddings, HF half-rotation convention:
+    ``positions (...,)`` int32 → ``(cos, sin)`` of shape
+    ``(..., head_dim)`` fp32, frequencies duplicated over both halves."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32)
+                                * (2.0 / head_dim)))
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., half)
+    ang = jnp.concatenate([ang, ang], axis=-1)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate ``x (..., S, D)`` by tables ``(S, D)`` (broadcast over
+    leading dims).  rotate_half: the second half holds the negated
+    quadrature component (HF modeling_llama.rotate_half)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x.astype(jnp.float32) * cos
+            + rotated.astype(jnp.float32) * sin).astype(x.dtype)
+
+
+class LlamaBlock(nn.Module):
+    """Pre-norm decoder block: RMSNorm → RoPE-GQA causal attention →
+    residual, RMSNorm → SwiGLU FFN → residual.  No biases (Llama
+    convention)."""
+
+    def __init__(self, hidden, heads, kv_heads, intermediate,
+                 rope_theta=10000.0, eps=1e-6, head_dim=None):
+        super().__init__()
+        if head_dim is None:
+            # some checkpoints (Mistral-Nemo etc.) decouple head_dim from
+            # hidden/heads; the default is the usual coupling
+            if hidden % heads:
+                raise ValueError(
+                    f"hidden {hidden} not divisible by {heads} — pass "
+                    f"head_dim explicitly")
+            head_dim = hidden // heads
+        if heads % kv_heads:
+            raise ValueError(
+                f"heads {heads} not divisible by kv_heads {kv_heads} "
+                f"(GQA shares each K/V head over an equal group)")
+        self.heads = heads
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        self.rope_theta = rope_theta
+        self.ln1 = FusedRMSNorm(hidden, eps=eps)
+        self.q_proj = nn.Linear(hidden, heads * head_dim, bias=False)
+        self.k_proj = nn.Linear(hidden, kv_heads * head_dim, bias=False)
+        self.v_proj = nn.Linear(hidden, kv_heads * head_dim, bias=False)
+        self.o_proj = nn.Linear(heads * head_dim, hidden, bias=False)
+        self.ln2 = FusedRMSNorm(hidden, eps=eps)
+        self.gate_proj = nn.Linear(hidden, intermediate, bias=False)
+        self.up_proj = nn.Linear(hidden, intermediate, bias=False)
+        self.down_proj = nn.Linear(intermediate, hidden, bias=False)
+
+    def _qkv(self, ctx, h):
+        """(B, S, E) → q (B, H, S, D), k/v (B, KVH, S, D)."""
+        b, s, _ = h.shape
+        d = self.head_dim
+        to_heads = lambda y, nh: jnp.swapaxes(
+            y.reshape(b, s, nh, d), 1, 2)
+        q = to_heads(self.q_proj.forward(ctx, h), self.heads)
+        k = to_heads(self.k_proj.forward(ctx, h), self.kv_heads)
+        v = to_heads(self.v_proj.forward(ctx, h), self.kv_heads)
+        return q, k, v
+
+    def forward(self, ctx, x, cos, sin):
+        b, s, e = x.shape
+        h = self.ln1.forward(ctx, x)
+        q, k, v = self._qkv(ctx, h)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if self.kv_heads != self.heads:
+            # GQA: repeat each KV head over its query group.  Trace-time
+            # expansion is exact and XLA folds it into the attention
+            # matmul's layout; a kv-aware kernel would only save HBM for
+            # the expanded operand, which flash already streams blockwise
+            rep = self.heads // self.kv_heads
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        o = flash_attention(q, k, v, causal=True)          # (B, H, S, D)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, s, self.heads * self.head_dim)
+        x = x + self.o_proj.forward(ctx, o)
+        h = self.ln2.forward(ctx, x)
+        gated = F.silu(self.gate_proj.forward(ctx, h)) \
+            * self.up_proj.forward(ctx, h)
+        return x + self.down_proj.forward(ctx, gated)
+
+    def decode(self, ctx, x, kcache, vcache, t):
+        """One-token decode, ``x (B, E)`` at position ``t`` (traced i32);
+        caches ``(B, KVH, S_max, D)`` hold UN-repeated KV heads (the GQA
+        memory win is exactly that the cache stays KVH-wide)."""
+        b, e = x.shape
+        d, kvh = self.head_dim, self.kv_heads
+        h = self.ln1.forward(ctx, x)
+        q = self.q_proj.forward(ctx, h).reshape(b, self.heads, d)
+        k_new = self.k_proj.forward(ctx, h).reshape(b, kvh, d)
+        v_new = self.v_proj.forward(ctx, h).reshape(b, kvh, d)
+        cos, sin = rope_tables(t[None], d, self.rope_theta)   # (1, D)
+        q = apply_rope(q, cos, sin)
+        k_new = apply_rope(k_new, cos, sin)
+        kcache = jax.lax.dynamic_update_slice(
+            kcache, k_new[:, :, None, :].astype(kcache.dtype), (0, 0, t, 0))
+        vcache = jax.lax.dynamic_update_slice(
+            vcache, v_new[:, :, None, :].astype(vcache.dtype), (0, 0, t, 0))
+        s_max = kcache.shape[2]
+        group = self.heads // kvh
+        qg = q.reshape(b, kvh, group, d)
+        scores = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32),
+                            kcache.astype(jnp.float32)) * (d ** -0.5)
+        valid = jnp.arange(s_max) <= t
+        scores = jnp.where(valid[None, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bkgs,bksd->bkgd", probs,
+                       vcache.astype(jnp.float32)).astype(x.dtype)
+        o = o.reshape(b, self.heads * d)
+        x = x + self.o_proj.forward(ctx, o)
+        h = self.ln2.forward(ctx, x)
+        gated = F.silu(self.gate_proj.forward(ctx, h)) \
+            * self.up_proj.forward(ctx, h)
+        return x + self.down_proj.forward(ctx, gated), kcache, vcache
+
+
+class LlamaModel(nn.Module):
+    """Embeddings → N Llama blocks → final RMSNorm → untied LM head.
+    ``forward(input_ids[B,S]) -> logits (B, S, V)``."""
+
+    def __init__(self, vocab_size=32000, hidden=512, layers=8, heads=8,
+                 kv_heads=None, intermediate=None, max_positions=2048,
+                 rope_theta=10000.0, eps=1e-6, remat=False,
+                 head_dim=None):
+        super().__init__()
+        self.hidden = hidden
+        self.max_positions = max_positions
+        self.rope_theta = rope_theta
+        self.remat = remat
+        kv_heads = kv_heads or heads
+        # Llama's FFN width: 2/3 * 4E rounded up to a multiple of 256
+        # (only the default — checkpoints carry their own)
+        if intermediate is None:
+            intermediate = -(-(8 * hidden // 3) // 256) * 256
+        self.tok_emb = nn.Embedding(vocab_size, hidden)
+        self.tok_emb.weight.data = self.tok_emb.weight.data * 0.02
+        self.blocks = nn.ModuleList([
+            LlamaBlock(hidden, heads, kv_heads, intermediate,
+                       rope_theta=rope_theta, eps=eps, head_dim=head_dim)
+            for _ in range(layers)])
+        self.norm = FusedRMSNorm(hidden, eps=eps)
+        self.lm_head = nn.Linear(hidden, vocab_size, bias=False)
+        # untied head initialized like the embedding, N(0, 0.02) (the
+        # Llama initializer_range) — replacing, not scaling, the Linear
+        # default kaiming draw
+        from ..nn.modules import _next_key
+        self.lm_head.weight.data = 0.02 * jax.random.normal(
+            _next_key(), (vocab_size, hidden), jnp.float32)
+
+    def forward(self, ctx, input_ids):
+        b, s = input_ids.shape
+        if s > self.max_positions:
+            raise ValueError(
+                f"sequence length {s} exceeds max_positions "
+                f"{self.max_positions}")
+        head_dim = self.blocks[0].head_dim
+        cos, sin = rope_tables(jnp.arange(s, dtype=jnp.int32), head_dim,
+                               self.rope_theta)
+        x = self.tok_emb.forward(ctx, input_ids)
+        for blk in self.blocks:
+            if self.remat:
+                x = nn.checkpoint_forward(blk, ctx, x, cos, sin)
+            else:
+                x = blk.forward(ctx, x, cos, sin)
+        x = self.norm.forward(ctx, x)
+        return self.lm_head.forward(ctx, x)
+
+    def init_caches(self, batch, s_max, dtype=jnp.float32):
+        """Per-layer (k, v) caches, (B, KVH, S_max, D) — KVH-wide, the
+        GQA cache saving."""
+        return [(jnp.zeros((batch, blk.kv_heads, s_max, blk.head_dim),
+                           dtype),
+                 jnp.zeros((batch, blk.kv_heads, s_max, blk.head_dim),
+                           dtype))
+                for blk in self.blocks]
+
+    def decode_step(self, ctx, tok, caches, t):
+        """Logits for one token (same decode protocol as GptModel, so
+        :func:`~apex_tpu.models.gpt.generate` drives this family too)."""
+        x = ctx.value(self.tok_emb.weight)[tok]
+        new_caches = []
+        for blk, (kc, vc) in zip(self.blocks, caches):
+            x, kc, vc = blk.decode(ctx, x, kc, vc, t)
+            new_caches.append((kc, vc))
+        x = self.norm.forward(ctx, x)
+        return jnp.matmul(
+            x, ctx.value(self.lm_head.weight).T.astype(x.dtype)), new_caches
+
+
+def llama_tiny(**kw):
+    """Test-scale geometry (for suites and examples)."""
+    return LlamaModel(**{**dict(vocab_size=1000, hidden=128, layers=2,
+                                heads=4, kv_heads=2, max_positions=128),
+                         **kw})

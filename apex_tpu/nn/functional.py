@@ -323,6 +323,12 @@ def gelu(x, approximate="tanh"):
     return jax.nn.gelu(x, approximate=(approximate == "tanh"))
 
 
+def silu(x):
+    """x * sigmoid(x) (a.k.a. swish) — the Llama-family gate
+    activation."""
+    return jax.nn.silu(x)
+
+
 def tanh(x):
     return jnp.tanh(x)
 

@@ -4,3 +4,8 @@ from .fused_layer_norm import (  # noqa: F401
     fused_layer_norm,
     fused_layer_norm_affine,
 )
+from .rms_norm import (  # noqa: F401
+    FusedRMSNorm,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)

@@ -1,0 +1,142 @@
+"""FusedRMSNorm — the RMS variant of FusedLayerNorm for the Llama-style
+model families.
+
+No reference analogue (the reference's ``fused_layer_norm_cuda``
+extension implements only the mean-centered form); same design as
+fused_layer_norm.py: a ``jax.custom_vjp`` whose forward saves the fp32
+reciprocal-RMS residual, dispatched to the Pallas kernels
+(apex_tpu/ops/pallas/rms_norm.py) on TPU with an equivalent jnp path
+elsewhere (also the test oracle).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.modules import Module
+from ..nn.parameter import Parameter
+from ..ops.pallas import pallas_mode
+from ..ops.pallas import rms_norm as _k
+from .fused_layer_norm import _flatten
+
+_f32 = jnp.float32
+
+
+# -- jnp fallback path (also the test oracle) -------------------------------
+
+def _ref_forward(x2d, weight, eps):
+    xf = x2d.astype(_f32)
+    ms = jnp.mean(xf * xf, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    y = xf * rstd
+    if weight is not None:
+        y = y * weight.astype(_f32)
+    return y.astype(x2d.dtype), rstd
+
+
+def _ref_backward(g2d, x2d, rstd, weight):
+    g = g2d.astype(_f32)
+    xhat = x2d.astype(_f32) * rstd
+    gh = g * weight.astype(_f32) if weight is not None else g
+    c2 = jnp.mean(gh * xhat, axis=1, keepdims=True)
+    dx = ((gh - xhat * c2) * rstd).astype(x2d.dtype)
+    if weight is None:
+        return (dx,)
+    return dx, jnp.sum(g * xhat, axis=0)
+
+
+def _fwd_dispatch(x2d, weight, eps):
+    mode = pallas_mode()
+    if mode is None:
+        return _ref_forward(x2d, weight, eps)
+    return _k.rms_forward(x2d, weight, eps,
+                          interpret=(mode == "interpret"))
+
+
+def _bwd_dispatch(g2d, x2d, rstd, weight):
+    mode = pallas_mode()
+    if mode is None:
+        return _ref_backward(g2d, x2d, rstd, weight)
+    return _k.rms_backward(g2d, x2d, rstd, weight,
+                           interpret=(mode == "interpret"))
+
+
+# -- public functional API ---------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_rms_norm_affine(input, weight, normalized_shape, eps=1e-6):
+    x2d, _, n = _flatten(input, normalized_shape)
+    y, _ = _fwd_dispatch(x2d, weight.reshape(n), eps)
+    return y.reshape(input.shape)
+
+
+def _affine_fwd(input, weight, normalized_shape, eps):
+    x2d, _, n = _flatten(input, normalized_shape)
+    y, rstd = _fwd_dispatch(x2d, weight.reshape(n), eps)
+    return y.reshape(input.shape), (x2d, rstd, weight)
+
+
+def _affine_bwd(normalized_shape, eps, res, g):
+    x2d, rstd, weight = res
+    n = x2d.shape[1]
+    dx, dw = _bwd_dispatch(g.reshape(x2d.shape), x2d, rstd,
+                           weight.reshape(n))
+    return (dx.reshape(g.shape).astype(g.dtype),
+            dw.reshape(weight.shape).astype(weight.dtype))
+
+
+fused_rms_norm_affine.defvjp(_affine_fwd, _affine_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def fused_rms_norm(input, normalized_shape, eps=1e-6):
+    x2d, _, _ = _flatten(input, normalized_shape)
+    y, _ = _fwd_dispatch(x2d, None, eps)
+    return y.reshape(input.shape)
+
+
+def _plain_fwd(input, normalized_shape, eps):
+    x2d, _, _ = _flatten(input, normalized_shape)
+    y, rstd = _fwd_dispatch(x2d, None, eps)
+    return y.reshape(input.shape), (x2d, rstd)
+
+
+def _plain_bwd(normalized_shape, eps, res, g):
+    x2d, rstd = res
+    (dx,) = _bwd_dispatch(g.reshape(x2d.shape), x2d, rstd, None)
+    return (dx.reshape(g.shape).astype(g.dtype),)
+
+
+fused_rms_norm.defvjp(_plain_fwd, _plain_bwd)
+
+
+# -- module ------------------------------------------------------------------
+
+class FusedRMSNorm(Module):
+    """Drop-in RMSNorm backed by the fused kernel; fp32 statistics for
+    half inputs, matching FusedLayerNorm's contract.  Llama convention:
+    eps default 1e-6, weight-only affine (no bias by construction)."""
+
+    def __init__(self, normalized_shape, eps=1e-6, elementwise_affine=True):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+        if elementwise_affine:
+            self.weight = Parameter(jnp.ones(self.normalized_shape, _f32))
+        else:
+            self.register_parameter("weight", None)
+
+    def forward(self, ctx, x):
+        if self.elementwise_affine:
+            return fused_rms_norm_affine(
+                x, ctx.value(self.weight), self.normalized_shape, self.eps)
+        return fused_rms_norm(x, self.normalized_shape, self.eps)
+
+    def extra_repr(self):
+        return (f"{self.normalized_shape}, eps={self.eps}, "
+                f"elementwise_affine={self.elementwise_affine}")

@@ -235,6 +235,29 @@ def run_kernel_checks():
     except Exception as e:
         results["layer_norm"] = f"error: {type(e).__name__}: {e}"
 
+    # --- fused rms norm fwd + bwd (the Llama-family norm) ---
+    try:
+        from apex_tpu.normalization import fused_rms_norm_affine
+        x = jnp.asarray(rng.standard_normal((256, 512)), jnp.float32)
+        w = jnp.asarray(1 + 0.1 * rng.standard_normal((512,)), jnp.float32)
+
+        def rloss(x, w):
+            return jnp.sum(fused_rms_norm_affine(x, w, (512,)) ** 2)
+
+        with prec(), pal.force_mode(mode):
+            out_k = fused_rms_norm_affine(x, w, (512,))
+            g_k = jax.grad(rloss, argnums=(0, 1))(x, w)
+        with prec(), pal.force_mode("off"):
+            out_r = fused_rms_norm_affine(x, w, (512,))
+            g_r = jax.grad(rloss, argnums=(0, 1))(x, w)
+        err = max(_rel_err(out_k, out_r),
+                  *[_rel_err(a, b) for a, b in zip(g_k, g_r)])
+        results["rms_norm"] = ("pass" if err < 1e-4
+                               else f"fail: rel_err={err:.2e}")
+        results["rms_norm_rel_err"] = err
+    except Exception as e:
+        results["rms_norm"] = f"error: {type(e).__name__}: {e}"
+
     # --- flash attention fwd + bwd ---
     try:
         from apex_tpu.contrib.multihead_attn.attn_funcs import flash_attention
@@ -349,8 +372,11 @@ def run_kernel_timing(iters=30):
         log("kernel timing skipped: no TPU backend")
         return {"mode": "skipped (no TPU)",
                 "layer_norm": {}, "attention": {}}, None
+    from apex_tpu.normalization import fused_rms_norm_affine
+
     mode = "compiled"
-    results = {"mode": mode, "layer_norm": {}, "attention": {}}
+    results = {"mode": mode, "layer_norm": {}, "rms_norm": {},
+               "attention": {}}
     rng = np.random.default_rng(0)
 
     def _sync(tree):
@@ -395,6 +421,20 @@ def run_kernel_timing(iters=30):
         _ab(build, (x, w, b), f"N{n}_E{e}_{jnp.dtype(dtype).name}",
             "layer_norm")
 
+    # --- fused rms norm (the Llama-family norm), same shapes ---
+    for (n, e), dtype in [((8192, 768), jnp.float32),
+                          ((16384, 1024), jnp.bfloat16)]:
+        x = jnp.asarray(rng.standard_normal((n, e)), dtype)
+        w = jnp.ones((e,), jnp.float32)
+
+        def build(e=e):
+            def loss(x, w):
+                out = fused_rms_norm_affine(x, w, (e,))
+                return jnp.sum(out.astype(jnp.float32) ** 2)
+            return jax.jit(jax.grad(loss, argnums=(0, 1)))
+        _ab(build, (x, w), f"N{n}_E{e}_{jnp.dtype(dtype).name}",
+            "rms_norm")
+
     # --- flash attention, VMEM-guard shapes, fwd+bwd ---
     for b_, h, s, d, causal, dtype in [
             (8, 12, 256, 64, True, jnp.bfloat16),
@@ -415,7 +455,7 @@ def run_kernel_timing(iters=30):
             f"B{b_}_H{h}_S{s}_D{d}{'_causal' if causal else ''}"
             f"_{jnp.dtype(dtype).name}", "attention")
 
-    ups = [r["speedup"] for bkt in ("layer_norm", "attention")
+    ups = [r["speedup"] for bkt in ("layer_norm", "rms_norm", "attention")
            for r in results[bkt].values() if r.get("speedup")]
     gmean = float(np.exp(np.mean(np.log(ups)))) if ups else None
     return results, gmean
@@ -667,6 +707,56 @@ def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False,
                               pallas_attn_flops=paf)
 
 
+def build_llama_step(batch, seq_len, remat=False, plain_loss=False):
+    """Llama-style ~125M causal LM (RoPE + RMSNorm + SwiGLU + GQA 12q/4kv)
+    with FusedAdam under the bf16 fused step — the modern-architecture
+    counterpart of the GPT-2 config (attention always takes the causal
+    flash path: the family has no attention dropout by construction)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.models import LlamaModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    stage("model_build", f"llama_125m batch={batch} seq={seq_len}")
+    nn.manual_seed(0)
+    vocab = 32000
+    layers, heads, hidden = 12, 12, 768
+    model = LlamaModel(vocab_size=vocab, hidden=hidden, layers=layers,
+                       heads=heads, kv_heads=4, intermediate=2048,
+                       max_positions=max(seq_len, 128), remat=remat)
+    model.train()
+    n_params = sum(int(np.prod(p.data.shape)) for p in model.parameters())
+    opt = FusedAdam(list(model.parameters()), lr=6e-4, weight_decay=0.1)
+    token_losses = _lm_loss_fns(plain_loss)
+
+    def lm_loss(logits, ids):
+        flat = logits[:, :-1].reshape((-1, vocab))
+        tgt = ids[:, 1:].reshape((-1,))
+        return jnp.mean(token_losses(flat, tgt))
+
+    step = make_train_step(model, opt, lm_loss,
+                           half_dtype=jnp.bfloat16, loss_scale=1.0)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq_len)))
+    return step, (ids, ids), \
+        lambda: 6.0 * n_params * batch * seq_len, \
+        flash_attn_step_flops(
+            [(layers, batch, heads, seq_len, seq_len, hidden // heads,
+              True)])
+
+
+def run_llama_throughput(batch, seq_len, iters, warmup, remat=False,
+                         plain_loss=False):
+    step, arrays, af, paf = build_llama_step(batch, seq_len, remat,
+                                             plain_loss)
+    stage("compile", f"llama batch={batch}")
+    return time_compiled_step(step, arrays, iters, warmup, af,
+                              pallas_attn_flops=paf)
+
+
 def run_decode_throughput(batch, seq_len, new_tokens=128):
     """Greedy KV-cache decode tokens/s (gpt2-small): one warm compiled
     call timed via value fetch."""
@@ -751,6 +841,9 @@ def main():
     ap.add_argument("--bert", action="store_true",
                     help="run the BERT-base pretrain config (BASELINE.md 4) "
                          "instead of ResNet-50")
+    ap.add_argument("--llama", action="store_true",
+                    help="Llama-style ~125M causal LM (RoPE/RMSNorm/"
+                         "SwiGLU/GQA) FusedAdam throughput")
     ap.add_argument("--gpt", action="store_true",
                     help="run the GPT-2-small causal-LM config")
     ap.add_argument("--gpt-decode", action="store_true",
@@ -881,6 +974,10 @@ def main():
                                       args.warmup, remat=args.remat,
                                       size=args.gpt_size,
                                       plain_loss=args.plain_loss)
+        if args.llama:
+            return run_llama_throughput(batch, args.seq_len, args.iters,
+                                        args.warmup, remat=args.remat,
+                                        plain_loss=args.plain_loss)
         return run_throughput(batch, args.iters, args.warmup)
 
     if args.sweep:
@@ -889,6 +986,7 @@ def main():
         # reports and the sweep continues; exit 1 if NO point succeeds
         cfg = ("bert" if args.bert else
                f"gpt2_{args.gpt_size}" if args.gpt else
+               "llama_125m" if args.llama else
                "seq2seq" if args.seq2seq else "resnet50")
         peak, kind = peak_tflops(devices[0])
         ok = 0
@@ -920,8 +1018,8 @@ def main():
     # per-config default batch; an explicitly requested batch is honored
     first_batch = args.batch
     if first_batch is None:
-        first_batch = 64 if (args.bert or args.gpt or args.seq2seq) \
-            else 128
+        first_batch = 64 if (args.bert or args.gpt or args.llama
+                             or args.seq2seq) else 128
         log(f"default batch: {first_batch}")
     for batch in [first_batch, first_batch // 2, first_batch // 4]:
         if batch < 1:
@@ -959,6 +1057,10 @@ def main():
         unit, vs_baseline = "sequences/sec/chip", None
     elif args.gpt:
         metric = (f"gpt2_{args.gpt_size}_causal_lm_seq{args.seq_len}_"
+                  "sequences_per_sec_per_chip_ampO2")
+        unit, vs_baseline = "sequences/sec/chip", None
+    elif args.llama:
+        metric = (f"llama_125m_causal_lm_seq{args.seq_len}_"
                   "sequences_per_sec_per_chip_ampO2")
         unit, vs_baseline = "sequences/sec/chip", None
     elif args.seq2seq:

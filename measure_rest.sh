@@ -33,6 +33,8 @@ run_ok() {  # usage: pre=$(lines); run ...; run_ok "$pre"
 ENVV=()
 run --gpt-decode
 ./probe_tunnel.sh || exit 1
+run --llama --seq-len 512 --iters 30
+./probe_tunnel.sh || exit 1
 run --seq2seq
 ./probe_tunnel.sh || exit 1
 run --kernels-timing

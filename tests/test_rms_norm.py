@@ -1,0 +1,125 @@
+"""FusedRMSNorm (normalization/rms_norm.py): numerics vs a from-scratch
+jnp RMSNorm and vs jax.grad of that reference, the pallas-interpret vs
+jnp-fallback cross-build oracle (tests/L1/common/compare.py:34-40
+analogue, as test_fused_layer_norm.py does for LN), and torch parity."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.normalization import (FusedRMSNorm, fused_rms_norm,
+                                    fused_rms_norm_affine)
+from apex_tpu.ops.pallas import force_mode
+
+
+def _ref_rms(x, norm_shape, w=None, eps=1e-6):
+    ns = int(np.prod(norm_shape))
+    x2 = x.reshape(-1, ns).astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(x2 * x2, axis=1, keepdims=True) + eps)
+    y = x2 * rstd
+    if w is not None:
+        y = y * w.reshape(ns).astype(jnp.float32)
+    return y.reshape(x.shape).astype(x.dtype)
+
+
+@pytest.mark.parametrize("shape,norm_shape", [
+    ((8, 16, 32), (32,)),
+    ((4, 6, 8, 10), (8, 10)),
+    ((64, 96), (96,)),
+])
+def test_forward_matches_reference(rng, shape, norm_shape):
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    w = jnp.asarray(1 + 0.1 * rng.standard_normal(norm_shape), jnp.float32)
+    y = fused_rms_norm_affine(x, w, norm_shape, 1e-6)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(_ref_rms(x, norm_shape, w)),
+                               rtol=1e-5, atol=1e-5)
+    y2 = fused_rms_norm(x, norm_shape, 1e-6)
+    np.testing.assert_allclose(np.asarray(y2),
+                               np.asarray(_ref_rms(x, norm_shape)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_backward_matches_autodiff_of_reference(rng):
+    x = jnp.asarray(rng.standard_normal((32, 48)), jnp.float32)
+    w = jnp.asarray(1 + 0.1 * rng.standard_normal((48,)), jnp.float32)
+
+    def fused_loss(x, w):
+        return jnp.sum(fused_rms_norm_affine(x, w, (48,), 1e-6) ** 2)
+
+    def ref_loss(x, w):
+        return jnp.sum(_ref_rms(x, (48,), w) ** 2)
+
+    gf = jax.grad(fused_loss, argnums=(0, 1))(x, w)
+    gr = jax.grad(ref_loss, argnums=(0, 1))(x, w)
+    for a, r in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_half_input_fp32_stats(rng):
+    x = jnp.asarray(rng.standard_normal((16, 64)), jnp.bfloat16)
+    w = jnp.ones((64,), jnp.float32)
+    y = fused_rms_norm_affine(x, w, (64,), 1e-6)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(_ref_rms(x, (64,), w), np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_pallas_interpret_matches_fallback(rng):
+    """Kernel logic vs jnp fallback, fwd + bwd, with row padding (40 rows
+    is not a multiple of the 16-row sublane block)."""
+    x = jnp.asarray(rng.standard_normal((40, 136)), jnp.float32)
+    w = jnp.asarray(1 + 0.1 * rng.standard_normal((136,)), jnp.float32)
+
+    def loss(x, w):
+        return jnp.sum(jnp.sin(fused_rms_norm_affine(x, w, (136,))))
+
+    with force_mode("off"):
+        y0 = fused_rms_norm_affine(x, w, (136,))
+        g0 = jax.grad(loss, argnums=(0, 1))(x, w)
+    with force_mode("interpret"):
+        y1 = fused_rms_norm_affine(x, w, (136,))
+        g1 = jax.grad(loss, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=1e-5, atol=1e-6)
+    for a, r in zip(g1, g0):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-5)
+
+    # plain (no-affine) path through the kernel too
+    with force_mode("interpret"):
+        yp = fused_rms_norm(x, (136,))
+    np.testing.assert_allclose(np.asarray(yp),
+                               np.asarray(_ref_rms(x, (136,))),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_torch_parity(rng):
+    torch = pytest.importorskip("torch")
+    if not hasattr(torch.nn, "RMSNorm"):
+        pytest.skip("torch too old for nn.RMSNorm")
+    x = rng.standard_normal((12, 80)).astype(np.float32)
+    w = (1 + 0.1 * rng.standard_normal(80)).astype(np.float32)
+    m = torch.nn.RMSNorm(80, eps=1e-6)
+    with torch.no_grad():
+        m.weight.copy_(torch.from_numpy(w))
+    want = m(torch.from_numpy(x)).detach().numpy()
+    got = np.asarray(fused_rms_norm_affine(
+        jnp.asarray(x), jnp.asarray(w), (80,), 1e-6))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_module_trains(rng):
+    nn.manual_seed(0)
+    m = FusedRMSNorm(24)
+    x = jnp.asarray(rng.standard_normal((8, 24)), jnp.float32)
+    y = m(x).value
+    assert y.shape == (8, 24)
+    # unit RMS per row at weight=1
+    np.testing.assert_allclose(
+        np.asarray(jnp.sqrt(jnp.mean(y * y, axis=1))), 1, atol=1e-3)
+    assert m.weight.data.shape == (24,)
+    assert not hasattr(m, "bias") or m.bias is None
