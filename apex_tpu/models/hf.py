@@ -37,9 +37,11 @@ import jax.numpy as jnp
 
 def _to_numpy(t):
     """torch tensor / numpy array -> float32 numpy (no torch import
-    required unless the value is a torch tensor)."""
+    required unless the value is a torch tensor).  Torch tensors go
+    through .float() first: numpy has no bf16, and bf16 is the default
+    distribution dtype of the checkpoints these loaders target."""
     if hasattr(t, "detach"):
-        t = t.detach().cpu().numpy()
+        t = t.detach().float().cpu().numpy()
     return np.asarray(t, np.float32)
 
 

@@ -61,8 +61,16 @@ class LlamaBlock(nn.Module):
     convention)."""
 
     def __init__(self, hidden, heads, kv_heads, intermediate,
-                 rope_theta=10000.0, eps=1e-6, head_dim=None):
+                 rope_theta=10000.0, eps=1e-6, head_dim=None,
+                 tp_axis=None):
         super().__init__()
+        # tp_axis: Megatron tensor parallelism — forward must run inside
+        # shard_map over a mesh with this axis.  Q heads AND KV heads
+        # shard over it (both row-major head blocks in the projection
+        # weights), o_proj/down_proj are row-parallel; weights stay FULL
+        # (replicated) and each device slices its block at trace time,
+        # exactly the GPT/BERT families' convention (models/gpt.py).
+        self.tp_axis = tp_axis
         if head_dim is None:
             # some checkpoints (Mistral-Nemo etc.) decouple head_dim from
             # hidden/heads; the default is the usual coupling
@@ -90,14 +98,36 @@ class LlamaBlock(nn.Module):
         self.down_proj = nn.Linear(intermediate, hidden, bias=False)
 
     def _qkv(self, ctx, h):
-        """(B, S, E) → q (B, H, S, D), k/v (B, KVH, S, D)."""
+        """(B, S, E) → q (B, H, S, D), k/v (B, KVH, S, D).  Under
+        ``tp_axis`` the returned head dims are the LOCAL head counts and
+        the entry f operator has been applied to ``h``'s stream."""
         b, s, _ = h.shape
         d = self.head_dim
+        heads, kv_heads = self.heads, self.kv_heads
+        wq = ctx.value(self.q_proj.weight)
+        wk = ctx.value(self.k_proj.weight)
+        wv = ctx.value(self.v_proj.weight)
+        if self.tp_axis is not None:
+            # head-major row blocks: a contiguous row slice IS a head
+            # block, for Q and for KV alike — so _shard_rows shards heads
+            from ..parallel.tensor_parallel import (copy_to_tp_region,
+                                                    _shard_rows)
+            n = jax.lax.psum(1, self.tp_axis)
+            if heads % n or kv_heads % n:
+                raise ValueError(
+                    f"tensor parallelism: heads ({heads}) and kv_heads "
+                    f"({kv_heads}) must both divide by the "
+                    f"'{self.tp_axis}' axis size ({n})")
+            h = copy_to_tp_region(h, self.tp_axis)
+            wq = _shard_rows(wq, self.tp_axis)
+            wk = _shard_rows(wk, self.tp_axis)
+            wv = _shard_rows(wv, self.tp_axis)
+            heads, kv_heads = heads // n, kv_heads // n
         to_heads = lambda y, nh: jnp.swapaxes(
             y.reshape(b, s, nh, d), 1, 2)
-        q = to_heads(self.q_proj.forward(ctx, h), self.heads)
-        k = to_heads(self.k_proj.forward(ctx, h), self.kv_heads)
-        v = to_heads(self.v_proj.forward(ctx, h), self.kv_heads)
+        q = to_heads(jnp.matmul(h, wq.T.astype(h.dtype)), heads)
+        k = to_heads(jnp.matmul(h, wk.T.astype(h.dtype)), kv_heads)
+        v = to_heads(jnp.matmul(h, wv.T.astype(h.dtype)), kv_heads)
         return q, k, v
 
     def forward(self, ctx, x, cos, sin):
@@ -106,21 +136,58 @@ class LlamaBlock(nn.Module):
         q, k, v = self._qkv(ctx, h)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if self.kv_heads != self.heads:
-            # GQA: repeat each KV head over its query group.  Trace-time
-            # expansion is exact and XLA folds it into the attention
-            # matmul's layout; a kv-aware kernel would only save HBM for
-            # the expanded operand, which flash already streams blockwise
-            rep = self.heads // self.kv_heads
+        if q.shape[1] != k.shape[1]:
+            # GQA: repeat each KV head over its query group (the local
+            # ratio equals the global one under TP — both divide by n).
+            # Trace-time expansion is exact and XLA folds it into the
+            # attention matmul's layout; a kv-aware kernel would only
+            # save HBM for the expanded operand, which flash already
+            # streams blockwise
+            rep = q.shape[1] // k.shape[1]
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
-        o = flash_attention(q, k, v, causal=True)          # (B, H, S, D)
-        o = jnp.swapaxes(o, 1, 2).reshape(b, s, self.heads * self.head_dim)
+        o = flash_attention(q, k, v, causal=True)     # (B, H_loc, S, D)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, s, q.shape[1] * self.head_dim)
+        if self.tp_axis is not None:
+            from ..parallel.tensor_parallel import (row_parallel_linear,
+                                                    _shard_cols)
+            wo = _shard_cols(ctx.value(self.o_proj.weight), self.tp_axis)
+            x = x + row_parallel_linear(o, wo, None, self.tp_axis)
+            h = self.ln2.forward(ctx, x)
+            x = x + self._tp_swiglu(ctx, h)
+            return x
         x = x + self.o_proj.forward(ctx, o)
         h = self.ln2.forward(ctx, x)
         gated = F.silu(self.gate_proj.forward(ctx, h)) \
             * self.up_proj.forward(ctx, h)
         return x + self.down_proj.forward(ctx, gated)
+
+    def _tp_swiglu(self, ctx, h):
+        """SwiGLU as the Megatron column→row pair: gate and up are both
+        column-parallel consumers of the same f-entered stream (one
+        backward psum covers both), the gating product happens on the
+        feature shard, and down_proj's row-parallel psum is the pair's
+        single forward collective."""
+        from ..parallel.tensor_parallel import (copy_to_tp_region,
+                                                row_parallel_linear,
+                                                _shard_rows, _shard_cols)
+        h = copy_to_tp_region(h, self.tp_axis)
+        wg = _shard_rows(ctx.value(self.gate_proj.weight), self.tp_axis)
+        wu = _shard_rows(ctx.value(self.up_proj.weight), self.tp_axis)
+        wd = _shard_cols(ctx.value(self.down_proj.weight), self.tp_axis)
+        gated = F.silu(jnp.matmul(h, wg.T.astype(h.dtype))) \
+            * jnp.matmul(h, wu.T.astype(h.dtype))
+        return row_parallel_linear(gated, wd, None, self.tp_axis)
+
+    def tp_sharded_params(self):
+        """Parameters whose per-device gradients are block-sparse under
+        ``tp_axis`` (make_train_step(tp_axis=...) psum-assembles them):
+        the head-sharded Q/K/V rows, the column-sharded o_proj, and the
+        SwiGLU pair's sharded dims."""
+        return [self.q_proj.weight, self.k_proj.weight,
+                self.v_proj.weight, self.o_proj.weight,
+                self.gate_proj.weight, self.up_proj.weight,
+                self.down_proj.weight]
 
     def decode(self, ctx, x, kcache, vcache, t):
         """One-token decode, ``x (B, E)`` at position ``t`` (traced i32);
@@ -164,12 +231,13 @@ class LlamaModel(nn.Module):
     def __init__(self, vocab_size=32000, hidden=512, layers=8, heads=8,
                  kv_heads=None, intermediate=None, max_positions=2048,
                  rope_theta=10000.0, eps=1e-6, remat=False,
-                 head_dim=None):
+                 head_dim=None, tp_axis=None):
         super().__init__()
         self.hidden = hidden
         self.max_positions = max_positions
         self.rope_theta = rope_theta
         self.remat = remat
+        self.tp_axis = tp_axis
         kv_heads = kv_heads or heads
         # Llama's FFN width: 2/3 * 4E rounded up to a multiple of 256
         # (only the default — checkpoints carry their own)
@@ -179,7 +247,8 @@ class LlamaModel(nn.Module):
         self.tok_emb.weight.data = self.tok_emb.weight.data * 0.02
         self.blocks = nn.ModuleList([
             LlamaBlock(hidden, heads, kv_heads, intermediate,
-                       rope_theta=rope_theta, eps=eps, head_dim=head_dim)
+                       rope_theta=rope_theta, eps=eps, head_dim=head_dim,
+                       tp_axis=tp_axis)
             for _ in range(layers)])
         self.norm = FusedRMSNorm(hidden, eps=eps)
         self.lm_head = nn.Linear(hidden, vocab_size, bias=False)
@@ -217,9 +286,18 @@ class LlamaModel(nn.Module):
                            dtype))
                 for blk in self.blocks]
 
+    def tp_sharded_params(self):
+        """All blocks' TP-block-sparse parameters (see LlamaBlock) — the
+        contract make_train_step(tp_axis=...) assembles by psum."""
+        return [p for blk in self.blocks for p in blk.tp_sharded_params()]
+
     def decode_step(self, ctx, tok, caches, t):
         """Logits for one token (same decode protocol as GptModel, so
         :func:`~apex_tpu.models.gpt.generate` drives this family too)."""
+        if self.tp_axis is not None:
+            raise NotImplementedError(
+                "decode_step is single-shard; build the model without "
+                "tp_axis for inference")
         x = ctx.value(self.tok_emb.weight)[tok]
         new_caches = []
         for blk, (kc, vc) in zip(self.blocks, caches):

@@ -934,6 +934,7 @@ def main():
         stage("kernel_checks")
         res = run_kernel_checks()
         ok = (res.get("layer_norm") == "pass"
+              and res.get("rms_norm") == "pass"
               and res.get("attention") == "pass"
               and res.get("vmem_guard") == "pass")
         emit({"metric": "pallas_kernel_parity", "value": 1.0 if ok else 0.0,

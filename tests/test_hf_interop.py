@@ -155,3 +155,13 @@ def test_untied_head_rejected():
     sd["lm_head.weight"] = sd["lm_head.weight"] + 1.0
     with pytest.raises(ValueError, match="not tied"):
         gpt2_from_hf(sd, heads=HEADS)
+
+
+def test_bf16_checkpoint_loads(rng):
+    """bf16-dtype checkpoints (the default distribution dtype for real
+    weights) convert without a numpy bf16 TypeError."""
+    hf = _hf_model().to(torch.bfloat16)
+    model = gpt2_from_hf(hf)
+    ids = _ids(rng, b=1, s=7)
+    got = np.asarray(model(jnp.asarray(ids)).value)
+    assert np.isfinite(got).all()
