@@ -21,7 +21,9 @@ from .tensor_parallel import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, column_parallel_linear,
     row_parallel_linear, vocab_parallel_cross_entropy,
     vocab_parallel_embedding, vocab_parallel_logits)
-from .pipeline import PipelinedStack, pipeline_apply  # noqa: F401
+from .pipeline import (PipelinedStack, build_1f1b_schedule,  # noqa: F401
+                       make_pipeline_train_step, pipeline_1f1b_grads,
+                       pipeline_apply, ring_slots)
 from .expert_parallel import switch_moe  # noqa: F401
 from .zero import ZeroTrainStep, zero_state_sharding  # noqa: F401
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -101,6 +102,268 @@ def pipeline_apply(stage_fn, stage_params, xs, axis_name,
     # (psum of one-hot contribution — every other stage holds zeros)
     return lax.psum(jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)),
                     axis_name)
+
+
+def build_1f1b_schedule(n_stages, n_micro):
+    """Static 1F1B tick tables for an ``n_stages`` pipeline over
+    ``n_micro`` microbatches.
+
+    Returns ``(fwd, bwd)``, each ``(ticks, n_stages)`` int32: the
+    microbatch stage ``s`` forwards (resp. backwards) at tick ``t``, or
+    ``-1`` for an idle unit.  The schedule is the lockstep synchronous
+    1F1B: ``t_F(s, m) = s + m`` and ``t_B(s, m) = 2(n-1) - s + m`` — in
+    steady state every stage does one forward and one backward per tick
+    (the 1F1B alternation), the last stage backwards a microbatch on the
+    same tick it forwards it, and a stage holds at most
+    ``2(n_stages - 1 - s) + 1`` live microbatches.  Total ticks:
+    ``n_micro + 2 (n_stages - 1)``.
+    """
+    t_total = n_micro + 2 * (n_stages - 1)
+    fwd = -np.ones((t_total, n_stages), np.int32)
+    bwd = -np.ones((t_total, n_stages), np.int32)
+    for s in range(n_stages):
+        for m in range(n_micro):
+            fwd[s + m, s] = m
+            bwd[2 * (n_stages - 1) - s + m, s] = m
+    return fwd, bwd
+
+
+def ring_slots(n_stages, n_micro):
+    """Residual ring-buffer depth for the 1F1B schedule: a stage's input
+    for microbatch ``m`` stays live from its forward tick to its backward
+    tick — at most ``2 (n_stages - 1)`` ticks — so ``2 n - 1`` slots
+    suffice regardless of ``n_micro``.  This is the 1F1B memory bound:
+    the GPipe scan's transpose instead keeps every tick's residual,
+    ``n_micro + n_stages - 1`` of them."""
+    return min(2 * n_stages - 1, n_micro)
+
+
+def pipeline_1f1b_grads(stage_fn, stage_params, xs, yrefs, loss_fn,
+                        axis_name, cotangent_scale=1.0):
+    """Loss and THIS stage's parameter gradients for a 1F1B pipeline.
+
+    One-forward-one-backward interleaves each microbatch's backward into
+    the forward stream, so it cannot be phrased as ``jax.grad`` over a
+    forward schedule (custom_vjp separates the phases); this function
+    computes gradients directly instead.  Backward ticks rebuild the
+    stage forward from the stored stage INPUT (activation recomputation,
+    the Megatron 1F1B recipe) — the only O(n_micro)-free storage is a
+    ring of :func:`ring_slots` microbatch inputs, which is the point:
+    GPipe under ``jax.grad`` (:func:`pipeline_apply`) keeps
+    ``n_micro + n_stages - 1`` tick residuals live, this path keeps at
+    most ``2 n_stages - 1`` regardless of microbatch count, at the price
+    of (n_stages - 1) extra bubble ticks and the recompute.
+
+    ``stage_fn(params, x) -> y`` — one stage, same contract as
+    :func:`pipeline_apply` (one activation shape/dtype across stages).
+    ``xs`` — ``(n_micro, micro, ...)`` inputs, replicated over the axis.
+    ``yrefs`` — per-microbatch loss references (labels/targets pytree,
+    leading dim ``n_micro``), replicated.  ``loss_fn(y, yref) -> scalar``
+    per microbatch; the optimized total is the microbatch mean.
+    ``cotangent_scale`` — multiplies the seed cotangent (amp loss
+    scaling); the returned loss is unscaled.
+
+    Returns ``(loss, grads)``: the microbatch-mean loss (replicated) and
+    this device's stage-parameter gradients (a pytree like
+    ``stage_params`` — disjoint per device; psum over the axis assembles
+    the full stacked gradient, the ``tp_sharded_params`` pattern).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = xs.shape[0]
+    slots = ring_slots(n, n_micro)
+    fwd_np, bwd_np = build_1f1b_schedule(n, n_micro)
+    fwd_tbl, bwd_tbl = jnp.asarray(fwd_np), jnp.asarray(bwd_np)
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+    is_last = idx == n - 1
+
+    def fwd_loss(params, x, yref):
+        y = stage_fn(params, x)
+        if y.shape != x.shape or y.dtype != x.dtype:
+            raise ValueError(
+                f"pipeline_1f1b_grads: stage_fn changed the activation "
+                f"from {x.shape}/{x.dtype} to {y.shape}/{y.dtype} — "
+                f"pipeline stages must share one activation shape/dtype "
+                f"(pad narrower stages)")
+        # every stage evaluates loss_fn (the SPMD-uniform program needs
+        # one vjp structure); only the last stage's value/cotangent is
+        # ever unmasked
+        return y, loss_fn(y, yref)
+
+    micro_zero = jnp.zeros_like(xs[0])
+    carry0 = (
+        micro_zero,                                  # act arriving s-1 -> s
+        micro_zero,                                  # ct arriving s+1 -> s
+        jnp.zeros((slots,) + xs.shape[1:], xs.dtype),  # input ring
+        jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                     stage_params),                  # grad accumulator
+        jnp.zeros((), jnp.float32),                  # loss sum (last stage)
+    )
+
+    def tick(carry, rows):
+        act_in, ct_in, ring, gacc, loss_sum = carry
+        row_f, row_b = rows
+
+        # --- forward unit: stage idx forwards microbatch mf (if any) ---
+        mf = row_f[idx]
+        do_f = mf >= 0
+        mf_c = jnp.maximum(mf, 0)
+        x_in = jnp.where(idx == 0, xs[jnp.minimum(mf_c, n_micro - 1)],
+                         act_in)
+        y = stage_fn(stage_params, x_in)
+        slot_f = mf_c % slots
+        prev = lax.dynamic_index_in_dim(ring, slot_f, 0, keepdims=False)
+        ring = lax.dynamic_update_index_in_dim(
+            ring, jnp.where(do_f, x_in, prev), slot_f, 0)
+
+        # --- backward unit: stage idx backwards microbatch mb (if any),
+        #     recomputing its forward from the stored input ---
+        mb = row_b[idx]
+        do_b = mb >= 0
+        mb_c = jnp.maximum(mb, 0)
+        xb = lax.dynamic_index_in_dim(ring, mb_c % slots, 0, keepdims=False)
+        yrb = jax.tree.map(lambda a: a[jnp.minimum(mb_c, n_micro - 1)],
+                           yrefs)
+        (yb, lb), vjp = jax.vjp(fwd_loss, stage_params, xb, yrb)
+        ct_y = jnp.where(is_last, jnp.zeros_like(yb), ct_in.astype(yb.dtype))
+        ct_l = jnp.where(is_last,
+                         jnp.asarray(cotangent_scale / n_micro,
+                                     jnp.float32), 0.0).astype(lb.dtype)
+        g_params, g_x, _ = vjp((ct_y, ct_l))
+        gacc = jax.tree.map(
+            lambda a, g: a + jnp.where(do_b, g.astype(jnp.float32), 0.0),
+            gacc, g_params)
+        loss_sum = loss_sum + jnp.where(
+            jnp.logical_and(do_b, is_last), lb.astype(jnp.float32), 0.0)
+
+        # --- hops: activations one stage forward, cotangents one back;
+        #     production-to-consumption is exactly one tick in this
+        #     schedule, so a single buffer carries each stream ---
+        act_in = lax.ppermute(y, axis_name, fwd_perm)
+        ct_in = lax.ppermute(g_x, axis_name, bwd_perm)
+        return (act_in, ct_in, ring, gacc, loss_sum), None
+
+    (_, _, _, grads, loss_sum), _ = lax.scan(
+        tick, carry0, (fwd_tbl, bwd_tbl))
+    # only the last stage accumulated real loss values; psum replicates
+    loss = lax.psum(jnp.where(is_last, loss_sum, 0.0), axis_name) / n_micro
+    return loss, grads
+
+
+def make_pipeline_train_step(stack, optimizer, loss_fn, *,
+                             schedule="1f1b",
+                             half_dtype=None,
+                             dynamic_loss_scale=True,
+                             scale_window=2000,
+                             min_loss_scale=None,
+                             max_loss_scale=2.0 ** 24,
+                             loss_scale="dynamic",
+                             lr_schedule=None):
+    """Fused amp train step for a :class:`PipelinedStack`.
+
+    ``schedule="gpipe"`` delegates to
+    ``make_train_step(stack, ..., tp_axis=stack.axis_name)`` — the
+    fill/drain scan differentiated by ``jax.grad`` (all tick residuals
+    live through the backward; pair with ``remat_stage=True`` on the
+    stack to shrink them).  ``schedule="1f1b"`` uses
+    :func:`pipeline_1f1b_grads`: backward interleaved one-forward-one-
+    backward with activation recomputation, residual memory bounded by
+    :func:`ring_slots` microbatches independent of ``n_micro``.
+
+    ``loss_fn(y, yref) -> scalar`` must be a per-sample mean for the
+    microbatch-mean total to equal the full-batch loss (the same
+    contract as ``grad_accum_steps``).  Run the returned step's
+    ``._step_fn`` under ``shard_map`` over the stack's pp axis with the
+    batch replicated — see ``tests/test_pipeline.py`` for the mesh
+    setup.  Dynamic loss scaling, the optimizer update and the skip-on-
+    overflow path are the same fused machinery as ``make_train_step``.
+    """
+    from ..training.step import (TrainStep, apply_fused_update,
+                                 build_opt_update, init_step_state,
+                                 match_param_groups, model_vals_of)
+
+    if schedule == "gpipe":
+        from ..training.step import make_train_step
+        return make_train_step(
+            stack, optimizer, loss_fn, half_dtype=half_dtype,
+            dynamic_loss_scale=dynamic_loss_scale,
+            scale_window=scale_window, min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale, loss_scale=loss_scale,
+            lr_schedule=lr_schedule, tp_axis=stack.axis_name)
+    if schedule != "1f1b":
+        raise ValueError(
+            f"make_pipeline_train_step: schedule must be 'gpipe' or "
+            f"'1f1b', got {schedule!r}")
+    if stack.remat_stage:
+        raise ValueError(
+            "make_pipeline_train_step(schedule='1f1b') recomputes each "
+            "stage forward by construction; build the PipelinedStack "
+            "with remat_stage=False")
+
+    params = stack.parameters()
+    group_idxs = match_param_groups(optimizer, params,
+                                    caller="make_pipeline_train_step")
+    model_dtypes = [p.data.dtype if half_dtype is None
+                    else jnp.dtype(half_dtype) for p in params]
+    opt_update, opt_init = build_opt_update(
+        optimizer, params, group_idxs, caller="make_pipeline_train_step")
+
+    dynamic = loss_scale == "dynamic"
+    init_scale = (min(max_loss_scale, 2.0 ** 16) if dynamic
+                  else float(loss_scale))
+    axis = stack.axis_name
+    n_micro = stack.n_micro
+
+    def step_fn(state, x, yref):
+        vals = model_vals_of(state)
+        stacked = jax.tree.unflatten(stack._treedef, vals)
+        i = lax.axis_index(axis)
+        local = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            stacked)
+        if half_dtype is not None:
+            from ..amp.policy import _cast_tree
+            x = _cast_tree(x, jnp.dtype(half_dtype))
+        b = x.shape[0]
+        if b % n_micro:
+            raise ValueError(
+                f"make_pipeline_train_step: batch {b} does not divide "
+                f"into n_micro={n_micro} microbatches")
+        micro = b // n_micro
+        xs = x.reshape((n_micro, micro) + x.shape[1:])
+        yrefs = jax.tree.map(
+            lambda a: a.reshape((n_micro, micro) + a.shape[1:]), yref)
+
+        loss, local_grads = pipeline_1f1b_grads(
+            stack.stage_fn, local, xs, yrefs, loss_fn, axis,
+            cotangent_scale=state.scaler.loss_scale)
+
+        # expand this stage's slice into the stacked layout (disjoint
+        # blocks per device) and psum-assemble, as for tp_sharded_params
+        stacked_grads = jax.tree.map(
+            lambda g, full: lax.psum(
+                lax.dynamic_update_index_in_dim(
+                    jnp.zeros(full.shape, jnp.float32),
+                    g.astype(jnp.float32), i, 0),
+                axis),
+            local_grads, stacked)
+        grads = jax.tree.leaves(stacked_grads)
+
+        new_state = apply_fused_update(
+            state, grads, opt_update, model_dtypes,
+            dynamic=dynamic, init_scale=init_scale,
+            scale_window=scale_window, min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale, lr_schedule=lr_schedule)
+        return new_state, loss
+
+    init_state = init_step_state(params, [], model_dtypes, opt_init,
+                                 init_scale)
+    ts = TrainStep(stack, optimizer, loss_fn, step_fn, params, [],
+                   init_state)
+    ts._raw_step_fn = step_fn
+    ts._donate_state = False
+    return ts
 
 
 class PipelinedStack:

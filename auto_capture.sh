@@ -1,0 +1,56 @@
+#!/bin/bash
+# Resumable on-chip capture queue for a flaky tunnel: probe before every
+# item; on a wedged probe sleep and retry (the axon tunnel has healed
+# after 2-9h in past sessions).  Items are ordered value-first/risk-last.
+# bench.py exit codes: 4 = wedged before any real work (do NOT advance —
+# retry the item next healthy window); 3 = internal watchdog fired mid
+# work (advance; the item is suspect and gets a diagnostic JSON line).
+set -u
+cd "$(dirname "$0")"
+CURSOR_FILE="${CAPTURE_CURSOR:-.capture_cursor}"
+LOG=measurements.jsonl
+
+QUEUE=(
+  # diagnose prints human progress lines to stdout: route them to its own
+  # log so the measurements JSONL stream stays parseable (its JSON result
+  # lines go to diagnose_gpt1024.jsonl via DIAG_LOG)
+  "bash diagnose_gpt1024.sh >>diagnose_stdout.log 2>&1"
+  "timeout 700 python bench.py --profile"
+  "timeout 700 python bench.py --profile --gpt"
+  "timeout 900 python bench.py --sweep 96,128,192,256 --no-kernels --budget-s 840"
+  "timeout 900 python bench.py --gpt --sweep 32,64,128 --no-kernels --budget-s 840"
+  "timeout 700 python bench.py --llama --no-kernels"
+  "timeout 700 python bench.py --gpt-decode --no-kernels"
+  "timeout 700 python bench.py --seq2seq --no-kernels"
+  "timeout 900 python bench.py --kernels-timing --budget-s 840"
+  "DIAG_FULL=1 bash diagnose_gpt1024.sh >>diagnose_stdout.log 2>&1"
+)
+
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((64, 64)); print('probe ok:', float(jnp.sum(x @ x)))
+" 2>/dev/null
+}
+
+cursor=$(cat "$CURSOR_FILE" 2>/dev/null || echo 0)
+while [ "$cursor" -lt "${#QUEUE[@]}" ]; do
+  if ! probe; then
+    echo "$(date -u +%H:%M:%S) tunnel wedged; sleeping 600s (cursor=$cursor)" >&2
+    sleep 600
+    continue
+  fi
+  cmd="${QUEUE[$cursor]}"
+  echo "$(date -u +%H:%M:%S) === item $cursor: $cmd ===" >&2
+  eval "$cmd" >>"$LOG" 2>>"$LOG.err"
+  rc=$?
+  if [ "$rc" -eq 4 ]; then
+    echo "$(date -u +%H:%M:%S) item $cursor wedged at init (rc=4); will retry" >&2
+    sleep 600
+    continue
+  fi
+  echo "$(date -u +%H:%M:%S) item $cursor done rc=$rc" >&2
+  cursor=$((cursor + 1))
+  echo "$cursor" >"$CURSOR_FILE"
+done
+echo "$(date -u +%H:%M:%S) capture queue complete" >&2

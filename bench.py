@@ -47,9 +47,13 @@ def emit(obj):
     print(json.dumps(obj), flush=True)
 
 
+FAIL_METRIC = {"metric": "resnet50_imagenet_images_per_sec_per_chip_ampO2",
+               "unit": "images/sec/chip"}
+
+
 def fail(error, **extra):
-    out = {"metric": "resnet50_imagenet_images_per_sec_per_chip_ampO2",
-           "value": None, "unit": "images/sec/chip", "vs_baseline": None,
+    out = {"metric": FAIL_METRIC["metric"],
+           "value": None, "unit": FAIL_METRIC["unit"], "vs_baseline": None,
            "error": error, "stage": STAGE["name"],
            "stage_detail": STAGE["detail"],
            "elapsed_s": round(time.perf_counter() - T0, 1)}
@@ -394,6 +398,7 @@ def run_kernel_timing(iters=30):
     def _ab(build_fn, args, label, bucket):
         row = {}
         for arm, m in (("pallas", mode), ("xla", "off")):
+            stage("kernel_timing", f"{bucket} {label} {arm} arm")
             with pal.force_mode(m):
                 try:
                     row[f"{arm}_ms"] = round(_time(build_fn(), args) * 1e3, 4)
@@ -404,6 +409,11 @@ def run_kernel_timing(iters=30):
             row["speedup"] = round(row["xla_ms"] / row["pallas_ms"], 3)
         results[bucket][label] = row
         log(f"kernel timing {bucket} {label}: {row}")
+        # one JSON line per completed row, immediately: a later shape's
+        # hang (observed: tunnel wedge mid-matrix) must not lose the rows
+        # already measured
+        emit({"metric": "pallas_kernel_ab", "kernel": bucket,
+              "shape": label, **row})
 
     # --- fused layer norm, training shapes (tokens x hidden), fwd+bwd ---
     for (n, e), dtype in [((8192, 768), jnp.float32),
@@ -505,12 +515,18 @@ def time_compiled_step(step, batch_arrays, iters, warmup, analytic_flops,
 
     stage("warmup", f"{warmup} iters")
     state = step.state
-    for _ in range(warmup):
+    for i in range(warmup):
         state, loss = compiled(state, *batch_arrays)
-    # NOTE: jax.block_until_ready is a no-op on the experimental axon
-    # platform — only an actual device->host fetch synchronizes, so sync
-    # against a scalar fetch that data-depends on the whole step chain.
-    float(jnp.sum(state.master_params[0]))
+        # NOTE: jax.block_until_ready is a no-op on the experimental axon
+        # platform — only an actual device->host fetch synchronizes, so
+        # sync against a scalar fetch that data-depends on the whole step
+        # chain.  Per-iter (not once after the loop) so a watchdog fire
+        # names the exact iteration and the stage log records whether the
+        # step is slow or dead.
+        ti = time.perf_counter()
+        float(jnp.sum(state.master_params[0]))
+        stage("warmup", f"iter {i + 1}/{warmup} done "
+                        f"({time.perf_counter() - ti:.1f}s)")
     log(f"warm, loss={float(loss):.4f}")
 
     stage("timing", f"{iters} iters")
@@ -873,6 +889,42 @@ def main():
 
     start_watchdog(args.budget_s)
     log(f"start (watchdog {args.budget_s:.0f}s)")
+
+    # diagnostic JSON lines carry the selected config's metric name, not
+    # the resnet default (a wedged --profile run is not a resnet failure)
+    if args.profile:
+        kind = "bert" if args.bert else ("gpt" if args.gpt else "resnet")
+        FAIL_METRIC.update(metric=f"{kind}_step_op_time_attribution",
+                           unit="us_matched")
+    elif args.kernels_timing:
+        FAIL_METRIC.update(metric="pallas_kernel_speedup_vs_xla",
+                           unit="x_geomean")
+    elif args.kernels:
+        FAIL_METRIC.update(metric="pallas_kernel_parity", unit="pass")
+    elif args.gpt_decode:
+        FAIL_METRIC.update(
+            metric="gpt2_small_greedy_decode_tokens_per_sec_per_chip",
+            unit="tokens/sec/chip")
+    elif args.bert:            # same precedence as the report dispatch
+        FAIL_METRIC.update(
+            metric=f"bert_base_mlm_seq{args.seq_len}_"
+                   "sequences_per_sec_per_chip_ampO2",
+            unit="sequences/sec/chip")
+    elif args.gpt:
+        FAIL_METRIC.update(
+            metric=f"gpt2_{args.gpt_size}_causal_lm_seq{args.seq_len}_"
+                   "sequences_per_sec_per_chip_ampO2",
+            unit="sequences/sec/chip")
+    elif args.llama:
+        FAIL_METRIC.update(
+            metric=f"llama_125m_causal_lm_seq{args.seq_len}_"
+                   "sequences_per_sec_per_chip_ampO2",
+            unit="sequences/sec/chip")
+    elif args.seq2seq:
+        FAIL_METRIC.update(
+            metric=f"seq2seq_base_seq{args.seq_len}_"
+                   "sequences_per_sec_per_chip_ampO2",
+            unit="sequences/sec/chip")
 
     # validate cheap config errors BEFORE spending the backend-init
     # budget on the tunnel (and emit the promised diagnostic JSON line)

@@ -1,0 +1,124 @@
+#!/bin/bash
+# Staged on-chip diagnosis of the GPT seq-1024 warmup hang seen in the
+# round-3 `measure_all.sh` run (watchdog_timeout at stage=warmup after
+# 540s; the same config measured 211ms/step in round 2 pre-rbg-dropout,
+# pre-fused-xentropy).  Each probe isolates one suspect and is cheap to
+# kill early; probes run smallest-blast-radius first so a hang is
+# attributed to the first failing stage, not a combination.
+set -u
+cd "$(dirname "$0")"
+LOG="${DIAG_LOG:-diagnose_gpt1024.jsonl}"
+
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((64, 64)); print('probe ok:', float(jnp.sum(x @ x)))
+" 2>/dev/null
+}
+
+run() {
+  name="$1"; shift
+  echo "=== $name: $* ===" >&2
+  # exit 4 = wedged before real work, matching bench.py's code so
+  # auto_capture.sh retries this item instead of advancing past it
+  if ! probe; then echo "{\"probe\": \"$name\", \"result\": \"tunnel_dead_before\"}" >>"$LOG"; exit 4; fi
+  ( timeout "$DIAG_TIMEOUT" "$@" && echo "{\"probe\": \"$name\", \"result\": \"ok\"}" >>"$LOG" ) \
+    || echo "{\"probe\": \"$name\", \"result\": \"failed_or_timeout\"}" >>"$LOG"
+}
+
+DIAG_TIMEOUT="${DIAG_TIMEOUT:-120}"
+
+# 0. flash attention at S=1024, each arm alone, fwd then fwd+bwd.
+#    Round 3 evidence: both the GPT seq-1024 warmup AND the kernel-timing
+#    S1024 A/B hung on-chip (watchdog fired mid-shape), while S<=256
+#    attention and the full GPT seq-128 step (flash engaged) measure fine.
+#    Round 2 measured the same kernel at S=1024 at 211ms/step, so either
+#    the tunnel wedges spontaneously under long-running jobs or something
+#    environmental broke large-S flash since.
+run flash1024_pallas_fwd python - <<'EOF'
+import time, jax, jax.numpy as jnp, numpy as np
+from apex_tpu.contrib.multihead_attn.attn_funcs import flash_attention
+r = np.random.default_rng(0)
+q, k, v = (jnp.asarray(r.standard_normal((4, 12, 1024, 64)), jnp.bfloat16)
+           for _ in range(3))
+f = jax.jit(lambda q, k, v: jnp.sum(
+    flash_attention(q, k, v, causal=True).astype(jnp.float32)))
+print("compiling fwd...", flush=True)
+t = time.perf_counter(); val = float(f(q, k, v))
+print(f"fwd compile+run {time.perf_counter()-t:.1f}s val={val:.2f}", flush=True)
+t = time.perf_counter(); val = float(f(q, k, v))
+print(f"fwd warm {1e3*(time.perf_counter()-t):.1f}ms", flush=True)
+EOF
+run flash1024_pallas_bwd python - <<'EOF'
+import time, jax, jax.numpy as jnp, numpy as np
+from apex_tpu.contrib.multihead_attn.attn_funcs import flash_attention
+r = np.random.default_rng(0)
+q, k, v = (jnp.asarray(r.standard_normal((4, 12, 1024, 64)), jnp.bfloat16)
+           for _ in range(3))
+f = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+    flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2),
+    argnums=(0, 1, 2)))
+print("compiling fwd+bwd...", flush=True)
+t = time.perf_counter(); g = f(q, k, v)
+val = float(jnp.sum(g[0].astype(jnp.float32)))
+print(f"bwd compile+run {time.perf_counter()-t:.1f}s val={val:.2f}", flush=True)
+t = time.perf_counter(); g = f(q, k, v); val = float(jnp.sum(g[0].astype(jnp.float32)))
+print(f"bwd warm {1e3*(time.perf_counter()-t):.1f}ms", flush=True)
+EOF
+run flash1024_xla_arm env APEX_TPU_PALLAS=off python - <<'EOF'
+import time, jax, jax.numpy as jnp, numpy as np
+from apex_tpu.contrib.multihead_attn.attn_funcs import flash_attention
+r = np.random.default_rng(0)
+q, k, v = (jnp.asarray(r.standard_normal((4, 12, 1024, 64)), jnp.bfloat16)
+           for _ in range(3))
+f = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+    flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2),
+    argnums=(0, 1, 2)))
+print("compiling xla-arm fwd+bwd...", flush=True)
+t = time.perf_counter(); g = f(q, k, v)
+val = float(jnp.sum(g[0].astype(jnp.float32)))
+print(f"xla bwd compile+run {time.perf_counter()-t:.1f}s val={val:.2f}", flush=True)
+t = time.perf_counter(); g = f(q, k, v); val = float(jnp.sum(g[0].astype(jnp.float32)))
+print(f"xla bwd warm {1e3*(time.perf_counter()-t):.1f}ms", flush=True)
+EOF
+
+# 1. rbg alone at GPT-1024 mask shapes (and 4x larger): is the
+#    RngBitGenerator HLO itself the hang?
+run rbg_shapes python - <<'EOF'
+import time, jax, jax.numpy as jnp
+from jax import lax, random
+for shape in [(16, 1024, 768), (16, 1024, 3072), (64, 1024, 3072)]:
+    f = jax.jit(lambda k: lax.rng_bit_generator(k, shape, dtype=jnp.uint32)[1].sum())
+    k = jnp.zeros((4,), jnp.uint32)
+    t = time.perf_counter(); v = float(f(k)); dt = time.perf_counter() - t
+    t = time.perf_counter(); v = float(f(k)); dt2 = time.perf_counter() - t
+    print(f"rbg {shape}: compile+run {dt:.2f}s, warm {dt2*1e3:.1f}ms")
+EOF
+
+# 2. fused xentropy fwd+bwd at the (16384, 50257) loss shape.
+run xentropy python - <<'EOF'
+import time, jax, jax.numpy as jnp, numpy as np
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+r = np.random.default_rng(0)
+logits = jnp.asarray(r.standard_normal((16384, 50257)), jnp.bfloat16)
+labels = jnp.asarray(r.integers(0, 50257, (16384,)))
+f = jax.jit(jax.grad(lambda l: softmax_cross_entropy_loss(l, labels, 0.0, -1, True).mean()))
+t = time.perf_counter(); g = f(logits); s = float(jnp.sum(g.astype(jnp.float32))); dt = time.perf_counter() - t
+t = time.perf_counter(); g = f(logits); s = float(jnp.sum(g.astype(jnp.float32))); dt2 = time.perf_counter() - t
+print(f"xentropy grad 16384x50257: compile+run {dt:.2f}s, warm {dt2*1e3:.1f}ms")
+EOF
+
+# 3. full config minus one suspect each (short runs: 3 warmup + 5 iters).
+#    Riskiest probes (a hang here is a mid-step kill → possible re-wedge):
+#    gated behind DIAG_FULL=1 so the quick stages can run early in a
+#    healthy window and these can run at the end of the capture queue.
+[ "${DIAG_FULL:-0}" = "1" ] || { echo "quick stages done (DIAG_FULL=1 for full-config probes); results in $LOG" >&2; exit 0; }
+DIAG_TIMEOUT=650
+run gpt1024_threefry env APEX_TPU_DROPOUT_IMPL=threefry \
+    python bench.py 16 --gpt --seq-len 1024 --no-kernels --iters 5 --warmup 2 --budget-s 600
+run gpt1024_plainloss python bench.py 16 --gpt --seq-len 1024 --plain-loss \
+    --no-kernels --iters 5 --warmup 2 --budget-s 600
+# 4. the config as shipped (per-iter warmup sync now pinpoints the iter).
+run gpt1024_default python bench.py 16 --gpt --seq-len 1024 \
+    --no-kernels --iters 5 --warmup 2 --budget-s 600
+echo "diagnosis done; results in $LOG" >&2

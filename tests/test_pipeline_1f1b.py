@@ -1,0 +1,253 @@
+"""1F1B pipeline schedule (parallel/pipeline.py) vs the dense oracle on
+the CPU mesh: schedule-table invariants, the ring-buffer memory bound,
+direct gradient equality against jax.grad of the sequential stack, and
+the fused train step tracking both the dense run and the GPipe step."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.parallel import (PipelinedStack, build_1f1b_schedule,
+                               make_pipeline_train_step,
+                               pipeline_1f1b_grads, ring_slots)
+
+D, MICRO = 8, 4
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("pp",))
+
+
+def _stage_fn(params, x):
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+def _params(rng, n_stages):
+    w = jnp.asarray(rng.standard_normal((n_stages, D, D)) * 0.5, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((n_stages, D)) * 0.1, jnp.float32)
+    return w, b
+
+
+def _dense_apply(w, b, x):
+    for i in range(w.shape[0]):
+        x = jnp.tanh(x @ w[i] + b[i])
+    return x
+
+
+@pytest.mark.parametrize("n,m", [(1, 4), (2, 3), (4, 4), (4, 9), (8, 2)])
+def test_schedule_tables_invariants(n, m):
+    """Every (stage, microbatch) forwards and backwards exactly once; a
+    stage's input arrives exactly one tick before it forwards it; a
+    cotangent arrives exactly one tick before it backwards it; backward
+    never precedes the same microbatch's forward at that stage."""
+    fwd, bwd = build_1f1b_schedule(n, m)
+    assert fwd.shape == bwd.shape == (m + 2 * (n - 1), n)
+    tf = np.full((n, m), -1)
+    tb = np.full((n, m), -1)
+    for t in range(fwd.shape[0]):
+        for s in range(n):
+            if fwd[t, s] >= 0:
+                assert tf[s, fwd[t, s]] == -1
+                tf[s, fwd[t, s]] = t
+            if bwd[t, s] >= 0:
+                assert tb[s, bwd[t, s]] == -1
+                tb[s, bwd[t, s]] = t
+    assert (tf >= 0).all() and (tb >= 0).all()
+    for s in range(n):
+        for mb in range(m):
+            if s > 0:
+                assert tf[s, mb] == tf[s - 1, mb] + 1
+            if s < n - 1:
+                assert tb[s, mb] == tb[s + 1, mb] + 1
+            assert tb[s, mb] >= tf[s, mb]
+            # the 1F1B residency bound: input live from forward tick to
+            # backward tick, bounded independent of m
+            assert tb[s, mb] - tf[s, mb] <= 2 * (n - 1)
+
+
+def test_ring_slots_bounded_independent_of_microbatches():
+    assert ring_slots(4, 64) == 7          # 2n-1, NOT m + n - 1
+    assert ring_slots(4, 3) == 3           # never more slots than batches
+    assert ring_slots(1, 16) == 1
+    # the bound the GPipe scan pays instead grows with n_micro
+    assert ring_slots(4, 64) < 64 + 4 - 1
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(1, 4), (4, 4), (4, 9),
+                                              (8, 3)])
+def test_1f1b_grads_match_dense_oracle(rng, n_stages, n_micro):
+    mesh = _mesh(n_stages)
+    w, b = _params(rng, n_stages)
+    xs = jnp.asarray(rng.standard_normal((n_micro, MICRO, D)), jnp.float32)
+    ys = jnp.asarray(rng.standard_normal((n_micro, MICRO, D)), jnp.float32)
+
+    def loss_fn(y, yref):
+        return jnp.mean((y - yref) ** 2)
+
+    def run(w, b, xs, ys):
+        i = jax.lax.axis_index("pp")
+        local = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            (w, b))
+        loss, g = pipeline_1f1b_grads(_stage_fn, local, xs, ys, loss_fn,
+                                      "pp")
+        g = jax.tree.map(
+            lambda gi, full: jax.lax.psum(
+                jax.lax.dynamic_update_index_in_dim(
+                    jnp.zeros(full.shape, jnp.float32), gi, i, 0), "pp"),
+            g, (w, b))
+        return loss, g
+
+    loss, (gw, gb) = jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=(P(), P(), P(), P()),
+        out_specs=(P(), (P(), P())), check_vma=False))(w, b, xs, ys)
+
+    def ref(w, b):
+        per = [loss_fn(_dense_apply(w, b, xs[i]), ys[i])
+               for i in range(n_micro)]
+        return sum(per) / n_micro
+
+    want, (gw_r, gb_r) = jax.value_and_grad(ref, argnums=(0, 1))(w, b)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_r),
+                               rtol=3e-5, atol=3e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_r),
+                               rtol=3e-5, atol=3e-5)
+
+
+def test_1f1b_cotangent_scale_scales_grads_not_loss(rng):
+    mesh = _mesh(4)
+    w, b = _params(rng, 4)
+    xs = jnp.asarray(rng.standard_normal((4, MICRO, D)), jnp.float32)
+    ys = jnp.asarray(rng.standard_normal((4, MICRO, D)), jnp.float32)
+
+    def loss_fn(y, yref):
+        return jnp.mean((y - yref) ** 2)
+
+    def run(scale, w, b, xs, ys):
+        i = jax.lax.axis_index("pp")
+        local = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            (w, b))
+        loss, g = pipeline_1f1b_grads(_stage_fn, local, xs, ys, loss_fn,
+                                      "pp", cotangent_scale=scale)
+        return loss, jax.lax.psum(jnp.sum(jnp.abs(g[0])), "pp")
+
+    f = jax.jit(jax.shard_map(
+        functools.partial(run), mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P()), out_specs=(P(), P()),
+        check_vma=False), static_argnums=())
+    l1, g1 = f(jnp.float32(1.0), w, b, xs, ys)
+    l128, g128 = f(jnp.float32(128.0), w, b, xs, ys)
+    np.testing.assert_allclose(float(l1), float(l128), rtol=1e-6)
+    np.testing.assert_allclose(float(g128), 128.0 * float(g1), rtol=1e-4)
+
+
+def test_1f1b_step_matches_dense_and_gpipe(rng):
+    """make_pipeline_train_step(schedule='1f1b') trains identically to a
+    dense sequential run of the same stages (mean-reduction loss) and to
+    the GPipe-schedule step."""
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    n_stages, n_micro, batch = 4, 4, 16
+    mesh = _mesh(n_stages)
+    w, bias = _params(rng, n_stages)
+    x = jnp.asarray(rng.standard_normal((batch, D)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((batch, D)), jnp.float32)
+
+    def loss_fn(out, y):
+        return jnp.mean((out - y) ** 2)
+
+    class Dense:
+        def __init__(self):
+            from apex_tpu.nn.parameter import Parameter
+            self._w = Parameter(w)
+            self._b = Parameter(bias)
+            self.training = True
+
+        def parameters(self):
+            return [self._w, self._b]
+
+        def buffers(self):
+            return []
+
+        def modules(self):
+            return []
+
+        def forward(self, ctx, x):
+            return _dense_apply(ctx.value(self._w), ctx.value(self._b), x)
+
+    dense = Dense()
+    step_d = make_train_step(dense, FusedAdam(dense.parameters(), lr=1e-2),
+                             loss_fn, half_dtype=None, loss_scale=1.0)
+    ref_losses = [float(step_d(x, y)) for _ in range(8)]
+
+    losses = {}
+    for schedule in ("1f1b", "gpipe"):
+        stack = PipelinedStack(_stage_fn, (w, bias), "pp", n_micro=n_micro)
+        step = make_pipeline_train_step(
+            stack, FusedAdam(stack.parameters(), lr=1e-2), loss_fn,
+            schedule=schedule, half_dtype=None, loss_scale=1.0)
+        sharded = jax.jit(jax.shard_map(
+            step._step_fn, mesh=mesh, in_specs=(P(), P(), P()),
+            out_specs=(P(), P()), check_vma=False))
+        state, ls = step.state, []
+        for _ in range(8):
+            state, l = sharded(state, x, y)
+            ls.append(float(l))
+        losses[schedule] = ls
+    np.testing.assert_allclose(losses["1f1b"], ref_losses,
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(losses["gpipe"], ref_losses,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_1f1b_step_bf16_dynamic_scale_converges(rng):
+    """The 1F1B step composes with amp: bf16 stage compute + dynamic loss
+    scaling, loss decreasing over steps."""
+    from apex_tpu.optimizers import FusedSGD
+
+    n_stages, n_micro, batch = 4, 8, 32
+    mesh = _mesh(n_stages)
+    w, bias = _params(rng, n_stages)
+    x = jnp.asarray(rng.standard_normal((batch, D)), jnp.float32)
+    y = jnp.asarray(np.tanh(rng.standard_normal((batch, D))), jnp.float32)
+
+    def loss_fn(out, y):
+        return jnp.mean((out.astype(jnp.float32) - y) ** 2)
+
+    stack = PipelinedStack(_stage_fn, (w, bias), "pp", n_micro=n_micro)
+    step = make_pipeline_train_step(
+        stack, FusedSGD(stack.parameters(), lr=0.05, momentum=0.9),
+        loss_fn, half_dtype=jnp.bfloat16)
+    sharded = jax.jit(jax.shard_map(
+        step._step_fn, mesh=mesh, in_specs=(P(), P(), P()),
+        out_specs=(P(), P()), check_vma=False))
+    state = step.state
+    losses = []
+    for _ in range(30):
+        state, l = sharded(state, x, y)
+        losses.append(float(l))
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_1f1b_rejects_remat_stack_and_bad_schedule(rng):
+    from apex_tpu.optimizers import FusedAdam
+
+    w, bias = _params(rng, 4)
+    stack = PipelinedStack(_stage_fn, (w, bias), "pp", n_micro=4,
+                           remat_stage=True)
+    with pytest.raises(ValueError, match="remat_stage=False"):
+        make_pipeline_train_step(
+            stack, FusedAdam(stack.parameters(), lr=1e-2),
+            lambda o, y: jnp.mean((o - y) ** 2), schedule="1f1b")
+    stack2 = PipelinedStack(_stage_fn, (w, bias), "pp", n_micro=4)
+    with pytest.raises(ValueError, match="gpipe.*1f1b|1f1b.*gpipe"):
+        make_pipeline_train_step(
+            stack2, FusedAdam(stack2.parameters(), lr=1e-2),
+            lambda o, y: jnp.mean((o - y) ** 2), schedule="2f2b")
