@@ -1,0 +1,4 @@
+"""Inference-side utilities: weight-only int8 quantization for the
+bandwidth-bound decode path (see quant.py for the rationale)."""
+from .quant import (QuantTensor, quantize_int8,  # noqa: F401
+                    quantize_tensor_int8)

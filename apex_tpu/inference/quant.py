@@ -1,0 +1,114 @@
+"""Weight-only int8 quantization for inference.
+
+TPU decode is HBM-bandwidth-bound: each generated token re-reads every
+weight, so halving weight bytes (bf16 -> int8) is a direct lever on
+tokens/sec (v5e HBM ~819 GB/s; a 124M-param model at bf16 reads ~250MB
+per token).  This is weight-ONLY quantization (w8a16): weights live in
+HBM as int8 with one fp scale per output row and are dequantized at the
+point of use — XLA fuses the ``int8 -> compute-dtype multiply`` into the
+consuming matmul, so the full-precision weight tensor never
+materializes in HBM.  Compute stays bf16/f32 on the MXU; there is no
+activation quantization and no calibration step (absmax per row is
+exact for weights).
+
+The reference has no inference path at all (it is a training-side
+library; SURVEY.md §2) — this extends the framework's own decode story
+(models/gpt.py:generate).
+
+Usage::
+
+    model = llama_from_hf(hf)           # or any family
+    quantize_int8(model)                # in place; model is now eval-only
+    out = generate(model, prompt, 128)  # decode reads int8 weights
+
+Mechanism: each selected ``Parameter.data`` is replaced by a
+:class:`QuantTensor` — a pytree of ``(int8 values, per-row scales)``
+that ``Ctx.value`` dequantizes on access inside the jitted program.
+Quantized models are inference-only: the train-step builders coerce
+``p.data`` through ``jnp.array`` and fail loudly on a QuantTensor.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantTensor(NamedTuple):
+    """Int8 weight + per-leading-row scale; dequantizes to
+    ``scale.dtype``.  A NamedTuple of arrays, so it traverses jit/pytree
+    boundaries like any array container."""
+    q: jax.Array          # int8, the original shape
+    scale: jax.Array      # (rows, 1, ..., 1) broadcast shape, fp
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.scale.dtype
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def size(self):
+        return self.q.size
+
+    def dequant(self):
+        return self.q.astype(self.scale.dtype) * self.scale
+
+
+def quantize_tensor_int8(x, dtype=None):
+    """Absmax-per-row symmetric int8: ``x (rows, ...)`` -> QuantTensor
+    with one scale per leading row (for a torch-layout ``(out, in)``
+    Linear weight that is per-output-channel; for an embedding, per
+    vocab row).  ``dtype``: dequantization dtype (default: x's)."""
+    x = jnp.asarray(x)
+    if x.ndim < 2:
+        raise ValueError(
+            f"quantize_tensor_int8 expects a >=2-D weight, got shape "
+            f"{x.shape} — 1-D params (norms/biases) stay full precision")
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)),
+                     axis=tuple(range(1, x.ndim)), keepdims=True)
+    # round against the scale AS STORED (post-cast): quantization and
+    # dequantization must use the identical scale value, or the
+    # round-trip error bound silently grows by the cast's rounding
+    scale = (jnp.maximum(absmax, 1e-12) / 127.0).astype(dtype or x.dtype)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32)
+                           / scale.astype(jnp.float32)), -127, 127) \
+        .astype(jnp.int8)
+    return QuantTensor(q, scale)
+
+
+def quantize_int8(model, min_size=4096, dtype=None):
+    """Quantize a model's weight matrices to int8 in place, for decode.
+
+    Every parameter with ``ndim >= 2`` and at least ``min_size`` elements
+    is replaced (Linear/projection weights, embeddings); 1-D params
+    (norm scales, biases) and small tensors stay full precision — their
+    bytes are noise and their dynamic range matters.  Returns the model
+    (now in ``eval()`` mode).  The change is inference-only: building a
+    train step over a quantized model raises.  ``dtype`` sets the
+    dequantization dtype (default: each weight's own; pass
+    ``jnp.bfloat16`` to also cast compute).
+    """
+    n = 0
+    for p in model.parameters():
+        if p is None or getattr(p, "_derived", None) is not None:
+            continue
+        d = p.data
+        if isinstance(d, QuantTensor):
+            continue
+        if d.ndim >= 2 and d.size >= min_size:
+            p.data = quantize_tensor_int8(d, dtype=dtype)
+            n += 1
+    if n == 0:
+        raise ValueError(
+            f"quantize_int8: no parameter met the criteria (ndim >= 2, "
+            f"size >= {min_size}) — nothing was quantized")
+    model.eval()
+    return model

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from . import functional as F
 from .parameter import Parameter
+from ..inference.quant import QuantTensor
 
 # lazy: creating a PRNGKey at import would initialize the device backend
 # (and open the TPU connection) for every process that merely imports the
@@ -85,14 +86,20 @@ class Ctx:
 
     def value(self, p):
         v = self.env.get(id(p))
-        if v is not None:
-            return v
-        d = getattr(p, "_derived", None)
-        if d is not None:
-            # derived (reparameterized) parameter: compute from its source
-            # parameters through this ctx so autodiff reaches them
-            return d(self)
-        return p.data
+        if v is None:
+            d = getattr(p, "_derived", None)
+            if d is not None:
+                # derived (reparameterized) parameter: compute from its
+                # source parameters through this ctx so autodiff reaches
+                # them
+                return d(self)
+            v = p.data
+        if isinstance(v, QuantTensor):
+            # int8-quantized weight (inference/quant.py): dequantize at
+            # the point of use — XLA fuses the multiply into the
+            # consuming matmul, so only int8 bytes cross HBM
+            return v.dequant()
+        return v
 
     def write_stat(self, buf: Buffer, value):
         if self.stats_out is None:

@@ -184,6 +184,13 @@ def init_step_state(params, buffers, model_dtypes, opt_init, init_scale):
     no-op view for already-fp32 params, and the state is donated — without
     the copy the first step would delete the live Parameter.data /
     Buffer.data arrays out from under the model."""
+    from ..inference.quant import QuantTensor
+    for p in params:
+        if isinstance(p.data, QuantTensor):
+            raise ValueError(
+                "this model has int8-quantized weights "
+                "(apex_tpu.inference.quantize_int8) — quantized models "
+                "are inference-only; rebuild/reload the model to train")
     masters0 = [jnp.array(p.data, dtype=jnp.float32, copy=True)
                 for p in params]
     return StepState(

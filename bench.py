@@ -773,9 +773,11 @@ def run_llama_throughput(batch, seq_len, iters, warmup, remat=False,
                               pallas_attn_flops=paf)
 
 
-def run_decode_throughput(batch, seq_len, new_tokens=128):
+def run_decode_throughput(batch, seq_len, new_tokens=128, int8=False):
     """Greedy KV-cache decode tokens/s (gpt2-small): one warm compiled
-    call timed via value fetch."""
+    call timed via value fetch.  ``int8=True`` quantizes the weight
+    matrices (weight-only w8a16, inference/quant.py) first — decode is
+    HBM-bound, so halved weight bytes should show as tokens/s."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -783,11 +785,15 @@ def run_decode_throughput(batch, seq_len, new_tokens=128):
     import apex_tpu.nn as nn
     from apex_tpu.models import generate, gpt2_small
 
-    stage("model_build", f"gpt2_small decode batch={batch}")
+    stage("model_build", f"gpt2_small decode batch={batch}"
+          + (" int8" if int8 else ""))
     nn.manual_seed(0)
     model = gpt2_small(max_positions=seq_len + new_tokens,
                        attn_dropout=0.0, dropout=0.0)
     model.eval()
+    if int8:
+        from apex_tpu.inference import quantize_int8
+        quantize_int8(model)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, 50257, (batch, seq_len)))
 
@@ -864,6 +870,9 @@ def main():
                     help="run the GPT-2-small causal-LM config")
     ap.add_argument("--gpt-decode", action="store_true",
                     help="measure greedy KV-cache decode tokens/s")
+    ap.add_argument("--int8", action="store_true",
+                    help="with --gpt-decode: weight-only int8 "
+                         "quantization (w8a16) before decoding")
     ap.add_argument("--seq2seq", action="store_true",
                     help="run the transformer-base seq2seq config")
     ap.add_argument("--seq-len", type=int, default=128)
@@ -890,44 +899,50 @@ def main():
     start_watchdog(args.budget_s)
     log(f"start (watchdog {args.budget_s:.0f}s)")
 
-    # diagnostic JSON lines carry the selected config's metric name, not
-    # the resnet default (a wedged --profile run is not a resnet failure)
-    if args.profile:
-        kind = "bert" if args.bert else ("gpt" if args.gpt else "resnet")
-        FAIL_METRIC.update(metric=f"{kind}_step_op_time_attribution",
-                           unit="us_matched")
-    elif args.kernels_timing:
-        FAIL_METRIC.update(metric="pallas_kernel_speedup_vs_xla",
-                           unit="x_geomean")
-    elif args.kernels:
-        FAIL_METRIC.update(metric="pallas_kernel_parity", unit="pass")
-    elif args.gpt_decode:
-        FAIL_METRIC.update(
-            metric="gpt2_small_greedy_decode_tokens_per_sec_per_chip",
-            unit="tokens/sec/chip")
-    elif args.bert:            # same precedence as the report dispatch
-        FAIL_METRIC.update(
-            metric=f"bert_base_mlm_seq{args.seq_len}_"
-                   "sequences_per_sec_per_chip_ampO2",
-            unit="sequences/sec/chip")
-    elif args.gpt:
-        FAIL_METRIC.update(
-            metric=f"gpt2_{args.gpt_size}_causal_lm_seq{args.seq_len}_"
-                   "sequences_per_sec_per_chip_ampO2",
-            unit="sequences/sec/chip")
-    elif args.llama:
-        FAIL_METRIC.update(
-            metric=f"llama_125m_causal_lm_seq{args.seq_len}_"
-                   "sequences_per_sec_per_chip_ampO2",
-            unit="sequences/sec/chip")
-    elif args.seq2seq:
-        FAIL_METRIC.update(
-            metric=f"seq2seq_base_seq{args.seq_len}_"
-                   "sequences_per_sec_per_chip_ampO2",
-            unit="sequences/sec/chip")
+    # ONE metric name per config, used by both the failure diagnostics
+    # (fail()) and the success emit paths below — computed here so a
+    # rename can never desync a wedged run's JSON from a successful
+    # run's.  Branch order mirrors the dispatch order below.
+    def config_metric():
+        if args.profile:
+            kind = "bert" if args.bert else ("gpt" if args.gpt else "resnet")
+            return f"{kind}_step_op_time_attribution", "us_matched"
+        if args.kernels_timing:
+            return "pallas_kernel_speedup_vs_xla", "x_geomean"
+        if args.kernels:
+            return "pallas_kernel_parity", "pass"
+        if args.gpt_decode:
+            q = "_int8" if args.int8 else ""
+            return (f"gpt2_small_greedy_decode{q}_tokens_per_sec_per_chip",
+                    "tokens/sec/chip")
+        if args.bert:
+            return (f"bert_base_mlm_seq{args.seq_len}_"
+                    "sequences_per_sec_per_chip_ampO2",
+                    "sequences/sec/chip")
+        if args.gpt:
+            return (f"gpt2_{args.gpt_size}_causal_lm_seq{args.seq_len}_"
+                    "sequences_per_sec_per_chip_ampO2",
+                    "sequences/sec/chip")
+        if args.llama:
+            return (f"llama_125m_causal_lm_seq{args.seq_len}_"
+                    "sequences_per_sec_per_chip_ampO2",
+                    "sequences/sec/chip")
+        if args.seq2seq:
+            return (f"seq2seq_base_seq{args.seq_len}_"
+                    "sequences_per_sec_per_chip_ampO2",
+                    "sequences/sec/chip")
+        return "resnet50_imagenet_images_per_sec_per_chip_ampO2", \
+            "images/sec/chip"
+
+    metric_name, metric_unit = config_metric()
+    FAIL_METRIC.update(metric=metric_name, unit=metric_unit)
 
     # validate cheap config errors BEFORE spending the backend-init
     # budget on the tunnel (and emit the promised diagnostic JSON line)
+    if args.int8 and not args.gpt_decode:
+        fail("int8_unsupported_config: --int8 is the weight-only "
+             "quantized DECODE measurement; pair it with --gpt-decode")
+        return 1
     sweep_batches = None
     if args.sweep:
         if args.profile or args.kernels or args.kernels_timing \
@@ -965,8 +980,8 @@ def main():
         except Exception as e:
             fail(f"profile_failed: {type(e).__name__}: {e}")
             return 1
-        emit({"metric": f"{kind}_step_op_time_attribution",
-              "value": res["matched_us"], "unit": "us_matched",
+        emit({"metric": metric_name,
+              "value": res["matched_us"], "unit": metric_unit,
               "vs_baseline": None, **res})
         return 0
 
@@ -977,9 +992,9 @@ def main():
         except Exception as e:
             fail(f"kernel_timing_failed: {type(e).__name__}: {e}")
             return 1
-        emit({"metric": "pallas_kernel_speedup_vs_xla",
+        emit({"metric": metric_name,
               "value": round(gmean, 3) if gmean else None,
-              "unit": "x_geomean", "vs_baseline": None, "kernels": res})
+              "unit": metric_unit, "vs_baseline": None, "kernels": res})
         return 0
 
     if args.kernels:
@@ -989,20 +1004,20 @@ def main():
               and res.get("rms_norm") == "pass"
               and res.get("attention") == "pass"
               and res.get("vmem_guard") == "pass")
-        emit({"metric": "pallas_kernel_parity", "value": 1.0 if ok else 0.0,
-              "unit": "pass", "vs_baseline": None, "kernels": res})
+        emit({"metric": metric_name, "value": 1.0 if ok else 0.0,
+              "unit": metric_unit, "vs_baseline": None, "kernels": res})
         return 0
 
     if args.gpt_decode:
         batch = args.batch or 8
         try:
             toks, dt, compile_s = run_decode_throughput(
-                batch, args.seq_len)
+                batch, args.seq_len, int8=args.int8)
         except Exception as e:
             fail(f"decode_failed: {type(e).__name__}: {e}")
             return 1
-        emit({"metric": "gpt2_small_greedy_decode_tokens_per_sec_per_chip",
-              "value": round(toks, 1), "unit": "tokens/sec/chip",
+        emit({"metric": metric_name,
+              "value": round(toks, 1), "unit": metric_unit,
               "vs_baseline": None, "batch": batch,
               "prompt_len": args.seq_len, "new_tokens": 128,
               "call_time_s": round(dt, 3),
@@ -1104,30 +1119,13 @@ def main():
             kernels = {"error": f"{type(e).__name__}: {e}"}
 
     stage("report")
-    if args.bert:
-        metric = (f"bert_base_mlm_seq{args.seq_len}_"
-                  "sequences_per_sec_per_chip_ampO2")
-        unit, vs_baseline = "sequences/sec/chip", None
-    elif args.gpt:
-        metric = (f"gpt2_{args.gpt_size}_causal_lm_seq{args.seq_len}_"
-                  "sequences_per_sec_per_chip_ampO2")
-        unit, vs_baseline = "sequences/sec/chip", None
-    elif args.llama:
-        metric = (f"llama_125m_causal_lm_seq{args.seq_len}_"
-                  "sequences_per_sec_per_chip_ampO2")
-        unit, vs_baseline = "sequences/sec/chip", None
-    elif args.seq2seq:
-        metric = (f"seq2seq_base_seq{args.seq_len}_"
-                  "sequences_per_sec_per_chip_ampO2")
-        unit, vs_baseline = "sequences/sec/chip", None
-    else:
-        metric = "resnet50_imagenet_images_per_sec_per_chip_ampO2"
-        unit = "images/sec/chip"
-        vs_baseline = round(imgs_per_sec / V100_APEX_O2_IMGS_PER_SEC, 3)
+    is_resnet = not (args.bert or args.gpt or args.llama or args.seq2seq)
+    vs_baseline = (round(imgs_per_sec / V100_APEX_O2_IMGS_PER_SEC, 3)
+                   if is_resnet else None)
     emit({
-        "metric": metric,
+        "metric": metric_name,
         "value": round(imgs_per_sec, 1),
-        "unit": unit,
+        "unit": metric_unit,
         "vs_baseline": vs_baseline,
         "batch": batch,
         "step_time_ms": round(dt * 1e3, 2),

@@ -165,3 +165,106 @@ def test_bf16_checkpoint_loads(rng):
     ids = _ids(rng, b=1, s=7)
     got = np.asarray(model(jnp.asarray(ids)).value)
     assert np.isfinite(got).all()
+
+
+# ---------------------------------------------------------------------------
+# Llama / Mistral family (llama_from_hf)
+# ---------------------------------------------------------------------------
+
+L_VOCAB, L_HIDDEN, L_LAYERS, L_HEADS, L_KV = 211, 64, 2, 4, 2
+
+
+def _hf_llama(seed=0, tied=False, **over):
+    cfg = transformers.LlamaConfig(
+        vocab_size=L_VOCAB, hidden_size=L_HIDDEN,
+        num_hidden_layers=L_LAYERS, num_attention_heads=L_HEADS,
+        num_key_value_heads=L_KV, intermediate_size=96,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        rope_theta=10000.0, attention_dropout=0.0,
+        tie_word_embeddings=tied, **over)
+    torch.manual_seed(seed)
+    m = transformers.LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _lids(rng, b=3, s=13):
+    return rng.integers(0, L_VOCAB, (b, s))
+
+
+def test_llama_logit_parity(rng):
+    """Converted LlamaModel (GQA, rotate_half RoPE, RMSNorm, SwiGLU)
+    reproduces transformers' torch forward logits."""
+    from apex_tpu.models import llama_from_hf
+
+    hf = _hf_llama()
+    ids = _lids(rng)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+    model = llama_from_hf(hf)
+    got = np.asarray(model(jnp.asarray(ids)).value)
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_llama_from_state_dict_requires_heads(rng):
+    from apex_tpu.models import llama_from_hf
+
+    hf = _hf_llama(seed=1)
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    with pytest.raises(ValueError, match="heads="):
+        llama_from_hf(sd)
+    ids = _lids(rng, b=2, s=9)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+    model = llama_from_hf(sd, heads=L_HEADS)   # kv_heads from the tensors
+    assert model.blocks[0].kv_heads == L_KV
+    got = np.asarray(model(jnp.asarray(ids)).value)
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_llama_tied_checkpoint_parity(rng):
+    """tie_word_embeddings checkpoints serialize no lm_head.weight; the
+    embedding loads into the (untied here) head — the tied forward."""
+    from apex_tpu.models import llama_from_hf
+
+    hf = _hf_llama(seed=2, tied=True)
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    # state_dict() materializes the tied alias; serialized tied
+    # checkpoints (safetensors dedup) ship without the key — simulate
+    sd.pop("lm_head.weight", None)
+    ids = _lids(rng, b=2, s=8)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(llama_from_hf(sd, heads=L_HEADS)(
+        jnp.asarray(ids)).value)
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_llama_converted_decodes(rng):
+    """KV-cache greedy decode from the converted model matches its own
+    full-forward argmax continuation (GQA cache path included)."""
+    from apex_tpu.models import llama_from_hf
+    from apex_tpu.models.gpt import generate
+
+    model = llama_from_hf(_hf_llama(seed=3))
+    prompt = jnp.asarray(_lids(rng, b=2, s=6))
+    out = generate(model, prompt, max_new_tokens=5)
+    assert out.shape == (2, 11)
+    # oracle: re-run the full forward argmax step by step
+    cur = prompt
+    for _ in range(5):
+        logits = model(cur).value
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
+def test_llama_geometry_inferred():
+    from apex_tpu.models import llama_from_hf
+
+    model = llama_from_hf(_hf_llama())
+    assert model.hidden == L_HIDDEN
+    assert len(model.blocks) == L_LAYERS
+    assert model.blocks[0].heads == L_HEADS
+    assert model.blocks[0].kv_heads == L_KV
+    assert not model.training
