@@ -1,0 +1,184 @@
+"""Greedy speculative decoding: a small draft model proposes, the target
+model verifies — decode latency drops while the OUTPUT IS EXACTLY the
+target model's own greedy decode.
+
+Why it works on TPU: single-token decode is HBM-bound (every step
+re-reads all weights to produce one token), but scoring ``k+1`` tokens
+in one cached forward (``decode_chunk``) costs nearly the same HBM
+traffic as scoring one.  So let a cheap draft model propose ``k`` tokens
+autoregressively and the expensive target verify them in ONE chunk:
+each accepted prefix amortizes the target's weight reads over several
+tokens.  With greedy acceptance the guarantee is exact: a draft token is
+accepted iff it equals the target's own argmax, so the emitted sequence
+matches ``generate(target, temperature=0)`` for ANY draft — the draft
+only changes speed, never output
+(tests/test_speculative.py::test_output_matches_target_greedy).  The
+one caveat is floating point, not logic: the chunked and single-token
+paths share one attention body (LlamaBlock.decode delegates to
+decode_chunk), but XLA may reduce the two shapes in different orders,
+and an exact argmax TIE between top-2 logits can then resolve
+differently.  Tests assert bit-identity; bench tolerates a rare tie.
+
+Both models must expose the cache protocol of the Llama family
+(``init_caches`` / ``decode_step`` / ``decode_chunk``) and share a
+vocabulary.  Pair naturally with weight-only int8 on the draft
+(quant.py) — the draft's quality only gates the acceptance rate.
+
+Cache-staleness invariant (why rejected tokens need no cleanup): cache
+entries are indexed by position and attention masks strictly by
+position, so a slot written by a later-rejected token is invisible until
+the position is re-fed — and re-feeding overwrites the slot first.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def speculative_generate(target, draft, prompt_ids, max_new_tokens,
+                         k=4, cache_dtype=None):
+    """Greedy decode of ``target`` accelerated by ``draft`` proposals.
+
+    ``prompt_ids (B, P)`` -> ``(B, P + max_new_tokens)``, bit-identical
+    to ``generate(target, prompt_ids, max_new_tokens)`` (greedy).
+    ``k``: draft tokens proposed per verification chunk; each round
+    accepts between 1 and ``k + 1`` tokens (the verified draft prefix
+    plus the target's own next token), so rounds <= max_new_tokens.
+
+    The batch runs in LOCKSTEP: every round advances all rows by the
+    batch-minimum accepted count (the cache protocol takes one position
+    for the whole batch).  This is exactly correct — a position re-fed
+    next round reproduces the identical greedy token, since emitted
+    tokens are always the target's own argmax — it only costs some
+    acceptance on rows that agreed further.  Batch 1 pays no such tax.
+    """
+    from ..nn.modules import Ctx
+
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    for name, m in (("target", target), ("draft", draft)):
+        if not (hasattr(m, "decode_chunk") and hasattr(m, "prefill")):
+            raise ValueError(
+                f"speculative_generate needs {name}.decode_chunk and "
+                f"{name}.prefill (the Llama-family cache protocol)")
+    b, p = prompt_ids.shape
+    if p < 1:
+        raise ValueError("prompt must hold at least one token")
+    s_total = p + max_new_tokens
+    # chunk writes may touch up to k+1 positions past the last needed
+    # one on already-finished rows; pad the buffers so they stay in
+    # bounds (extra slots are never emitted)
+    s_buf = s_total + k + 1
+    for name, m in (("target", target), ("draft", draft)):
+        if s_buf > m.max_positions:
+            raise ValueError(
+                f"{name}.max_positions ({m.max_positions}) < prompt + "
+                f"max_new_tokens + k + 1 ({s_buf}) — speculative "
+                f"verification needs k+1 slack positions")
+
+    t_params = [q for q in target.parameters()] + list(target.buffers())
+    d_params = [q for q in draft.parameters()] + list(draft.buffers())
+    t_vals = [q.data for q in t_params]
+    d_vals = [q.data for q in d_params]
+
+    def run(t_vals, d_vals, prompt_ids):
+        t_ctx = Ctx(env={id(o): v for o, v in zip(t_params, t_vals)},
+                    stats_out={}, training=False)
+        d_ctx = Ctx(env={id(o): v for o, v in zip(d_params, d_vals)},
+                    stats_out={}, training=False)
+        # cache dtypes default per model to the embedding dtype, the
+        # same rule generate() uses — the exactness guarantee compares
+        # against generate(target), so the target must score through
+        # identically-typed caches
+        t_dtype = cache_dtype or target.tok_emb.weight.data.dtype
+        d_dtype = cache_dtype or draft.tok_emb.weight.data.dtype
+        t_caches = target.init_caches(b, s_buf, dtype=t_dtype)
+        d_caches = draft.init_caches(b, s_buf, dtype=d_dtype)
+
+        ids = jnp.concatenate(
+            [prompt_ids, jnp.zeros((b, s_buf - p), prompt_ids.dtype)],
+            axis=1)
+
+        # prefill both models on the prompt (flash path, same program
+        # generate() prefills with; a 1-token prompt goes through
+        # decode_chunk — generate() keeps the step path there too);
+        # token at position p is the target's continuation
+        if p > 1:
+            t_logits, t_caches = target.prefill(t_ctx, ids[:, :p],
+                                                t_caches)
+            _, d_caches = draft.prefill(d_ctx, ids[:, :p], d_caches)
+        else:
+            t_logits, t_caches = target.decode_chunk(
+                t_ctx, ids[:, :1], t_caches, jnp.int32(0))
+            _, d_caches = draft.decode_chunk(
+                d_ctx, ids[:, :1], d_caches, jnp.int32(0))
+        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(ids.dtype)
+        ids = jax.lax.dynamic_update_slice(ids, first[:, None], (0, p))
+
+        # m: position of the last known-but-unfed token (scalar — the
+        # batch is lockstep); tokens are needed through s_total - 1
+        m0 = jnp.int32(p)
+
+        def cond(carry):
+            ids, m, t_caches, d_caches = carry
+            return m < s_total - 1
+
+        def body(carry):
+            ids, m, t_caches, d_caches = carry
+
+            # --- draft proposes k tokens (k+1 single steps feeding its
+            #     own argmax chain from ids[:, m], so its cache also
+            #     covers position m+k for the all-accepted case) ---
+            def d_step(carry, _):
+                tok, d_caches, t = carry
+                logits, d_caches = draft.decode_step(d_ctx, tok, d_caches,
+                                                     t)
+                nxt = jnp.argmax(logits, axis=-1).astype(ids.dtype)
+                return (nxt, d_caches, t + 1), nxt
+
+            tok0 = jax.lax.dynamic_slice(ids, (0, m), (b, 1))[:, 0]
+            (_, d_caches, _), props = jax.lax.scan(
+                d_step, (tok0, d_caches, m), None, length=k + 1)
+            drafts = jnp.swapaxes(props, 0, 1)[:, :k]   # (B, k) d_1..d_k
+
+            # --- target verifies [ids[m], d_1..d_k] in one chunk ---
+            chunk = jnp.concatenate([tok0[:, None], drafts], axis=1)
+            t_logits, t_caches = target.decode_chunk(
+                t_ctx, chunk, t_caches, m)
+            greedy = jnp.argmax(t_logits, axis=-1).astype(ids.dtype)
+            # longest prefix where draft == target argmax, per row; the
+            # lockstep advance is the batch minimum
+            agree = drafts == greedy[:, :k]
+            acc = jnp.argmin(
+                jnp.concatenate([agree, jnp.zeros((b, 1), bool)], axis=1)
+                .astype(jnp.int32), axis=1)             # (B,) in [0, k]
+            n_round = jnp.min(acc) + 1                  # in [1, k+1]
+            # emit greedy[:, :n_round] (accepted drafts EQUAL the greedy
+            # tokens on the agreed prefix, so the target argmax chain is
+            # the emission for every row)
+            cur = jax.lax.dynamic_slice(ids, (0, m + 1), (b, k + 1))
+            merged = jnp.where(
+                jnp.arange(k + 1)[None, :] < n_round, greedy, cur)
+            ids = jax.lax.dynamic_update_slice(ids, merged, (0, m + 1))
+            return ids, jnp.minimum(m + n_round, s_total - 1), \
+                t_caches, d_caches
+
+        ids, _, _, _ = jax.lax.while_loop(cond, body, (ids, m0, t_caches,
+                                                       d_caches))
+        return ids[:, :s_total]
+
+    # bounded compile cache: each entry's closure pins its draft module
+    # (and XLA executable), so evict oldest beyond a small working set —
+    # a loop trying many drafts against one target must not accumulate
+    # them all for the target's lifetime
+    cache = getattr(target, "_spec_jit_cache", None)
+    if cache is None:
+        cache = target._spec_jit_cache = {}
+    cfg = (id(draft), b, p, max_new_tokens, k,
+           None if cache_dtype is None else jnp.dtype(cache_dtype).name)
+    jitted = cache.get(cfg)
+    if jitted is None:
+        while len(cache) >= 8:
+            cache.pop(next(iter(cache)))
+        jitted = cache[cfg] = jax.jit(run)
+    return jitted(t_vals, d_vals, prompt_ids)

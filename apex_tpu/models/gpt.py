@@ -440,6 +440,13 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
         [prompt_ids, jnp.zeros((b, max_new_tokens), prompt_ids.dtype)],
         axis=1)
 
+    # models exposing prefill (the Llama family) consume the whole
+    # prompt in ONE flash-attention cached forward instead of p
+    # sequential decode steps; max_new_tokens == 0 keeps the legacy path
+    # (the prefill path's first sampled token would be unrequested)
+    chunk_prefill = hasattr(model, "prefill") and p > 1 \
+        and max_new_tokens >= 1
+
     def run(vals, prompt_padded, key):
         env = {id(o): v for o, v in zip(params + buffers, vals)}
         ctx = Ctx(env=env, stats_out={}, training=False)
@@ -454,6 +461,18 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
             # covers t < s_total - 1, so t + 1 is always in bounds)
             nxt = jnp.where(t + 1 < p, prompt_padded[:, t + 1], sampled)
             return (nxt, caches, key), nxt
+
+        if chunk_prefill:
+            logits, caches = model.prefill(
+                ctx, prompt_padded[:, :p], caches)
+            key, sub = jax.random.split(key)
+            first_new = sample(logits[:, -1], sub)
+            (_, _, _), toks = jax.lax.scan(
+                step, (first_new, caches, key),
+                jnp.arange(p, s_total - 1))
+            return jnp.concatenate(
+                [prompt_padded[:, :p], first_new[:, None],
+                 jnp.swapaxes(toks, 0, 1)], axis=1)
 
         (_, _, _), toks = jax.lax.scan(
             step, (prompt_padded[:, 0], caches, key),

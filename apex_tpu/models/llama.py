@@ -189,39 +189,90 @@ class LlamaBlock(nn.Module):
                 self.gate_proj.weight, self.up_proj.weight,
                 self.down_proj.weight]
 
-    def decode(self, ctx, x, kcache, vcache, t):
-        """One-token decode, ``x (B, E)`` at position ``t`` (traced i32);
-        caches ``(B, KVH, S_max, D)`` hold UN-repeated KV heads (the GQA
-        memory win is exactly that the cache stays KVH-wide)."""
-        b, e = x.shape
-        d, kvh = self.head_dim, self.kv_heads
-        h = self.ln1.forward(ctx, x)
-        q = self.q_proj.forward(ctx, h).reshape(b, self.heads, d)
-        k_new = self.k_proj.forward(ctx, h).reshape(b, kvh, d)
-        v_new = self.v_proj.forward(ctx, h).reshape(b, kvh, d)
-        cos, sin = rope_tables(t[None], d, self.rope_theta)   # (1, D)
-        q = apply_rope(q, cos, sin)
-        k_new = apply_rope(k_new, cos, sin)
-        kcache = jax.lax.dynamic_update_slice(
-            kcache, k_new[:, :, None, :].astype(kcache.dtype), (0, 0, t, 0))
-        vcache = jax.lax.dynamic_update_slice(
-            vcache, v_new[:, :, None, :].astype(vcache.dtype), (0, 0, t, 0))
-        s_max = kcache.shape[2]
-        group = self.heads // kvh
-        qg = q.reshape(b, kvh, group, d)
-        scores = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32),
-                            kcache.astype(jnp.float32)) * (d ** -0.5)
-        valid = jnp.arange(s_max) <= t
-        scores = jnp.where(valid[None, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bkgs,bksd->bkgd", probs,
-                       vcache.astype(jnp.float32)).astype(x.dtype)
-        o = o.reshape(b, self.heads * d)
+    def _mlp_tail(self, ctx, x, o):
+        """Shared residual tail: attention output projection + SwiGLU FFN
+        (one body for forward-with-cache/decode paths)."""
         x = x + self.o_proj.forward(ctx, o)
         h = self.ln2.forward(ctx, x)
         gated = F.silu(self.gate_proj.forward(ctx, h)) \
             * self.up_proj.forward(ctx, h)
-        return x + self.down_proj.forward(ctx, gated), kcache, vcache
+        return x + self.down_proj.forward(ctx, gated)
+
+    def _chunk_qkv(self, ctx, x, pos):
+        """(B, S_c, E) -> rotated q (B, H, S_c, D), k/v (B, KVH, S_c, D)
+        at absolute positions ``pos (S_c,)`` (single-shard decode path)."""
+        b, s_c, _ = x.shape
+        d, kvh = self.head_dim, self.kv_heads
+        h = self.ln1.forward(ctx, x)
+        to_heads = lambda y, nh: jnp.swapaxes(y.reshape(b, s_c, nh, d), 1, 2)
+        q = to_heads(self.q_proj.forward(ctx, h), self.heads)
+        k = to_heads(self.k_proj.forward(ctx, h), kvh)
+        v = to_heads(self.v_proj.forward(ctx, h), kvh)
+        cos, sin = rope_tables(pos, d, self.rope_theta)
+        return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+    def prefill(self, ctx, x, kcache, vcache):
+        """Cache-filling forward from position 0: flash causal attention
+        over the chunk itself (the caches are empty — nothing earlier
+        exists to attend) + KV writes.  Use for prompts; decode_chunk's
+        whole-cache attention is for SHORT chunks against a long cache —
+        on a prompt it would materialize (S_p, S_max) scores per head."""
+        b, s_c, _ = x.shape
+        q, k_new, v_new = self._chunk_qkv(
+            ctx, x, jnp.arange(s_c, dtype=jnp.int32))
+        kcache = jax.lax.dynamic_update_slice(
+            kcache, k_new.astype(kcache.dtype), (0, 0, 0, 0))
+        vcache = jax.lax.dynamic_update_slice(
+            vcache, v_new.astype(vcache.dtype), (0, 0, 0, 0))
+        rep = self.heads // self.kv_heads
+        if rep > 1:
+            k_new = jnp.repeat(k_new, rep, axis=1)
+            v_new = jnp.repeat(v_new, rep, axis=1)
+        o = flash_attention(q, k_new, v_new, causal=True)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, s_c,
+                                          self.heads * self.head_dim)
+        return self._mlp_tail(ctx, x, o), kcache, vcache
+
+    def decode_chunk(self, ctx, x, kcache, vcache, t0):
+        """Cached forward over a CHUNK: ``x (B, S_c, E)`` at positions
+        ``t0 .. t0+S_c-1`` (``t0`` traced i32).  Writes the chunk's KV
+        into the caches and attends each query over the cache with the
+        shifted-causal mask (position ``t0+i`` sees keys ``<= t0+i``).
+        One matmul-shaped pass instead of ``S_c`` single-token steps —
+        the speculative-scoring primitive.  Scores materialize
+        (S_c, S_max) per head: meant for SHORT chunks against the cache;
+        prefill a prompt with :meth:`prefill` instead."""
+        b, s_c, _ = x.shape
+        d, kvh = self.head_dim, self.kv_heads
+        pos = t0 + jnp.arange(s_c, dtype=jnp.int32)
+        q, k_new, v_new = self._chunk_qkv(ctx, x, pos)
+        kcache = jax.lax.dynamic_update_slice(
+            kcache, k_new.astype(kcache.dtype), (0, 0, t0, 0))
+        vcache = jax.lax.dynamic_update_slice(
+            vcache, v_new.astype(vcache.dtype), (0, 0, t0, 0))
+        s_max = kcache.shape[2]
+        group = self.heads // kvh
+        qg = q.reshape(b, kvh, group, s_c, d)
+        scores = jnp.einsum("bkgqd,bksd->bkgqs", qg.astype(jnp.float32),
+                            kcache.astype(jnp.float32)) * (d ** -0.5)
+        valid = jnp.arange(s_max)[None, :] <= pos[:, None]   # (S_c, S_max)
+        scores = jnp.where(valid[None, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bkgqs,bksd->bkgqd", probs,
+                       vcache.astype(jnp.float32)).astype(x.dtype)
+        o = jnp.swapaxes(o.reshape(b, self.heads, s_c, d), 1, 2) \
+            .reshape(b, s_c, self.heads * d)
+        return self._mlp_tail(ctx, x, o), kcache, vcache
+
+    def decode(self, ctx, x, kcache, vcache, t):
+        """One-token decode, ``x (B, E)`` at position ``t`` (traced i32);
+        caches ``(B, KVH, S_max, D)`` hold UN-repeated KV heads (the GQA
+        memory win is exactly that the cache stays KVH-wide).  The
+        ``S_c = 1`` case of :meth:`decode_chunk` — one body, so the
+        single-token and chunked programs cannot drift apart."""
+        y, kcache, vcache = self.decode_chunk(
+            ctx, x[:, None, :], kcache, vcache, t)
+        return y[:, 0], kcache, vcache
 
 
 class LlamaModel(nn.Module):
@@ -290,6 +341,46 @@ class LlamaModel(nn.Module):
         """All blocks' TP-block-sparse parameters (see LlamaBlock) — the
         contract make_train_step(tp_axis=...) assembles by psum."""
         return [p for blk in self.blocks for p in blk.tp_sharded_params()]
+
+    def _head(self, ctx, x):
+        return jnp.matmul(
+            x, ctx.value(self.lm_head.weight).T.astype(x.dtype))
+
+    def prefill(self, ctx, toks, caches):
+        """Consume a PROMPT ``toks (B, S_p)`` from position 0 in one
+        flash-attention pass, filling the KV caches: returns
+        ``(logits (B, S_p, V), new_caches)``.  O(1) calls instead of
+        ``S_p`` decode steps, with no (S_p, S_max) score tensor (the
+        caches are empty, so the chunk attends only itself)."""
+        if self.tp_axis is not None:
+            raise NotImplementedError(
+                "prefill is single-shard; build the model without "
+                "tp_axis for inference")
+        x = ctx.value(self.tok_emb.weight)[toks]
+        new_caches = []
+        for blk, (kc, vc) in zip(self.blocks, caches):
+            x, kc, vc = blk.prefill(ctx, x, kc, vc)
+            new_caches.append((kc, vc))
+        return self._head(ctx, self.norm.forward(ctx, x)), new_caches
+
+    def decode_chunk(self, ctx, toks, caches, t0):
+        """Logits for a token CHUNK ``toks (B, S_c)`` at positions
+        ``t0 .. t0+S_c-1``, attending the KV caches: returns
+        ``(logits (B, S_c, V), new_caches)``.  ``logits[:, i]`` is the
+        next-token distribution after consuming ``toks[:, :i+1]`` (and
+        everything already in the caches) — the speculative-verification
+        primitive (inference/speculative.py scores draft tokens with it;
+        prompts go through :meth:`prefill`)."""
+        if self.tp_axis is not None:
+            raise NotImplementedError(
+                "decode_chunk is single-shard; build the model without "
+                "tp_axis for inference")
+        x = ctx.value(self.tok_emb.weight)[toks]
+        new_caches = []
+        for blk, (kc, vc) in zip(self.blocks, caches):
+            x, kc, vc = blk.decode_chunk(ctx, x, kc, vc, t0)
+            new_caches.append((kc, vc))
+        return self._head(ctx, self.norm.forward(ctx, x)), new_caches
 
     def decode_step(self, ctx, tok, caches, t):
         """Logits for one token (same decode protocol as GptModel, so

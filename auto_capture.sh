@@ -22,6 +22,7 @@ QUEUE=(
   "timeout 700 python bench.py --llama --no-kernels"
   "timeout 700 python bench.py --gpt-decode --no-kernels"
   "timeout 700 python bench.py --gpt-decode --int8 --no-kernels"
+  "timeout 900 python bench.py --spec-decode --no-kernels --budget-s 840"
   "timeout 700 python bench.py --seq2seq --no-kernels"
   "timeout 900 python bench.py --kernels-timing --budget-s 840"
   "DIAG_FULL=1 bash diagnose_gpt1024.sh >>diagnose_stdout.log 2>&1"

