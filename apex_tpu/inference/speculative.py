@@ -19,9 +19,10 @@ decode_chunk), but XLA may reduce the two shapes in different orders,
 and an exact argmax TIE between top-2 logits can then resolve
 differently.  Tests assert bit-identity; bench tolerates a rare tie.
 
-Both models must expose the cache protocol of the Llama family
-(``init_caches`` / ``decode_step`` / ``decode_chunk``) and share a
-vocabulary.  Pair naturally with weight-only int8 on the draft
+Both models must expose the cache protocol (``init_caches`` /
+``decode_step`` / ``decode_chunk`` / ``prefill`` — the GPT and Llama
+families both do) and share a vocabulary; target and draft need not be
+the same family.  Pair naturally with weight-only int8 on the draft
 (quant.py) — the draft's quality only gates the acceptance rate.
 
 Cache-staleness invariant (why rejected tokens need no cleanup): cache
@@ -57,10 +58,14 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
     for name, m in (("target", target), ("draft", draft)):
-        if not (hasattr(m, "decode_chunk") and hasattr(m, "prefill")):
+        missing = [a for a in ("init_caches", "decode_step",
+                               "decode_chunk", "prefill")
+                   if not hasattr(m, a)]
+        if missing:
             raise ValueError(
-                f"speculative_generate needs {name}.decode_chunk and "
-                f"{name}.prefill (the Llama-family cache protocol)")
+                f"speculative_generate needs {name}.{missing[0]} "
+                f"(the GPT/Llama cache protocol: init_caches, "
+                f"decode_step, decode_chunk, prefill)")
     b, p = prompt_ids.shape
     if p < 1:
         raise ValueError("prompt must hold at least one token")

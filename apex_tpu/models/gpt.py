@@ -79,43 +79,85 @@ class GptBlock(nn.Module):
         return self.attn.tp_sharded_params() + [
             self.fc1.weight, self.fc1.bias, self.fc2.weight]
 
-    def decode(self, ctx, x, kcache, vcache, t):
-        """One-token decode with a KV cache: ``x (B, E)`` at global
-        position ``t`` (traced i32), caches ``(B, H, S_max, D)``.
-        Mirrors the training projection exactly (the interleaved QKV
-        layout of attn_funcs._split_interleaved_qkv) so a cache filled by
-        decode reproduces the training forward's attention."""
+    def _chunk_qkv(self, ctx, x):
+        """(B, S_c, E) -> q/k/v (B, H, S_c, D) via the training
+        projection (the interleaved QKV layout of
+        attn_funcs._split_interleaved_qkv), so caches filled here
+        reproduce the training forward's attention."""
         attn = self.attn
         heads, d = attn.num_heads, attn.head_dim
-        b = x.shape[0]
+        b, s_c, _ = x.shape
         h = self.ln1.forward(ctx, x)
         qkv = jnp.matmul(h, ctx.value(attn.in_proj_weight).T.astype(h.dtype))
         if attn.bias:
             qkv = qkv + ctx.value(attn.in_proj_bias).astype(qkv.dtype)
-        qkv = qkv.reshape(b, heads, 3, d)
-        q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        kcache = jax.lax.dynamic_update_slice(
-            kcache, k_new[:, :, None, :].astype(kcache.dtype),
-            (0, 0, t, 0))
-        vcache = jax.lax.dynamic_update_slice(
-            vcache, v_new[:, :, None, :].astype(vcache.dtype),
-            (0, 0, t, 0))
-        s_max = kcache.shape[2]
-        scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
-                            kcache.astype(jnp.float32)) * attn.scaling
-        # cache slots beyond t are unwritten (or stale): mask them out
-        valid = jnp.arange(s_max) <= t
-        scores = jnp.where(valid[None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhs,bhsd->bhd", probs,
-                       vcache.astype(jnp.float32)).astype(x.dtype)
-        o = o.reshape(b, heads * d)
+        qkv = qkv.reshape(b, s_c, heads, 3, d)
+        to_bh = lambda y: jnp.swapaxes(y, 1, 2)       # (B, H, S_c, D)
+        return (to_bh(qkv[:, :, :, 0]), to_bh(qkv[:, :, :, 1]),
+                to_bh(qkv[:, :, :, 2]))
+
+    def _attn_mlp_tail(self, ctx, x, o):
+        """Shared residual tail after attention combine: out projection
+        + GELU MLP (one body for prefill/decode_chunk/decode)."""
+        attn = self.attn
         o = jnp.matmul(o, ctx.value(attn.out_proj_weight).T.astype(o.dtype))
         if attn.bias:
             o = o + ctx.value(attn.out_proj_bias).astype(o.dtype)
         x = x + o
         hh = F.gelu(self.fc1.forward(ctx, self.ln2.forward(ctx, x)))
-        return x + self.fc2.forward(ctx, hh), kcache, vcache
+        return x + self.fc2.forward(ctx, hh)
+
+    def prefill(self, ctx, x, kcache, vcache):
+        """Cache-filling forward from position 0: flash causal attention
+        over the chunk (the caches are empty) + KV writes — one pass for
+        a whole prompt instead of S_p decode steps."""
+        b, s_c, _ = x.shape
+        heads, d = self.attn.num_heads, self.attn.head_dim
+        q, k_new, v_new = self._chunk_qkv(ctx, x)
+        kcache = jax.lax.dynamic_update_slice(
+            kcache, k_new.astype(kcache.dtype), (0, 0, 0, 0))
+        vcache = jax.lax.dynamic_update_slice(
+            vcache, v_new.astype(vcache.dtype), (0, 0, 0, 0))
+        from ..contrib.multihead_attn.attn_funcs import flash_attention
+        o = flash_attention(q, k_new, v_new, causal=True,
+                            scale=self.attn.scaling)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, s_c, heads * d)
+        return self._attn_mlp_tail(ctx, x, o), kcache, vcache
+
+    def decode_chunk(self, ctx, x, kcache, vcache, t0):
+        """Cached forward over a chunk ``x (B, S_c, E)`` at positions
+        ``t0 ..`` — each query attends the cache with the shifted-causal
+        mask.  Meant for SHORT verification windows (scores are
+        (S_c, S_max) per head); prompts go through :meth:`prefill`."""
+        attn = self.attn
+        heads, d = attn.num_heads, attn.head_dim
+        b, s_c, _ = x.shape
+        pos = t0 + jnp.arange(s_c, dtype=jnp.int32)
+        q, k_new, v_new = self._chunk_qkv(ctx, x)
+        kcache = jax.lax.dynamic_update_slice(
+            kcache, k_new.astype(kcache.dtype), (0, 0, t0, 0))
+        vcache = jax.lax.dynamic_update_slice(
+            vcache, v_new.astype(vcache.dtype), (0, 0, t0, 0))
+        s_max = kcache.shape[2]
+        scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
+                            kcache.astype(jnp.float32)) * attn.scaling
+        # cache slots beyond each position are unwritten (or stale)
+        valid = jnp.arange(s_max)[None, :] <= pos[:, None]
+        scores = jnp.where(valid[None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqs,bhsd->bhqd", probs,
+                       vcache.astype(jnp.float32)).astype(x.dtype)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, s_c, heads * d)
+        return self._attn_mlp_tail(ctx, x, o), kcache, vcache
+
+    def decode(self, ctx, x, kcache, vcache, t):
+        """One-token decode with a KV cache: ``x (B, E)`` at global
+        position ``t`` (traced i32), caches ``(B, H, S_max, D)``.  The
+        ``S_c = 1`` case of :meth:`decode_chunk` — one body, so the
+        single-token and chunked programs cannot drift apart."""
+        y, kcache, vcache = self.decode_chunk(
+            ctx, x[:, None, :], kcache, vcache, t)
+        return y[:, 0], kcache, vcache
 
 
 class MoeGptBlock(nn.Module):
@@ -367,14 +409,53 @@ class GptModel(nn.Module):
                  jnp.zeros((batch, h, s_max, d), dtype))
                 for _ in self.blocks]
 
-    def decode_step(self, ctx, tok, caches, t):
-        """Logits for one token: ``tok (B,)`` ids at global position
-        ``t`` (traced i32).  Returns ``(logits (B, V), new_caches)``."""
+    def _decode_guard(self, what):
         if self.sp_axis is not None or self.tp_axis is not None \
                 or self.moe_axis is not None:
             raise NotImplementedError(
-                "decode_step is single-shard; build the model without "
-                "sp_axis/tp_axis/moe_axis for inference")
+                f"{what} is single-shard; build the model without "
+                f"sp_axis/tp_axis/moe_axis for inference")
+
+    def prefill(self, ctx, toks, caches):
+        """Consume a PROMPT ``toks (B, S_p)`` from position 0 in one
+        flash-attention pass, filling the KV caches: returns
+        ``(logits (B, S_p, V), new_caches)`` — O(1) calls instead of
+        S_p decode steps."""
+        self._decode_guard("prefill")
+        emb = ctx.value(self.tok_emb.weight)
+        pos = ctx.value(self.pos_emb.weight)
+        s_p = toks.shape[1]
+        x = emb[toks] + pos[:s_p][None, :, :]
+        new_caches = []
+        for blk, (kc, vc) in zip(self.blocks, caches):
+            x, kc, vc = blk.prefill(ctx, x, kc, vc)
+            new_caches.append((kc, vc))
+        x = self.ln_f.forward(ctx, x)
+        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype)), \
+            new_caches
+
+    def decode_chunk(self, ctx, toks, caches, t0):
+        """Logits for a token CHUNK ``toks (B, S_c)`` at positions
+        ``t0 ..`` against the caches (the speculative-verification
+        primitive; same contract as LlamaModel.decode_chunk)."""
+        self._decode_guard("decode_chunk")
+        emb = ctx.value(self.tok_emb.weight)
+        pos = ctx.value(self.pos_emb.weight)
+        s_c = toks.shape[1]
+        x = emb[toks] + jax.lax.dynamic_slice(
+            pos, (t0, 0), (s_c, pos.shape[1]))[None, :, :]
+        new_caches = []
+        for blk, (kc, vc) in zip(self.blocks, caches):
+            x, kc, vc = blk.decode_chunk(ctx, x, kc, vc, t0)
+            new_caches.append((kc, vc))
+        x = self.ln_f.forward(ctx, x)
+        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype)), \
+            new_caches
+
+    def decode_step(self, ctx, tok, caches, t):
+        """Logits for one token: ``tok (B,)`` ids at global position
+        ``t`` (traced i32).  Returns ``(logits (B, V), new_caches)``."""
+        self._decode_guard("decode_step")
         emb = ctx.value(self.tok_emb.weight)
         pos = ctx.value(self.pos_emb.weight)
         x = emb[tok] + jax.lax.dynamic_index_in_dim(pos, t, keepdims=False)
@@ -401,6 +482,12 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     ``jnp.bfloat16`` to halve cache HBM for fp32 checkpoints).  The
     reference has no inference path (it is a training-side library); this
     is the decode half of the GPT family.
+
+    Note on sampled reproducibility: the prefill fast path consumes ONE
+    key split for the prompt where the legacy per-token path consumed
+    ``P - 1``, so sampled (temperature > 0) streams differ from runs of
+    this function before prefill existed (and from models without the
+    chunk protocol).  Greedy output is unaffected.
     """
     from ..nn.modules import Ctx
 

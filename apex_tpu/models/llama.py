@@ -346,22 +346,33 @@ class LlamaModel(nn.Module):
         return jnp.matmul(
             x, ctx.value(self.lm_head.weight).T.astype(x.dtype))
 
+    def _decode_guard(self, what):
+        if self.tp_axis is not None:
+            raise NotImplementedError(
+                f"{what} is single-shard; build the model without "
+                f"tp_axis for inference")
+
+    def _run_blocks(self, ctx, toks, caches, blk_fn):
+        """Embed ``toks``, thread the caches through ``blk_fn`` per
+        block, final-norm + head — the shared body of every cached
+        decode entry point."""
+        x = ctx.value(self.tok_emb.weight)[toks]
+        new_caches = []
+        for blk, (kc, vc) in zip(self.blocks, caches):
+            x, kc, vc = blk_fn(blk, x, kc, vc)
+            new_caches.append((kc, vc))
+        return self._head(ctx, self.norm.forward(ctx, x)), new_caches
+
     def prefill(self, ctx, toks, caches):
         """Consume a PROMPT ``toks (B, S_p)`` from position 0 in one
         flash-attention pass, filling the KV caches: returns
         ``(logits (B, S_p, V), new_caches)``.  O(1) calls instead of
         ``S_p`` decode steps, with no (S_p, S_max) score tensor (the
         caches are empty, so the chunk attends only itself)."""
-        if self.tp_axis is not None:
-            raise NotImplementedError(
-                "prefill is single-shard; build the model without "
-                "tp_axis for inference")
-        x = ctx.value(self.tok_emb.weight)[toks]
-        new_caches = []
-        for blk, (kc, vc) in zip(self.blocks, caches):
-            x, kc, vc = blk.prefill(ctx, x, kc, vc)
-            new_caches.append((kc, vc))
-        return self._head(ctx, self.norm.forward(ctx, x)), new_caches
+        self._decode_guard("prefill")
+        return self._run_blocks(
+            ctx, toks, caches,
+            lambda blk, x, kc, vc: blk.prefill(ctx, x, kc, vc))
 
     def decode_chunk(self, ctx, toks, caches, t0):
         """Logits for a token CHUNK ``toks (B, S_c)`` at positions
@@ -371,32 +382,18 @@ class LlamaModel(nn.Module):
         everything already in the caches) — the speculative-verification
         primitive (inference/speculative.py scores draft tokens with it;
         prompts go through :meth:`prefill`)."""
-        if self.tp_axis is not None:
-            raise NotImplementedError(
-                "decode_chunk is single-shard; build the model without "
-                "tp_axis for inference")
-        x = ctx.value(self.tok_emb.weight)[toks]
-        new_caches = []
-        for blk, (kc, vc) in zip(self.blocks, caches):
-            x, kc, vc = blk.decode_chunk(ctx, x, kc, vc, t0)
-            new_caches.append((kc, vc))
-        return self._head(ctx, self.norm.forward(ctx, x)), new_caches
+        self._decode_guard("decode_chunk")
+        return self._run_blocks(
+            ctx, toks, caches,
+            lambda blk, x, kc, vc: blk.decode_chunk(ctx, x, kc, vc, t0))
 
     def decode_step(self, ctx, tok, caches, t):
         """Logits for one token (same decode protocol as GptModel, so
         :func:`~apex_tpu.models.gpt.generate` drives this family too)."""
-        if self.tp_axis is not None:
-            raise NotImplementedError(
-                "decode_step is single-shard; build the model without "
-                "tp_axis for inference")
-        x = ctx.value(self.tok_emb.weight)[tok]
-        new_caches = []
-        for blk, (kc, vc) in zip(self.blocks, caches):
-            x, kc, vc = blk.decode(ctx, x, kc, vc, t)
-            new_caches.append((kc, vc))
-        x = self.norm.forward(ctx, x)
-        return jnp.matmul(
-            x, ctx.value(self.lm_head.weight).T.astype(x.dtype)), new_caches
+        self._decode_guard("decode_step")
+        return self._run_blocks(
+            ctx, tok, caches,
+            lambda blk, x, kc, vc: blk.decode(ctx, x, kc, vc, t))
 
 
 def llama_tiny(**kw):

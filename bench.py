@@ -1083,9 +1083,10 @@ def main():
 
     if args.spec_decode:
         batch = args.batch or 1
+        spec_new_tokens, spec_k = 128, 4
         try:
             spec_toks, plain_toks, compile_s = run_spec_decode_throughput(
-                batch, args.seq_len)
+                batch, args.seq_len, new_tokens=spec_new_tokens, k=spec_k)
         except Exception as e:
             fail(f"spec_decode_failed: {type(e).__name__}: {e}")
             return 1
@@ -1093,7 +1094,7 @@ def main():
               "value": round(spec_toks, 1), "unit": metric_unit,
               "vs_baseline": round(spec_toks / plain_toks, 3),
               "batch": batch, "prompt_len": args.seq_len,
-              "new_tokens": 128, "k": 4,
+              "new_tokens": spec_new_tokens, "k": spec_k,
               "plain_tokens_per_sec": round(plain_toks, 1),
               "compile_s": round(compile_s, 1),
               "device_kind": (devices[0].device_kind or "").lower(),

@@ -133,6 +133,30 @@ def test_perfect_draft_accepts_everything(rng):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_gpt_family_prefill_and_speculative(rng):
+    """The GPT family implements the same cache protocol: prefill logits
+    match the training forward, and speculative output matches the
+    target's greedy decode."""
+    from apex_tpu.models.gpt import GptModel
+
+    nn.manual_seed(0)
+    target = GptModel(vocab_size=307, hidden=64, layers=2, heads=4,
+                      max_positions=64, dropout=0.0).eval()
+    nn.manual_seed(1)
+    draft = GptModel(vocab_size=307, hidden=32, layers=1, heads=2,
+                     max_positions=64, dropout=0.0).eval()
+    ids = jnp.asarray(rng.integers(0, 307, (2, 8)))
+    want = np.asarray(target(ids).value)
+    got, _ = target.prefill(Ctx(training=False), ids,
+                            target.init_caches(2, 16))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4,
+                               atol=2e-4)
+    base = generate(target, ids, max_new_tokens=7)
+    spec = speculative_generate(target, draft, ids, max_new_tokens=7,
+                                k=3)
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(base))
+
+
 def test_validation_errors(rng):
     target = _model(seed=8)
     draft = _model(seed=9)
