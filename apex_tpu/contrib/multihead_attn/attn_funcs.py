@@ -31,6 +31,35 @@ _f32 = jnp.float32
 _NEG = -1e30
 
 
+def _flash_min_sk():
+    """Key-length threshold below which compiled dispatch prefers XLA's
+    own attention over the Pallas flash kernel.
+
+    Measured on v5e (bench --kernels-timing, fwd+bwd): at S=256 the
+    flash kernel runs 0.82x XLA — short rows underfill the lane-padded
+    blocks, while from ~512 keys up the materialized score tensor grows
+    quadratically and flash's O(S) sweep wins.  Override with
+    APEX_TPU_FLASH_MIN_SK (0 forces flash everywhere)."""
+    import os
+    return int(os.environ.get("APEX_TPU_FLASH_MIN_SK", 512))
+
+
+# the XLA fallback's score tensor (fwd scores + softmax residual for
+# backward, f32) must also stay SMALL in absolute terms — key length
+# alone ignores the B*H factor.  128 MB keeps the fallback's footprint
+# noise-level next to activations; beyond it flash's O(S) memory is the
+# point even where it is a little slower per-FLOP.
+_XLA_SCORES_BYTE_CAP = 128 * 1024 * 1024
+
+
+def _use_xla_attention(b, h, sq, sk):
+    """Compiled-mode dispatch: take the materializing XLA path only when
+    it is both faster (short keys) and memory-harmless (small total
+    score tensor)."""
+    return sk < _flash_min_sk() and \
+        b * h * sq * sk * 4 <= _XLA_SCORES_BYTE_CAP
+
+
 def attention_reference(q4, k4, v4, bias, causal, scale):
     """Plain-XLA attention, (B, H, S, D) layout; the fallback/oracle path."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q4.astype(_f32),
@@ -97,7 +126,12 @@ def flash_attention(q4, k4, v4, bias=None, causal=False, scale=None):
     if scale is None:
         scale = 1.0 / math.sqrt(q4.shape[-1])
     mode = pallas_mode()
-    if mode is None:
+    # compiled dispatch is shape-aware: below the measured crossover the
+    # materializing XLA path is faster AND memory-harmless (interpret
+    # mode still runs the kernel — that mode exists to test it)
+    if mode is None or (mode == "compiled"
+                        and _use_xla_attention(*q4.shape[:2],
+                                               q4.shape[2], k4.shape[2])):
         if bias is not None:
             bias = jax.lax.stop_gradient(bias)
         return attention_reference(q4, k4, v4, bias, causal, scale)

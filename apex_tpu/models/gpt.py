@@ -416,65 +416,63 @@ class GptModel(nn.Module):
                 f"{what} is single-shard; build the model without "
                 f"sp_axis/tp_axis/moe_axis for inference")
 
+    def _run_blocks(self, ctx, toks, caches, pos_of, blk_fn):
+        """Embed ``toks`` + positions (``pos_of(pos_table)``), thread the
+        caches through ``blk_fn`` per block, final-LN + tied head — the
+        shared body of every cached decode entry point."""
+        emb = ctx.value(self.tok_emb.weight)
+        x = emb[toks] + pos_of(ctx.value(self.pos_emb.weight))
+        new_caches = []
+        for blk, (kc, vc) in zip(self.blocks, caches):
+            x, kc, vc = blk_fn(blk, x, kc, vc)
+            new_caches.append((kc, vc))
+        x = self.ln_f.forward(ctx, x)
+        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype)), \
+            new_caches
+
     def prefill(self, ctx, toks, caches):
         """Consume a PROMPT ``toks (B, S_p)`` from position 0 in one
         flash-attention pass, filling the KV caches: returns
         ``(logits (B, S_p, V), new_caches)`` — O(1) calls instead of
         S_p decode steps."""
         self._decode_guard("prefill")
-        emb = ctx.value(self.tok_emb.weight)
-        pos = ctx.value(self.pos_emb.weight)
         s_p = toks.shape[1]
-        x = emb[toks] + pos[:s_p][None, :, :]
-        new_caches = []
-        for blk, (kc, vc) in zip(self.blocks, caches):
-            x, kc, vc = blk.prefill(ctx, x, kc, vc)
-            new_caches.append((kc, vc))
-        x = self.ln_f.forward(ctx, x)
-        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype)), \
-            new_caches
+        return self._run_blocks(
+            ctx, toks, caches, lambda pos: pos[:s_p][None, :, :],
+            lambda blk, x, kc, vc: blk.prefill(ctx, x, kc, vc))
 
     def decode_chunk(self, ctx, toks, caches, t0):
         """Logits for a token CHUNK ``toks (B, S_c)`` at positions
         ``t0 ..`` against the caches (the speculative-verification
         primitive; same contract as LlamaModel.decode_chunk)."""
         self._decode_guard("decode_chunk")
-        emb = ctx.value(self.tok_emb.weight)
-        pos = ctx.value(self.pos_emb.weight)
         s_c = toks.shape[1]
-        x = emb[toks] + jax.lax.dynamic_slice(
-            pos, (t0, 0), (s_c, pos.shape[1]))[None, :, :]
-        new_caches = []
-        for blk, (kc, vc) in zip(self.blocks, caches):
-            x, kc, vc = blk.decode_chunk(ctx, x, kc, vc, t0)
-            new_caches.append((kc, vc))
-        x = self.ln_f.forward(ctx, x)
-        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype)), \
-            new_caches
+        return self._run_blocks(
+            ctx, toks, caches,
+            lambda pos: jax.lax.dynamic_slice(
+                pos, (t0, 0), (s_c, pos.shape[1]))[None, :, :],
+            lambda blk, x, kc, vc: blk.decode_chunk(ctx, x, kc, vc, t0))
 
     def decode_step(self, ctx, tok, caches, t):
         """Logits for one token: ``tok (B,)`` ids at global position
         ``t`` (traced i32).  Returns ``(logits (B, V), new_caches)``."""
         self._decode_guard("decode_step")
-        emb = ctx.value(self.tok_emb.weight)
-        pos = ctx.value(self.pos_emb.weight)
-        x = emb[tok] + jax.lax.dynamic_index_in_dim(pos, t, keepdims=False)
-        new_caches = []
-        for blk, (kc, vc) in zip(self.blocks, caches):
-            x, kc, vc = blk.decode(ctx, x, kc, vc, t)
-            new_caches.append((kc, vc))
-        x = self.ln_f.forward(ctx, x)
-        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype)), \
-            new_caches
+        return self._run_blocks(
+            ctx, tok, caches,
+            lambda pos: jax.lax.dynamic_index_in_dim(pos, t,
+                                                     keepdims=False),
+            lambda blk, x, kc, vc: blk.decode(ctx, x, kc, vc, t))
 
 
 def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
              top_k=None, key=None, cache_dtype=None):
-    """Autoregressive sampling with a KV cache, compiled as one
-    ``lax.scan`` over positions (prefill and generation share the same
-    per-token decode, so there is exactly one compiled step; the
-    compiled program is cached per model instance and config, so repeated
-    calls pay compile once).
+    """Autoregressive sampling with a KV cache: models with the chunk
+    protocol (GPT, Llama) consume the prompt in ONE ``model.prefill``
+    flash pass, then generation runs a ``lax.scan`` of per-token decode
+    steps; models without it run the whole sequence through the scan,
+    teacher-forced inside the prompt.  Either way everything compiles
+    into one jitted program, cached per model instance and config, so
+    repeated calls pay compile once.
 
     ``prompt_ids (B, P)``; returns ``(B, P + max_new_tokens)``.
     ``temperature=0`` is greedy; ``top_k`` restricts sampling;
@@ -527,10 +525,11 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
         [prompt_ids, jnp.zeros((b, max_new_tokens), prompt_ids.dtype)],
         axis=1)
 
-    # models exposing prefill (the Llama family) consume the whole
-    # prompt in ONE flash-attention cached forward instead of p
-    # sequential decode steps; max_new_tokens == 0 keeps the legacy path
-    # (the prefill path's first sampled token would be unrequested)
+    # models exposing prefill (the GPT and Llama families; the dispatch
+    # condition is the method itself) consume the whole prompt in ONE
+    # flash-attention cached forward instead of p sequential decode
+    # steps; max_new_tokens == 0 keeps the legacy path (the prefill
+    # path's first sampled token would be unrequested)
     chunk_prefill = hasattr(model, "prefill") and p > 1 \
         and max_new_tokens >= 1
 

@@ -14,6 +14,7 @@ to smaller batches; a watchdog guarantees a diagnostic JSON line naming the
 last-reached stage is emitted even on a hang — never a bare traceback.
 """
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -178,8 +179,16 @@ def flash_attn_step_flops(attn_shapes):
     Softmax (≈5·area) and the Pallas LayerNorm (O(b·s·e)) are noise at
     these shapes and left out.
     """
+    from apex_tpu.contrib.multihead_attn.attn_funcs import \
+        _use_xla_attention
+
     total = 0.0
     for layers, b, h, sq, sk, d, causal in attn_shapes:
+        if _use_xla_attention(b, h, sq, sk):
+            # the dispatch routes this shape to the XLA path, whose
+            # matmuls cost analysis already counts — adding the
+            # complement would double-count
+            continue
         area = b * h * sq * sk * (0.5 if causal else 1.0)
         total += layers * 12.0 * area * d
     return total
@@ -189,6 +198,24 @@ def _rel_err(a, b):
     import jax.numpy as jnp
     denom = float(jnp.max(jnp.abs(b))) + 1e-6
     return float(jnp.max(jnp.abs(a - b))) / denom
+
+
+@contextlib.contextmanager
+def _pin_flash_dispatch():
+    """Force the flash kernel at every shape for the duration (the
+    kernel parity/timing paths must exercise the KERNEL, not whatever
+    the shape-aware dispatch would pick), restoring the production
+    dispatch afterwards — bench must not leave a process-global
+    override behind."""
+    prev = os.environ.get("APEX_TPU_FLASH_MIN_SK")
+    os.environ["APEX_TPU_FLASH_MIN_SK"] = "0"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("APEX_TPU_FLASH_MIN_SK", None)
+        else:
+            os.environ["APEX_TPU_FLASH_MIN_SK"] = prev
 
 
 def run_kernel_checks():
@@ -206,6 +233,20 @@ def run_kernel_checks():
     mode = "compiled" if on_tpu else "interpret"
     results = {"mode": mode}
     rng = np.random.default_rng(0)
+    # the parity check must exercise the KERNEL at every shape — pin the
+    # shape-aware dispatch open (it would route small S to XLA and this
+    # would silently compare XLA to itself); _pin_flash_dispatch restores
+    # the production dispatch afterwards
+    with _pin_flash_dispatch():
+        return _run_kernel_checks_inner(mode, results, rng)
+
+
+def _run_kernel_checks_inner(mode, results, rng):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from apex_tpu.ops import pallas as pal
+    from apex_tpu.ops.pallas.attention import vmem_fit
 
     # Pin matmuls to f32-exact (6-pass) so the comparison isolates kernel
     # correctness from MXU bf16 rounding: under default precision the Pallas
@@ -1061,7 +1102,8 @@ def main():
     if args.kernels_timing:
         stage("kernel_timing")
         try:
-            res, gmean = run_kernel_timing()
+            with _pin_flash_dispatch():
+                res, gmean = run_kernel_timing()
         except Exception as e:
             fail(f"kernel_timing_failed: {type(e).__name__}: {e}")
             return 1

@@ -131,3 +131,22 @@ def test_gpt_tp_example_runs():
     final = float(out.stdout.rsplit("final loss:", 1)[1].strip())
     import math
     assert math.isfinite(final) and final < math.log(97) + 1.0
+
+
+def test_llama_example_runs():
+    """Train + prefill generate + int8 self-draft speculative decode in
+    one script; the script itself asserts speculative == greedy."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    script = os.path.join(REPO, "examples", "llama", "main.py")
+    code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.argv = ['main.py', '--steps', '6', "
+            f"'--batch', '2', '--seq-len', '32', '--layers', '2', "
+            f"'--hidden', '64', '--heads', '4', '--kv-heads', '2', "
+            f"'--gen-tokens', '8', '--print-freq', '2']; "
+            f"import runpy; runpy.run_path({script!r}, "
+            f"run_name='__main__')")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "matches greedy exactly" in out.stdout
