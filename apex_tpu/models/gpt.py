@@ -287,6 +287,11 @@ class GptModel(nn.Module):
                 raise ValueError(
                     "moe_axis and tp_axis are mutually exclusive for now "
                     "(the MoE FFN replaces the dense FFN that TP shards)")
+            if not 1 <= moe_every <= layers:
+                raise ValueError(
+                    f"moe_every={moe_every} with layers={layers}: must "
+                    f"be in [1, layers] or no block would be MoE (block "
+                    f"moe_every-1 is the first routed one)")
         # tp_axis: Megatron tensor parallelism — forward must run inside
         # shard_map over a mesh with this axis; attention heads and the
         # MLP hidden shard over it, embeddings/LNs/head stay replicated.

@@ -62,7 +62,7 @@ class LlamaBlock(nn.Module):
 
     def __init__(self, hidden, heads, kv_heads, intermediate,
                  rope_theta=10000.0, eps=1e-6, head_dim=None,
-                 tp_axis=None):
+                 tp_axis=None, _dense_ffn=True):
         super().__init__()
         # tp_axis: Megatron tensor parallelism — forward must run inside
         # shard_map over a mesh with this axis.  Q heads AND KV heads
@@ -93,9 +93,15 @@ class LlamaBlock(nn.Module):
         self.v_proj = nn.Linear(hidden, kv_heads * head_dim, bias=False)
         self.o_proj = nn.Linear(heads * head_dim, hidden, bias=False)
         self.ln2 = FusedRMSNorm(hidden, eps=eps)
-        self.gate_proj = nn.Linear(hidden, intermediate, bias=False)
-        self.up_proj = nn.Linear(hidden, intermediate, bias=False)
-        self.down_proj = nn.Linear(intermediate, hidden, bias=False)
+        if _dense_ffn:
+            self.gate_proj = nn.Linear(hidden, intermediate, bias=False)
+            self.up_proj = nn.Linear(hidden, intermediate, bias=False)
+            self.down_proj = nn.Linear(intermediate, hidden, bias=False)
+        else:
+            # MoeLlamaBlock supplies its own routed FFN: skip drawing
+            # (and then discarding) three dense matrices that can be
+            # hundreds of MB at Mixtral scale
+            self.gate_proj = self.up_proj = self.down_proj = None
 
     def _qkv(self, ctx, h):
         """(B, S, E) → q (B, H, S, D), k/v (B, KVH, S, D).  Under
@@ -156,11 +162,7 @@ class LlamaBlock(nn.Module):
             h = self.ln2.forward(ctx, x)
             x = x + self._tp_swiglu(ctx, h)
             return x
-        x = x + self.o_proj.forward(ctx, o)
-        h = self.ln2.forward(ctx, x)
-        gated = F.silu(self.gate_proj.forward(ctx, h)) \
-            * self.up_proj.forward(ctx, h)
-        return x + self.down_proj.forward(ctx, gated)
+        return self._mlp_tail(ctx, x, o)
 
     def _tp_swiglu(self, ctx, h):
         """SwiGLU as the Megatron column→row pair: gate and up are both
@@ -189,14 +191,19 @@ class LlamaBlock(nn.Module):
                 self.gate_proj.weight, self.up_proj.weight,
                 self.down_proj.weight]
 
-    def _mlp_tail(self, ctx, x, o):
-        """Shared residual tail: attention output projection + SwiGLU FFN
-        (one body for forward-with-cache/decode paths)."""
-        x = x + self.o_proj.forward(ctx, o)
-        h = self.ln2.forward(ctx, x)
+    def _ffn(self, ctx, h):
+        """Dense SwiGLU — MoeLlamaBlock overrides this with the routed
+        expert mixture."""
         gated = F.silu(self.gate_proj.forward(ctx, h)) \
             * self.up_proj.forward(ctx, h)
-        return x + self.down_proj.forward(ctx, gated)
+        return self.down_proj.forward(ctx, gated)
+
+    def _mlp_tail(self, ctx, x, o):
+        """Shared residual tail: attention output projection + FFN (one
+        body for the training forward and every cached decode path)."""
+        x = x + self.o_proj.forward(ctx, o)
+        h = self.ln2.forward(ctx, x)
+        return x + self._ffn(ctx, h)
 
     def _chunk_qkv(self, ctx, x, pos):
         """(B, S_c, E) -> rotated q (B, H, S_c, D), k/v (B, KVH, S_c, D)
@@ -275,6 +282,79 @@ class LlamaBlock(nn.Module):
         return y[:, 0], kcache, vcache
 
 
+class MoeLlamaBlock(LlamaBlock):
+    """Mixtral-shape block: the Llama attention (RoPE + GQA + flash)
+    with the dense SwiGLU replaced by a top-k routed mixture of SwiGLU
+    experts — one expert per device along ``moe_axis``, dispatch and
+    combine via the Switch/GShard ``all_to_all`` machinery
+    (parallel/expert_parallel.py), load-balancing aux loss through
+    ``Ctx.add_aux_loss``.
+
+    Expert weights are stacked full-size ``(E, ...)`` and replicated
+    (mesh-independent checkpoints, exact grads under the step's
+    psum-mean — the MoeGptBlock convention, models/gpt.py).  Unlike
+    Mixtral's softmax-over-top-k, gates follow the framework-wide
+    Switch/GShard semantics of ``switch_moe`` (top-1: the chosen
+    expert's softmax probability; top-2: normalized over the pair).
+    """
+
+    def __init__(self, hidden, heads, kv_heads, intermediate,
+                 num_experts, rope_theta=10000.0, eps=1e-6,
+                 head_dim=None, moe_axis="data", capacity_factor=1.25,
+                 top_k=1, aux_weight=0.01):
+        from ..nn.parameter import Parameter
+
+        super().__init__(hidden, heads, kv_heads, intermediate,
+                         rope_theta=rope_theta, eps=eps,
+                         head_dim=head_dim, _dense_ffn=False)
+        self.moe_axis = moe_axis
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.top_k = top_k
+        self.aux_weight = aux_weight
+        self.router = nn.Linear(hidden, num_experts, bias=False)
+        self.router.weight.data = self.router.weight.data * 0.1
+        wg, wu, wd = [], [], []
+        for _ in range(num_experts):
+            lg = nn.Linear(hidden, intermediate, bias=False)
+            lu = nn.Linear(hidden, intermediate, bias=False)
+            ld = nn.Linear(intermediate, hidden, bias=False)
+            wg.append(lg.weight.data)
+            wu.append(lu.weight.data)
+            wd.append(ld.weight.data)
+        self.wg = Parameter(jnp.stack(wg))    # (E, I, H)
+        self.wu = Parameter(jnp.stack(wu))    # (E, I, H)
+        self.wd = Parameter(jnp.stack(wd))    # (E, H, I)
+
+    def _ffn(self, ctx, h):
+        from ..parallel.expert_parallel import switch_moe
+
+        b, s, e = h.shape
+        toks = h.reshape(b * s, e)
+        i = jax.lax.axis_index(self.moe_axis)
+        params = tuple(
+            jax.lax.dynamic_index_in_dim(ctx.value(p), i, 0,
+                                         keepdims=False)
+            for p in (self.wg, self.wu, self.wd))
+
+        def expert_fn(params, xe):
+            wgl, wul, wdl = params
+            gated = F.silu(jnp.matmul(xe, wgl.T.astype(xe.dtype))) \
+                * jnp.matmul(xe, wul.T.astype(xe.dtype))
+            return jnp.matmul(gated, wdl.T.astype(xe.dtype))
+
+        y, aux = switch_moe(toks, ctx.value(self.router.weight).T,
+                            params, expert_fn, self.moe_axis,
+                            capacity_factor=self.capacity_factor,
+                            top_k=self.top_k)
+        ctx.add_aux_loss(self.aux_weight * aux)
+        return y.reshape(b, s, e)
+
+    def tp_sharded_params(self):
+        raise NotImplementedError(
+            "MoeLlamaBlock does not compose with tensor parallelism")
+
+
 class LlamaModel(nn.Module):
     """Embeddings → N Llama blocks → final RMSNorm → untied LM head.
     ``forward(input_ids[B,S]) -> logits (B, S, V)``."""
@@ -282,13 +362,34 @@ class LlamaModel(nn.Module):
     def __init__(self, vocab_size=32000, hidden=512, layers=8, heads=8,
                  kv_heads=None, intermediate=None, max_positions=2048,
                  rope_theta=10000.0, eps=1e-6, remat=False,
-                 head_dim=None, tp_axis=None):
+                 head_dim=None, tp_axis=None, moe_axis=None,
+                 moe_num_experts=None, moe_every=2,
+                 moe_capacity_factor=1.25, moe_top_k=1,
+                 moe_aux_weight=0.01):
         super().__init__()
         self.hidden = hidden
         self.max_positions = max_positions
         self.rope_theta = rope_theta
         self.remat = remat
         self.tp_axis = tp_axis
+        # moe_axis: Mixtral-shape MoE — every ``moe_every``-th block
+        # routes its SwiGLU over experts along the axis (the GptModel
+        # convention; one expert per device, moe_num_experts = axis size)
+        self.moe_axis = moe_axis
+        if moe_axis is not None:
+            if moe_num_experts is None:
+                raise ValueError(
+                    "moe_axis requires moe_num_experts (= the mesh axis "
+                    "size: one expert per device)")
+            if tp_axis is not None:
+                raise ValueError(
+                    "moe_axis and tp_axis are mutually exclusive for now "
+                    "(expert FFNs are not tensor-sharded)")
+            if not 1 <= moe_every <= layers:
+                raise ValueError(
+                    f"moe_every={moe_every} with layers={layers}: must "
+                    f"be in [1, layers] or no block would be MoE (block "
+                    f"moe_every-1 is the first routed one)")
         kv_heads = kv_heads or heads
         # Llama's FFN width: 2/3 * 4E rounded up to a multiple of 256
         # (only the default — checkpoints carry their own)
@@ -296,11 +397,21 @@ class LlamaModel(nn.Module):
             intermediate = -(-(8 * hidden // 3) // 256) * 256
         self.tok_emb = nn.Embedding(vocab_size, hidden)
         self.tok_emb.weight.data = self.tok_emb.weight.data * 0.02
-        self.blocks = nn.ModuleList([
-            LlamaBlock(hidden, heads, kv_heads, intermediate,
-                       rope_theta=rope_theta, eps=eps, head_dim=head_dim,
-                       tp_axis=tp_axis)
-            for _ in range(layers)])
+
+        def build_block(idx):
+            if moe_axis is not None and idx % moe_every == moe_every - 1:
+                return MoeLlamaBlock(
+                    hidden, heads, kv_heads, intermediate,
+                    moe_num_experts, rope_theta=rope_theta, eps=eps,
+                    head_dim=head_dim, moe_axis=moe_axis,
+                    capacity_factor=moe_capacity_factor,
+                    top_k=moe_top_k, aux_weight=moe_aux_weight)
+            return LlamaBlock(hidden, heads, kv_heads, intermediate,
+                              rope_theta=rope_theta, eps=eps,
+                              head_dim=head_dim, tp_axis=tp_axis)
+
+        self.blocks = nn.ModuleList([build_block(i)
+                                     for i in range(layers)])
         self.norm = FusedRMSNorm(hidden, eps=eps)
         self.lm_head = nn.Linear(hidden, vocab_size, bias=False)
         # untied head initialized like the embedding, N(0, 0.02) (the
@@ -347,10 +458,10 @@ class LlamaModel(nn.Module):
             x, ctx.value(self.lm_head.weight).T.astype(x.dtype))
 
     def _decode_guard(self, what):
-        if self.tp_axis is not None:
+        if self.tp_axis is not None or self.moe_axis is not None:
             raise NotImplementedError(
                 f"{what} is single-shard; build the model without "
-                f"tp_axis for inference")
+                f"tp_axis/moe_axis for inference")
 
     def _run_blocks(self, ctx, toks, caches, blk_fn):
         """Embed ``toks``, thread the caches through ``blk_fn`` per

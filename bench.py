@@ -224,21 +224,16 @@ def run_kernel_checks():
     run in interpret mode on CPU).  Pallas-compiled vs jnp-fallback parity +
     VMEM-fit guard for the attention block sizes."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
-    from apex_tpu.ops import pallas as pal
-    from apex_tpu.ops.pallas.attention import vmem_fit
 
-    on_tpu = jax.default_backend() == "tpu"
-    mode = "compiled" if on_tpu else "interpret"
-    results = {"mode": mode}
-    rng = np.random.default_rng(0)
+    mode = "compiled" if jax.default_backend() == "tpu" else "interpret"
     # the parity check must exercise the KERNEL at every shape — pin the
     # shape-aware dispatch open (it would route small S to XLA and this
     # would silently compare XLA to itself); _pin_flash_dispatch restores
     # the production dispatch afterwards
     with _pin_flash_dispatch():
-        return _run_kernel_checks_inner(mode, results, rng)
+        return _run_kernel_checks_inner(mode, {"mode": mode},
+                                        np.random.default_rng(0))
 
 
 def _run_kernel_checks_inner(mode, results, rng):
