@@ -175,15 +175,22 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
     # bounded compile cache: each entry's closure pins its draft module
     # (and XLA executable), so evict oldest beyond a small working set —
     # a loop trying many drafts against one target must not accumulate
-    # them all for the target's lifetime
+    # them all for the target's lifetime.  Parameter-object tuples are
+    # part of the key for the same reason as generate()'s cache: the
+    # cached run zips its closure's param lists with the caller's vals,
+    # so swapping either model's parameter set (LoRA apply/merge) must
+    # miss; the entry holds the refs so ids cannot recycle into false
+    # hits.
     cache = getattr(target, "_spec_jit_cache", None)
     if cache is None:
         cache = target._spec_jit_cache = {}
     cfg = (id(draft), b, p, max_new_tokens, k,
-           None if cache_dtype is None else jnp.dtype(cache_dtype).name)
-    jitted = cache.get(cfg)
-    if jitted is None:
+           None if cache_dtype is None else jnp.dtype(cache_dtype).name,
+           tuple(id(o) for o in t_params), tuple(id(o) for o in d_params))
+    entry = cache.pop(cfg, None)    # pop + reinsert = LRU refresh
+    if entry is None:
         while len(cache) >= 8:
             cache.pop(next(iter(cache)))
-        jitted = cache[cfg] = jax.jit(run)
-    return jitted(t_vals, d_vals, prompt_ids)
+        entry = ((t_params, d_params), jax.jit(run))
+    cache[cfg] = entry
+    return entry[1](t_vals, d_vals, prompt_ids)

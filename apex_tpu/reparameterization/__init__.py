@@ -5,6 +5,7 @@ remove_reparameterization with the reference's dotted-name and
 apply-to-everything ('' name) semantics."""
 from .reparameterization import Reparameterization
 from .weight_norm import WeightNorm
+from .lora import LoRA, apply_lora, lora_parameters  # noqa: F401
 
 
 def apply_weight_norm(module, name="", dim=0, hook_child=True):

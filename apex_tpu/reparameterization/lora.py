@@ -1,0 +1,108 @@
+"""LoRA (low-rank adaptation) as a weight reparameterization:
+``w = w0 + (alpha / r) * B @ A`` with ``w0`` frozen and only the rank-r
+factors trained.
+
+Built on the same derived-parameter machinery as WeightNorm
+(reparameterization.py): the module attribute stays a Parameter whose
+value ``Ctx.value`` computes at trace time, so EVERY consumer — the
+fused train step, the imperative tape, decode paths — sees the adapted
+weight with no forward-code changes, and XLA fuses the rank-r update
+into the consuming matmul.  ``Reparameterization.remove`` doubles as
+the standard LoRA MERGE: it bakes ``w0 + scale * B A`` back into a
+plain parameter for inference.
+
+Init follows the LoRA paper: ``A ~ N(0, 0.02)``, ``B = 0`` — the
+adapted model starts exactly at the base model.  Train by giving the
+optimizer ONLY :func:`lora_parameters`; everything else is frozen by
+the framework's torch-semantics rule (parameters in no optimizer group
+receive no update).  Honest cost note: the fused step still computes
+gradients for frozen parameters inside the one compiled program (they
+feed only the overflow check) and allocates their optimizer slots —
+LoRA's win here is update/comm volume and the merge/swap workflow, not
+backward FLOPs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .reparameterization import Reparameterization
+from ..nn.parameter import Parameter
+
+
+class LoRA(Reparameterization):
+    """``dim`` carries the rank r (the generic plumbing's one free
+    slot); ``alpha`` is a class attribute so :func:`apply_lora` can
+    specialize it — default ``2 r``, the common alpha/r = 2 recipe."""
+
+    alpha = None
+
+    def __init__(self, name, dim, module, retain_forward=True):
+        if dim is None or dim < 1:
+            raise ValueError(f"LoRA rank must be a positive int, "
+                             f"got {dim!r}")
+        super().__init__(name, dim, module, retain_forward)
+        self.r = dim
+        self.scale = (self.alpha if self.alpha is not None
+                      else 2.0 * dim) / dim
+
+    def compute_weight(self, ctx, module=None, name=None):
+        if module is None:
+            module = self.module
+        if name is None:
+            name = self.name
+        module, name = Reparameterization.get_module_and_name(module, name)
+        w0 = ctx.value(getattr(module, name + "_w0"))
+        b = ctx.value(getattr(module, name + "_lora_b"))
+        a = ctx.value(getattr(module, name + "_lora_a"))
+        delta = self.scale * jnp.matmul(b.astype(jnp.float32),
+                                        a.astype(jnp.float32))
+        return (w0.astype(jnp.float32)
+                + delta.reshape(w0.shape)).astype(w0.dtype)
+
+    def reparameterize(self, name, weight, dim):
+        out_f = weight.data.shape[0]
+        in_f = int(np.prod(weight.data.shape[1:]))
+        if dim > min(out_f, in_f):
+            raise ValueError(
+                f"LoRA rank {dim} exceeds min(out, in) = "
+                f"{min(out_f, in_f)} of '{name}' {tuple(weight.data.shape)}")
+        w0 = Parameter(weight.data, requires_grad=False)
+        from ..nn.modules import _next_key
+        a = Parameter(0.02 * jax.random.normal(
+            _next_key(), (dim, in_f), jnp.float32))
+        b = Parameter(jnp.zeros((out_f, dim), jnp.float32))
+        return ([name + "_w0", name + "_lora_b", name + "_lora_a"],
+                [w0, b, a])
+
+
+def apply_lora(module, name="", r=8, alpha=None, hook_child=True):
+    """Adapt ``name`` (or, with no name, every >1-d parameter) with a
+    rank-``r`` LoRA.  Returns the module.  ``alpha`` scales the update
+    by ``alpha / r`` (default ``2 r``).  Typical fine-tune::
+
+        apply_lora(model, "blocks.0.q_proj.weight", r=8)   # per weight
+        apply_lora(model, r=8)                             # everything
+        opt = FusedAdam(lora_parameters(model), lr=1e-4)
+        step = make_train_step(model, opt, loss_fn)        # w0 frozen
+
+    Merge for inference with
+    ``remove_reparameterization(model, LoRA, remove_all=True)`` (or a
+    single name) — the adapted value bakes into a plain parameter.
+    """
+    from . import apply_reparameterization
+
+    cls = LoRA if alpha is None else type(
+        "LoRA", (LoRA,), {"alpha": float(alpha)})
+    return apply_reparameterization(
+        module, reparameterization=cls, name=name, dim=r,
+        hook_child=hook_child)
+
+
+def lora_parameters(module):
+    """The trainable LoRA factors (``*_lora_a`` / ``*_lora_b``) — the
+    list to hand the optimizer."""
+    return [p for n, p in module.named_parameters()
+            if n.endswith("_lora_a") or n.endswith("_lora_b")]

@@ -92,9 +92,19 @@ class Reparameterization:
         else:
             fn = reparameterization(name, dim, module)
 
+        # build the source parameters BEFORE touching the registry: a
+        # reparameterize that rejects this weight (e.g. LoRA's rank
+        # bound) must leave the module intact — and under the bulk
+        # non-strict sweep it skips the weight instead of aborting
+        # half-adapted
+        try:
+            names, params = fn.reparameterize(name2use, weight, dim)
+        except ValueError:
+            if strict:
+                raise
+            return
         # remove weight from the parameter list, register sources
         del module2use._parameters[name2use]
-        names, params = fn.reparameterize(name2use, weight, dim)
         for n, p in zip(names, params):
             module2use.register_parameter(n, p)
         fn.reparameterization_names = names

@@ -1,0 +1,189 @@
+"""LoRA fine-tuning (reparameterization/lora.py): exact base-model
+start (B=0), gradient flow to the factors only, frozen w0 through the
+fused step, merge-for-inference parity, conv adaptation, and the HF
+fine-tune flow on a converted checkpoint."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import apex_tpu.nn as nn
+from apex_tpu.nn.modules import Ctx
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.reparameterization import (LoRA, apply_lora,
+                                         lora_parameters,
+                                         remove_reparameterization)
+from apex_tpu.training import make_train_step
+
+
+def _mlp(seed=0):
+    nn.manual_seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+
+
+def test_lora_starts_at_base_model(rng):
+    m = _mlp()
+    x = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+    base = np.asarray(m(x).value)
+    apply_lora(m, r=4)
+    got = np.asarray(m(x).value)
+    np.testing.assert_allclose(got, base, rtol=1e-6, atol=1e-6)
+    # factors exist for every >1-d weight; biases untouched
+    names = [n for n, _ in m.named_parameters()]
+    assert any(n.endswith("weight_lora_a") for n in names)
+    assert any(n.endswith("weight_w0") for n in names)
+    assert not any(n.endswith("bias_lora_a") for n in names)
+
+
+def test_lora_trains_factors_only_through_fused_step(rng):
+    m = _mlp(seed=1)
+    apply_lora(m, r=4)
+    w0_before = {
+        n: np.asarray(p.data) for n, p in m.named_parameters()
+        if n.endswith("_w0")}
+    opt = FusedAdam(lora_parameters(m), lr=5e-2)
+    step = make_train_step(
+        m, opt, lambda out, y: jnp.mean((out - y) ** 2),
+        half_dtype=None, loss_scale=1.0)
+    x = jnp.asarray(rng.standard_normal((32, 16)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)
+    l0 = float(step(x, y))
+    for _ in range(25):
+        l = float(step(x, y))
+    assert np.isfinite(l) and l < 0.7 * l0
+    step.sync_to_objects()
+    for n, p in m.named_parameters():
+        if n.endswith("_w0"):
+            np.testing.assert_array_equal(np.asarray(p.data),
+                                          w0_before[n]), n
+        if n.endswith("_lora_b"):
+            assert float(jnp.sum(jnp.abs(p.data))) > 0, \
+                f"{n} never trained"
+
+
+def test_lora_merge_matches_adapted_forward(rng):
+    m = _mlp(seed=2)
+    apply_lora(m, "0.weight", r=2)
+    # give the factors nonzero values so the merge is nontrivial
+    for n, p in m.named_parameters():
+        if n.endswith("_lora_b"):
+            p.data = jnp.ones_like(p.data) * 0.1
+    x = jnp.asarray(rng.standard_normal((4, 16)), jnp.float32)
+    adapted = np.asarray(m(x).value)
+    remove_reparameterization(m, LoRA, remove_all=True)
+    merged = np.asarray(m(x).value)
+    np.testing.assert_allclose(merged, adapted, rtol=1e-5, atol=1e-6)
+    names = [n for n, _ in m.named_parameters()]
+    assert not any("lora" in n or n.endswith("_w0") for n in names)
+
+
+def test_lora_on_conv(rng):
+    nn.manual_seed(3)
+    conv = nn.Conv2d(3, 8, 3, padding=1)
+    x = jnp.asarray(rng.standard_normal((2, 3, 8, 8)), jnp.float32)
+    base = np.asarray(conv(x).value)
+    apply_lora(conv, "weight", r=2)
+    np.testing.assert_allclose(np.asarray(conv(x).value), base,
+                               rtol=1e-6, atol=1e-6)
+    # factor shapes: B (out, r), A (r, in*k*k)
+    assert conv.weight_lora_b.shape == (8, 2)
+    assert conv.weight_lora_a.shape == (2, 3 * 3 * 3)
+
+
+def test_lora_rank_validation():
+    m = _mlp(seed=4)
+    with pytest.raises(ValueError, match="rank"):
+        apply_lora(m, "0.weight", r=0)
+    with pytest.raises(ValueError, match="exceeds"):
+        apply_lora(m, "2.weight", r=64)   # Linear(32, 8): min dim 8
+    # a rejected apply must leave the model INTACT (the registry is
+    # only mutated after reparameterize succeeds)
+    names = [n for n, _ in m.named_parameters()]
+    assert "2.weight" in names and not any("lora" in n for n in names)
+    np.isfinite(np.asarray(m(jnp.ones((1, 16))).value)).all()
+
+
+def test_lora_bulk_sweep_skips_small_weights(rng):
+    """The '' (everything) sweep skips weights too small for the rank
+    instead of aborting half-adapted — the strict=False contract."""
+    nn.manual_seed(5)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 2))
+    apply_lora(m, r=8)                    # Linear(32, 2): min dim 2 < 8
+    names = [n for n, _ in m.named_parameters()]
+    assert any(n.startswith("0.weight_lora") for n in names)
+    assert "2.weight" in names            # skipped, intact
+    assert not any(n.startswith("2.weight_lora") for n in names)
+    x = jnp.asarray(rng.standard_normal((3, 16)), jnp.float32)
+    assert np.isfinite(np.asarray(m(x).value)).all()
+
+
+def test_lora_fine_tunes_hf_gpt2(rng):
+    """The migration flow: convert an HF GPT-2 checkpoint, LoRA the
+    attention projections, fine-tune — base weights bit-frozen, loss
+    decreases, and the merged model serves without LoRA machinery."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from apex_tpu.models import gpt2_from_hf
+    from apex_tpu.nn import functional as F
+
+    cfg = transformers.GPT2Config(
+        vocab_size=97, n_embd=32, n_layer=2, n_head=4, n_positions=32,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    model = gpt2_from_hf(transformers.GPT2LMHeadModel(cfg))
+    model.train()
+    for blk in model.blocks:
+        apply_lora(blk, "attn.in_proj_weight", r=4)
+    opt = FusedAdam(lora_parameters(model), lr=1e-2)
+
+    def lm_loss(logits, ids):
+        return jnp.mean(F.cross_entropy(
+            logits[:, :-1].reshape((-1, 97)), ids[:, 1:].reshape((-1,))))
+
+    step = make_train_step(model, opt, lm_loss, half_dtype=None,
+                           loss_scale=1.0)
+    ids = jnp.asarray(rng.integers(0, 97, (4, 16)))
+    l0 = float(step(ids, ids))
+    for _ in range(20):
+        l = float(step(ids, ids))
+    assert np.isfinite(l) and l < l0
+    step.sync_to_objects()
+    remove_reparameterization(model, LoRA, remove_all=True)
+    out = model(ids)
+    assert np.isfinite(np.asarray(out.value)).all()
+
+
+def test_lora_train_sync_generate_flow(rng):
+    """Regression: generate() before AND after a LoRA merge.  The jit
+    cache used to key only on shapes/config, so the post-merge call hit
+    the pre-merge compiled run, whose env zipped the OLD parameter list
+    against the new values — reading the wrong weights (a trace-time
+    shape error here; silently wrong logits in same-shape cases).  The
+    cache now keys on the parameter-object tuple."""
+    from apex_tpu.models import generate
+    from apex_tpu.models.llama import llama_tiny
+    from apex_tpu.nn import functional as F
+
+    nn.manual_seed(0)
+    model = llama_tiny()
+    for blk in model.blocks:
+        apply_lora(blk, "q_proj.weight", r=4)
+        apply_lora(blk, "v_proj.weight", r=4)
+    opt = FusedAdam(lora_parameters(model), lr=5e-3)
+    step = make_train_step(
+        model, opt,
+        lambda lg, t: jnp.mean(F.cross_entropy(
+            lg[:, :-1].reshape((-1, 1000)), t[:, 1:].reshape((-1,)))),
+        half_dtype=jnp.bfloat16)
+    ids = jnp.asarray(rng.integers(0, 1000, (8, 24)))
+    l0 = float(step(ids, ids))
+    for _ in range(10):
+        l = float(step(ids, ids))
+    assert np.isfinite(l) and l < l0
+    step.sync_to_objects()
+    model.eval()
+    pre = generate(model, ids[:1, :8], 6)
+    remove_reparameterization(model, LoRA, remove_all=True)
+    post = generate(model, ids[:1, :8], 6)
+    np.testing.assert_array_equal(np.asarray(pre), np.asarray(post))
