@@ -237,3 +237,101 @@ def llama_from_hf(src, **model_kw):
                 norm[p + "mlp." + name + ".weight"])
     model.eval()
     return model
+
+
+# ---------------------------------------------------------------------------
+# export: the inverse direction (train here, serve anywhere)
+# ---------------------------------------------------------------------------
+
+def _deinterleave_qkv(w, heads, head_dim):
+    """Reference interleaved head-major ``(3E, E)`` -> HF type-major
+    ``(3E, E)`` (inverse of :func:`_interleave_qkv`)."""
+    e = heads * head_dim
+    return w.reshape(heads, 3, head_dim, e).transpose(1, 0, 2, 3) \
+            .reshape(3 * e, e)
+
+
+def _deinterleave_qkv_bias(b, heads, head_dim):
+    return b.reshape(heads, 3, head_dim).transpose(1, 0, 2).reshape(-1)
+
+
+def gpt2_to_hf_state_dict(model):
+    """Export a :class:`GptModel` as an HF ``GPT2LMHeadModel`` state
+    dict (numpy float32 values, ``transformer.``-prefixed keys plus the
+    tied ``lm_head.weight``).  Inverse of :func:`gpt2_from_hf` — load
+    with ``strict=False`` (HF's causal-mask buffers are constants the
+    dict omits) and the torch forward reproduces this model's logits
+    (tests/test_hf_interop.py round-trip).
+    """
+    if getattr(model, "moe_axis", None) is not None:
+        raise ValueError(
+            "gpt2_to_hf_state_dict: MoE models have no GPT2LMHeadModel "
+            "layout (export the dense family, or the experts separately)")
+    heads = model.blocks[0].attn.num_heads
+    head_dim = model.blocks[0].attn.head_dim
+    attn0 = model.blocks[0].attn
+    if attn0.in_proj_bias is None or attn0.out_proj_bias is None:
+        # a model-wide constructor property: check once, before any work
+        raise ValueError(
+            "gpt2_to_hf_state_dict requires attention biases (HF "
+            "GPT-2's Conv1D projections always carry them) — build "
+            "the model with attn_bias=True, as gpt2_from_hf does")
+    sd = {}
+
+    def np32(p):
+        return _to_numpy(p.data)
+
+    sd["transformer.wte.weight"] = np32(model.tok_emb.weight)
+    sd["transformer.wpe.weight"] = np32(model.pos_emb.weight)
+    sd["transformer.ln_f.weight"] = np32(model.ln_f.weight)
+    sd["transformer.ln_f.bias"] = np32(model.ln_f.bias)
+    sd["lm_head.weight"] = sd["transformer.wte.weight"]
+    for i, blk in enumerate(model.blocks):
+        p = f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = np32(blk.ln1.weight)
+        sd[p + "ln_1.bias"] = np32(blk.ln1.bias)
+        sd[p + "ln_2.weight"] = np32(blk.ln2.weight)
+        sd[p + "ln_2.bias"] = np32(blk.ln2.bias)
+        sd[p + "attn.c_attn.weight"] = _deinterleave_qkv(
+            np32(blk.attn.in_proj_weight), heads, head_dim).T
+        sd[p + "attn.c_attn.bias"] = _deinterleave_qkv_bias(
+            np32(blk.attn.in_proj_bias), heads, head_dim)
+        sd[p + "attn.c_proj.weight"] = np32(blk.attn.out_proj_weight).T
+        sd[p + "attn.c_proj.bias"] = np32(blk.attn.out_proj_bias)
+        sd[p + "mlp.c_fc.weight"] = np32(blk.fc1.weight).T
+        sd[p + "mlp.c_fc.bias"] = np32(blk.fc1.bias)
+        sd[p + "mlp.c_proj.weight"] = np32(blk.fc2.weight).T
+        sd[p + "mlp.c_proj.bias"] = np32(blk.fc2.bias)
+    return sd
+
+
+def llama_to_hf_state_dict(model):
+    """Export a :class:`LlamaModel` as an HF ``LlamaForCausalLM`` state
+    dict (numpy float32; plain ``(out, in)`` linears both sides, no
+    permutations).  Inverse of :func:`llama_from_hf`; round-trip logit
+    parity in tests/test_hf_interop.py.  MoE models (`moe_axis`) have
+    no HF Llama equivalent and are refused.
+    """
+    if getattr(model, "moe_axis", None) is not None:
+        raise ValueError(
+            "llama_to_hf_state_dict: MoE models have no LlamaForCausalLM "
+            "layout (export the dense family, or the experts separately)")
+    sd = {}
+
+    def np32(p):
+        return _to_numpy(p.data)
+
+    sd["model.embed_tokens.weight"] = np32(model.tok_emb.weight)
+    sd["model.norm.weight"] = np32(model.norm.weight)
+    sd["lm_head.weight"] = np32(model.lm_head.weight)
+    for i, blk in enumerate(model.blocks):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = np32(blk.ln1.weight)
+        sd[p + "post_attention_layernorm.weight"] = np32(blk.ln2.weight)
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[p + "self_attn." + name + ".weight"] = \
+                np32(getattr(blk, name).weight)
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            sd[p + "mlp." + name + ".weight"] = \
+                np32(getattr(blk, name).weight)
+    return sd

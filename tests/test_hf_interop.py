@@ -268,3 +268,64 @@ def test_llama_geometry_inferred():
     assert model.blocks[0].heads == L_HEADS
     assert model.blocks[0].kv_heads == L_KV
     assert not model.training
+
+
+# ---------------------------------------------------------------------------
+# export (to-HF round trips)
+# ---------------------------------------------------------------------------
+
+def test_gpt2_roundtrip_export(rng):
+    """apex_tpu -> HF state dict -> transformers forward reproduces the
+    exported model's logits (train here, serve anywhere)."""
+    from apex_tpu.models import gpt2_to_hf_state_dict
+
+    hf = _hf_model(seed=5)
+    model = gpt2_from_hf(hf)           # carry known weights
+    sd = gpt2_to_hf_state_dict(model)
+    fresh = _hf_model(seed=6)          # different weights
+    missing, unexpected = fresh.load_state_dict(
+        {k: torch.from_numpy(v.copy()) for k, v in sd.items()},
+        strict=False)
+    assert not unexpected
+    assert all("attn.bias" in k or "masked_bias" in k for k in missing)
+    ids = _ids(rng, b=2, s=11)
+    with torch.no_grad():
+        got = fresh(torch.from_numpy(ids)).logits.numpy()
+    want = np.asarray(model(jnp.asarray(ids)).value)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_roundtrip_export(rng):
+    from apex_tpu.models import llama_from_hf, llama_to_hf_state_dict
+
+    hf = _hf_llama(seed=7)
+    model = llama_from_hf(hf)
+    sd = llama_to_hf_state_dict(model)
+    fresh = _hf_llama(seed=8)
+    missing, unexpected = fresh.load_state_dict(
+        {k: torch.from_numpy(v.copy()) for k, v in sd.items()},
+        strict=False)
+    assert not unexpected and not missing
+    ids = _lids(rng, b=2, s=9)
+    with torch.no_grad():
+        got = fresh(torch.from_numpy(ids)).logits.numpy()
+    want = np.asarray(model(jnp.asarray(ids)).value)
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_llama_export_refuses_moe():
+    from apex_tpu.models import LlamaModel, llama_to_hf_state_dict
+
+    m = LlamaModel(vocab_size=64, hidden=32, layers=2, heads=2,
+                   moe_axis="data", moe_num_experts=4)
+    with pytest.raises(ValueError, match="MoE"):
+        llama_to_hf_state_dict(m)
+
+
+def test_gpt2_export_refuses_moe():
+    from apex_tpu.models import GptModel, gpt2_to_hf_state_dict
+
+    m = GptModel(vocab_size=64, hidden=32, layers=2, heads=2,
+                 attn_dropout=0.0, moe_axis="data", moe_num_experts=4)
+    with pytest.raises(ValueError, match="MoE"):
+        gpt2_to_hf_state_dict(m)
