@@ -1,3 +1,3 @@
 from .checkpoint import (  # noqa: F401
-    load_checkpoint, restore_train_state, save_checkpoint,
-    save_train_state)
+    AsyncTrainStateSaver, load_checkpoint, restore_train_state,
+    save_checkpoint, save_train_state)

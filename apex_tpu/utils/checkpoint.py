@@ -72,6 +72,61 @@ def save_train_state(path: str, step) -> None:
     ckptr.wait_until_finished()
 
 
+class AsyncTrainStateSaver:
+    """Asynchronous :func:`save_train_state`: serialization overlaps
+    training instead of stalling the step loop.
+
+    ``save`` returns once orbax's AsyncCheckpointer has copied the
+    state device-to-host (its documented contract — THIS is what makes
+    continuing to train safe: the fused step's buffer donation deletes
+    the old device arrays on the next call, so the copy must complete
+    before the loop resumes, and it does, inside ``save``).  The disk
+    write then proceeds on background threads.  A second ``save``
+    before the first finishes blocks until it completes (one in-flight
+    write per saver).  Call ``wait`` (or close the saver) before
+    reading the checkpoint or exiting::
+
+        saver = AsyncTrainStateSaver()
+        for i, batch in enumerate(loader):
+            loss = step(*batch)
+            if i % 1000 == 0:
+                saver.save(f"ckpt/step_{i}", step)
+        saver.close()
+
+    Restore with the synchronous :func:`restore_train_state`.
+    """
+
+    def __init__(self):
+        import orbax.checkpoint as ocp
+
+        self._ckptr = ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler())
+
+    def save(self, path: str, step) -> None:
+        import os
+
+        import orbax.checkpoint as ocp
+
+        self._ckptr.save(os.path.abspath(path),
+                         args=ocp.args.StandardSave(step.state),
+                         force=True)
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) is durable."""
+        self._ckptr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._ckptr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
 def restore_train_state(path: str, step) -> None:
     """Restore a :func:`save_train_state` checkpoint into ``step.state``,
     preserving each array's CURRENT sharding (a ZeRO step restores its

@@ -9,12 +9,20 @@ set -u
 cd "$(dirname "$0")"
 CURSOR_FILE="${CAPTURE_CURSOR:-.capture_cursor}"
 LOG=measurements.jsonl
+# NOTE: the cursor is POSITIONAL — when editing QUEUE, restart the
+# runner AND delete the cursor file unless only appending at the end.
 
 QUEUE=(
   # diagnose prints human progress lines to stdout: route them to its own
   # log so the measurements JSONL stream stays parseable (its JSON result
   # lines go to diagnose_gpt1024.jsonl via DIAG_LOG)
   "bash diagnose_gpt1024.sh >>diagnose_stdout.log 2>&1"
+  # headline configs re-measured on the shape-aware flash dispatch (the
+  # round-3 numbers in BENCH_HISTORY predate it: seq-128 attention now
+  # takes the XLA path, which the kernel A/B measured 1.2x faster there)
+  "timeout 700 python bench.py --no-kernels"
+  "timeout 700 python bench.py --bert --no-kernels"
+  "timeout 700 python bench.py --gpt --no-kernels"
   "timeout 700 python bench.py --profile"
   "timeout 700 python bench.py --profile --gpt"
   "timeout 900 python bench.py --sweep 96,128,192,256 --no-kernels --budget-s 840"
