@@ -6,6 +6,7 @@ from .gpt import (  # noqa: F401
     GptBlock, GptModel, generate, gpt2_small, gpt2_medium)
 from .llama import (  # noqa: F401
     LlamaBlock, LlamaModel, llama_tiny)
+from .vit import VitBlock, VitModel, vit_base, vit_small  # noqa: F401
 from .hf import (gpt2_from_hf, gpt2_to_hf_state_dict,  # noqa: F401
                  llama_from_hf, llama_to_hf_state_dict)
 from .seq2seq import (  # noqa: F401

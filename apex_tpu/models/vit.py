@@ -1,0 +1,109 @@
+"""Vision Transformer family — patch embedding + pre-LN encoder on the
+same fused substrate as the language families (SelfMultiheadAttn,
+FusedLayerNorm, fused train step, remat).
+
+The reference repo carries no vision transformer (its imagenet example
+is ResNet, SURVEY.md §2); this rounds out the zoo with the standard
+ViT shape (Dosovitskiy et al.): conv patchify, learned positions, a
+prepended CLS token, pre-LN blocks, classification off the CLS state.
+At ViT sequence lengths (197 tokens for 224/16) the shape-aware
+dispatch routes attention to XLA's own path — exactly the regime the
+kernel A/B measured it faster in.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..normalization import FusedLayerNorm
+from ..contrib.multihead_attn import SelfMultiheadAttn
+
+
+class VitBlock(nn.Module):
+    """Pre-LN encoder block: LN → MHA → residual, LN → GELU FFN →
+    residual (contrast BertLayer's post-LN)."""
+
+    def __init__(self, hidden, heads, intermediate, dropout=0.0,
+                 attn_dropout=0.0):
+        super().__init__()
+        self.ln1 = FusedLayerNorm(hidden)
+        self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
+                                      impl="fast")
+        self.ln2 = FusedLayerNorm(hidden)
+        self.fc1 = nn.Linear(hidden, intermediate)
+        self.fc2 = nn.Linear(intermediate, hidden)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, ctx, x):
+        h, _ = self.attn.forward(ctx, self.ln1.forward(ctx, x))
+        x = x + self.dropout.forward(ctx, h)
+        h = F.gelu(self.fc1.forward(ctx, self.ln2.forward(ctx, x)))
+        return x + self.dropout.forward(ctx, self.fc2.forward(ctx, h))
+
+
+class VitModel(nn.Module):
+    """``forward(images (B, 3, H, W)) -> logits (B, num_classes)``."""
+
+    def __init__(self, image_size=224, patch_size=16, hidden=384,
+                 layers=12, heads=6, num_classes=1000, intermediate=None,
+                 dropout=0.0, attn_dropout=0.0, remat=False):
+        super().__init__()
+        if image_size % patch_size:
+            raise ValueError(
+                f"image_size {image_size} not divisible by patch_size "
+                f"{patch_size}")
+        self.patch_size = patch_size
+        self.remat = remat
+        n_patches = (image_size // patch_size) ** 2
+        intermediate = intermediate or 4 * hidden
+        self.patch_embed = nn.Conv2d(3, hidden, patch_size,
+                                     stride=patch_size)
+        from ..nn.modules import _next_key
+        from ..nn.parameter import Parameter
+        self.cls_token = Parameter(0.02 * jax.random.normal(
+            _next_key(), (1, 1, hidden), jnp.float32))
+        self.pos_emb = Parameter(0.02 * jax.random.normal(
+            _next_key(), (n_patches + 1, hidden), jnp.float32))
+        self.dropout = nn.Dropout(dropout)
+        self.blocks = nn.ModuleList([
+            VitBlock(hidden, heads, intermediate, dropout=dropout,
+                     attn_dropout=attn_dropout)
+            for _ in range(layers)])
+        self.ln_f = FusedLayerNorm(hidden)
+        self.head = nn.Linear(hidden, num_classes)
+
+    def forward(self, ctx, x):
+        b = x.shape[0]
+        p = self.patch_embed.forward(ctx, x)          # (B, E, H', W')
+        e = p.shape[1]
+        p = p.reshape(b, e, -1)
+        p = jnp.swapaxes(p, 1, 2)                     # (B, N, E)
+        cls = jnp.broadcast_to(ctx.value(self.cls_token).astype(p.dtype),
+                               (b, 1, e))
+        x = jnp.concatenate([cls, p], axis=1)         # (B, N+1, E)
+        pos = ctx.value(self.pos_emb).astype(x.dtype)
+        if pos.shape[0] != x.shape[1]:
+            raise ValueError(
+                f"ViT built for {pos.shape[0] - 1} patches, got "
+                f"{x.shape[1] - 1} (input spatial size mismatch)")
+        x = self.dropout.forward(ctx, x + pos[None, :, :])
+        x = jnp.swapaxes(x, 0, 1)                     # (S, B, E) for MHA
+        for blk in self.blocks:
+            if self.remat:
+                x = nn.checkpoint_forward(blk, ctx, x)
+            else:
+                x = blk.forward(ctx, x)
+        x = self.ln_f.forward(ctx, x[0])              # CLS state (B, E)
+        return self.head.forward(ctx, x)
+
+
+def vit_small(**kw):
+    """ViT-S/16: 12 layers, hidden 384, 6 heads (~22M)."""
+    return VitModel(**{**dict(hidden=384, layers=12, heads=6), **kw})
+
+
+def vit_base(**kw):
+    """ViT-B/16: 12 layers, hidden 768, 12 heads (~86M)."""
+    return VitModel(**{**dict(hidden=768, layers=12, heads=12), **kw})

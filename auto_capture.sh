@@ -33,6 +33,11 @@ QUEUE=(
   "timeout 900 python bench.py --spec-decode --no-kernels --budget-s 840"
   "timeout 700 python bench.py --seq2seq --no-kernels"
   "timeout 900 python bench.py --kernels-timing --budget-s 840"
+  # intermediate long-seq datapoint (flash engages at 512 under the
+  # new dispatch; lower-risk than the seq-1024 config that hung)
+  "timeout 700 python bench.py 32 --gpt --seq-len 512 --no-kernels"
+  "timeout 700 python bench.py --llama --seq-len 512 --no-kernels"
+  "timeout 700 python bench.py --vit --no-kernels"
   "DIAG_FULL=1 bash diagnose_gpt1024.sh >>diagnose_stdout.log 2>&1"
 )
 

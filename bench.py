@@ -917,6 +917,44 @@ def run_decode_throughput(batch, seq_len, new_tokens=128, int8=False):
     return toks_per_sec, dt, compile_s
 
 
+def build_vit_step(batch):
+    """ViT-S/16 at 224 (~22M params), AdamW-style FusedAdam under the
+    bf16 fused step — the vision-transformer counterpart of the ResNet
+    headline (attention at 197 tokens rides the XLA path per the
+    shape-aware dispatch, so cost analysis sees every matmul)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.models import vit_small
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    stage("model_build", f"vit_small batch={batch}")
+    nn.manual_seed(0)
+    model = vit_small(num_classes=1000)
+    n_params = sum(int(np.prod(p.data.shape)) for p in model.parameters())
+    opt = FusedAdam(list(model.parameters()), lr=1e-3, adam_w_mode=True,
+                    weight_decay=0.05)
+    step = make_train_step(
+        model, opt, lambda out, y: F.cross_entropy(out, y),
+        half_dtype=jnp.bfloat16, loss_scale=1.0)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 3, 224, 224)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, (batch,)))
+    # 6ND-style fallback only (N params x D tokens: 197 per image);
+    # cost analysis sees the whole program on the normal path
+    tokens = (224 // 16) ** 2 + 1
+    return step, (x, y), (lambda: 6.0 * n_params * batch * tokens), 0.0
+
+
+def run_vit_throughput(batch, iters, warmup):
+    step, arrays, af, _ = build_vit_step(batch)
+    stage("compile", f"vit batch={batch}")
+    return time_compiled_step(step, arrays, iters, warmup, af)
+
+
 def build_resnet_step(batch):
     import jax.numpy as jnp
     import numpy as np
@@ -981,6 +1019,8 @@ def main():
                          "llama config (draft-verified, output exact)")
     ap.add_argument("--seq2seq", action="store_true",
                     help="run the transformer-base seq2seq config")
+    ap.add_argument("--vit", action="store_true",
+                    help="ViT-S/16 at 224 classification throughput")
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--gpt-size", default="small",
                     choices=["small", "medium"],
@@ -1040,6 +1080,9 @@ def main():
             return (f"seq2seq_base_seq{args.seq_len}_"
                     "sequences_per_sec_per_chip_ampO2",
                     "sequences/sec/chip")
+        if args.vit:
+            return ("vit_s16_imagenet_images_per_sec_per_chip_ampO2",
+                    "images/sec/chip")
         return "resnet50_imagenet_images_per_sec_per_chip_ampO2", \
             "images/sec/chip"
 
@@ -1051,6 +1094,11 @@ def main():
     if args.int8 and not args.gpt_decode:
         fail("int8_unsupported_config: --int8 is the weight-only "
              "quantized DECODE measurement; pair it with --gpt-decode")
+        return 1
+    if args.profile and (args.seq2seq or args.gpt_decode or args.vit
+                         or args.llama):
+        fail("profile_unsupported_config: --profile supports the "
+             "resnet (default), --gpt and --bert configs")
         return 1
     sweep_batches = None
     if args.sweep:
@@ -1076,7 +1124,7 @@ def main():
         return 1
 
     if args.profile:
-        if args.seq2seq or args.gpt_decode:
+        if args.seq2seq or args.gpt_decode or args.vit or args.llama:
             fail("profile_unsupported_config: --profile supports the "
                  "resnet (default), --gpt and --bert configs")
             return 1
@@ -1176,6 +1224,8 @@ def main():
             return run_llama_throughput(batch, args.seq_len, args.iters,
                                         args.warmup, remat=args.remat,
                                         plain_loss=args.plain_loss)
+        if args.vit:
+            return run_vit_throughput(batch, args.iters, args.warmup)
         return run_throughput(batch, args.iters, args.warmup)
 
     if args.sweep:
@@ -1185,7 +1235,8 @@ def main():
         cfg = ("bert" if args.bert else
                f"gpt2_{args.gpt_size}" if args.gpt else
                "llama_125m" if args.llama else
-               "seq2seq" if args.seq2seq else "resnet50")
+               "seq2seq" if args.seq2seq else
+               "vit_s16" if args.vit else "resnet50")
         peak, kind = peak_tflops(devices[0])
         ok = 0
         for batch in sweep_batches:
@@ -1249,7 +1300,8 @@ def main():
             kernels = {"error": f"{type(e).__name__}: {e}"}
 
     stage("report")
-    is_resnet = not (args.bert or args.gpt or args.llama or args.seq2seq)
+    is_resnet = not (args.bert or args.gpt or args.llama or args.seq2seq
+                     or args.vit)
     vs_baseline = (round(imgs_per_sec / V100_APEX_O2_IMGS_PER_SEC, 3)
                    if is_resnet else None)
     emit({
