@@ -62,8 +62,13 @@ class LlamaBlock(nn.Module):
 
     def __init__(self, hidden, heads, kv_heads, intermediate,
                  rope_theta=10000.0, eps=1e-6, head_dim=None,
-                 tp_axis=None, _dense_ffn=True):
+                 tp_axis=None, sp_axis=None, _dense_ffn=True):
         super().__init__()
+        # sp_axis: ring sequence parallelism — the sequence dim is
+        # sharded over this mesh axis and attention runs the ring
+        # (parallel/ring_attention.py); the MODEL supplies global-offset
+        # RoPE tables so each shard rotates by its absolute positions
+        self.sp_axis = sp_axis
         # tp_axis: Megatron tensor parallelism — forward must run inside
         # shard_map over a mesh with this axis.  Q heads AND KV heads
         # shard over it (both row-major head blocks in the projection
@@ -142,17 +147,22 @@ class LlamaBlock(nn.Module):
         q, k, v = self._qkv(ctx, h)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if q.shape[1] != k.shape[1]:
-            # GQA: repeat each KV head over its query group (the local
-            # ratio equals the global one under TP — both divide by n).
-            # Trace-time expansion is exact and XLA folds it into the
-            # attention matmul's layout; a kv-aware kernel would only
-            # save HBM for the expanded operand, which flash already
-            # streams blockwise
-            rep = q.shape[1] // k.shape[1]
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
-        o = flash_attention(q, k, v, causal=True)     # (B, H_loc, S, D)
+        if self.sp_axis is not None:
+            # the ring is GQA-aware: KVH-wide chunks rotate (H/KVH x
+            # fewer ICI bytes per hop), expansion happens at use
+            from ..parallel.ring_attention import ring_attention
+            o = ring_attention(q, k, v, self.sp_axis, causal=True)
+        else:
+            if q.shape[1] != k.shape[1]:
+                # GQA: repeat each KV head over its query group (the
+                # local ratio equals the global one under TP — both
+                # divide by n).  Trace-time expansion is exact and XLA
+                # folds it into the attention matmul's layout; flash
+                # already streams the expanded operand blockwise
+                rep = q.shape[1] // k.shape[1]
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            o = flash_attention(q, k, v, causal=True)  # (B, H_loc, S, D)
         o = jnp.swapaxes(o, 1, 2).reshape(b, s, q.shape[1] * self.head_dim)
         if self.tp_axis is not None:
             from ..parallel.tensor_parallel import (row_parallel_linear,
@@ -301,12 +311,13 @@ class MoeLlamaBlock(LlamaBlock):
     def __init__(self, hidden, heads, kv_heads, intermediate,
                  num_experts, rope_theta=10000.0, eps=1e-6,
                  head_dim=None, moe_axis="data", capacity_factor=1.25,
-                 top_k=1, aux_weight=0.01):
+                 top_k=1, aux_weight=0.01, sp_axis=None):
         from ..nn.parameter import Parameter
 
         super().__init__(hidden, heads, kv_heads, intermediate,
                          rope_theta=rope_theta, eps=eps,
-                         head_dim=head_dim, _dense_ffn=False)
+                         head_dim=head_dim, sp_axis=sp_axis,
+                         _dense_ffn=False)
         self.moe_axis = moe_axis
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
@@ -362,7 +373,7 @@ class LlamaModel(nn.Module):
     def __init__(self, vocab_size=32000, hidden=512, layers=8, heads=8,
                  kv_heads=None, intermediate=None, max_positions=2048,
                  rope_theta=10000.0, eps=1e-6, remat=False,
-                 head_dim=None, tp_axis=None, moe_axis=None,
+                 head_dim=None, tp_axis=None, sp_axis=None, moe_axis=None,
                  moe_num_experts=None, moe_every=2,
                  moe_capacity_factor=1.25, moe_top_k=1,
                  moe_aux_weight=0.01):
@@ -372,6 +383,13 @@ class LlamaModel(nn.Module):
         self.rope_theta = rope_theta
         self.remat = remat
         self.tp_axis = tp_axis
+        # sp_axis: ring sequence parallelism — forward must run inside
+        # shard_map with the sequence dim sharded rank-contiguously over
+        # this axis (device i holds global rows [i*S_loc, (i+1)*S_loc));
+        # RoPE rotates by global positions, attention runs the ring.
+        # Composes with tp_axis (heads shard, the ring passes local-head
+        # KV shards) and a data axis, exactly as the GPT family.
+        self.sp_axis = sp_axis
         # moe_axis: Mixtral-shape MoE — every ``moe_every``-th block
         # routes its SwiGLU over experts along the axis (the GptModel
         # convention; one expert per device, moe_num_experts = axis size)
@@ -405,10 +423,12 @@ class LlamaModel(nn.Module):
                     moe_num_experts, rope_theta=rope_theta, eps=eps,
                     head_dim=head_dim, moe_axis=moe_axis,
                     capacity_factor=moe_capacity_factor,
-                    top_k=moe_top_k, aux_weight=moe_aux_weight)
+                    top_k=moe_top_k, aux_weight=moe_aux_weight,
+                    sp_axis=sp_axis)
             return LlamaBlock(hidden, heads, kv_heads, intermediate,
                               rope_theta=rope_theta, eps=eps,
-                              head_dim=head_dim, tp_axis=tp_axis)
+                              head_dim=head_dim, tp_axis=tp_axis,
+                              sp_axis=sp_axis)
 
         self.blocks = nn.ModuleList([build_block(i)
                                      for i in range(layers)])
@@ -423,13 +443,23 @@ class LlamaModel(nn.Module):
 
     def forward(self, ctx, input_ids):
         b, s = input_ids.shape
-        if s > self.max_positions:
-            raise ValueError(
-                f"sequence length {s} exceeds max_positions "
-                f"{self.max_positions}")
         head_dim = self.blocks[0].head_dim
-        cos, sin = rope_tables(jnp.arange(s, dtype=jnp.int32), head_dim,
-                               self.rope_theta)
+        if self.sp_axis is not None:
+            # ``s`` is the LOCAL shard; RoPE rotates by global positions
+            n = jax.lax.axis_size(self.sp_axis)
+            if s * n > self.max_positions:
+                raise ValueError(
+                    f"global sequence {s} x {n} shards exceeds "
+                    f"max_positions {self.max_positions}")
+            pos = jax.lax.axis_index(self.sp_axis) * s \
+                + jnp.arange(s, dtype=jnp.int32)
+        else:
+            if s > self.max_positions:
+                raise ValueError(
+                    f"sequence length {s} exceeds max_positions "
+                    f"{self.max_positions}")
+            pos = jnp.arange(s, dtype=jnp.int32)
+        cos, sin = rope_tables(pos, head_dim, self.rope_theta)
         x = self.tok_emb.forward(ctx, input_ids)
         for blk in self.blocks:
             if self.remat:
@@ -458,10 +488,11 @@ class LlamaModel(nn.Module):
             x, ctx.value(self.lm_head.weight).T.astype(x.dtype))
 
     def _decode_guard(self, what):
-        if self.tp_axis is not None or self.moe_axis is not None:
+        if self.tp_axis is not None or self.moe_axis is not None \
+                or self.sp_axis is not None:
             raise NotImplementedError(
                 f"{what} is single-shard; build the model without "
-                f"tp_axis/moe_axis for inference")
+                f"tp_axis/sp_axis/moe_axis for inference")
 
     def _run_blocks(self, ctx, toks, caches, blk_fn):
         """Embed ``toks``, thread the caches through ``blk_fn`` per

@@ -117,7 +117,29 @@ def _merge(out, lse, o_r, lse_r):
 UNROLL_LIMIT = int(os.environ.get("APEX_TPU_RING_UNROLL_LIMIT", "8"))
 
 
-def _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode):
+def _expand_kv(kv3, groups, batch):
+    """(B*KVH, Sk, D) -> (B*H, Sk, D): repeat each KV head over its
+    query group (kv-major, groups consecutive — the GQA head order the
+    Llama family uses).  groups == 1 is the MHA no-op."""
+    if groups == 1:
+        return kv3
+    bkv, sk, d = kv3.shape
+    kv4 = kv3.reshape(batch, bkv // batch, sk, d)
+    return jnp.repeat(kv4, groups, axis=1).reshape(bkv * groups, sk, d)
+
+
+def _reduce_kv_grad(g3, groups, batch):
+    """Transpose of :func:`_expand_kv`: sum each query group's gradient
+    back onto its shared KV head."""
+    if groups == 1:
+        return g3
+    bh, sk, d = g3.shape
+    g5 = g3.reshape(batch, bh // batch // groups, groups, sk, d)
+    return jnp.sum(g5, axis=2).reshape(bh // groups, sk, d)
+
+
+def _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode, groups,
+                   batch):
     n = lax.psum(1, axis_name)          # static mesh-axis size
     idx = lax.axis_index(axis_name)
     bh, sq, d = q3.shape
@@ -128,10 +150,14 @@ def _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode):
 
     def step(r, out, lse, k_cur, v_cur, rotate):
         """One ring step, shared by the unrolled and fori paths; ``rotate``
-        controls the trailing hop (the unrolled path elides the last one)."""
+        controls the trailing hop (the unrolled path elides the last one).
+        GQA: the ring carries KVH-wide chunks (groups x fewer ICI bytes
+        per hop) and expands at the point of use."""
         src = (idx - r) % n             # which global chunk we hold now
         bias = _chunk_bias(sq, sk, idx * sq, src * sk, causal)
-        o_r, lse_r = _chunk_fwd(q3, k_cur, v_cur, bias, scale, mode)
+        o_r, lse_r = _chunk_fwd(q3, _expand_kv(k_cur, groups, batch),
+                                _expand_kv(v_cur, groups, batch), bias,
+                                scale, mode)
         out, lse = _merge(out, lse, o_r, lse_r)
         if rotate:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
@@ -153,18 +179,21 @@ def _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring(q3, k3, v3, axis_name, causal, scale, mode):
-    out, _ = _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring(q3, k3, v3, axis_name, causal, scale, mode, groups, batch):
+    out, _ = _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode,
+                            groups, batch)
     return out
 
 
-def _ring_vjp_fwd(q3, k3, v3, axis_name, causal, scale, mode):
-    out, lse = _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode)
+def _ring_vjp_fwd(q3, k3, v3, axis_name, causal, scale, mode, groups,
+                  batch):
+    out, lse = _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode,
+                              groups, batch)
     return out, (q3, k3, v3, out, lse)
 
 
-def _ring_vjp_bwd(axis_name, causal, scale, mode, res, g):
+def _ring_vjp_bwd(axis_name, causal, scale, mode, groups, batch, res, g):
     q3, k3, v3, out, lse = res
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -184,11 +213,13 @@ def _ring_vjp_bwd(axis_name, causal, scale, mode, res, g):
         so the unrolled path elides the final K/V rotate (``rotate_kv``)."""
         src = (idx - r) % n
         bias = _chunk_bias(sq, sk, idx * sq, src * sk, causal)
-        dq_r, dk_r, dv_r = _chunk_bwd(q3, k_cur, v_cur, bias, out_c, lse,
-                                      g_c, scale, mode)
+        dq_r, dk_r, dv_r = _chunk_bwd(
+            q3, _expand_kv(k_cur, groups, batch),
+            _expand_kv(v_cur, groups, batch), bias, out_c, lse,
+            g_c, scale, mode)
         dq = dq + dq_r.astype(_f32)
-        dk_cur = dk_cur + dk_r.astype(_f32)
-        dv_cur = dv_cur + dv_r.astype(_f32)
+        dk_cur = dk_cur + _reduce_kv_grad(dk_r, groups, batch).astype(_f32)
+        dv_cur = dv_cur + _reduce_kv_grad(dv_r, groups, batch).astype(_f32)
         if rotate_kv:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
@@ -216,19 +247,27 @@ _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     """Ring self/cross attention over a sequence-sharded mesh axis.
 
-    q (B, H, Sq_local, D); k/v (B, H, Sk_local, D), all sharded on the same
-    ``axis_name`` in rank-contiguous order (device i holds global rows
-    [i*S_local, (i+1)*S_local)).  Call inside shard_map/pjit.  Returns the
-    local output shard (B, H, Sq_local, D) in q's dtype.
+    q (B, H, Sq_local, D); k/v (B, KVH, Sk_local, D) with KVH dividing H
+    (GQA: the ring carries KVH-wide chunks — H/KVH x fewer ICI bytes per
+    hop — and expands each chunk at the point of use; KVH == H is plain
+    MHA).  All sharded on the same ``axis_name`` in rank-contiguous order
+    (device i holds global rows [i*S_local, (i+1)*S_local)).  Call inside
+    shard_map/pjit.  Returns the local output shard (B, H, Sq_local, D)
+    in q's dtype.
     """
     b, h, s, d = q.shape
+    h_kv = k.shape[1]
+    if h % h_kv:
+        raise ValueError(
+            f"ring_attention: q heads ({h}) not divisible by kv heads "
+            f"({h_kv})")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     mode = pallas_mode()
     q3 = q.reshape(b * h, s, d)
-    k3 = k.reshape(b * h, k.shape[2], d)
-    v3 = v.reshape(b * h, v.shape[2], d)
-    out = _ring(q3, k3, v3, axis_name, causal, scale, mode)
+    k3 = k.reshape(b * h_kv, k.shape[2], d)
+    v3 = v.reshape(b * h_kv, v.shape[2], d)
+    out = _ring(q3, k3, v3, axis_name, causal, scale, mode, h // h_kv, b)
     return out.reshape(b, h, s, d).astype(q.dtype)
 
 

@@ -1124,10 +1124,7 @@ def main():
         return 1
 
     if args.profile:
-        if args.seq2seq or args.gpt_decode or args.vit or args.llama:
-            fail("profile_unsupported_config: --profile supports the "
-                 "resnet (default), --gpt and --bert configs")
-            return 1
+        # unsupported combos already rejected before backend init
         kind = "bert" if args.bert else ("gpt" if args.gpt else "resnet")
         batch = args.batch or (64 if kind in ("bert", "gpt") else 128)
         try:

@@ -227,3 +227,43 @@ def test_ring_fori_loop_path(rng, causal, monkeypatch):
     for a, b in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-4, atol=3e-5)
+
+
+def test_ring_gqa_matches_expanded(rng):
+    """KVH-wide ring (GQA: chunks rotate un-expanded, H/KVH x fewer ICI
+    bytes) equals the ring over pre-repeated K/V — values and gradients."""
+    import functools
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    b, h, kvh, s, d = 2, 8, 2, 32, 16
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, kvh, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, kvh, s, d)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+
+    def run(q, k, v, w, expand_first):
+        def f(q_l, k_l, v_l, w_l):
+            kk, vv = k_l, v_l
+            if expand_first:
+                kk = jnp.repeat(k_l, h // kvh, axis=1)
+                vv = jnp.repeat(v_l, h // kvh, axis=1)
+            out = ring_attention(q_l, kk, vv, "sp", causal=True)
+            return jax.lax.psum(jnp.sum(out * w_l), "sp")
+        shard = jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, None, "sp"), P(None, None, "sp"),
+                      P(None, None, "sp"), P(None, None, "sp")),
+            out_specs=P(), check_vma=False)
+        loss, grads = jax.value_and_grad(
+            lambda q, k, v: shard(q, k, v, w), argnums=(0, 1, 2))(q, k, v)
+        return loss, grads
+
+    l_g, g_g = jax.jit(functools.partial(run, expand_first=False))(
+        q, k, v, w)
+    l_e, g_e = jax.jit(functools.partial(run, expand_first=True))(
+        q, k, v, w)
+    np.testing.assert_allclose(float(l_g), float(l_e), rtol=1e-5)
+    for a, bb in zip(g_g, g_e):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=2e-5, atol=2e-5)
