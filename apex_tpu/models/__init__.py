@@ -3,9 +3,10 @@ from .resnet import (ResNet, BasicBlock, Bottleneck, resnet18, resnet34,
 from .bert import (BertForMaskedLM, BertLayer, BertModel, bert_base,
                    bert_large)  # noqa: F401
 from .gpt import (  # noqa: F401
-    GptBlock, GptModel, generate, gpt2_small, gpt2_medium)
+    GptBlock, GptModel, generate, gpt2_small, gpt2_medium,
+    gpt2_large, gpt2_xl)
 from .llama import (  # noqa: F401
-    LlamaBlock, LlamaModel, llama_tiny)
+    LlamaBlock, LlamaModel, llama_1b, llama_7b, llama_tiny)
 from .vit import VitBlock, VitModel, vit_base, vit_small  # noqa: F401
 from .hf import (gpt2_from_hf, gpt2_to_hf_state_dict,  # noqa: F401
                  llama_from_hf, llama_to_hf_state_dict)

@@ -604,3 +604,13 @@ def gpt2_small(**kw):
 def gpt2_medium(**kw):
     """GPT-2 medium geometry: 24 layers, hidden 1024, 16 heads (350M)."""
     return GptModel(**{**dict(hidden=1024, layers=24, heads=16), **kw})
+
+
+def gpt2_large(**kw):
+    """GPT-2 large geometry: 36 layers, hidden 1280, 20 heads (774M)."""
+    return GptModel(**{**dict(hidden=1280, layers=36, heads=20), **kw})
+
+
+def gpt2_xl(**kw):
+    """GPT-2 XL geometry: 48 layers, hidden 1600, 25 heads (1.5B)."""
+    return GptModel(**{**dict(hidden=1600, layers=48, heads=25), **kw})

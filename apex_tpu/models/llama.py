@@ -543,3 +543,18 @@ def llama_tiny(**kw):
     return LlamaModel(**{**dict(vocab_size=1000, hidden=128, layers=2,
                                 heads=4, kv_heads=2, max_positions=128),
                          **kw})
+
+
+def llama_1b(**kw):
+    """~1.2B geometry (Llama-3.2-1B-like: 16 layers, hidden 2048,
+    32q/8kv heads, FFN 8192, 128k vocab scaled to the config given)."""
+    return LlamaModel(**{**dict(hidden=2048, layers=16, heads=32,
+                                kv_heads=8, intermediate=8192,
+                                rope_theta=500000.0), **kw})
+
+
+def llama_7b(**kw):
+    """Llama-2-7B geometry: 32 layers, hidden 4096, 32 MHA heads,
+    FFN 11008."""
+    return LlamaModel(**{**dict(hidden=4096, layers=32, heads=32,
+                                intermediate=11008), **kw})

@@ -459,3 +459,20 @@ def test_llama_sp_rejects_oversized_global_sequence(rng):
             f, mesh=mesh, in_specs=(P(), P(None, "sp")),
             out_specs=P(None, "sp", None), check_vma=False))(
             [p.data for p in params], ids)
+
+
+def test_size_presets_plumb_geometry():
+    """Preset helpers merge caller overrides over the published
+    geometry (shrunk here — full builds are multi-GB)."""
+    from apex_tpu.models import llama_1b, llama_7b
+    from apex_tpu.models.gpt import gpt2_large, gpt2_xl
+
+    m = llama_1b(layers=1, vocab_size=64)
+    assert m.hidden == 2048 and m.blocks[0].kv_heads == 8
+    assert m.rope_theta == 500000.0
+    m = llama_7b(layers=1, vocab_size=64)
+    assert m.hidden == 4096 and m.blocks[0].heads == 32
+    g = gpt2_large(layers=1, vocab_size=64, max_positions=16)
+    assert g.hidden == 1280 and g.blocks[0].attn.num_heads == 20
+    g = gpt2_xl(layers=1, vocab_size=64, max_positions=16)
+    assert g.hidden == 1600 and g.blocks[0].attn.num_heads == 25
