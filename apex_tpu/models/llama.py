@@ -547,14 +547,18 @@ def llama_tiny(**kw):
 
 def llama_1b(**kw):
     """~1.2B geometry (Llama-3.2-1B-like: 16 layers, hidden 2048,
-    32q/8kv heads, FFN 8192, 128k vocab scaled to the config given)."""
+    32q/8kv heads, FFN 8192; vocab comes from the caller/checkpoint).
+    ``max_positions`` defaults to 8192 — raise it (cache/HBM cost only,
+    RoPE has no table) for the checkpoint's full 128k window."""
     return LlamaModel(**{**dict(hidden=2048, layers=16, heads=32,
                                 kv_heads=8, intermediate=8192,
-                                rope_theta=500000.0), **kw})
+                                rope_theta=500000.0,
+                                max_positions=8192), **kw})
 
 
 def llama_7b(**kw):
     """Llama-2-7B geometry: 32 layers, hidden 4096, 32 MHA heads,
-    FFN 11008."""
+    FFN 11008, the checkpoint's 4096 context window."""
     return LlamaModel(**{**dict(hidden=4096, layers=32, heads=32,
-                                intermediate=11008), **kw})
+                                intermediate=11008,
+                                max_positions=4096), **kw})

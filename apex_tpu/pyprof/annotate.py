@@ -273,6 +273,8 @@ def init():
         # the function — resolve the module itself
         _fln = importlib.import_module(
             _norm_pkg.__name__ + ".fused_layer_norm")
+        _frn = importlib.import_module(
+            _norm_pkg.__name__ + ".rms_norm")
         # NOTE: the named_scope label carries into the *forward* HLO only;
         # a custom_vjp's backward is traced outside the scope, so measured-
         # mode bwd durations for these ops stay unattributed (their bwd
@@ -281,6 +283,8 @@ def init():
                 ((_attn, _attn_pkg), "flash_attention"),
                 ((_fln, _norm_pkg), "fused_layer_norm_affine"),
                 ((_fln, _norm_pkg), "fused_layer_norm"),
+                ((_frn, _norm_pkg), "fused_rms_norm_affine"),
+                ((_frn, _norm_pkg), "fused_rms_norm"),
                 ((_sx, _sx_pkg), "softmax_cross_entropy_loss")):
             fn = getattr(mods[0], name)
             if not hasattr(fn, "__wrapped_pyprof__"):

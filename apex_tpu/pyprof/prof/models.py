@@ -123,10 +123,12 @@ def _conv_family(row):
 
 
 _POINTWISE_COST = {"relu": 1, "leaky_relu": 2, "tanh": 4, "sigmoid": 4,
-                   "gelu": 8, "dropout": 2, "pad": 1, "flatten": 0}
+                   "gelu": 8, "dropout": 2, "pad": 1, "flatten": 0,
+                   "silu": 5}
 _NORM_COST = {"batch_norm": 8, "layer_norm": 8, "group_norm": 8,
               "instance_norm": 8, "fused_layer_norm": 8,
-              "fused_layer_norm_affine": 8}
+              "fused_layer_norm_affine": 8,
+              "fused_rms_norm": 6, "fused_rms_norm_affine": 6}
 _SOFTMAX_COST = {"softmax": 5, "log_softmax": 6}
 _LOSS_COST = {"cross_entropy": 7, "nll_loss": 2, "mse_loss": 3,
               "l1_loss": 3, "binary_cross_entropy": 6,

@@ -419,3 +419,23 @@ def test_flash_attention_package_reexport_annotated(rng):
     with pyprof.capture() as ev:
         pkg.flash_attention(q, q, q, causal=True)
     assert [e["op"] for e in ev] == ["flash_attention"]
+
+
+def test_rms_norm_annotated_and_modeled(rng):
+    """The Llama-family norm rows get the norm cost model (not the
+    generic 1-flop fallback) and FusedRMSNorm calls produce rows."""
+    from apex_tpu.normalization import FusedRMSNorm
+
+    nn.manual_seed(0)
+    rn = FusedRMSNorm(16)
+    x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    with pyprof.capture() as ev:
+        rn(x)
+    ops = [e["op"] for e in ev]
+    assert "fused_rms_norm_affine" in ops
+
+    row = {"op": "fused_rms_norm_affine", "dir": "fwd",
+           "shapes": [[8, 16], [16]], "dtypes": ["float32"],
+           "params": {"normalized_shape": [16]}}
+    f, b, _ = model_row(row)
+    assert f == 6 * 8 * 16 and b == 3 * 8 * 16 * 4
