@@ -37,26 +37,52 @@ import jax.numpy as jnp
 
 
 def speculative_generate(target, draft, prompt_ids, max_new_tokens,
-                         k=4, cache_dtype=None):
-    """Greedy decode of ``target`` accelerated by ``draft`` proposals.
+                         k=4, cache_dtype=None, temperature=0.0,
+                         key=None):
+    """Decode of ``target`` accelerated by ``draft`` proposals.
 
-    ``prompt_ids (B, P)`` -> ``(B, P + max_new_tokens)``, bit-identical
-    to ``generate(target, prompt_ids, max_new_tokens)`` (greedy).
+    ``prompt_ids (B, P)`` -> ``(B, P + max_new_tokens)``.
+
+    ``temperature == 0`` (default): greedy — bit-identical to
+    ``generate(target, prompt_ids, max_new_tokens)`` for ANY draft.
     ``k``: draft tokens proposed per verification chunk; each round
     accepts between 1 and ``k + 1`` tokens (the verified draft prefix
     plus the target's own next token), so rounds <= max_new_tokens.
-
     The batch runs in LOCKSTEP: every round advances all rows by the
     batch-minimum accepted count (the cache protocol takes one position
     for the whole batch).  This is exactly correct — a position re-fed
     next round reproduces the identical greedy token, since emitted
     tokens are always the target's own argmax — it only costs some
     acceptance on rows that agreed further.  Batch 1 pays no such tax.
+
+    ``temperature > 0``: SAMPLED speculative decoding (Leviathan et al.
+    rejection scheme; needs ``key``, batch 1 only).  The draft SAMPLES
+    each proposal from its own softmax; the target accepts token ``d``
+    with probability ``min(1, p_t(d) / p_d(d))`` and, on the first
+    rejection, resamples from the normalized residual
+    ``max(p_t - p_d, 0)`` — the emitted DISTRIBUTION is exactly the
+    target's own sampling at this temperature, for any draft (the
+    classic guarantee; tests check the marginal distribution against
+    the exactly-enumerated 2-step marginal of a tiny model).  Re-fed positions under
+    lockstep would be RE-sampled, which breaks the guarantee for
+    batch > 1 — hence the batch-1 restriction.
     """
     from ..nn.modules import Ctx
 
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    sampled = temperature > 0.0
+    if sampled and key is None:
+        raise ValueError("sampled speculative decoding (temperature > 0) "
+                         "needs a PRNG key")
+    if sampled and prompt_ids.shape[0] != 1:
+        raise ValueError(
+            "sampled speculative decoding supports batch 1 (lockstep "
+            "re-feeding would resample committed tokens; see docstring)")
+    if key is None:
+        key = jax.random.PRNGKey(0)
     for name, m in (("target", target), ("draft", draft)):
         missing = [a for a in ("init_caches", "decode_step",
                                "decode_chunk", "prefill")
@@ -86,7 +112,7 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
     t_vals = [q.data for q in t_params]
     d_vals = [q.data for q in d_params]
 
-    def run(t_vals, d_vals, prompt_ids):
+    def run(t_vals, d_vals, prompt_ids, key):
         t_ctx = Ctx(env={id(o): v for o, v in zip(t_params, t_vals)},
                     stats_out={}, training=False)
         d_ctx = Ctx(env={id(o): v for o, v in zip(d_params, d_vals)},
@@ -117,7 +143,13 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
                 t_ctx, ids[:, :1], t_caches, jnp.int32(0))
             _, d_caches = draft.decode_chunk(
                 d_ctx, ids[:, :1], d_caches, jnp.int32(0))
-        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(ids.dtype)
+        if sampled:
+            key, sub = jax.random.split(key)
+            first = jax.random.categorical(
+                sub, t_logits[:, -1].astype(jnp.float32) / temperature,
+                axis=-1).astype(ids.dtype)
+        else:
+            first = jnp.argmax(t_logits[:, -1], axis=-1).astype(ids.dtype)
         ids = jax.lax.dynamic_update_slice(ids, first[:, None], (0, p))
 
         # m: position of the last known-but-unfed token (scalar — the
@@ -125,51 +157,102 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
         m0 = jnp.int32(p)
 
         def cond(carry):
-            ids, m, t_caches, d_caches = carry
+            ids, m, t_caches, d_caches, key = carry
             return m < s_total - 1
 
         def body(carry):
-            ids, m, t_caches, d_caches = carry
+            ids, m, t_caches, d_caches, key = carry
+            # per-round randomness derived from the position so the
+            # program is replay-stable
+            round_key = jax.random.fold_in(key, m)
 
             # --- draft proposes k tokens (k+1 single steps feeding its
             #     own argmax chain from ids[:, m], so its cache also
             #     covers position m+k for the all-accepted case) ---
-            def d_step(carry, _):
+            def d_step(carry, skey):
                 tok, d_caches, t = carry
                 logits, d_caches = draft.decode_step(d_ctx, tok, d_caches,
                                                      t)
-                nxt = jnp.argmax(logits, axis=-1).astype(ids.dtype)
-                return (nxt, d_caches, t + 1), nxt
+                if sampled:
+                    probs = jax.nn.softmax(
+                        logits.astype(jnp.float32) / temperature, axis=-1)
+                    nxt = jax.random.categorical(
+                        skey, logits.astype(jnp.float32) / temperature,
+                        axis=-1).astype(ids.dtype)
+                else:
+                    probs = jnp.zeros_like(logits, jnp.float32)
+                    nxt = jnp.argmax(logits, axis=-1).astype(ids.dtype)
+                return (nxt, d_caches, t + 1), (nxt, probs)
 
             tok0 = jax.lax.dynamic_slice(ids, (0, m), (b, 1))[:, 0]
-            (_, d_caches, _), props = jax.lax.scan(
-                d_step, (tok0, d_caches, m), None, length=k + 1)
+            d_keys = jax.random.split(
+                jax.random.fold_in(round_key, 0), k + 1)
+            (_, d_caches, _), (props, d_probs) = jax.lax.scan(
+                d_step, (tok0, d_caches, m), d_keys)
             drafts = jnp.swapaxes(props, 0, 1)[:, :k]   # (B, k) d_1..d_k
 
             # --- target verifies [ids[m], d_1..d_k] in one chunk ---
             chunk = jnp.concatenate([tok0[:, None], drafts], axis=1)
             t_logits, t_caches = target.decode_chunk(
                 t_ctx, chunk, t_caches, m)
-            greedy = jnp.argmax(t_logits, axis=-1).astype(ids.dtype)
-            # longest prefix where draft == target argmax, per row; the
-            # lockstep advance is the batch minimum
-            agree = drafts == greedy[:, :k]
-            acc = jnp.argmin(
-                jnp.concatenate([agree, jnp.zeros((b, 1), bool)], axis=1)
-                .astype(jnp.int32), axis=1)             # (B,) in [0, k]
-            n_round = jnp.min(acc) + 1                  # in [1, k+1]
-            # emit greedy[:, :n_round] (accepted drafts EQUAL the greedy
-            # tokens on the agreed prefix, so the target argmax chain is
-            # the emission for every row)
+            if sampled:
+                # Leviathan rejection: accept d_i with min(1, p_t/p_d);
+                # on the first rejection resample from the normalized
+                # residual; all-accepted earns a bonus sample from the
+                # target's next-position distribution.  (batch == 1)
+                p_t = jax.nn.softmax(
+                    t_logits[0].astype(jnp.float32) / temperature,
+                    axis=-1)                            # (k+1, V)
+                p_d = d_probs[:, 0, :]                  # (k+1, V) rows 0..k
+                d_row = drafts[0]                       # (k,)
+                pos_i = jnp.arange(k)
+                ratio = p_t[pos_i, d_row] / jnp.maximum(
+                    p_d[pos_i, d_row], 1e-20)
+                u = jax.random.uniform(
+                    jax.random.fold_in(round_key, 1), (k,))
+                accept = u < jnp.minimum(ratio, 1.0)
+                acc0 = jnp.argmin(jnp.concatenate(
+                    [accept, jnp.zeros((1,), bool)]).astype(jnp.int32))
+                # per-position replacement samples: residual at 0..k-1,
+                # the bonus target distribution at position k.  Where
+                # the residual is identically zero (p_t == p_d) the
+                # acceptance probability was 1, so the sample is never
+                # selected — the uniform fallback inside log(0+tiny)
+                # never escapes the where.
+                res = jnp.maximum(p_t[:k] - p_d[:k], 0.0)
+                res_dist = jnp.concatenate([res, p_t[k:]], axis=0)
+                r_keys = jax.random.split(
+                    jax.random.fold_in(round_key, 2), k + 1)
+                res_samples = jax.vmap(
+                    lambda kk, d: jax.random.categorical(
+                        kk, jnp.log(d + 1e-30)))(r_keys, res_dist)
+                emit = jnp.where(jnp.arange(k + 1) == acc0,
+                                 res_samples.astype(ids.dtype),
+                                 jnp.concatenate(
+                                     [d_row, d_row[-1:]]).astype(
+                                     ids.dtype))
+                merged = emit[None, :]
+                n_round = acc0 + 1
+            else:
+                greedy = jnp.argmax(t_logits, axis=-1).astype(ids.dtype)
+                # longest prefix where draft == target argmax, per row;
+                # the lockstep advance is the batch minimum
+                agree = drafts == greedy[:, :k]
+                acc = jnp.argmin(
+                    jnp.concatenate([agree, jnp.zeros((b, 1), bool)],
+                                    axis=1).astype(jnp.int32), axis=1)
+                n_round = jnp.min(acc) + 1              # in [1, k+1]
+                merged = greedy
+            # emit merged[:, :n_round] — beyond it, keep what is there
             cur = jax.lax.dynamic_slice(ids, (0, m + 1), (b, k + 1))
             merged = jnp.where(
-                jnp.arange(k + 1)[None, :] < n_round, greedy, cur)
+                jnp.arange(k + 1)[None, :] < n_round, merged, cur)
             ids = jax.lax.dynamic_update_slice(ids, merged, (0, m + 1))
             return ids, jnp.minimum(m + n_round, s_total - 1), \
-                t_caches, d_caches
+                t_caches, d_caches, key
 
-        ids, _, _, _ = jax.lax.while_loop(cond, body, (ids, m0, t_caches,
-                                                       d_caches))
+        ids, _, _, _, _ = jax.lax.while_loop(
+            cond, body, (ids, m0, t_caches, d_caches, key))
         return ids[:, :s_total]
 
     # bounded compile cache: each entry's closure pins its draft module
@@ -184,7 +267,7 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
     cache = getattr(target, "_spec_jit_cache", None)
     if cache is None:
         cache = target._spec_jit_cache = {}
-    cfg = (id(draft), b, p, max_new_tokens, k,
+    cfg = (id(draft), b, p, max_new_tokens, k, float(temperature),
            None if cache_dtype is None else jnp.dtype(cache_dtype).name,
            tuple(id(o) for o in t_params), tuple(id(o) for o in d_params))
     entry = cache.pop(cfg, None)    # pop + reinsert = LRU refresh
@@ -193,4 +276,4 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
             cache.pop(next(iter(cache)))
         entry = ((t_params, d_params), jax.jit(run))
     cache[cfg] = entry
-    return entry[1](t_vals, d_vals, prompt_ids)
+    return entry[1](t_vals, d_vals, prompt_ids, key)
