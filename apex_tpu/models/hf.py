@@ -220,6 +220,7 @@ def llama_from_hf(src, **model_kw):
                            2048),
         rope_theta=dflt("rope_theta", "rope_theta", 10000.0),
         eps=dflt("eps", "rms_norm_eps", 1e-6), head_dim=head_dim,
+        sliding_window=dflt("sliding_window", "sliding_window", None),
         **model_kw)
 
     _put(model.tok_emb.weight, emb)

@@ -62,8 +62,15 @@ class LlamaBlock(nn.Module):
 
     def __init__(self, hidden, heads, kv_heads, intermediate,
                  rope_theta=10000.0, eps=1e-6, head_dim=None,
-                 tp_axis=None, sp_axis=None, _dense_ffn=True):
+                 tp_axis=None, sp_axis=None, sliding_window=None,
+                 _dense_ffn=True):
         super().__init__()
+        # sliding_window: Mistral-style banded causal attention —
+        # position t sees keys in (t - window, t].  The cached decode
+        # paths band-mask exactly; the full-sequence forward/prefill
+        # are exact while S <= window (causal == banded there) and the
+        # MODEL refuses longer (docs/models.md)
+        self.sliding_window = sliding_window
         # sp_axis: ring sequence parallelism — the sequence dim is
         # sharded over this mesh axis and attention runs the ring
         # (parallel/ring_attention.py); the MODEL supplies global-offset
@@ -273,6 +280,10 @@ class LlamaBlock(nn.Module):
         scores = jnp.einsum("bkgqd,bksd->bkgqs", qg.astype(jnp.float32),
                             kcache.astype(jnp.float32)) * (d ** -0.5)
         valid = jnp.arange(s_max)[None, :] <= pos[:, None]   # (S_c, S_max)
+        if self.sliding_window is not None:
+            # banded: key j visible from position t iff t-w < j <= t
+            valid = valid & (jnp.arange(s_max)[None, :]
+                             > pos[:, None] - self.sliding_window)
         scores = jnp.where(valid[None, None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bkgqs,bksd->bkgqd", probs,
@@ -311,12 +322,14 @@ class MoeLlamaBlock(LlamaBlock):
     def __init__(self, hidden, heads, kv_heads, intermediate,
                  num_experts, rope_theta=10000.0, eps=1e-6,
                  head_dim=None, moe_axis="data", capacity_factor=1.25,
-                 top_k=1, aux_weight=0.01, sp_axis=None):
+                 top_k=1, aux_weight=0.01, sp_axis=None,
+                 sliding_window=None):
         from ..nn.parameter import Parameter
 
         super().__init__(hidden, heads, kv_heads, intermediate,
                          rope_theta=rope_theta, eps=eps,
                          head_dim=head_dim, sp_axis=sp_axis,
+                         sliding_window=sliding_window,
                          _dense_ffn=False)
         self.moe_axis = moe_axis
         self.num_experts = num_experts
@@ -376,7 +389,7 @@ class LlamaModel(nn.Module):
                  head_dim=None, tp_axis=None, sp_axis=None, moe_axis=None,
                  moe_num_experts=None, moe_every=2,
                  moe_capacity_factor=1.25, moe_top_k=1,
-                 moe_aux_weight=0.01):
+                 moe_aux_weight=0.01, sliding_window=None):
         super().__init__()
         self.hidden = hidden
         self.max_positions = max_positions
@@ -390,6 +403,18 @@ class LlamaModel(nn.Module):
         # Composes with tp_axis (heads shard, the ring passes local-head
         # KV shards) and a data axis, exactly as the GPT family.
         self.sp_axis = sp_axis
+        # sliding_window: Mistral-style banded causal attention (see
+        # LlamaBlock); the cached decode paths are banded exactly, the
+        # full-sequence forward refuses S > window
+        self.sliding_window = sliding_window
+        if sliding_window is not None:
+            if sliding_window < 1:
+                raise ValueError(
+                    f"sliding_window must be >= 1, got {sliding_window}")
+            if sp_axis is not None:
+                raise ValueError(
+                    "sliding_window with sp_axis is not supported (the "
+                    "ring's chunk bias is causal, not banded)")
         # moe_axis: Mixtral-shape MoE — every ``moe_every``-th block
         # routes its SwiGLU over experts along the axis (the GptModel
         # convention; one expert per device, moe_num_experts = axis size)
@@ -424,11 +449,12 @@ class LlamaModel(nn.Module):
                     head_dim=head_dim, moe_axis=moe_axis,
                     capacity_factor=moe_capacity_factor,
                     top_k=moe_top_k, aux_weight=moe_aux_weight,
-                    sp_axis=sp_axis)
+                    sp_axis=sp_axis, sliding_window=sliding_window)
             return LlamaBlock(hidden, heads, kv_heads, intermediate,
                               rope_theta=rope_theta, eps=eps,
                               head_dim=head_dim, tp_axis=tp_axis,
-                              sp_axis=sp_axis)
+                              sp_axis=sp_axis,
+                              sliding_window=sliding_window)
 
         self.blocks = nn.ModuleList([build_block(i)
                                      for i in range(layers)])
@@ -459,6 +485,14 @@ class LlamaModel(nn.Module):
                     f"sequence length {s} exceeds max_positions "
                     f"{self.max_positions}")
             pos = jnp.arange(s, dtype=jnp.int32)
+        if self.sliding_window is not None and s > self.sliding_window:
+            raise ValueError(
+                f"sequence length {s} exceeds sliding_window "
+                f"{self.sliding_window}: the full-sequence forward runs "
+                f"causal attention, which equals banded attention only "
+                f"within one window — use the cached decode paths "
+                f"(decode_chunk applies the band exactly) or shorter "
+                f"sequences")
         cos, sin = rope_tables(pos, head_dim, self.rope_theta)
         x = self.tok_emb.forward(ctx, input_ids)
         for blk in self.blocks:
@@ -510,8 +544,14 @@ class LlamaModel(nn.Module):
         flash-attention pass, filling the KV caches: returns
         ``(logits (B, S_p, V), new_caches)``.  O(1) calls instead of
         ``S_p`` decode steps, with no (S_p, S_max) score tensor (the
-        caches are empty, so the chunk attends only itself)."""
+        caches are empty, so the chunk attends only itself).  Under
+        ``sliding_window`` a prompt longer than one window routes
+        through :meth:`decode_chunk`, whose mask is banded exactly (at
+        its (S_p, S_max) score cost)."""
         self._decode_guard("prefill")
+        if self.sliding_window is not None \
+                and toks.shape[1] > self.sliding_window:
+            return self.decode_chunk(ctx, toks, caches, jnp.int32(0))
         return self._run_blocks(
             ctx, toks, caches,
             lambda blk, x, kc, vc: blk.prefill(ctx, x, kc, vc))

@@ -476,3 +476,65 @@ def test_size_presets_plumb_geometry():
     assert g.hidden == 1280 and g.blocks[0].attn.num_heads == 20
     g = gpt2_xl(layers=1, vocab_size=64, max_positions=16)
     assert g.hidden == 1600 and g.blocks[0].attn.num_heads == 25
+
+
+def test_sliding_window_decode_matches_mistral(rng):
+    """Mistral parity beyond one window: a converted checkpoint with
+    sliding_window=8 scored over 13 positions via decode_chunk (the
+    banded cached path) reproduces transformers' banded forward."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from apex_tpu.models import llama_from_hf
+    from apex_tpu.nn.modules import Ctx
+
+    cfg = transformers.MistralConfig(
+        vocab_size=151, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=96, max_position_embeddings=64,
+        sliding_window=8, rope_theta=10000.0, attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = transformers.MistralForCausalLM(cfg).eval()
+    ids = rng.integers(0, 151, (2, 13))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+
+    model = llama_from_hf(hf)
+    assert model.sliding_window == 8
+    # 13 > window: the full-sequence forward refuses (it would run
+    # causal, not banded, attention)...
+    with pytest.raises(ValueError, match="sliding_window"):
+        model(jnp.asarray(ids))
+    # ...and the banded cached path scores it exactly
+    ctx = Ctx(training=False)
+    got, _ = model.decode_chunk(ctx, jnp.asarray(ids),
+                                model.init_caches(2, 16), jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=3e-4,
+                               atol=3e-4)
+    # prefill routes long prompts through the banded path too
+    got2, _ = model.prefill(ctx, jnp.asarray(ids),
+                            model.init_caches(2, 16))
+    np.testing.assert_allclose(np.asarray(got2), want, rtol=3e-4,
+                               atol=3e-4)
+
+
+def test_sliding_window_generate(rng):
+    """generate() over a windowed model: decode continues past the
+    window (old keys fall out of view) and stays finite."""
+    from apex_tpu.models import generate
+
+    nn.manual_seed(0)
+    model = llama_tiny(sliding_window=6, max_positions=64).eval()
+    prompt = jnp.asarray(rng.integers(0, 1000, (1, 4)))
+    out = generate(model, prompt, max_new_tokens=20)
+    assert out.shape == (1, 24)
+    # oracle: eager banded decode via decode_chunk over the full prefix
+    from apex_tpu.nn.modules import Ctx
+    ctx = Ctx(training=False)
+    cur = prompt
+    for _ in range(20):
+        logits, _ = model.decode_chunk(
+            ctx, cur, model.init_caches(1, cur.shape[1] + 1),
+            jnp.int32(0))
+        cur = jnp.concatenate(
+            [cur, jnp.argmax(logits[:, -1], axis=-1)[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
