@@ -168,8 +168,35 @@ def llama_from_hf(src, **model_kw):
     dict) loads the embedding into the (untied here) head, which is
     exactly the tied forward.
     """
-    from .llama import LlamaModel
+    norm, emb, geom, dflt = _llama_prelude(src, model_kw)
+    inter = norm["layers.0.mlp.gate_proj.weight"].shape[0]
 
+    from .llama import LlamaModel
+    model = LlamaModel(
+        intermediate=inter,
+        max_positions=dflt("max_positions", "max_position_embeddings",
+                           2048),
+        rope_theta=dflt("rope_theta", "rope_theta", 10000.0),
+        eps=dflt("eps", "rms_norm_eps", 1e-6),
+        sliding_window=dflt("sliding_window", "sliding_window", None),
+        **geom, **model_kw)
+
+    _load_llama_trunk(model, norm, emb)
+    for i, blk in enumerate(model.blocks):
+        p = f"layers.{i}."
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            _put(getattr(blk, name).weight,
+                norm[p + "mlp." + name + ".weight"])
+    model.eval()
+    return model
+
+
+def _llama_prelude(src, model_kw):
+    """Shared loader front half for Llama-family checkpoints: normalize
+    keys (strip ``model.``, drop rotary buffers), recover geometry with
+    the heads/kv_heads divisibility diagnostics, and build the
+    config-with-override resolver.  Returns ``(norm, emb, geometry
+    kwargs, dflt)``; mutates ``model_kw`` (pops the override keys)."""
     sd = src.state_dict() if hasattr(src, "state_dict") else dict(src)
     norm = {}
     for k, v in sd.items():
@@ -183,7 +210,6 @@ def llama_from_hf(src, **model_kw):
     vocab, hidden = emb.shape
     layers = 1 + max(int(k.split(".")[1]) for k in norm
                      if k.startswith("layers."))
-    inter = norm["layers.0.mlp.gate_proj.weight"].shape[0]
 
     cfg = getattr(src, "config", None)
     heads = model_kw.pop("heads", None) \
@@ -213,16 +239,15 @@ def llama_from_hf(src, **model_kw):
             v = getattr(cfg, attr, None)
         return fallback if v is None else v
 
-    model = LlamaModel(
-        vocab_size=vocab, hidden=hidden, layers=layers, heads=heads,
-        kv_heads=kv_heads, intermediate=inter,
-        max_positions=dflt("max_positions", "max_position_embeddings",
-                           2048),
-        rope_theta=dflt("rope_theta", "rope_theta", 10000.0),
-        eps=dflt("eps", "rms_norm_eps", 1e-6), head_dim=head_dim,
-        sliding_window=dflt("sliding_window", "sliding_window", None),
-        **model_kw)
+    geom = dict(vocab_size=vocab, hidden=hidden, layers=layers,
+                heads=heads, kv_heads=kv_heads, head_dim=head_dim)
+    return norm, emb, geom, dflt
 
+
+def _load_llama_trunk(model, norm, emb):
+    """Embedding, final norm, (possibly tied) head, and every block's
+    norms + attention projections — the layout both Llama-family
+    loaders share."""
     _put(model.tok_emb.weight, emb)
     _put(model.norm.weight, norm["norm.weight"])
     _put(model.lm_head.weight, norm.get("lm_head.weight", emb))
@@ -233,11 +258,6 @@ def llama_from_hf(src, **model_kw):
         for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
             _put(getattr(blk, name).weight,
                 norm[p + "self_attn." + name + ".weight"])
-        for name in ("gate_proj", "up_proj", "down_proj"):
-            _put(getattr(blk, name).weight,
-                norm[p + "mlp." + name + ".weight"])
-    model.eval()
-    return model
 
 
 # ---------------------------------------------------------------------------
@@ -336,3 +356,63 @@ def llama_to_hf_state_dict(model):
             sd[p + "mlp." + name + ".weight"] = \
                 np32(getattr(blk, name).weight)
     return sd
+
+
+def mixtral_from_hf(src, moe_axis="data", capacity_factor=8.0,
+                    aux_weight=0.0, **model_kw):
+    """Build a Mixtral-shape :class:`LlamaModel` (every block MoE)
+    carrying the weights of an HF ``MixtralForCausalLM``.
+
+    Gating semantics match exactly: softmax over all experts, top-2,
+    normalized over the selected pair (transformers
+    modeling_mixtral.py:111-113 == ``switch_moe(top_k=2)``).  The ONE
+    semantic divergence is capacity: Mixtral dispatches densely (every
+    routed token computes), while this framework's Switch/GShard
+    machinery drops tokens beyond ``ceil(T_local/E * capacity_factor)``
+    per expert.  The default factor 8.0 makes drops rare; raise it
+    (2*E guarantees none, at dispatch-buffer memory cost) for exact
+    parity, lower it to trade fidelity for memory.
+
+    ``aux_weight`` defaults to 0 (inference/fine-tune from a trained
+    checkpoint needs no balance pressure; set >0 to re-enable the
+    Switch aux loss for continued pretraining).  ``moe_top_k`` can be
+    overridden by keyword (bare state dicts carry no config; the
+    default is Mixtral's 2).  The model's forward
+    must run inside ``shard_map`` over ``moe_axis`` with one expert per
+    device (``moe_num_experts`` = the axis size = the checkpoint's
+    expert count).
+    """
+    from .llama import LlamaModel
+
+    norm, emb, geom, dflt = _llama_prelude(src, model_kw)
+    n_exp = 1 + max(
+        int(k.split(".")[4]) for k in norm
+        if ".block_sparse_moe.experts." in k)
+    inter = norm["layers.0.block_sparse_moe.experts.0.w1.weight"].shape[0]
+    top_k = dflt("moe_top_k", "num_experts_per_tok", 2)
+
+    model = LlamaModel(
+        intermediate=inter,
+        max_positions=dflt("max_positions", "max_position_embeddings",
+                           2048),
+        rope_theta=dflt("rope_theta", "rope_theta", 10000.0),
+        eps=dflt("eps", "rms_norm_eps", 1e-6),
+        sliding_window=dflt("sliding_window", "sliding_window", None),
+        moe_axis=moe_axis, moe_num_experts=n_exp, moe_every=1,
+        moe_top_k=top_k, moe_capacity_factor=capacity_factor,
+        moe_aux_weight=aux_weight, **geom, **model_kw)
+
+    _load_llama_trunk(model, norm, emb)
+    for i, blk in enumerate(model.blocks):
+        p = f"layers.{i}."
+        _put(blk.router.weight, norm[p + "block_sparse_moe.gate.weight"])
+        ep = p + "block_sparse_moe.experts."
+        # HF per-expert w1=gate, w3=up, w2=down -> stacked wg/wu/wd
+        _put(blk.wg, np.stack(
+            [norm[f"{ep}{e}.w1.weight"] for e in range(n_exp)]))
+        _put(blk.wu, np.stack(
+            [norm[f"{ep}{e}.w3.weight"] for e in range(n_exp)]))
+        _put(blk.wd, np.stack(
+            [norm[f"{ep}{e}.w2.weight"] for e in range(n_exp)]))
+    model.eval()
+    return model
