@@ -27,9 +27,14 @@ run() {
 run                                   # resnet50 headline + kernels
 run --bert
 run --gpt
+run --llama
+run --vit
+run 32 --gpt --seq-len 512
 run 16 --gpt --seq-len 1024
 run 8 --gpt --seq-len 2048 --remat
 run --gpt-decode
+run --gpt-decode --int8
+run --spec-decode
 run --seq2seq
 run --kernels-timing                  # Pallas vs XLA A/B per shape
 run --profile                         # resnet per-op time attribution
