@@ -150,3 +150,22 @@ def test_llama_example_runs():
         capture_output=True, text=True, timeout=300, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "matches greedy exactly" in out.stdout
+
+
+def test_llama_lora_example_runs():
+    """LoRA fine-tune example: factors-only training, merge, and the
+    merged-decode assertion inside the script."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    script = os.path.join(REPO, "examples", "llama", "main_lora.py")
+    code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.argv = ['main_lora.py', '--steps', '6', "
+            f"'--batch', '2', '--seq-len', '32', '--layers', '2', "
+            f"'--hidden', '64', '--rank', '4', '--print-freq', '2']; "
+            f"import runpy; runpy.run_path({script!r}, "
+            f"run_name='__main__')")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "merged: decode identical" in out.stdout
+    assert "trainable:" in out.stdout
