@@ -1,6 +1,6 @@
 """Inference-side utilities: weight-only int8 quantization for the
 bandwidth-bound decode path (quant.py) and draft-verified greedy
 speculative decoding (speculative.py)."""
-from .quant import (QuantTensor, quantize_int8,  # noqa: F401
-                    quantize_tensor_int8)
+from .quant import (QuantTensor, gather_rows,  # noqa: F401
+                    quantize_int8, quantize_tensor_int8)
 from .speculative import speculative_generate  # noqa: F401

@@ -112,3 +112,17 @@ def quantize_int8(model, min_size=4096, dtype=None):
             f"size >= {min_size}) — nothing was quantized")
     model.eval()
     return model
+
+
+def gather_rows(ctx, param, ids):
+    """Embedding-style row gather that stays int8 until after the
+    gather: ``table[ids]`` reads only the selected rows' int8 bytes
+    (plus their scales) instead of dequantizing the whole table first —
+    at GPT-2's vocab the full-table dequant is ~75 MB of bf16 writes
+    per decode step.  Falls back to ``ctx.value(param)[ids]`` for
+    unquantized (or derived) parameters."""
+    v = ctx.raw(param)
+    if isinstance(v, QuantTensor):
+        rows = v.q[ids].astype(v.scale.dtype)
+        return rows * v.scale[ids]
+    return v[ids]

@@ -424,9 +424,14 @@ class GptModel(nn.Module):
     def _run_blocks(self, ctx, toks, caches, pos_of, blk_fn):
         """Embed ``toks`` + positions (``pos_of(pos_table)``), thread the
         caches through ``blk_fn`` per block, final-LN + tied head — the
-        shared body of every cached decode entry point."""
+        shared body of every cached decode entry point.  The token
+        gather is int8-aware (only selected rows dequantize); the tied
+        HEAD matmul still reads the full table, which ctx.value
+        dequantizes fused into the matmul."""
+        from ..inference.quant import gather_rows
         emb = ctx.value(self.tok_emb.weight)
-        x = emb[toks] + pos_of(ctx.value(self.pos_emb.weight))
+        x = gather_rows(ctx, self.tok_emb.weight, toks) \
+            + pos_of(ctx.value(self.pos_emb.weight))
         new_caches = []
         for blk, (kc, vc) in zip(self.blocks, caches):
             x, kc, vc = blk_fn(blk, x, kc, vc)

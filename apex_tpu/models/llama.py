@@ -531,8 +531,10 @@ class LlamaModel(nn.Module):
     def _run_blocks(self, ctx, toks, caches, blk_fn):
         """Embed ``toks``, thread the caches through ``blk_fn`` per
         block, final-norm + head — the shared body of every cached
-        decode entry point."""
-        x = ctx.value(self.tok_emb.weight)[toks]
+        decode entry point.  The embedding gather is int8-aware: under
+        quantize_int8 only the selected rows dequantize."""
+        from ..inference.quant import gather_rows
+        x = gather_rows(ctx, self.tok_emb.weight, toks)
         new_caches = []
         for blk, (kc, vc) in zip(self.blocks, caches):
             x, kc, vc = blk_fn(blk, x, kc, vc)

@@ -84,7 +84,11 @@ class Ctx:
         loss)."""
         self.aux_losses.append(value)
 
-    def value(self, p):
+    def raw(self, p):
+        """Resolve a Parameter to its RAW substituted value — env entry,
+        derived recompute, or ``p.data`` — WITHOUT the QuantTensor
+        dequantization.  The single resolution path shared by ``value``
+        and int8-aware consumers (inference/quant.py gather_rows)."""
         v = self.env.get(id(p))
         if v is None:
             d = getattr(p, "_derived", None)
@@ -94,6 +98,10 @@ class Ctx:
                 # them
                 return d(self)
             v = p.data
+        return v
+
+    def value(self, p):
+        v = self.raw(p)
         if isinstance(v, QuantTensor):
             # int8-quantized weight (inference/quant.py): dequantize at
             # the point of use — XLA fuses the multiply into the
