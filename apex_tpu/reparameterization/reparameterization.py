@@ -71,8 +71,11 @@ class Reparameterization:
                     f"parameters ('{name}')")
             return
 
+        from ..inference.quant import QuantTensor
+
         weight = getattr(module2use, name2use, None)
         if not isinstance(weight, Parameter) or weight._derived is not None \
+                or isinstance(weight.data, QuantTensor) \
                 or weight.data.ndim <= 1:
             if strict:
                 if not isinstance(weight, Parameter):
@@ -82,6 +85,11 @@ class Reparameterization:
                 if weight._derived is not None:
                     raise ValueError(
                         f"'{name}' is already reparameterized")
+                if isinstance(weight.data, QuantTensor):
+                    raise ValueError(
+                        f"cannot reparameterize int8-quantized weight "
+                        f"'{name}' — quantized models are inference-only; "
+                        f"reparameterize first, quantize after")
                 raise ValueError(
                     f"cannot reparameterize {weight.data.ndim}-d parameter "
                     f"'{name}' (needs ndim > 1)")

@@ -187,3 +187,24 @@ def test_lora_train_sync_generate_flow(rng):
     remove_reparameterization(model, LoRA, remove_all=True)
     post = generate(model, ids[:1, :8], 6)
     np.testing.assert_array_equal(np.asarray(pre), np.asarray(post))
+
+
+def test_lora_refuses_quantized_weight(rng):
+    """Adapting an int8 weight would train factors against rounding
+    noise and break compute_weight's dtype math — refuse loudly, and
+    (non-strict sweep) leave the model intact."""
+    from apex_tpu.inference import quantize_int8
+    from apex_tpu.models.llama import llama_tiny
+
+    nn.manual_seed(0)
+    model = llama_tiny()
+    quantize_int8(model, min_size=1)
+    with pytest.raises(ValueError, match="quantized"):
+        apply_lora(model.blocks[0], "q_proj.weight", r=4)
+    # bulk sweep: every matrix is quantized -> everything skipped
+    apply_lora(model, r=4)
+    assert not any("lora" in n for n, _ in model.named_parameters())
+    # the guard is generic (shared eligibility): WeightNorm refuses too
+    from apex_tpu.reparameterization import apply_weight_norm
+    with pytest.raises(ValueError, match="quantized"):
+        apply_weight_norm(model.blocks[0], "q_proj.weight")

@@ -225,3 +225,15 @@ def test_sampled_speculative_validation(rng):
     with pytest.raises(ValueError, match="temperature"):
         speculative_generate(target, draft, prompt[:1], 4,
                              temperature=-1.0)
+
+
+def test_k_larger_than_remaining_tokens(rng):
+    """k >= max_new_tokens: rounds overshoot into the slack buffer and
+    the clamp still emits exactly max_new_tokens, matching greedy."""
+    target = _model(seed=30)
+    draft = _model(seed=31, hidden=64, layers=1, heads=2, kv_heads=1)
+    prompt = jnp.asarray(rng.integers(0, 1000, (1, 5)))
+    want = generate(target, prompt, max_new_tokens=3)
+    got = speculative_generate(target, draft, prompt, max_new_tokens=3,
+                               k=8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
