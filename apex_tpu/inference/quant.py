@@ -90,15 +90,27 @@ def quantize_int8(model, min_size=4096, dtype=None):
     Every parameter with ``ndim >= 2`` and at least ``min_size`` elements
     is replaced (Linear/projection weights, embeddings); 1-D params
     (norm scales, biases) and small tensors stay full precision — their
-    bytes are noise and their dynamic range matters.  Returns the model
-    (now in ``eval()`` mode).  The change is inference-only: building a
-    train step over a quantized model raises.  ``dtype`` sets the
-    dequantization dtype (default: each weight's own; pass
-    ``jnp.bfloat16`` to also cast compute).
+    bytes are noise and their dynamic range matters.  Reparameterization
+    *source* parameters (WeightNorm's ``_g``/``_v``, LoRA's
+    ``_w0``/``_lora_a``/``_lora_b``) are skipped too: they feed a derived
+    weight whose closure expects full-precision sources, and quantizing a
+    trainable rank factor is never what the caller meant — merge first
+    (``remove_reparameterization``) to quantize the composed weight.
+    Returns the model (now in ``eval()`` mode).  The change is
+    inference-only: building a train step over a quantized model raises.
+    ``dtype`` sets the dequantization dtype (default: each weight's own;
+    pass ``jnp.bfloat16`` to also cast compute).
     """
+    # identity set of reparameterization sources: exact (registry-driven),
+    # not a name-suffix heuristic
+    reparam_sources = set()
+    for m in model.modules():
+        for fn in (getattr(m, "_reparameterizations", None) or {}).values():
+            reparam_sources.update(id(p) for p in fn.get_params(m))
     n = 0
     for p in model.parameters():
-        if p is None or getattr(p, "_derived", None) is not None:
+        if p is None or getattr(p, "_derived", None) is not None \
+                or id(p) in reparam_sources:
             continue
         d = p.data
         if isinstance(d, QuantTensor):

@@ -454,9 +454,25 @@ class GptModel(nn.Module):
     def decode_chunk(self, ctx, toks, caches, t0):
         """Logits for a token CHUNK ``toks (B, S_c)`` at positions
         ``t0 ..`` against the caches (the speculative-verification
-        primitive; same contract as LlamaModel.decode_chunk)."""
+        primitive; same contract as LlamaModel.decode_chunk).
+
+        ``t0 + S_c`` must be ``<= max_positions``: the position table is
+        read with ``lax.dynamic_slice``, which CLAMPS an out-of-range
+        start instead of failing — silently wrong position embeddings.
+        A concrete (Python int) ``t0`` is checked here; traced callers
+        (generate / speculative_generate) enforce the bound on the whole
+        generation up front, so the clamp is unreachable through them."""
         self._decode_guard("decode_chunk")
         s_c = toks.shape[1]
+        if not isinstance(t0, jax.core.Tracer):
+            bound = min(self.max_positions, caches[0][0].shape[2])
+            if int(t0) < 0 or int(t0) + s_c > bound:
+                raise ValueError(
+                    f"decode_chunk: positions {int(t0)}..{int(t0) + s_c} "
+                    f"out of range for max_positions {self.max_positions} "
+                    f"/ cache length {caches[0][0].shape[2]} — "
+                    f"dynamic_slice would clamp and return wrong position "
+                    f"embeddings / corrupt the cache")
         return self._run_blocks(
             ctx, toks, caches,
             lambda pos: jax.lax.dynamic_slice(

@@ -592,3 +592,34 @@ def test_fold_shard_into_key_gives_per_shard_masks():
     # no-op without a key
     ctx = Ctx(training=False)
     assert fold_shard_into_key(ctx, "sp") is ctx
+
+
+def test_decode_chunk_rejects_out_of_range_t0(rng):
+    """A concrete t0 past the position table must raise, not let
+    lax.dynamic_slice clamp to wrong position embeddings."""
+    import pytest
+    from apex_tpu.nn.modules import Ctx
+
+    m = _tiny_gpt()
+    m.eval()
+    caches = m.init_caches(batch=1, s_max=64)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="max_positions"):
+        m.decode_chunk(Ctx(), toks, caches, 60)   # 60 + 8 > 64
+    # in-range concrete t0 still works
+    logits, _ = m.decode_chunk(Ctx(), toks, caches, 56)
+    assert logits.shape == (1, 8, V)
+
+
+def test_decode_chunk_rejects_negative_t0_and_short_cache(rng):
+    import pytest
+    from apex_tpu.nn.modules import Ctx
+
+    m = _tiny_gpt()
+    m.eval()
+    toks = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="out of range"):
+        m.decode_chunk(Ctx(), toks, m.init_caches(1, 64), -1)
+    # cache shorter than max_positions bounds the write window too
+    with pytest.raises(ValueError, match="cache length"):
+        m.decode_chunk(Ctx(), toks, m.init_caches(1, 32), 30)

@@ -183,3 +183,30 @@ def test_gather_rows_matches_dequant_gather(rng):
     # takes the int8 gather
     got_eager = np.asarray(gather_rows(Ctx(training=False), p, ids))
     np.testing.assert_allclose(got_eager, want, rtol=1e-6, atol=1e-7)
+
+
+def test_quantize_skips_lora_sources(rng):
+    """LoRA factors / frozen bases are reparameterization SOURCES — they
+    must stay full precision under quantize_int8 (the derived-weight
+    closure reads them; quantizing a trainable rank factor is never
+    intended).  Merging first quantizes the composed weight as usual."""
+    from apex_tpu.models.llama import llama_tiny
+    from apex_tpu.reparameterization import (LoRA, apply_lora,
+                                             remove_reparameterization)
+
+    model = llama_tiny()
+    apply_lora(model, r=2)
+    quantize_int8(model, min_size=1)
+    for name, p in model.named_parameters():
+        if name.endswith(("_w0", "_lora_a", "_lora_b")):
+            assert not isinstance(p.data, QuantTensor), name
+    # non-reparameterized matrices (embedding) still quantized
+    assert isinstance(model.tok_emb.weight.data, QuantTensor)
+
+    # the documented flow: merge, then quantize the composed weight
+    model2 = llama_tiny()
+    apply_lora(model2, r=2)
+    remove_reparameterization(model2, LoRA, remove_all=True)
+    quantize_int8(model2, min_size=1)
+    assert all(isinstance(p.data, QuantTensor)
+               for p in model2.parameters() if p.ndim >= 2)
