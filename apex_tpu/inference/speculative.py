@@ -92,6 +92,15 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
                 f"speculative_generate needs {name}.{missing[0]} "
                 f"(the GPT/Llama cache protocol: init_caches, "
                 f"decode_step, decode_chunk, prefill)")
+        if getattr(m, "tp_axis", None) is not None:
+            # generate() grew a mesh= path; this driver still builds a
+            # plain jit — without this guard a tp model would die on an
+            # unbound-axis error deep inside tracing
+            raise NotImplementedError(
+                f"speculative_generate does not run under tensor "
+                f"parallelism yet — {name} was built with tp_axis="
+                f"'{m.tp_axis}'; use generate(..., mesh=...) for TP "
+                f"decode or build the {name} without tp_axis")
     b, p = prompt_ids.shape
     if p < 1:
         raise ValueError("prompt must hold at least one token")

@@ -83,14 +83,33 @@ class GptBlock(nn.Module):
         """(B, S_c, E) -> q/k/v (B, H, S_c, D) via the training
         projection (the interleaved QKV layout of
         attn_funcs._split_interleaved_qkv), so caches filled here
-        reproduce the training forward's attention."""
+        reproduce the training forward's attention.  Under ``tp_axis``
+        the interleaved layout is head-major (3·D contiguous rows per
+        head), so a contiguous row slice of the in-projection IS a head
+        block — decode shards heads exactly like the training path —
+        and the returned H is the LOCAL head count."""
         attn = self.attn
         heads, d = attn.num_heads, attn.head_dim
         b, s_c, _ = x.shape
         h = self.ln1.forward(ctx, x)
-        qkv = jnp.matmul(h, ctx.value(attn.in_proj_weight).T.astype(h.dtype))
-        if attn.bias:
-            qkv = qkv + ctx.value(attn.in_proj_bias).astype(qkv.dtype)
+        wi = ctx.value(attn.in_proj_weight)
+        bi = ctx.value(attn.in_proj_bias) if attn.bias else None
+        if self.tp_axis is not None:
+            from ..parallel.tensor_parallel import (copy_to_tp_region,
+                                                    _shard_rows)
+            n = jax.lax.psum(1, self.tp_axis)
+            if heads % n:
+                raise ValueError(
+                    f"tensor parallelism: heads ({heads}) not divisible "
+                    f"by the '{self.tp_axis}' axis size ({n})")
+            h = copy_to_tp_region(h, self.tp_axis)
+            wi = _shard_rows(wi, self.tp_axis)
+            if bi is not None:
+                bi = _shard_rows(bi, self.tp_axis)
+            heads //= n
+        qkv = jnp.matmul(h, wi.T.astype(h.dtype))
+        if bi is not None:
+            qkv = qkv + bi.astype(qkv.dtype)
         qkv = qkv.reshape(b, s_c, heads, 3, d)
         to_bh = lambda y: jnp.swapaxes(y, 1, 2)       # (B, H, S_c, D)
         return (to_bh(qkv[:, :, :, 0]), to_bh(qkv[:, :, :, 1]),
@@ -98,11 +117,27 @@ class GptBlock(nn.Module):
 
     def _attn_mlp_tail(self, ctx, x, o):
         """Shared residual tail after attention combine: out projection
-        + GELU MLP (one body for prefill/decode_chunk/decode)."""
+        + GELU MLP (one body for prefill/decode_chunk/decode).  Under
+        ``tp_axis`` ``o`` carries LOCAL head features: the out
+        projection is row-parallel (its psum exits the attention
+        region; the bias is added once, post-reduction) and the MLP is
+        the column→row pair."""
         attn = self.attn
-        o = jnp.matmul(o, ctx.value(attn.out_proj_weight).T.astype(o.dtype))
+        wo = ctx.value(attn.out_proj_weight)
+        bo = ctx.value(attn.out_proj_bias) if attn.bias else None
+        if self.tp_axis is not None:
+            from ..parallel.tensor_parallel import (row_parallel_linear,
+                                                    _shard_cols, tp_ffn)
+            x = x + row_parallel_linear(
+                o, _shard_cols(wo, self.tp_axis), bo, self.tp_axis)
+            return x + tp_ffn(
+                self.ln2.forward(ctx, x),
+                ctx.value(self.fc1.weight), ctx.value(self.fc1.bias),
+                ctx.value(self.fc2.weight), ctx.value(self.fc2.bias),
+                self.tp_axis, activation=F.gelu)
+        o = jnp.matmul(o, wo.T.astype(o.dtype))
         if attn.bias:
-            o = o + ctx.value(attn.out_proj_bias).astype(o.dtype)
+            o = o + bo.astype(o.dtype)
         x = x + o
         hh = F.gelu(self.fc1.forward(ctx, self.ln2.forward(ctx, x)))
         return x + self.fc2.forward(ctx, hh)
@@ -112,8 +147,8 @@ class GptBlock(nn.Module):
         over the chunk (the caches are empty) + KV writes — one pass for
         a whole prompt instead of S_p decode steps."""
         b, s_c, _ = x.shape
-        heads, d = self.attn.num_heads, self.attn.head_dim
-        q, k_new, v_new = self._chunk_qkv(ctx, x)
+        d = self.attn.head_dim
+        q, k_new, v_new = self._chunk_qkv(ctx, x)     # H is LOCAL under tp
         kcache = jax.lax.dynamic_update_slice(
             kcache, k_new.astype(kcache.dtype), (0, 0, 0, 0))
         vcache = jax.lax.dynamic_update_slice(
@@ -121,7 +156,7 @@ class GptBlock(nn.Module):
         from ..contrib.multihead_attn.attn_funcs import flash_attention
         o = flash_attention(q, k_new, v_new, causal=True,
                             scale=self.attn.scaling)
-        o = jnp.swapaxes(o, 1, 2).reshape(b, s_c, heads * d)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, s_c, q.shape[1] * d)
         return self._attn_mlp_tail(ctx, x, o), kcache, vcache
 
     def decode_chunk(self, ctx, x, kcache, vcache, t0):
@@ -130,10 +165,10 @@ class GptBlock(nn.Module):
         mask.  Meant for SHORT verification windows (scores are
         (S_c, S_max) per head); prompts go through :meth:`prefill`."""
         attn = self.attn
-        heads, d = attn.num_heads, attn.head_dim
+        d = attn.head_dim
         b, s_c, _ = x.shape
         pos = t0 + jnp.arange(s_c, dtype=jnp.int32)
-        q, k_new, v_new = self._chunk_qkv(ctx, x)
+        q, k_new, v_new = self._chunk_qkv(ctx, x)     # H is LOCAL under tp
         kcache = jax.lax.dynamic_update_slice(
             kcache, k_new.astype(kcache.dtype), (0, 0, t0, 0))
         vcache = jax.lax.dynamic_update_slice(
@@ -147,7 +182,7 @@ class GptBlock(nn.Module):
         probs = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bhqs,bhsd->bhqd", probs,
                        vcache.astype(jnp.float32)).astype(x.dtype)
-        o = jnp.swapaxes(o, 1, 2).reshape(b, s_c, heads * d)
+        o = jnp.swapaxes(o, 1, 2).reshape(b, s_c, q.shape[1] * d)
         return self._attn_mlp_tail(ctx, x, o), kcache, vcache
 
     def decode(self, ctx, x, kcache, vcache, t):
@@ -407,19 +442,40 @@ class GptModel(nn.Module):
 
 
     def init_caches(self, batch, s_max, dtype=jnp.float32):
-        """Per-layer (k, v) caches of shape (B, H, S_max, D)."""
+        """Per-layer (k, v) caches of shape (B, H, S_max, D).  Under
+        ``tp_axis`` H is the LOCAL head count (call inside shard_map —
+        generate does): each device caches only its own head shard."""
         blk0 = self.blocks[0]
         h, d = blk0.attn.num_heads, blk0.attn.head_dim
+        if self.tp_axis is not None:
+            try:
+                n = jax.lax.psum(1, self.tp_axis)   # static axis size
+            except NameError:
+                raise ValueError(
+                    f"init_caches on a tp_axis='{self.tp_axis}' model "
+                    f"must run inside shard_map over a mesh with that "
+                    f"axis — generate(..., mesh=...) wraps the whole "
+                    f"decode; direct callers must shard_map themselves"
+                ) from None
+            if h % n:
+                raise ValueError(
+                    f"init_caches: heads ({h}) must divide by the "
+                    f"'{self.tp_axis}' axis size ({n})")
+            h //= n
         return [(jnp.zeros((batch, h, s_max, d), dtype),
                  jnp.zeros((batch, h, s_max, d), dtype))
                 for _ in self.blocks]
 
     def _decode_guard(self, what):
-        if self.sp_axis is not None or self.tp_axis is not None \
-                or self.moe_axis is not None:
+        """Cached decode supports single-shard AND tensor-parallel
+        execution (``tp_axis``: run inside shard_map — generate(mesh=...)
+        wraps it; caches shard heads, logits come out replicated).
+        Sequence parallelism and MoE stay training-only (no cached ring
+        protocol / no expert cache story) — refuse loudly."""
+        if self.sp_axis is not None or self.moe_axis is not None:
             raise NotImplementedError(
-                f"{what} is single-shard; build the model without "
-                f"sp_axis/tp_axis/moe_axis for inference")
+                f"{what} supports single-shard or tp_axis execution; "
+                f"build the model without sp_axis/moe_axis for inference")
 
     def _run_blocks(self, ctx, toks, caches, pos_of, blk_fn):
         """Embed ``toks`` + positions (``pos_of(pos_table)``), thread the
@@ -491,7 +547,7 @@ class GptModel(nn.Module):
 
 
 def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
-             top_k=None, key=None, cache_dtype=None):
+             top_k=None, key=None, cache_dtype=None, mesh=None):
     """Autoregressive sampling with a KV cache: models with the chunk
     protocol (GPT, Llama) consume the prompt in ONE ``model.prefill``
     flash pass, then generation runs a ``lax.scan`` of per-token decode
@@ -506,6 +562,15 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     ``jnp.bfloat16`` to halve cache HBM for fp32 checkpoints).  The
     reference has no inference path (it is a training-side library); this
     is the decode half of the GPT family.
+
+    Tensor-parallel decode: a model built with ``tp_axis`` needs
+    ``mesh`` (a ``jax.sharding.Mesh`` carrying that axis) — the whole
+    decode program runs inside ``shard_map`` with weights, tokens, and
+    the PRNG key replicated: each device projects only its own head
+    blocks (KV caches are head-sharded, HBM/device shrinks with the
+    mesh), the row-parallel psums make the logits replicated, and
+    sampling therefore emits bit-identical tokens on every device —
+    the output equals the single-shard decode of the same weights.
 
     Note on sampled reproducibility: the prefill fast path consumes ONE
     key split for the prompt where the legacy per-token path consumed
@@ -531,6 +596,20 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     if top_k is not None and not 1 <= top_k <= vocab:
         raise ValueError(
             f"top_k must be in [1, vocab={vocab}], got {top_k}")
+    tp_axis = getattr(model, "tp_axis", None)
+    if tp_axis is not None and mesh is None:
+        raise ValueError(
+            f"model was built with tp_axis='{tp_axis}': decode runs "
+            f"inside shard_map — pass generate(..., mesh=<Mesh with "
+            f"'{tp_axis}'>)")
+    if mesh is not None and tp_axis is None:
+        raise ValueError(
+            "mesh was passed but the model has no tp_axis — single-"
+            "shard decode needs no mesh")
+    if mesh is not None and tp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh axes {mesh.axis_names} do not include the model's "
+            f"tp_axis '{tp_axis}'")
 
     params = [q for q in model.parameters()]
     buffers = list(model.buffers())
@@ -606,13 +685,22 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     if cache is None:
         cache = model._generate_jit_cache = {}
     cfg = (b, p, max_new_tokens, float(temperature), top_k,
-           jnp.dtype(cache_dtype).name,
+           jnp.dtype(cache_dtype).name, mesh,
            tuple(id(o) for o in params + buffers))
     entry = cache.pop(cfg, None)    # pop + reinsert = LRU refresh
     if entry is None:
         while len(cache) >= 16:
             cache.pop(next(iter(cache)))
-        entry = (params + buffers, jax.jit(run))
+        if mesh is not None:
+            # everything replicated in and out; the TP sharding lives in
+            # the trace-time head-block slices inside the blocks
+            from jax.sharding import PartitionSpec as _P
+            fn = jax.jit(jax.shard_map(
+                run, mesh=mesh, in_specs=(_P(), _P(), _P()),
+                out_specs=_P(), check_vma=False))
+        else:
+            fn = jax.jit(run)
+        entry = (params + buffers, fn)
     cache[cfg] = entry
     return entry[1](vals, prompt_padded, key)
 

@@ -171,14 +171,6 @@ class LlamaBlock(nn.Module):
                 v = jnp.repeat(v, rep, axis=1)
             o = flash_attention(q, k, v, causal=True)  # (B, H_loc, S, D)
         o = jnp.swapaxes(o, 1, 2).reshape(b, s, q.shape[1] * self.head_dim)
-        if self.tp_axis is not None:
-            from ..parallel.tensor_parallel import (row_parallel_linear,
-                                                    _shard_cols)
-            wo = _shard_cols(ctx.value(self.o_proj.weight), self.tp_axis)
-            x = x + row_parallel_linear(o, wo, None, self.tp_axis)
-            h = self.ln2.forward(ctx, x)
-            x = x + self._tp_swiglu(ctx, h)
-            return x
         return self._mlp_tail(ctx, x, o)
 
     def _tp_swiglu(self, ctx, h):
@@ -217,22 +209,31 @@ class LlamaBlock(nn.Module):
 
     def _mlp_tail(self, ctx, x, o):
         """Shared residual tail: attention output projection + FFN (one
-        body for the training forward and every cached decode path)."""
+        body for the training forward and every cached decode path).
+        Under ``tp_axis`` the attention combine ``o`` carries the LOCAL
+        head features: o_proj is row-parallel (its psum is the exit g
+        operator of the attention region) and the FFN runs the
+        column→row SwiGLU pair."""
+        if self.tp_axis is not None:
+            from ..parallel.tensor_parallel import (row_parallel_linear,
+                                                    _shard_cols)
+            wo = _shard_cols(ctx.value(self.o_proj.weight), self.tp_axis)
+            x = x + row_parallel_linear(o, wo, None, self.tp_axis)
+            h = self.ln2.forward(ctx, x)
+            return x + self._tp_swiglu(ctx, h)
         x = x + self.o_proj.forward(ctx, o)
         h = self.ln2.forward(ctx, x)
         return x + self._ffn(ctx, h)
 
     def _chunk_qkv(self, ctx, x, pos):
         """(B, S_c, E) -> rotated q (B, H, S_c, D), k/v (B, KVH, S_c, D)
-        at absolute positions ``pos (S_c,)`` (single-shard decode path)."""
-        b, s_c, _ = x.shape
-        d, kvh = self.head_dim, self.kv_heads
+        at absolute positions ``pos (S_c,)`` — the cached-decode
+        projection.  Routed through :meth:`_qkv`, so under ``tp_axis``
+        the head dims are LOCAL and decode shards exactly like the
+        training forward (one projection body, no drift)."""
         h = self.ln1.forward(ctx, x)
-        to_heads = lambda y, nh: jnp.swapaxes(y.reshape(b, s_c, nh, d), 1, 2)
-        q = to_heads(self.q_proj.forward(ctx, h), self.heads)
-        k = to_heads(self.k_proj.forward(ctx, h), kvh)
-        v = to_heads(self.v_proj.forward(ctx, h), kvh)
-        cos, sin = rope_tables(pos, d, self.rope_theta)
+        q, k, v = self._qkv(ctx, h)
+        cos, sin = rope_tables(pos, self.head_dim, self.rope_theta)
         return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
     def prefill(self, ctx, x, kcache, vcache):
@@ -248,13 +249,15 @@ class LlamaBlock(nn.Module):
             kcache, k_new.astype(kcache.dtype), (0, 0, 0, 0))
         vcache = jax.lax.dynamic_update_slice(
             vcache, v_new.astype(vcache.dtype), (0, 0, 0, 0))
-        rep = self.heads // self.kv_heads
+        # LOCAL head counts (== global ones single-shard; both divide by
+        # the axis size under tp, so the GQA ratio is shard-invariant)
+        rep = q.shape[1] // k_new.shape[1]
         if rep > 1:
             k_new = jnp.repeat(k_new, rep, axis=1)
             v_new = jnp.repeat(v_new, rep, axis=1)
         o = flash_attention(q, k_new, v_new, causal=True)
         o = jnp.swapaxes(o, 1, 2).reshape(b, s_c,
-                                          self.heads * self.head_dim)
+                                          q.shape[1] * self.head_dim)
         return self._mlp_tail(ctx, x, o), kcache, vcache
 
     def decode_chunk(self, ctx, x, kcache, vcache, t0):
@@ -267,15 +270,18 @@ class LlamaBlock(nn.Module):
         (S_c, S_max) per head: meant for SHORT chunks against the cache;
         prefill a prompt with :meth:`prefill` instead."""
         b, s_c, _ = x.shape
-        d, kvh = self.head_dim, self.kv_heads
+        d = self.head_dim
         pos = t0 + jnp.arange(s_c, dtype=jnp.int32)
         q, k_new, v_new = self._chunk_qkv(ctx, x, pos)
+        # LOCAL head counts: under tp_axis the caches are KVH/n-wide and
+        # q carries H/n heads (the GQA group ratio is shard-invariant)
+        h_loc, kvh = q.shape[1], k_new.shape[1]
         kcache = jax.lax.dynamic_update_slice(
             kcache, k_new.astype(kcache.dtype), (0, 0, t0, 0))
         vcache = jax.lax.dynamic_update_slice(
             vcache, v_new.astype(vcache.dtype), (0, 0, t0, 0))
         s_max = kcache.shape[2]
-        group = self.heads // kvh
+        group = h_loc // kvh
         qg = q.reshape(b, kvh, group, s_c, d)
         scores = jnp.einsum("bkgqd,bksd->bkgqs", qg.astype(jnp.float32),
                             kcache.astype(jnp.float32)) * (d ** -0.5)
@@ -288,8 +294,8 @@ class LlamaBlock(nn.Module):
         probs = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bkgqs,bksd->bkgqd", probs,
                        vcache.astype(jnp.float32)).astype(x.dtype)
-        o = jnp.swapaxes(o.reshape(b, self.heads, s_c, d), 1, 2) \
-            .reshape(b, s_c, self.heads * d)
+        o = jnp.swapaxes(o.reshape(b, h_loc, s_c, d), 1, 2) \
+            .reshape(b, s_c, h_loc * d)
         return self._mlp_tail(ctx, x, o), kcache, vcache
 
     def decode(self, ctx, x, kcache, vcache, t):
@@ -505,11 +511,29 @@ class LlamaModel(nn.Module):
 
     def init_caches(self, batch, s_max, dtype=jnp.float32):
         """Per-layer (k, v) caches, (B, KVH, S_max, D) — KVH-wide, the
-        GQA cache saving."""
-        return [(jnp.zeros((batch, blk.kv_heads, s_max, blk.head_dim),
-                           dtype),
-                 jnp.zeros((batch, blk.kv_heads, s_max, blk.head_dim),
-                           dtype))
+        GQA cache saving.  Under ``tp_axis`` the caches are LOCAL
+        (KVH/n-wide, each device caching only its own KV head shard —
+        the per-device cache HBM shrinks with the mesh) and this must be
+        called inside ``shard_map`` (generate does)."""
+        n = 1
+        if self.tp_axis is not None:
+            try:
+                n = jax.lax.psum(1, self.tp_axis)   # static axis size
+            except NameError:
+                raise ValueError(
+                    f"init_caches on a tp_axis='{self.tp_axis}' model "
+                    f"must run inside shard_map over a mesh with that "
+                    f"axis — generate(..., mesh=...) wraps the whole "
+                    f"decode; direct callers must shard_map themselves"
+                ) from None
+            if any(blk.kv_heads % n for blk in self.blocks):
+                raise ValueError(
+                    f"init_caches: kv_heads must divide by the "
+                    f"'{self.tp_axis}' axis size ({n})")
+        return [(jnp.zeros((batch, blk.kv_heads // n, s_max,
+                            blk.head_dim), dtype),
+                 jnp.zeros((batch, blk.kv_heads // n, s_max,
+                            blk.head_dim), dtype))
                 for blk in self.blocks]
 
     def tp_sharded_params(self):
@@ -522,11 +546,16 @@ class LlamaModel(nn.Module):
             x, ctx.value(self.lm_head.weight).T.astype(x.dtype))
 
     def _decode_guard(self, what):
-        if self.tp_axis is not None or self.moe_axis is not None \
-                or self.sp_axis is not None:
+        """Cached decode supports single-shard AND tensor-parallel
+        execution (``tp_axis``: run inside shard_map — generate(mesh=...)
+        wraps it; caches shard KV heads, logits come out replicated).
+        Sequence parallelism and MoE stay training-only: the ring
+        protocol has no cached/banded form and expert dispatch has no
+        cache story yet — refuse loudly rather than decode wrongly."""
+        if self.moe_axis is not None or self.sp_axis is not None:
             raise NotImplementedError(
-                f"{what} is single-shard; build the model without "
-                f"tp_axis/sp_axis/moe_axis for inference")
+                f"{what} supports single-shard or tp_axis execution; "
+                f"build the model without sp_axis/moe_axis for inference")
 
     def _run_blocks(self, ctx, toks, caches, blk_fn):
         """Embed ``toks``, thread the caches through ``blk_fn`` per
