@@ -10,7 +10,8 @@ from .llama import (  # noqa: F401
 from .vit import VitBlock, VitModel, vit_base, vit_small  # noqa: F401
 from .hf import (gpt2_from_hf, gpt2_to_hf_state_dict,  # noqa: F401
                  llama_from_hf, llama_to_hf_state_dict,
-                 mixtral_from_hf)
+                 mixtral_from_hf, resnet_from_torch,
+                 resnet18_from_torch, resnet50_from_torch)
 from .seq2seq import (  # noqa: F401
     Seq2SeqDecoderLayer, TransformerSeq2Seq, seq2seq_generate,
     transformer_seq2seq)

@@ -416,3 +416,84 @@ def mixtral_from_hf(src, moe_axis="data", capacity_factor=8.0,
             [norm[f"{ep}{e}.w2.weight"] for e in range(n_exp)]))
     model.eval()
     return model
+
+
+def resnet_from_torch(src, **model_kw):
+    """Build a :class:`~apex_tpu.models.resnet.ResNet` carrying the
+    weights of a torch/torchvision ResNet (18/34/50/101 and friends).
+
+    The north-star clause asks for the reference's examples to consume
+    existing torch checkpoints (the imagenet example's ``--resume``,
+    reference examples/imagenet/main_amp.py:180-195); torch-xla is not
+    available here, so the interop story is checkpoint-level — mirror of
+    :func:`gpt2_from_hf` for the vision path.  ``src``: a torch module
+    (``torchvision.models.resnet50()``), a ``state_dict()`` mapping, or
+    a ``torch.load`` result (``state_dict``/``model`` wrapper keys and
+    DDP ``module.`` prefixes are unwrapped).  Geometry — block type
+    (Basic vs Bottleneck), stage depths, class count, CIFAR-vs-ImageNet
+    stem — is inferred from the tensors; this framework's module tree
+    uses torchvision's exact attribute names, so the load is
+    name-matched with shape checks, and missing/unexpected keys raise.
+
+    Returns the model in ``eval()`` mode with BN running stats loaded
+    (``num_batches_tracked`` included when present — absent in very old
+    torch checkpoints, tolerated).
+    """
+    from .resnet import BasicBlock, Bottleneck, ResNet
+
+    sd = src.state_dict() if hasattr(src, "state_dict") else dict(src)
+    # torch.load checkpoint wrappers (examples/imagenet resume format)
+    for wrap in ("state_dict", "model"):
+        if wrap in sd and not hasattr(sd[wrap], "shape"):
+            sd = dict(sd[wrap])
+    sd = {(k[len("module."):] if k.startswith("module.") else k): v
+          for k, v in sd.items()}
+
+    for needed in ("conv1.weight", "fc.weight", "layer1.0.conv1.weight"):
+        if needed not in sd:
+            raise ValueError(
+                f"state dict does not look like a torchvision ResNet: "
+                f"missing '{needed}'")
+    depths = [1 + max(int(k.split(".")[1]) for k in sd
+                      if k.startswith(f"layer{i}."))
+              for i in range(1, 5)]
+    block = Bottleneck if "layer1.0.conv3.weight" in sd else BasicBlock
+    num_classes = sd["fc.weight"].shape[0]
+    small_input = sd["conv1.weight"].shape[-1] == 3
+    model = ResNet(block, depths, num_classes=num_classes,
+                   small_input=small_input, **model_kw)
+
+    used = set()
+    for name, p in model.named_parameters():
+        if name not in sd:
+            raise ValueError(f"checkpoint is missing parameter '{name}'")
+        _put(p, _to_numpy(sd[name]))
+        used.add(name)
+    for name, b in model.named_buffers():
+        if name not in sd:
+            if name.endswith("num_batches_tracked"):
+                continue    # pre-0.4-era checkpoints lack the counter
+            raise ValueError(f"checkpoint is missing buffer '{name}'")
+        used.add(name)
+        if name.endswith("num_batches_tracked"):
+            b.data = jnp.asarray(np.asarray(sd[name]).item(), jnp.int32)
+            continue
+        v = _to_numpy(sd[name])
+        if tuple(b.data.shape) != v.shape:
+            raise ValueError(
+                f"shape mismatch loading torch weights: buffer {name} "
+                f"{tuple(b.data.shape)} vs checkpoint {v.shape}")
+        b.data = jnp.asarray(v)
+    unexpected = sorted(set(sd) - used)
+    if unexpected:
+        raise ValueError(
+            f"checkpoint carries keys this ResNet has no slot for: "
+            f"{unexpected[:5]}{'...' if len(unexpected) > 5 else ''}")
+    model.eval()
+    return model
+
+
+# the flagship alias the migration guide points at; the generic loader
+# already infers the depth/block geometry, so all named variants share it
+resnet50_from_torch = resnet_from_torch
+resnet18_from_torch = resnet_from_torch

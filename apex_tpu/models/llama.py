@@ -594,8 +594,25 @@ class LlamaModel(nn.Module):
         next-token distribution after consuming ``toks[:, :i+1]`` (and
         everything already in the caches) — the speculative-verification
         primitive (inference/speculative.py scores draft tokens with it;
-        prompts go through :meth:`prefill`)."""
+        prompts go through :meth:`prefill`).
+
+        Same bounds contract as GptModel.decode_chunk: a concrete
+        (Python int) ``t0`` is validated against the cache length here —
+        ``lax.dynamic_update_slice`` CLAMPS an out-of-range write start,
+        which would silently overwrite prefix KV entries while RoPE
+        rotates by the unclamped positions.  Traced callers (generate /
+        speculative_generate) enforce the bound up front."""
         self._decode_guard("decode_chunk")
+        if not isinstance(t0, jax.core.Tracer):
+            s_c = toks.shape[1]
+            bound = min(self.max_positions, caches[0][0].shape[2])
+            if int(t0) < 0 or int(t0) + s_c > bound:
+                raise ValueError(
+                    f"decode_chunk: positions {int(t0)}..{int(t0) + s_c} "
+                    f"out of range for max_positions "
+                    f"{self.max_positions} / cache length "
+                    f"{caches[0][0].shape[2]} — dynamic_update_slice "
+                    f"would clamp and corrupt the cache")
         return self._run_blocks(
             ctx, toks, caches,
             lambda blk, x, kc, vc: blk.decode_chunk(ctx, x, kc, vc, t0))

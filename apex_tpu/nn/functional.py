@@ -506,7 +506,13 @@ def _reduce(v, reduction):
 def cross_entropy(logits, target, weight=None, reduction="mean",
                   label_smoothing=0.0):
     """Softmax cross entropy with integer class targets (torch semantics:
-    logits (N, C, ...), target (N, ...))."""
+    logits (N, C, ...), target (N, ...)).
+
+    One traced-semantics divergence from torch: an OUT-OF-RANGE target
+    (negative or >= C) cannot raise under jit — ``one_hot`` zeroes it,
+    so the row contributes 0 loss (the optax convention).  A training
+    loss that sits near 0 from step one usually means a class-count /
+    label-range mismatch, not a converged model."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=1)
     tgt = jax.nn.one_hot(target, logits.shape[1], axis=1, dtype=logp.dtype)
     if label_smoothing > 0.0:

@@ -27,6 +27,18 @@ T0 = time.perf_counter()
 STAGE = {"name": "import", "detail": ""}
 V100_APEX_O2_IMGS_PER_SEC = 800.0
 
+# vs_baseline anchor for the non-ResNet training configs (BERT/GPT/
+# Llama/seq2seq/ViT/DCGAN), where no like-for-like measured V100+Apex
+# number exists (the reference publishes none, BASELINE.md).  The
+# anchor is DERIVED, with the arithmetic in the emitted line:
+# the throughput a V100 would deliver on the same step at 30% MFU of
+# its 125 TFLOP/s fp16 tensor-core peak (0.3 is the V100-era rule of
+# thumb for well-tuned fp16 transformer/conv training).  anchor
+# items/s = 37.5e12 / (step FLOPs / batch), so
+# vs_baseline = achieved TFLOP/s / 37.5 — self-contained and coarse by
+# construction, but it makes every bench line adjudicable.
+V100_EST_SUSTAINED_TFLOPS = 0.30 * 125.0
+
 # bf16 peak TFLOP/s by TPU generation (public spec sheets); used for MFU
 _PEAK_TFLOPS = (
     ("v6", 918.0), ("v5p", 459.0), ("v5e", 197.0), ("v5 lite", 197.0),
@@ -508,15 +520,22 @@ def run_kernel_timing(iters=30):
 
 
 def time_compiled_step(step, batch_arrays, iters, warmup, analytic_flops,
-                       pallas_attn_flops=0.0):
+                       pallas_attn_flops=0.0, sync_state=None):
     """Compile + time a fused train step: returns (dt, compile_s, flops,
     flops_source).  FLOPs come from XLA cost analysis with
     ``analytic_flops()`` as the fallback; ``pallas_attn_flops`` is the
     analytic attention-matmul complement added on top of cost analysis
     when the compiled program actually contains Pallas custom calls
     (cost analysis reports 0 FLOPs for them, so without the complement
-    flash-attention configs understate MFU)."""
+    flash-attention configs understate MFU).  ``sync_state``: fetch one
+    scalar data-dependent on the step chain (the axon no-op
+    block_until_ready workaround); default reads master_params[0] —
+    states shaped differently (the GAN step's d/g pair) pass their
+    own."""
     import jax.numpy as jnp
+
+    if sync_state is None:
+        sync_state = lambda s: float(jnp.sum(s.master_params[0]))
 
     tc = time.perf_counter()
     compiled = step._step_fn.lower(step.state, *batch_arrays).compile()
@@ -560,16 +579,17 @@ def time_compiled_step(step, batch_arrays, iters, warmup, analytic_flops,
         # names the exact iteration and the stage log records whether the
         # step is slow or dead.
         ti = time.perf_counter()
-        float(jnp.sum(state.master_params[0]))
+        sync_state(state)
         stage("warmup", f"iter {i + 1}/{warmup} done "
                         f"({time.perf_counter() - ti:.1f}s)")
-    log(f"warm, loss={float(loss):.4f}")
+    lval = loss[0] if isinstance(loss, tuple) else loss
+    log(f"warm, loss={float(lval):.4f}")
 
     stage("timing", f"{iters} iters")
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss = compiled(state, *batch_arrays)
-    float(jnp.sum(state.master_params[0]))
+    sync_state(state)
     dt = (time.perf_counter() - t0) / iters
     return dt, compile_s, flops, flops_source
 
@@ -955,6 +975,90 @@ def run_vit_throughput(batch, iters, warmup):
     return time_compiled_step(step, arrays, iters, warmup, af)
 
 
+def build_dcgan_step(batch, image_size=64, nz=100, ngf=64, ndf=64):
+    """DCGAN multi-model/multi-loss amp iteration — BASELINE config 5
+    (reference examples/dcgan/main_amp.py:214-253: two models, two
+    optimizers, three scaled losses).  Canonical 64x64 DCGAN geometry;
+    the whole D-real/D-fake/G iteration compiles into ONE executable
+    via make_gan_train_step with the example's O1-equivalent settings
+    (fp32 params, dynamic loss scale)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_gan_train_step
+
+    stage("model_build", f"dcgan{image_size} batch={batch}")
+    nn.manual_seed(0)
+    netG = nn.Sequential(
+        nn.ConvTranspose2d(nz, ngf * 8, 4, stride=1, padding=0),
+        nn.BatchNorm2d(ngf * 8), nn.ReLU(),
+        nn.ConvTranspose2d(ngf * 8, ngf * 4, 4, stride=2, padding=1),
+        nn.BatchNorm2d(ngf * 4), nn.ReLU(),
+        nn.ConvTranspose2d(ngf * 4, ngf * 2, 4, stride=2, padding=1),
+        nn.BatchNorm2d(ngf * 2), nn.ReLU(),
+        nn.ConvTranspose2d(ngf * 2, ngf, 4, stride=2, padding=1),
+        nn.BatchNorm2d(ngf), nn.ReLU(),
+        nn.ConvTranspose2d(ngf, 3, 4, stride=2, padding=1),
+        nn.Tanh())
+    netD = nn.Sequential(
+        nn.Conv2d(3, ndf, 4, stride=2, padding=1), nn.LeakyReLU(0.2),
+        nn.Conv2d(ndf, ndf * 2, 4, stride=2, padding=1),
+        nn.BatchNorm2d(ndf * 2), nn.LeakyReLU(0.2),
+        nn.Conv2d(ndf * 2, ndf * 4, 4, stride=2, padding=1),
+        nn.BatchNorm2d(ndf * 4), nn.LeakyReLU(0.2),
+        nn.Conv2d(ndf * 4, ndf * 8, 4, stride=2, padding=1),
+        nn.BatchNorm2d(ndf * 8), nn.LeakyReLU(0.2),
+        nn.Conv2d(ndf * 8, 1, 4, stride=1, padding=0), nn.Flatten(0))
+    optD = FusedAdam(list(netD.parameters()), lr=2e-4, betas=(0.5, 0.999))
+    optG = FusedAdam(list(netG.parameters()), lr=2e-4, betas=(0.5, 0.999))
+
+    def d_loss(out_r, out_f):
+        return (F.binary_cross_entropy_with_logits(
+                    out_r, jnp.ones_like(out_r))
+                + F.binary_cross_entropy_with_logits(
+                    out_f, jnp.zeros_like(out_f)))
+
+    def g_loss(out_f):
+        return F.binary_cross_entropy_with_logits(
+            out_f, jnp.ones_like(out_f))
+
+    step = make_gan_train_step(netD, netG, optD, optG, d_loss, g_loss,
+                               half_dtype=None, loss_scale="dynamic")
+    rng = np.random.default_rng(0)
+    real = jnp.asarray(
+        rng.standard_normal((batch, 3, image_size, image_size)),
+        jnp.float32)
+    z = jnp.asarray(rng.standard_normal((batch, nz, 1, 1)), jnp.float32)
+
+    def _conv_flops(cin, cout, k, hout):
+        return 2.0 * cin * cout * k * k * hout * hout
+
+    g_f = sum(_conv_flops(*a) for a in
+              ((nz, ngf * 8, 4, 4), (ngf * 8, ngf * 4, 4, 8),
+               (ngf * 4, ngf * 2, 4, 16), (ngf * 2, ngf, 4, 32),
+               (ngf, 3, 4, 64)))
+    d_f = sum(_conv_flops(*a) for a in
+              ((3, ndf, 4, 32), (ndf, ndf * 2, 4, 16),
+               (ndf * 2, ndf * 4, 4, 8), (ndf * 4, ndf * 8, 4, 4),
+               (ndf * 8, 1, 4, 1)))
+    # coarse fwd+bwd(~3x fwd) over: D on real+fake, G once for the D
+    # loss (detached) + the G-loss path through both nets — cost
+    # analysis replaces this whenever available
+    analytic = lambda: 3.0 * batch * (2.0 * g_f + 3.0 * d_f)
+    sync = lambda s: float(jnp.sum(s.d.master_params[0]))
+    return step, (real, z), analytic, sync
+
+
+def run_dcgan_throughput(batch, iters, warmup):
+    step, arrays, af, sync = build_dcgan_step(batch)
+    stage("compile", f"dcgan batch={batch}")
+    return time_compiled_step(step, arrays, iters, warmup, af,
+                              sync_state=sync)
+
+
 def build_resnet_step(batch):
     import jax.numpy as jnp
     import numpy as np
@@ -1021,6 +1125,9 @@ def main():
                     help="run the transformer-base seq2seq config")
     ap.add_argument("--vit", action="store_true",
                     help="ViT-S/16 at 224 classification throughput")
+    ap.add_argument("--dcgan", action="store_true",
+                    help="DCGAN 64x64 multi-model/multi-loss amp "
+                         "iteration (BASELINE config 5)")
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--gpt-size", default="small",
                     choices=["small", "medium"],
@@ -1083,6 +1190,9 @@ def main():
         if args.vit:
             return ("vit_s16_imagenet_images_per_sec_per_chip_ampO2",
                     "images/sec/chip")
+        if args.dcgan:
+            return ("dcgan64_multi_loss_images_per_sec_per_chip_ampO1",
+                    "images/sec/chip")
         return "resnet50_imagenet_images_per_sec_per_chip_ampO2", \
             "images/sec/chip"
 
@@ -1096,7 +1206,7 @@ def main():
              "quantized DECODE measurement; pair it with --gpt-decode")
         return 1
     if args.profile and (args.seq2seq or args.gpt_decode or args.vit
-                         or args.llama):
+                         or args.llama or args.dcgan):
         fail("profile_unsupported_config: --profile supports the "
              "resnet (default), --gpt and --bert configs")
         return 1
@@ -1223,6 +1333,8 @@ def main():
                                         plain_loss=args.plain_loss)
         if args.vit:
             return run_vit_throughput(batch, args.iters, args.warmup)
+        if args.dcgan:
+            return run_dcgan_throughput(batch, args.iters, args.warmup)
         return run_throughput(batch, args.iters, args.warmup)
 
     if args.sweep:
@@ -1233,7 +1345,8 @@ def main():
                f"gpt2_{args.gpt_size}" if args.gpt else
                "llama_125m" if args.llama else
                "seq2seq" if args.seq2seq else
-               "vit_s16" if args.vit else "resnet50")
+               "vit_s16" if args.vit else
+               "dcgan64" if args.dcgan else "resnet50")
         peak, kind = peak_tflops(devices[0])
         ok = 0
         for batch in sweep_batches:
@@ -1298,14 +1411,25 @@ def main():
 
     stage("report")
     is_resnet = not (args.bert or args.gpt or args.llama or args.seq2seq
-                     or args.vit)
-    vs_baseline = (round(imgs_per_sec / V100_APEX_O2_IMGS_PER_SEC, 3)
-                   if is_resnet else None)
+                     or args.vit or args.dcgan)
+    if is_resnet:
+        # measured-anchor convention: the commonly reported V100 Apex-O2
+        # ResNet-50 number (BASELINE.md)
+        vs_baseline = round(imgs_per_sec / V100_APEX_O2_IMGS_PER_SEC, 3)
+        anchor_note = "v100_apex_o2_measured_800_img_s"
+    else:
+        # derived-anchor convention (see V100_EST_SUSTAINED_TFLOPS):
+        # a V100 at 30% MFU of its 125 TFLOP/s fp16 peak on this exact
+        # step's FLOPs; ratio reduces to achieved TFLOP/s / 37.5
+        vs_baseline = round(tflops / V100_EST_SUSTAINED_TFLOPS, 3)
+        anchor_note = ("v100_est_30pct_mfu_125tflops: anchor_items_s="
+                       f"{V100_EST_SUSTAINED_TFLOPS * 1e12 * batch / flops:.1f}")
     emit({
         "metric": metric_name,
         "value": round(imgs_per_sec, 1),
         "unit": metric_unit,
         "vs_baseline": vs_baseline,
+        "baseline_anchor": anchor_note,
         "batch": batch,
         "step_time_ms": round(dt * 1e3, 2),
         "compile_s": round(compile_s, 1),

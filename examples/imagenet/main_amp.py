@@ -35,6 +35,10 @@ def parse_args():
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--resume", default="", help="checkpoint to resume from")
+    p.add_argument("--load-torch", default="",
+                   help="initialize from a torch/torchvision ResNet "
+                        "checkpoint (.pth state dict or the reference "
+                        "example's resume format)")
     p.add_argument("--checkpoint", default="checkpoint.pkl")
     p.add_argument("--opt-level", default="O2",
                    choices=["O0", "O1", "O2", "O3"])
@@ -96,7 +100,17 @@ def main():
     from apex_tpu.optimizers import FusedSGD
 
     nn.manual_seed(0)
-    model = getattr(models, args.arch)(num_classes=1000)
+    if args.load_torch:
+        # torch checkpoint interop (mirror of the reference's --resume,
+        # main_amp.py:180-195): geometry comes from the tensors
+        import torch
+        model = models.resnet_from_torch(
+            torch.load(args.load_torch, map_location="cpu",
+                       weights_only=True))
+        model.train()    # the loader returns eval(); this script trains
+        print(f"=> loaded torch weights from {args.load_torch}")
+    else:
+        model = getattr(models, args.arch)(num_classes=1000)
     if args.sync_bn:
         model = parallel.convert_syncbn_model(model)
     optimizer = FusedSGD(list(model.parameters()), lr=args.lr,

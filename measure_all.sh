@@ -36,6 +36,7 @@ run --gpt-decode
 run --gpt-decode --int8
 run --spec-decode
 run --seq2seq
+run --dcgan
 run --kernels-timing                  # Pallas vs XLA A/B per shape
 run --profile                         # resnet per-op time attribution
 run --profile --gpt                   # gpt per-op time attribution

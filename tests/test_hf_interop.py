@@ -369,3 +369,120 @@ def test_mixtral_logit_parity(rng):
         check_vma=False))([p.data for p in params], jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(got), want, rtol=4e-4,
                                atol=4e-4)
+
+
+# ---------------------------------------------------------------- resnet
+
+
+def _torch_resnet_sd(model, dtype=None):
+    """Export an apex_tpu ResNet's values as a torchvision-style torch
+    state dict (the module trees share attribute names, so the key set
+    IS torchvision's — including BN running stats and the int64
+    num_batches_tracked counter)."""
+    sd = {}
+    for n, p in model.named_parameters():
+        t = torch.from_numpy(np.asarray(p.data, np.float32))
+        sd[n] = t.to(dtype) if dtype is not None else t
+    for n, b in model.named_buffers():
+        if n.endswith("num_batches_tracked"):
+            sd[n] = torch.tensor(int(np.asarray(b.data)),
+                                 dtype=torch.int64)
+        else:
+            t = torch.from_numpy(np.asarray(b.data, np.float32))
+            sd[n] = t.to(dtype) if dtype is not None else t
+    return sd
+
+
+def _trained_stats_resnet(seed=3):
+    """A resnet18 whose BN running stats are NOT the init zeros/ones
+    (one train-mode forward), so stat loading is actually exercised."""
+    import apex_tpu.nn as nn
+    from apex_tpu.models import resnet18
+
+    nn.manual_seed(seed)
+    m = resnet18(num_classes=10, small_input=True)
+    rng = np.random.default_rng(seed)
+    m.train()
+    m(jnp.asarray(rng.standard_normal((2, 3, 16, 16)), jnp.float32))
+    m.eval()
+    return m
+
+
+def test_resnet_from_torch_logit_parity(rng):
+    from apex_tpu.models import resnet_from_torch
+
+    src = _trained_stats_resnet()
+    x = jnp.asarray(rng.standard_normal((2, 3, 16, 16)), jnp.float32)
+    want = np.asarray(src(x).value)
+
+    got_model = resnet_from_torch(_torch_resnet_sd(src))
+    assert not got_model.training
+    got = np.asarray(got_model(x).value)
+    np.testing.assert_array_equal(got, want)
+    # running stats and the step counter came through
+    np.testing.assert_array_equal(
+        np.asarray(got_model.bn1.running_mean.data),
+        np.asarray(src.bn1.running_mean.data))
+    assert int(got_model.bn1.num_batches_tracked.data) \
+        == int(src.bn1.num_batches_tracked.data)
+
+
+def test_resnet_from_torch_geometry_inferred():
+    from apex_tpu.models import resnet50, resnet_from_torch
+    import apex_tpu.nn as nn
+
+    nn.manual_seed(0)
+    src = resnet50(num_classes=7)
+    m = resnet_from_torch(_torch_resnet_sd(src))
+    # bottleneck stages [3, 4, 6, 3], 7 classes, imagenet stem
+    assert len(m.layer3) == 6 and hasattr(m.layer1[0], "conv3")
+    assert m.fc.weight.shape == (7, 2048)
+    assert m.conv1.weight.shape[-1] == 7      # 7x7 stem kernel
+
+
+def test_resnet_from_torch_ddp_prefix_and_wrapper(rng):
+    """torch.load of the reference imagenet example's checkpoint format:
+    {'state_dict': {'module.conv1.weight': ...}} loads transparently
+    (reference examples/imagenet/main_amp.py:180-195 resume)."""
+    from apex_tpu.models import resnet_from_torch
+
+    src = _trained_stats_resnet()
+    sd = {"module." + k: v for k, v in _torch_resnet_sd(src).items()}
+    ckpt = {"state_dict": sd, "epoch": 3, "best_prec1": 11.1}
+    m = resnet_from_torch(ckpt)
+    x = jnp.asarray(rng.standard_normal((1, 3, 16, 16)), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(m(x).value),
+                                  np.asarray(src(x).value))
+
+
+def test_resnet_from_torch_bf16_checkpoint(rng):
+    from apex_tpu.models import resnet_from_torch
+
+    src = _trained_stats_resnet()
+    m = resnet_from_torch(_torch_resnet_sd(src, dtype=torch.bfloat16))
+    x = jnp.asarray(rng.standard_normal((1, 3, 16, 16)), jnp.float32)
+    got = np.asarray(m(x).value)
+    want = np.asarray(src(x).value)
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.15)
+
+
+def test_resnet_from_torch_rejects_bad_dicts():
+    from apex_tpu.models import resnet_from_torch
+
+    src = _trained_stats_resnet()
+    sd = _torch_resnet_sd(src)
+    with pytest.raises(ValueError, match="does not look like"):
+        resnet_from_torch({"foo.weight": sd["conv1.weight"]})
+    missing = dict(sd)
+    del missing["layer1.0.bn1.weight"]
+    with pytest.raises(ValueError, match="missing parameter"):
+        resnet_from_torch(missing)
+    extra = dict(sd)
+    extra["layer9.0.conv1.weight"] = sd["conv1.weight"]
+    with pytest.raises(ValueError, match="no slot"):
+        resnet_from_torch(extra)
+    # old checkpoints without num_batches_tracked still load
+    old = {k: v for k, v in sd.items()
+           if not k.endswith("num_batches_tracked")}
+    m = resnet_from_torch(old)
+    assert not m.training

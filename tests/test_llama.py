@@ -538,3 +538,22 @@ def test_sliding_window_generate(rng):
         cur = jnp.concatenate(
             [cur, jnp.argmax(logits[:, -1], axis=-1)[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
+def test_llama_decode_chunk_rejects_out_of_range_t0(rng):
+    """Same bounds contract as GptModel.decode_chunk: a concrete t0
+    whose chunk would clamp the cache write raises instead of silently
+    corrupting prefix KV entries."""
+    import pytest
+    from apex_tpu.models.llama import llama_tiny
+    from apex_tpu.nn.modules import Ctx
+
+    m = llama_tiny()
+    m.eval()
+    toks = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="out of range"):
+        m.decode_chunk(Ctx(), toks, m.init_caches(1, 64), 60)
+    with pytest.raises(ValueError, match="out of range"):
+        m.decode_chunk(Ctx(), toks, m.init_caches(1, 64), -1)
+    logits, _ = m.decode_chunk(Ctx(), toks, m.init_caches(1, 64), 56)
+    assert logits.shape[1] == 8
