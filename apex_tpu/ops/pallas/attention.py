@@ -97,23 +97,35 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(_f32)
-    k = k_ref[0].astype(_f32)
-    v = v_ref[0].astype(_f32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=_f32) * scale
-    if has_bias:
-        s = s + bias_ref[0].astype(_f32)
-    s = _mask_block(s, i, j, bq, bk, causal)
+    def _compute():
+        q = q_ref[0].astype(_f32)
+        k = k_ref[0].astype(_f32)
+        v = v_ref[0].astype(_f32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=_f32) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(_f32)
+        s = _mask_block(s, i, j, bq, bk, causal)
 
-    m_prev = m_scr[...]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
-        p, v, preferred_element_type=_f32)
-    m_scr[...] = m_new
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=_f32)
+        m_scr[...] = m_new
+
+    if causal:
+        # skip k-blocks strictly above the diagonal: every entry is
+        # masked, so the block's contribution is exactly p = 0 — the
+        # update is an arithmetic no-op and the two MXU matmuls are
+        # pure waste (~half the blocks as Sq grows; the reason causal
+        # flash exists).  Numerics are bit-identical to the unskipped
+        # sweep.
+        pl.when(j * bk <= i * bq + bq - 1)(_compute)
+    else:
+        _compute()
 
     @pl.when(j == nk - 1)
     def _fin():
@@ -138,20 +150,27 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
     def _init():
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(_f32)
-    k = k_ref[0].astype(_f32)
-    v = v_ref[0].astype(_f32)
-    do = do_ref[0].astype(_f32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=_f32) * scale
-    if has_bias:
-        s = s + bias_ref[0].astype(_f32)
-    s = _mask_block(s, i, j, bq, bk, causal)
-    p = jnp.exp(s - lse_ref[0])
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=_f32)
-    ds = p * (dp - delta_ref[0])
-    acc_scr[...] += jax.lax.dot(ds, k, preferred_element_type=_f32)
+    def _compute():
+        q = q_ref[0].astype(_f32)
+        k = k_ref[0].astype(_f32)
+        v = v_ref[0].astype(_f32)
+        do = do_ref[0].astype(_f32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=_f32) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(_f32)
+        s = _mask_block(s, i, j, bq, bk, causal)
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=_f32)
+        ds = p * (dp - delta_ref[0])
+        acc_scr[...] += jax.lax.dot(ds, k, preferred_element_type=_f32)
+
+    if causal:
+        # fully-masked block: p = 0 → ds = 0, contributes nothing to dq
+        pl.when(j * bk <= i * bq + bq - 1)(_compute)
+    else:
+        _compute()
 
     @pl.when(j == nk - 1)
     def _fin():
@@ -172,23 +191,32 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    q = q_ref[0].astype(_f32)
-    k = k_ref[0].astype(_f32)
-    v = v_ref[0].astype(_f32)
-    do = do_ref[0].astype(_f32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=_f32) * scale
-    if has_bias:
-        s = s + bias_ref[0].astype(_f32)
-    s = _mask_block(s, i, j, bq, bk, causal)
-    p = jnp.exp(s - lse_ref[0])  # (bq, bk)
-    dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                       preferred_element_type=_f32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=_f32)
-    ds = p * (dp - delta_ref[0])  # (bq, bk)
-    dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                       preferred_element_type=_f32)
+    def _compute():
+        q = q_ref[0].astype(_f32)
+        k = k_ref[0].astype(_f32)
+        v = v_ref[0].astype(_f32)
+        do = do_ref[0].astype(_f32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=_f32) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(_f32)
+        s = _mask_block(s, i, j, bq, bk, causal)
+        p = jnp.exp(s - lse_ref[0])  # (bq, bk)
+        dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                           preferred_element_type=_f32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=_f32)
+        ds = p * (dp - delta_ref[0])  # (bq, bk)
+        dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                           preferred_element_type=_f32)
+
+    if causal:
+        # q-block entirely above the diagonal contributes nothing to
+        # this k-block's dk/dv (every score masked, p = 0) — skip the
+        # four matmuls
+        pl.when(i * bq + bq - 1 >= j * bk)(_compute)
+    else:
+        _compute()
 
     @pl.when(i == nq - 1)
     def _fin():

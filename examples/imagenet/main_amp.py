@@ -108,6 +108,14 @@ def main():
             torch.load(args.load_torch, map_location="cpu",
                        weights_only=True))
         model.train()    # the loader returns eval(); this script trains
+        n_cls = model.fc.weight.shape[0]
+        if n_cls != 1000:
+            # out-of-range labels contribute 0 loss under jit (see
+            # nn/functional.cross_entropy) — a class-count mismatch
+            # would train with silent near-zero loss, so refuse here
+            raise SystemExit(
+                f"--load-torch checkpoint has {n_cls} classes; this "
+                f"script's loaders produce 1000-class ImageNet labels")
         print(f"=> loaded torch weights from {args.load_torch}")
     else:
         model = getattr(models, args.arch)(num_classes=1000)

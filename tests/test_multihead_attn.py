@@ -144,3 +144,37 @@ def test_causal_flag_matches_explicit_time_mask(rng, impl):
         out_m, _ = m_masked(x, attn_mask=jnp.asarray(tri))
     np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_m),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("shape", [(256, 256), (192, 320)])
+def test_flash_causal_block_skip_multi_block(rng, shape, monkeypatch):
+    """The causal block-skip must be exercised across MANY q/k blocks
+    (the default 256/512 blocks make small tests single-block, where
+    skipping never triggers): shrink blocks to 64x64 so the grid has
+    fully-masked, diagonal, and fully-valid blocks, and assert fwd+bwd
+    against the reference — skipped blocks contribute exactly p=0, so
+    agreement must be as tight as the unskipped kernel's."""
+    from apex_tpu.ops.pallas import attention as A
+
+    monkeypatch.setattr(A, "_block_sizes", lambda sq, sk, d: (64, 64))
+    sq, sk = shape
+    q, k, v = _qkv(rng, sq=sq, sk=sk, d=32)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(q, k, v, causal=True)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(
+            attention_reference(q, k, v, None, True, scale)))
+
+    with force_mode("interpret"):
+        out = flash_attention(q, k, v, causal=True)
+        g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    ref = attention_reference(q, k, v, None, True, scale)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    for a, r in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=1e-4)
