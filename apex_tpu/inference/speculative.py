@@ -38,7 +38,7 @@ import jax.numpy as jnp
 
 def speculative_generate(target, draft, prompt_ids, max_new_tokens,
                          k=4, cache_dtype=None, temperature=0.0,
-                         key=None):
+                         key=None, mesh=None):
     """Decode of ``target`` accelerated by ``draft`` proposals.
 
     ``prompt_ids (B, P)`` -> ``(B, P + max_new_tokens)``.
@@ -66,6 +66,15 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
     the exactly-enumerated 2-step marginal of a tiny model).  Re-fed positions under
     lockstep would be RE-sampled, which breaks the guarantee for
     batch > 1 — hence the batch-1 restriction.
+
+    Tensor parallelism: if the target and/or draft was built with
+    ``tp_axis``, pass ``mesh`` (a Mesh carrying the axis/axes) — the
+    whole speculative program runs inside ``shard_map`` with
+    generate()'s TP decode convention (replicated tokens/key,
+    head-sharded caches, replicated logits), so the exactness
+    guarantees hold unchanged; a model without ``tp_axis`` computes
+    replicated inside the same region (the usual big-TP-target /
+    small-replicated-draft serving shape).
     """
     from ..nn.modules import Ctx
 
@@ -92,15 +101,22 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
                 f"speculative_generate needs {name}.{missing[0]} "
                 f"(the GPT/Llama cache protocol: init_caches, "
                 f"decode_step, decode_chunk, prefill)")
-        if getattr(m, "tp_axis", None) is not None:
-            # generate() grew a mesh= path; this driver still builds a
-            # plain jit — without this guard a tp model would die on an
-            # unbound-axis error deep inside tracing
-            raise NotImplementedError(
-                f"speculative_generate does not run under tensor "
-                f"parallelism yet — {name} was built with tp_axis="
-                f"'{m.tp_axis}'; use generate(..., mesh=...) for TP "
-                f"decode or build the {name} without tp_axis")
+        ax = getattr(m, "tp_axis", None)
+        if ax is not None and mesh is None:
+            raise ValueError(
+                f"{name} was built with tp_axis='{ax}': speculative "
+                f"decode runs inside shard_map — pass "
+                f"speculative_generate(..., mesh=<Mesh with '{ax}'>)")
+        if ax is not None and mesh is not None \
+                and ax not in mesh.axis_names:
+            raise ValueError(
+                f"mesh axes {mesh.axis_names} do not include {name}'s "
+                f"tp_axis '{ax}'")
+    if mesh is not None and getattr(target, "tp_axis", None) is None \
+            and getattr(draft, "tp_axis", None) is None:
+        raise ValueError(
+            "mesh was passed but neither target nor draft has a "
+            "tp_axis — single-shard speculative decode needs no mesh")
     b, p = prompt_ids.shape
     if p < 1:
         raise ValueError("prompt must hold at least one token")
@@ -278,11 +294,24 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
         cache = target._spec_jit_cache = {}
     cfg = (id(draft), b, p, max_new_tokens, k, float(temperature),
            None if cache_dtype is None else jnp.dtype(cache_dtype).name,
+           mesh,
            tuple(id(o) for o in t_params), tuple(id(o) for o in d_params))
     entry = cache.pop(cfg, None)    # pop + reinsert = LRU refresh
     if entry is None:
         while len(cache) >= 8:
             cache.pop(next(iter(cache)))
-        entry = ((t_params, d_params), jax.jit(run))
+        if mesh is not None:
+            # whole program replicated in/out, exactly generate()'s TP
+            # convention: the tp model(s) slice their head blocks at
+            # trace time, row-parallel psums leave every logit
+            # replicated, and an unsharded counterpart model simply
+            # computes replicated inside the same region
+            from jax.sharding import PartitionSpec as _P
+            fn = jax.jit(jax.shard_map(
+                run, mesh=mesh, in_specs=(_P(), _P(), _P(), _P()),
+                out_specs=_P(), check_vma=False))
+        else:
+            fn = jax.jit(run)
+        entry = ((t_params, d_params), fn)
     cache[cfg] = entry
     return entry[1](t_vals, d_vals, prompt_ids, key)

@@ -83,6 +83,15 @@ def _mask_block(s, i, j, bq, bk, causal):
     return jnp.where(rows >= cols, s, _NEG)
 
 
+def _block_has_unmasked(i, j, bq, bk):
+    """Block-granular mirror of ``_mask_block``'s ``rows >= cols``: true
+    iff q-block ``i`` x k-block ``j`` holds at least one unmasked entry
+    (max row >= min col).  The kernels skip compute on fully-masked
+    blocks — this predicate and ``_mask_block`` must stay in lockstep if
+    the mask convention ever changes."""
+    return j * bk <= i * bq + bq - 1
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
                 has_bias):
     if has_bias:
@@ -123,7 +132,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
         # pure waste (~half the blocks as Sq grows; the reason causal
         # flash exists).  Numerics are bit-identical to the unskipped
         # sweep.
-        pl.when(j * bk <= i * bq + bq - 1)(_compute)
+        pl.when(_block_has_unmasked(i, j, bq, bk))(_compute)
     else:
         _compute()
 
@@ -168,7 +177,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
     if causal:
         # fully-masked block: p = 0 → ds = 0, contributes nothing to dq
-        pl.when(j * bk <= i * bq + bq - 1)(_compute)
+        pl.when(_block_has_unmasked(i, j, bq, bk))(_compute)
     else:
         _compute()
 
@@ -214,7 +223,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         # q-block entirely above the diagonal contributes nothing to
         # this k-block's dk/dv (every score masked, p = 0) — skip the
         # four matmuls
-        pl.when(i * bq + bq - 1 >= j * bk)(_compute)
+        pl.when(_block_has_unmasked(i, j, bq, bk))(_compute)
     else:
         _compute()
 

@@ -159,8 +159,8 @@ def test_tp_generate_requires_mesh(rng):
 
 
 def test_tp_decode_loud_guards(rng):
-    """The paths that cannot run TP yet refuse with clear messages
-    instead of unbound-axis trace errors."""
+    """The paths that cannot run TP as called refuse with clear
+    messages instead of unbound-axis trace errors."""
     from apex_tpu.inference import speculative_generate
 
     m_tp = _llama(tp_axis="tp")
@@ -172,14 +172,60 @@ def test_tp_decode_loud_guards(rng):
     g_tp.eval()
     with pytest.raises(ValueError, match="inside shard_map"):
         g_tp.init_caches(1, 16)
-    # speculative decoding has no mesh path yet
+    # speculative decoding with a tp model needs the mesh
     draft = _llama()
     draft.eval()
     prompt = jnp.zeros((1, 4), jnp.int32)
-    with pytest.raises(NotImplementedError, match="tensor "):
+    with pytest.raises(ValueError, match="mesh"):
         speculative_generate(m_tp, draft, prompt, 4)
-    with pytest.raises(NotImplementedError, match="tensor "):
+    with pytest.raises(ValueError, match="mesh"):
         speculative_generate(draft, m_tp, prompt, 4)
+    with pytest.raises(ValueError, match="needs no mesh"):
+        speculative_generate(draft, _llama(), prompt, 4, mesh=_mesh(2))
+
+
+def test_tp_speculative_greedy_exact(rng):
+    """The greedy exactness guarantee survives TP: a TP target with a
+    replicated draft (the serving shape) emits the same tokens as the
+    single-shard target's own generate."""
+    from apex_tpu.inference import speculative_generate
+
+    m_ref = _llama()
+    m_ref.eval()
+    m_tp = _llama(tp_axis="tp")
+    m_tp.eval()
+    _sync_params(m_ref, m_tp)
+    nn.manual_seed(99)
+    draft = LlamaModel(vocab_size=V, hidden=16, layers=1, heads=2,
+                       max_positions=64)
+    draft.eval()
+
+    prompt = jnp.asarray(rng.integers(0, V, (1, 5)))
+    want = np.asarray(generate(m_ref, prompt, 12))
+    got = np.asarray(speculative_generate(m_tp, draft, prompt, 12, k=3,
+                                          mesh=_mesh(2)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_speculative_sampled_runs(rng):
+    """Sampled (Leviathan) speculative decode under TP compiles and
+    emits in-range tokens (distribution exactness is established
+    single-shard in tests/test_speculative.py; TP logits are the same
+    values psum-assembled)."""
+    from apex_tpu.inference import speculative_generate
+
+    m_tp = _llama(tp_axis="tp")
+    m_tp.eval()
+    nn.manual_seed(98)
+    draft = LlamaModel(vocab_size=V, hidden=16, layers=1, heads=2,
+                       max_positions=64)
+    draft.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 4)))
+    out = np.asarray(speculative_generate(
+        m_tp, draft, prompt, 8, k=2, temperature=0.7,
+        key=jax.random.PRNGKey(3), mesh=_mesh(2)))
+    assert out.shape == (1, 12)
+    assert ((out >= 0) & (out < V)).all()
 
 
 def test_tp_decode_int8_quantized(rng):
