@@ -164,7 +164,7 @@ def transformer_seq2seq(**kw):
 
 def seq2seq_generate(model: TransformerSeq2Seq, src_ids, max_new_tokens,
                      bos_id=0, src_attention_mask=None, temperature=0.0,
-                     top_k=None, key=None):
+                     top_k=None, key=None, mesh=None):
     """Decoding: encode the source once, then extend the target one token
     per step.  The decoder runs over a fixed-size padded target buffer
     every step (causal attention makes positions > t inert), so the whole
@@ -176,11 +176,27 @@ def seq2seq_generate(model: TransformerSeq2Seq, src_ids, max_new_tokens,
     the same sampling surface as ``gpt.generate``.  ``src_ids (B, S_src)``
     → ``(B, max_new_tokens)`` generated ids (BOS not included).  Compiled
     programs are cached per model + shapes + sampling config.
+
+    A model built with ``tp_axis`` needs ``mesh`` (the gpt.generate TP
+    convention): the whole encode+decode program runs inside shard_map
+    with everything replicated except the trace-time head/FFN block
+    slices the decoder layers already perform — logits come out
+    psum-replicated, so the emitted tokens match the single-shard
+    decode of the same weights.
     """
-    if model.tp_axis is not None:
-        raise NotImplementedError(
-            "seq2seq_generate is single-shard; build the model without "
-            "tp_axis for inference")
+    if model.tp_axis is not None and mesh is None:
+        raise ValueError(
+            f"model was built with tp_axis='{model.tp_axis}': decode "
+            f"runs inside shard_map — pass seq2seq_generate(..., "
+            f"mesh=<Mesh with '{model.tp_axis}'>)")
+    if mesh is not None and model.tp_axis is None:
+        raise ValueError(
+            "mesh was passed but the model has no tp_axis — single-"
+            "shard decode needs no mesh")
+    if mesh is not None and model.tp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh axes {mesh.axis_names} do not include the model's "
+            f"tp_axis '{model.tp_axis}'")
     import jax
 
     from ..nn.modules import Ctx
@@ -250,12 +266,27 @@ def seq2seq_generate(model: TransformerSeq2Seq, src_ids, max_new_tokens,
                                     jnp.arange(max_new_tokens))
         return jnp.swapaxes(toks, 0, 1)
 
+    # parameter-object ids in the key + refs in the entry + LRU cap:
+    # the gpt.generate cache convention — a stale hit would zip the
+    # closure's old param list against new vals (LoRA apply/merge swaps
+    # Parameters) and silently decode from wrong weights
     cache = getattr(model, "_s2s_gen_cache", None)
     if cache is None:
         cache = model._s2s_gen_cache = {}
     cfg = (b, src_ids.shape[1], max_new_tokens, int(bos_id),
-           src_attention_mask is not None, float(temperature), top_k)
-    jitted = cache.get(cfg)
-    if jitted is None:
-        jitted = cache[cfg] = jax.jit(run)
-    return jitted(vals, src_ids, src_attention_mask, key)
+           src_attention_mask is not None, float(temperature), top_k,
+           mesh, tuple(id(o) for o in params + buffers))
+    entry = cache.pop(cfg, None)    # pop + reinsert = LRU refresh
+    if entry is None:
+        while len(cache) >= 16:
+            cache.pop(next(iter(cache)))
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as _P
+            fn = jax.jit(jax.shard_map(
+                run, mesh=mesh, in_specs=(_P(), _P(), _P(), _P()),
+                out_specs=_P(), check_vma=False))
+        else:
+            fn = jax.jit(run)
+        entry = (params + buffers, fn)
+    cache[cfg] = entry
+    return entry[1](vals, src_ids, src_attention_mask, key)

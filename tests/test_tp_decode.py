@@ -262,3 +262,58 @@ def test_tp_decode_sliding_window(rng):
     want = np.asarray(generate(m_ref, prompt, 12))
     got = np.asarray(generate(m_tp, prompt, 12, mesh=_mesh(2)))
     np.testing.assert_array_equal(got, want)
+
+
+def test_seq2seq_tp_decode_matches_single_shard(rng):
+    """The encoder-decoder family decodes under TP too: its layers
+    already shard in forward, so the shard_map-wrapped generate must
+    reproduce the single-shard tokens."""
+    from apex_tpu.models.seq2seq import (TransformerSeq2Seq,
+                                         seq2seq_generate)
+
+    def build(**kw):
+        nn.manual_seed(17)
+        return TransformerSeq2Seq(vocab_size=V, hidden=32, enc_layers=1,
+                                  dec_layers=1, heads=4,
+                                  max_positions=32, dropout=0.0,
+                                  attn_dropout=0.0, **kw)
+
+    m_ref = build()
+    m_ref.eval()
+    m_tp = build(tp_axis="tp")
+    m_tp.eval()
+    _sync_params(m_ref, m_tp)
+    src = jnp.asarray(rng.integers(1, V, (2, 6)))
+    want = np.asarray(seq2seq_generate(m_ref, src, 8))
+    got = np.asarray(seq2seq_generate(m_tp, src, 8, mesh=_mesh(2)))
+    np.testing.assert_array_equal(got, want)
+    # guards
+    with pytest.raises(ValueError, match="mesh"):
+        seq2seq_generate(m_tp, src, 4)
+    with pytest.raises(ValueError, match="no tp_axis"):
+        seq2seq_generate(m_ref, src, 4, mesh=_mesh(2))
+
+
+def test_seq2seq_generate_cache_misses_on_param_swap(rng):
+    """Swapping the model's Parameter set (the LoRA apply/merge shape)
+    must miss the compiled-run cache — a stale hit would zip the old
+    closure params against new values and decode from wrong weights."""
+    from apex_tpu.models.seq2seq import (TransformerSeq2Seq,
+                                         seq2seq_generate)
+    from apex_tpu.nn.parameter import Parameter
+
+    nn.manual_seed(21)
+    m = TransformerSeq2Seq(vocab_size=V, hidden=32, enc_layers=1,
+                           dec_layers=1, heads=4, max_positions=32,
+                           dropout=0.0, attn_dropout=0.0)
+    m.eval()
+    src = jnp.asarray(rng.integers(1, V, (1, 5)))
+    out1 = np.asarray(seq2seq_generate(m, src, 6))
+    # replace the embedding Parameter OBJECT with shuffled rows: same
+    # shapes, different identity and values
+    perm = np.asarray(rng.permutation(V))
+    m.tok_emb.weight = Parameter(
+        jnp.asarray(np.asarray(m.tok_emb.weight.data)[perm]))
+    out2 = np.asarray(seq2seq_generate(m, src, 6))
+    assert not np.array_equal(out1, out2), \
+        "stale cache entry decoded with the old parameter set"
