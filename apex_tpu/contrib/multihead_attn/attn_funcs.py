@@ -60,8 +60,10 @@ def _use_xla_attention(b, h, sq, sk):
         b * h * sq * sk * 4 <= _XLA_SCORES_BYTE_CAP
 
 
-def attention_reference(q4, k4, v4, bias, causal, scale):
-    """Plain-XLA attention, (B, H, S, D) layout; the fallback/oracle path."""
+def attention_reference(q4, k4, v4, bias, causal, scale, window=None):
+    """Plain-XLA attention, (B, H, S, D) layout; the fallback/oracle
+    path.  ``window`` adds the Mistral band on top of ``causal``
+    (position t sees keys in (t - window, t])."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q4.astype(_f32),
                    k4.astype(_f32)) * scale
     if bias is not None:
@@ -70,18 +72,22 @@ def attention_reference(q4, k4, v4, bias, causal, scale):
         sq, sk = s.shape[-2], s.shape[-1]
         rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(rows >= cols, s, _NEG)
+        keep = rows >= cols
+        if window is not None:
+            keep = jnp.logical_and(keep, cols > rows - window)
+        s = jnp.where(keep, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v4.astype(_f32)).astype(q4.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q4, k4, v4, bias, causal, scale, interpret):
-    out, _ = _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret)
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q4, k4, v4, bias, causal, scale, interpret, window):
+    out, _ = _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret,
+                             window)
     return out
 
 
-def _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret):
+def _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret, window):
     b, h, sq, d = q4.shape
     sk = k4.shape[2]
     q3 = q4.reshape(b * h, sq, d)
@@ -93,21 +99,22 @@ def _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret):
         # repeating per head in the leading dim when per-batch
         bias3 = bias if bias.shape[0] == 1 else jnp.repeat(bias, h, axis=0)
     out3, lse = _k.flash_attention_fwd(q3, k3, v3, bias3, scale, causal,
-                                       interpret=interpret)
+                                       interpret=interpret, window=window)
     return out3.reshape(b, h, sq, d), (q3, k3, v3, bias3, out3, lse)
 
 
-def _flash_vjp_fwd(q4, k4, v4, bias, causal, scale, interpret):
-    out, res = _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret)
+def _flash_vjp_fwd(q4, k4, v4, bias, causal, scale, interpret, window):
+    out, res = _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret,
+                               window)
     return out, (res, q4.shape, k4.shape, bias)
 
 
-def _flash_vjp_bwd(causal, scale, interpret, saved, g):
+def _flash_vjp_bwd(causal, scale, interpret, window, saved, g):
     (q3, k3, v3, bias3, out3, lse), qshape, kshape, bias = saved
     b, h, sq, d = qshape
     dq, dk, dv = _k.flash_attention_bwd(
         q3, k3, v3, bias3, out3, lse, g.reshape(b * h, sq, d), scale, causal,
-        interpret=interpret)
+        interpret=interpret, window=window)
     dbias = None if bias is None else jnp.zeros_like(bias)
     return (dq.reshape(qshape), dk.reshape(kshape), dv.reshape(kshape),
             dbias)
@@ -116,13 +123,25 @@ def _flash_vjp_bwd(causal, scale, interpret, saved, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q4, k4, v4, bias=None, causal=False, scale=None):
+def flash_attention(q4, k4, v4, bias=None, causal=False, scale=None,
+                    sliding_window=None):
     """Fused scaled-dot-product attention, (B, H, S, D) layout.
 
     ``bias`` is an additive mask, broadcastable (B|1, Sq|1, Sk) — carries
     key-padding and attention masks; ``causal`` masks future timesteps
-    in-kernel.  Gradients flow to q/k/v only (masks are data).
+    in-kernel.  ``sliding_window`` (requires ``causal``) applies the
+    Mistral band — position t sees keys in (t - window, t] — with
+    fully-out-of-band blocks skipped in-kernel, so banded attention
+    costs O(S·window).  Gradients flow to q/k/v only (masks are data).
     """
+    if sliding_window is not None:
+        if not causal:
+            raise ValueError(
+                "sliding_window requires causal=True (the band is "
+                "defined against the causal direction)")
+        if sliding_window < 1:
+            raise ValueError(
+                f"sliding_window must be >= 1, got {sliding_window}")
     if scale is None:
         scale = 1.0 / math.sqrt(q4.shape[-1])
     mode = pallas_mode()
@@ -134,8 +153,10 @@ def flash_attention(q4, k4, v4, bias=None, causal=False, scale=None):
                                                q4.shape[2], k4.shape[2])):
         if bias is not None:
             bias = jax.lax.stop_gradient(bias)
-        return attention_reference(q4, k4, v4, bias, causal, scale)
-    return _flash(q4, k4, v4, bias, causal, scale, mode == "interpret")
+        return attention_reference(q4, k4, v4, bias, causal, scale,
+                                   window=sliding_window)
+    return _flash(q4, k4, v4, bias, causal, scale, mode == "interpret",
+                  sliding_window)
 
 
 # ---------------------------------------------------------------------------

@@ -280,26 +280,14 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
             cond, body, (ids, m0, t_caches, d_caches, key))
         return ids[:, :s_total]
 
-    # bounded compile cache: each entry's closure pins its draft module
-    # (and XLA executable), so evict oldest beyond a small working set —
-    # a loop trying many drafts against one target must not accumulate
-    # them all for the target's lifetime.  Parameter-object tuples are
-    # part of the key for the same reason as generate()'s cache: the
-    # cached run zips its closure's param lists with the caller's vals,
-    # so swapping either model's parameter set (LoRA apply/merge) must
-    # miss; the entry holds the refs so ids cannot recycle into false
-    # hits.
-    cache = getattr(target, "_spec_jit_cache", None)
-    if cache is None:
-        cache = target._spec_jit_cache = {}
-    cfg = (id(draft), b, p, max_new_tokens, k, float(temperature),
-           None if cache_dtype is None else jnp.dtype(cache_dtype).name,
-           mesh,
-           tuple(id(o) for o in t_params), tuple(id(o) for o in d_params))
-    entry = cache.pop(cfg, None)    # pop + reinsert = LRU refresh
-    if entry is None:
-        while len(cache) >= 8:
-            cache.pop(next(iter(cache)))
+    # per-model compiled-run cache (see utils/jit_cache.py for the
+    # parameter-identity/LRU invariants); each entry's closure pins its
+    # draft module and XLA executable, so the cap (8: spec programs are
+    # large) keeps a loop trying many drafts against one target from
+    # accumulating them all for the target's lifetime
+    from ..utils.jit_cache import compiled_run_cache
+
+    def build():
         if mesh is not None:
             # whole program replicated in/out, exactly generate()'s TP
             # convention: the tp model(s) slice their head blocks at
@@ -307,11 +295,15 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
             # replicated, and an unsharded counterpart model simply
             # computes replicated inside the same region
             from jax.sharding import PartitionSpec as _P
-            fn = jax.jit(jax.shard_map(
+            return jax.jit(jax.shard_map(
                 run, mesh=mesh, in_specs=(_P(), _P(), _P(), _P()),
                 out_specs=_P(), check_vma=False))
-        else:
-            fn = jax.jit(run)
-        entry = ((t_params, d_params), fn)
-    cache[cfg] = entry
-    return entry[1](t_vals, d_vals, prompt_ids, key)
+        return jax.jit(run)
+
+    fn = compiled_run_cache(
+        target, "_spec_jit_cache",
+        (id(draft), b, p, max_new_tokens, k, float(temperature),
+         None if cache_dtype is None else jnp.dtype(cache_dtype).name,
+         mesh),
+        t_params + d_params, build, cap=8)
+    return fn(t_vals, d_vals, prompt_ids, key)

@@ -671,38 +671,26 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
         return jnp.concatenate(
             [prompt_padded[:, :1], jnp.swapaxes(toks, 0, 1)], axis=1)
 
-    # jit caches by function identity: memoize the compiled run per
-    # model instance + config so repeated generate() calls reuse it.
-    # The parameter-OBJECT tuple is part of the key: `run`'s env zips
-    # the closure's params with the caller's vals, so a cached entry is
-    # only valid while the model's parameter set is the one it closed
-    # over — applying/removing LoRA or otherwise swapping Parameters
-    # must miss the cache (a stale hit misaligns the zip and reads the
-    # wrong weights).  Entries hold the param refs, so ids cannot be
-    # recycled into false hits; the cache is capped to keep dead
-    # parameter sets from accumulating.
-    cache = getattr(model, "_generate_jit_cache", None)
-    if cache is None:
-        cache = model._generate_jit_cache = {}
-    cfg = (b, p, max_new_tokens, float(temperature), top_k,
-           jnp.dtype(cache_dtype).name, mesh,
-           tuple(id(o) for o in params + buffers))
-    entry = cache.pop(cfg, None)    # pop + reinsert = LRU refresh
-    if entry is None:
-        while len(cache) >= 16:
-            cache.pop(next(iter(cache)))
+    # per-model compiled-run cache (see utils/jit_cache.py for the
+    # parameter-identity/LRU invariants — LoRA apply/merge must miss)
+    from ..utils.jit_cache import compiled_run_cache
+
+    def build():
         if mesh is not None:
             # everything replicated in and out; the TP sharding lives in
             # the trace-time head-block slices inside the blocks
             from jax.sharding import PartitionSpec as _P
-            fn = jax.jit(jax.shard_map(
+            return jax.jit(jax.shard_map(
                 run, mesh=mesh, in_specs=(_P(), _P(), _P()),
                 out_specs=_P(), check_vma=False))
-        else:
-            fn = jax.jit(run)
-        entry = (params + buffers, fn)
-    cache[cfg] = entry
-    return entry[1](vals, prompt_padded, key)
+        return jax.jit(run)
+
+    fn = compiled_run_cache(
+        model, "_generate_jit_cache",
+        (b, p, max_new_tokens, float(temperature), top_k,
+         jnp.dtype(cache_dtype).name, mesh),
+        params + buffers, build)
+    return fn(vals, prompt_padded, key)
 
 
 def gpt2_small(**kw):

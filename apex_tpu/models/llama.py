@@ -66,10 +66,10 @@ class LlamaBlock(nn.Module):
                  _dense_ffn=True):
         super().__init__()
         # sliding_window: Mistral-style banded causal attention —
-        # position t sees keys in (t - window, t].  The cached decode
-        # paths band-mask exactly; the full-sequence forward/prefill
-        # are exact while S <= window (causal == banded there) and the
-        # MODEL refuses longer (docs/models.md)
+        # position t sees keys in (t - window, t].  Exact EVERYWHERE:
+        # the cached decode paths band-mask their scores, and the
+        # full-sequence forward/prefill ride the banded flash kernel
+        # (out-of-band blocks skipped, O(S·window) compute)
         self.sliding_window = sliding_window
         # sp_axis: ring sequence parallelism — the sequence dim is
         # sharded over this mesh axis and attention runs the ring
@@ -169,7 +169,11 @@ class LlamaBlock(nn.Module):
                 rep = q.shape[1] // k.shape[1]
                 k = jnp.repeat(k, rep, axis=1)
                 v = jnp.repeat(v, rep, axis=1)
-            o = flash_attention(q, k, v, causal=True)  # (B, H_loc, S, D)
+            # the Mistral band rides the kernel (banded blocks skipped:
+            # O(S·window) compute), so the full-sequence forward is
+            # exact at ANY length              (B, H_loc, S, D)
+            o = flash_attention(q, k, v, causal=True,
+                                sliding_window=self.sliding_window)
         o = jnp.swapaxes(o, 1, 2).reshape(b, s, q.shape[1] * self.head_dim)
         return self._mlp_tail(ctx, x, o)
 
@@ -255,7 +259,8 @@ class LlamaBlock(nn.Module):
         if rep > 1:
             k_new = jnp.repeat(k_new, rep, axis=1)
             v_new = jnp.repeat(v_new, rep, axis=1)
-        o = flash_attention(q, k_new, v_new, causal=True)
+        o = flash_attention(q, k_new, v_new, causal=True,
+                            sliding_window=self.sliding_window)
         o = jnp.swapaxes(o, 1, 2).reshape(b, s_c,
                                           q.shape[1] * self.head_dim)
         return self._mlp_tail(ctx, x, o), kcache, vcache
@@ -410,8 +415,8 @@ class LlamaModel(nn.Module):
         # KV shards) and a data axis, exactly as the GPT family.
         self.sp_axis = sp_axis
         # sliding_window: Mistral-style banded causal attention (see
-        # LlamaBlock); the cached decode paths are banded exactly, the
-        # full-sequence forward refuses S > window
+        # LlamaBlock); exact in the cached decode paths AND the
+        # full-sequence forward/prefill (banded flash kernel)
         self.sliding_window = sliding_window
         if sliding_window is not None:
             if sliding_window < 1:
@@ -491,14 +496,6 @@ class LlamaModel(nn.Module):
                     f"sequence length {s} exceeds max_positions "
                     f"{self.max_positions}")
             pos = jnp.arange(s, dtype=jnp.int32)
-        if self.sliding_window is not None and s > self.sliding_window:
-            raise ValueError(
-                f"sequence length {s} exceeds sliding_window "
-                f"{self.sliding_window}: the full-sequence forward runs "
-                f"causal attention, which equals banded attention only "
-                f"within one window — use the cached decode paths "
-                f"(decode_chunk applies the band exactly) or shorter "
-                f"sequences")
         cos, sin = rope_tables(pos, head_dim, self.rope_theta)
         x = self.tok_emb.forward(ctx, input_ids)
         for blk in self.blocks:
@@ -576,13 +573,9 @@ class LlamaModel(nn.Module):
         ``(logits (B, S_p, V), new_caches)``.  O(1) calls instead of
         ``S_p`` decode steps, with no (S_p, S_max) score tensor (the
         caches are empty, so the chunk attends only itself).  Under
-        ``sliding_window`` a prompt longer than one window routes
-        through :meth:`decode_chunk`, whose mask is banded exactly (at
-        its (S_p, S_max) score cost)."""
+        ``sliding_window`` the kernel applies the band exactly at any
+        prompt length (banded blocks skipped, O(S·window))."""
         self._decode_guard("prefill")
-        if self.sliding_window is not None \
-                and toks.shape[1] > self.sliding_window:
-            return self.decode_chunk(ctx, toks, caches, jnp.int32(0))
         return self._run_blocks(
             ctx, toks, caches,
             lambda blk, x, kc, vc: blk.prefill(ctx, x, kc, vc))

@@ -266,27 +266,22 @@ def seq2seq_generate(model: TransformerSeq2Seq, src_ids, max_new_tokens,
                                     jnp.arange(max_new_tokens))
         return jnp.swapaxes(toks, 0, 1)
 
-    # parameter-object ids in the key + refs in the entry + LRU cap:
-    # the gpt.generate cache convention — a stale hit would zip the
-    # closure's old param list against new vals (LoRA apply/merge swaps
-    # Parameters) and silently decode from wrong weights
-    cache = getattr(model, "_s2s_gen_cache", None)
-    if cache is None:
-        cache = model._s2s_gen_cache = {}
-    cfg = (b, src_ids.shape[1], max_new_tokens, int(bos_id),
-           src_attention_mask is not None, float(temperature), top_k,
-           mesh, tuple(id(o) for o in params + buffers))
-    entry = cache.pop(cfg, None)    # pop + reinsert = LRU refresh
-    if entry is None:
-        while len(cache) >= 16:
-            cache.pop(next(iter(cache)))
+    # per-model compiled-run cache (see utils/jit_cache.py for the
+    # parameter-identity/LRU invariants — LoRA apply/merge must miss)
+    from ..utils.jit_cache import compiled_run_cache
+
+    def build():
         if mesh is not None:
             from jax.sharding import PartitionSpec as _P
-            fn = jax.jit(jax.shard_map(
+            return jax.jit(jax.shard_map(
                 run, mesh=mesh, in_specs=(_P(), _P(), _P(), _P()),
                 out_specs=_P(), check_vma=False))
-        else:
-            fn = jax.jit(run)
-        entry = (params + buffers, fn)
-    cache[cfg] = entry
-    return entry[1](vals, src_ids, src_attention_mask, key)
+        return jax.jit(run)
+
+    fn = compiled_run_cache(
+        model, "_s2s_gen_cache",
+        (b, src_ids.shape[1], max_new_tokens, int(bos_id),
+         src_attention_mask is not None, float(temperature), top_k,
+         mesh),
+        params + buffers, build)
+    return fn(vals, src_ids, src_attention_mask, key)

@@ -75,25 +75,36 @@ def _round8(x):
     return max(8, (x + 7) // 8 * 8)
 
 
-def _mask_block(s, i, j, bq, bk, causal):
+def _mask_block(s, i, j, bq, bk, causal, window=None):
+    """Causal (``rows >= cols``) and, with ``window``, Mistral-banded
+    (``cols > rows - window``) masking of one score block."""
     if not causal:
         return s
     rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(rows >= cols, s, _NEG)
+    keep = rows >= cols
+    if window is not None:
+        keep = jnp.logical_and(keep, cols > rows - window)
+    return jnp.where(keep, s, _NEG)
 
 
-def _block_has_unmasked(i, j, bq, bk):
-    """Block-granular mirror of ``_mask_block``'s ``rows >= cols``: true
-    iff q-block ``i`` x k-block ``j`` holds at least one unmasked entry
-    (max row >= min col).  The kernels skip compute on fully-masked
-    blocks — this predicate and ``_mask_block`` must stay in lockstep if
-    the mask convention ever changes."""
-    return j * bk <= i * bq + bq - 1
+def _block_has_unmasked(i, j, bq, bk, window=None):
+    """Block-granular mirror of ``_mask_block``: true iff q-block ``i``
+    x k-block ``j`` holds at least one unmasked entry — above-diagonal
+    blocks fail the causal edge (max row >= min col), and with
+    ``window`` blocks entirely BELOW the band fail the band edge
+    (max col > min row - window).  The kernels skip compute on
+    fully-masked blocks — banded attention therefore costs
+    O(S·window), not O(S²).  This predicate and ``_mask_block`` must
+    stay in lockstep if the mask convention ever changes."""
+    ok = j * bk <= i * bq + bq - 1
+    if window is not None:
+        ok = jnp.logical_and(ok, j * bk + bk - 1 > i * bq - window)
+    return ok
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
-                has_bias):
+                has_bias, window=None):
     if has_bias:
         bias_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     else:
@@ -114,7 +125,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
                                 preferred_element_type=_f32) * scale
         if has_bias:
             s = s + bias_ref[0].astype(_f32)
-        s = _mask_block(s, i, j, bq, bk, causal)
+        s = _mask_block(s, i, j, bq, bk, causal, window)
 
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -132,7 +143,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
         # pure waste (~half the blocks as Sq grows; the reason causal
         # flash exists).  Numerics are bit-identical to the unskipped
         # sweep.
-        pl.when(_block_has_unmasked(i, j, bq, bk))(_compute)
+        pl.when(_block_has_unmasked(i, j, bq, bk, window))(_compute)
     else:
         _compute()
 
@@ -148,7 +159,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-               scale, causal, bq, bk, nk, has_bias):
+               scale, causal, bq, bk, nk, has_bias, window=None):
     if has_bias:
         bias_ref, dq_ref, acc_scr = refs
     else:
@@ -168,7 +179,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                                 preferred_element_type=_f32) * scale
         if has_bias:
             s = s + bias_ref[0].astype(_f32)
-        s = _mask_block(s, i, j, bq, bk, causal)
+        s = _mask_block(s, i, j, bq, bk, causal, window)
         p = jnp.exp(s - lse_ref[0])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=_f32)
@@ -177,7 +188,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
     if causal:
         # fully-masked block: p = 0 → ds = 0, contributes nothing to dq
-        pl.when(_block_has_unmasked(i, j, bq, bk))(_compute)
+        pl.when(_block_has_unmasked(i, j, bq, bk, window))(_compute)
     else:
         _compute()
 
@@ -187,7 +198,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-                scale, causal, bq, bk, nq, has_bias):
+                scale, causal, bq, bk, nq, has_bias, window=None):
     if has_bias:
         bias_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
     else:
@@ -209,7 +220,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
                                 preferred_element_type=_f32) * scale
         if has_bias:
             s = s + bias_ref[0].astype(_f32)
-        s = _mask_block(s, i, j, bq, bk, causal)
+        s = _mask_block(s, i, j, bq, bk, causal, window)
         p = jnp.exp(s - lse_ref[0])  # (bq, bk)
         dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                            preferred_element_type=_f32)
@@ -223,7 +234,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         # q-block entirely above the diagonal contributes nothing to
         # this k-block's dk/dv (every score masked, p = 0) — skip the
         # four matmuls
-        pl.when(_block_has_unmasked(i, j, bq, bk))(_compute)
+        pl.when(_block_has_unmasked(i, j, bq, bk, window))(_compute)
     else:
         _compute()
 
@@ -244,7 +255,8 @@ def _bias_spec(bias, bq, bk, for_dkv=False):
     return pl.BlockSpec((1, bq if sq_ > 1 else 1, bk), idx)
 
 
-def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False):
+def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False,
+                        window=None):
     """q3 (BH, Sq, D), k3/v3 (BH, Sk, D), bias (B|1, Sq|1, Sk) or None.
     Returns (out (BH, Sq, D), lse (BH, Sq) fp32)."""
     bh, sq, d = q3.shape
@@ -278,7 +290,8 @@ def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False):
         args.append(bias)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq,
-                          bk=bk, nk=nk, has_bias=has_bias),
+                          bk=bk, nk=nk, has_bias=has_bias,
+                          window=window),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -300,7 +313,7 @@ def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False):
 
 
 def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
-                        interpret=False):
+                        interpret=False, window=None):
     """→ (dq, dk, dv) with the shapes/dtypes of q3/k3/v3."""
     bh, sq, d = q3.shape
     sk = k3.shape[1]
@@ -339,7 +352,8 @@ def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
         args.append(bias)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq,
-                          bk=bk, nk=nk, has_bias=has_bias),
+                          bk=bk, nk=nk, has_bias=has_bias,
+                          window=window),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -359,7 +373,8 @@ def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
         args2.append(bias)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq,
-                          bk=bk, nq=nq, has_bias=has_bias),
+                          bk=bk, nq=nq, has_bias=has_bias,
+                          window=window),
         grid=(bh, nk, nq),
         in_specs=in_specs2,
         out_specs=[

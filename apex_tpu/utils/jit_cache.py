@@ -1,0 +1,42 @@
+"""Per-model compiled-run cache shared by the decode entry points
+(models/gpt.py generate, models/seq2seq.py seq2seq_generate,
+inference/speculative.py speculative_generate).
+
+The invariants, in one place so the three callers cannot drift:
+
+* the PARAMETER-OBJECT id tuple is part of the key — each compiled
+  ``run`` closure zips ITS parameter list against the caller's values,
+  so an entry is only valid while the model's parameter set is the one
+  it closed over.  Applying/removing LoRA (or any Parameter swap) must
+  MISS: a stale hit misaligns the zip and silently reads wrong weights.
+* each entry pins the parameter objects it keyed on, so ids cannot be
+  recycled into false hits while the entry lives.
+* pop + reinsert on hit = LRU; the cache is capped so dead parameter
+  sets (and their pinned XLA executables) cannot accumulate for the
+  model's lifetime.
+"""
+from __future__ import annotations
+
+
+def compiled_run_cache(model, attr, cfg, pinned_objs, build_fn, cap=16):
+    """Return the compiled callable for ``cfg``, building it with
+    ``build_fn()`` on a miss.
+
+    ``attr``: name of the dict attribute holding the cache on ``model``;
+    ``cfg``: hashable config EXCLUDING the parameter ids (appended
+    here); ``pinned_objs``: the Parameter/Buffer objects the compiled
+    closure zips against — their ids join the key and the entry holds
+    the refs; ``cap``: max entries (oldest evicted first).
+    """
+    cache = getattr(model, attr, None)
+    if cache is None:
+        cache = {}
+        setattr(model, attr, cache)
+    key = (*cfg, tuple(id(o) for o in pinned_objs))
+    entry = cache.pop(key, None)    # pop + reinsert = LRU refresh
+    if entry is None:
+        while len(cache) >= cap:
+            cache.pop(next(iter(cache)))
+        entry = (list(pinned_objs), build_fn())
+    cache[key] = entry
+    return entry[1]

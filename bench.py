@@ -496,6 +496,11 @@ def run_kernel_timing(iters=30):
     # --- flash attention, VMEM-guard shapes, fwd+bwd ---
     for b_, h, s, d, causal, dtype in [
             (8, 12, 256, 64, True, jnp.bfloat16),
+            # S=512 sits exactly on the shape-aware dispatch threshold
+            # (attn_funcs: keys < 512 -> XLA): this row decides whether
+            # the boundary is placed right now that causal block-skip
+            # landed
+            (8, 12, 512, 64, True, jnp.bfloat16),
             (4, 12, 1024, 64, True, jnp.bfloat16),
             (1, 8, 2048, 128, True, jnp.bfloat16),
             (4, 12, 1024, 64, False, jnp.bfloat16)]:
@@ -512,6 +517,25 @@ def run_kernel_timing(iters=30):
         _ab(build, (q, k, v),
             f"B{b_}_H{h}_S{s}_D{d}{'_causal' if causal else ''}"
             f"_{jnp.dtype(dtype).name}", "attention")
+
+    # --- banded (Mistral sliding-window) attention: the kernel skips
+    # fully-out-of-band blocks, so the claim to verify is O(S*window)
+    # vs the XLA arm's O(S^2) materialized banded scores ---
+    for b_, h, s, d, w, dtype in [(4, 12, 2048, 64, 256, jnp.bfloat16)]:
+        q = jnp.asarray(rng.standard_normal((b_, h, s, d)), dtype)
+        k = jnp.asarray(rng.standard_normal((b_, h, s, d)), dtype)
+        v = jnp.asarray(rng.standard_normal((b_, h, s, d)), dtype)
+
+        def build(w=w):
+            def loss(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, causal=True,
+                                    sliding_window=w)
+                    .astype(jnp.float32) ** 2)
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        _ab(build, (q, k, v),
+            f"B{b_}_H{h}_S{s}_D{d}_w{w}_{jnp.dtype(dtype).name}",
+            "attention")
 
     ups = [r["speedup"] for bkt in ("layer_norm", "rms_norm", "attention")
            for r in results[bkt].values() if r.get("speedup")]

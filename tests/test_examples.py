@@ -169,3 +169,23 @@ def test_llama_lora_example_runs():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "merged: decode identical" in out.stdout
     assert "trainable:" in out.stdout
+
+
+def test_llama_tp_serve_example_runs():
+    """TP serving demo: sharded greedy decode bit-identical to
+    single-shard, int8 under TP, and TP-target speculative decoding —
+    the script itself asserts all three."""
+    env = dict(os.environ, PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    script = os.path.join(REPO, "examples", "llama", "main_tp_serve.py")
+    code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.argv = ['main_tp_serve.py', '--tp', '2', "
+            f"'--new-tokens', '12', '--hidden', '64', '--layers', '2']; "
+            f"import runpy; runpy.run_path({script!r}, "
+            f"run_name='__main__')")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "bit-identical to single-shard: True" in out.stdout
+    assert "exact match with tp int8 decode: True" in out.stdout

@@ -500,11 +500,11 @@ def test_sliding_window_decode_matches_mistral(rng):
 
     model = llama_from_hf(hf)
     assert model.sliding_window == 8
-    # 13 > window: the full-sequence forward refuses (it would run
-    # causal, not banded, attention)...
-    with pytest.raises(ValueError, match="sliding_window"):
-        model(jnp.asarray(ids))
-    # ...and the banded cached path scores it exactly
+    # 13 > window: the full-sequence forward applies the band exactly
+    # (the banded flash path — formerly this refused)
+    got_fwd = np.asarray(model(jnp.asarray(ids)).value)
+    np.testing.assert_allclose(got_fwd, want, rtol=3e-4, atol=3e-4)
+    # the banded cached path scores it exactly too
     ctx = Ctx(training=False)
     got, _ = model.decode_chunk(ctx, jnp.asarray(ids),
                                 model.init_caches(2, 16), jnp.int32(0))
@@ -557,3 +557,30 @@ def test_llama_decode_chunk_rejects_out_of_range_t0(rng):
         m.decode_chunk(Ctx(), toks, m.init_caches(1, 64), -1)
     logits, _ = m.decode_chunk(Ctx(), toks, m.init_caches(1, 64), 56)
     assert logits.shape[1] == 8
+
+
+def test_sliding_window_training_forward_multi_window(rng):
+    """Training forward at S spanning MANY windows: fwd logits match
+    the banded decode_chunk oracle, and grads are finite — the config
+    that previously refused (training a Mistral-shape model at its
+    real context length is the point of the banded kernel)."""
+    from apex_tpu.models.llama import llama_tiny
+    from apex_tpu.nn.modules import Ctx
+
+    nn.manual_seed(3)
+    m = llama_tiny(sliding_window=8, max_positions=64)
+    m.eval()
+    ids = jnp.asarray(rng.integers(0, 1000, (2, 40)))
+    got = np.asarray(m(ids).value)
+    want, _ = m.decode_chunk(Ctx(), ids, m.init_caches(2, 48),
+                             jnp.int32(0))
+    np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4,
+                               atol=2e-4)
+    # gradient flow through the banded path
+    m.train()
+    logits = m(ids)
+    labels = jnp.asarray(rng.integers(0, 1000, (2 * 40,)))
+    loss = nn.CrossEntropyLoss()(logits.reshape((-1, 1000)), labels)
+    loss.backward()
+    assert all(p.grad is None or np.isfinite(np.asarray(p.grad)).all()
+               for p in m.parameters())

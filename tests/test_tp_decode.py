@@ -317,3 +317,18 @@ def test_seq2seq_generate_cache_misses_on_param_swap(rng):
     out2 = np.asarray(seq2seq_generate(m, src, 6))
     assert not np.array_equal(out1, out2), \
         "stale cache entry decoded with the old parameter set"
+
+
+def test_gpt_tp_vocab_decode_matches_single_shard(rng):
+    """tp_vocab shards the tied table for TRAINING logits; decode reads
+    the full replicated table (sampling needs all-vocab argmax), so a
+    vocab-parallel model must still decode to the single-shard tokens."""
+    m_ref = _gpt()
+    m_ref.eval()
+    m_tp = _gpt(tp_axis="tp", tp_vocab=True)
+    m_tp.eval()
+    _sync_params(m_ref, m_tp)
+    prompt = jnp.asarray(rng.integers(0, V, (1, 5)))
+    want = np.asarray(generate(m_ref, prompt, 8))
+    got = np.asarray(generate(m_tp, prompt, 8, mesh=_mesh(2)))
+    np.testing.assert_array_equal(got, want)
