@@ -138,3 +138,64 @@ def gather_rows(ctx, param, ids):
         rows = v.q[ids].astype(v.scale.dtype)
         return rows * v.scale[ids]
     return v[ids]
+
+
+# ---------------------------------------------------------------- KV cache
+
+
+class QuantKV(NamedTuple):
+    """Int8 KV cache: values ``(B, H, S, D)`` int8 with one fp scale per
+    cached position ``(B, H, S, 1)``.  Decode at long context is
+    cache-traffic-bound — every step re-reads the whole cache — so int8
+    halves that traffic the way weight-only int8 halves weight reads.
+    Quantization is per-position absmax (exact at write time: each
+    position is written once and never rewritten), so the error bound
+    matches :func:`quantize_tensor_int8`'s per-row bound.  A NamedTuple
+    of arrays: traverses jit/scan/shard_map like any pytree."""
+    q: jax.Array          # int8 (B, H, S, D)
+    scale: jax.Array      # fp  (B, H, S, 1)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.scale.dtype
+
+
+def make_kv_cache(shape, dtype):
+    """Zeros cache of ``shape (B, H, S, D)``: a plain array for a fp
+    ``dtype``, a :class:`QuantKV` for int8 — either the string
+    ``"int8"`` or ``jnp.int8``, normalized so both spellings build the
+    quantized cache (a RAW int8 cache would truncating-cast float K/V
+    to garbage; there is no sane meaning for it).  Scales are fp32 —
+    1/D of the int8 bytes, negligible traffic."""
+    if jnp.dtype(dtype) == jnp.dtype("int8"):
+        return QuantKV(jnp.zeros(shape, jnp.int8),
+                       jnp.zeros(shape[:-1] + (1,), jnp.float32))
+    return jnp.zeros(shape, dtype)
+
+
+def kv_write(cache, new, start):
+    """Write ``new (..., S_c, D)`` into the cache at index tuple
+    ``start`` (4-d).  Plain caches cast-and-update; QuantKV quantizes
+    each written position against its own absmax."""
+    if isinstance(cache, QuantKV):
+        nf = new.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(nf), axis=-1, keepdims=True)
+        scale = jnp.maximum(absmax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(nf / scale), -127, 127).astype(jnp.int8)
+        return QuantKV(
+            jax.lax.dynamic_update_slice(cache.q, q, start),
+            jax.lax.dynamic_update_slice(cache.scale, scale, start))
+    return jax.lax.dynamic_update_slice(cache, new.astype(cache.dtype),
+                                        start)
+
+
+def kv_value(cache, dtype=jnp.float32):
+    """Read the cache as ``dtype`` (QuantKV dequantizes; XLA fuses the
+    int8→fp multiply into the consuming attention matmul)."""
+    if isinstance(cache, QuantKV):
+        return cache.q.astype(dtype) * cache.scale.astype(dtype)
+    return cache.astype(dtype)

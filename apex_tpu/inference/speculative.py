@@ -303,7 +303,9 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
     fn = compiled_run_cache(
         target, "_spec_jit_cache",
         (id(draft), b, p, max_new_tokens, k, float(temperature),
-         None if cache_dtype is None else jnp.dtype(cache_dtype).name,
+         None if cache_dtype is None
+         else cache_dtype if isinstance(cache_dtype, str)
+         else jnp.dtype(cache_dtype).name,
          mesh),
         t_params + d_params, build, cap=8)
     return fn(t_vals, d_vals, prompt_ids, key)

@@ -148,11 +148,10 @@ class GptBlock(nn.Module):
         a whole prompt instead of S_p decode steps."""
         b, s_c, _ = x.shape
         d = self.attn.head_dim
+        from ..inference.quant import kv_write
         q, k_new, v_new = self._chunk_qkv(ctx, x)     # H is LOCAL under tp
-        kcache = jax.lax.dynamic_update_slice(
-            kcache, k_new.astype(kcache.dtype), (0, 0, 0, 0))
-        vcache = jax.lax.dynamic_update_slice(
-            vcache, v_new.astype(vcache.dtype), (0, 0, 0, 0))
+        kcache = kv_write(kcache, k_new, (0, 0, 0, 0))
+        vcache = kv_write(vcache, v_new, (0, 0, 0, 0))
         from ..contrib.multihead_attn.attn_funcs import flash_attention
         o = flash_attention(q, k_new, v_new, causal=True,
                             scale=self.attn.scaling)
@@ -168,20 +167,19 @@ class GptBlock(nn.Module):
         d = attn.head_dim
         b, s_c, _ = x.shape
         pos = t0 + jnp.arange(s_c, dtype=jnp.int32)
+        from ..inference.quant import kv_value, kv_write
         q, k_new, v_new = self._chunk_qkv(ctx, x)     # H is LOCAL under tp
-        kcache = jax.lax.dynamic_update_slice(
-            kcache, k_new.astype(kcache.dtype), (0, 0, t0, 0))
-        vcache = jax.lax.dynamic_update_slice(
-            vcache, v_new.astype(vcache.dtype), (0, 0, t0, 0))
+        kcache = kv_write(kcache, k_new, (0, 0, t0, 0))
+        vcache = kv_write(vcache, v_new, (0, 0, t0, 0))
         s_max = kcache.shape[2]
         scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
-                            kcache.astype(jnp.float32)) * attn.scaling
+                            kv_value(kcache)) * attn.scaling
         # cache slots beyond each position are unwritten (or stale)
         valid = jnp.arange(s_max)[None, :] <= pos[:, None]
         scores = jnp.where(valid[None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bhqs,bhsd->bhqd", probs,
-                       vcache.astype(jnp.float32)).astype(x.dtype)
+                       kv_value(vcache)).astype(x.dtype)
         o = jnp.swapaxes(o, 1, 2).reshape(b, s_c, q.shape[1] * d)
         return self._attn_mlp_tail(ctx, x, o), kcache, vcache
 
@@ -462,8 +460,9 @@ class GptModel(nn.Module):
                     f"init_caches: heads ({h}) must divide by the "
                     f"'{self.tp_axis}' axis size ({n})")
             h //= n
-        return [(jnp.zeros((batch, h, s_max, d), dtype),
-                 jnp.zeros((batch, h, s_max, d), dtype))
+        from ..inference.quant import make_kv_cache
+        return [(make_kv_cache((batch, h, s_max, d), dtype),
+                 make_kv_cache((batch, h, s_max, d), dtype))
                 for _ in self.blocks]
 
     def _decode_guard(self, what):
@@ -559,7 +558,10 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     ``prompt_ids (B, P)``; returns ``(B, P + max_new_tokens)``.
     ``temperature=0`` is greedy; ``top_k`` restricts sampling;
     ``cache_dtype`` defaults to the token-embedding dtype (use
-    ``jnp.bfloat16`` to halve cache HBM for fp32 checkpoints).  The
+    ``jnp.bfloat16`` to halve cache HBM for fp32 checkpoints, or the
+    string ``"int8"`` for a quantized KV cache — per-position absmax,
+    half of bf16's traffic again; long-context decode re-reads the
+    whole cache every token, so cache bytes are the lever there).  The
     reference has no inference path (it is a training-side library); this
     is the decode half of the GPT family.
 
@@ -688,7 +690,8 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     fn = compiled_run_cache(
         model, "_generate_jit_cache",
         (b, p, max_new_tokens, float(temperature), top_k,
-         jnp.dtype(cache_dtype).name, mesh),
+         cache_dtype if isinstance(cache_dtype, str)
+         else jnp.dtype(cache_dtype).name, mesh),
         params + buffers, build)
     return fn(vals, prompt_padded, key)
 

@@ -247,12 +247,11 @@ class LlamaBlock(nn.Module):
         whole-cache attention is for SHORT chunks against a long cache —
         on a prompt it would materialize (S_p, S_max) scores per head."""
         b, s_c, _ = x.shape
+        from ..inference.quant import kv_write
         q, k_new, v_new = self._chunk_qkv(
             ctx, x, jnp.arange(s_c, dtype=jnp.int32))
-        kcache = jax.lax.dynamic_update_slice(
-            kcache, k_new.astype(kcache.dtype), (0, 0, 0, 0))
-        vcache = jax.lax.dynamic_update_slice(
-            vcache, v_new.astype(vcache.dtype), (0, 0, 0, 0))
+        kcache = kv_write(kcache, k_new, (0, 0, 0, 0))
+        vcache = kv_write(vcache, v_new, (0, 0, 0, 0))
         # LOCAL head counts (== global ones single-shard; both divide by
         # the axis size under tp, so the GQA ratio is shard-invariant)
         rep = q.shape[1] // k_new.shape[1]
@@ -280,16 +279,15 @@ class LlamaBlock(nn.Module):
         q, k_new, v_new = self._chunk_qkv(ctx, x, pos)
         # LOCAL head counts: under tp_axis the caches are KVH/n-wide and
         # q carries H/n heads (the GQA group ratio is shard-invariant)
+        from ..inference.quant import kv_value, kv_write
         h_loc, kvh = q.shape[1], k_new.shape[1]
-        kcache = jax.lax.dynamic_update_slice(
-            kcache, k_new.astype(kcache.dtype), (0, 0, t0, 0))
-        vcache = jax.lax.dynamic_update_slice(
-            vcache, v_new.astype(vcache.dtype), (0, 0, t0, 0))
+        kcache = kv_write(kcache, k_new, (0, 0, t0, 0))
+        vcache = kv_write(vcache, v_new, (0, 0, t0, 0))
         s_max = kcache.shape[2]
         group = h_loc // kvh
         qg = q.reshape(b, kvh, group, s_c, d)
         scores = jnp.einsum("bkgqd,bksd->bkgqs", qg.astype(jnp.float32),
-                            kcache.astype(jnp.float32)) * (d ** -0.5)
+                            kv_value(kcache)) * (d ** -0.5)
         valid = jnp.arange(s_max)[None, :] <= pos[:, None]   # (S_c, S_max)
         if self.sliding_window is not None:
             # banded: key j visible from position t iff t-w < j <= t
@@ -298,7 +296,7 @@ class LlamaBlock(nn.Module):
         scores = jnp.where(valid[None, None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bkgqs,bksd->bkgqd", probs,
-                       vcache.astype(jnp.float32)).astype(x.dtype)
+                       kv_value(vcache)).astype(x.dtype)
         o = jnp.swapaxes(o.reshape(b, h_loc, s_c, d), 1, 2) \
             .reshape(b, s_c, h_loc * d)
         return self._mlp_tail(ctx, x, o), kcache, vcache
@@ -527,10 +525,11 @@ class LlamaModel(nn.Module):
                 raise ValueError(
                     f"init_caches: kv_heads must divide by the "
                     f"'{self.tp_axis}' axis size ({n})")
-        return [(jnp.zeros((batch, blk.kv_heads // n, s_max,
-                            blk.head_dim), dtype),
-                 jnp.zeros((batch, blk.kv_heads // n, s_max,
-                            blk.head_dim), dtype))
+        from ..inference.quant import make_kv_cache
+        return [(make_kv_cache((batch, blk.kv_heads // n, s_max,
+                                blk.head_dim), dtype),
+                 make_kv_cache((batch, blk.kv_heads // n, s_max,
+                                blk.head_dim), dtype))
                 for blk in self.blocks]
 
     def tp_sharded_params(self):

@@ -31,6 +31,7 @@ QUEUE=(
   "timeout 700 python bench.py --gpt-decode --no-kernels"
   "timeout 700 python bench.py --gpt-decode --int8 --no-kernels"
   "timeout 900 python bench.py --spec-decode --no-kernels --budget-s 840"
+  "timeout 700 python bench.py --gpt-decode --int8 --kv-int8 --no-kernels"
   "timeout 700 python bench.py --seq2seq --no-kernels"
   "timeout 900 python bench.py --kernels-timing --budget-s 840"
   # intermediate long-seq datapoint (flash engages at 512 under the

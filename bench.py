@@ -920,11 +920,14 @@ def run_spec_decode_throughput(batch, seq_len, new_tokens=128, k=4,
     return toks / dt_spec, toks / dt_plain, compile_s
 
 
-def run_decode_throughput(batch, seq_len, new_tokens=128, int8=False):
+def run_decode_throughput(batch, seq_len, new_tokens=128, int8=False,
+                          kv_int8=False):
     """Greedy KV-cache decode tokens/s (gpt2-small): one warm compiled
     call timed via value fetch.  ``int8=True`` quantizes the weight
     matrices (weight-only w8a16, inference/quant.py) first — decode is
-    HBM-bound, so halved weight bytes should show as tokens/s."""
+    HBM-bound, so halved weight bytes should show as tokens/s;
+    ``kv_int8=True`` additionally quantizes the KV cache
+    (cache_dtype="int8"), the long-context traffic lever."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -933,7 +936,7 @@ def run_decode_throughput(batch, seq_len, new_tokens=128, int8=False):
     from apex_tpu.models import generate, gpt2_small
 
     stage("model_build", f"gpt2_small decode batch={batch}"
-          + (" int8" if int8 else ""))
+          + (" int8" if int8 else "") + (" kv-int8" if kv_int8 else ""))
     nn.manual_seed(0)
     model = gpt2_small(max_positions=seq_len + new_tokens,
                        attn_dropout=0.0, dropout=0.0)
@@ -944,9 +947,10 @@ def run_decode_throughput(batch, seq_len, new_tokens=128, int8=False):
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, 50257, (batch, seq_len)))
 
+    cache_dtype = "int8" if kv_int8 else None
     stage("compile", f"decode scan over {seq_len + new_tokens} positions")
     tc = time.perf_counter()
-    out = generate(model, prompt, new_tokens)
+    out = generate(model, prompt, new_tokens, cache_dtype=cache_dtype)
     int(jnp.sum(out))                       # fetch = sync
     compile_s = time.perf_counter() - tc
     log(f"compiled in {compile_s:.1f}s")
@@ -954,7 +958,7 @@ def run_decode_throughput(batch, seq_len, new_tokens=128, int8=False):
     stage("timing", "3 decode calls")
     t0 = time.perf_counter()
     for _ in range(3):
-        out = generate(model, prompt, new_tokens)
+        out = generate(model, prompt, new_tokens, cache_dtype=cache_dtype)
         int(jnp.sum(out))
     dt = (time.perf_counter() - t0) / 3
     toks_per_sec = batch * new_tokens / dt
@@ -1142,6 +1146,10 @@ def main():
     ap.add_argument("--int8", action="store_true",
                     help="with --gpt-decode: weight-only int8 "
                          "quantization (w8a16) before decoding")
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="with --gpt-decode: int8 KV cache "
+                         "(cache_dtype='int8') — the long-context "
+                         "cache-traffic lever")
     ap.add_argument("--spec-decode", action="store_true",
                     help="speculative vs plain greedy decode on the "
                          "llama config (draft-verified, output exact)")
@@ -1193,6 +1201,7 @@ def main():
                     "tokens/sec/chip")
         if args.gpt_decode:
             q = "_int8" if args.int8 else ""
+            q += "_kvint8" if args.kv_int8 else ""
             return (f"gpt2_small_greedy_decode{q}_tokens_per_sec_per_chip",
                     "tokens/sec/chip")
         if args.bert:
@@ -1225,9 +1234,9 @@ def main():
 
     # validate cheap config errors BEFORE spending the backend-init
     # budget on the tunnel (and emit the promised diagnostic JSON line)
-    if args.int8 and not args.gpt_decode:
-        fail("int8_unsupported_config: --int8 is the weight-only "
-             "quantized DECODE measurement; pair it with --gpt-decode")
+    if (args.int8 or args.kv_int8) and not args.gpt_decode:
+        fail("int8_unsupported_config: --int8/--kv-int8 are quantized "
+             "DECODE measurements; pair them with --gpt-decode")
         return 1
     if args.profile and (args.seq2seq or args.gpt_decode or args.vit
                          or args.llama or args.dcgan):
@@ -1321,7 +1330,8 @@ def main():
         batch = args.batch or 8
         try:
             toks, dt, compile_s = run_decode_throughput(
-                batch, args.seq_len, int8=args.int8)
+                batch, args.seq_len, int8=args.int8,
+                kv_int8=args.kv_int8)
         except Exception as e:
             fail(f"decode_failed: {type(e).__name__}: {e}")
             return 1

@@ -34,6 +34,7 @@ run 16 --gpt --seq-len 1024
 run 8 --gpt --seq-len 2048 --remat
 run --gpt-decode
 run --gpt-decode --int8
+run --gpt-decode --int8 --kv-int8
 run --spec-decode
 run --seq2seq
 run --dcgan

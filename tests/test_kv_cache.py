@@ -1,0 +1,148 @@
+"""Int8 KV cache (inference/quant.py QuantKV + cache_dtype="int8"):
+per-position quantization bounds, decode-logit closeness on both LM
+families, the speculative exactness guarantee over quantized caches,
+and TP decode parity.  Long-context decode re-reads the whole cache
+every token, so cache bytes are the traffic lever — same rationale as
+weight-only int8 (the reference has no inference path, SURVEY.md §2)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import apex_tpu.nn as nn
+from apex_tpu.inference import (QuantKV, kv_value, kv_write,
+                                make_kv_cache, speculative_generate)
+from apex_tpu.models import GptModel, generate
+from apex_tpu.models.llama import LlamaModel
+from apex_tpu.nn.modules import Ctx
+
+V = 97
+
+
+def _llama(**kw):
+    nn.manual_seed(7)
+    return LlamaModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                      kv_heads=2, max_positions=64, **kw)
+
+
+def _gpt(**kw):
+    nn.manual_seed(7)
+    return GptModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                    max_positions=64, dropout=0.0, attn_dropout=0.0, **kw)
+
+
+def test_kv_roundtrip_bound(rng):
+    """Each written position quantizes against its own absmax: error
+    <= absmax/254 per position (the quantize_tensor_int8 bound)."""
+    cache = make_kv_cache((2, 4, 16, 8), "int8")
+    assert isinstance(cache, QuantKV)
+    new = jnp.asarray(rng.standard_normal((2, 4, 5, 8)), jnp.float32)
+    cache = kv_write(cache, new, (0, 0, 3, 0))
+    back = np.asarray(kv_value(cache))[:, :, 3:8]
+    want = np.asarray(new)
+    bound = np.abs(want).max(axis=-1, keepdims=True) / 254 + 1e-7
+    assert (np.abs(back - want) <= bound).all()
+    # unwritten slots stay zero
+    assert (np.asarray(kv_value(cache))[:, :, :3] == 0).all()
+
+
+def test_kv_plain_cache_passthrough(rng):
+    """The helpers are transparent for plain caches (the default
+    path's behavior is unchanged)."""
+    cache = make_kv_cache((1, 2, 8, 4), jnp.bfloat16)
+    assert cache.dtype == jnp.bfloat16
+    new = jnp.asarray(rng.standard_normal((1, 2, 3, 4)), jnp.float32)
+    cache = kv_write(cache, new, (0, 0, 0, 0))
+    np.testing.assert_allclose(np.asarray(kv_value(cache))[:, :, :3],
+                               np.asarray(new), rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_int8_cache_decode_close(rng, family):
+    """Quantized-cache correctness has two parts: (1) BOUNDED error —
+    prefill logits over an int8 cache stay close to the fp32-cache
+    logits; (2) SELF-CONSISTENCY — teacher-forced per-token decode over
+    the int8 cache reproduces decode_chunk's logits (both read the
+    QUANTIZED entries; prefill intentionally attends the fresh
+    full-precision K/V and is slightly more accurate).
+    Token-trajectory equality vs the fp cache is deliberately NOT
+    asserted: tiny random models have near-tie argmax margins
+    comparable to the quantization error, so one early flip cascades —
+    real checkpoints have far larger margins."""
+    m = (_gpt() if family == "gpt" else _llama())
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (2, 6)))
+    ctx = Ctx(training=False)
+    # (1) bounded error vs the fp32 cache
+    l8, _ = m.prefill(ctx, prompt, m.init_caches(2, 32, dtype="int8"))
+    lf, _ = m.prefill(ctx, prompt, m.init_caches(2, 32))
+    np.testing.assert_allclose(np.asarray(l8), np.asarray(lf),
+                               rtol=0.1, atol=0.1)
+    # (2) chunked == stepped within the quantized numerics
+    want, _ = m.decode_chunk(ctx, prompt,
+                             m.init_caches(2, 32, dtype="int8"),
+                             jnp.int32(0))
+    caches = m.init_caches(2, 32, dtype="int8")
+    got = []
+    for t in range(6):
+        logits, caches = m.decode_step(ctx, prompt[:, t], caches,
+                                       jnp.asarray(t))
+        got.append(np.asarray(logits))
+    np.testing.assert_allclose(np.stack(got, axis=1), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    # and generate() runs end-to-end over the int8 cache
+    out = np.asarray(generate(m, prompt, 16, cache_dtype="int8"))
+    assert out.shape == (2, 22)
+    assert ((out >= 0) & (out < V)).all()
+
+
+def test_int8_cache_speculative_exact(rng):
+    """The greedy exactness guarantee is cache-dtype-invariant: the
+    target scores drafts through the SAME quantized cache numerics its
+    own decode uses, so speculative == generate holds bit-for-bit at
+    cache_dtype="int8" too."""
+    m = _llama()
+    m.eval()
+    nn.manual_seed(91)
+    draft = LlamaModel(vocab_size=V, hidden=16, layers=1, heads=2,
+                       max_positions=64)
+    draft.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 5)))
+    want = np.asarray(generate(m, prompt, 12, cache_dtype="int8"))
+    got = np.asarray(speculative_generate(m, draft, prompt, 12, k=3,
+                                          cache_dtype="int8"))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_int8_cache_tp_decode_matches_single_shard(rng):
+    """TP decode with int8 caches: each device quantizes its own head
+    shard's writes — identical values quantize identically, so the TP
+    tokens still match the single-shard int8-cache decode exactly."""
+    m_ref = _llama()
+    m_ref.eval()
+    m_tp = _llama(tp_axis="tp")
+    m_tp.eval()
+    for ps, pd in zip(m_ref.parameters(), m_tp.parameters()):
+        pd.data = ps.data
+    mesh = Mesh(np.array(jax.devices())[:2].reshape(2), ("tp",))
+    prompt = jnp.asarray(rng.integers(0, V, (1, 5)))
+    want = np.asarray(generate(m_ref, prompt, 10, cache_dtype="int8"))
+    got = np.asarray(generate(m_tp, prompt, 10, cache_dtype="int8",
+                              mesh=mesh))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_kv_int8_spelling_normalized(rng):
+    """cache_dtype=jnp.int8 and "int8" build the SAME quantized cache
+    (a raw int8 cache would truncate float K/V to garbage; the jit
+    cache also keys both spellings identically, so they must agree)."""
+    c1 = make_kv_cache((1, 2, 4, 8), "int8")
+    c2 = make_kv_cache((1, 2, 4, 8), jnp.int8)
+    assert isinstance(c1, QuantKV) and isinstance(c2, QuantKV)
+    m = _llama()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 4)))
+    a = np.asarray(generate(m, prompt, 6, cache_dtype="int8"))
+    b = np.asarray(generate(m, prompt, 6, cache_dtype=jnp.int8))
+    np.testing.assert_array_equal(a, b)
