@@ -62,6 +62,19 @@ class QuantTensor(NamedTuple):
         return self.q.astype(self.scale.dtype) * self.scale
 
 
+def _absmax_int8(xf, axis, scale_dtype):
+    """The symmetric-absmax int8 core shared by weight and KV-cache
+    quantization: ``xf`` fp32, reduce over ``axis``.  The scale is cast
+    to ``scale_dtype`` BEFORE rounding — quantization and
+    dequantization must use the identical stored scale value, or the
+    round-trip error bound silently grows by the cast's rounding."""
+    absmax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
+    scale = (jnp.maximum(absmax, 1e-12) / 127.0).astype(scale_dtype)
+    q = jnp.clip(jnp.round(xf / scale.astype(jnp.float32)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def quantize_tensor_int8(x, dtype=None):
     """Absmax-per-row symmetric int8: ``x (rows, ...)`` -> QuantTensor
     with one scale per leading row (for a torch-layout ``(out, in)``
@@ -72,15 +85,8 @@ def quantize_tensor_int8(x, dtype=None):
         raise ValueError(
             f"quantize_tensor_int8 expects a >=2-D weight, got shape "
             f"{x.shape} — 1-D params (norms/biases) stay full precision")
-    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)),
-                     axis=tuple(range(1, x.ndim)), keepdims=True)
-    # round against the scale AS STORED (post-cast): quantization and
-    # dequantization must use the identical scale value, or the
-    # round-trip error bound silently grows by the cast's rounding
-    scale = (jnp.maximum(absmax, 1e-12) / 127.0).astype(dtype or x.dtype)
-    q = jnp.clip(jnp.round(x.astype(jnp.float32)
-                           / scale.astype(jnp.float32)), -127, 127) \
-        .astype(jnp.int8)
+    q, scale = _absmax_int8(x.astype(jnp.float32),
+                            tuple(range(1, x.ndim)), dtype or x.dtype)
     return QuantTensor(q, scale)
 
 
@@ -182,10 +188,8 @@ def kv_write(cache, new, start):
     ``start`` (4-d).  Plain caches cast-and-update; QuantKV quantizes
     each written position against its own absmax."""
     if isinstance(cache, QuantKV):
-        nf = new.astype(jnp.float32)
-        absmax = jnp.max(jnp.abs(nf), axis=-1, keepdims=True)
-        scale = jnp.maximum(absmax, 1e-12) / 127.0
-        q = jnp.clip(jnp.round(nf / scale), -127, 127).astype(jnp.int8)
+        q, scale = _absmax_int8(new.astype(jnp.float32), -1,
+                                cache.scale.dtype)
         return QuantKV(
             jax.lax.dynamic_update_slice(cache.q, q, start),
             jax.lax.dynamic_update_slice(cache.scale, scale, start))

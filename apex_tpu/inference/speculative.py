@@ -101,22 +101,20 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
                 f"speculative_generate needs {name}.{missing[0]} "
                 f"(the GPT/Llama cache protocol: init_caches, "
                 f"decode_step, decode_chunk, prefill)")
-        ax = getattr(m, "tp_axis", None)
-        if ax is not None and mesh is None:
-            raise ValueError(
-                f"{name} was built with tp_axis='{ax}': speculative "
-                f"decode runs inside shard_map — pass "
-                f"speculative_generate(..., mesh=<Mesh with '{ax}'>)")
-        if ax is not None and mesh is not None \
-                and ax not in mesh.axis_names:
-            raise ValueError(
-                f"mesh axes {mesh.axis_names} do not include {name}'s "
-                f"tp_axis '{ax}'")
-    if mesh is not None and getattr(target, "tp_axis", None) is None \
-            and getattr(draft, "tp_axis", None) is None:
+        from ..models.gpt import _check_decode_mesh, _sharded_decode_axes
+        guard = getattr(m, "_decode_guard", None)
+        if guard is not None:
+            # unsupported compositions (GPT MoE, sp) refuse here, not
+            # mid-trace — and before any 'pass mesh=' demand
+            guard(f"speculative_generate ({name})")
+        _check_decode_mesh(m, mesh, what="speculative_generate",
+                           who=name)
+    if mesh is not None and not (_sharded_decode_axes(target)
+                                 or _sharded_decode_axes(draft)):
         raise ValueError(
             "mesh was passed but neither target nor draft has a "
-            "tp_axis — single-shard speculative decode needs no mesh")
+            "tp_axis/moe_axis — single-shard speculative decode needs "
+            "no mesh")
     b, p = prompt_ids.shape
     if p < 1:
         raise ValueError("prompt must hold at least one token")

@@ -545,6 +545,41 @@ class GptModel(nn.Module):
             lambda blk, x, kc, vc: blk.decode(ctx, x, kc, vc, t))
 
 
+def _sharded_decode_axes(model):
+    """The mesh axes a model's decode needs: tp (head-sharded) and/or
+    moe (expert dispatch).  Callers run the model's own ``_decode_guard``
+    FIRST, so a family whose guard refuses an axis (GPT MoE, any sp)
+    never reaches the mesh demands here."""
+    axes = []
+    for attr in ("tp_axis", "moe_axis"):
+        ax = getattr(model, attr, None)
+        if ax is not None:
+            axes.append((attr, ax))
+    return axes
+
+
+def _check_decode_mesh(model, mesh, what="generate", who="model"):
+    """Shared mesh validation for the decode drivers: a model with any
+    sharded decode axis needs a mesh carrying ALL of them; a mesh with
+    nothing to shard is a caller error.  ``who`` names the model in the
+    errors (speculative decoding passes "target"/"draft" so a mismatch
+    says which of its two models to fix).  Call the model's
+    ``_decode_guard`` before this — an unsupported-composition refusal
+    must win over a 'pass mesh=' demand."""
+    axes = _sharded_decode_axes(model)
+    if axes and mesh is None:
+        names = ", ".join(f"{a}='{v}'" for a, v in axes)
+        raise ValueError(
+            f"{who} was built with {names}: decode runs inside "
+            f"shard_map — pass {what}(..., mesh=<Mesh with the axes>)")
+    if mesh is not None:
+        for attr, ax in axes:
+            if ax not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh axes {mesh.axis_names} do not include "
+                    f"{who}'s {attr} '{ax}'")
+
+
 def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
              top_k=None, key=None, cache_dtype=None, mesh=None):
     """Autoregressive sampling with a KV cache: models with the chunk
@@ -598,20 +633,14 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     if top_k is not None and not 1 <= top_k <= vocab:
         raise ValueError(
             f"top_k must be in [1, vocab={vocab}], got {top_k}")
-    tp_axis = getattr(model, "tp_axis", None)
-    if tp_axis is not None and mesh is None:
+    # unsupported-composition refusal (GPT MoE, sp) wins over mesh
+    # demands; then validate the mesh against the sharded axes
+    model._decode_guard("generate")
+    _check_decode_mesh(model, mesh)
+    if mesh is not None and not _sharded_decode_axes(model):
         raise ValueError(
-            f"model was built with tp_axis='{tp_axis}': decode runs "
-            f"inside shard_map — pass generate(..., mesh=<Mesh with "
-            f"'{tp_axis}'>)")
-    if mesh is not None and tp_axis is None:
-        raise ValueError(
-            "mesh was passed but the model has no tp_axis — single-"
-            "shard decode needs no mesh")
-    if mesh is not None and tp_axis not in mesh.axis_names:
-        raise ValueError(
-            f"mesh axes {mesh.axis_names} do not include the model's "
-            f"tp_axis '{tp_axis}'")
+            "mesh was passed but the model has no tp_axis/moe_axis — "
+            "single-shard decode needs no mesh")
 
     params = [q for q in model.parameters()]
     buffers = list(model.buffers())

@@ -542,16 +542,21 @@ class LlamaModel(nn.Module):
             x, ctx.value(self.lm_head.weight).T.astype(x.dtype))
 
     def _decode_guard(self, what):
-        """Cached decode supports single-shard AND tensor-parallel
-        execution (``tp_axis``: run inside shard_map — generate(mesh=...)
-        wraps it; caches shard KV heads, logits come out replicated).
-        Sequence parallelism and MoE stay training-only: the ring
-        protocol has no cached/banded form and expert dispatch has no
-        cache story yet — refuse loudly rather than decode wrongly."""
-        if self.moe_axis is not None or self.sp_axis is not None:
+        """Cached decode supports single-shard, tensor-parallel
+        (``tp_axis``), AND expert-parallel (``moe_axis``) execution —
+        the sharded flavors run inside shard_map (generate(mesh=...)
+        wraps it): TP shards KV heads with psum-replicated logits; MoE
+        keeps caches replicated and routes each decoded chunk's tokens
+        through the expert all_to_all exactly like the training
+        forward (the Mixtral serving path — mixtral_from_hf builds this
+        model).  Sequence parallelism stays training-only: the ring
+        protocol has no cached form — refuse loudly rather than decode
+        wrongly."""
+        if self.sp_axis is not None:
             raise NotImplementedError(
-                f"{what} supports single-shard or tp_axis execution; "
-                f"build the model without sp_axis/moe_axis for inference")
+                f"{what} supports single-shard, tp_axis, or moe_axis "
+                f"execution; build the model without sp_axis for "
+                f"inference")
 
     def _run_blocks(self, ctx, toks, caches, blk_fn):
         """Embed ``toks``, thread the caches through ``blk_fn`` per
