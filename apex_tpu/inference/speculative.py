@@ -67,14 +67,16 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
     lockstep would be RE-sampled, which breaks the guarantee for
     batch > 1 — hence the batch-1 restriction.
 
-    Tensor parallelism: if the target and/or draft was built with
-    ``tp_axis``, pass ``mesh`` (a Mesh carrying the axis/axes) — the
-    whole speculative program runs inside ``shard_map`` with
-    generate()'s TP decode convention (replicated tokens/key,
-    head-sharded caches, replicated logits), so the exactness
-    guarantees hold unchanged; a model without ``tp_axis`` computes
-    replicated inside the same region (the usual big-TP-target /
-    small-replicated-draft serving shape).
+    Sharded decode: if the target and/or draft was built with
+    ``tp_axis`` (head-sharded) or ``moe_axis`` (expert-routed), pass
+    ``mesh`` (a Mesh carrying the axis/axes) — the whole speculative
+    program runs inside ``shard_map`` with generate()'s decode
+    convention (replicated tokens/key; TP shards caches with
+    psum-replicated logits, MoE routes verification chunks through the
+    expert all_to_all), so the exactness guarantees hold unchanged; a
+    model without sharded axes computes replicated inside the same
+    region (the usual big-sharded-target / small-replicated-draft
+    serving shape).
     """
     from ..nn.modules import Ctx
 
@@ -104,7 +106,7 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
         from ..models.gpt import _check_decode_mesh, _sharded_decode_axes
         guard = getattr(m, "_decode_guard", None)
         if guard is not None:
-            # unsupported compositions (GPT MoE, sp) refuse here, not
+            # unsupported compositions (sp) refuse here, not
             # mid-trace — and before any 'pass mesh=' demand
             guard(f"speculative_generate ({name})")
         _check_decode_mesh(m, mesh, what="speculative_generate",

@@ -28,7 +28,7 @@ class GptBlock(nn.Module):
 
     def __init__(self, hidden, heads, intermediate, dropout=0.1,
                  attn_dropout=0.1, sp_axis=None, tp_axis=None,
-                 attn_bias=False):
+                 attn_bias=False, _dense_ffn=True):
         super().__init__()
         self.ln1 = FusedLayerNorm(hidden)
         # causal=True: when the flash path applies (attn_dropout == 0 in
@@ -47,26 +47,37 @@ class GptBlock(nn.Module):
                                       seq_parallel_axis=sp_axis,
                                       tensor_parallel_axis=tp_axis)
         self.ln2 = FusedLayerNorm(hidden)
-        self.fc1 = nn.Linear(hidden, intermediate)
-        self.fc2 = nn.Linear(intermediate, hidden)
+        if _dense_ffn:
+            self.fc1 = nn.Linear(hidden, intermediate)
+            self.fc2 = nn.Linear(intermediate, hidden)
+        else:
+            # MoeGptBlock supplies its own routed FFN (the LlamaBlock
+            # convention): skip drawing dense matrices it would discard
+            self.fc1 = self.fc2 = None
         self.dropout = nn.Dropout(dropout)
         self.tp_axis = tp_axis
 
-    def forward(self, ctx, x):
-        h, _ = self.attn.forward(ctx, self.ln1.forward(ctx, x))
-        x = x + self.dropout.forward(ctx, h)
+    def _ffn(self, ctx, h):
+        """The feed-forward on the LN2 output — one hook for the dense,
+        Megatron-TP, and (in MoeGptBlock) expert-routed variants, shared
+        by the training forward and every cached decode path."""
         if self.tp_axis is not None:
             # Megatron MLP: fc1 column-parallel, gelu on the sharded
             # hidden, fc2 row-parallel — one psum for the pair; weights
             # stay full, the shard slice happens at trace time
             from ..parallel.tensor_parallel import tp_ffn
-            h = tp_ffn(self.ln2.forward(ctx, x),
-                       ctx.value(self.fc1.weight), ctx.value(self.fc1.bias),
-                       ctx.value(self.fc2.weight), ctx.value(self.fc2.bias),
-                       self.tp_axis, activation=F.gelu)
-        else:
-            h = F.gelu(self.fc1.forward(ctx, self.ln2.forward(ctx, x)))
-            h = self.fc2.forward(ctx, h)
+            return tp_ffn(h,
+                          ctx.value(self.fc1.weight),
+                          ctx.value(self.fc1.bias),
+                          ctx.value(self.fc2.weight),
+                          ctx.value(self.fc2.bias),
+                          self.tp_axis, activation=F.gelu)
+        return self.fc2.forward(ctx, F.gelu(self.fc1.forward(ctx, h)))
+
+    def forward(self, ctx, x):
+        h, _ = self.attn.forward(ctx, self.ln1.forward(ctx, x))
+        x = x + self.dropout.forward(ctx, h)
+        h = self._ffn(ctx, self.ln2.forward(ctx, x))
         return x + self.dropout.forward(ctx, h)
 
     def tp_sharded_params(self):
@@ -127,20 +138,15 @@ class GptBlock(nn.Module):
         bo = ctx.value(attn.out_proj_bias) if attn.bias else None
         if self.tp_axis is not None:
             from ..parallel.tensor_parallel import (row_parallel_linear,
-                                                    _shard_cols, tp_ffn)
+                                                    _shard_cols)
             x = x + row_parallel_linear(
                 o, _shard_cols(wo, self.tp_axis), bo, self.tp_axis)
-            return x + tp_ffn(
-                self.ln2.forward(ctx, x),
-                ctx.value(self.fc1.weight), ctx.value(self.fc1.bias),
-                ctx.value(self.fc2.weight), ctx.value(self.fc2.bias),
-                self.tp_axis, activation=F.gelu)
-        o = jnp.matmul(o, wo.T.astype(o.dtype))
-        if attn.bias:
-            o = o + bo.astype(o.dtype)
-        x = x + o
-        hh = F.gelu(self.fc1.forward(ctx, self.ln2.forward(ctx, x)))
-        return x + self.fc2.forward(ctx, hh)
+        else:
+            o = jnp.matmul(o, wo.T.astype(o.dtype))
+            if attn.bias:
+                o = o + bo.astype(o.dtype)
+            x = x + o
+        return x + self._ffn(ctx, self.ln2.forward(ctx, x))
 
     def prefill(self, ctx, x, kcache, vcache):
         """Cache-filling forward from position 0: flash causal attention
@@ -193,7 +199,7 @@ class GptBlock(nn.Module):
         return y[:, 0], kcache, vcache
 
 
-class MoeGptBlock(nn.Module):
+class MoeGptBlock(GptBlock):
     """Pre-LN decoder block with a Switch-MoE feed-forward: LN → causal
     MHA → residual, LN → top-k routed expert FFN → residual.
 
@@ -219,14 +225,9 @@ class MoeGptBlock(nn.Module):
                  dropout=0.1, attn_dropout=0.1, sp_axis=None,
                  moe_axis="data", capacity_factor=1.25, top_k=1,
                  aux_weight=0.01):
-        super().__init__()
         from ..nn.parameter import Parameter
-        self.ln1 = FusedLayerNorm(hidden)
-        self.attn = SelfMultiheadAttn(hidden, heads, dropout=attn_dropout,
-                                      impl="fast", causal=True,
-                                      seq_parallel_axis=sp_axis)
-        self.ln2 = FusedLayerNorm(hidden)
-        self.dropout = nn.Dropout(dropout)
+        super().__init__(hidden, heads, intermediate, dropout,
+                         attn_dropout, sp_axis=sp_axis, _dense_ffn=False)
         self.moe_axis = moe_axis
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
@@ -252,13 +253,15 @@ class MoeGptBlock(nn.Module):
         self.w2 = Parameter(jnp.stack(w2))    # (E, H, I)
         self.b2 = Parameter(jnp.stack(b2))    # (E, H)
 
-    def forward(self, ctx, x):
+    def _ffn(self, ctx, h):
+        """Routed expert mixture on the LN2 output (overrides the dense
+        hook, so the training forward AND the cached decode paths route
+        identically — tokens flatten over whatever leading layout the
+        caller uses: (S, B, E) in forward, (B, S_c, E) in decode)."""
         from ..parallel.expert_parallel import switch_moe
 
-        h, _ = self.attn.forward(ctx, self.ln1.forward(ctx, x))
-        x = x + self.dropout.forward(ctx, h)
-        s, b, e = x.shape
-        toks = self.ln2.forward(ctx, x).reshape(s * b, e)
+        shape = h.shape
+        toks = h.reshape(-1, shape[-1])
         i = jax.lax.axis_index(self.moe_axis)
         params = tuple(
             jax.lax.dynamic_index_in_dim(ctx.value(p), i, 0,
@@ -277,7 +280,7 @@ class MoeGptBlock(nn.Module):
                             capacity_factor=self.capacity_factor,
                             top_k=self.top_k)
         ctx.add_aux_loss(self.aux_weight * aux)
-        return x + self.dropout.forward(ctx, y.reshape(s, b, e))
+        return y.reshape(shape)
 
     def tp_sharded_params(self):
         return []    # MoE blocks carry no TP-sharded params
@@ -466,15 +469,19 @@ class GptModel(nn.Module):
                 for _ in self.blocks]
 
     def _decode_guard(self, what):
-        """Cached decode supports single-shard AND tensor-parallel
-        execution (``tp_axis``: run inside shard_map — generate(mesh=...)
-        wraps it; caches shard heads, logits come out replicated).
-        Sequence parallelism and MoE stay training-only (no cached ring
-        protocol / no expert cache story) — refuse loudly."""
-        if self.sp_axis is not None or self.moe_axis is not None:
+        """Cached decode supports single-shard, tensor-parallel
+        (``tp_axis``), and expert-parallel (``moe_axis``) execution —
+        the sharded flavors run inside shard_map (generate(mesh=...)
+        wraps it): TP shards heads with psum-replicated logits; MoE
+        keeps caches replicated and routes each decoded chunk through
+        the training forward's all_to_all (the Llama-family
+        convention).  Sequence parallelism stays training-only (no
+        cached ring protocol) — refuse loudly."""
+        if self.sp_axis is not None:
             raise NotImplementedError(
-                f"{what} supports single-shard or tp_axis execution; "
-                f"build the model without sp_axis/moe_axis for inference")
+                f"{what} supports single-shard, tp_axis, or moe_axis "
+                f"execution; build the model without sp_axis for "
+                f"inference")
 
     def _run_blocks(self, ctx, toks, caches, pos_of, blk_fn):
         """Embed ``toks`` + positions (``pos_of(pos_table)``), thread the
@@ -548,8 +555,8 @@ class GptModel(nn.Module):
 def _sharded_decode_axes(model):
     """The mesh axes a model's decode needs: tp (head-sharded) and/or
     moe (expert dispatch).  Callers run the model's own ``_decode_guard``
-    FIRST, so a family whose guard refuses an axis (GPT MoE, any sp)
-    never reaches the mesh demands here."""
+    FIRST, so a composition a family refuses (sp_axis, in both LM
+    families) never reaches the mesh demands here."""
     axes = []
     for attr in ("tp_axis", "moe_axis"):
         ax = getattr(model, attr, None)
@@ -633,8 +640,8 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     if top_k is not None and not 1 <= top_k <= vocab:
         raise ValueError(
             f"top_k must be in [1, vocab={vocab}], got {top_k}")
-    # unsupported-composition refusal (GPT MoE, sp) wins over mesh
-    # demands; then validate the mesh against the sharded axes
+    # unsupported-composition refusal (sp) wins over mesh demands;
+    # then validate the mesh against the sharded axes
     model._decode_guard("generate")
     _check_decode_mesh(model, mesh)
     if mesh is not None and not _sharded_decode_axes(model):

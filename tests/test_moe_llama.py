@@ -176,20 +176,89 @@ def test_moe_llama_generate_under_mesh(rng):
         generate(model, prompt, 4)
 
 
-def test_gpt_moe_decode_refuses_before_mesh_demand():
-    """A GPT-family MoE model (no cached decode paths) must hit the
-    NotImplementedError refusal — not a misleading 'pass mesh='
-    ValueError — whether or not a mesh was supplied."""
+def test_gpt_moe_decode_matches_forward(rng):
+    """GPT-family MoE decode (MoeGptBlock inherits GptBlock's cached
+    paths through the shared _ffn hook): teacher-forced decode under
+    the expert mesh reproduces the forward at non-dropping capacity."""
+    from apex_tpu.models import GptModel
+    from apex_tpu.nn.modules import Ctx
+
+    nn.manual_seed(4)
+    m = GptModel(vocab_size=61, hidden=16, layers=2, heads=2,
+                 max_positions=32, dropout=0.0, attn_dropout=0.0,
+                 moe_axis="data", moe_num_experts=4,
+                 moe_capacity_factor=8.0)
+    m.eval()
+    params = list(m.parameters())
+    vals = [p.data for p in params]
+    ids = jnp.asarray(rng.integers(0, 61, (2, 8)))
+    mesh = _mesh(4)
+
+    def fwd(vals, ids):
+        ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                  training=False)
+        return m.forward(ctx, ids)
+
+    want = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        check_vma=False))(vals, ids)
+
+    def stepped(vals, ids):
+        ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                  training=False)
+        caches = m.init_caches(2, 16)
+        outs = []
+        for t in range(8):
+            logits, caches = m.decode_step(ctx, ids[:, t], caches,
+                                           jnp.asarray(t))
+            outs.append(logits)
+        return jnp.stack(outs, axis=1)
+
+    got = jax.jit(jax.shard_map(
+        stepped, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        check_vma=False))(vals, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_gpt_moe_generate_under_mesh(rng):
     from apex_tpu.models import GptModel
     from apex_tpu.models.gpt import generate
 
-    nn.manual_seed(0)
+    nn.manual_seed(5)
     m = GptModel(vocab_size=61, hidden=16, layers=2, heads=2,
-                 max_positions=16, dropout=0.0, attn_dropout=0.0,
-                 moe_axis="data", moe_num_experts=4)
+                 max_positions=32, dropout=0.0, attn_dropout=0.0,
+                 moe_axis="data", moe_num_experts=4,
+                 moe_capacity_factor=8.0)
     m.eval()
-    prompt = jnp.zeros((1, 3), jnp.int32)
-    with pytest.raises(NotImplementedError, match="moe_axis"):
+    prompt = jnp.asarray(rng.integers(0, 61, (1, 4)))
+    out = np.asarray(generate(m, prompt, 8, mesh=_mesh(4)))
+    assert out.shape == (1, 12)
+    assert ((out >= 0) & (out < 61)).all()
+    with pytest.raises(ValueError, match="mesh"):
         generate(m, prompt, 4)
-    with pytest.raises(NotImplementedError, match="moe_axis"):
-        generate(m, prompt, 4, mesh=_mesh(4))
+
+
+def test_moe_speculative_greedy_exact(rng):
+    """Speculative decoding with an expert-routed target: the greedy
+    exactness guarantee holds under the MoE mesh (verification chunks
+    route through the same dispatch as the target's own decode)."""
+    from apex_tpu.inference import speculative_generate
+    from apex_tpu.models.gpt import generate
+
+    nn.manual_seed(11)
+    target = LlamaModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                        kv_heads=2, max_positions=64, moe_axis="data",
+                        moe_num_experts=4, moe_every=2,
+                        moe_capacity_factor=8.0)
+    target.eval()
+    nn.manual_seed(12)
+    draft = LlamaModel(vocab_size=V, hidden=16, layers=1, heads=2,
+                       max_positions=64)
+    draft.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 4)))
+    mesh = _mesh(4)
+    want = np.asarray(generate(target, prompt, 10, mesh=mesh))
+    got = np.asarray(speculative_generate(target, draft, prompt, 10,
+                                          k=3, mesh=mesh))
+    np.testing.assert_array_equal(got, want)
