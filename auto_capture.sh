@@ -40,6 +40,7 @@ QUEUE=(
   "timeout 700 python bench.py --llama --seq-len 512 --no-kernels"
   "timeout 700 python bench.py --vit --no-kernels"
   "timeout 700 python bench.py --dcgan --no-kernels"
+  "timeout 700 python bench.py --profile --llama"
   "DIAG_FULL=1 bash diagnose_gpt1024.sh >>diagnose_stdout.log 2>&1"
 )
 

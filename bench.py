@@ -370,6 +370,10 @@ def run_profile(kind, batch, seq_len, top_n=15, plain_loss=False,
         step, arrays, _, _ = build_gpt_step(batch, seq_len, remat=remat,
                                             size=size,
                                             plain_loss=plain_loss)
+    elif kind == "llama":
+        step, arrays, _, _ = build_llama_step(batch, seq_len,
+                                              remat=remat,
+                                              plain_loss=plain_loss)
     else:
         step, arrays, _, _ = build_resnet_step(batch)
 
@@ -1190,7 +1194,8 @@ def main():
     # run's.  Branch order mirrors the dispatch order below.
     def config_metric():
         if args.profile:
-            kind = "bert" if args.bert else ("gpt" if args.gpt else "resnet")
+            kind = ("bert" if args.bert else "gpt" if args.gpt
+                    else "llama" if args.llama else "resnet")
             return f"{kind}_step_op_time_attribution", "us_matched"
         if args.kernels_timing:
             return "pallas_kernel_speedup_vs_xla", "x_geomean"
@@ -1239,9 +1244,9 @@ def main():
              "DECODE measurements; pair them with --gpt-decode")
         return 1
     if args.profile and (args.seq2seq or args.gpt_decode or args.vit
-                         or args.llama or args.dcgan):
+                         or args.dcgan):
         fail("profile_unsupported_config: --profile supports the "
-             "resnet (default), --gpt and --bert configs")
+             "resnet (default), --gpt, --bert and --llama configs")
         return 1
     sweep_batches = None
     if args.sweep:
@@ -1268,8 +1273,10 @@ def main():
 
     if args.profile:
         # unsupported combos already rejected before backend init
-        kind = "bert" if args.bert else ("gpt" if args.gpt else "resnet")
-        batch = args.batch or (64 if kind in ("bert", "gpt") else 128)
+        kind = ("bert" if args.bert else "gpt" if args.gpt
+                else "llama" if args.llama else "resnet")
+        batch = args.batch or (64 if kind in ("bert", "gpt", "llama")
+                               else 128)
         try:
             res = run_profile(kind, batch, args.seq_len,
                               plain_loss=args.plain_loss,
