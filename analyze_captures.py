@@ -1,0 +1,138 @@
+"""Summarize on-chip capture results (measurements.jsonl +
+diagnose_gpt1024.jsonl) into a markdown report.
+
+Run after `auto_capture.sh` has drained (or partially drained):
+
+    python analyze_captures.py            # prints the report
+    python analyze_captures.py --update   # also appends it to BENCH_HISTORY.md
+
+What it computes:
+- per-metric best row (latest non-null value), with the round-3
+  reference number and the delta where one exists;
+- the kernel A/B table grouped by kernel, flagging rows <1.0x and the
+  S=512 dispatch-threshold verdict (should APEX_TPU_FLASH_MIN_SK move?);
+- decode ladder: plain -> int8 -> int8+kv-int8 -> speculative ratios;
+- the GPT-1024 diagnosis outcomes (which probe attributed the hang).
+"""
+import argparse
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# round-3 reference captures (BENCH_HISTORY.md) for deltas
+R3 = {
+    "resnet50_imagenet_images_per_sec_per_chip_ampO2": 2310.8,
+    "bert_base_mlm_seq128_sequences_per_sec_per_chip_ampO2": 866.2,
+    "gpt2_small_causal_lm_seq128_sequences_per_sec_per_chip_ampO2": 705.4,
+    "gpt2_small_causal_lm_seq1024_sequences_per_sec_per_chip_ampO2": 75.8,
+}
+
+
+def _load_jsonl(path):
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows
+
+
+def report():
+    out = ["# On-chip capture summary", ""]
+    rows = _load_jsonl(os.path.join(HERE, "measurements.jsonl"))
+    if not rows:
+        return "\n".join(out + ["(measurements.jsonl empty or missing)"])
+
+    # ---- headline metrics: last non-null value per metric
+    best = {}
+    for r in rows:
+        if r.get("value") is not None and r.get("metric"):
+            best[r["metric"]] = r
+    if best:
+        out += ["## Headline metrics", "",
+                "| metric | value | unit | vs r3 | mfu |", "|---|---|---|---|---|"]
+        for m, r in sorted(best.items()):
+            if m == "pallas_kernel_ab":
+                continue
+            r3 = R3.get(m)
+            delta = (f"{(r['value'] / r3 - 1) * 100:+.1f}%"
+                     if r3 else "—")
+            out.append(f"| {m} | {r['value']} | {r.get('unit', '')} "
+                       f"| {delta} | {r.get('mfu', '—')} |")
+        out.append("")
+
+    # ---- kernel A/B rows
+    ab = [r for r in rows if r.get("metric") == "pallas_kernel_ab"
+          and r.get("speedup")]
+    if ab:
+        out += ["## Kernel A/B (pallas vs xla, fwd+bwd)", "",
+                "| kernel | shape | pallas ms | xla ms | speedup |",
+                "|---|---|---|---|---|"]
+        losses = []
+        for r in ab:
+            flag = "" if r["speedup"] >= 1.0 else "  **<1.0x**"
+            out.append(f"| {r.get('kernel')} | {r.get('shape')} "
+                       f"| {r.get('pallas_ms')} | {r.get('xla_ms')} "
+                       f"| {r['speedup']}{flag} |")
+            if r["speedup"] < 1.0:
+                losses.append(r)
+        out.append("")
+        s512 = [r for r in ab if "S512" in str(r.get("shape", ""))]
+        if s512:
+            v = s512[-1]["speedup"]
+            out.append(
+                f"S=512 threshold row: {v}x — "
+                + ("flash wins at 512; consider LOWERING "
+                   "APEX_TPU_FLASH_MIN_SK below 512." if v > 1.05 else
+                   "flash loses at 512; consider RAISING "
+                   "APEX_TPU_FLASH_MIN_SK." if v < 0.95 else
+                   "threshold is placed about right."))
+            out.append("")
+        if losses:
+            out.append(f"{len(losses)} row(s) below 1.0x — candidates for "
+                       f"dispatch rerouting or retirement notes.")
+            out.append("")
+
+    # ---- decode ladder
+    dec = {}
+    for r in rows:
+        m = r.get("metric", "")
+        if "decode" in m and r.get("value") is not None:
+            dec[m] = r["value"]
+    if dec:
+        out += ["## Decode ladder (tokens/sec/chip)", ""]
+        plain = dec.get("gpt2_small_greedy_decode_tokens_per_sec_per_chip")
+        for m, v in sorted(dec.items()):
+            rel = f"  ({v / plain:.2f}x plain)" if plain and v else ""
+            out.append(f"- {m}: {v}{rel}")
+        out.append("")
+
+    # ---- GPT-1024 diagnosis
+    diag = _load_jsonl(os.path.join(HERE, "diagnose_gpt1024.jsonl"))
+    if diag:
+        out += ["## GPT seq-1024 hang diagnosis", ""]
+        for r in diag:
+            out.append(f"- {r.get('probe')}: {r.get('result')}")
+        out.append("")
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true",
+                    help="append the report to BENCH_HISTORY.md")
+    args = ap.parse_args()
+    text = report()
+    print(text)
+    if args.update:
+        with open(os.path.join(HERE, "BENCH_HISTORY.md"), "a") as f:
+            f.write("\n" + text + "\n")
+        print("\n(appended to BENCH_HISTORY.md)")
