@@ -1,0 +1,58 @@
+"""The shared compiled-run cache (utils/jit_cache.py): parameter-
+identity keying, LRU eviction, and pinned refs — the invariants the
+three decode drivers rely on (a stale hit would zip old closure params
+against new values and silently read wrong weights)."""
+from apex_tpu.utils.jit_cache import compiled_run_cache
+
+
+class _Obj:
+    pass
+
+
+def test_hit_and_param_identity_miss():
+    m = _Obj()
+    p1, p2 = object(), object()
+    builds = []
+
+    def build():
+        builds.append(1)
+        return lambda: len(builds)
+
+    f1 = compiled_run_cache(m, "_c", ("cfg",), [p1, p2], build)
+    f2 = compiled_run_cache(m, "_c", ("cfg",), [p1, p2], build)
+    assert f1 is f2 and len(builds) == 1           # hit
+    f3 = compiled_run_cache(m, "_c", ("cfg",), [p1, object()], build)
+    assert f3 is not f1 and len(builds) == 2       # param swap missed
+    f4 = compiled_run_cache(m, "_c", ("other",), [p1, p2], build)
+    assert f4 is not f1 and len(builds) == 3       # cfg change missed
+
+
+def test_lru_eviction_and_refresh():
+    m = _Obj()
+    p = object()
+
+    def build():
+        return object()
+
+    entries = [compiled_run_cache(m, "_c", (i,), [p], build, cap=3)
+               for i in range(3)]
+    # refresh entry 0 (pop + reinsert), then insert a 4th: entry 1 is
+    # now the oldest and must be the one evicted
+    assert compiled_run_cache(m, "_c", (0,), [p], build, cap=3) \
+        is entries[0]
+    compiled_run_cache(m, "_c", (99,), [p], build, cap=3)
+    assert compiled_run_cache(m, "_c", (0,), [p], build, cap=3) \
+        is entries[0]                               # survived
+    assert compiled_run_cache(m, "_c", (1,), [p], build, cap=3) \
+        is not entries[1]                           # evicted, rebuilt
+
+
+def test_entry_pins_param_refs():
+    """The entry must hold the parameter objects it keyed on — without
+    the pin, a garbage-collected param's id could be recycled by a new
+    object and FALSELY hit the stale entry."""
+    m = _Obj()
+    p = object()
+    compiled_run_cache(m, "_c", ("k",), [p], lambda: object())
+    (pinned, _), = list(m._c.values())
+    assert pinned[0] is p
