@@ -68,15 +68,17 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
     batch > 1 — hence the batch-1 restriction.
 
     Sharded decode: if the target and/or draft was built with
-    ``tp_axis`` (head-sharded) or ``moe_axis`` (expert-routed), pass
-    ``mesh`` (a Mesh carrying the axis/axes) — the whole speculative
-    program runs inside ``shard_map`` with generate()'s decode
-    convention (replicated tokens/key; TP shards caches with
-    psum-replicated logits, MoE routes verification chunks through the
-    expert all_to_all), so the exactness guarantees hold unchanged; a
-    model without sharded axes computes replicated inside the same
-    region (the usual big-sharded-target / small-replicated-draft
-    serving shape).
+    ``tp_axis`` (head-sharded), ``moe_axis`` (expert-routed), or
+    ``sp_axis`` (time-sharded KV cache), pass ``mesh`` (a Mesh carrying
+    the axis/axes) — the whole speculative program runs inside
+    ``shard_map`` with generate()'s decode convention (replicated
+    tokens/key; TP shards caches with psum-replicated logits, MoE
+    routes verification chunks through the expert all_to_all, SP
+    lse-merges partial attention over its time-sharded cache blocks —
+    parallel/context_parallel.py), so the exactness guarantees hold
+    unchanged; a model without sharded axes computes replicated inside
+    the same region (the usual big-sharded-target /
+    small-replicated-draft serving shape).
     """
     from ..nn.modules import Ctx
 
@@ -106,7 +108,7 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
         from ..models.gpt import _check_decode_mesh, _sharded_decode_axes
         guard = getattr(m, "_decode_guard", None)
         if guard is not None:
-            # unsupported compositions (sp) refuse here, not
+            # unsupported compositions (sp x moe) refuse here, not
             # mid-trace — and before any 'pass mesh=' demand
             guard(f"speculative_generate ({name})")
         _check_decode_mesh(m, mesh, what="speculative_generate",
@@ -115,8 +117,8 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
                                  or _sharded_decode_axes(draft)):
         raise ValueError(
             "mesh was passed but neither target nor draft has a "
-            "tp_axis/moe_axis — single-shard speculative decode needs "
-            "no mesh")
+            "tp_axis/moe_axis/sp_axis — single-shard speculative "
+            "decode needs no mesh")
     b, p = prompt_ids.shape
     if p < 1:
         raise ValueError("prompt must hold at least one token")

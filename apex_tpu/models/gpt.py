@@ -56,6 +56,7 @@ class GptBlock(nn.Module):
             self.fc1 = self.fc2 = None
         self.dropout = nn.Dropout(dropout)
         self.tp_axis = tp_axis
+        self.sp_axis = sp_axis
 
     def _ffn(self, ctx, h):
         """The feed-forward on the LN2 output — one hook for the dense,
@@ -175,17 +176,35 @@ class GptBlock(nn.Module):
         pos = t0 + jnp.arange(s_c, dtype=jnp.int32)
         from ..inference.quant import kv_value, kv_write
         q, k_new, v_new = self._chunk_qkv(ctx, x)     # H is LOCAL under tp
-        kcache = kv_write(kcache, k_new, (0, 0, t0, 0))
-        vcache = kv_write(vcache, v_new, (0, 0, t0, 0))
-        s_max = kcache.shape[2]
+        if self.sp_axis is not None:
+            # sequence-parallel decode: this device's cache block holds
+            # positions sp_slot_positions(...); the chunk's KV rows land
+            # on their owners, scores run against the LOCAL block only,
+            # and the partials lse-merge over the axis
+            # (parallel/context_parallel.py)
+            from ..parallel.context_parallel import (
+                sp_kv_write, sp_slot_positions, sp_softmax_combine)
+            kcache = sp_kv_write(kcache, k_new, t0, self.sp_axis)
+            vcache = sp_kv_write(vcache, v_new, t0, self.sp_axis)
+            slots = sp_slot_positions(kcache.shape[2], self.sp_axis)
+        else:
+            kcache = kv_write(kcache, k_new, (0, 0, t0, 0))
+            vcache = kv_write(vcache, v_new, (0, 0, t0, 0))
+            slots = jnp.arange(kcache.shape[2], dtype=jnp.int32)
         scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(jnp.float32),
                             kv_value(kcache)) * attn.scaling
         # cache slots beyond each position are unwritten (or stale)
-        valid = jnp.arange(s_max)[None, :] <= pos[:, None]
+        valid = slots[None, :] <= pos[:, None]
         scores = jnp.where(valid[None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhqs,bhsd->bhqd", probs,
-                       kv_value(vcache)).astype(x.dtype)
+        if self.sp_axis is not None:
+            o = sp_softmax_combine(
+                scores, self.sp_axis,
+                lambda p: jnp.einsum("bhqs,bhsd->bhqd", p,
+                                     kv_value(vcache))).astype(x.dtype)
+        else:
+            probs = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bhqs,bhsd->bhqd", probs,
+                           kv_value(vcache)).astype(x.dtype)
         o = jnp.swapaxes(o, 1, 2).reshape(b, s_c, q.shape[1] * d)
         return self._attn_mlp_tail(ctx, x, o), kcache, vcache
 
@@ -445,7 +464,10 @@ class GptModel(nn.Module):
     def init_caches(self, batch, s_max, dtype=jnp.float32):
         """Per-layer (k, v) caches of shape (B, H, S_max, D).  Under
         ``tp_axis`` H is the LOCAL head count (call inside shard_map —
-        generate does): each device caches only its own head shard."""
+        generate does): each device caches only its own head shard.
+        Under ``sp_axis`` S is the LOCAL sequence block (ceil(S_max/n),
+        rounded up so every position has an owner): per-device cache HBM
+        shrinks with the mesh — the context-length scaling lever."""
         blk0 = self.blocks[0]
         h, d = blk0.attn.num_heads, blk0.attn.head_dim
         if self.tp_axis is not None:
@@ -463,25 +485,39 @@ class GptModel(nn.Module):
                     f"init_caches: heads ({h}) must divide by the "
                     f"'{self.tp_axis}' axis size ({n})")
             h //= n
+        if self.sp_axis is not None:
+            from ..parallel.context_parallel import sp_axis_size
+            s_max = -(-s_max // sp_axis_size(self.sp_axis))
         from ..inference.quant import make_kv_cache
         return [(make_kv_cache((batch, h, s_max, d), dtype),
                  make_kv_cache((batch, h, s_max, d), dtype))
                 for _ in self.blocks]
 
+    def _cache_capacity(self, caches):
+        """Global position capacity of the caches (under ``sp_axis`` the
+        per-device block times the axis size)."""
+        cap = caches[0][0].shape[2]
+        if self.sp_axis is not None:
+            from ..parallel.context_parallel import sp_axis_size
+            cap *= sp_axis_size(self.sp_axis)
+        return cap
+
     def _decode_guard(self, what):
         """Cached decode supports single-shard, tensor-parallel
-        (``tp_axis``), and expert-parallel (``moe_axis``) execution —
-        the sharded flavors run inside shard_map (generate(mesh=...)
-        wraps it): TP shards heads with psum-replicated logits; MoE
-        keeps caches replicated and routes each decoded chunk through
-        the training forward's all_to_all (the Llama-family
-        convention).  Sequence parallelism stays training-only (no
-        cached ring protocol) — refuse loudly."""
-        if self.sp_axis is not None:
+        (``tp_axis``), expert-parallel (``moe_axis``), and
+        sequence-parallel (``sp_axis``) execution — the sharded flavors
+        run inside shard_map (generate(mesh=...) wraps it): TP shards
+        heads with psum-replicated logits; MoE keeps caches replicated
+        and routes each decoded chunk through the training forward's
+        all_to_all; SP shards the KV cache's TIME axis with lse-merged
+        partial attention (parallel/context_parallel.py).  SP×TP
+        composes (heads and time shard independently); SP×MoE does not
+        (untested collective interleaving) — refuse loudly."""
+        if self.sp_axis is not None and self.moe_axis is not None:
             raise NotImplementedError(
-                f"{what} supports single-shard, tp_axis, or moe_axis "
-                f"execution; build the model without sp_axis for "
-                f"inference")
+                f"{what}: sp_axis does not compose with moe_axis for "
+                f"cached decode; build the model with one or the other "
+                f"for inference")
 
     def _run_blocks(self, ctx, toks, caches, pos_of, blk_fn):
         """Embed ``toks`` + positions (``pos_of(pos_table)``), thread the
@@ -506,8 +542,13 @@ class GptModel(nn.Module):
         """Consume a PROMPT ``toks (B, S_p)`` from position 0 in one
         flash-attention pass, filling the KV caches: returns
         ``(logits (B, S_p, V), new_caches)`` — O(1) calls instead of
-        S_p decode steps."""
+        S_p decode steps.  Under ``sp_axis`` the prompt runs in cache-
+        block-bounded chunks instead (cross-chunk attention rides the
+        sharded cache; parallel/context_parallel.py)."""
         self._decode_guard("prefill")
+        if self.sp_axis is not None:
+            from ..parallel.context_parallel import sp_chunked_prefill
+            return sp_chunked_prefill(self, ctx, toks, caches)
         s_p = toks.shape[1]
         return self._run_blocks(
             ctx, toks, caches, lambda pos: pos[:s_p][None, :, :],
@@ -527,12 +568,12 @@ class GptModel(nn.Module):
         self._decode_guard("decode_chunk")
         s_c = toks.shape[1]
         if not isinstance(t0, jax.core.Tracer):
-            bound = min(self.max_positions, caches[0][0].shape[2])
+            bound = min(self.max_positions, self._cache_capacity(caches))
             if int(t0) < 0 or int(t0) + s_c > bound:
                 raise ValueError(
                     f"decode_chunk: positions {int(t0)}..{int(t0) + s_c} "
                     f"out of range for max_positions {self.max_positions} "
-                    f"/ cache length {caches[0][0].shape[2]} — "
+                    f"/ cache capacity {self._cache_capacity(caches)} — "
                     f"dynamic_slice would clamp and return wrong position "
                     f"embeddings / corrupt the cache")
         return self._run_blocks(
@@ -553,12 +594,12 @@ class GptModel(nn.Module):
 
 
 def _sharded_decode_axes(model):
-    """The mesh axes a model's decode needs: tp (head-sharded) and/or
-    moe (expert dispatch).  Callers run the model's own ``_decode_guard``
-    FIRST, so a composition a family refuses (sp_axis, in both LM
-    families) never reaches the mesh demands here."""
+    """The mesh axes a model's decode needs: tp (head-sharded), moe
+    (expert dispatch), and/or sp (time-sharded KV cache).  Callers run
+    the model's own ``_decode_guard`` FIRST, so a composition a family
+    refuses (sp×moe) never reaches the mesh demands here."""
     axes = []
-    for attr in ("tp_axis", "moe_axis"):
+    for attr in ("tp_axis", "moe_axis", "sp_axis"):
         ax = getattr(model, attr, None)
         if ax is not None:
             axes.append((attr, ax))
@@ -646,8 +687,8 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     _check_decode_mesh(model, mesh)
     if mesh is not None and not _sharded_decode_axes(model):
         raise ValueError(
-            "mesh was passed but the model has no tp_axis/moe_axis — "
-            "single-shard decode needs no mesh")
+            "mesh was passed but the model has no tp_axis/moe_axis/"
+            "sp_axis — single-shard decode needs no mesh")
 
     params = [q for q in model.parameters()]
     buffers = list(model.buffers())

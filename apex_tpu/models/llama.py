@@ -281,22 +281,39 @@ class LlamaBlock(nn.Module):
         # q carries H/n heads (the GQA group ratio is shard-invariant)
         from ..inference.quant import kv_value, kv_write
         h_loc, kvh = q.shape[1], k_new.shape[1]
-        kcache = kv_write(kcache, k_new, (0, 0, t0, 0))
-        vcache = kv_write(vcache, v_new, (0, 0, t0, 0))
-        s_max = kcache.shape[2]
+        if self.sp_axis is not None:
+            # sequence-parallel decode (parallel/context_parallel.py):
+            # time-sharded caches, windowed owner writes, lse-merged
+            # partial attention.  sliding_window never reaches here —
+            # the model constructor refuses that composition.
+            from ..parallel.context_parallel import (
+                sp_kv_write, sp_slot_positions, sp_softmax_combine)
+            kcache = sp_kv_write(kcache, k_new, t0, self.sp_axis)
+            vcache = sp_kv_write(vcache, v_new, t0, self.sp_axis)
+            slots = sp_slot_positions(kcache.shape[2], self.sp_axis)
+        else:
+            kcache = kv_write(kcache, k_new, (0, 0, t0, 0))
+            vcache = kv_write(vcache, v_new, (0, 0, t0, 0))
+            slots = jnp.arange(kcache.shape[2], dtype=jnp.int32)
         group = h_loc // kvh
         qg = q.reshape(b, kvh, group, s_c, d)
         scores = jnp.einsum("bkgqd,bksd->bkgqs", qg.astype(jnp.float32),
                             kv_value(kcache)) * (d ** -0.5)
-        valid = jnp.arange(s_max)[None, :] <= pos[:, None]   # (S_c, S_max)
+        valid = slots[None, :] <= pos[:, None]          # (S_c, S_local)
         if self.sliding_window is not None:
             # banded: key j visible from position t iff t-w < j <= t
-            valid = valid & (jnp.arange(s_max)[None, :]
+            valid = valid & (slots[None, :]
                              > pos[:, None] - self.sliding_window)
         scores = jnp.where(valid[None, None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bkgqs,bksd->bkgqd", probs,
-                       kv_value(vcache)).astype(x.dtype)
+        if self.sp_axis is not None:
+            o = sp_softmax_combine(
+                scores, self.sp_axis,
+                lambda p: jnp.einsum("bkgqs,bksd->bkgqd", p,
+                                     kv_value(vcache))).astype(x.dtype)
+        else:
+            probs = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bkgqs,bksd->bkgqd", probs,
+                           kv_value(vcache)).astype(x.dtype)
         o = jnp.swapaxes(o.reshape(b, h_loc, s_c, d), 1, 2) \
             .reshape(b, s_c, h_loc * d)
         return self._mlp_tail(ctx, x, o), kcache, vcache
@@ -525,12 +542,26 @@ class LlamaModel(nn.Module):
                 raise ValueError(
                     f"init_caches: kv_heads must divide by the "
                     f"'{self.tp_axis}' axis size ({n})")
+        if self.sp_axis is not None:
+            # LOCAL time block (the GptModel convention): per-device
+            # cache HBM shrinks with the axis — context-length scaling
+            from ..parallel.context_parallel import sp_axis_size
+            s_max = -(-s_max // sp_axis_size(self.sp_axis))
         from ..inference.quant import make_kv_cache
         return [(make_kv_cache((batch, blk.kv_heads // n, s_max,
                                 blk.head_dim), dtype),
                  make_kv_cache((batch, blk.kv_heads // n, s_max,
                                 blk.head_dim), dtype))
                 for blk in self.blocks]
+
+    def _cache_capacity(self, caches):
+        """Global position capacity of the caches (under ``sp_axis`` the
+        per-device block times the axis size)."""
+        cap = caches[0][0].shape[2]
+        if self.sp_axis is not None:
+            from ..parallel.context_parallel import sp_axis_size
+            cap *= sp_axis_size(self.sp_axis)
+        return cap
 
     def tp_sharded_params(self):
         """All blocks' TP-block-sparse parameters (see LlamaBlock) — the
@@ -549,14 +580,16 @@ class LlamaModel(nn.Module):
         keeps caches replicated and routes each decoded chunk's tokens
         through the expert all_to_all exactly like the training
         forward (the Mixtral serving path — mixtral_from_hf builds this
-        model).  Sequence parallelism stays training-only: the ring
-        protocol has no cached form — refuse loudly rather than decode
-        wrongly."""
-        if self.sp_axis is not None:
+        model).  Sequence parallelism (``sp_axis``) decodes with a
+        TIME-sharded KV cache and lse-merged partial attention
+        (parallel/context_parallel.py) — the serving mirror of the
+        training ring; it composes with tp_axis but not with moe_axis
+        (untested collective interleaving) — refuse that loudly."""
+        if self.sp_axis is not None and self.moe_axis is not None:
             raise NotImplementedError(
-                f"{what} supports single-shard, tp_axis, or moe_axis "
-                f"execution; build the model without sp_axis for "
-                f"inference")
+                f"{what}: sp_axis does not compose with moe_axis for "
+                f"cached decode; build the model with one or the other "
+                f"for inference")
 
     def _run_blocks(self, ctx, toks, caches, blk_fn):
         """Embed ``toks``, thread the caches through ``blk_fn`` per
@@ -578,8 +611,13 @@ class LlamaModel(nn.Module):
         ``S_p`` decode steps, with no (S_p, S_max) score tensor (the
         caches are empty, so the chunk attends only itself).  Under
         ``sliding_window`` the kernel applies the band exactly at any
-        prompt length (banded blocks skipped, O(S·window))."""
+        prompt length (banded blocks skipped, O(S·window)).  Under
+        ``sp_axis`` the prompt runs in cache-block-bounded chunks
+        instead (parallel/context_parallel.py)."""
         self._decode_guard("prefill")
+        if self.sp_axis is not None:
+            from ..parallel.context_parallel import sp_chunked_prefill
+            return sp_chunked_prefill(self, ctx, toks, caches)
         return self._run_blocks(
             ctx, toks, caches,
             lambda blk, x, kc, vc: blk.prefill(ctx, x, kc, vc))
@@ -602,14 +640,15 @@ class LlamaModel(nn.Module):
         self._decode_guard("decode_chunk")
         if not isinstance(t0, jax.core.Tracer):
             s_c = toks.shape[1]
-            bound = min(self.max_positions, caches[0][0].shape[2])
+            bound = min(self.max_positions, self._cache_capacity(caches))
             if int(t0) < 0 or int(t0) + s_c > bound:
                 raise ValueError(
                     f"decode_chunk: positions {int(t0)}..{int(t0) + s_c} "
                     f"out of range for max_positions "
-                    f"{self.max_positions} / cache length "
-                    f"{caches[0][0].shape[2]} — dynamic_update_slice "
-                    f"would clamp and corrupt the cache")
+                    f"{self.max_positions} / cache capacity "
+                    f"{self._cache_capacity(caches)} — "
+                    f"dynamic_update_slice would clamp and corrupt the "
+                    f"cache")
         return self._run_blocks(
             ctx, toks, caches,
             lambda blk, x, kc, vc: blk.decode_chunk(ctx, x, kc, vc, t0))

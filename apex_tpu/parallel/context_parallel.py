@@ -1,0 +1,133 @@
+"""Sequence-parallel (context-parallel) cached decode.
+
+Training-side sequence parallelism (parallel/ring_attention.py) shards
+the TIME axis of activations; its decode-side mirror shards the TIME
+axis of the KV cache: each device along ``sp_axis`` owns one contiguous
+block of cache positions, so per-device cache HBM shrinks with the mesh
+and the servable context length scales past one chip's memory — the
+serving analogue of the training long-context recipe.  (The reference,
+apex/contrib/multihead_attn/, is single-device and training-only; this
+subsystem has no reference counterpart.)
+
+The protocol per decoded chunk, run inside ``shard_map`` over the axis
+(models/gpt.py ``generate(mesh=...)`` wraps it):
+
+1. every device computes the chunk's q/k/v (replicated — per-token
+   projection work is tiny next to the O(S) cache sweep);
+2. each device writes ONLY the chunk rows whose global positions fall in
+   its cache block (:func:`sp_kv_write` — a windowed masked write, O(S_c)
+   traffic, no full-cache rewrite);
+3. each device computes partial attention scores against its LOCAL cache
+   block, masked by global validity, and the partials merge with the
+   streaming-softmax identity over the axis (:func:`sp_softmax_combine`):
+   ``m = pmax(m_i)``, ``o = Σ_i e^{s_i - m} v_i / Σ_i e^{s_i - m}`` —
+   two psums + one pmax per layer, the same lse-merge flash attention
+   uses across blocks, here across devices.
+
+Score compute — the O(S) part of decode — is therefore SHARDED n ways,
+and the result is bit-comparable to single-shard decode (same f32
+softmax math, reassociated only across the device partition).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sp_axis_size(axis):
+    """Static size of a shard_map axis, with a decode-shaped error when
+    called outside shard_map (mirrors models' init_caches contract)."""
+    try:
+        return jax.lax.psum(1, axis)
+    except NameError:
+        raise ValueError(
+            f"sequence-parallel decode on sp_axis='{axis}' must run "
+            f"inside shard_map over a mesh with that axis — "
+            f"generate(..., mesh=...) wraps the whole decode; direct "
+            f"callers must shard_map themselves") from None
+
+
+def sp_slot_positions(s_local, axis):
+    """Global position of each LOCAL cache slot: device ``i`` owns the
+    contiguous block ``[i*s_local, (i+1)*s_local)``."""
+    off = jax.lax.axis_index(axis) * s_local
+    return off + jnp.arange(s_local, dtype=jnp.int32)
+
+
+def _masked_window_write(arr, src, t0, off):
+    """Write the rows of ``src (B, H, S_c, Dx)`` whose global positions
+    ``t0+i`` fall inside this device's block ``[off, off+S_local)`` into
+    ``arr (B, H, S_local, Dx)``.
+
+    One S_c-wide window at ``clip(t0-off, 0, S_local-S_c)`` covers any
+    contiguous overlap (chunks may straddle two devices' blocks): rows
+    outside the overlap are re-written with their own current values.
+    O(S_c) traffic — the cache is never rewritten wholesale.  Requires
+    ``S_c <= S_local`` (callers chunk prompts accordingly).
+    """
+    s_local, s_c = arr.shape[2], src.shape[2]
+    j0 = jnp.clip(t0 - off, 0, s_local - s_c)
+    old = jax.lax.dynamic_slice(
+        arr, (0, 0, j0, 0), arr.shape[:2] + (s_c, arr.shape[3]))
+    slot_pos = off + j0 + jnp.arange(s_c, dtype=jnp.int32)
+    cand = jnp.take(src, jnp.clip(slot_pos - t0, 0, s_c - 1), axis=2)
+    own = ((slot_pos >= t0) & (slot_pos < t0 + s_c))[None, None, :, None]
+    return jax.lax.dynamic_update_slice(
+        arr, jnp.where(own, cand, old), (0, 0, j0, 0))
+
+
+def sp_kv_write(cache, new, t0, axis):
+    """Sequence-sharded counterpart of inference.quant.kv_write: write
+    chunk ``new (B, H, S_c, D)`` at global positions ``t0..`` into this
+    device's block of the cache.  QuantKV caches quantize the chunk
+    per-position first (identical values to the single-shard write, so
+    int8 decode stays bit-comparable across shardings)."""
+    from ..inference.quant import QuantKV, _absmax_int8
+
+    s_local, s_c = cache.shape[2], new.shape[2]
+    if s_c > s_local:
+        raise ValueError(
+            f"sp_kv_write: chunk length {s_c} exceeds the per-device "
+            f"cache block {s_local} — chunk the write (prefill does)")
+    off = jax.lax.axis_index(axis) * s_local
+    if isinstance(cache, QuantKV):
+        q, scale = _absmax_int8(new.astype(jnp.float32), -1,
+                                cache.scale.dtype)
+        return QuantKV(_masked_window_write(cache.q, q, t0, off),
+                       _masked_window_write(cache.scale, scale, t0, off))
+    return _masked_window_write(cache, new.astype(cache.dtype), t0, off)
+
+
+def sp_softmax_combine(scores, axis, weighted_v):
+    """Merge per-device partial attention over ``axis``: ``scores``
+    (..., S_c, S_local) are this device's f32 masked scores (invalid
+    slots at -1e30); ``weighted_v(p)`` contracts probabilities-shaped
+    weights with the LOCAL values (caller owns the einsum — GPT and GQA
+    layouts differ).  Fully-masked local blocks contribute exactly 0
+    (``e^{-1e30 - m} == 0``); some device always holds the query's own
+    position, so the global row is never empty."""
+    m = jax.lax.pmax(jnp.max(scores, axis=-1, keepdims=True), axis)
+    p = jnp.exp(scores - m)
+    l = jax.lax.psum(jnp.sum(p, axis=-1, keepdims=True), axis)
+    return jax.lax.psum(weighted_v(p), axis) / l
+
+
+def sp_chunked_prefill(model, ctx, toks, caches, chunk=512):
+    """Prompt consumption under sequence-parallel decode: the prompt
+    runs through ``model.decode_chunk`` in chunks bounded by the
+    per-device cache block, so cross-chunk attention rides the cache
+    (chunk i attends blocks 0..i through the lse merge) and every KV row
+    lands on its owning device.  Scores stay (S_chunk, S_local) per
+    head — the quadratic term is sharded n ways.  Returns
+    ``(logits (B, S_p, V), caches)`` — the non-sp prefill contract."""
+    s_p = toks.shape[1]
+    c = min(caches[0][0].shape[2], s_p, chunk)
+    outs = []
+    t = 0
+    while t < s_p:
+        s_c = min(c, s_p - t)
+        logits, caches = model.decode_chunk(ctx, toks[:, t:t + s_c],
+                                            caches, t)
+        outs.append(logits)
+        t += s_c
+    return jnp.concatenate(outs, axis=1), caches

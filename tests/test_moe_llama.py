@@ -101,12 +101,14 @@ def test_moe_llama_config_validation():
             LlamaModel(vocab_size=V, hidden=H, layers=2, heads=4,
                        moe_axis="data", moe_num_experts=4,
                        moe_every=bad)
-    # MoE decode is supported (under a mesh — see the decode tests
-    # below); sequence parallelism remains the decode refusal
-    sp_model = LlamaModel(vocab_size=V, hidden=H, layers=2, heads=4,
-                          kv_heads=2, sp_axis="sp")
+    # MoE decode and SP decode are each supported (under a mesh — see
+    # the decode tests and tests/test_sp_decode.py); their COMPOSITION
+    # is the remaining decode refusal
+    sp_moe = LlamaModel(vocab_size=V, hidden=H, layers=2, heads=4,
+                        kv_heads=2, sp_axis="sp", moe_axis="data",
+                        moe_num_experts=4)
     with pytest.raises(NotImplementedError, match="sp_axis"):
-        sp_model.decode_step(None, jnp.zeros((1,), jnp.int32), [], 0)
+        sp_moe.decode_step(None, jnp.zeros((1,), jnp.int32), [], 0)
 
 
 def test_moe_llama_decode_matches_forward(rng):

@@ -1,0 +1,217 @@
+"""Sequence-parallel (context-parallel) cached decode
+(parallel/context_parallel.py + models/{gpt,llama}.py ``sp_axis`` decode):
+the KV cache's TIME axis shards over the mesh, chunk writes land on the
+owning device, and partial attention lse-merges over the axis — emitted
+tokens must match the single-shard decode of the same weights.
+
+Reference analogue: none (the reference is training-side and
+single-device, SURVEY.md §5 long-context row); oracle methodology
+mirrors tests/test_tp_decode.py (sharded vs unsharded build agree).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import apex_tpu.nn as nn
+from apex_tpu.models import GptModel
+from apex_tpu.models.gpt import generate
+from apex_tpu.models.llama import LlamaModel
+from apex_tpu.nn.modules import Ctx
+
+V = 97
+
+
+def _sp_mesh(n):
+    return Mesh(np.array(jax.devices())[:n].reshape(n), ("sp",))
+
+
+def _llama(**kw):
+    nn.manual_seed(7)
+    return LlamaModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                      kv_heads=2, max_positions=64, **kw)
+
+
+def _gpt(**kw):
+    nn.manual_seed(7)
+    return GptModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                    max_positions=64, dropout=0.0, attn_dropout=0.0, **kw)
+
+
+def _sync_params(src, dst):
+    for ps, pd in zip(src.parameters(), dst.parameters()):
+        pd.data = ps.data
+
+
+def test_gpt_sp_greedy_decode_matches_single_shard(rng):
+    m_ref = _gpt()
+    m_ref.eval()
+    m_sp = _gpt(sp_axis="sp")
+    m_sp.eval()
+    _sync_params(m_ref, m_sp)
+
+    prompt = jnp.asarray(rng.integers(0, V, (2, 5)))
+    want = np.asarray(generate(m_ref, prompt, 10))
+    got = np.asarray(generate(m_sp, prompt, 10, mesh=_sp_mesh(4)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gpt_sp_prompt_straddles_cache_blocks(rng):
+    """Prompt longer than one device's cache block: the chunked prefill
+    must split it and the windowed writes must handle chunks straddling
+    two owners (s_total=50 over sp=4 -> 13-slot blocks, prompt 40)."""
+    m_ref = _gpt()
+    m_ref.eval()
+    m_sp = _gpt(sp_axis="sp")
+    m_sp.eval()
+    _sync_params(m_ref, m_sp)
+
+    prompt = jnp.asarray(rng.integers(0, V, (1, 40)))
+    want = np.asarray(generate(m_ref, prompt, 10))
+    got = np.asarray(generate(m_sp, prompt, 10, mesh=_sp_mesh(4)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_llama_sp_gqa_greedy_decode_matches_single_shard(rng):
+    m_ref = _llama()
+    m_ref.eval()
+    m_sp = _llama(sp_axis="sp")
+    m_sp.eval()
+    _sync_params(m_ref, m_sp)
+
+    prompt = jnp.asarray(rng.integers(0, V, (2, 5)))
+    want = np.asarray(generate(m_ref, prompt, 10))
+    got = np.asarray(generate(m_sp, prompt, 10, mesh=_sp_mesh(2)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gpt_sp_tp_composed_decode(rng):
+    """SP (time-sharded caches) x TP (head-sharded projections) on a
+    2x2 mesh: the two merges ride different axes and must compose."""
+    m_ref = _gpt()
+    m_ref.eval()
+    m_2d = _gpt(sp_axis="sp", tp_axis="tp")
+    m_2d.eval()
+    _sync_params(m_ref, m_2d)
+
+    mesh = Mesh(np.array(jax.devices())[:4].reshape(2, 2), ("tp", "sp"))
+    prompt = jnp.asarray(rng.integers(0, V, (2, 5)))
+    want = np.asarray(generate(m_ref, prompt, 10))
+    got = np.asarray(generate(m_2d, prompt, 10, mesh=mesh))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_llama_sp_int8_kv_matches_single_shard_int8(rng):
+    """QuantKV under SP quantizes each written position against its own
+    absmax — bit-identical STORED values to the single-shard int8 write
+    — so the only cross-sharding difference is the lse merge's float
+    reassociation: compare chunk LOGITS (token streams can flip at the
+    near-ties int8-coarsened caches make likelier).  The oracle
+    prefills through decode_chunk, not prefill: blk.prefill attends the
+    prompt with UNQUANTIZED flash K/V while every cache-mediated path
+    (including SP's chunked prefill) attends the quantized rows — the
+    comparable single-shard int8 program is the cache-mediated one."""
+    from jax.sharding import PartitionSpec as P
+
+    m_ref = _llama()
+    m_ref.eval()
+    m_sp = _llama(sp_axis="sp")
+    m_sp.eval()
+    _sync_params(m_ref, m_sp)
+    params = list(m_sp.parameters())
+    prompt = jnp.asarray(rng.integers(0, V, (1, 6)))
+    chunk = jnp.asarray(rng.integers(0, V, (1, 3)))
+
+    ctx = Ctx(training=False)
+    caches = m_ref.init_caches(1, 16, dtype="int8")
+    _, caches = m_ref.decode_chunk(ctx, prompt, caches, 0)
+    want, _ = m_ref.decode_chunk(ctx, chunk, caches, 6)
+
+    def run(vals, prompt, chunk):
+        ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                  training=False)
+        caches = m_sp.init_caches(1, 16, dtype="int8")
+        _, caches = m_sp.prefill(ctx, prompt, caches)
+        out, _ = m_sp.decode_chunk(ctx, chunk, caches, 6)
+        return out
+
+    got = jax.jit(jax.shard_map(
+        run, mesh=_sp_mesh(2), in_specs=(P(), P(), P()), out_specs=P(),
+        check_vma=False))([p.data for p in params], prompt, chunk)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sp_decode_chunk_matches_single_shard(rng):
+    """The speculative-verification primitive under SP: chunk logits
+    against a prefilled time-sharded cache agree with the single-shard
+    chunk (close in float; the lse merge reassociates)."""
+    from jax.sharding import PartitionSpec as P
+
+    m_ref = _llama()
+    m_ref.eval()
+    m_sp = _llama(sp_axis="sp")
+    m_sp.eval()
+    _sync_params(m_ref, m_sp)
+    params = list(m_sp.parameters())
+    prompt = jnp.asarray(rng.integers(0, V, (1, 6)))
+    chunk = jnp.asarray(rng.integers(0, V, (1, 3)))
+
+    ctx = Ctx(training=False)
+    caches = m_ref.init_caches(1, 16)
+    _, caches = m_ref.prefill(ctx, prompt, caches)
+    want, _ = m_ref.decode_chunk(ctx, chunk, caches, 6)
+
+    def run(vals, prompt, chunk):
+        ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                  training=False)
+        caches = m_sp.init_caches(1, 16)   # 8-slot blocks on sp=2
+        _, caches = m_sp.prefill(ctx, prompt, caches)
+        out, _ = m_sp.decode_chunk(ctx, chunk, caches, 6)
+        return out
+
+    got = jax.jit(jax.shard_map(
+        run, mesh=_sp_mesh(2), in_specs=(P(), P(), P()), out_specs=P(),
+        check_vma=False))([p.data for p in params], prompt, chunk)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_speculative_sp_target_exactness(rng):
+    """Greedy speculative decoding with an SP-sharded target and a
+    replicated draft emits exactly the target's own greedy stream (the
+    exactness guarantee is sharding-invariant)."""
+    from apex_tpu.inference.speculative import speculative_generate
+
+    target_ref = _gpt()
+    target_ref.eval()
+    target_sp = _gpt(sp_axis="sp")
+    target_sp.eval()
+    _sync_params(target_ref, target_sp)
+    nn.manual_seed(11)
+    draft = GptModel(vocab_size=V, hidden=16, layers=1, heads=2,
+                     max_positions=64, dropout=0.0, attn_dropout=0.0)
+    draft.eval()
+
+    prompt = jnp.asarray(rng.integers(0, V, (1, 5)))
+    want = np.asarray(generate(target_ref, prompt, 10))
+    got = np.asarray(speculative_generate(
+        target_sp, draft, prompt, 10, mesh=_sp_mesh(2)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sp_decode_requires_mesh():
+    m = _gpt(sp_axis="sp")
+    m.eval()
+    with pytest.raises(ValueError, match="mesh"):
+        generate(m, jnp.zeros((1, 4), jnp.int32), 4)
+
+
+def test_sp_moe_decode_refuses():
+    nn.manual_seed(7)
+    m = _gpt(sp_axis="sp", moe_axis="data", moe_num_experts=2)
+    m.eval()
+    with pytest.raises(NotImplementedError, match="sp_axis"):
+        generate(m, jnp.zeros((1, 4), jnp.int32), 4,
+                 mesh=_sp_mesh(2))
