@@ -55,20 +55,19 @@ class BatchNorm2d_NHWC(Module):
 
     def forward(self, ctx, x, z=None):
         training = ctx.training and self.training
-        # NHWC → NCHW for the shared stats core, back after
-        xc = jnp.moveaxis(x, -1, 1)
+        # NHWC natively: the shared stats core takes the channel axis
+        # directly (channel_axis=-1) — no layout-transpose sandwich
         y, new_rm, new_rv, mb_mean, mb_riv = F.batch_norm(
-            xc, ctx.value(self.running_mean), ctx.value(self.running_var),
+            x, ctx.value(self.running_mean), ctx.value(self.running_var),
             ctx.value(self.weight), ctx.value(self.bias),
             training=training, momentum=self.momentum, eps=self.eps,
-            axis_name=self.axis_name,
+            axis_name=self.axis_name, channel_axis=-1,
             axis_index_groups=self.axis_index_groups, return_stats=True)
         if training:
             ctx.write_stat(self.running_mean, new_rm)
             ctx.write_stat(self.running_var, new_rv)
             ctx.write_stat(self.minibatch_mean, mb_mean)
             ctx.write_stat(self.minibatch_riv, mb_riv)
-        y = jnp.moveaxis(y, 1, -1)
         if z is not None:
             y = y + z
         if self.fuse_relu:

@@ -8,4 +8,4 @@ from .modules import (  # noqa: F401
     InstanceNorm3d, L1Loss, LayerNorm, LeakyReLU, Linear, MaxPool2d,
     Module, ModuleList, MSELoss, NLLLoss, ReLU, Sequential, Sigmoid,
     Softmax, Tanh, _BatchNorm, checkpoint_forward, fold_shard_into_key,
-    manual_seed)
+    manual_seed, to_channels_last)

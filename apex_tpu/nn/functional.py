@@ -62,7 +62,7 @@ def _conv_dn(ndim):
 
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, ndim,
-          transposed=False, output_padding=0):
+          transposed=False, output_padding=0, channels_last=False):
     if isinstance(stride, int):
         stride = (stride,) * ndim
     if isinstance(dilation, int):
@@ -72,7 +72,19 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, ndim,
     elif isinstance(padding, (tuple, list)) and padding and \
             isinstance(padding[0], int):
         padding = tuple((p, p) for p in padding)
-    spec = _conv_dn(ndim)
+    if channels_last:
+        # NHWC activations with the torch OIHW kernel: the MXU wants
+        # channels on the minor (lane) dimension, and NHWC keeps them
+        # there end-to-end with no layout transposes between ops (the
+        # reference's channel-last path, apex/contrib/groupbn).  Kernels
+        # stay OIHW so checkpoints are layout-independent — XLA picks
+        # its own internal kernel layout either way.
+        if transposed or ndim != 2:
+            raise ValueError(
+                "channels_last is supported for 2-d forward convs")
+        spec = ("NHWC", "OIHW", "NHWC")
+    else:
+        spec = _conv_dn(ndim)
     if transposed:
         # expressed as an input-dilated forward conv (lhs_dilation=stride),
         # which unlike lax.conv_transpose supports feature groups.  torch
@@ -105,7 +117,8 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, ndim,
             rhs_dilation=dilation, dimension_numbers=dn,
             feature_group_count=groups)
     if bias is not None:
-        y = y + bias.reshape((1, -1) + (1,) * ndim)
+        y = y + (bias if channels_last
+                 else bias.reshape((1, -1) + (1,) * ndim))
     return y
 
 
@@ -115,8 +128,10 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
 
 
 @_policied("conv2d")
-def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
-    return _conv(x, weight, bias, stride, padding, dilation, groups, 2)
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           channels_last=False):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 channels_last=channels_last)
 
 
 @_policied("conv3d")
@@ -154,8 +169,11 @@ def _warn_unbound_bn_axis(axis_name):
 @_policied("batch_norm")
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.1, eps=1e-5,
-               axis_name=None, axis_index_groups=None, return_stats=False):
-    """torch-semantics batch norm over axis 1 (NC...).
+               axis_name=None, axis_index_groups=None, return_stats=False,
+               channel_axis=1):
+    """torch-semantics batch norm over ``channel_axis`` (default 1,
+    NC...; pass -1 for channel-last NHWC activations — the reference's
+    channel_last groupbn/syncbn layout).
 
     When ``axis_name`` is given and we are inside a mapped axis, batch
     statistics are averaged across that mesh axis — this is the SyncBatchNorm
@@ -163,8 +181,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     all_gather + welford merge; here a psum of (sum, sqsum, count) is the
     TPU-native equivalent).  Returns (y, new_running_mean, new_running_var).
     """
-    reduce_axes = (0,) + tuple(range(2, x.ndim))
-    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    channel_axis = channel_axis % x.ndim
+    reduce_axes = tuple(a for a in range(x.ndim) if a != channel_axis)
+    shape = tuple(x.shape[a] if a == channel_axis else 1
+                  for a in range(x.ndim))
     xf = x.astype(jnp.float32)
     if training:
         local_count = 1
@@ -429,7 +449,8 @@ def dropout(x, p=0.5, training=True, key=None):
 # Pooling
 # ---------------------------------------------------------------------------
 
-def max_pool2d(x, kernel_size, stride=None, padding=0):
+def _pool_dims(kernel_size, stride, padding, channels_last):
+    """(window, strides, pads) for 2-d pooling in NCHW or NHWC."""
     if isinstance(kernel_size, int):
         kernel_size = (kernel_size, kernel_size)
     stride = stride or kernel_size
@@ -437,28 +458,30 @@ def max_pool2d(x, kernel_size, stride=None, padding=0):
         stride = (stride, stride)
     if isinstance(padding, int):
         padding = ((padding, padding), (padding, padding))
+    if channels_last:
+        return ((1,) + tuple(kernel_size) + (1,),
+                (1,) + tuple(stride) + (1,),
+                ((0, 0),) + tuple(padding) + ((0, 0),),
+                kernel_size)
+    return ((1, 1) + tuple(kernel_size), (1, 1) + tuple(stride),
+            ((0, 0), (0, 0)) + tuple(padding), kernel_size)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, channels_last=False):
+    window, strides, pads, _ = _pool_dims(kernel_size, stride, padding,
+                                          channels_last)
     # init must stay a Python scalar: a traced/committed array init stops
     # JAX recognizing the max monoid, breaking reverse AD under jit
     neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
         else jnp.iinfo(x.dtype).min
-    return lax.reduce_window(
-        x, neg_inf, lax.max,
-        (1, 1) + kernel_size, (1, 1) + stride,
-        ((0, 0), (0, 0)) + tuple(padding))
+    return lax.reduce_window(x, neg_inf, lax.max, window, strides, pads)
 
 
-def avg_pool2d(x, kernel_size, stride=None, padding=0):
-    if isinstance(kernel_size, int):
-        kernel_size = (kernel_size, kernel_size)
-    stride = stride or kernel_size
-    if isinstance(stride, int):
-        stride = (stride, stride)
-    if isinstance(padding, int):
-        padding = ((padding, padding), (padding, padding))
+def avg_pool2d(x, kernel_size, stride=None, padding=0, channels_last=False):
+    window, strides, pads, kernel_size = _pool_dims(
+        kernel_size, stride, padding, channels_last)
     s = lax.reduce_window(
-        x.astype(jnp.float32), 0.0, lax.add,
-        (1, 1) + kernel_size, (1, 1) + stride,
-        ((0, 0), (0, 0)) + tuple(padding))
+        x.astype(jnp.float32), 0.0, lax.add, window, strides, pads)
     return (s / (kernel_size[0] * kernel_size[1])).astype(x.dtype)
 
 
@@ -474,19 +497,24 @@ def _adaptive_pool_matrix(in_size, out_size):
     return jnp.asarray(m)
 
 
-def adaptive_avg_pool2d(x, output_size=(1, 1)):
+def adaptive_avg_pool2d(x, output_size=(1, 1), channels_last=False):
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
-    h, w = x.shape[2], x.shape[3]
+    hd, wd = ((1, 2) if channels_last else (2, 3))
+    h, w = x.shape[hd], x.shape[wd]
     oh = h if output_size[0] is None else output_size[0]
     ow = w if output_size[1] is None else output_size[1]
     x32 = x.astype(jnp.float32)
     if (oh, ow) == (1, 1):
-        return jnp.mean(x32, axis=(2, 3), keepdims=True).astype(x.dtype)
+        return jnp.mean(x32, axis=(hd, wd), keepdims=True).astype(x.dtype)
     # non-uniform adaptive windows as two small matmuls (static shapes,
     # MXU-friendly; uniform stride cases fuse to the same thing)
-    y = jnp.einsum("nchw,ph->ncpw", x32, _adaptive_pool_matrix(h, oh))
-    y = jnp.einsum("ncpw,qw->ncpq", y, _adaptive_pool_matrix(w, ow))
+    if channels_last:
+        y = jnp.einsum("nhwc,ph->npwc", x32, _adaptive_pool_matrix(h, oh))
+        y = jnp.einsum("npwc,qw->npqc", y, _adaptive_pool_matrix(w, ow))
+    else:
+        y = jnp.einsum("nchw,ph->ncpw", x32, _adaptive_pool_matrix(h, oh))
+        y = jnp.einsum("ncpw,qw->ncpq", y, _adaptive_pool_matrix(w, ow))
     return y.astype(x.dtype)
 
 

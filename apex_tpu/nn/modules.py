@@ -331,11 +331,17 @@ class _ConvNd(Module):
         else:
             self.register_parameter("bias", None)
 
+    # flipped to True by to_channels_last() on 2-d convs: activations
+    # are NHWC, the stored OIHW kernel is layout-independent
+    channels_last = False
+
     def forward(self, ctx, x):
         b = ctx.value(self.bias) if self.bias is not None else None
+        kw = {"channels_last": True} if self.channels_last else {}
         return type(self)._fn(
             x, ctx.value(self.weight), b, stride=self.stride,
-            padding=self.padding, dilation=self.dilation, groups=self.groups)
+            padding=self.padding, dilation=self.dilation,
+            groups=self.groups, **kw)
 
     def extra_repr(self):
         return (f"{self.in_channels}, {self.out_channels}, "
@@ -411,6 +417,9 @@ class _BatchNorm(Module):
             self.register_buffer("running_mean", None)
             self.register_buffer("running_var", None)
 
+    # flipped to True by to_channels_last(): stats over NHWC's last axis
+    channels_last = False
+
     # overridden by parallel.SyncBatchNorm
     def _stats_args(self):
         return dict(axis_name=None, axis_index_groups=None)
@@ -425,7 +434,9 @@ class _BatchNorm(Module):
         b = ctx.value(self.bias) if self.bias is not None else None
         y, new_rm, new_rv = F.batch_norm(
             x, rm, rv, w, b, training=training or rm is None,
-            momentum=self.momentum, eps=self.eps, **self._stats_args())
+            momentum=self.momentum, eps=self.eps,
+            channel_axis=(-1 if self.channels_last else 1),
+            **self._stats_args())
         if training and self.track_running_stats:
             ctx.write_stat(self.running_mean, new_rm)
             ctx.write_stat(self.running_var, new_rv)
@@ -608,30 +619,39 @@ class Softmax(Module):
 
 
 class MaxPool2d(Module):
+    channels_last = False
+
     def __init__(self, kernel_size, stride=None, padding=0):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
 
     def forward(self, ctx, x):
-        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            channels_last=self.channels_last)
 
 
 class AvgPool2d(Module):
+    channels_last = False
+
     def __init__(self, kernel_size, stride=None, padding=0):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
 
     def forward(self, ctx, x):
-        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            channels_last=self.channels_last)
 
 
 class AdaptiveAvgPool2d(Module):
+    channels_last = False
+
     def __init__(self, output_size=(1, 1)):
         super().__init__()
         self.output_size = output_size
 
     def forward(self, ctx, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     channels_last=self.channels_last)
 
 
 class Flatten(Module):
@@ -825,3 +845,36 @@ def fold_shard_into_key(ctx, axis_name):
     inner._key_idx = ctx._key_idx
     inner.aux_losses = ctx.aux_losses   # shared list: aux terms propagate
     return inner
+
+def to_channels_last(module, enabled=True):
+    """Flip a module tree to channels-last (NHWC) execution: 2-d convs,
+    batch norms, and 2-d pools compute directly on (B, H, W, C)
+    activations (the caller feeds NHWC inputs).  The TPU-native layout
+    lever: the MXU wants channels on the minor (lane) dimension, and
+    running the whole tree NHWC removes every inter-op layout transpose
+    XLA would otherwise insert around NCHW convs.  Weights stay OIHW —
+    checkpoints (incl. models.hf.resnet_from_torch imports) are
+    layout-independent.  In-place tree rewrite, returns the module (the
+    convert_syncbn_model convention; the reference ships channel-last
+    variants of its BN kernels, apex/contrib/groupbn and
+    optimized_sync_batchnorm.py:58).
+
+    Modules with no channels-last path — 1-d/3-d convs,
+    ConvTranspose2d, 1-d/3-d batch norms, GroupNorm, InstanceNorm —
+    make the tree refuse rather than silently mixing layouts (their
+    channel axis stays hard-coded at 1).
+    """
+    refuse = (Conv1d, Conv3d, ConvTranspose2d, BatchNorm1d, BatchNorm3d,
+              GroupNorm, _InstanceNorm)
+    # BatchNorm2d and 2-d-shaped _BatchNorm subclasses (SyncBatchNorm)
+    # flip; the dimension-specific norms above refuse first
+    flippable = (Conv2d, _BatchNorm, MaxPool2d, AvgPool2d,
+                 AdaptiveAvgPool2d)
+    for m in module.modules():
+        if isinstance(m, refuse):
+            raise ValueError(
+                f"to_channels_last: {type(m).__name__} has no "
+                f"channels-last path (2-d convs/norms/pools only)")
+        if isinstance(m, flippable):
+            m.channels_last = bool(enabled)
+    return module

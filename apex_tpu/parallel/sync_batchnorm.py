@@ -25,9 +25,11 @@ from ..nn.modules import _BatchNorm
 
 
 class SyncBatchNorm(_BatchNorm):
-    """Cross-replica BatchNorm.  ``channel_last`` accepted for reference API
-    parity (optimized_sync_batchnorm.py:58); layout is XLA's concern on TPU,
-    so it only changes the expected input layout NHWC->NCHW handling."""
+    """Cross-replica BatchNorm.  ``channel_last`` matches the reference
+    API (optimized_sync_batchnorm.py:58) and feeds _BatchNorm's native
+    channel-axis path (stats over NHWC's minor axis directly — no
+    transpose sandwich, so the channels-last layout survives through
+    the norm)."""
 
     def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
                  track_running_stats=True, process_group=None,
@@ -37,21 +39,27 @@ class SyncBatchNorm(_BatchNorm):
                          affine=affine,
                          track_running_stats=track_running_stats)
         self.process_group = process_group  # axis_index_groups
-        self.channel_last = channel_last
+        self.channel_last = channel_last    # property -> channels_last
         self.fuse_relu = fuse_relu
         self.axis_name = axis_name
+
+    # one flag, two spellings: the reference API says channel_last,
+    # _BatchNorm's layout switch (nn.to_channels_last) says channels_last
+    @property
+    def channel_last(self):
+        return self.channels_last
+
+    @channel_last.setter
+    def channel_last(self, v):
+        self.channels_last = v
 
     def _stats_args(self):
         return dict(axis_name=self.axis_name,
                     axis_index_groups=self.process_group)
 
     def forward(self, ctx, x):
-        if self.channel_last:
-            x = x.swapaxes(1, -1)
         y = super().forward(ctx, x)
         if self.fuse_relu:
             from ..nn import functional as F
             y = F.relu(y)
-        if self.channel_last:
-            y = y.swapaxes(1, -1)
         return y

@@ -42,6 +42,11 @@ QUEUE=(
   "timeout 700 python bench.py --dcgan --no-kernels"
   "timeout 700 python bench.py --profile --llama"
   "DIAG_FULL=1 bash diagnose_gpt1024.sh >>diagnose_stdout.log 2>&1"
+  # channels-last A/B arm (appended round 4: nn.to_channels_last) — the
+  # conv-layout lever against the 0.28-MFU NCHW headline, plus its
+  # profile attribution
+  "timeout 700 python bench.py --nhwc --no-kernels"
+  "timeout 700 python bench.py --profile --nhwc"
 )
 
 probe() {

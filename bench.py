@@ -348,6 +348,7 @@ def _run_kernel_checks_inner(mode, results, rng):
 
 
 def run_profile(kind, batch, seq_len, top_n=15, plain_loss=False,
+                nhwc=False,
                 remat=False, size="small"):
     """Measured per-op-family attribution of one train step — the
     diagnosis tool behind the MFU numbers (VERDICT r2 weak #2: ResNet
@@ -375,7 +376,7 @@ def run_profile(kind, batch, seq_len, top_n=15, plain_loss=False,
                                               remat=remat,
                                               plain_loss=plain_loss)
     else:
-        step, arrays, _, _ = build_resnet_step(batch)
+        step, arrays, _, _ = build_resnet_step(batch, nhwc=nhwc)
 
     stage("profile", f"{kind} batch={batch}")
     rows, report = profile_step(step._raw_step_fn, step.state, *arrays)
@@ -1091,7 +1092,7 @@ def run_dcgan_throughput(batch, iters, warmup):
                               sync_state=sync)
 
 
-def build_resnet_step(batch):
+def build_resnet_step(batch, nhwc=False):
     import jax.numpy as jnp
     import numpy as np
 
@@ -1101,9 +1102,13 @@ def build_resnet_step(batch):
     from apex_tpu.optimizers import FusedSGD
     from apex_tpu.training import make_train_step
 
-    stage("model_build", f"resnet50 batch={batch}")
+    stage("model_build", f"resnet50 batch={batch} nhwc={nhwc}")
     nn.manual_seed(0)
     model = resnet50(num_classes=1000)
+    if nhwc:
+        # channels-last A/B arm: same OIHW weights, NHWC activations
+        # end-to-end (nn.to_channels_last) — the conv-layout MFU lever
+        nn.to_channels_last(model)
     opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9,
                    weight_decay=1e-4)
     step = make_train_step(
@@ -1111,14 +1116,15 @@ def build_resnet_step(batch):
         half_dtype=jnp.bfloat16, loss_scale=1.0)
 
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((batch, 3, 224, 224)), jnp.float32)
+    shape = (batch, 224, 224, 3) if nhwc else (batch, 3, 224, 224)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
     y = jnp.asarray(rng.integers(0, 1000, (batch,)))
 
     return step, (x, y), (lambda: resnet50_step_flops(batch)), 0.0
 
 
-def run_throughput(batch, iters, warmup):
-    step, arrays, af, _ = build_resnet_step(batch)
+def run_throughput(batch, iters, warmup, nhwc=False):
+    step, arrays, af, _ = build_resnet_step(batch, nhwc=nhwc)
     stage("compile", f"batch={batch}")
     return time_compiled_step(step, arrays, iters, warmup, af)
 
@@ -1164,6 +1170,10 @@ def main():
     ap.add_argument("--dcgan", action="store_true",
                     help="DCGAN 64x64 multi-model/multi-loss amp "
                          "iteration (BASELINE config 5)")
+    ap.add_argument("--nhwc", action="store_true",
+                    help="channels-last (NHWC) arm of the resnet config "
+                         "(nn.to_channels_last): the conv-layout MFU "
+                         "lever — A/B against the default NCHW run")
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--gpt-size", default="small",
                     choices=["small", "medium"],
@@ -1231,6 +1241,9 @@ def main():
         if args.dcgan:
             return ("dcgan64_multi_loss_images_per_sec_per_chip_ampO1",
                     "images/sec/chip")
+        if args.nhwc:
+            return ("resnet50_imagenet_nhwc_images_per_sec_per_chip_"
+                    "ampO2", "images/sec/chip")
         return "resnet50_imagenet_images_per_sec_per_chip_ampO2", \
             "images/sec/chip"
 
@@ -1242,6 +1255,12 @@ def main():
     if (args.int8 or args.kv_int8) and not args.gpt_decode:
         fail("int8_unsupported_config: --int8/--kv-int8 are quantized "
              "DECODE measurements; pair them with --gpt-decode")
+        return 1
+    if args.nhwc and (args.bert or args.gpt or args.llama or args.seq2seq
+                      or args.vit or args.dcgan or args.gpt_decode
+                      or args.spec_decode):
+        fail("nhwc_unsupported_config: --nhwc is the channels-last arm "
+             "of the resnet config (default / --sweep / --profile)")
         return 1
     if args.profile and (args.seq2seq or args.gpt_decode or args.vit
                          or args.dcgan):
@@ -1280,6 +1299,7 @@ def main():
         try:
             res = run_profile(kind, batch, args.seq_len,
                               plain_loss=args.plain_loss,
+                              nhwc=args.nhwc,
                               remat=args.remat, size=args.gpt_size)
         except Exception as e:
             fail(f"profile_failed: {type(e).__name__}: {e}")
@@ -1376,7 +1396,8 @@ def main():
             return run_vit_throughput(batch, args.iters, args.warmup)
         if args.dcgan:
             return run_dcgan_throughput(batch, args.iters, args.warmup)
-        return run_throughput(batch, args.iters, args.warmup)
+        return run_throughput(batch, args.iters, args.warmup,
+                              nhwc=args.nhwc)
 
     if args.sweep:
         # batch sweep in ONE process (warm backend shared): one JSON line
@@ -1387,7 +1408,8 @@ def main():
                "llama_125m" if args.llama else
                "seq2seq" if args.seq2seq else
                "vit_s16" if args.vit else
-               "dcgan64" if args.dcgan else "resnet50")
+               "dcgan64" if args.dcgan else
+               "resnet50_nhwc" if args.nhwc else "resnet50")
         peak, kind = peak_tflops(devices[0])
         ok = 0
         for batch in sweep_batches:

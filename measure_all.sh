@@ -25,6 +25,7 @@ run() {
 }
 
 run                                   # resnet50 headline + kernels
+run --nhwc --no-kernels               # channels-last A/B arm
 run --bert
 run --gpt
 run --llama

@@ -1,0 +1,165 @@
+"""Channels-last (NHWC) execution (nn.to_channels_last + the
+channel-axis paths in nn/functional.py): the layout flip must be
+numerically invisible — same logits, same parameter gradients — with
+weights stored identically (OIHW) in both layouts.
+
+Reference analogue: the channel-last kernel variants in
+apex/contrib/groupbn and apex/parallel/optimized_sync_batchnorm.py:58;
+oracle style follows SURVEY.md §4 (fused/alternate path == reference
+path numerics)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+import apex_tpu.nn.functional as F
+from apex_tpu.models.resnet import resnet18
+from apex_tpu.nn.modules import Ctx
+
+
+def _nhwc(x):
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def test_conv2d_channels_last_matches(rng):
+    x = jnp.asarray(rng.standard_normal((2, 5, 12, 12)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((7, 5, 3, 3)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((7,)), jnp.float32)
+    want = F.conv2d(x, w, b, stride=2, padding=1)
+    got = F.conv2d(_nhwc(x), w, b, stride=2, padding=1,
+                   channels_last=True)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_nhwc(want)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_conv2d_channels_last_matches(rng):
+    x = jnp.asarray(rng.standard_normal((2, 6, 8, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((4, 3, 3, 3)), jnp.float32)
+    want = F.conv2d(x, w, None, padding=1, groups=2)
+    got = F.conv2d(_nhwc(x), w, None, padding=1, groups=2,
+                   channels_last=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(_nhwc(want)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pools_channels_last_match(rng):
+    x = jnp.asarray(rng.standard_normal((2, 4, 11, 11)), jnp.float32)
+    for f, kw in ((F.max_pool2d, dict(kernel_size=3, stride=2, padding=1)),
+                  (F.avg_pool2d, dict(kernel_size=2)),
+                  (F.adaptive_avg_pool2d, dict(output_size=(1, 1))),
+                  (F.adaptive_avg_pool2d, dict(output_size=(3, 5)))):
+        want = f(x, **kw)
+        got = f(_nhwc(x), channels_last=True, **kw)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_nhwc(want)),
+                                   rtol=1e-5, atol=1e-5, err_msg=str(kw))
+
+
+def test_batch_norm_channel_axis_matches(rng):
+    x = jnp.asarray(rng.standard_normal((3, 5, 6, 6)), jnp.float32) + 2.0
+    rm = jnp.zeros((5,))
+    rv = jnp.ones((5,))
+    w = jnp.asarray(rng.standard_normal((5,)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((5,)), jnp.float32)
+    want, wrm, wrv = F.batch_norm(x, rm, rv, w, b, training=True)
+    got, grm, grv = F.batch_norm(_nhwc(x), rm, rv, w, b, training=True,
+                                 channel_axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(_nhwc(want)),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grm), np.asarray(wrm), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grv), np.asarray(wrv), rtol=1e-6)
+
+
+def test_resnet_channels_last_forward_and_grads_match(rng):
+    """The MFU-lever flow: the same ResNet weights run NCHW and NHWC;
+    logits and every parameter gradient agree (layout is numerically
+    invisible, OIHW weights shared)."""
+    nn.manual_seed(0)
+    model = resnet18(num_classes=7, small_input=True)
+    nn.manual_seed(0)
+    model_cl = nn.to_channels_last(resnet18(num_classes=7,
+                                            small_input=True))
+    for a, b in zip(model.parameters(), model_cl.parameters()):
+        b.data = a.data
+
+    x = jnp.asarray(rng.standard_normal((2, 3, 16, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 7, (2,)))
+
+    def loss_of(m, params, xin):
+        vals = list(params)
+        ps = list(m.parameters())
+        ctx = Ctx(env={id(p): v for p, v in zip(ps, vals)},
+                  stats_out={}, training=True)
+        logits = m.forward(ctx, xin)
+        return F.cross_entropy(logits, y), logits
+
+    p0 = [p.data for p in model.parameters()]
+    (want_l, want_logits), want_g = jax.value_and_grad(
+        lambda ps: loss_of(model, ps, x), has_aux=True)(p0)
+    (got_l, got_logits), got_g = jax.value_and_grad(
+        lambda ps: loss_of(model_cl, ps, _nhwc(x)), has_aux=True)(p0)
+
+    np.testing.assert_allclose(np.asarray(got_logits),
+                               np.asarray(want_logits),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(got_l), float(want_l), rtol=1e-5)
+    for ga, gb in zip(want_g, got_g):
+        np.testing.assert_allclose(np.asarray(gb), np.asarray(ga),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_resnet_channels_last_eval_uses_running_stats(rng):
+    nn.manual_seed(1)
+    model = resnet18(num_classes=5, small_input=True)
+    model.eval()
+    nn.manual_seed(1)
+    model_cl = nn.to_channels_last(resnet18(num_classes=5,
+                                            small_input=True))
+    model_cl.eval()
+    for a, b in zip(model.parameters(), model_cl.parameters()):
+        b.data = a.data
+    x = jnp.asarray(rng.standard_normal((2, 3, 16, 16)), jnp.float32)
+    ctx = Ctx(training=False)
+    want = model.forward(ctx, x)
+    got = model_cl.forward(Ctx(training=False), _nhwc(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_to_channels_last_refuses_conv_transpose():
+    nn.manual_seed(2)
+    gen = nn.Sequential(nn.ConvTranspose2d(4, 8, 4, stride=2),
+                        nn.ReLU())
+    with pytest.raises(ValueError, match="ConvTranspose2d"):
+        nn.to_channels_last(gen)
+
+
+def test_to_channels_last_refuses_axis1_norms():
+    """Norms whose channel axis stays hard-coded at 1 refuse instead of
+    silently normalizing the wrong axis under NHWC."""
+    nn.manual_seed(2)
+    for bad in (nn.GroupNorm(2, 4), nn.InstanceNorm2d(4),
+                nn.BatchNorm1d(4), nn.BatchNorm3d(4)):
+        tree = nn.Sequential(nn.Conv2d(3, 4, 3), bad)
+        with pytest.raises(ValueError, match="channels-last path"):
+            nn.to_channels_last(tree)
+
+
+def test_sync_batchnorm_channel_last_native_axis(rng):
+    """SyncBatchNorm(channel_last=True) normalizes NHWC natively (no
+    transpose sandwich) and matches the NCHW module's numbers."""
+    from apex_tpu.parallel import SyncBatchNorm
+
+    bn = SyncBatchNorm(6, axis_name="data")
+    bn_cl = SyncBatchNorm(6, channel_last=True, axis_name="data")
+    for a, b in zip(bn.parameters(), bn_cl.parameters()):
+        b.data = a.data
+    x = jnp.asarray(rng.standard_normal((2, 6, 4, 4)), jnp.float32)
+    # outside shard_map the axis is unbound -> local stats (warned path)
+    want = bn.forward(Ctx(training=True, stats_out={}), x)
+    got = bn_cl.forward(Ctx(training=True, stats_out={}), _nhwc(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(_nhwc(want)),
+                               rtol=1e-5, atol=1e-5)
+    assert bn_cl.channel_last is True    # reference-API spelling intact
