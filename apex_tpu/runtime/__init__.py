@@ -73,11 +73,14 @@ def _get():
                         ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
                         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
                         ctypes.c_int]
-                    lib.apex_normalize_u8_nhwc_to_f32_nchw.argtypes = [
-                        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-                        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-                        ctypes.POINTER(ctypes.c_float),
-                        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+                    for nrm in ("apex_normalize_u8_nhwc_to_f32_nchw",
+                                "apex_normalize_u8_nhwc_to_f32_nhwc"):
+                        getattr(lib, nrm).argtypes = [
+                            ctypes.c_void_p, ctypes.c_void_p,
+                            ctypes.c_int64, ctypes.c_int64,
+                            ctypes.c_int64, ctypes.c_int64,
+                            ctypes.POINTER(ctypes.c_float),
+                            ctypes.POINTER(ctypes.c_float), ctypes.c_int]
                     lib.apex_f32_to_bf16.argtypes = [
                         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                         ctypes.c_int]
@@ -171,6 +174,29 @@ def normalize_u8_nhwc_to_f32_nchw(batch, mean, std, threads: int = 0):
     return out
 
 
+def normalize_u8_nhwc_to_f32_nhwc(batch, mean, std, threads: int = 0):
+    """uint8 (N,H,W,C) → float32 (N,H,W,C), (x/255 - mean)/std fused,
+    layout-preserving — the input path for channels-last models
+    (nn.to_channels_last): the decode layout IS the compute layout, so
+    the transpose disappears from the pipeline entirely."""
+    batch = _as_contig(np.asarray(batch, np.uint8))
+    n, h, w, c = batch.shape
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    if mean.shape != (c,) or std.shape != (c,):
+        raise ValueError(f"mean/std must have shape ({c},)")
+    lib = _get()
+    if lib is None:
+        x = batch.astype(np.float32) / 255.0
+        return np.ascontiguousarray((x - mean) / std)
+    out = np.empty((n, h, w, c), np.float32)
+    lib.apex_normalize_u8_nhwc_to_f32_nhwc(
+        batch.ctypes.data, out.ctypes.data, n, h, w, c,
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), threads)
+    return out
+
+
 def f32_to_bf16(x, threads: int = 0):
     """Bulk float32 → bfloat16 (round-to-nearest-even) on host."""
     import ml_dtypes
@@ -186,4 +212,5 @@ def f32_to_bf16(x, threads: int = 0):
 from .data import DataPrefetcher  # noqa: E402,F401
 
 __all__ = ["flatten", "unflatten", "normalize_u8_nhwc_to_f32_nchw",
-           "f32_to_bf16", "available", "DataPrefetcher"]
+           "normalize_u8_nhwc_to_f32_nhwc", "f32_to_bf16", "available",
+           "DataPrefetcher"]

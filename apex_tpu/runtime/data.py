@@ -29,8 +29,13 @@ class DataPrefetcher:
     """
 
     def __init__(self, loader, mean=None, std=None, half_dtype=None,
-                 device=None, depth: int = 2, threads: int = 0):
+                 device=None, depth: int = 2, threads: int = 0,
+                 channels_last: bool = False):
         self.loader = iter(loader)
+        # channels_last: keep uint8 batches NHWC through the normalize
+        # (for nn.to_channels_last models — the decode layout IS the
+        # compute layout, no transpose anywhere on the input path)
+        self.channels_last = channels_last
         self.mean = np.asarray(
             mean if mean is not None else [0.485, 0.456, 0.406], np.float32)
         self.std = np.asarray(
@@ -45,11 +50,13 @@ class DataPrefetcher:
         self._worker.start()
 
     def _prepare(self, images):
-        from . import f32_to_bf16, normalize_u8_nhwc_to_f32_nchw
+        from . import (f32_to_bf16, normalize_u8_nhwc_to_f32_nchw,
+                       normalize_u8_nhwc_to_f32_nhwc)
         images = np.asarray(images)
         if images.dtype == np.uint8 and images.ndim == 4:
-            images = normalize_u8_nhwc_to_f32_nchw(
-                images, self.mean, self.std, self.threads)
+            norm = (normalize_u8_nhwc_to_f32_nhwc if self.channels_last
+                    else normalize_u8_nhwc_to_f32_nchw)
+            images = norm(images, self.mean, self.std, self.threads)
         if self.half_dtype is not None:
             import jax.numpy as jnp
             if jnp.dtype(self.half_dtype) == jnp.bfloat16 and \

@@ -96,6 +96,34 @@ void apex_normalize_u8_nhwc_to_f32_nchw(const uint8_t* src, float* dst,
   });
 }
 
+// Layout-preserving variant for channels-last models (nn.to_channels_last):
+// uint8 NHWC → float32 NHWC, same per-channel normalize, no transpose — the
+// channel sweep stays the inner (contiguous) loop on both sides.
+void apex_normalize_u8_nhwc_to_f32_nhwc(const uint8_t* src, float* dst,
+                                        int64_t n, int64_t h, int64_t w,
+                                        int64_t c, const float* mean,
+                                        const float* stdv, int threads) {
+  std::vector<float> scale(static_cast<size_t>(c)), bias(
+      static_cast<size_t>(c));
+  for (int64_t ch = 0; ch < c; ++ch) {
+    scale[ch] = 1.0f / (255.0f * stdv[ch]);
+    bias[ch] = -mean[ch] / stdv[ch];
+  }
+  // split n*h ways (rows are layout-contiguous; channels are
+  // interleaved) so small batches still fan out across cores — the
+  // NCHW sibling's n*c granularity, adapted to this layout
+  parallel_for(n * h, threads, [&](int64_t job) {
+    const int64_t off = job * w * c;
+    const uint8_t* s = src + off;
+    float* d = dst + off;
+    for (int64_t i = 0; i < w; ++i) {
+      for (int64_t ch = 0; ch < c; ++ch) {
+        d[i * c + ch] = s[i * c + ch] * scale[ch] + bias[ch];
+      }
+    }
+  });
+}
+
 // float32 → bfloat16 (round-to-nearest-even) bulk cast: host-side half of
 // feeding bf16 batches without paying an on-device cast + extra transfer.
 void apex_f32_to_bf16(const float* src, uint16_t* dst, int64_t n,

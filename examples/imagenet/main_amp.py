@@ -50,6 +50,11 @@ def parse_args():
                    help="pyprof op capture + analysis for one iteration")
     p.add_argument("--synthetic", action="store_true",
                    help="generated data instead of an ImageFolder tree")
+    p.add_argument("--channels-last", action="store_true",
+                   help="NHWC execution (nn.to_channels_last): convs/BN/"
+                        "pools compute channels-minor, and the input "
+                        "pipeline skips its layout transpose — the TPU "
+                        "conv-layout lever (docs/performance.md)")
     p.add_argument("--image-size", type=int, default=224)
     return p.parse_args()
 
@@ -120,7 +125,10 @@ def main():
     else:
         model = getattr(models, args.arch)(num_classes=1000)
     if args.sync_bn:
-        model = parallel.convert_syncbn_model(model)
+        model = parallel.convert_syncbn_model(
+            model, channel_last=args.channels_last)
+    if args.channels_last:
+        model = nn.to_channels_last(model)
     optimizer = FusedSGD(list(model.parameters()), lr=args.lr,
                          momentum=args.momentum,
                          weight_decay=args.weight_decay)
@@ -159,7 +167,8 @@ def main():
         batch_time, losses = AverageMeter(), AverageMeter()
         loader = synthetic_loader(args) if args.synthetic else \
             folder_loader(args)
-        prefetcher = runtime.DataPrefetcher(loader, half_dtype=half)
+        prefetcher = runtime.DataPrefetcher(
+            loader, half_dtype=half, channels_last=args.channels_last)
         end = time.time()
         i = 0
         inp, target = prefetcher.next()

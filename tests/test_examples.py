@@ -189,3 +189,28 @@ def test_llama_tp_serve_example_runs():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "bit-identical to single-shard: True" in out.stdout
     assert "exact match with tp int8 decode: True" in out.stdout
+
+
+def test_imagenet_channels_last_example_runs(tmp_path):
+    """The flagship example's NHWC arm: to_channels_last model + the
+    layout-preserving prefetcher train end-to-end (tiny synthetic).
+    ONE device: the eager DDP loop's per-op compiles desynchronize
+    multi-device rendezvous on a single CPU core (40s timeout); DDP
+    collectives are covered by the fused-step and distributed suites —
+    this test is about the layout path."""
+    env = dict(os.environ, PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    script = os.path.join(REPO, "examples", "imagenet", "main_amp.py")
+    code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.argv = ['main_amp.py', '--synthetic', "
+            f"'--channels-last', '-a', 'resnet18', '-b', '8', "
+            f"'--image-size', '32', '--iters-per-epoch', '4', "
+            f"'--print-freq', '2', "
+            f"'--checkpoint', {str(tmp_path / 'ck.pkl')!r}]; "
+            f"import runpy; runpy.run_path({script!r}, "
+            f"run_name='__main__')")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "img/s" in out.stdout or "loss" in out.stdout.lower()

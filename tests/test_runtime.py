@@ -57,6 +57,32 @@ def test_normalize_u8(rng):
     np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
 
 
+def test_normalize_u8_channels_last(rng):
+    """The layout-preserving variant: same arithmetic, NHWC out, and
+    equal to the NCHW variant up to the transpose."""
+    batch = rng.integers(0, 256, (4, 10, 12, 3), dtype=np.uint8)
+    mean = np.array([0.485, 0.456, 0.406], np.float32)
+    std = np.array([0.229, 0.224, 0.225], np.float32)
+    out = runtime.normalize_u8_nhwc_to_f32_nhwc(batch, mean, std)
+    ref = (batch.astype(np.float32) / 255.0 - mean) / std
+    assert out.shape == (4, 10, 12, 3)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+    nchw = runtime.normalize_u8_nhwc_to_f32_nchw(batch, mean, std)
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), nchw,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_data_prefetcher_channels_last(rng):
+    batches = [(rng.integers(0, 256, (2, 4, 4, 3), dtype=np.uint8),
+                np.zeros(2))]
+    pf = runtime.DataPrefetcher(batches, channels_last=True)
+    inp, _ = pf.next()
+    assert inp.shape == (2, 4, 4, 3)    # NHWC preserved
+    ref = runtime.normalize_u8_nhwc_to_f32_nhwc(
+        batches[0][0], pf.mean, pf.std)
+    np.testing.assert_allclose(np.asarray(inp), ref, rtol=1e-6)
+
+
 def test_f32_to_bf16_rne(rng):
     import ml_dtypes
     x = rng.standard_normal(10000).astype(np.float32)
