@@ -1,6 +1,7 @@
 """Inference-side utilities: weight-only int8 quantization for the
-bandwidth-bound decode path (quant.py) and draft-verified greedy
-speculative decoding (speculative.py)."""
+bandwidth-bound decode path (quant.py), draft-verified greedy
+speculative decoding (speculative.py), and beam search (beam.py)."""
+from .beam import beam_generate  # noqa: F401
 from .quant import (QuantKV, QuantTensor, gather_rows,  # noqa: F401
                     kv_value, kv_write, make_kv_cache,
                     quantize_int8, quantize_tensor_int8)

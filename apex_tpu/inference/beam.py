@@ -1,0 +1,153 @@
+"""Beam-search decoding over the LM families' cache protocol.
+
+The deterministic third leg of the decode stack next to
+greedy/sampled ``models.gpt.generate`` and
+``inference.speculative_generate``: keep the ``num_beams`` highest
+cumulative-log-prob continuations per batch item, expanding all beams
+in one batched cache pass per step.  (The reference is training-side
+only, SURVEY.md §2 — the decode stack has no reference counterpart;
+the algorithm is the standard fixed-width beam search.)
+
+TPU shape: beams fold into the batch dimension (caches and token
+buffers are ``(B*K, ...)``), every step is one ``decode_step`` + one
+``top_k`` over ``K*V`` candidates per item, and the per-step beam
+reordering is a gather on the batch-beam axis — all static shapes
+inside one ``lax.scan``, compiled once per config (the
+``compiled_run_cache`` convention).
+
+Scoring is the plain sum of token log-probs (no length penalty); with
+``eos_id`` set, a finished beam freezes its score and pads with
+``eos_id`` while continuing to compete for the final ranking.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def beam_generate(model, prompt_ids, max_new_tokens, num_beams,
+                  eos_id=None, cache_dtype=None, mesh=None):
+    """Beam-search continuation of ``prompt_ids (B, P)``: returns the
+    best beam per item, ``(B, P + max_new_tokens)`` int32.
+
+    ``num_beams=1`` reduces exactly to greedy ``generate``.
+    ``cache_dtype`` follows generate's contract (``"int8"`` for the
+    quantized KV cache).  Sharded decode follows generate's mesh
+    convention: a model built with ``tp_axis``/``moe_axis``/``sp_axis``
+    passes ``mesh`` and the whole search runs inside ``shard_map``
+    (replicated tokens; the beam bookkeeping is identical on every
+    device, so the emitted beams are too).
+    """
+    from ..models.gpt import _check_decode_mesh, _sharded_decode_axes
+    from ..nn.modules import Ctx
+    from ..utils.jit_cache import compiled_run_cache
+
+    b, p = prompt_ids.shape
+    k = int(num_beams)
+    if k < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    s_total = p + max_new_tokens
+    if s_total > model.max_positions:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_positions {model.max_positions}")
+    missing = [a for a in ("init_caches", "prefill", "decode_step")
+               if not hasattr(model, a)]
+    if missing:
+        raise ValueError(
+            f"beam_generate needs model.{missing[0]} (the GPT/Llama "
+            f"cache protocol)")
+    vocab = model.tok_emb.weight.shape[0]
+    if k > vocab:
+        raise ValueError(f"num_beams ({k}) exceeds vocab ({vocab})")
+    if eos_id is not None and not 0 <= eos_id < vocab:
+        raise ValueError(f"eos_id {eos_id} out of vocab range {vocab}")
+    guard = getattr(model, "_decode_guard", None)
+    if guard is not None:
+        guard("beam_generate")
+    _check_decode_mesh(model, mesh, what="beam_generate")
+    if mesh is not None and not _sharded_decode_axes(model):
+        raise ValueError(
+            "mesh was passed but the model has no tp_axis/moe_axis/"
+            "sp_axis — single-shard decode needs no mesh")
+
+    params = list(model.parameters())
+    buffers = list(model.buffers())
+    vals = [q.data for q in params] + [bu.data for bu in buffers]
+    if cache_dtype is None:
+        cache_dtype = model.tok_emb.weight.data.dtype
+    NEG = jnp.float32(-1e30)
+
+    def run(vals, prompt):
+        env = {id(o): v for o, v in zip(params + buffers, vals)}
+        ctx = Ctx(env=env, stats_out={}, training=False)
+        # prefill ONCE at batch B (the FLOP-dominant phase for long
+        # prompts), then fan the caches out item-major to (B*K, ...) —
+        # beams of item i occupy rows i*k..i*k+k-1, the layout every
+        # later gather assumes
+        caches = model.init_caches(b, s_total, dtype=cache_dtype)
+        logits, caches = model.prefill(ctx, prompt, caches)
+        caches = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, k, axis=0), caches)
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32))        # (B, V)
+        scores, tok = jax.lax.top_k(logp, k)          # (B, K) twice
+        alive = (tok != eos_id) if eos_id is not None \
+            else jnp.ones((b, k), bool)
+        buf = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+        buf = buf.at[:, :, 0].set(tok)
+
+        def step(carry, t):
+            tok, scores, alive, buf, caches = carry
+            logits, caches = model.decode_step(
+                ctx, tok.reshape(b * k), caches, t)
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32)).reshape(b, k, vocab)
+            if eos_id is not None:
+                # finished beams: only continuation is eos at +0, so
+                # the frozen score keeps competing in the rankings
+                frozen = jnp.full((vocab,), NEG).at[eos_id].set(0.0)
+                logp = jnp.where(alive[:, :, None], logp,
+                                 frozen[None, None, :])
+            cand = (scores[:, :, None] + logp).reshape(b, k * vocab)
+            scores, idx = jax.lax.top_k(cand, k)      # (B, K)
+            beam = idx // vocab                       # source beam
+            tok = (idx % vocab).astype(jnp.int32)
+            rows = (jnp.arange(b)[:, None] * k + beam).reshape(-1)
+            caches = jax.tree_util.tree_map(
+                lambda c: jnp.take(c, rows, axis=0), caches)
+            buf = jnp.take_along_axis(buf, beam[:, :, None], axis=1)
+            buf = jax.lax.dynamic_update_slice(
+                buf, tok[:, :, None], (0, 0, t - p + 1))
+            alive = jnp.take_along_axis(alive, beam, axis=1)
+            if eos_id is not None:
+                alive = alive & (tok != eos_id)
+            return (tok, scores, alive, buf, caches), ()
+
+        if max_new_tokens > 1:
+            (tok, scores, alive, buf, caches), _ = jax.lax.scan(
+                step, (tok, scores, alive, buf, caches),
+                jnp.arange(p, s_total - 1))
+        best = jnp.argmax(scores, axis=1)             # (B,)
+        seq = jnp.take_along_axis(
+            buf, best[:, None, None], axis=1)[:, 0]   # (B, T)
+        return jnp.concatenate([prompt, seq], axis=1)
+
+    def build():
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as _P
+            return jax.jit(jax.shard_map(
+                run, mesh=mesh, in_specs=(_P(), _P()), out_specs=_P(),
+                check_vma=False))
+        return jax.jit(run)
+
+    fn = compiled_run_cache(
+        model, "_beam_jit_cache",
+        (b, p, max_new_tokens, k, eos_id,
+         cache_dtype if isinstance(cache_dtype, str)
+         else jnp.dtype(cache_dtype).name, mesh),
+        params + buffers, build)
+    return fn(vals, prompt_ids)

@@ -1,0 +1,191 @@
+"""Beam-search decoding (inference/beam.py): fixed-width beam search
+over the LM families' cache protocol.
+
+Oracles: (1) num_beams=1 must equal greedy generate token-for-token;
+(2) an independently-written numpy reference beam search — scoring
+candidates with the model's TEACHER-FORCED forward (no caches, no
+scan) — must emit the same best sequence; (3) eos freezes a beam's
+score while it keeps competing.  Reference analogue: none (the
+reference is training-side, SURVEY.md §2); oracle style per §4."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import apex_tpu.nn as nn
+from apex_tpu.inference import beam_generate
+from apex_tpu.models import GptModel
+from apex_tpu.models.gpt import generate
+from apex_tpu.models.llama import LlamaModel
+from apex_tpu.nn.modules import Ctx
+
+V = 23
+
+
+def _gpt(**kw):
+    nn.manual_seed(3)
+    return GptModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                    max_positions=32, dropout=0.0, attn_dropout=0.0, **kw)
+
+
+def _llama(**kw):
+    nn.manual_seed(3)
+    return LlamaModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                      kv_heads=2, max_positions=32, **kw)
+
+
+def _np_beam_reference(model, prompt, n_new, k, eos_id=None):
+    """Plain-python beam search scoring every candidate with the
+    model's teacher-forced forward — no caches, no scan, no top_k —
+    the independent oracle for the compiled implementation."""
+    ctx = Ctx(training=False)
+
+    def next_logp(seq):
+        ids = jnp.asarray(np.asarray(seq)[None, :])
+        logits = model.forward(ctx, ids)
+        return np.asarray(jax.nn.log_softmax(
+            logits[0, -1].astype(jnp.float32)))
+
+    outs = []
+    for row in np.asarray(prompt):
+        beams = [(list(row), 0.0, True)]      # (seq, score, alive)
+        for _ in range(n_new):
+            cand = []
+            for seq, score, alive in beams:
+                if not alive:
+                    cand.append((seq + [eos_id], score, False))
+                    continue
+                lp = next_logp(seq)
+                for v in range(V):
+                    a = not (eos_id is not None and v == eos_id)
+                    cand.append((seq + [v], score + lp[v], a))
+            cand.sort(key=lambda c: -c[1])
+            beams = cand[:k]
+        outs.append(beams[0][0])
+    return np.asarray(outs)
+
+
+def test_beam1_equals_greedy(rng):
+    m = _gpt()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (2, 4)))
+    want = np.asarray(generate(m, prompt, 8))
+    got = np.asarray(beam_generate(m, prompt, 8, num_beams=1))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_matches_numpy_reference(rng):
+    m = _gpt()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (2, 3)))
+    got = np.asarray(beam_generate(m, prompt, 5, num_beams=3))
+    want = _np_beam_reference(m, prompt, 5, 3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_llama_matches_numpy_reference(rng):
+    m = _llama()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 3)))
+    got = np.asarray(beam_generate(m, prompt, 4, num_beams=4))
+    want = _np_beam_reference(m, prompt, 4, 4)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_eos_freezes_and_pads(rng):
+    """With eos in vocab, a finished beam pads with eos and its frozen
+    score still competes — match the numpy reference with the same
+    convention."""
+    m = _gpt()
+    m.eval()
+    eos = 5
+    prompt = jnp.asarray(rng.integers(0, V, (2, 3)))
+    got = np.asarray(beam_generate(m, prompt, 5, num_beams=3,
+                                   eos_id=eos))
+    want = _np_beam_reference(m, prompt, 5, 3, eos_id=eos)
+    np.testing.assert_array_equal(got, want)
+    # every token after an eos is eos
+    for row in got:
+        tail = row[3:]
+        hits = np.where(tail == eos)[0]
+        if hits.size:
+            assert (tail[hits[0]:] == eos).all()
+
+
+def test_beam_beats_or_ties_greedy_logprob(rng):
+    """The point of the search: the beam result's total log-prob is
+    >= greedy's on the same model (scored teacher-forced)."""
+    m = _gpt()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 3)))
+    n = 6
+
+    def total_logp(seq):
+        ctx = Ctx(training=False)
+        ids = jnp.asarray(seq[None, :])
+        logits = m.forward(ctx, ids)
+        lp = np.asarray(jax.nn.log_softmax(
+            logits[0].astype(jnp.float32)))
+        return sum(lp[t, seq[t + 1]] for t in range(2, 2 + n))
+
+    greedy = np.asarray(generate(m, prompt, n))[0]
+    beam = np.asarray(beam_generate(m, prompt, n, num_beams=4))[0]
+    assert total_logp(beam) >= total_logp(greedy) - 1e-5
+
+
+def test_beam_int8_cache_runs(rng):
+    m = _gpt()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 3)))
+    out = beam_generate(m, prompt, 4, num_beams=2, cache_dtype="int8")
+    assert out.shape == (1, 7)
+    assert (np.asarray(out)[:, :3] == np.asarray(prompt)).all()
+
+
+def test_beam_tp_matches_single_shard(rng):
+    m_ref = _gpt()
+    m_ref.eval()
+    m_tp = _gpt(tp_axis="tp")
+    m_tp.eval()
+    for a, b in zip(m_ref.parameters(), m_tp.parameters()):
+        b.data = a.data
+    mesh = Mesh(np.array(jax.devices())[:2].reshape(2), ("tp",))
+    prompt = jnp.asarray(rng.integers(0, V, (2, 4)))
+    want = np.asarray(beam_generate(m_ref, prompt, 6, num_beams=3))
+    got = np.asarray(beam_generate(m_tp, prompt, 6, num_beams=3,
+                                   mesh=mesh))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_sp_matches_single_shard(rng):
+    m_ref = _gpt()
+    m_ref.eval()
+    m_sp = _gpt(sp_axis="sp")
+    m_sp.eval()
+    for a, b in zip(m_ref.parameters(), m_sp.parameters()):
+        b.data = a.data
+    mesh = Mesh(np.array(jax.devices())[:2].reshape(2), ("sp",))
+    prompt = jnp.asarray(rng.integers(0, V, (1, 4)))
+    want = np.asarray(beam_generate(m_ref, prompt, 6, num_beams=3))
+    got = np.asarray(beam_generate(m_sp, prompt, 6, num_beams=3,
+                                   mesh=mesh))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_validation():
+    m = _gpt()
+    m.eval()
+    toks = jnp.zeros((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match="num_beams"):
+        beam_generate(m, toks, 4, num_beams=0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        beam_generate(m, toks, 0, num_beams=2)
+    with pytest.raises(ValueError, match="exceeds"):
+        beam_generate(m, toks, 40, num_beams=2)
+    with pytest.raises(ValueError, match="eos_id"):
+        beam_generate(m, toks, 4, num_beams=2, eos_id=V)
+    m_sp = _gpt(sp_axis="sp")
+    m_sp.eval()
+    with pytest.raises(ValueError, match="mesh"):
+        beam_generate(m_sp, toks, 4, num_beams=2)
