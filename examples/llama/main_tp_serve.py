@@ -8,7 +8,9 @@ Demonstrates, on the same weights:
      bit-identity with single-shard decode,
   2. int8 weight-only quantization under TP,
   3. TP-target + replicated-draft speculative decoding
-     (``speculative_generate(..., mesh=...)``), greedy-exact.
+     (``speculative_generate(..., mesh=...)``), greedy-exact,
+  4. beam search under the same mesh (``beam_generate(..., mesh=...)``),
+     bit-identical to single-shard beam search.
 
 Run (any host; uses a virtual CPU mesh unless real devices exist):
     python main_tp_serve.py --tp 2 --new-tokens 32
@@ -50,7 +52,8 @@ def main():
     from jax.sharding import Mesh
 
     import apex_tpu.nn as nn
-    from apex_tpu.inference import quantize_int8, speculative_generate
+    from apex_tpu.inference import (beam_generate, quantize_int8,
+                                    speculative_generate)
     from apex_tpu.models import LlamaModel, generate
 
     devs = jax.devices()
@@ -107,6 +110,17 @@ def main():
     assert (spec == out8).all(), \
         "speculative decode broke the greedy exactness guarantee"
     print(f"tp speculative decode: exact match with tp int8 decode: True")
+
+    # 4. beam search under the same mesh (int8 weights already applied
+    #    to tp; compare against single-shard int8 beams)
+    quantize_int8(single, min_size=1)
+    bwant = np.asarray(beam_generate(single, prompt, args.new_tokens,
+                                     num_beams=3))
+    bgot = np.asarray(beam_generate(tp, prompt, args.new_tokens,
+                                    num_beams=3, mesh=mesh))
+    assert (bwant == bgot).all(), "TP beam search diverged"
+    print(f"tp beam search (3 beams): bit-identical to single-shard: "
+          f"True")
 
 
 if __name__ == "__main__":

@@ -189,6 +189,8 @@ def test_llama_tp_serve_example_runs():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "bit-identical to single-shard: True" in out.stdout
     assert "exact match with tp int8 decode: True" in out.stdout
+    assert "tp beam search (3 beams): bit-identical to single-shard: " \
+        "True" in out.stdout
 
 
 def test_imagenet_channels_last_example_runs(tmp_path):
