@@ -1,28 +1,34 @@
 #!/bin/bash
-# Resumable on-chip capture queue for a flaky tunnel: probe before every
-# item; on a wedged probe sleep and retry (the axon tunnel has healed
-# after 2-9h in past sessions).  Items are ordered value-first/risk-last.
-# bench.py exit codes: 4 = wedged before any real work (do NOT advance —
-# retry the item next healthy window); 3 = internal watchdog fired mid
-# work (advance; the item is suspect and gets a diagnostic JSON line).
+# Resumable on-chip capture queue for a flaky tunnel: each item is run
+# DIRECTLY (no separate probe client — every item signals a wedged
+# backend by exiting 4, costing exactly one client creation per
+# attempt against the single-claim tunnel); on rc=4 sleep a long quiet
+# gap and retry the same item (the tunnel has healed after 2-9h in
+# past sessions, and client churn may itself hold the claim wedged).
+# Items are ordered value-first/risk-last.  Item exit codes: 4 =
+# wedged before any real work (do NOT advance — retry next window);
+# 3 = internal watchdog fired mid work (advance; the item is suspect
+# and gets a diagnostic JSON line).
 set -u
 cd "$(dirname "$0")"
 CURSOR_FILE="${CAPTURE_CURSOR:-.capture_cursor}"
 LOG=measurements.jsonl
-# NOTE: the cursor is POSITIONAL — when editing QUEUE, restart the
-# runner AND delete the cursor file unless only appending at the end.
+# The cursor is POSITIONAL; a queue hash stored next to it makes that
+# self-enforcing — any non-append edit resets the resume point.
 
 QUEUE=(
-  # diagnose prints human progress lines to stdout: route them to its own
-  # log so the measurements JSONL stream stays parseable (its JSON result
-  # lines go to diagnose_gpt1024.jsonl via DIAG_LOG)
-  "bash diagnose_gpt1024.sh >>diagnose_stdout.log 2>&1"
-  # headline configs re-measured on the shape-aware flash dispatch (the
-  # round-3 numbers in BENCH_HISTORY predate it: seq-128 attention now
-  # takes the XLA path, which the kernel A/B measured 1.2x faster there)
+  # headline configs FIRST (one client creation each — the claim-churn
+  # lesson): re-measured on the shape-aware flash dispatch (the round-3
+  # numbers in BENCH_HISTORY predate it: seq-128 attention now takes
+  # the XLA path, which the kernel A/B measured 1.2x faster there)
   "timeout 700 python bench.py --no-kernels"
   "timeout 700 python bench.py --bert --no-kernels"
   "timeout 700 python bench.py --gpt --no-kernels"
+  # diagnose prints human progress lines to stdout: route them to its own
+  # log so the measurements JSONL stream stays parseable (its JSON result
+  # lines go to diagnose_gpt1024.jsonl via DIAG_LOG); it probes between
+  # stages (several client creations) so it runs after the headlines
+  "bash diagnose_gpt1024.sh >>diagnose_stdout.log 2>&1"
   "timeout 700 python bench.py --profile"
   "timeout 700 python bench.py --profile --gpt"
   "timeout 900 python bench.py --sweep 96,128,192,256 --no-kernels --budget-s 840"
@@ -49,31 +55,38 @@ QUEUE=(
   "timeout 700 python bench.py --profile --nhwc"
 )
 
-probe() {
-  timeout 75 python -c "
-import jax, jax.numpy as jnp
-x = jnp.ones((64, 64)); print('probe ok:', float(jnp.sum(x @ x)))
-" 2>/dev/null
-}
-
+# No separate probe client: bench.py itself exits 4 when the backend
+# is wedged at init, so each attempt costs exactly ONE client creation
+# against the single-claim tunnel (round-4 observation: the tunnel was
+# healthy at 01:36, wedged for every probe from 01:38 on — the 10-min
+# probe churn may itself hold the claim wedged; long quiet gaps give
+# any leaked claim time to expire).  RETRY_SLEEP overridable for tests.
+# cursor validity guard: positions only resume against the same queue
+# PREFIX they were written for (appending is safe; any other edit
+# resets to 0 rather than silently skipping/repeating items)
 cursor=$(cat "$CURSOR_FILE" 2>/dev/null || echo 0)
-while [ "$cursor" -lt "${#QUEUE[@]}" ]; do
-  if ! probe; then
-    echo "$(date -u +%H:%M:%S) tunnel wedged; sleeping 600s (cursor=$cursor)" >&2
-    sleep 600
-    continue
+if [ "$cursor" -gt 0 ]; then
+  done_hash=$(printf '%s\n' "${QUEUE[@]:0:$cursor}" | sha256sum | cut -d' ' -f1)
+  saved=$(cat "$CURSOR_FILE.qhash" 2>/dev/null || echo none)
+  if [ "$saved" != "$done_hash" ]; then
+    echo "$(date -u +%H:%M:%S) queue edited under a saved cursor; resetting to 0" >&2
+    cursor=0
   fi
+fi
+while [ "$cursor" -lt "${#QUEUE[@]}" ]; do
   cmd="${QUEUE[$cursor]}"
-  echo "$(date -u +%H:%M:%S) === item $cursor: $cmd ===" >&2
+  echo "$(date -u +%H:%M:%S) === item $cursor attempt: $cmd ===" >&2
   eval "$cmd" >>"$LOG" 2>>"$LOG.err"
   rc=$?
   if [ "$rc" -eq 4 ]; then
-    echo "$(date -u +%H:%M:%S) item $cursor wedged at init (rc=4); will retry" >&2
-    sleep 600
+    echo "$(date -u +%H:%M:%S) item $cursor wedged at init (rc=4); quiet ${RETRY_SLEEP:-2400}s then retry" >&2
+    sleep "${RETRY_SLEEP:-2400}"
     continue
   fi
   echo "$(date -u +%H:%M:%S) item $cursor done rc=$rc" >&2
   cursor=$((cursor + 1))
   echo "$cursor" >"$CURSOR_FILE"
+  printf '%s\n' "${QUEUE[@]:0:$cursor}" | sha256sum | cut -d' ' -f1 \
+    >"$CURSOR_FILE.qhash"
 done
 echo "$(date -u +%H:%M:%S) capture queue complete" >&2
