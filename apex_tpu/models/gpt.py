@@ -628,8 +628,30 @@ def _check_decode_mesh(model, mesh, what="generate", who="model"):
                     f"{who}'s {attr} '{ax}'")
 
 
+def nucleus_filter(logits, top_p):
+    """Top-p (nucleus) logit filter, static shapes: keep the smallest
+    prefix of the probability-sorted vocab whose cumulative probability
+    reaches ``top_p`` (the first token always survives), set the rest
+    to -1e30.  ``logits (..., V)``."""
+    if top_p >= 1.0:
+        # exact no-op: f32 cumsum rounding can push the tail's prefix
+        # mass a few ulps past 1.0 and mask valid tokens otherwise
+        return logits
+    srt = jnp.sort(logits, axis=-1)[..., ::-1]          # descending
+    probs = jax.nn.softmax(srt.astype(jnp.float32), axis=-1)
+    # token i is OUTSIDE the nucleus iff the mass strictly before it
+    # already reached top_p
+    before = jnp.cumsum(probs, axis=-1) - probs
+    kept = before < top_p                               # (..., V) sorted
+    # per-row threshold = smallest kept logit
+    thresh = jnp.min(jnp.where(kept, srt, jnp.inf), axis=-1,
+                     keepdims=True).astype(logits.dtype)
+    return jnp.where(logits < thresh, -1e30, logits)
+
+
 def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
-             top_k=None, key=None, cache_dtype=None, mesh=None):
+             top_k=None, key=None, cache_dtype=None, mesh=None,
+             top_p=None):
     """Autoregressive sampling with a KV cache: models with the chunk
     protocol (GPT, Llama) consume the prompt in ONE ``model.prefill``
     flash pass, then generation runs a ``lax.scan`` of per-token decode
@@ -639,8 +661,10 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     repeated calls pay compile once.
 
     ``prompt_ids (B, P)``; returns ``(B, P + max_new_tokens)``.
-    ``temperature=0`` is greedy; ``top_k`` restricts sampling;
-    ``cache_dtype`` defaults to the token-embedding dtype (use
+    ``temperature=0`` is greedy; ``top_k`` keeps the k highest logits
+    and ``top_p`` the probability nucleus (applied after top_k, the
+    usual composition); ``cache_dtype`` defaults to the token-embedding
+    dtype (use
     ``jnp.bfloat16`` to halve cache HBM for fp32 checkpoints, or the
     string ``"int8"`` for a quantized KV cache — per-position absmax,
     half of bf16's traffic again; long-context decode re-reads the
@@ -681,6 +705,8 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     if top_k is not None and not 1 <= top_k <= vocab:
         raise ValueError(
             f"top_k must be in [1, vocab={vocab}], got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     # unsupported-composition refusal (sp) wins over mesh demands;
     # then validate the mesh against the sharded axes
     model._decode_guard("generate")
@@ -703,6 +729,8 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
         if top_k is not None:
             kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
             logits = jnp.where(logits < kth, -1e30, logits)
+        if top_p is not None:
+            logits = nucleus_filter(logits, top_p)
         return jax.random.categorical(k, logits, axis=-1)
 
     prompt_padded = jnp.concatenate(
@@ -767,6 +795,7 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     fn = compiled_run_cache(
         model, "_generate_jit_cache",
         (b, p, max_new_tokens, float(temperature), top_k,
+         None if top_p is None else float(top_p),
          cache_dtype if isinstance(cache_dtype, str)
          else jnp.dtype(cache_dtype).name, mesh),
         params + buffers, build)

@@ -163,3 +163,39 @@ def test_sync_batchnorm_channel_last_native_axis(rng):
     np.testing.assert_allclose(np.asarray(got), np.asarray(_nhwc(want)),
                                rtol=1e-5, atol=1e-5)
     assert bn_cl.channel_last is True    # reference-API spelling intact
+
+
+def test_resnet_channels_last_bf16_step_parity(rng):
+    """The queued bench arm's exact regime (half_dtype=bf16 fused step,
+    fp32-stat BN): NHWC and NCHW runs of the same weights stay together
+    over several steps — de-risks `bench.py --nhwc` numerics."""
+    import jax.numpy as jnp
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.training import make_train_step
+
+    def build(cl):
+        nn.manual_seed(4)
+        m = resnet18(num_classes=7, small_input=True)
+        if cl:
+            nn.to_channels_last(m)
+        opt = FusedSGD(list(m.parameters()), lr=0.05, momentum=0.9)
+        step = make_train_step(m, opt,
+                               lambda o, y: F.cross_entropy(o, y),
+                               half_dtype=jnp.bfloat16, loss_scale=1.0)
+        return m, step
+
+    m_a, step_a = build(False)
+    m_b, step_b = build(True)
+    for a, b in zip(m_a.parameters(), m_b.parameters()):
+        b.data = a.data
+
+    x = jnp.asarray(rng.standard_normal((4, 3, 16, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 7, (4,)))
+    la = [float(step_a(x, y)) for _ in range(4)]
+    lb = [float(step_b(jnp.transpose(x, (0, 2, 3, 1)), y))
+          for _ in range(4)]
+    # bf16 activations round differently across layouts (conv
+    # reassociation), so trajectories drift — bound it per step
+    for a, b in zip(la, lb):
+        assert abs(a - b) / max(abs(a), 1e-6) < 0.05, (la, lb)
+    assert lb[-1] < lb[0]          # and it actually learns

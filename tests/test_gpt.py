@@ -623,3 +623,50 @@ def test_decode_chunk_rejects_negative_t0_and_short_cache(rng):
     # cache shorter than max_positions bounds the write window too
     with pytest.raises(ValueError, match="cache length"):
         m.decode_chunk(Ctx(), toks, m.init_caches(1, 32), 30)
+
+
+def test_nucleus_filter_matches_numpy_reference(rng):
+    """nucleus_filter vs a plain-python reference: keep the smallest
+    probability-sorted prefix reaching top_p; everything else -1e30."""
+    from apex_tpu.models.gpt import nucleus_filter
+
+    logits = jnp.asarray(rng.standard_normal((5, 17)) * 3, jnp.float32)
+    for p in (0.1, 0.5, 0.9, 1.0):
+        got = np.asarray(nucleus_filter(logits, p))
+        for row_l, row_g in zip(np.asarray(logits), got):
+            order = np.argsort(-row_l)
+            probs = np.exp(row_l[order] - row_l.max())
+            probs = probs / probs.sum()
+            keep = np.cumsum(probs) - probs < p          # prefix mass
+            kept_set = set(order[keep])
+            for v in range(17):
+                if v in kept_set:
+                    assert row_g[v] == row_l[v]
+                else:
+                    assert row_g[v] == -1e30
+
+
+def test_generate_top_p(rng):
+    """top_p tiny enough keeps only the argmax -> sampling reduces to
+    greedy exactly; top_p=1.0 keeps the full distribution."""
+    import jax
+    from apex_tpu.models import generate
+
+    m = _tiny_gpt()
+    m.eval()
+    prompt = _ids(rng, b=2, s=4)
+    greedy = np.asarray(generate(m, prompt, 6))
+    nucleus1 = np.asarray(generate(m, prompt, 6, temperature=1.0,
+                                   top_p=1e-9,
+                                   key=jax.random.PRNGKey(3)))
+    np.testing.assert_array_equal(nucleus1, greedy)
+    s = generate(m, prompt, 6, temperature=1.0, top_p=0.9,
+                 key=jax.random.PRNGKey(3))
+    assert s.shape == (2, 10)
+    import pytest
+    with pytest.raises(ValueError, match="top_p"):
+        generate(m, prompt, 2, temperature=1.0, top_p=0.0,
+                 key=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="top_p"):
+        generate(m, prompt, 2, temperature=1.0, top_p=1.5,
+                 key=jax.random.PRNGKey(0))
