@@ -621,7 +621,7 @@ def test_decode_chunk_rejects_negative_t0_and_short_cache(rng):
     with pytest.raises(ValueError, match="out of range"):
         m.decode_chunk(Ctx(), toks, m.init_caches(1, 64), -1)
     # cache shorter than max_positions bounds the write window too
-    with pytest.raises(ValueError, match="cache length"):
+    with pytest.raises(ValueError, match="cache capacity"):
         m.decode_chunk(Ctx(), toks, m.init_caches(1, 32), 30)
 
 
