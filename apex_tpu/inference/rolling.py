@@ -1,0 +1,81 @@
+"""Rolling (modular) KV cache for sliding-window decode.
+
+A ``sliding_window=w`` model (models/llama.py — the Mistral band) can
+only ever attend the last ``w`` positions, so its decode cache needs
+exactly ``w`` slots: position ``p`` lives in slot ``p % w`` and new
+writes overwrite the positions that just fell out of the band.  Cache
+HBM per layer drops from O(context) to O(window) — at long context the
+cache is decode's dominant memory AND traffic term, so this is the
+Mistral-serving memory lever the band itself promises.  (The reference
+is training-side only, SURVEY.md §2; the rolling buffer is the standard
+serving companion of banded attention.)
+
+No slot-position bookkeeping arrays are needed: the decode protocol
+writes positions contiguously (prefill chunks, then one position per
+step), so after everything below ``t_hi`` is written, slot ``s`` holds
+global position ``t_hi-1 - ((t_hi-1 - s) mod W)`` — a closed form
+(:func:`rolling_slot_positions`), negative iff the slot was never
+written.  The attention mask derives validity entirely from it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: Extra slots past the window in every rolling cache.  Speculative
+#: decoding REWINDS after rejected proposals; a rejected chunk's write
+#: lands in slots that, with exactly ``window`` slots, would clobber
+#: live band keys (slot collisions mod W destroy positions the
+#: post-rewind queries still need).  With ``window + SLACK`` slots a
+#: stale write of length <= SLACK aliases — under the closed-form
+#: position mask — to a position at least one full window behind every
+#: later query, so the band mask provably excludes it, and the
+#: contiguous re-writes after the rewind reclaim the slots.  Bounds the
+#: verification chunk: speculative k+1 <= SLACK (checked there).
+ROLLING_SLACK = 32
+
+
+def rolling_slot_positions(n_slots, t_hi):
+    """Global position held by each of the ``n_slots`` cache slots once
+    positions ``0 .. t_hi-1`` have been written (``t_hi`` may be
+    traced).  Slot ``s`` holds the LARGEST ``p < t_hi`` with
+    ``p % n_slots == s``; negative means never written."""
+    last = t_hi - 1
+    s = jnp.arange(n_slots, dtype=jnp.int32)
+    return last - jnp.mod(last - s, n_slots)
+
+
+def rolling_kv_write(cache, new, t0):
+    """Write chunk ``new (B, H, S_c, D)`` at global positions
+    ``t0 ..`` into the W-slot rolling cache (slot = position mod W).
+
+    ``S_c == 1`` takes an O(1) single-slot ``dynamic_update_slice``;
+    longer chunks (which may wrap) use one full-width masked select —
+    O(W) traffic, the same order the attention read already pays.
+    Chunks LONGER than the cache keep only their last ``W`` rows (the
+    earlier ones are already out of every future query's band).
+    QuantKV caches quantize per-position first (inference/quant.py
+    values — identical stored bytes to the full-cache write)."""
+    from .quant import QuantKV, _absmax_int8
+
+    w, s_c = cache.shape[2], new.shape[2]
+    if s_c > w:
+        return rolling_kv_write(cache, new[:, :, s_c - w:, :],
+                                t0 + (s_c - w))
+
+    def write_arr(arr, src):
+        if s_c == 1:
+            return jax.lax.dynamic_update_slice(
+                arr, src, (0, 0, jnp.mod(t0, w), 0))
+        # slot s receives chunk row d = (s - t0) mod W when d < S_c
+        d = jnp.mod(jnp.arange(w, dtype=jnp.int32) - t0, w)
+        cand = jnp.take(src, jnp.clip(d, 0, s_c - 1), axis=2)
+        own = (d < s_c)[None, None, :, None]
+        return jnp.where(own, cand, arr)
+
+    if isinstance(cache, QuantKV):
+        q, scale = _absmax_int8(new.astype(jnp.float32), -1,
+                                cache.scale.dtype)
+        return QuantKV(write_arr(cache.q, q),
+                       write_arr(cache.scale, scale))
+    return write_arr(cache, new.astype(cache.dtype))

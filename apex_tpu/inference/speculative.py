@@ -113,6 +113,16 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
             guard(f"speculative_generate ({name})")
         _check_decode_mesh(m, mesh, what="speculative_generate",
                            who=name)
+        if getattr(m, "sliding_window", None) is not None:
+            from .rolling import ROLLING_SLACK
+            if k + 1 > ROLLING_SLACK:
+                raise ValueError(
+                    f"speculative k={k} with a sliding-window {name}: "
+                    f"rejected chunks up to k+1 tokens must fit the "
+                    f"rolling cache's rewind margin "
+                    f"(ROLLING_SLACK={ROLLING_SLACK}, "
+                    f"inference/rolling.py) — use k <= "
+                    f"{ROLLING_SLACK - 1}")
     if mesh is not None and not (_sharded_decode_axes(target)
                                  or _sharded_decode_axes(draft)):
         raise ValueError(

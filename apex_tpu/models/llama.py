@@ -291,29 +291,52 @@ class LlamaBlock(nn.Module):
             kcache = sp_kv_write(kcache, k_new, t0, self.sp_axis)
             vcache = sp_kv_write(vcache, v_new, t0, self.sp_axis)
             slots = sp_slot_positions(kcache.shape[2], self.sp_axis)
+        elif self.sliding_window is not None:
+            # rolling window cache (inference/rolling.py): W slots, slot
+            # = position mod W.  Attend [pre-write cache | fresh chunk]:
+            # the PRE-write cache holds exactly the band prefix
+            # (t0-W, t0) every chunk query can reach, while writing
+            # first would evict band keys the chunk's early queries
+            # still need; the fresh rows cover in-chunk attention (so
+            # chunks of ANY length work — the band mask prunes).  The
+            # write lands after, for subsequent calls.
+            from ..inference.rolling import (rolling_kv_write,
+                                             rolling_slot_positions)
+            keys = jnp.concatenate(
+                [kv_value(kcache), k_new.astype(jnp.float32)], axis=2)
+            vals = jnp.concatenate(
+                [kv_value(vcache), v_new.astype(jnp.float32)], axis=2)
+            slots = jnp.concatenate(
+                [rolling_slot_positions(kcache.shape[2], t0), pos])
+            kcache = rolling_kv_write(kcache, k_new, t0)
+            vcache = rolling_kv_write(vcache, v_new, t0)
         else:
             kcache = kv_write(kcache, k_new, (0, 0, t0, 0))
             vcache = kv_write(vcache, v_new, (0, 0, t0, 0))
             slots = jnp.arange(kcache.shape[2], dtype=jnp.int32)
+        if self.sliding_window is None or self.sp_axis is not None:
+            keys, vals = kv_value(kcache), kv_value(vcache)
         group = h_loc // kvh
         qg = q.reshape(b, kvh, group, s_c, d)
         scores = jnp.einsum("bkgqd,bksd->bkgqs", qg.astype(jnp.float32),
-                            kv_value(kcache)) * (d ** -0.5)
-        valid = slots[None, :] <= pos[:, None]          # (S_c, S_local)
+                            keys) * (d ** -0.5)
+        valid = slots[None, :] <= pos[:, None]          # (S_c, S_keys)
         if self.sliding_window is not None:
-            # banded: key j visible from position t iff t-w < j <= t
+            # banded: key j visible from position t iff t-w < j <= t;
+            # negative slot positions are never-written rolling slots
             valid = valid & (slots[None, :]
-                             > pos[:, None] - self.sliding_window)
+                             > pos[:, None] - self.sliding_window) \
+                & (slots[None, :] >= 0)
         scores = jnp.where(valid[None, None, None, :, :], scores, -1e30)
         if self.sp_axis is not None:
             o = sp_softmax_combine(
                 scores, self.sp_axis,
                 lambda p: jnp.einsum("bkgqs,bksd->bkgqd", p,
-                                     kv_value(vcache))).astype(x.dtype)
+                                     vals)).astype(x.dtype)
         else:
             probs = jax.nn.softmax(scores, axis=-1)
             o = jnp.einsum("bkgqs,bksd->bkgqd", probs,
-                           kv_value(vcache)).astype(x.dtype)
+                           vals).astype(x.dtype)
         o = jnp.swapaxes(o.reshape(b, h_loc, s_c, d), 1, 2) \
             .reshape(b, s_c, h_loc * d)
         return self._mlp_tail(ctx, x, o), kcache, vcache
@@ -547,6 +570,14 @@ class LlamaModel(nn.Module):
             # cache HBM shrinks with the axis — context-length scaling
             from ..parallel.context_parallel import sp_axis_size
             s_max = -(-s_max // sp_axis_size(self.sp_axis))
+        if self.sliding_window is not None:
+            # rolling cache: the band can only attend the last `window`
+            # positions, so the cache needs that many slots plus a
+            # rewind-safety margin (slot = position mod n_slots;
+            # inference/rolling.py, ROLLING_SLACK) — decode cache HBM
+            # is O(window), not O(context)
+            from ..inference.rolling import ROLLING_SLACK
+            s_max = min(s_max, self.sliding_window + ROLLING_SLACK)
         from ..inference.quant import make_kv_cache
         return [(make_kv_cache((batch, blk.kv_heads // n, s_max,
                                 blk.head_dim), dtype),
@@ -556,7 +587,21 @@ class LlamaModel(nn.Module):
 
     def _cache_capacity(self, caches):
         """Global position capacity of the caches (under ``sp_axis`` the
-        per-device block times the axis size)."""
+        per-device block times the axis size).  A FULL-SIZE rolling
+        sliding-window cache never bounds positions — old slots are
+        overwritten as they fall out of the band — so capacity is the
+        position-table-free family's only position limit,
+        ``max_positions``; a cache allocated SMALLER than the rolling
+        size (init_caches clamps to the caller's declared s_max) must
+        not wrap — wrapping would evict in-band keys — so it keeps its
+        slot count as the capacity."""
+        if self.sliding_window is not None:
+            from ..inference.rolling import ROLLING_SLACK
+            n = caches[0][0].shape[2]
+            if n >= min(self.max_positions,
+                        self.sliding_window + ROLLING_SLACK):
+                return self.max_positions
+            return n
         cap = caches[0][0].shape[2]
         if self.sp_axis is not None:
             from ..parallel.context_parallel import sp_axis_size
@@ -612,12 +657,16 @@ class LlamaModel(nn.Module):
         caches are empty, so the chunk attends only itself).  Under
         ``sliding_window`` the kernel applies the band exactly at any
         prompt length (banded blocks skipped, O(S·window)).  Under
-        ``sp_axis`` the prompt runs in cache-block-bounded chunks
-        instead (parallel/context_parallel.py)."""
+        ``sp_axis`` OR a rolling ``sliding_window`` cache, the prompt
+        runs in cache-bounded chunks through ``decode_chunk`` instead
+        (the chunk loop is layout-generic: it splits to the per-device
+        block / the window respectively)."""
         self._decode_guard("prefill")
-        if self.sp_axis is not None:
+        if self.sp_axis is not None or self.sliding_window is not None:
             from ..parallel.context_parallel import sp_chunked_prefill
-            return sp_chunked_prefill(self, ctx, toks, caches)
+            return sp_chunked_prefill(
+                self, ctx, toks, caches,
+                bound_by_cache=self.sp_axis is not None)
         return self._run_blocks(
             ctx, toks, caches,
             lambda blk, x, kc, vc: blk.prefill(ctx, x, kc, vc))

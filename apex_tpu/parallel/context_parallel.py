@@ -112,16 +112,20 @@ def sp_softmax_combine(scores, axis, weighted_v):
     return jax.lax.psum(weighted_v(p), axis) / l
 
 
-def sp_chunked_prefill(model, ctx, toks, caches, chunk=512):
-    """Prompt consumption under sequence-parallel decode: the prompt
-    runs through ``model.decode_chunk`` in chunks bounded by the
-    per-device cache block, so cross-chunk attention rides the cache
-    (chunk i attends blocks 0..i through the lse merge) and every KV row
-    lands on its owning device.  Scores stay (S_chunk, S_local) per
-    head — the quadratic term is sharded n ways.  Returns
-    ``(logits (B, S_p, V), caches)`` — the non-sp prefill contract."""
+def sp_chunked_prefill(model, ctx, toks, caches, chunk=512,
+                       bound_by_cache=True):
+    """Prompt consumption through ``model.decode_chunk`` in chunks —
+    the cache-mediated prefill loop shared by sequence-parallel decode
+    (chunks bounded by the per-device cache block so every KV row lands
+    on its owning device; cross-chunk attention rides the lse merge)
+    and the rolling sliding-window cache (``bound_by_cache=False``:
+    rolling decode_chunk takes any chunk length, so chunks stay large
+    and the unroll count small).  Returns ``(logits (B, S_p, V),
+    caches)`` — the non-chunked prefill contract."""
     s_p = toks.shape[1]
-    c = min(caches[0][0].shape[2], s_p, chunk)
+    c = min(s_p, chunk)
+    if bound_by_cache:
+        c = min(caches[0][0].shape[2], c)
     outs = []
     t = 0
     while t < s_p:

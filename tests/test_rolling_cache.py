@@ -1,0 +1,158 @@
+"""Rolling sliding-window KV cache (inference/rolling.py + the Llama
+family's windowed decode): a ``sliding_window=w`` model allocates
+exactly ``w`` cache slots (decode cache HBM O(window), not
+O(context)), writes modularly, and attends [pre-write cache | fresh
+chunk] so every query sees its whole band.
+
+Oracles: the closed-form slot positions vs a naive full-history numpy
+simulation; decode == teacher-forced forward (the banded flash
+forward is exact at any length); speculative exactness; existing
+Mistral-window suites (tests/test_llama.py) run against the same
+rolling path.  Reference analogue: none (training-side library,
+SURVEY.md §2) — the rolling buffer is banded attention's standard
+serving companion."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.inference.rolling import (rolling_kv_write,
+                                        rolling_slot_positions)
+from apex_tpu.models.gpt import generate
+from apex_tpu.models.llama import LlamaModel
+from apex_tpu.nn.modules import Ctx
+
+V = 89
+W = 8
+
+
+def _model(**kw):
+    nn.manual_seed(5)
+    return LlamaModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                      kv_heads=2, max_positions=64, sliding_window=W,
+                      **kw)
+
+
+def test_windowed_cache_allocates_window_slots():
+    from apex_tpu.inference.rolling import ROLLING_SLACK
+
+    m = _model()
+    caches = m.init_caches(1, 64)
+    for kc, vc in caches:
+        # window + the speculative-rewind margin, not the context
+        assert kc.shape[2] == W + ROLLING_SLACK
+        assert vc.shape[2] == W + ROLLING_SLACK
+    # window wider than the context: cache stays context-sized
+    nn.manual_seed(5)
+    wide = LlamaModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                      kv_heads=2, max_positions=16, sliding_window=100)
+    assert wide.init_caches(1, 12)[0][0].shape[2] == 12
+
+
+def test_rolling_write_and_slots_match_naive_simulation(rng):
+    """Write chunks of assorted lengths; the W-slot cache + closed-form
+    positions must equal keeping full history and taking, per slot s,
+    the latest position == s (mod W)."""
+    full = np.zeros((1, 2, 40, 4), np.float32)
+    cache = jnp.zeros((1, 2, W, 4))
+    t = 0
+    for length in (3, 1, W, 5, 2, 11):
+        chunk = rng.standard_normal((1, 2, length, 4)).astype(np.float32)
+        full[:, :, t:t + length] = chunk
+        cache = rolling_kv_write(cache, jnp.asarray(chunk), t)
+        t += length
+        slots = np.asarray(rolling_slot_positions(W, t))
+        for s in range(W):
+            p = slots[s]
+            written = [q for q in range(t) if q % W == s]
+            if not written:
+                assert p < 0          # never-written sentinel
+                continue
+            assert p == max(written)
+            np.testing.assert_allclose(np.asarray(cache)[:, :, s],
+                                       full[:, :, p], rtol=1e-6)
+
+
+def test_windowed_decode_matches_teacher_forced_forward(rng):
+    """Long generation (context far beyond the window): greedy decode
+    must agree with the exact banded-flash FORWARD re-scoring of its
+    own output at every generated position."""
+    m = _model()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 20)))
+    out = generate(m, prompt, 30)
+    ctx = Ctx(training=False)
+    logits = m.forward(ctx, out)
+    redo = np.asarray(jnp.argmax(logits, axis=-1))
+    got = np.asarray(out)
+    # position t's forward argmax must be the token decoded at t+1
+    np.testing.assert_array_equal(got[0, 20:], redo[0, 19:-1])
+
+
+def test_windowed_speculative_exactness(rng):
+    from apex_tpu.inference.speculative import speculative_generate
+
+    m = _model()
+    m.eval()
+    nn.manual_seed(9)
+    draft = LlamaModel(vocab_size=V, hidden=16, layers=1, heads=2,
+                       max_positions=64)
+    draft.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 6)))
+    want = np.asarray(generate(m, prompt, 20))
+    got = np.asarray(speculative_generate(m, draft, prompt, 20, k=4))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_windowed_int8_and_beam_run(rng):
+    from apex_tpu.inference import beam_generate
+
+    m = _model()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 6)))
+    out = generate(m, prompt, 12, cache_dtype="int8")
+    assert out.shape == (1, 18)
+    assert (np.asarray(out)[:, :6] == np.asarray(prompt)).all()
+    b = beam_generate(m, prompt, 10, num_beams=3)
+    assert b.shape == (1, 16)
+
+
+def test_windowed_decode_chunk_longer_than_window(rng):
+    """A direct decode_chunk longer than the window works in one call
+    (in-chunk keys come from the fresh rows, not the cache) and agrees
+    with the teacher-forced forward."""
+    m = _model()
+    m.eval()
+    toks = jnp.asarray(rng.integers(0, V, (1, 21)))
+    ctx = Ctx(training=False)
+    caches = m.init_caches(1, 32)
+    got, caches = m.decode_chunk(ctx, toks, caches, 0)
+    want = m.forward(Ctx(training=False), toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    # and the cache is correctly positioned for a follow-up chunk
+    nxt = jnp.asarray(rng.integers(0, V, (1, 3)))
+    got2, _ = m.decode_chunk(ctx, nxt, caches, 21)
+    full = m.forward(Ctx(training=False),
+                     jnp.concatenate([toks, nxt], axis=1))
+    np.testing.assert_allclose(np.asarray(got2),
+                               np.asarray(full[:, 21:]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_undersized_windowed_cache_refuses_wrap(rng):
+    """A cache allocated smaller than the rolling size (the caller
+    declared fewer positions) must refuse writes past its slots instead
+    of wrapping — wrapping would evict keys still inside the wide
+    band."""
+    nn.manual_seed(5)
+    wide = LlamaModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                      kv_heads=2, max_positions=64, sliding_window=100)
+    wide.eval()
+    caches = wide.init_caches(1, 12)          # 12 slots, band is 100
+    ctx = Ctx(training=False)
+    toks = jnp.asarray(rng.integers(0, V, (1, 3)))
+    _, caches = wide.decode_chunk(ctx, toks, caches, 0)
+    with pytest.raises(ValueError, match="cache capacity"):
+        wide.decode_chunk(ctx, toks, caches, 12)
