@@ -293,23 +293,37 @@ class LlamaBlock(nn.Module):
             slots = sp_slot_positions(kcache.shape[2], self.sp_axis)
         elif self.sliding_window is not None:
             # rolling window cache (inference/rolling.py): W slots, slot
-            # = position mod W.  Attend [pre-write cache | fresh chunk]:
-            # the PRE-write cache holds exactly the band prefix
-            # (t0-W, t0) every chunk query can reach, while writing
-            # first would evict band keys the chunk's early queries
-            # still need; the fresh rows cover in-chunk attention (so
-            # chunks of ANY length work — the band mask prunes).  The
-            # write lands after, for subsequent calls.
+            # = position mod W.
             from ..inference.rolling import (rolling_kv_write,
                                              rolling_slot_positions)
-            keys = jnp.concatenate(
-                [kv_value(kcache), k_new.astype(jnp.float32)], axis=2)
-            vals = jnp.concatenate(
-                [kv_value(vcache), v_new.astype(jnp.float32)], axis=2)
-            slots = jnp.concatenate(
-                [rolling_slot_positions(kcache.shape[2], t0), pos])
-            kcache = rolling_kv_write(kcache, k_new, t0)
-            vcache = rolling_kv_write(vcache, v_new, t0)
+            if s_c == 1:
+                # hot decode path: write first (one O(1) slot write),
+                # attend the cache in place — safe because the one
+                # evicted position is >= a full window behind the query
+                # (n_slots >= window + slack, or the cache never wraps)
+                kcache = rolling_kv_write(kcache, k_new, t0)
+                vcache = rolling_kv_write(vcache, v_new, t0)
+                keys = kv_value(kcache)
+                vals = kv_value(vcache)
+                slots = rolling_slot_positions(kcache.shape[2], t0 + 1)
+            else:
+                # chunks attend [pre-write cache | fresh rows]: the
+                # PRE-write cache holds exactly the band prefix
+                # (t0-W, t0) every chunk query can reach, while writing
+                # first would evict band keys the chunk's early queries
+                # still need; the fresh rows cover in-chunk attention
+                # (so chunks of ANY length work — the band mask
+                # prunes).  The write lands after, for later calls.
+                keys = jnp.concatenate(
+                    [kv_value(kcache), k_new.astype(jnp.float32)],
+                    axis=2)
+                vals = jnp.concatenate(
+                    [kv_value(vcache), v_new.astype(jnp.float32)],
+                    axis=2)
+                slots = jnp.concatenate(
+                    [rolling_slot_positions(kcache.shape[2], t0), pos])
+                kcache = rolling_kv_write(kcache, k_new, t0)
+                vcache = rolling_kv_write(vcache, v_new, t0)
         else:
             kcache = kv_write(kcache, k_new, (0, 0, t0, 0))
             vcache = kv_write(vcache, v_new, (0, 0, t0, 0))

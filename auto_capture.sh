@@ -53,6 +53,12 @@ QUEUE=(
   # profile attribution
   "timeout 700 python bench.py --nhwc --no-kernels"
   "timeout 700 python bench.py --profile --nhwc"
+  # llama GQA decode ladder + the rolling-cache A/B (window arm reads
+  # O(window) cache per token instead of O(context) — sized so the KV
+  # term is visible against the 125M weights: B=16, 512-token prompt)
+  "timeout 700 python bench.py --llama-decode --no-kernels"
+  "timeout 700 python bench.py 16 --llama-decode --seq-len 512 --no-kernels"
+  "timeout 700 python bench.py 16 --llama-decode --seq-len 512 --window 128 --no-kernels"
 )
 
 # No separate probe client: bench.py itself exits 4 when the backend

@@ -970,6 +970,51 @@ def run_decode_throughput(batch, seq_len, new_tokens=128, int8=False,
     return toks_per_sec, dt, compile_s
 
 
+def run_llama_decode_throughput(batch, seq_len, new_tokens=128,
+                                int8=False, kv_int8=False, window=None):
+    """Greedy KV-cache decode tokens/s on the llama_125m geometry (GQA
+    4-kv-head cache).  ``window=w`` builds the Mistral-band model whose
+    decode runs the ROLLING cache (inference/rolling.py): cache reads
+    per token drop from O(context) to O(window) — the A/B against the
+    unwindowed run is the rolling cache's reason-to-exist number."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.models import LlamaModel, generate
+
+    stage("model_build",
+          f"llama_125m decode batch={batch} window={window}"
+          + (" int8" if int8 else "") + (" kv-int8" if kv_int8 else ""))
+    nn.manual_seed(0)
+    model = LlamaModel(vocab_size=32000, hidden=768, layers=12, heads=12,
+                       kv_heads=4, intermediate=2048,
+                       max_positions=seq_len + new_tokens,
+                       sliding_window=window)
+    model.eval()
+    if int8:
+        from apex_tpu.inference import quantize_int8
+        quantize_int8(model)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 32000, (batch, seq_len)))
+
+    cache_dtype = "int8" if kv_int8 else None
+    stage("compile", f"decode scan over {seq_len + new_tokens} positions")
+    tc = time.perf_counter()
+    out = generate(model, prompt, new_tokens, cache_dtype=cache_dtype)
+    int(jnp.sum(out))                       # fetch = sync
+    compile_s = time.perf_counter() - tc
+    log(f"compiled in {compile_s:.1f}s")
+
+    stage("timing", "3 decode calls")
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = generate(model, prompt, new_tokens, cache_dtype=cache_dtype)
+        int(jnp.sum(out))
+    dt = (time.perf_counter() - t0) / 3
+    return batch * new_tokens / dt, dt, compile_s
+
+
 def build_vit_step(batch):
     """ViT-S/16 at 224 (~22M params), AdamW-style FusedAdam under the
     bf16 fused step — the vision-transformer counterpart of the ResNet
@@ -1151,6 +1196,13 @@ def main():
                          "SwiGLU/GQA) FusedAdam throughput")
     ap.add_argument("--gpt", action="store_true",
                     help="run the GPT-2-small causal-LM config")
+    ap.add_argument("--llama-decode", action="store_true",
+                    help="greedy KV-cache decode tokens/s on the "
+                         "llama_125m GQA geometry; --window N adds the "
+                         "Mistral band + rolling cache arm")
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding_window for --llama-decode (rolling "
+                         "cache: O(window) cache reads per token)")
     ap.add_argument("--gpt-decode", action="store_true",
                     help="measure greedy KV-cache decode tokens/s")
     ap.add_argument("--int8", action="store_true",
@@ -1219,6 +1271,12 @@ def main():
             q += "_kvint8" if args.kv_int8 else ""
             return (f"gpt2_small_greedy_decode{q}_tokens_per_sec_per_chip",
                     "tokens/sec/chip")
+        if args.llama_decode:
+            q = "_int8" if args.int8 else ""
+            q += "_kvint8" if args.kv_int8 else ""
+            w = f"_window{args.window}" if args.window else ""
+            return (f"llama_125m_greedy_decode{q}{w}_tokens_per_sec_"
+                    f"per_chip", "tokens/sec/chip")
         if args.bert:
             return (f"bert_base_mlm_seq{args.seq_len}_"
                     "sequences_per_sec_per_chip_ampO2",
@@ -1252,17 +1310,28 @@ def main():
 
     # validate cheap config errors BEFORE spending the backend-init
     # budget on the tunnel (and emit the promised diagnostic JSON line)
-    if (args.int8 or args.kv_int8) and not args.gpt_decode:
+    if (args.int8 or args.kv_int8) and not (args.gpt_decode
+                                            or args.llama_decode):
         fail("int8_unsupported_config: --int8/--kv-int8 are quantized "
-             "DECODE measurements; pair them with --gpt-decode")
+             "DECODE measurements; pair them with --gpt-decode or "
+             "--llama-decode")
+        return 1
+    if args.window is not None and not args.llama_decode:
+        fail("window_unsupported_config: --window is the rolling-cache "
+             "arm of --llama-decode")
+        return 1
+    if args.gpt_decode and args.llama_decode:
+        fail("decode_config_conflict: pick ONE of --gpt-decode / "
+             "--llama-decode (the metric names one model)")
         return 1
     if args.nhwc and (args.bert or args.gpt or args.llama or args.seq2seq
                       or args.vit or args.dcgan or args.gpt_decode
-                      or args.spec_decode):
+                      or args.llama_decode or args.spec_decode):
         fail("nhwc_unsupported_config: --nhwc is the channels-last arm "
              "of the resnet config (default / --sweep / --profile)")
         return 1
-    if args.profile and (args.seq2seq or args.gpt_decode or args.vit
+    if args.profile and (args.seq2seq or args.gpt_decode
+                         or args.llama_decode or args.vit
                          or args.dcgan):
         fail("profile_unsupported_config: --profile supports the "
              "resnet (default), --gpt, --bert and --llama configs")
@@ -1270,7 +1339,8 @@ def main():
     sweep_batches = None
     if args.sweep:
         if args.profile or args.kernels or args.kernels_timing \
-                or args.gpt_decode or args.spec_decode:
+                or args.gpt_decode or args.llama_decode \
+                or args.spec_decode:
             fail("sweep_unsupported_config: --sweep applies to the "
                  "throughput configs (resnet/--gpt/--bert/--seq2seq)")
             return 1
@@ -1353,12 +1423,17 @@ def main():
               "kernels": None})
         return 0
 
-    if args.gpt_decode:
+    if args.gpt_decode or args.llama_decode:
         batch = args.batch or 8
         try:
-            toks, dt, compile_s = run_decode_throughput(
-                batch, args.seq_len, int8=args.int8,
-                kv_int8=args.kv_int8)
+            if args.llama_decode:
+                toks, dt, compile_s = run_llama_decode_throughput(
+                    batch, args.seq_len, int8=args.int8,
+                    kv_int8=args.kv_int8, window=args.window)
+            else:
+                toks, dt, compile_s = run_decode_throughput(
+                    batch, args.seq_len, int8=args.int8,
+                    kv_int8=args.kv_int8)
         except Exception as e:
             fail(f"decode_failed: {type(e).__name__}: {e}")
             return 1
@@ -1366,6 +1441,7 @@ def main():
               "value": round(toks, 1), "unit": metric_unit,
               "vs_baseline": None, "batch": batch,
               "prompt_len": args.seq_len, "new_tokens": 128,
+              "window": args.window,
               "call_time_s": round(dt, 3),
               "compile_s": round(compile_s, 1),
               "device_kind": (devices[0].device_kind or "").lower(),

@@ -36,6 +36,9 @@ run 8 --gpt --seq-len 2048 --remat
 run --gpt-decode
 run --gpt-decode --int8
 run --gpt-decode --int8 --kv-int8
+run --llama-decode
+run 16 --llama-decode --seq-len 512
+run 16 --llama-decode --seq-len 512 --window 128
 run --spec-decode
 run --seq2seq
 run --dcgan

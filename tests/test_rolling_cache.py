@@ -91,6 +91,12 @@ def test_windowed_decode_matches_teacher_forced_forward(rng):
 
 
 def test_windowed_speculative_exactness(rng):
+    """40 new tokens: the speculative buffer (6+40+5=51 positions)
+    exceeds the W+ROLLING_SLACK=40-slot cache, so rejected-chunk writes
+    DO alias mod the cache size — the rewind-margin masking argument
+    (inference/rolling.py ROLLING_SLACK) is exercised, not just
+    stated."""
+    from apex_tpu.inference.rolling import ROLLING_SLACK
     from apex_tpu.inference.speculative import speculative_generate
 
     m = _model()
@@ -100,8 +106,9 @@ def test_windowed_speculative_exactness(rng):
                        max_positions=64)
     draft.eval()
     prompt = jnp.asarray(rng.integers(0, V, (1, 6)))
-    want = np.asarray(generate(m, prompt, 20))
-    got = np.asarray(speculative_generate(m, draft, prompt, 20, k=4))
+    assert 6 + 40 + 5 > W + ROLLING_SLACK    # the cache must wrap
+    want = np.asarray(generate(m, prompt, 40))
+    got = np.asarray(speculative_generate(m, draft, prompt, 40, k=4))
     np.testing.assert_array_equal(got, want)
 
 
