@@ -1,7 +1,10 @@
 """Inference-side utilities: weight-only int8 quantization for the
 bandwidth-bound decode path (quant.py), draft-verified greedy
-speculative decoding (speculative.py), and beam search (beam.py)."""
+speculative decoding (speculative.py), beam search (beam.py), the
+rolling sliding-window KV cache (rolling.py), and stateful multi-turn
+decode sessions (session.py)."""
 from .beam import beam_generate  # noqa: F401
+from .session import DecodeSession  # noqa: F401
 from .quant import (QuantKV, QuantTensor, gather_rows,  # noqa: F401
                     kv_value, kv_write, make_kv_cache,
                     quantize_int8, quantize_tensor_int8)

@@ -1,0 +1,200 @@
+"""Stateful multi-turn decode sessions over the LM cache protocol.
+
+``models.gpt.generate`` is one-shot: prompt in, tokens out, caches
+gone.  The chat/serving pattern — prefill a history once, generate,
+append the next user turn, generate again — would re-prefill the whole
+conversation every turn.  :class:`DecodeSession` keeps the KV caches
+(and the write cursor) alive across calls instead: ``append`` ingests
+tokens at the cursor, ``generate`` continues from it, and every turn
+reuses the same compiled programs (the cursor is a traced argument, so
+shapes and sampling config — not positions — key the compilation,
+through the shared ``compiled_run_cache`` with its parameter-identity
+and LRU invariants: a LoRA apply/merge mid-session recompiles against
+the new parameter objects rather than silently decoding stale
+weights).
+
+The reference has no inference path (SURVEY.md §2 — training-side
+library); this is the serving-session layer over the decode stack, and
+it composes with everything the underlying paths do: int8 KV caches,
+int8 weights, and the rolling sliding-window cache.  Sharded decode
+(tp/sp/moe) stays with the one-shot ``generate(mesh=...)`` drivers —
+a session would have to hold device-sharded caches across shard_map
+regions; refused loudly for now.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class DecodeSession:
+    """Incremental decoding with persistent KV caches.
+
+    ``DecodeSession(model, batch=1, capacity=None, cache_dtype=None)``
+    allocates caches for ``capacity`` positions (default
+    ``model.max_positions``).  Then, any interleaving of:
+
+    - ``append(tokens)`` — teacher-force ``tokens (B, S)`` into the
+      caches (a user turn, a system prompt); returns the logits for
+      the ingested positions.
+    - ``generate(n, temperature=0.0, top_k=None, top_p=None, key=None)``
+      — continue from the cursor, returning the ``(B, n)`` new tokens
+      (they are also ingested, like a model turn).
+    - ``reset()`` — drop the decode state, keep the session.
+
+    ``session.position`` is the write cursor.  Output equals one-shot
+    ``generate`` on the concatenated history (cache-mediated numerics:
+    ingest runs through ``decode_chunk``).
+    """
+
+    def __init__(self, model, batch=1, capacity=None, cache_dtype=None):
+        from ..models.gpt import _sharded_decode_axes
+
+        for a in ("init_caches", "decode_chunk", "decode_step"):
+            if not hasattr(model, a):
+                raise ValueError(
+                    f"DecodeSession needs model.{a} (the GPT/Llama "
+                    f"cache protocol)")
+        guard = getattr(model, "_decode_guard", None)
+        if guard is not None:
+            guard("DecodeSession")
+        if _sharded_decode_axes(model):
+            raise NotImplementedError(
+                "DecodeSession holds caches across calls and runs "
+                "single-shard; sharded models (tp/sp/moe) decode "
+                "through the one-shot generate(mesh=...) drivers")
+        self.model = model
+        self.batch = batch
+        self.capacity = capacity if capacity is not None \
+            else model.max_positions
+        if not 1 <= self.capacity <= model.max_positions:
+            raise ValueError(
+                f"capacity must be in [1, max_positions="
+                f"{model.max_positions}], got {self.capacity}")
+        self._cache_dtype = cache_dtype if cache_dtype is not None \
+            else model.tok_emb.weight.data.dtype
+        self._vocab = model.tok_emb.weight.shape[0]
+        self.reset()
+
+    def reset(self):
+        self.caches = self.model.init_caches(
+            self.batch, self.capacity, dtype=self._cache_dtype)
+        self.position = 0
+        self._last_logits = None
+
+    # -- internals ---------------------------------------------------------
+
+    def _compiled(self, cfg, build_with_params):
+        """A compiled program from the model's shared session cache:
+        ``build_with_params(params)`` closes over the CURRENT
+        Parameter/Buffer objects, and the cache keys on their ids
+        (utils/jit_cache.py invariants — LoRA swaps miss, entries
+        LRU-capped), so stale zips cannot read wrong weights."""
+        from ..utils.jit_cache import compiled_run_cache
+
+        params = list(self.model.parameters()) + \
+            list(self.model.buffers())
+        fn = compiled_run_cache(
+            self.model, "_session_jit_cache", cfg, params,
+            lambda: build_with_params(params))
+        return fn, [p.data for p in params]
+
+    def _check_room(self, n, what):
+        if self.position + n > self.capacity:
+            raise ValueError(
+                f"{what}: cursor {self.position} + {n} tokens exceeds "
+                f"the session capacity {self.capacity} — reset() or "
+                f"allocate a larger session")
+
+    @staticmethod
+    def _ctx(params, vals):
+        from ..nn.modules import Ctx
+        return Ctx(env={id(p): v for p, v in zip(params, vals)},
+                   stats_out={}, training=False)
+
+    # -- public ------------------------------------------------------------
+
+    def append(self, tokens):
+        """Ingest ``tokens (B, S)`` at the cursor; returns their logits
+        ``(B, S, V)`` (the last row is the next-token distribution)."""
+        tokens = jnp.asarray(tokens)
+        if tokens.ndim != 2 or tokens.shape[0] != self.batch:
+            raise ValueError(
+                f"append expects (batch={self.batch}, S) token ids, "
+                f"got {tokens.shape}")
+        s = int(tokens.shape[1])
+        self._check_room(s, "append")
+
+        def build(params):
+            def run(vals, toks, caches, pos):
+                ctx = self._ctx(params, vals)
+                return self.model.decode_chunk(ctx, toks, caches, pos)
+            return jax.jit(run)
+
+        cache_name = self._cache_dtype if isinstance(
+            self._cache_dtype, str) else jnp.dtype(self._cache_dtype).name
+        fn, vals = self._compiled(
+            ("session-append", self.batch, s, cache_name), build)
+        logits, self.caches = fn(vals, tokens, self.caches,
+                                 jnp.int32(self.position))
+        self.position += s
+        self._last_logits = logits[:, -1]
+        return logits
+
+    def generate(self, max_new_tokens, temperature=0.0, top_k=None,
+                 top_p=None, key=None):
+        """Continue the session by ``max_new_tokens`` (greedy, or
+        sampled with generate()'s knobs); the emitted tokens are
+        ingested like any turn.  Requires at least one prior ``append``
+        (there is nothing to continue otherwise)."""
+        from ..models.gpt import make_sampler
+
+        if self.position == 0:
+            raise ValueError(
+                "generate on an empty session — append a prompt first")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self._check_room(max_new_tokens, "generate")
+        sample = make_sampler(temperature, top_k, top_p, self._vocab)
+        if temperature > 0.0 and key is None:
+            raise ValueError("sampling (temperature > 0) needs a PRNG "
+                             "key")
+        if key is None:
+            key = jax.random.PRNGKey(0)
+
+        def build(params):
+            def run(vals, caches, pos, last_logits, key):
+                ctx = self._ctx(params, vals)
+                key, sub = jax.random.split(key)
+                tok = sample(last_logits, sub)    # token AT the cursor
+
+                def step(carry, t):
+                    tok, caches, key, _ = carry
+                    logits, caches = self.model.decode_step(
+                        ctx, tok, caches, t)
+                    key, sub = jax.random.split(key)
+                    nxt = sample(logits, sub)
+                    return (nxt, caches, key, logits), tok
+
+                (_, caches, _, logits), toks = jax.lax.scan(
+                    step, (tok, caches, key, last_logits),
+                    pos + jnp.arange(max_new_tokens, dtype=jnp.int32))
+                # toks = the n EMITTED tokens (each step emits the
+                # token it consumed); the final carry logits are the
+                # cursor's next-token distribution, kept so a
+                # back-to-back generate() continues correctly
+                return jnp.swapaxes(toks, 0, 1), logits, caches
+            return jax.jit(run)
+
+        cache_name = self._cache_dtype if isinstance(
+            self._cache_dtype, str) else jnp.dtype(self._cache_dtype).name
+        fn, vals = self._compiled(
+            ("session-generate", self.batch, max_new_tokens,
+             float(temperature), top_k,
+             None if top_p is None else float(top_p), cache_name), build)
+        toks, self._last_logits, self.caches = fn(
+            vals, self.caches, jnp.int32(self.position),
+            self._last_logits, key)
+        self.position += max_new_tokens
+        return toks

@@ -649,6 +649,33 @@ def nucleus_filter(logits, top_p):
     return jnp.where(logits < thresh, -1e30, logits)
 
 
+def make_sampler(temperature, top_k, top_p, vocab):
+    """Validate the sampling knobs and return ``sample(logits, key)``
+    — ONE implementation of the greedy/temperature/top-k/top-p
+    composition, shared by ``generate`` and ``inference.DecodeSession``
+    so the two paths cannot drift."""
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k is not None and not 1 <= top_k <= vocab:
+        raise ValueError(
+            f"top_k must be in [1, vocab={vocab}], got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        if top_p is not None:
+            logits = nucleus_filter(logits, top_p)
+        return jax.random.categorical(k, logits, axis=-1)
+
+    return sample
+
+
 def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
              top_k=None, key=None, cache_dtype=None, mesh=None,
              top_p=None):
@@ -695,18 +722,12 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
         raise ValueError(
             f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_positions {model.max_positions}")
-    if temperature < 0.0:
-        raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0.0 and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if key is None:
         key = jax.random.PRNGKey(0)
     vocab = model.tok_emb.weight.shape[0]
-    if top_k is not None and not 1 <= top_k <= vocab:
-        raise ValueError(
-            f"top_k must be in [1, vocab={vocab}], got {top_k}")
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    sample = make_sampler(temperature, top_k, top_p, vocab)
     # unsupported-composition refusal (sp) wins over mesh demands;
     # then validate the mesh against the sharded axes
     model._decode_guard("generate")
@@ -721,17 +742,6 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
     vals = [q.data for q in params] + [bu.data for bu in buffers]
     if cache_dtype is None:
         cache_dtype = model.tok_emb.weight.data.dtype
-
-    def sample(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1)
-        logits = logits / temperature
-        if top_k is not None:
-            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-            logits = jnp.where(logits < kth, -1e30, logits)
-        if top_p is not None:
-            logits = nucleus_filter(logits, top_p)
-        return jax.random.categorical(k, logits, axis=-1)
 
     prompt_padded = jnp.concatenate(
         [prompt_ids, jnp.zeros((b, max_new_tokens), prompt_ids.dtype)],

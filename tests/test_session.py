@@ -1,0 +1,187 @@
+"""Multi-turn decode sessions (inference/session.py): persistent KV
+caches across append/generate calls must reproduce the one-shot decode
+of the concatenated history.
+
+Oracle: ``generate(model, full_history, n)`` (the cache protocol's
+one-shot driver).  Reference analogue: none (training-side library,
+SURVEY.md §2)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.inference import DecodeSession
+from apex_tpu.models import GptModel
+from apex_tpu.models.gpt import generate
+from apex_tpu.models.llama import LlamaModel
+
+V = 73
+
+
+def _gpt(**kw):
+    nn.manual_seed(6)
+    return GptModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                    max_positions=96, dropout=0.0, attn_dropout=0.0, **kw)
+
+
+def test_single_turn_matches_one_shot(rng):
+    m = _gpt()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 6)))
+    want = np.asarray(generate(m, prompt, 8))[:, 6:]
+    s = DecodeSession(m)
+    s.append(prompt)
+    got = np.asarray(s.generate(8))
+    np.testing.assert_array_equal(got, want)
+    assert s.position == 14
+
+
+def test_multi_turn_matches_one_shot_of_history(rng):
+    """The chat pattern: prompt -> model turn -> user turn -> model
+    turn, never re-prefilling; equals one-shot decode of the full
+    history."""
+    m = _gpt()
+    m.eval()
+    p1 = jnp.asarray(rng.integers(0, V, (1, 5)))
+    u2 = jnp.asarray(rng.integers(0, V, (1, 4)))
+
+    s = DecodeSession(m)
+    s.append(p1)
+    g1 = s.generate(6)
+    s.append(u2)
+    g2 = np.asarray(s.generate(6))
+
+    history = jnp.concatenate([p1, g1, u2], axis=1)
+    want = np.asarray(generate(m, history, 6))[:, history.shape[1]:]
+    np.testing.assert_array_equal(g2, want)
+
+
+def test_back_to_back_generate_continues(rng):
+    m = _gpt()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 5)))
+    s = DecodeSession(m)
+    s.append(prompt)
+    g = np.concatenate([np.asarray(s.generate(3)),
+                        np.asarray(s.generate(3))], axis=1)
+    want = np.asarray(generate(m, prompt, 6))[:, 5:]
+    np.testing.assert_array_equal(g, want)
+
+
+def test_session_append_logits_match_forward(rng):
+    from apex_tpu.nn.modules import Ctx
+
+    m = _gpt()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (2, 7)))
+    s = DecodeSession(m, batch=2)
+    logits = s.append(prompt)
+    want = m.forward(Ctx(training=False), prompt)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_session_rolling_window_model(rng):
+    """Windowed (rolling-cache) session: a multi-turn history well past
+    the window, with every generated position verified against the
+    exact banded-flash forward re-score of the full stream."""
+    from apex_tpu.nn.modules import Ctx
+
+    nn.manual_seed(6)
+    m = LlamaModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                   kv_heads=2, max_positions=96, sliding_window=8)
+    m.eval()
+    s = DecodeSession(m)
+    p1 = jnp.asarray(rng.integers(0, V, (1, 12)))
+    u2 = jnp.asarray(rng.integers(0, V, (1, 6)))
+    s.append(p1)
+    g1 = s.generate(10)
+    s.append(u2)
+    g2 = s.generate(10)
+    assert s.position == 12 + 10 + 6 + 10
+
+    hist = np.asarray(jnp.concatenate([p1, g1, u2, g2], axis=1))
+    logits = m.forward(Ctx(training=False), jnp.asarray(hist))
+    redo = np.asarray(jnp.argmax(logits, axis=-1))
+    # every greedily generated token equals the forward argmax of its
+    # preceding position
+    np.testing.assert_array_equal(hist[0, 12:22], redo[0, 11:21])
+    np.testing.assert_array_equal(hist[0, 28:38], redo[0, 27:37])
+
+
+def test_session_int8_cache_and_sampling(rng):
+    m = _gpt()
+    m.eval()
+    s = DecodeSession(m, cache_dtype="int8")
+    s.append(jnp.asarray(rng.integers(0, V, (1, 5))))
+    g = s.generate(6, temperature=0.8, top_k=10, top_p=0.9,
+                   key=jax.random.PRNGKey(0))
+    assert g.shape == (1, 6)
+    assert s.position == 11
+
+
+def test_session_validation(rng):
+    m = _gpt()
+    m.eval()
+    s = DecodeSession(m, capacity=10)
+    with pytest.raises(ValueError, match="append a prompt"):
+        s.generate(2)
+    s.append(jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(ValueError, match="capacity"):
+        s.generate(5)
+    s.reset()
+    assert s.position == 0
+
+    sp = _gpt(sp_axis="sp")
+    sp.eval()
+    with pytest.raises(NotImplementedError, match="single-shard"):
+        DecodeSession(sp)
+
+
+def test_session_lora_swap_recompiles(rng):
+    """Parameter-identity invariant: applying LoRA mid-lifecycle must
+    MISS the session's compiled cache and decode with the new
+    weights (utils/jit_cache.py contract)."""
+    from apex_tpu.reparameterization import apply_lora
+
+    m = _gpt()
+    m.eval()
+    prompt = jnp.asarray(rng.integers(0, V, (1, 5)))
+    s = DecodeSession(m)
+    s.append(prompt)
+    before = np.asarray(s.generate(4))
+
+    apply_lora(m, r=2)
+    # fresh decode state under the new parameter set
+    s.reset()
+    s.append(prompt)
+    after = np.asarray(s.generate(4))
+    want = np.asarray(generate(m, prompt, 4))[:, 5:]
+    np.testing.assert_array_equal(after, want)
+    assert s.position == 9
+    _ = before  # decoded under the pre-LoRA weights
+
+
+def test_session_capacity_validation():
+    m = _gpt()
+    m.eval()
+    with pytest.raises(ValueError, match="capacity"):
+        DecodeSession(m, capacity=0)
+    with pytest.raises(ValueError, match="capacity"):
+        DecodeSession(m, capacity=-3)
+    with pytest.raises(ValueError, match="capacity"):
+        DecodeSession(m, capacity=1000)
+
+
+def test_session_sampler_validation(rng):
+    m = _gpt()
+    m.eval()
+    s = DecodeSession(m)
+    s.append(jnp.zeros((1, 3), jnp.int32))
+    with pytest.raises(ValueError, match="top_p"):
+        s.generate(2, temperature=0.7, top_p=0.0,
+                   key=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="top_k"):
+        s.generate(2, temperature=0.7, top_k=0,
+                   key=jax.random.PRNGKey(0))
