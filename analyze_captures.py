@@ -115,6 +115,58 @@ def report():
             out.append(f"- {m}: {v}{rel}")
         out.append("")
 
+    # ---- round-4 A/B verdicts (channels-last conv layout; rolling
+    # window cache) — explicit ratio lines when both arms landed
+    nhwc = best.get("resnet50_imagenet_nhwc_images_per_sec_per_chip_ampO2")
+    # batch-matched NCHW arm (bench retries smaller batches on failure,
+    # so the two arms can land at different batch sizes)
+    nchw = None
+    if nhwc:
+        for r in rows:
+            if (r.get("metric") ==
+                    "resnet50_imagenet_images_per_sec_per_chip_ampO2"
+                    and r.get("value") is not None
+                    and r.get("batch") == nhwc.get("batch")):
+                nchw = r
+    if nchw and nhwc and nchw.get("value"):
+        r = nhwc["value"] / nchw["value"]
+        out += ["## Channels-last A/B", "",
+                f"NHWC {nhwc['value']} vs NCHW {nchw['value']} img/s "
+                f"(batch {nhwc.get('batch')}) = {r:.3f}x — "
+                + ("adopt NHWC as the headline path (and re-profile)."
+                   if r > 1.03 else
+                   "layout change does not pay on this model/compiler; "
+                   "keep NCHW headline, document the finding."
+                   if r < 0.97 else "within noise; keep NCHW default."),
+                ""]
+    # every windowed arm (any window size / quantization flavor),
+    # each against its config-matched full-cache sibling (same
+    # int8/kv-int8 flavor, batch, and prompt)
+    win_rows = [r for m, r in sorted(best.items())
+                if "_decode" in m and "_window" in m
+                and m.startswith("llama_125m_greedy_decode")]
+    ab_lines = []
+    for win in win_rows:
+        sibling = win["metric"].split("_window")[0] \
+            + "_tokens_per_sec_per_chip"
+        full = None
+        for r in rows:
+            if (r.get("metric") == sibling
+                    and r.get("value") is not None
+                    and r.get("batch") == win.get("batch")
+                    and r.get("prompt_len") == win.get("prompt_len")):
+                full = r
+        if full:
+            ab_lines.append(
+                f"- window={win.get('window')} arm {win['value']} vs "
+                f"full-cache {full['value']} tok/s (batch "
+                f"{win.get('batch')}, prompt {win.get('prompt_len')}) "
+                f"= {win['value'] / full['value']:.2f}x")
+    if ab_lines:
+        out += ["## Rolling-cache decode A/B", "", *ab_lines,
+                "(expected >1 when the KV term dominates; see the "
+                "batch/prompt sizing note in auto_capture.sh)", ""]
+
     # ---- GPT-1024 diagnosis
     diag = _load_jsonl(os.path.join(HERE, "diagnose_gpt1024.jsonl"))
     if diag:
