@@ -156,7 +156,7 @@ def report():
                     and r.get("batch") == win.get("batch")
                     and r.get("prompt_len") == win.get("prompt_len")):
                 full = r
-        if full:
+        if full and full.get("value"):
             ab_lines.append(
                 f"- window={win.get('window')} arm {win['value']} vs "
                 f"full-cache {full['value']} tok/s (batch "

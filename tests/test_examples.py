@@ -216,3 +216,20 @@ def test_imagenet_channels_last_example_runs(tmp_path):
         capture_output=True, text=True, timeout=600, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "img/s" in out.stdout or "loss" in out.stdout.lower()
+
+
+def test_gpt_session_example_runs():
+    """The serving-session demo: multi-turn int8 chat with the one-shot
+    exactness assertion inside the script."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    script = os.path.join(REPO, "examples", "gpt", "main_session.py")
+    code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.argv = ['main_session.py', '--turns', '2', "
+            f"'--reply-tokens', '6', '--hidden', '64']; "
+            f"import runpy; runpy.run_path({script!r}, "
+            f"run_name='__main__')")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "equals one-shot decode of the history: True" in out.stdout
