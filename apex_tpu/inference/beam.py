@@ -15,9 +15,11 @@ reordering is a gather on the batch-beam axis — all static shapes
 inside one ``lax.scan``, compiled once per config (the
 ``compiled_run_cache`` convention).
 
-Scoring is the plain sum of token log-probs (no length penalty); with
-``eos_id`` set, a finished beam freezes its score and pads with
-``eos_id`` while continuing to compete for the final ranking.
+Scores carry the raw sum of token log-probs; ranking (and the final
+beam choice) optionally normalizes by the GNMT length penalty
+(``length_penalty=alpha``).  With ``eos_id`` set, a finished beam
+freezes its score and length and pads with ``eos_id`` while
+continuing to compete for the final ranking.
 """
 from __future__ import annotations
 
@@ -26,11 +28,18 @@ import jax.numpy as jnp
 
 
 def beam_generate(model, prompt_ids, max_new_tokens, num_beams,
-                  eos_id=None, cache_dtype=None, mesh=None):
+                  eos_id=None, length_penalty=0.0, cache_dtype=None,
+                  mesh=None):
     """Beam-search continuation of ``prompt_ids (B, P)``: returns the
     best beam per item, ``(B, P + max_new_tokens)`` int32.
 
     ``num_beams=1`` reduces exactly to greedy ``generate``.
+    ``length_penalty`` is the GNMT normalization exponent: candidates
+    rank by ``score / ((5 + len) / 6) ** alpha`` (``len`` counts
+    generated tokens, frozen at eos), countering beam search's
+    short-sequence bias; ``0.0`` (default) ranks by the raw summed
+    log-probs.  Raw scores are carried either way — only the ranking
+    (and the final beam choice) normalizes.
     ``cache_dtype`` follows generate's contract (``"int8"`` for the
     quantized KV cache).  Sharded decode follows generate's mesh
     convention: a model built with ``tp_axis``/``moe_axis``/``sp_axis``
@@ -79,7 +88,15 @@ def beam_generate(model, prompt_ids, max_new_tokens, num_beams,
     vals = [q.data for q in params] + [bu.data for bu in buffers]
     if cache_dtype is None:
         cache_dtype = model.tok_emb.weight.data.dtype
+    if length_penalty < 0.0:
+        raise ValueError(
+            f"length_penalty must be >= 0, got {length_penalty}")
+    alpha = float(length_penalty)
     NEG = jnp.float32(-1e30)
+
+    def _lp(lens):
+        # GNMT normalizer; alpha == 0 -> exactly 1.0 (raw ranking)
+        return ((5.0 + lens.astype(jnp.float32)) / 6.0) ** alpha
 
     def run(vals, prompt):
         env = {id(o): v for o, v in zip(params + buffers, vals)}
@@ -97,11 +114,12 @@ def beam_generate(model, prompt_ids, max_new_tokens, num_beams,
         scores, tok = jax.lax.top_k(logp, k)          # (B, K) twice
         alive = (tok != eos_id) if eos_id is not None \
             else jnp.ones((b, k), bool)
+        lens = jnp.ones((b, k), jnp.int32)            # generated tokens
         buf = jnp.zeros((b, k, max_new_tokens), jnp.int32)
         buf = buf.at[:, :, 0].set(tok)
 
         def step(carry, t):
-            tok, scores, alive, buf, caches = carry
+            tok, scores, alive, lens, buf, caches = carry
             logits, caches = model.decode_step(
                 ctx, tok.reshape(b * k), caches, t)
             logp = jax.nn.log_softmax(
@@ -113,7 +131,15 @@ def beam_generate(model, prompt_ids, max_new_tokens, num_beams,
                 logp = jnp.where(alive[:, :, None], logp,
                                  frozen[None, None, :])
             cand = (scores[:, :, None] + logp).reshape(b, k * vocab)
-            scores, idx = jax.lax.top_k(cand, k)      # (B, K)
+            # rank by the length-normalized score (alive candidates are
+            # one token longer; frozen ones keep their final length),
+            # CARRY the raw sum either way
+            # per-candidate length: alive beams grow by one token
+            denom = _lp(lens + alive.astype(jnp.int32))
+            rank = (cand.reshape(b, k, vocab)
+                    / denom[:, :, None]).reshape(b, k * vocab)
+            _, idx = jax.lax.top_k(rank, k)           # (B, K)
+            scores = jnp.take_along_axis(cand, idx, axis=1)
             beam = idx // vocab                       # source beam
             tok = (idx % vocab).astype(jnp.int32)
             rows = (jnp.arange(b)[:, None] * k + beam).reshape(-1)
@@ -122,16 +148,19 @@ def beam_generate(model, prompt_ids, max_new_tokens, num_beams,
             buf = jnp.take_along_axis(buf, beam[:, :, None], axis=1)
             buf = jax.lax.dynamic_update_slice(
                 buf, tok[:, :, None], (0, 0, t - p + 1))
-            alive = jnp.take_along_axis(alive, beam, axis=1)
+            src_alive = jnp.take_along_axis(alive, beam, axis=1)
+            lens = jnp.take_along_axis(lens, beam, axis=1) \
+                + src_alive.astype(jnp.int32)
+            alive = src_alive
             if eos_id is not None:
                 alive = alive & (tok != eos_id)
-            return (tok, scores, alive, buf, caches), ()
+            return (tok, scores, alive, lens, buf, caches), ()
 
         if max_new_tokens > 1:
-            (tok, scores, alive, buf, caches), _ = jax.lax.scan(
-                step, (tok, scores, alive, buf, caches),
+            (tok, scores, alive, lens, buf, caches), _ = jax.lax.scan(
+                step, (tok, scores, alive, lens, buf, caches),
                 jnp.arange(p, s_total - 1))
-        best = jnp.argmax(scores, axis=1)             # (B,)
+        best = jnp.argmax(scores / _lp(lens), axis=1)  # (B,)
         seq = jnp.take_along_axis(
             buf, best[:, None, None], axis=1)[:, 0]   # (B, T)
         return jnp.concatenate([prompt, seq], axis=1)
@@ -146,7 +175,7 @@ def beam_generate(model, prompt_ids, max_new_tokens, num_beams,
 
     fn = compiled_run_cache(
         model, "_beam_jit_cache",
-        (b, p, max_new_tokens, k, eos_id,
+        (b, p, max_new_tokens, k, eos_id, alpha,
          cache_dtype if isinstance(cache_dtype, str)
          else jnp.dtype(cache_dtype).name, mesh),
         params + buffers, build)

@@ -35,11 +35,15 @@ def _llama(**kw):
                       kv_heads=2, max_positions=32, **kw)
 
 
-def _np_beam_reference(model, prompt, n_new, k, eos_id=None):
+def _np_beam_reference(model, prompt, n_new, k, eos_id=None, alpha=0.0):
     """Plain-python beam search scoring every candidate with the
     model's teacher-forced forward — no caches, no scan, no top_k —
-    the independent oracle for the compiled implementation."""
+    the independent oracle for the compiled implementation.  ``alpha``
+    is the GNMT length penalty: ranking (and the final pick) uses
+    score / ((5 + len)/6)**alpha with ``len`` the generated-token
+    count, frozen at eos."""
     ctx = Ctx(training=False)
+    p_len = prompt.shape[1]
 
     def next_logp(seq):
         ids = jnp.asarray(np.asarray(seq)[None, :])
@@ -47,22 +51,27 @@ def _np_beam_reference(model, prompt, n_new, k, eos_id=None):
         return np.asarray(jax.nn.log_softmax(
             logits[0, -1].astype(jnp.float32)))
 
+    def norm(score, length):
+        return score / (((5.0 + length) / 6.0) ** alpha)
+
     outs = []
     for row in np.asarray(prompt):
-        beams = [(list(row), 0.0, True)]      # (seq, score, alive)
+        beams = [(list(row), 0.0, True, 0)]  # (seq, score, alive, len)
         for _ in range(n_new):
             cand = []
-            for seq, score, alive in beams:
+            for seq, score, alive, ln in beams:
                 if not alive:
-                    cand.append((seq + [eos_id], score, False))
+                    cand.append((seq + [eos_id], score, False, ln))
                     continue
                 lp = next_logp(seq)
                 for v in range(V):
                     a = not (eos_id is not None and v == eos_id)
-                    cand.append((seq + [v], score + lp[v], a))
-            cand.sort(key=lambda c: -c[1])
+                    cand.append((seq + [v], score + lp[v], a, ln + 1))
+            cand.sort(key=lambda c: -norm(c[1], c[3]))
             beams = cand[:k]
+        beams.sort(key=lambda c: -norm(c[1], c[3]))
         outs.append(beams[0][0])
+        assert all(len(s) == p_len + n_new for s, *_ in beams)
     return np.asarray(outs)
 
 
@@ -189,3 +198,23 @@ def test_beam_validation():
     m_sp.eval()
     with pytest.raises(ValueError, match="mesh"):
         beam_generate(m_sp, toks, 4, num_beams=2)
+
+
+def test_beam_length_penalty_matches_numpy_reference(rng):
+    """GNMT length normalization with eos in play (beam lengths
+    diverge, so the penalty actually reorders candidates): the
+    compiled search matches the oracle under the same formula."""
+    from apex_tpu.inference import beam_generate as bg
+
+    m = _gpt()
+    m.eval()
+    eos = 3
+    prompt = jnp.asarray(rng.integers(0, V, (2, 3)))
+    for alpha in (0.6, 1.2):
+        got = np.asarray(bg(m, prompt, 6, num_beams=4, eos_id=eos,
+                            length_penalty=alpha))
+        want = _np_beam_reference(m, prompt, 6, 4, eos_id=eos,
+                                  alpha=alpha)
+        np.testing.assert_array_equal(got, want, err_msg=f"alpha={alpha}")
+    with pytest.raises(ValueError, match="length_penalty"):
+        bg(m, prompt, 4, num_beams=2, length_penalty=-1.0)
