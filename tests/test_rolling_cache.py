@@ -163,3 +163,32 @@ def test_undersized_windowed_cache_refuses_wrap(rng):
     _, caches = wide.decode_chunk(ctx, toks, caches, 0)
     with pytest.raises(ValueError, match="cache capacity"):
         wide.decode_chunk(ctx, toks, caches, 12)
+
+
+def test_random_chunk_schedules_match_forward(rng):
+    """Property-style: several random decode_chunk interleavings
+    (assorted chunk lengths, incl. window-straddling and
+    longer-than-window) must all reproduce the teacher-forced banded
+    forward — the cache protocol is schedule-invariant."""
+    m = _model()
+    m.eval()
+    toks = jnp.asarray(rng.integers(0, V, (1, 40)))
+    want = np.asarray(m.forward(Ctx(training=False), toks))
+    for trial in range(3):
+        sizes = []
+        left = 40
+        while left:
+            c = int(rng.integers(1, min(left, 13) + 1))
+            sizes.append(c)
+            left -= c
+        caches = m.init_caches(1, 40)
+        ctx = Ctx(training=False)
+        outs = []
+        t = 0
+        for c in sizes:
+            lg, caches = m.decode_chunk(ctx, toks[:, t:t + c], caches, t)
+            outs.append(np.asarray(lg))
+            t += c
+        got = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4,
+                                   err_msg=f"schedule {sizes}")

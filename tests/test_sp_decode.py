@@ -264,3 +264,48 @@ def test_sp_train_then_sp_decode_bridge(rng):
     ref.eval()
     want = np.asarray(generate(ref, prompt, 10))
     np.testing.assert_array_equal(got, want)
+
+
+def test_sp_random_chunk_schedules_match_forward(rng):
+    """Property-style: random decode_chunk interleavings under SP
+    (chunks bounded by the per-device cache block, straddling owners
+    arbitrarily) all reproduce the single-shard teacher-forced
+    forward — the sharded cache protocol is schedule-invariant."""
+    from jax.sharding import PartitionSpec as P
+
+    m_ref = _gpt()
+    m_ref.eval()
+    m_sp = _gpt(sp_axis="sp")
+    m_sp.eval()
+    _sync_params(m_ref, m_sp)
+    params = list(m_sp.parameters())
+    toks = jnp.asarray(rng.integers(0, V, (1, 32)))
+    want = np.asarray(m_ref.forward(Ctx(training=False), toks))
+
+    for trial in range(2):
+        sizes = []
+        left = 32
+        while left:
+            c = int(rng.integers(1, min(left, 8) + 1))  # block = 8
+            sizes.append(c)
+            left -= c
+
+        def run(vals, toks):
+            ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                      training=False)
+            caches = m_sp.init_caches(1, 32)   # 8-slot blocks on sp=4
+            outs = []
+            t = 0
+            for c in sizes:
+                lg, caches = m_sp.decode_chunk(
+                    ctx, toks[:, t:t + c], caches, t)
+                outs.append(lg)
+                t += c
+            return jnp.concatenate(outs, axis=1)
+
+        got = jax.jit(jax.shard_map(
+            run, mesh=_sp_mesh(4), in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))([p.data for p in params], toks)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=3e-4, atol=3e-4,
+                                   err_msg=f"schedule {sizes}")
