@@ -14,15 +14,45 @@ Loss semantics (xentropy_kernel.cu:404-410): with smoothing s and C classes,
 entropy against ``q = (1-s)·onehot + s/C``.  Per-sample losses are returned
 (no reduction); rows with ``label == padding_idx`` contribute zero loss and
 zero gradient (softmax_xentropy.py:10,24).
+
+Memory discipline (the part the CUDA kernel gets from streaming row-blocks
+through shared memory): two measures keep peak HBM bounded at LM shapes,
+where a (B·S, 50257) f32 temporary is gigabytes —
+
+* the backward never materializes the one-hot/q tensor: the smoothing term
+  folds into the elementwise ``probs - s/C`` and the label column is fixed
+  up with a per-row scatter-add (O(rows), not O(rows·C));
+* above ``_AUTO_ELEMS`` elements (or always, when ``APEX_TPU_XENT_BLOCK_ROWS``
+  is set) both passes run row-blocked under ``lax.map(batch_size=...)`` so
+  only one block of f32 temporaries is live at a time.  The GPT seq-1024
+  loss shape (16384, 50257) — the on-chip OOM-crash signature this guards
+  against (diagnose_gpt1024.jsonl round 4) — chunks into two blocks; the
+  seq-128 headline shape stays on the single-shot path.
 """
 from __future__ import annotations
 
+import math
+import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 _f32 = jnp.float32
+# Single-shot threshold, in logits elements: one f32 temporary of this size
+# is ~2.1 GB.  (16GB v5e; the backward keeps ~2 block-sized f32 temps live.)
+_AUTO_ELEMS = 1 << 29
+
+
+def _block_rows(n, c):
+    """Rows per chunk; 0 from the env means auto (single-shot when small)."""
+    forced = int(os.environ.get("APEX_TPU_XENT_BLOCK_ROWS", "0"))
+    if forced > 0:
+        return min(forced, n)
+    if n * c <= _AUTO_ELEMS:
+        return n
+    return max(1, min(n, _AUTO_ELEMS // max(c, 1)))
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
@@ -34,15 +64,32 @@ def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, padding_idx=0,
     return losses
 
 
+def _fwd_row(lf_row, label, smoothing, padding_idx):
+    lf = lf_row.astype(_f32)
+    m = jnp.max(lf)
+    lse = m + jnp.log(jnp.sum(jnp.exp(lf - m)))
+    loss = lse - (1.0 - smoothing) * lf[label] - smoothing * jnp.mean(lf)
+    return jnp.where(label == padding_idx, 0.0, loss), lse
+
+
+def _rowwise(row_fn, xs, n, block_rows):
+    """Apply a per-row function over stacked rows: plain vmap when a single
+    block covers everything (identical HLO to hand-batched code — no scan
+    wrapper on the hot path), lax.map row-blocks otherwise."""
+    if block_rows >= n:
+        return jax.vmap(row_fn)(xs)
+    return lax.map(row_fn, xs, batch_size=block_rows)
+
+
 def _fwd_math(logits, labels, smoothing, padding_idx):
-    lf = logits.astype(_f32)
-    m = jnp.max(lf, axis=-1)
-    lse = m + jnp.log(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1))
-    tgt_logit = jnp.take_along_axis(lf, labels[..., None], axis=-1)[..., 0]
-    losses = lse - (1.0 - smoothing) * tgt_logit \
-        - smoothing * jnp.mean(lf, axis=-1)
-    losses = jnp.where(labels == padding_idx, 0.0, losses)
-    return losses, lse
+    c = logits.shape[-1]
+    lead = logits.shape[:-1]
+    n = math.prod(lead)
+    losses, lse = _rowwise(
+        lambda xs: _fwd_row(xs[0], xs[1], smoothing, padding_idx),
+        (logits.reshape(n, c), labels.reshape(n)),
+        n, _block_rows(n, c))
+    return losses.reshape(lead), lse.reshape(lead)
 
 
 def _fwd(logits, labels, smoothing, padding_idx, half_to_float):
@@ -52,15 +99,28 @@ def _fwd(logits, labels, smoothing, padding_idx, half_to_float):
     return out, (logits, lse, labels)
 
 
+def _bwd_row(lf_row, lse, label, g, smoothing, padding_idx, out_dtype):
+    c = lf_row.shape[-1]
+    probs = jnp.exp(lf_row.astype(_f32) - lse)
+    gm = jnp.where(label == padding_idx, 0.0, g.astype(_f32))
+    grad = gm * (probs - smoothing / c)
+    # label-column fixup: q's one-hot part.  A padding label of -1 wraps to
+    # the last column, but gm is 0 there so the add is a no-op.
+    grad = grad.at[label].add(-(1.0 - smoothing) * gm)
+    return grad.astype(out_dtype)
+
+
 def _bwd(smoothing, padding_idx, half_to_float, res, g):
     logits, lse, labels = res
     c = logits.shape[-1]
-    probs = jnp.exp(logits.astype(_f32) - lse[..., None])
-    onehot = jax.nn.one_hot(labels, c, dtype=_f32)
-    q = (1.0 - smoothing) * onehot + smoothing / c
-    gmask = jnp.where(labels == padding_idx, 0.0, g.astype(_f32))
-    grad = gmask[..., None] * (probs - q)
-    return grad.astype(logits.dtype), None
+    n = math.prod(logits.shape[:-1])
+    grad = _rowwise(
+        lambda xs: _bwd_row(xs[0], xs[1], xs[2], xs[3], smoothing,
+                            padding_idx, logits.dtype),
+        (logits.reshape(n, c), lse.reshape(n), labels.reshape(n),
+         g.reshape(n)),
+        n, _block_rows(n, c))
+    return grad.reshape(logits.shape), None
 
 
 softmax_cross_entropy_loss.defvjp(_fwd, _bwd)

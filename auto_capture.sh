@@ -59,6 +59,11 @@ QUEUE=(
   "timeout 700 python bench.py --llama-decode --no-kernels"
   "timeout 700 python bench.py 16 --llama-decode --seq-len 512 --no-kernels"
   "timeout 700 python bench.py 16 --llama-decode --seq-len 512 --window 128 --no-kernels"
+  # appended round-4 continuation: the seq-1024 configs the xentropy OOM
+  # crash blocked (diagnose round 4: flash/rbg clean, xentropy at
+  # (16384, 50257) died) — re-measured on the row-blocked xentropy
+  "timeout 700 python bench.py 16 --gpt --seq-len 1024 --no-kernels"
+  "timeout 700 python bench.py 16 --llama --seq-len 1024 --no-kernels"
 )
 
 # No separate probe client: bench.py itself exits 4 when the backend

@@ -69,6 +69,44 @@ def test_half_to_float(rng):
     assert out32.dtype == jnp.float32
 
 
+@pytest.mark.parametrize("block", [3, 7, 32])
+def test_row_blocked_matches_single_shot(rng, block, monkeypatch):
+    """The memory-bounded row-blocked path (APEX_TPU_XENT_BLOCK_ROWS /
+    auto-chunking at LM loss shapes) must be numerically identical to the
+    single-shot path — blocks of 3 and 7 exercise the non-divisible
+    remainder of 32 rows."""
+    logits = jnp.asarray(rng.standard_normal((32, 50)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 50, (32,)))  # incl. padding rows
+
+    def run():
+        def total(lg):
+            per = softmax_cross_entropy_loss(lg, labels, 0.1, 0)
+            return jnp.sum(per ** 2), per
+        (_, per), grad = jax.value_and_grad(total, has_aux=True)(logits)
+        return np.asarray(per), np.asarray(grad)
+
+    loss_ref, grad_ref = run()
+    monkeypatch.setenv("APEX_TPU_XENT_BLOCK_ROWS", str(block))
+    loss_blk, grad_blk = run()
+    # scan-of-vmap and plain vmap fuse reductions differently → 1-ulp noise
+    np.testing.assert_allclose(loss_blk, loss_ref, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(grad_blk, grad_ref, rtol=1e-6, atol=1e-7)
+
+
+def test_blocked_preserves_leading_dims(rng, monkeypatch):
+    monkeypatch.setenv("APEX_TPU_XENT_BLOCK_ROWS", "2")
+    logits = jnp.asarray(rng.standard_normal((4, 6, 10)), jnp.float32)
+    labels = jnp.asarray(rng.integers(1, 10, (4, 6)))
+    out = softmax_cross_entropy_loss(logits, labels, 0.1, -1)
+    assert out.shape == (4, 6)
+    ref = _ref_losses(logits, labels, 0.1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    grad = jax.grad(lambda lg: jnp.sum(
+        softmax_cross_entropy_loss(lg, labels, 0.1, -1)))(logits)
+    assert grad.shape == logits.shape
+
+
 def test_agrees_with_cross_entropy_mean(rng):
     logits = jnp.asarray(rng.standard_normal((16, 10)), jnp.float32)
     labels = jnp.asarray(rng.integers(1, 10, (16,)))
