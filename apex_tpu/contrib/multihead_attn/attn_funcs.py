@@ -35,10 +35,13 @@ def _flash_min_sk():
     """Key-length threshold below which compiled dispatch prefers XLA's
     own attention over the Pallas flash kernel.
 
-    Measured on v5e (bench --kernels-timing, fwd+bwd): at S=256 the
-    flash kernel runs 0.82x XLA — short rows underfill the lane-padded
-    blocks, while from ~512 keys up the materialized score tensor grows
-    quadratically and flash's O(S) sweep wins.  Override with
+    Measured on v5e (bench --kernels-timing, fwd+bwd).  Round 3, before
+    causal block skipping: S=256 ran 0.82x XLA.  Round 4, with skipping
+    (BENCH_HISTORY round-4 A/B table): S=256 1.06x, S=512 0.96x (both
+    noise-level), S=1024 causal 1.24x, S=2048/D=128 1.19x, banded
+    S=2048/w=256 1.82x — flash decisively wins the shapes it exists
+    for, and the 256-512 boundary is a wash (the score-byte cap below
+    routes big-batch S=512 to flash regardless).  Override with
     APEX_TPU_FLASH_MIN_SK (0 forces flash everywhere)."""
     import os
     return int(os.environ.get("APEX_TPU_FLASH_MIN_SK", 512))

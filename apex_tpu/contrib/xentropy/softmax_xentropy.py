@@ -46,13 +46,20 @@ _AUTO_ELEMS = 1 << 29
 
 
 def _block_rows(n, c):
-    """Rows per chunk; 0 from the env means auto (single-shot when small)."""
+    """Rows per chunk; 0 from the env means auto (single-shot when small).
+
+    Auto blocks are BALANCED (ceil(n / n_chunks)) rather than maximal:
+    when the chunk count divides ``n`` — every power-of-two LM shape —
+    lax.map gets no remainder chunk, which halves the number of large
+    programs XLA compiles (the remainder is a second full fwd+bwd body;
+    measured ~4-minute seq-1024 compiles with it)."""
     forced = int(os.environ.get("APEX_TPU_XENT_BLOCK_ROWS", "0"))
     if forced > 0:
         return min(forced, n)
     if n * c <= _AUTO_ELEMS:
         return n
-    return max(1, min(n, _AUTO_ELEMS // max(c, 1)))
+    cap = max(1, min(n, _AUTO_ELEMS // max(c, 1)))
+    return math.ceil(n / math.ceil(n / cap))
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

@@ -75,7 +75,8 @@ def main(argv=None):
                             scope_map)
         with open(args.hlo) as f:
             smap = scope_map(f.read())
-        per_seq, unattributed = correlate(load_thunk_events(args.trace), smap)
+        per_seq, unattributed, _ = correlate(load_thunk_events(args.trace),
+                                             smap)
         rows = merge_measurements(rows, per_seq, executions=args.executions)
         print(f"# matched {len(per_seq)} ops, "
               f"unattributed {unattributed:.1f}us", file=sys.stderr)

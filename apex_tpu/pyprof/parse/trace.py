@@ -30,6 +30,8 @@ import re
 
 # host-runtime bookkeeping events on the device lanes that are not kernels
 _INFRA = ("ThreadpoolListener", "ThunkExecutor", "end: ")
+# whole-program span events: "jit_step(2360695404505296586)" etc.
+_PROGRAM_RE = re.compile(r"^jit_?[\w$.\-]*\(-?\d+\)$")
 
 _SCOPE_RE = re.compile(r"pp(\d+)_")
 _INSTR_RE = re.compile(
@@ -96,6 +98,12 @@ def load_thunk_events(path: str):
         name = e.get("name", "")
         if name.startswith("$") or any(s in name for s in _INFRA):
             continue
+        if _PROGRAM_RE.match(name):
+            # whole-program umbrella span on the device lane
+            # ("jit_step(<fingerprint>)"): it covers every thunk beneath
+            # it, so counting it double-counts the entire execution as
+            # unattributed time (round 4: 104ms of a 54ms resnet step)
+            continue
         out.append({"name": name, "dur_us": float(e.get("dur", 0.0)),
                     "ts_us": float(e.get("ts", 0.0))})
     return out
@@ -109,20 +117,44 @@ def scope_map(hlo_text: str):
             for m in _INSTR_RE.finditer(hlo_text)}
 
 
+_THUNK_KIND_RE = re.compile(r"[A-Za-z_][\w\-]*?(?=[.\d]|$)")
+
+
+def _thunk_kind(t, op_name):
+    """Coarse category for an unattributed thunk: the HLO instruction-name
+    stem ("fusion", "copy", "transpose", "convolution", "all-reduce", ...)
+    or, when the instruction DID carry scope-less metadata, the last
+    component of its op_name path prefixed "op:" — enough to tell layout
+    transposes and copies apart from real compute in the unmatched bucket."""
+    if op_name is not None:
+        return "op:" + op_name.rsplit("/", 1)[-1]
+    m = _THUNK_KIND_RE.match(t["name"].lstrip("%"))
+    return m.group(0) if m else "other"
+
+
 def correlate(thunks, smap):
-    """-> (per-seq measurements, unattributed) where measurements is
-    ``{seq: {"fwd_us", "bwd_us", "fwd_n", "bwd_n"}}`` summed over every
-    execution captured in the trace."""
+    """-> (per-seq measurements, unattributed, unattributed_by) where
+    measurements is ``{seq: {"fwd_us", "bwd_us", "fwd_n", "bwd_n"}}``
+    summed over every execution captured in the trace and
+    ``unattributed_by`` buckets the unmatched time by thunk category."""
     per_seq = {}
     unattributed_us = 0.0
+    unattributed_by = {}
+
+    def _miss(t, op_name):
+        nonlocal unattributed_us
+        unattributed_us += t["dur_us"]
+        k = _thunk_kind(t, op_name)
+        unattributed_by[k] = unattributed_by.get(k, 0.0) + t["dur_us"]
+
     for t in thunks:
         op_name = smap.get(t["name"])
         if op_name is None:
-            unattributed_us += t["dur_us"]
+            _miss(t, None)
             continue
         m = _SCOPE_RE.search(op_name)
         if m is None:
-            unattributed_us += t["dur_us"]
+            _miss(t, op_name)
             continue
         seq = int(m.group(1))
         d = per_seq.setdefault(
@@ -133,7 +165,7 @@ def correlate(thunks, smap):
         else:
             d["fwd_us"] += t["dur_us"]
             d["fwd_n"] += 1
-    return per_seq, unattributed_us
+    return per_seq, unattributed_us, unattributed_by
 
 
 def merge_measurements(rows, per_seq, executions: int = 1):
@@ -209,7 +241,7 @@ def profile_step(fn, *args, trace_dir=None, executions: int = 3,
             import shutil
             shutil.rmtree(tmp, ignore_errors=True)
             tmp = None
-    per_seq, unattributed_us = correlate(thunks, smap)
+    per_seq, unattributed_us, unattributed_by = correlate(thunks, smap)
     rows = merge_measurements(
         enrich(events, with_backward=with_backward), per_seq,
         executions=executions)
@@ -221,6 +253,10 @@ def profile_step(fn, *args, trace_dir=None, executions: int = 3,
         "matched_seqs": len(per_seq),
         "matched_us": round(matched_us, 3),
         "unattributed_us": round(unattributed_us, 3),
+        "unattributed_by": {
+            k: round(v, 3)
+            for k, v in sorted(unattributed_by.items(),
+                               key=lambda kv: -kv[1])},
         "executions": executions,
     }
     return rows, report

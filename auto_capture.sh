@@ -64,6 +64,17 @@ QUEUE=(
   # (16384, 50257) died) — re-measured on the row-blocked xentropy
   "timeout 700 python bench.py 16 --gpt --seq-len 1024 --no-kernels"
   "timeout 700 python bench.py 16 --llama --seq-len 1024 --no-kernels"
+  # GPT sweep re-run: the 08:45 UTC capture hit a shared-tunnel
+  # contention window (uniform 1.6x slowdown incl. compiles; llama at
+  # 08:48 healthy) — its points contradict the same-config headline
+  "timeout 900 python bench.py --gpt --sweep 32,64,128 --no-kernels --budget-s 840"
+  # spec-decode re-run on the teacher-forced exactness gate (the prefix
+  # gate cascade-failed on a benign position-147 argmax tie at 08:52)
+  "timeout 900 python bench.py --spec-decode --no-kernels --budget-s 840"
+  # profile re-runs now that the unattributed bucket is split by thunk
+  # category (the 08:38 resnet profile left 72% of step time unnamed)
+  "timeout 700 python bench.py --profile"
+  "timeout 700 python bench.py --profile --gpt"
 )
 
 # No separate probe client: bench.py itself exits 4 when the backend

@@ -112,6 +112,14 @@ def _run_with_timeout(fn, timeout_s, wedge_msg):
 
 def init_backend(retries=4, probe_timeout_s=75):
     import jax
+
+    # off-chip smoke escape hatch: the axon plugin ignores JAX_PLATFORMS,
+    # so without this every bench invocation claims the (single-claim)
+    # TPU tunnel — even ones meant as CPU dry-runs next to a live
+    # capture queue.  The config update does stick (tests/conftest.py).
+    plat = os.environ.get("APEX_TPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     last = None
     for attempt in range(retries):
         try:
@@ -397,6 +405,13 @@ def run_profile(kind, batch, seq_len, top_n=15, plain_loss=False,
         "matched_us": round(total, 1),
         "unattributed_us": round(
             float(report.get("unattributed_us", 0.0)) / n_exec, 1),
+        # per-category split of the unmatched bucket (same per-execution
+        # scale): names whether unattributed time is layout transposes,
+        # copies, or unannotated fusions
+        "unattributed_top": {
+            k: round(v / n_exec, 1)
+            for k, v in sorted(report.get("unattributed_by", {}).items(),
+                               key=lambda kv: -kv[1])[:10]},
         "top_ops": [
             {"op": op, "dir": d, "us": round(us, 1),
              "pct": round(100.0 * us / total, 1) if total else None}
@@ -898,17 +913,47 @@ def run_spec_decode_throughput(batch, seq_len, new_tokens=128, k=4,
     log(f"compiled both in {compile_s:.1f}s")
     # the guarantee is exact up to floating-point argmax ties between
     # the chunked and single-token attention programs (one shared body,
-    # but XLA may reduce the two shapes differently); a tie flips one
-    # token and the tails diverge.  Tolerate a rare tie, fail on gross
-    # disagreement (a real bug breaks most positions, not one)
+    # but XLA may reduce the two shapes differently on the MXU); ONE tie
+    # flip cascades the whole tail, so prefix agreement is the wrong
+    # gate on hardware (round 4: a position-147 flip failed it while the
+    # algorithm was fine).  The non-cascading check is teacher-forced:
+    # re-run the target over each arm's own output and count positions
+    # where the emitted token disagrees with the target's argmax on that
+    # same prefix — a tie costs 1 mismatch, a real accept-logic bug
+    # mismatches nearly everywhere (1 - 1/V of positions).
     first_diff = int(jnp.sum(jnp.cumprod(
         (base == spec).all(0).astype(jnp.int32))))
     log(f"greedy/speculative agree on first {first_diff}/"
-        f"{base.shape[1]} positions")
-    if first_diff < seq_len + new_tokens // 2:
+        f"{base.shape[1]} positions (informational)")
+
+    import jax as _jax
+
+    from apex_tpu.nn.modules import Ctx
+
+    # params ride as jit ARGUMENTS (the decode entry points' ctx-env
+    # convention) — closing over the module would inline 125M weights
+    # as HLO constants and blow the remote-compile payload
+    t_params = list(target.parameters()) + list(target.buffers())
+    t_vals = [q.data for q in t_params]
+
+    @_jax.jit
+    def _tf_mismatches(vals, toks):
+        ctx = Ctx(env={id(o): v for o, v in zip(t_params, vals)},
+                  stats_out={}, training=False)
+        logits = target.forward(ctx, toks[:, :-1])
+        pred = jnp.argmax(logits[:, seq_len - 1:], axis=-1)
+        return jnp.sum(pred != toks[:, seq_len:])
+
+    n_gen = batch * new_tokens
+    mm_base = int(_tf_mismatches(t_vals, base))
+    mm_spec = int(_tf_mismatches(t_vals, spec))
+    log(f"teacher-forced mismatches: base {mm_base}/{n_gen}, "
+        f"spec {mm_spec}/{n_gen}")
+    if mm_spec > mm_base + max(2, n_gen // 16):
         raise AssertionError(
-            f"speculative output diverged from target greedy decode at "
-            f"position {first_diff} — more than an argmax tie")
+            f"speculative decode disagrees with the target's own argmax "
+            f"at {mm_spec}/{n_gen} positions (plain decode: {mm_base}) — "
+            f"more than argmax-tie noise")
 
     stage("timing", "3 calls each arm")
     t0 = time.perf_counter()

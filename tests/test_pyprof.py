@@ -223,6 +223,32 @@ def test_profile_step_measured_durations(rng, tmp_path):
     # the transpose thunks matched
     lin_bwd = [r for r in rows if r["op"] == "linear" and r["dir"] == "bwd"]
     assert lin_bwd
+    # the unmatched bucket is named by thunk category, and its categories
+    # sum to the unattributed total (same trace, same scale)
+    by = report["unattributed_by"]
+    assert abs(sum(by.values()) - report["unattributed_us"]) < 1.0
+
+
+def test_correlate_unattributed_breakdown():
+    """Unmatched thunk time buckets by instruction-name stem (no metadata)
+    or scope-less op_name tail — the split that tells layout transposes
+    from unannotated compute in a profile."""
+    from apex_tpu.pyprof.parse.trace import correlate
+
+    thunks = [
+        {"name": "pp0lin", "dur_us": 5.0, "ts_us": 0.0},       # matched
+        {"name": "transpose.7", "dur_us": 3.0, "ts_us": 1.0},  # no metadata
+        {"name": "transpose.9", "dur_us": 2.0, "ts_us": 2.0},
+        {"name": "copy.1", "dur_us": 4.0, "ts_us": 3.0},
+        {"name": "fusion.2", "dur_us": 1.5, "ts_us": 4.0},     # scope-less
+    ]
+    smap = {"pp0lin": "jit(f)/pp0_linear/dot_general",
+            "fusion.2": "jit(f)/convert_element_type"}
+    per_seq, unattributed, by = correlate(thunks, smap)
+    assert per_seq[0]["fwd_us"] == 5.0
+    assert unattributed == 10.5
+    assert by == {"transpose": 5.0, "copy": 4.0,
+                  "op:convert_element_type": 1.5}
 
 
 def test_parse_cli_with_trace(tmp_path, rng):
