@@ -11,9 +11,12 @@ attention GEMMs, mask fill, softmax, dropout, output projection.
 ``flash_attention`` is the fast path (replacing the ``fast_*_multihead_attn``
 CUDA extensions): a Pallas flash kernel on TPU
 (apex_tpu/ops/pallas/attention.py), an equivalent jnp computation elsewhere.
-Dropout inside the attention matrix uses the materializing path (the
-reference's fast kernels materialize the full softmax too — csrc/
-multihead_attn/softmax.h); with dropout off the flash path is O(S) memory.
+Attention dropout rides IN-KERNEL on this path — a counter-based hash mask
+regenerated in the backward (the analogue of the reference's fused Philox
+dropout, csrc/multihead_attn/dropout.cuh) — so the flash path stays O(S)
+memory with dropout active; only the tp/sp-mesh paths still require
+attn_dropout=0.  The ``_attn_with_dropout`` materializing path remains for
+the 'default' impl (reference softmax.h parity).
 """
 from __future__ import annotations
 
@@ -63,16 +66,21 @@ def _use_xla_attention(b, h, sq, sk):
         b * h * sq * sk * 4 <= _XLA_SCORES_BYTE_CAP
 
 
-def attention_reference(q4, k4, v4, bias, causal, scale, window=None):
+def attention_reference(q4, k4, v4, bias, causal, scale, window=None,
+                        dropout_p=0.0, dropout_seed=None):
     """Plain-XLA attention, (B, H, S, D) layout; the fallback/oracle
     path.  ``window`` adds the Mistral band on top of ``causal``
-    (position t sees keys in (t - window, t])."""
+    (position t sees keys in (t - window, t]).  ``dropout_p`` applies
+    the SAME counter-based hash mask the Pallas kernels generate
+    (ops/pallas/attention.dropout_keep_reference), so the two paths
+    agree bit-for-bit on which probs drop for a given seed."""
+    b, h, sq, d = q4.shape
+    sk = k4.shape[2]
     s = jnp.einsum("bhqd,bhkd->bhqk", q4.astype(_f32),
                    k4.astype(_f32)) * scale
     if bias is not None:
         s = s + bias[:, None].astype(_f32)
     if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
         rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         keep = rows >= cols
@@ -80,17 +88,23 @@ def attention_reference(q4, k4, v4, bias, causal, scale, window=None):
             keep = jnp.logical_and(keep, cols > rows - window)
         s = jnp.where(keep, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0:
+        mult = _k.dropout_keep_reference(b * h, sq, sk, dropout_seed,
+                                         dropout_p)
+        p = p * jax.lax.stop_gradient(mult).reshape(b, h, sq, sk)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v4.astype(_f32)).astype(q4.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q4, k4, v4, bias, causal, scale, interpret, window):
-    out, _ = _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret,
-                             window)
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q4, k4, v4, bias, seed, causal, scale, interpret, window,
+           dropout_p):
+    out, _ = _flash_fwd_math(q4, k4, v4, bias, seed, causal, scale,
+                             interpret, window, dropout_p)
     return out
 
 
-def _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret, window):
+def _flash_fwd_math(q4, k4, v4, bias, seed, causal, scale, interpret,
+                    window, dropout_p):
     b, h, sq, d = q4.shape
     sk = k4.shape[2]
     q3 = q4.reshape(b * h, sq, d)
@@ -102,32 +116,41 @@ def _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret, window):
         # repeating per head in the leading dim when per-batch
         bias3 = bias if bias.shape[0] == 1 else jnp.repeat(bias, h, axis=0)
     out3, lse = _k.flash_attention_fwd(q3, k3, v3, bias3, scale, causal,
-                                       interpret=interpret, window=window)
+                                       interpret=interpret, window=window,
+                                       dropout_p=dropout_p,
+                                       dropout_seed=seed)
     return out3.reshape(b, h, sq, d), (q3, k3, v3, bias3, out3, lse)
 
 
-def _flash_vjp_fwd(q4, k4, v4, bias, causal, scale, interpret, window):
-    out, res = _flash_fwd_math(q4, k4, v4, bias, causal, scale, interpret,
-                               window)
-    return out, (res, q4.shape, k4.shape, bias)
+def _flash_vjp_fwd(q4, k4, v4, bias, seed, causal, scale, interpret, window,
+                   dropout_p):
+    out, res = _flash_fwd_math(q4, k4, v4, bias, seed, causal, scale,
+                               interpret, window, dropout_p)
+    return out, (res, q4.shape, k4.shape, bias, seed)
 
 
-def _flash_vjp_bwd(causal, scale, interpret, window, saved, g):
-    (q3, k3, v3, bias3, out3, lse), qshape, kshape, bias = saved
+def _flash_vjp_bwd(causal, scale, interpret, window, dropout_p, saved, g):
+    (q3, k3, v3, bias3, out3, lse), qshape, kshape, bias, seed = saved
     b, h, sq, d = qshape
     dq, dk, dv = _k.flash_attention_bwd(
         q3, k3, v3, bias3, out3, lse, g.reshape(b * h, sq, d), scale, causal,
-        interpret=interpret, window=window)
+        interpret=interpret, window=window, dropout_p=dropout_p,
+        dropout_seed=seed)
     dbias = None if bias is None else jnp.zeros_like(bias)
+    # int32 seed cotangent is float0 by JAX convention
+    import numpy as _np
+
+    dseed = None if seed is None else _np.zeros(_np.shape(seed),
+                                                jax.dtypes.float0)
     return (dq.reshape(qshape), dk.reshape(kshape), dv.reshape(kshape),
-            dbias)
+            dbias, dseed)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q4, k4, v4, bias=None, causal=False, scale=None,
-                    sliding_window=None):
+                    sliding_window=None, dropout_p=0.0, dropout_seed=None):
     """Fused scaled-dot-product attention, (B, H, S, D) layout.
 
     ``bias`` is an additive mask, broadcastable (B|1, Sq|1, Sk) — carries
@@ -136,6 +159,13 @@ def flash_attention(q4, k4, v4, bias=None, causal=False, scale=None,
     Mistral band — position t sees keys in (t - window, t] — with
     fully-out-of-band blocks skipped in-kernel, so banded attention
     costs O(S·window).  Gradients flow to q/k/v only (masks are data).
+
+    ``dropout_p`` > 0 drops attention probabilities IN-KERNEL (the
+    reference's fused-dropout feature, apex/contrib/csrc/multihead_attn/
+    dropout.cuh): the mask is a counter-based hash of (``dropout_seed``,
+    head, row, col) regenerated in the backward — no (Sq, Sk) mask
+    tensor ever exists in HBM.  The XLA fallback applies the identical
+    hash mask, so dispatch does not change numerics for a given seed.
     """
     if sliding_window is not None:
         if not causal:
@@ -145,6 +175,13 @@ def flash_attention(q4, k4, v4, bias=None, causal=False, scale=None,
         if sliding_window < 1:
             raise ValueError(
                 f"sliding_window must be >= 1, got {sliding_window}")
+    if dropout_p:
+        if not 0.0 <= dropout_p < 1.0:
+            raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed (an "
+                             "int32 scalar; derive one per step from the "
+                             "training PRNG key)")
     if scale is None:
         scale = 1.0 / math.sqrt(q4.shape[-1])
     mode = pallas_mode()
@@ -157,9 +194,13 @@ def flash_attention(q4, k4, v4, bias=None, causal=False, scale=None,
         if bias is not None:
             bias = jax.lax.stop_gradient(bias)
         return attention_reference(q4, k4, v4, bias, causal, scale,
-                                   window=sliding_window)
-    return _flash(q4, k4, v4, bias, causal, scale, mode == "interpret",
-                  sliding_window)
+                                   window=sliding_window,
+                                   dropout_p=dropout_p,
+                                   dropout_seed=dropout_seed)
+    return _flash(q4, k4, v4, bias,
+                  None if not dropout_p else dropout_seed,
+                  causal, scale, mode == "interpret", sliding_window,
+                  dropout_p)
 
 
 # ---------------------------------------------------------------------------
@@ -309,13 +350,24 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
                                      causal=causal, scale=scale,
                                      bias=sp_bias)
         ctx3 = ctx4.reshape(b * heads, t, head_dim)
-    elif use_flash and dropout == 0.0:
+    elif use_flash:
+        # dropout rides IN-KERNEL (the reference fast path fuses dropout
+        # the same way, apex/contrib/csrc/multihead_attn/dropout.cuh);
+        # under TP the head-block hash positions would need the global
+        # head offset, but tp_attn_begin above already refuses
+        # dropout_prob > 0, so dropout here is single-shard only
         bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)
         q4 = q3.reshape(b, heads, t, head_dim)
         k4 = k3.reshape(b, heads, t, head_dim)
         v4 = v3.reshape(b, heads, t, head_dim)
+        seed = None
+        if dropout > 0.0:
+            if key is None:
+                raise ValueError("attention dropout requires a PRNG key")
+            seed = jax.random.bits(key, dtype=jnp.uint32).astype(jnp.int32)
         ctx4 = flash_attention(q4, k4, v4, bias=bias, causal=causal,
-                               scale=scale)
+                               scale=scale, dropout_p=dropout,
+                               dropout_seed=seed)
         ctx3 = ctx4.reshape(b * heads, t, head_dim)
     else:
         bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)
@@ -368,12 +420,20 @@ def encdec_attn_func(use_time_mask, is_training, heads, scale, inputs_q,
     v3 = jnp.swapaxes(kv[:, :, 1], 0, 1)
     bias = _masks_to_bias(mask, use_time_mask, b, heads, tq, tk)
     dropout = dropout_prob if is_training else 0.0
-    if use_flash and dropout == 0.0:
+    if use_flash:
         q4 = q3.reshape(b, heads, tq, head_dim)
         k4 = k3.reshape(b, heads, tk, head_dim)
         v4 = v3.reshape(b, heads, tk, head_dim)
+        seed = None
+        if dropout > 0.0:
+            # in-kernel dropout, same contract as self_attn_func (TP
+            # already refused dropout in tp_attn_begin above)
+            if key is None:
+                raise ValueError("attention dropout requires a PRNG key")
+            seed = jax.random.bits(key, dtype=jnp.uint32).astype(jnp.int32)
         ctx4 = flash_attention(q4, k4, v4, bias=bias, causal=False,
-                               scale=scale)
+                               scale=scale, dropout_p=dropout,
+                               dropout_seed=seed)
         ctx3 = ctx4.reshape(b * heads, tq, head_dim)
     else:
         ctx3 = _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout,

@@ -110,10 +110,15 @@ def _bwd_row(lf_row, lse, label, g, smoothing, padding_idx, out_dtype):
     c = lf_row.shape[-1]
     probs = jnp.exp(lf_row.astype(_f32) - lse)
     gm = jnp.where(label == padding_idx, 0.0, g.astype(_f32))
-    grad = gm * (probs - smoothing / c)
-    # label-column fixup: q's one-hot part.  A padding label of -1 wraps to
-    # the last column, but gm is 0 there so the add is a no-op.
-    grad = grad.at[label].add(-(1.0 - smoothing) * gm)
+    # label-column fixup (q's one-hot part) as an iota-compare, NOT a
+    # scatter: the compare fuses into this elementwise chain, while a
+    # vmapped scatter-add lowered to an XLA scatter that serialized the
+    # whole (rows, vocab) grad — measured 1.6x step-time regression on
+    # the seq-128 LM headlines (BENCH_HISTORY round 4).  For a padding
+    # label of -1 no column compares equal, and gm is 0 anyway.
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (c,), 0) == label)
+    grad = gm * (probs - smoothing / c) \
+        - ((1.0 - smoothing) * gm) * onehot.astype(_f32)
     return grad.astype(out_dtype)
 
 

@@ -31,10 +31,11 @@ class GptBlock(nn.Module):
                  attn_bias=False, _dense_ffn=True):
         super().__init__()
         self.ln1 = FusedLayerNorm(hidden)
-        # causal=True: when the flash path applies (attn_dropout == 0 in
-        # training, or eval) the kernel masks the triangle in-kernel with
-        # no O(S^2) mask operand; with attention dropout active the
-        # materializing fallback runs (the Pallas kernel has no dropout).
+        # causal=True: the flash path masks the triangle in-kernel with
+        # no O(S^2) mask operand.  Attention dropout ALSO rides the
+        # kernel (counter-based hash mask regenerated in the backward,
+        # ops/pallas/attention.py) — no (S, S) dropout mask tensor in
+        # HBM; only tp/sp meshes still require attn_dropout=0.
         # attn_bias=True (GPT-2 checkpoints carry QKV/out-proj biases)
         # selects the reference's 'default' impl, which is the one that
         # supports biases (reference contrib/multihead_attn/

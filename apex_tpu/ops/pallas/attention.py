@@ -75,6 +75,58 @@ def _round8(x):
     return max(8, (x + 7) // 8 * 8)
 
 
+def _hash_keep_u32(rows, cols, bh, seed):
+    """Counter-based per-element hash (murmur3-finalizer style) of
+    (seed, batch·head, global row, global col) → uint32.  Pure uint32
+    vector arithmetic: lowers on Mosaic AND in interpret mode, and the
+    jnp oracle (``dropout_keep_reference``) reproduces it bit-exactly —
+    unlike the hardware PRNG, which interpret mode mocks as zeros.  The
+    mask is a function of absolute positions only, so forward and both
+    backward kernels regenerate it identically regardless of block
+    sizes.  This is the TPU analogue of the reference's fused-dropout
+    Philox replay (apex/contrib/csrc/multihead_attn/dropout.cuh:
+    curand_uniform4 regenerated from the saved seed/offset in bwd)."""
+    h = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         + cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+         + seed.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+         + bh.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _mult_from_hash(h, rate):
+    """hash → inverted-dropout multiplier: 1/(1-rate) where the hash
+    clears the keep threshold, 0 elsewhere.  THE single definition of
+    the threshold/scaling — the kernels and the jnp oracle both call it,
+    so their bit-exact agreement cannot drift."""
+    thresh = jnp.uint32(min(int((1.0 - rate) * 2.0 ** 32), 2 ** 32 - 1))
+    return jnp.where(h < thresh, jnp.float32(1.0 / (1.0 - rate)),
+                     jnp.float32(0.0))
+
+
+def _dropout_mult(i, j, b, bq, bk, seed, rate):
+    """(bq, bk) f32 multiplier grid: 1/(1-rate) on kept positions, 0 on
+    dropped — inverted-dropout scaling applied to the attention probs."""
+    rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return _mult_from_hash(
+        _hash_keep_u32(rows, cols, jnp.asarray(b), seed), rate)
+
+
+def dropout_keep_reference(b, sq, sk, seed, rate):
+    """jnp oracle of the in-kernel mask: (B·H, Sq, Sk) f32 multipliers,
+    bit-identical to what the kernels generate (tests + fallback path)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (b, sq, sk), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (b, sq, sk), 2)
+    bh = jax.lax.broadcasted_iota(jnp.int32, (b, sq, sk), 0)
+    return _mult_from_hash(
+        _hash_keep_u32(rows, cols, bh, jnp.asarray(seed)), rate)
+
+
 def _mask_block(s, i, j, bq, bk, causal, window=None):
     """Causal (``rows >= cols``) and, with ``window``, Mistral-banded
     (``cols > rows - window``) masking of one score block."""
@@ -104,12 +156,14 @@ def _block_has_unmasked(i, j, bq, bk, window=None):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
-                has_bias, window=None):
+                has_bias, window=None, dropout_p=0.0):
+    refs = list(refs)
+    seed_ref = refs.pop(0) if dropout_p > 0.0 else None
     if has_bias:
         bias_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     else:
         o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-    i, j = pl.program_id(1), pl.program_id(2)
+    b, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
     def _init():
@@ -131,7 +185,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        # dropout multiplies the (unnormalized) probs in the ACCUMULATOR
+        # only; l keeps the full softmax sum, so out = dropout(P) @ v
+        # exactly (P the normalized probs), matching the eager path
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_p > 0.0:
+            p = p * _dropout_mult(i, j, b, bq, bk, seed_ref[0], dropout_p)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
             p, v, preferred_element_type=_f32)
         m_scr[...] = m_new
@@ -159,12 +218,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-               scale, causal, bq, bk, nk, has_bias, window=None):
+               scale, causal, bq, bk, nk, has_bias, window=None,
+               dropout_p=0.0):
+    refs = list(refs)
+    seed_ref = refs.pop(0) if dropout_p > 0.0 else None
     if has_bias:
         bias_ref, dq_ref, acc_scr = refs
     else:
         dq_ref, acc_scr = refs
-    i, j = pl.program_id(1), pl.program_id(2)
+    b, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
     def _init():
@@ -183,6 +245,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         p = jnp.exp(s - lse_ref[0])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=_f32)
+        if dropout_p > 0.0:
+            # d(out)/d(P) routes through the dropout multiplier; delta
+            # already includes it (delta = sum(do*out), out dropped)
+            dp = dp * _dropout_mult(i, j, b, bq, bk, seed_ref[0], dropout_p)
         ds = p * (dp - delta_ref[0])
         acc_scr[...] += jax.lax.dot(ds, k, preferred_element_type=_f32)
 
@@ -198,12 +264,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
-                scale, causal, bq, bk, nq, has_bias, window=None):
+                scale, causal, bq, bk, nq, has_bias, window=None,
+                dropout_p=0.0):
+    refs = list(refs)
+    seed_ref = refs.pop(0) if dropout_p > 0.0 else None
     if has_bias:
         bias_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
     else:
         dk_ref, dv_ref, dk_scr, dv_scr = refs
     # grid is (bh, k-blocks, q-blocks): q innermost for the accumulation
+    b = pl.program_id(0)
     j, i = pl.program_id(1), pl.program_id(2)
 
     @pl.when(i == 0)
@@ -222,10 +292,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
             s = s + bias_ref[0].astype(_f32)
         s = _mask_block(s, i, j, bq, bk, causal, window)
         p = jnp.exp(s - lse_ref[0])  # (bq, bk)
-        dv_scr[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        if dropout_p > 0.0:
+            dmult = _dropout_mult(i, j, b, bq, bk, seed_ref[0], dropout_p)
+            pd = p * dmult  # dropped probs: dv sees dropout(P)
+        else:
+            pd = p
+        dv_scr[...] += jax.lax.dot_general(pd, do, (((0,), (0,)), ((), ())),
                                            preferred_element_type=_f32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=_f32)
+        if dropout_p > 0.0:
+            dp = dp * dmult
         ds = p * (dp - delta_ref[0])  # (bq, bk)
         dk_scr[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                            preferred_element_type=_f32)
@@ -256,9 +333,15 @@ def _bias_spec(bias, bq, bk, for_dkv=False):
 
 
 def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False,
-                        window=None):
+                        window=None, dropout_p=0.0, dropout_seed=None):
     """q3 (BH, Sq, D), k3/v3 (BH, Sk, D), bias (B|1, Sq|1, Sk) or None.
-    Returns (out (BH, Sq, D), lse (BH, Sq) fp32)."""
+    ``dropout_p`` > 0 applies in-kernel inverted dropout to the attention
+    probs, regenerated from ``dropout_seed`` (int32 scalar) in the
+    backward.  Returns (out (BH, Sq, D), lse (BH, Sq) fp32)."""
+    if dropout_p and not 0.0 <= dropout_p < 1.0:
+        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     bq, bk = _block_sizes(sq, sk, d)
@@ -285,13 +368,16 @@ def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False,
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
     ]
     args = [q3, k3, v3]
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
     if has_bias:
         in_specs.append(_bias_spec(bias, bq, bk))
         args.append(bias)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq,
                           bk=bk, nk=nk, has_bias=has_bias,
-                          window=window),
+                          window=window, dropout_p=dropout_p),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -313,7 +399,8 @@ def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False,
 
 
 def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
-                        interpret=False, window=None):
+                        interpret=False, window=None, dropout_p=0.0,
+                        dropout_seed=None):
     """→ (dq, dk, dv) with the shapes/dtypes of q3/k3/v3."""
     bh, sq, d = q3.shape
     sk = k3.shape[1]
@@ -347,13 +434,18 @@ def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
 
     in_specs = [q_spec, k_spec, k_spec, q_spec, lse_spec, lse_spec]
     args = common + [lse, delta]
+    seed_arr = (jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+                if dropout_p > 0.0 else None)
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed_arr)
     if has_bias:
         in_specs.append(_bias_spec(bias, bq, bk))
         args.append(bias)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq,
                           bk=bk, nk=nk, has_bias=has_bias,
-                          window=window),
+                          window=window, dropout_p=dropout_p),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -368,13 +460,16 @@ def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
     lse_spec2 = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0))
     in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, lse_spec2, lse_spec2]
     args2 = common + [lse, delta]
+    if dropout_p > 0.0:
+        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args2.append(seed_arr)
     if has_bias:
         in_specs2.append(_bias_spec(bias, bq, bk, for_dkv=True))
         args2.append(bias)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq,
                           bk=bk, nq=nq, has_bias=has_bias,
-                          window=window),
+                          window=window, dropout_p=dropout_p),
         grid=(bh, nk, nq),
         in_specs=in_specs2,
         out_specs=[

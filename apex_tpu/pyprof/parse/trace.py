@@ -98,11 +98,13 @@ def load_thunk_events(path: str):
         name = e.get("name", "")
         if name.startswith("$") or any(s in name for s in _INFRA):
             continue
-        if _PROGRAM_RE.match(name):
-            # whole-program umbrella span on the device lane
-            # ("jit_step(<fingerprint>)"): it covers every thunk beneath
-            # it, so counting it double-counts the entire execution as
-            # unattributed time (round 4: 104ms of a 54ms resnet step)
+        if _PROGRAM_RE.match(name) or name.isdigit():
+            # whole-program umbrella spans on the device lane: named
+            # "jit_step(<fingerprint>)" on one lane and by bare
+            # per-execution run index ("0", "1", ...) on another — each
+            # covers every thunk beneath it, so counting them
+            # double-counts the entire execution as unattributed time
+            # (round 4: 104ms of a 54ms resnet step)
             continue
         out.append({"name": name, "dur_us": float(e.get("dur", 0.0)),
                     "ts_us": float(e.get("ts", 0.0))})

@@ -75,6 +75,26 @@ QUEUE=(
   # category (the 08:38 resnet profile left 72% of step time unnamed)
   "timeout 700 python bench.py --profile"
   "timeout 700 python bench.py --profile --gpt"
+  # seq-1024 "before" attribution (ran on pre-in-kernel-dropout code:
+  # names the materializing XLA attention + mask-RNG cost that the
+  # dropout kernel work below then removes)
+  "timeout 700 python bench.py 16 --profile --gpt --seq-len 1024"
+  # post-in-kernel-dropout re-measures: GPT/BERT attention now rides
+  # flash (or the hash-masked XLA path at short seq) WITH dropout —
+  # no (S, S) mask tensors, no rbg mask generation in the step.  The
+  # second seq-1024 profile is the "after" arm of the one above.
+  "timeout 700 python bench.py --gpt --no-kernels"
+  "timeout 700 python bench.py --bert --no-kernels"
+  "timeout 700 python bench.py 16 --gpt --seq-len 1024 --no-kernels"
+  "timeout 700 python bench.py 16 --profile --gpt --seq-len 1024"
+  "timeout 700 python bench.py 32 --bert --seq-len 512 --no-kernels"
+  "timeout 700 python bench.py --seq2seq --no-kernels"
+  # re-measures after replacing the xentropy backward's scatter with a
+  # fused iota-compare (the scatter was the 1.6x seq-128 LM regression
+  # first seen in the 08:45 sweep)
+  "timeout 700 python bench.py --gpt --no-kernels"
+  "timeout 700 python bench.py --bert --no-kernels"
+  "timeout 700 python bench.py 16 --gpt --seq-len 1024 --no-kernels"
 )
 
 # No separate probe client: bench.py itself exits 4 when the backend

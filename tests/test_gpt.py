@@ -215,6 +215,38 @@ def test_gpt_pallas_vs_fallback_loss_parity(rng):
                                rtol=2e-3, atol=2e-4)
 
 
+def test_gpt_attn_dropout_loss_parity_across_modes(rng):
+    """Attention dropout through the kernel: the in-kernel hash mask is a
+    pure function of the per-step key, so the interpret-mode Pallas build
+    and the jnp fallback drop the SAME probs and the training loss curves
+    match — the dropped-path analogue of the parity test above."""
+    from apex_tpu.nn import functional as F
+    from apex_tpu.ops.pallas import force_mode
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    def run(mode):
+        nn.manual_seed(6)
+        m = GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                     max_positions=64, dropout=0.1, attn_dropout=0.1)
+        opt = FusedAdam(list(m.parameters()), lr=1e-2)
+
+        def lm_loss(logits, ids):
+            return F.cross_entropy(logits[:, :-1].reshape((-1, V)),
+                                   ids[:, 1:].reshape((-1,)))
+
+        step = make_train_step(m, opt, lm_loss, loss_scale=1.0)
+        r = np.random.default_rng(8)
+        ids = jnp.asarray(r.integers(0, V, (4, S)))
+        with force_mode(mode):
+            return [float(step(ids, ids)) for _ in range(3)]
+
+    pallas_build = run("interpret")
+    python_build = run("off")
+    np.testing.assert_allclose(pallas_build, python_build,
+                               rtol=2e-3, atol=2e-4)
+
+
 def test_sequence_parallel_gpt_matches_unsharded(rng):
     """GptModel(sp_axis=...) under shard_map with the sequence dim sharded
     8-way: logits and parameter gradients match the unsharded model (ring

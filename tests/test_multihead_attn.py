@@ -146,6 +146,73 @@ def test_causal_flag_matches_explicit_time_mask(rng, impl):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_inkernel_dropout_matches_reference(rng, causal):
+    """In-kernel dropout (the reference's fused-dropout feature,
+    apex/contrib/csrc/multihead_attn/dropout.cuh) must agree with the
+    XLA oracle applying the SAME counter-based hash mask
+    (dropout_keep_reference) — fwd and grads, across block boundaries
+    (sq 320 > bq 256 forces a multi-q-block grid)."""
+    q, k, v = _qkv(rng, b=1, h=2, sq=320, sk=320, d=16)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    seed = jnp.int32(424242)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       dropout_p=0.3,
+                                       dropout_seed=seed) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(
+            q, k, v, None, causal, scale, dropout_p=0.3,
+            dropout_seed=seed) ** 2)
+
+    with force_mode("interpret"):
+        out = flash_attention(q, k, v, causal=causal, dropout_p=0.3,
+                              dropout_seed=seed)
+        g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    ref = attention_reference(q, k, v, None, causal, scale,
+                              dropout_p=0.3, dropout_seed=seed)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    for a, r in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_dropout_mask_properties(rng):
+    """The hash mask is seed-deterministic, seed-sensitive, and drops
+    ~p of the positions with inverted scaling on the rest."""
+    from apex_tpu.ops.pallas.attention import dropout_keep_reference
+
+    m1 = np.asarray(dropout_keep_reference(4, 64, 64, jnp.int32(7), 0.25))
+    m2 = np.asarray(dropout_keep_reference(4, 64, 64, jnp.int32(7), 0.25))
+    m3 = np.asarray(dropout_keep_reference(4, 64, 64, jnp.int32(8), 0.25))
+    assert (m1 == m2).all()
+    assert not (m1 == m3).all()
+    assert set(np.unique(m1)).issubset({0.0, np.float32(1.0 / 0.75)})
+    drop_frac = (m1 == 0.0).mean()
+    assert abs(drop_frac - 0.25) < 0.02
+    # distinct heads get distinct masks
+    assert not (m1[0] == m1[1]).all()
+
+
+def test_flash_dropout_zero_p_is_plain_attention(rng):
+    q, k, v = _qkv(rng, sq=48, sk=48)
+    with force_mode("interpret"):
+        a = flash_attention(q, k, v, causal=True)
+        b = flash_attention(q, k, v, causal=True, dropout_p=0.0,
+                            dropout_seed=jnp.int32(1))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_flash_dropout_requires_seed():
+    q = jnp.zeros((1, 1, 8, 8), jnp.float32)
+    with pytest.raises(ValueError, match="dropout_seed"):
+        flash_attention(q, q, q, dropout_p=0.1)
+
+
 @pytest.mark.parametrize("shape", [(256, 256), (192, 320)])
 def test_flash_causal_block_skip_multi_block(rng, shape, monkeypatch):
     """The causal block-skip must be exercised across MANY q/k blocks
