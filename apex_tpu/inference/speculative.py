@@ -38,7 +38,7 @@ import jax.numpy as jnp
 
 def speculative_generate(target, draft, prompt_ids, max_new_tokens,
                          k=4, cache_dtype=None, temperature=0.0,
-                         key=None, mesh=None):
+                         key=None, mesh=None, return_stats=False):
     """Decode of ``target`` accelerated by ``draft`` proposals.
 
     ``prompt_ids (B, P)`` -> ``(B, P + max_new_tokens)``.
@@ -194,11 +194,11 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
         m0 = jnp.int32(p)
 
         def cond(carry):
-            ids, m, t_caches, d_caches, key = carry
+            ids, m, t_caches, d_caches, key, rounds = carry
             return m < s_total - 1
 
         def body(carry):
-            ids, m, t_caches, d_caches, key = carry
+            ids, m, t_caches, d_caches, key, rounds = carry
             # per-round randomness derived from the position so the
             # program is replay-stable
             round_key = jax.random.fold_in(key, m)
@@ -286,11 +286,12 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
                 jnp.arange(k + 1)[None, :] < n_round, merged, cur)
             ids = jax.lax.dynamic_update_slice(ids, merged, (0, m + 1))
             return ids, jnp.minimum(m + n_round, s_total - 1), \
-                t_caches, d_caches, key
+                t_caches, d_caches, key, rounds + 1
 
-        ids, _, _, _, _ = jax.lax.while_loop(
-            cond, body, (ids, m0, t_caches, d_caches, key))
-        return ids[:, :s_total]
+        ids, _, _, _, _, rounds = jax.lax.while_loop(
+            cond, body, (ids, m0, t_caches, d_caches, key,
+                         jnp.zeros((), jnp.int32)))
+        return ids[:, :s_total], rounds
 
     # per-model compiled-run cache (see utils/jit_cache.py for the
     # parameter-identity/LRU invariants); each entry's closure pins its
@@ -309,7 +310,7 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
             from jax.sharding import PartitionSpec as _P
             return jax.jit(jax.shard_map(
                 run, mesh=mesh, in_specs=(_P(), _P(), _P(), _P()),
-                out_specs=_P(), check_vma=False))
+                out_specs=(_P(), _P()), check_vma=False))
         return jax.jit(run)
 
     fn = compiled_run_cache(
@@ -320,4 +321,21 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
          else jnp.dtype(cache_dtype).name,
          mesh),
         t_params + d_params, build, cap=8)
-    return fn(t_vals, d_vals, prompt_ids, key)
+    ids, rounds = fn(t_vals, d_vals, prompt_ids, key)
+    if return_stats:
+        # rounds is a traced-by-product scalar: fetching it syncs, which
+        # the stats path accepts (callers timing pure decode leave
+        # return_stats off and never pay the fetch).  The FIRST new
+        # token comes from the prefill argmax before the loop, so the
+        # verification rounds produce max_new_tokens - 1 tokens; the
+        # final round's tail clamp makes the derived acceptance a floor.
+        r = int(rounds)
+        tpr = (max_new_tokens - 1) / max(r, 1)
+        return ids, {
+            "rounds": r,
+            "tokens_per_round": tpr,
+            # per round the target contributes 1 token regardless; the
+            # rest are accepted draft proposals out of k offered
+            "draft_acceptance": (tpr - 1.0) / k if k else 0.0,
+        }
+    return ids

@@ -95,6 +95,12 @@ QUEUE=(
   "timeout 700 python bench.py --gpt --no-kernels"
   "timeout 700 python bench.py --bert --no-kernels"
   "timeout 700 python bench.py 16 --gpt --seq-len 1024 --no-kernels"
+  # in-kernel attention dropout arms (the historical GPT-2/BERT recipes
+  # the stable headline configs omit) + the acceptance-logged spec run
+  "timeout 700 python bench.py --gpt --attn-dropout 0.1 --no-kernels"
+  "timeout 700 python bench.py 16 --gpt --seq-len 1024 --attn-dropout 0.1 --no-kernels"
+  "timeout 700 python bench.py --bert --attn-dropout 0.1 --no-kernels"
+  "timeout 900 python bench.py --spec-decode --no-kernels --budget-s 840"
 )
 
 # No separate probe client: bench.py itself exits 4 when the backend

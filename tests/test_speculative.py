@@ -133,6 +133,31 @@ def test_perfect_draft_accepts_everything(rng):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_acceptance_stats(rng):
+    """return_stats: a perfect draft advances k+1 per round (acceptance
+    1.0); stats never change the emitted ids."""
+    target = _model(seed=7)
+    prompt = jnp.asarray(rng.integers(0, 1000, (2, 4)))
+    plain = speculative_generate(target, target, prompt,
+                                 max_new_tokens=9, k=3)
+    ids, stats = speculative_generate(target, target, prompt,
+                                      max_new_tokens=9, k=3,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(ids), np.asarray(plain))
+    # token 1 comes from the prefill; the loop covers the other 8 in
+    # two all-accepted rounds of k+1 = 4
+    assert stats["rounds"] == 2
+    assert stats["tokens_per_round"] == 4.0
+    assert stats["draft_acceptance"] == 1.0
+    # an adversarial draft accepts ~nothing: ~1 token per round
+    draft = _model(seed=99, hidden=64, layers=1, heads=2, kv_heads=1)
+    _, worst = speculative_generate(target, draft, prompt,
+                                    max_new_tokens=9, k=3,
+                                    return_stats=True)
+    assert worst["rounds"] >= 2
+    assert 0.0 <= worst["draft_acceptance"] <= 1.0
+
+
 def test_gpt_family_prefill_and_speculative(rng):
     """The GPT family implements the same cache protocol: prefill logits
     match the training forward, and speculative output matches the
