@@ -14,9 +14,11 @@ CUDA extensions): a Pallas flash kernel on TPU
 Attention dropout rides IN-KERNEL on this path — a counter-based hash mask
 regenerated in the backward (the analogue of the reference's fused Philox
 dropout, csrc/multihead_attn/dropout.cuh) — so the flash path stays O(S)
-memory with dropout active; only the tp/sp-mesh paths still require
-attn_dropout=0.  The ``_attn_with_dropout`` materializing path remains for
-the 'default' impl (reference softmax.h parity).
+memory with dropout active; under TP each head-shard folds its axis
+index into the seed (per-rank streams).  Only the SP-mesh path and the
+materializing 'default' impl under TP still require attn_dropout=0.
+The ``_attn_with_dropout`` materializing path remains for the 'default'
+impl (reference softmax.h parity).
 """
 from __future__ import annotations
 
@@ -237,6 +239,19 @@ def _masks_to_bias(mask, use_time_mask, b, heads, sq, sk, dtype=_f32):
     return mask.astype(_f32)[:, None, :]
 
 
+def _dropout_seed(key, tp_axis=None):
+    """int32 kernel seed from the step's PRNG key.  Under TP the mesh
+    axis index folds in, so each head-shard draws a decorrelated mask
+    stream (the reference's per-rank Philox-stream semantics: multi-rank
+    dropout is statistically independent, not bitwise equal to the
+    single-device run)."""
+    seed = jax.random.bits(key, dtype=jnp.uint32)
+    if tp_axis is not None:
+        seed = seed ^ (jax.lax.axis_index(tp_axis).astype(jnp.uint32)
+                       * jnp.uint32(0x9E3779B1))
+    return seed.astype(jnp.int32)
+
+
 def _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout_prob, key,
                        use_time_mask_causal=False):
     """Materializing attention with dropout on the probabilities — the
@@ -288,9 +303,9 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
     column→row pattern (parallel/tensor_parallel.py).  Weights stay FULL
     (replicated); each device slices its block at trace time, which XLA
     folds into the weight layout.  Composes with ``seq_parallel_axis``
-    (TP shards heads, SP shards time).  Attention dropout is unsupported
-    under TP (all devices share the PRNG key, so per-head-block masks
-    would be correlated; the model families require attn_dropout=0).
+    (TP shards heads, SP shards time).  Attention dropout composes with
+    TP on the flash path (per-shard seed streams, ``_dropout_seed``);
+    the materializing 'default' impl refuses it under TP.
     """
     t, b, e = inputs.shape
     head_dim = e // heads
@@ -303,7 +318,7 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
         # multiplies exactly head block i
         from ...parallel.tensor_parallel import tp_attn_begin
         (inputs,), heads, rows, (ow,) = tp_attn_begin(
-            tensor_parallel_axis, heads, is_training, dropout_prob,
+            tensor_parallel_axis, heads,
             [inputs], [iw] + ([ib] if ib is not None else []), [ow])
         iw = rows[0]
         if ib is not None:
@@ -352,10 +367,7 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
         ctx3 = ctx4.reshape(b * heads, t, head_dim)
     elif use_flash:
         # dropout rides IN-KERNEL (the reference fast path fuses dropout
-        # the same way, apex/contrib/csrc/multihead_attn/dropout.cuh);
-        # under TP the head-block hash positions would need the global
-        # head offset, but tp_attn_begin above already refuses
-        # dropout_prob > 0, so dropout here is single-shard only
+        # the same way, apex/contrib/csrc/multihead_attn/dropout.cuh)
         bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)
         q4 = q3.reshape(b, heads, t, head_dim)
         k4 = k3.reshape(b, heads, t, head_dim)
@@ -364,12 +376,18 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
         if dropout > 0.0:
             if key is None:
                 raise ValueError("attention dropout requires a PRNG key")
-            seed = jax.random.bits(key, dtype=jnp.uint32).astype(jnp.int32)
+            seed = _dropout_seed(key, tensor_parallel_axis)
         ctx4 = flash_attention(q4, k4, v4, bias=bias, causal=causal,
                                scale=scale, dropout_p=dropout,
                                dropout_seed=seed)
         ctx3 = ctx4.reshape(b * heads, t, head_dim)
     else:
+        if tensor_parallel_axis is not None and dropout > 0.0:
+            raise NotImplementedError(
+                "attention dropout under tensor parallelism requires the "
+                "flash path (impl='fast'): the materializing impl draws "
+                "its mask from one shared key, which would correlate "
+                "dropout across head shards")
         bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)
         ctx3 = _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout,
                                   key, use_time_mask_causal=causal)
@@ -409,7 +427,7 @@ def encdec_attn_func(use_time_mask, is_training, heads, scale, inputs_q,
         # as [k_h, v_h] pairs — contiguous row blocks are head blocks
         from ...parallel.tensor_parallel import tp_attn_begin
         (inputs_q, inputs_kv), heads, (wq, wkv), (ow,) = tp_attn_begin(
-            tensor_parallel_axis, heads, is_training, dropout_prob,
+            tensor_parallel_axis, heads,
             [inputs_q, inputs_kv], [wq, wkv], [ow])
         e = heads * head_dim
     q = jnp.matmul(inputs_q, wq.T)
@@ -426,16 +444,21 @@ def encdec_attn_func(use_time_mask, is_training, heads, scale, inputs_q,
         v4 = v3.reshape(b, heads, tk, head_dim)
         seed = None
         if dropout > 0.0:
-            # in-kernel dropout, same contract as self_attn_func (TP
-            # already refused dropout in tp_attn_begin above)
+            # in-kernel dropout, same contract as self_attn_func
             if key is None:
                 raise ValueError("attention dropout requires a PRNG key")
-            seed = jax.random.bits(key, dtype=jnp.uint32).astype(jnp.int32)
+            seed = _dropout_seed(key, tensor_parallel_axis)
         ctx4 = flash_attention(q4, k4, v4, bias=bias, causal=False,
                                scale=scale, dropout_p=dropout,
                                dropout_seed=seed)
         ctx3 = ctx4.reshape(b * heads, tq, head_dim)
     else:
+        if tensor_parallel_axis is not None and dropout > 0.0:
+            raise NotImplementedError(
+                "attention dropout under tensor parallelism requires the "
+                "flash path (impl='fast'): the materializing impl draws "
+                "its mask from one shared key, which would correlate "
+                "dropout across head shards")
         ctx3 = _attn_with_dropout(q3, k3, v3, bias, heads, scale, dropout,
                                   key)
     ctx = jnp.swapaxes(ctx3, 0, 1).reshape(tq, b, e)

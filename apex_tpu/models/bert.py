@@ -93,10 +93,9 @@ class BertModel(nn.Module):
         # design: heads + MLP hidden shard, everything else replicated,
         # full weights sliced at trace time); composes with sp_axis
         self.tp_axis = tp_axis
-        if tp_axis is not None and attn_dropout > 0.0:
-            raise ValueError(
-                "tp_axis requires attn_dropout=0.0 — attention dropout "
-                "is unsupported under tensor parallelism")
+        # attention dropout composes with tp_axis: each head-shard
+        # folds its axis index into the in-kernel mask seed (decorrelated
+        # per-rank streams, attn_funcs._dropout_seed)
         # remat: rematerialize each layer's activations in backward
         # (jax.checkpoint via nn.checkpoint_forward) — the long-sequence
         # HBM saver

@@ -35,7 +35,8 @@ class GptBlock(nn.Module):
         # no O(S^2) mask operand.  Attention dropout ALSO rides the
         # kernel (counter-based hash mask regenerated in the backward,
         # ops/pallas/attention.py) — no (S, S) dropout mask tensor in
-        # HBM; only tp/sp meshes still require attn_dropout=0.
+        # HBM; composes with tp_axis (per-shard seed streams); only
+        # sp meshes still require attn_dropout=0.
         # attn_bias=True (GPT-2 checkpoints carry QKV/out-proj biases)
         # selects the reference's 'default' impl, which is the one that
         # supports biases (reference contrib/multihead_attn/
@@ -352,13 +353,11 @@ class GptModel(nn.Module):
         # shard_map over a mesh with this axis; attention heads and the
         # MLP hidden shard over it, embeddings/LNs/head stay replicated.
         # Composes with sp_axis (TP shards heads, SP shards time) and
-        # with a data axis for 2-D/3-D meshes.  Requires attn_dropout=0
-        # (see attn_funcs.self_attn_func).
+        # with a data axis for 2-D/3-D meshes.
         self.tp_axis = tp_axis
-        if tp_axis is not None and attn_dropout > 0.0:
-            raise ValueError(
-                "tp_axis requires attn_dropout=0.0 — attention dropout "
-                "is unsupported under tensor parallelism")
+        # attention dropout composes with tp_axis: each head-shard
+        # folds its axis index into the in-kernel mask seed (decorrelated
+        # per-rank streams, attn_funcs._dropout_seed)
         # tp_vocab: Megatron vocab parallelism — the tied embedding table
         # row-shards over tp_axis, the input lookup combines partial rows,
         # and forward returns VOCAB-SHARDED logits (B, S, V/n_tp): the

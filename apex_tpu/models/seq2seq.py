@@ -86,13 +86,11 @@ class TransformerSeq2Seq(nn.Module):
         self.hidden = hidden
         self.max_positions = max_positions
         # tp_axis: Megatron tensor parallelism across BOTH stacks (see
-        # models/gpt.py — same full-weight/trace-time-slice design);
-        # requires attn_dropout=0 like the other families
+        # models/gpt.py — same full-weight/trace-time-slice design)
         self.tp_axis = tp_axis
-        if tp_axis is not None and attn_dropout > 0.0:
-            raise ValueError(
-                "tp_axis requires attn_dropout=0.0 — attention dropout "
-                "is unsupported under tensor parallelism")
+        # attention dropout composes with tp_axis: each head-shard
+        # folds its axis index into the in-kernel mask seed (decorrelated
+        # per-rank streams, attn_funcs._dropout_seed)
         self.tok_emb = nn.Embedding(vocab_size, hidden)
         self.pos_emb = nn.Embedding(max_positions, hidden)
         for emb in (self.tok_emb, self.pos_emb):

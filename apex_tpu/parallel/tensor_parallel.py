@@ -213,23 +213,26 @@ def vocab_parallel_cross_entropy(logits_shard, targets, axis_name,
     return losses
 
 
-def tp_attn_begin(axis_name, heads, is_training, dropout_prob,
-                  inputs, row_weights, col_weights):
+def tp_attn_begin(axis_name, heads, inputs, row_weights, col_weights):
     """Shared TP entry protocol for the attention functionals
-    (contrib/multihead_attn/attn_funcs.py) — one place for the dropout
-    guard, the f-operator application to every input stream, the head
-    divisibility check, and the weight-block slicing, so the self and
-    encdec paths cannot desynchronize.
+    (contrib/multihead_attn/attn_funcs.py) — one place for the
+    f-operator application to every input stream, the head divisibility
+    check, and the weight-block slicing, so the self and encdec paths
+    cannot desynchronize.
 
     Returns ``(inputs, heads_local, row_shards, col_shards)`` where
     ``row_weights`` slice dim 0 (head-major projection rows) and
     ``col_weights`` slice dim 1 (the row-parallel output projections);
-    exit is ``reduce_from_tp_region`` on the projected output."""
-    if is_training and dropout_prob > 0.0:
-        raise NotImplementedError(
-            "attention dropout is not supported under tensor "
-            "parallelism (per-head-block masks would be drawn from "
-            "one shared key); set attn_dropout=0.0")
+    exit is ``reduce_from_tp_region`` on the projected output.
+
+    Attention dropout IS supported under TP: the in-kernel hash mask's
+    seed is folded with ``lax.axis_index`` at the call site
+    (attn_funcs), so each head-shard draws a decorrelated stream — the
+    TPU analogue of the reference's per-rank Philox streams (multi-GPU
+    dropout there is not bit-identical to single-GPU either).  The
+    flip side, same as the reference: a TP run's dropped positions
+    differ from the single-shard run's, so dropped-path tp-vs-unsharded
+    comparisons are statistical, not bitwise."""
     inputs = [copy_to_tp_region(x, axis_name) for x in inputs]
     n = lax.psum(1, axis_name)
     if heads % n:

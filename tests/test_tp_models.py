@@ -118,6 +118,94 @@ def test_tp_gpt_fused_step_matches_unsharded():
     assert tp[-1] < tp[0]
 
 
+def test_tp_gpt_attention_dropout_trains():
+    """TP x attention dropout (refusal lifted): each head-shard folds
+    its axis index into the in-kernel mask seed, so the sharded dropped
+    step runs, the loss is finite and trains, dropout is demonstrably
+    ACTIVE (train loss differs from the dropout-free TP run), and eval
+    logits — dropout off — still match the unsharded oracle exactly."""
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, V, (2, S)))
+    tgt = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1))
+    mesh = Mesh(np.array(jax.devices())[:4].reshape(4), ("tp",))
+
+    def lm_loss(logits, tgt):
+        return F.cross_entropy(logits.reshape((-1, V)),
+                               tgt.reshape((-1,)))
+
+    def run_tp(attn_dropout, n=4):
+        nn.manual_seed(5)
+        m = GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                     max_positions=64, dropout=0.0,
+                     attn_dropout=attn_dropout, tp_axis="tp")
+        opt = FusedAdam(list(m.parameters()), lr=1e-2)
+        step = make_train_step(m, opt, lm_loss, half_dtype=None,
+                               loss_scale=1.0, tp_axis="tp")
+        sharded = jax.jit(jax.shard_map(
+            step._step_fn, mesh=mesh, in_specs=(P(), P(), P()),
+            out_specs=(P(), P()), check_vma=False))
+        state, losses = step.state, []
+        for _ in range(n):
+            state, l = sharded(state, ids, tgt)
+            losses.append(float(l))
+        return m, losses
+
+    m_drop, dropped = run_tp(0.3)
+    _, clean = run_tp(0.0)
+    assert np.isfinite(dropped).all()
+    assert dropped[-1] < dropped[0]          # still trains
+    assert abs(dropped[1] - clean[1]) > 1e-6  # dropout is active
+
+    # eval (dropout off): sharded logits == unsharded oracle
+    m_drop.eval()
+    params = list(m_drop.parameters())
+    vals = [p.data for p in params]
+
+    def tp_fwd(vals, ids):
+        ctx = Ctx(env={id(p): v for p, v in zip(params, vals)},
+                  training=False)
+        return m_drop.forward(ctx, ids)
+
+    out_tp = jax.jit(jax.shard_map(
+        tp_fwd, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        check_vma=False))(vals, ids)
+    nn.manual_seed(5)
+    m_ref = GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                     max_positions=64, dropout=0.0,
+                     attn_dropout=0.3).eval()
+    # same seed sequence -> same initial draw; but m_drop has TRAINED
+    # params, so evaluate the reference with m_drop's weights instead
+    params_ref = list(m_ref.parameters())
+    ctx = Ctx(env={id(pr): v for pr, v in zip(params_ref, vals)},
+              training=False)
+    out_ref = m_ref.forward(ctx, ids)
+    np.testing.assert_allclose(np.asarray(out_tp), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_tp_default_impl_attention_dropout_refused(rng):
+    """The materializing 'default' impl cannot decorrelate masks across
+    head shards (one shared key) — TP + dropout must refuse loudly
+    there, while the flash path composes (test above)."""
+    from apex_tpu.contrib.multihead_attn import self_attn_func
+
+    t, b, e, heads = 8, 2, 16, 4
+    x = jnp.asarray(rng.standard_normal((t, b, e)), jnp.float32)
+    iw = jnp.asarray(rng.standard_normal((3 * e, e)), jnp.float32)
+    ow = jnp.asarray(rng.standard_normal((e, e)), jnp.float32)
+    mesh = Mesh(np.array(jax.devices())[:4].reshape(4), ("tp",))
+
+    def fwd(x):
+        return self_attn_func(False, True, heads, 0.5, x, iw, ow,
+                              dropout_prob=0.1,
+                              key=jax.random.PRNGKey(0), use_flash=False,
+                              tensor_parallel_axis="tp")
+
+    with pytest.raises(NotImplementedError, match="flash path"):
+        jax.jit(jax.shard_map(fwd, mesh=mesh, in_specs=(P(),),
+                              out_specs=P(), check_vma=False))(x)
+
+
 def test_dp_x_tp_2d_mesh_training():
     """2-D composition on a (2, 4) mesh: batch sharded over 'data',
     heads/MLP sharded over 'tp'; per-step losses track the single-device
@@ -460,12 +548,11 @@ def test_tp_vocab_requires_tp_axis():
 
 
 def test_tp_config_validation():
-    with pytest.raises(ValueError, match="attn_dropout"):
-        GptModel(vocab_size=V, hidden=H, layers=1, heads=HEADS,
-                 tp_axis="tp")  # default attn_dropout=0.1
-    with pytest.raises(ValueError, match="attn_dropout"):
-        BertModel(vocab_size=V, hidden=H, layers=1, heads=HEADS,
-                  intermediate=64, tp_axis="tp")
+    # tp_axis with the default attn_dropout=0.1 constructs since the
+    # in-kernel per-shard mask streams landed (the old refusal is gone)
+    GptModel(vocab_size=V, hidden=H, layers=1, heads=HEADS, tp_axis="tp")
+    BertModel(vocab_size=V, hidden=H, layers=1, heads=HEADS,
+              intermediate=64, tp_axis="tp")
     # heads not divisible by the axis size fails loudly at trace time
     m = _gpt(tp_axis="tp")
     params = list(m.parameters())
