@@ -14,10 +14,13 @@ CUDA extensions): a Pallas flash kernel on TPU
 Attention dropout rides IN-KERNEL on this path — a counter-based hash mask
 regenerated in the backward (the analogue of the reference's fused Philox
 dropout, csrc/multihead_attn/dropout.cuh) — so the flash path stays O(S)
-memory with dropout active; under TP each head-shard folds its axis
-index into the seed (per-rank streams).  Only the SP-mesh path and the
-materializing 'default' impl under TP still require attn_dropout=0.
-The ``_attn_with_dropout`` materializing path remains for the 'default'
+memory with dropout active.  It composes with every mesh: under TP each
+head-shard folds its axis index into the seed (per-rank streams); under
+ring-SP the mask hashes GLOBAL coordinates from the replicated pre-shard
+key, making the dropped positions bit-identical to the single-device
+run; ulysses decorrelates per head-shard.  Only the materializing
+'default' impl refuses dropout under TP (one shared key).  The
+``_attn_with_dropout`` materializing path remains for the 'default'
 impl (reference softmax.h parity).
 """
 from __future__ import annotations
@@ -282,7 +285,7 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
                    output_biases=None, mask=None, dropout_prob=0.0,
                    key=None, use_flash=False, causal=False,
                    seq_parallel_axis=None, seq_parallel_impl="ring",
-                   tensor_parallel_axis=None):
+                   tensor_parallel_axis=None, sp_shared_key=None):
     """Reference signature parity (self_multihead_attn_func.py:6-10);
     ``use_flash`` selects the Pallas path (the fast_* extension analogue).
     ``causal`` applies the triangle in-kernel (no O(S^2) mask operand) —
@@ -292,8 +295,10 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
     on that mesh axis — attention rides the ring (or Ulysses all-to-all,
     per ``seq_parallel_impl``) while projections stay local.  The causal
     triangle is handled globally by the SP kernels; masks are supported
-    under 'ulysses' only (pass them GLOBAL-shape and replicated), and
-    attention dropout not at all.
+    under 'ulysses' only (pass them GLOBAL-shape and replicated).
+    Attention dropout composes with BOTH impls: ring hashes global
+    coordinates under the replicated pre-shard key (bit-consistent with
+    the single-device run), ulysses decorrelates per head-shard.
 
     ``tensor_parallel_axis``: Megatron-style head sharding over a mesh
     axis.  The QKV projection is column-parallel — the interleaved weight
@@ -348,22 +353,43 @@ def self_attn_func(use_time_mask, is_training, heads, scale, inputs,
             # from the mask's own (global) shape, and ulysses_attention
             # validates it against the gathered lengths
             sp_bias = _masks_to_bias(mask, use_time_mask, b, heads, t, t)
+        ring_seed = uly_seed = None
         if dropout > 0.0:
-            raise NotImplementedError(
-                "attention dropout is not supported under sequence "
-                "parallelism (the SP kernels have no dropout, like flash)")
+            # ring: the mask hashes GLOBAL coordinates, so a seed
+            # replicated across the axis makes SP dropout bit-consistent
+            # with the single-device kernel.  ulysses: heads are what is
+            # sharded — per-shard decorrelated streams (TP semantics).
+            if seq_parallel_impl == "ring":
+                if sp_shared_key is None:
+                    raise ValueError(
+                        "ring-SP attention dropout needs the replicated "
+                        "pre-shard key (sp_shared_key); model forwards "
+                        "supply it via fold_shard_into_key's shared_key")
+                # sp-replicated seed; under a TP x SP mesh the tp fold
+                # decorrelates head shards (axis_index(tp) is constant
+                # along sp, so sp-replication survives)
+                ring_seed = _dropout_seed(sp_shared_key,
+                                          tensor_parallel_axis)
+            else:
+                if key is None:
+                    raise ValueError(
+                        "attention dropout requires a PRNG key")
+                uly_seed = _dropout_seed(key, tensor_parallel_axis)
         q4 = q3.reshape(b, heads, t, head_dim)
         k4 = k3.reshape(b, heads, t, head_dim)
         v4 = v3.reshape(b, heads, t, head_dim)
         if seq_parallel_impl == "ring":
             ctx4 = ring_attention(q4, k4, v4,
                                   axis_name=seq_parallel_axis,
-                                  causal=causal, scale=scale)
+                                  causal=causal, scale=scale,
+                                  dropout_p=dropout,
+                                  dropout_seed=ring_seed)
         else:
             ctx4 = ulysses_attention(q4, k4, v4,
                                      axis_name=seq_parallel_axis,
                                      causal=causal, scale=scale,
-                                     bias=sp_bias)
+                                     bias=sp_bias, dropout_p=dropout,
+                                     dropout_seed=uly_seed)
         ctx3 = ctx4.reshape(b * heads, t, head_dim)
     elif use_flash:
         # dropout rides IN-KERNEL (the reference fast path fuses dropout

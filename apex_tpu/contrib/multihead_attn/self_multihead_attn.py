@@ -125,6 +125,14 @@ class SelfMultiheadAttn(_AttnModule):
             is_training = ctx.training and self.training
         drop_key = ctx.next_key() if (is_training and self.dropout > 0.0) \
             else None
+        # ring-SP dropout needs the PRE-FOLD (axis-replicated) key so the
+        # global hash mask agrees on every sequence shard; same counter
+        # as drop_key, so it equals the unsharded run's drop_key exactly
+        sp_shared_key = None
+        if (drop_key is not None and self.seq_parallel_axis is not None
+                and ctx.shared_key is not None):
+            sp_shared_key = jax.random.fold_in(ctx.shared_key,
+                                               ctx._key_idx)
 
         x = query
         if self.include_norm_add:
@@ -143,7 +151,8 @@ class SelfMultiheadAttn(_AttnModule):
             use_flash=(self.impl == "fast"), causal=self.causal,
             seq_parallel_axis=self.seq_parallel_axis,
             seq_parallel_impl=self.seq_parallel_impl,
-            tensor_parallel_axis=self.tensor_parallel_axis)
+            tensor_parallel_axis=self.tensor_parallel_axis,
+            sp_shared_key=sp_shared_key)
 
         if self.include_norm_add:
             if is_training and self.dropout > 0.0:

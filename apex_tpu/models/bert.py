@@ -106,10 +106,9 @@ class BertModel(nn.Module):
         # GLOBAL (B, S_global) and replicated.  Position embeddings use
         # global shard offsets; max_positions caps the GLOBAL length.
         self.sp_axis = sp_axis
-        if sp_axis is not None and attn_dropout > 0.0:
-            raise ValueError(
-                "sp_axis requires attn_dropout=0.0 — the sequence-"
-                "parallel kernels have no attention dropout (like flash)")
+        # attention dropout composes with sp_axis (ring: bit-consistent
+        # global hash mask; ulysses: per-shard streams — see
+        # attn_funcs.self_attn_func)
         self.tok_emb = nn.Embedding(vocab_size, hidden)
         self.pos_emb = nn.Embedding(max_positions, hidden)
         self.type_emb = nn.Embedding(type_vocab, hidden)

@@ -35,8 +35,8 @@ class GptBlock(nn.Module):
         # no O(S^2) mask operand.  Attention dropout ALSO rides the
         # kernel (counter-based hash mask regenerated in the backward,
         # ops/pallas/attention.py) — no (S, S) dropout mask tensor in
-        # HBM; composes with tp_axis (per-shard seed streams); only
-        # sp meshes still require attn_dropout=0.
+        # HBM; composes with tp_axis (per-shard seed streams) and
+        # sp_axis (ring: bit-consistent global hash mask).
         # attn_bias=True (GPT-2 checkpoints carry QKV/out-proj biases)
         # selects the reference's 'default' impl, which is the one that
         # supports biases (reference contrib/multihead_attn/
@@ -355,9 +355,17 @@ class GptModel(nn.Module):
         # Composes with sp_axis (TP shards heads, SP shards time) and
         # with a data axis for 2-D/3-D meshes.
         self.tp_axis = tp_axis
-        # attention dropout composes with tp_axis: each head-shard
-        # folds its axis index into the in-kernel mask seed (decorrelated
-        # per-rank streams, attn_funcs._dropout_seed)
+        # attention dropout composes with tp_axis on the flash path:
+        # each head-shard folds its axis index into the in-kernel mask
+        # seed (attn_funcs._dropout_seed).  The 'default' impl
+        # (attn_bias=True) cannot decorrelate — fail where the config
+        # is written, not deep inside shard_map tracing
+        if tp_axis is not None and attn_dropout > 0.0 and attn_bias:
+            raise ValueError(
+                "tp_axis with attn_dropout > 0 requires the flash impl; "
+                "attn_bias=True selects the materializing 'default' "
+                "impl, which draws from one shared key — set "
+                "attn_dropout=0.0 or attn_bias=False")
         # tp_vocab: Megatron vocab parallelism — the tied embedding table
         # row-shards over tp_axis, the input lookup combines partial rows,
         # and forward returns VOCAB-SHARDED logits (B, S, V/n_tp): the
@@ -379,12 +387,10 @@ class GptModel(nn.Module):
         # max_positions caps the GLOBAL sequence length.  Composes with
         # remat for the long-context recipe.
         self.sp_axis = sp_axis
-        if sp_axis is not None and attn_dropout > 0.0:
-            # fail where the config is written, not deep inside
-            # shard_map tracing on the first training step
-            raise ValueError(
-                "sp_axis requires attn_dropout=0.0 — the sequence-"
-                "parallel kernels have no attention dropout (like flash)")
+        # attention dropout composes with sp_axis: the ring hashes
+        # GLOBAL coordinates under the replicated pre-shard key, so the
+        # dropped positions are bit-identical to the unsharded run
+        # (attn_funcs.self_attn_func; ulysses decorrelates per shard)
         self.tok_emb = nn.Embedding(vocab_size, hidden)
         self.pos_emb = nn.Embedding(max_positions, hidden)
         # GPT initializer_range=0.02 (nn.Embedding draws std-1 normals; the

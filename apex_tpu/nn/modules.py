@@ -68,7 +68,7 @@ class Ctx:
     into the optimized loss (training/step.py).
     """
     __slots__ = ("env", "stats_out", "training", "key", "_key_idx",
-                 "aux_losses")
+                 "aux_losses", "shared_key")
 
     def __init__(self, env=None, stats_out=None, training=False, key=None):
         self.env = env or {}
@@ -77,6 +77,12 @@ class Ctx:
         self.key = key
         self._key_idx = 0
         self.aux_losses = []
+        # the key as it was BEFORE the innermost fold_shard_into_key:
+        # replicated across that shard axis.  Ring-attention dropout
+        # draws from it so the mask is identical on every sequence
+        # shard (bit-consistent with the single-device run), while
+        # ordinary dropout keeps drawing from the folded key.
+        self.shared_key = None
 
     def add_aux_loss(self, value):
         """Record a scalar auxiliary loss term (differentiable; gradients
@@ -844,6 +850,10 @@ def fold_shard_into_key(ctx, axis_name):
                                        jax.lax.axis_index(axis_name)))
     inner._key_idx = ctx._key_idx
     inner.aux_losses = ctx.aux_losses   # shared list: aux terms propagate
+    # pre-fold key: replicated across THIS axis.  Overwritten by the
+    # innermost fold (data-axis then sp-axis composition leaves the
+    # post-data/pre-sp key here — exactly what ring dropout needs).
+    inner.shared_key = ctx.key
     return inner
 
 def to_channels_last(module, enabled=True):

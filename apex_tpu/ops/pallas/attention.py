@@ -98,6 +98,14 @@ def _hash_keep_u32(rows, cols, bh, seed):
     return h
 
 
+def _seed_vec(seed, row_off, col_off):
+    """(3,) int32 SMEM payload [seed, row_off, col_off] for the kernels
+    (offsets may be traced scalars — ring chunks compute them per hop)."""
+    return jnp.stack([jnp.asarray(seed, jnp.int32).reshape(()),
+                      jnp.asarray(row_off, jnp.int32).reshape(()),
+                      jnp.asarray(col_off, jnp.int32).reshape(())])
+
+
 def _mult_from_hash(h, rate):
     """hash → inverted-dropout multiplier: 1/(1-rate) where the hash
     clears the keep threshold, 0 elsewhere.  THE single definition of
@@ -108,20 +116,29 @@ def _mult_from_hash(h, rate):
                      jnp.float32(0.0))
 
 
-def _dropout_mult(i, j, b, bq, bk, seed, rate):
+def _dropout_mult(i, j, b, bq, bk, seed_ref, rate):
     """(bq, bk) f32 multiplier grid: 1/(1-rate) on kept positions, 0 on
-    dropped — inverted-dropout scaling applied to the attention probs."""
-    rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    dropped — inverted-dropout scaling applied to the attention probs.
+    ``seed_ref`` is the (3,) SMEM vector [seed, row_off, col_off]: the
+    offsets shift block coordinates to GLOBAL positions, so a chunked
+    caller (ring attention) whose q/k blocks sit at arbitrary global
+    offsets draws the exact mask the single-device kernel would."""
+    rows = seed_ref[1] + i * bq \
+        + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = seed_ref[2] + j * bk \
+        + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return _mult_from_hash(
-        _hash_keep_u32(rows, cols, jnp.asarray(b), seed), rate)
+        _hash_keep_u32(rows, cols, jnp.asarray(b), seed_ref[0]), rate)
 
 
-def dropout_keep_reference(b, sq, sk, seed, rate):
+def dropout_keep_reference(b, sq, sk, seed, rate, row_off=0,
+                           col_off=0):
     """jnp oracle of the in-kernel mask: (B·H, Sq, Sk) f32 multipliers,
-    bit-identical to what the kernels generate (tests + fallback path)."""
-    rows = jax.lax.broadcasted_iota(jnp.int32, (b, sq, sk), 1)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (b, sq, sk), 2)
+    bit-identical to what the kernels generate (tests + fallback path).
+    ``row_off``/``col_off`` shift to global coordinates for chunked
+    callers (ring attention's jnp arm)."""
+    rows = row_off + jax.lax.broadcasted_iota(jnp.int32, (b, sq, sk), 1)
+    cols = col_off + jax.lax.broadcasted_iota(jnp.int32, (b, sq, sk), 2)
     bh = jax.lax.broadcasted_iota(jnp.int32, (b, sq, sk), 0)
     return _mult_from_hash(
         _hash_keep_u32(rows, cols, bh, jnp.asarray(seed)), rate)
@@ -190,7 +207,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
         # exactly (P the normalized probs), matching the eager path
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         if dropout_p > 0.0:
-            p = p * _dropout_mult(i, j, b, bq, bk, seed_ref[0], dropout_p)
+            p = p * _dropout_mult(i, j, b, bq, bk, seed_ref, dropout_p)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
             p, v, preferred_element_type=_f32)
         m_scr[...] = m_new
@@ -248,7 +265,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         if dropout_p > 0.0:
             # d(out)/d(P) routes through the dropout multiplier; delta
             # already includes it (delta = sum(do*out), out dropped)
-            dp = dp * _dropout_mult(i, j, b, bq, bk, seed_ref[0], dropout_p)
+            dp = dp * _dropout_mult(i, j, b, bq, bk, seed_ref, dropout_p)
         ds = p * (dp - delta_ref[0])
         acc_scr[...] += jax.lax.dot(ds, k, preferred_element_type=_f32)
 
@@ -293,7 +310,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
         s = _mask_block(s, i, j, bq, bk, causal, window)
         p = jnp.exp(s - lse_ref[0])  # (bq, bk)
         if dropout_p > 0.0:
-            dmult = _dropout_mult(i, j, b, bq, bk, seed_ref[0], dropout_p)
+            dmult = _dropout_mult(i, j, b, bq, bk, seed_ref, dropout_p)
             pd = p * dmult  # dropped probs: dv sees dropout(P)
         else:
             pd = p
@@ -333,7 +350,8 @@ def _bias_spec(bias, bq, bk, for_dkv=False):
 
 
 def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False,
-                        window=None, dropout_p=0.0, dropout_seed=None):
+                        window=None, dropout_p=0.0, dropout_seed=None,
+                        dropout_row_off=0, dropout_col_off=0):
     """q3 (BH, Sq, D), k3/v3 (BH, Sk, D), bias (B|1, Sq|1, Sk) or None.
     ``dropout_p`` > 0 applies in-kernel inverted dropout to the attention
     probs, regenerated from ``dropout_seed`` (int32 scalar) in the
@@ -370,7 +388,8 @@ def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False,
     args = [q3, k3, v3]
     if dropout_p > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
+        args.append(_seed_vec(dropout_seed, dropout_row_off,
+                              dropout_col_off))
     if has_bias:
         in_specs.append(_bias_spec(bias, bq, bk))
         args.append(bias)
@@ -400,7 +419,8 @@ def flash_attention_fwd(q3, k3, v3, bias, scale, causal, interpret=False,
 
 def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
                         interpret=False, window=None, dropout_p=0.0,
-                        dropout_seed=None):
+                        dropout_seed=None, dropout_row_off=0,
+                        dropout_col_off=0):
     """→ (dq, dk, dv) with the shapes/dtypes of q3/k3/v3."""
     bh, sq, d = q3.shape
     sk = k3.shape[1]
@@ -434,7 +454,8 @@ def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
 
     in_specs = [q_spec, k_spec, k_spec, q_spec, lse_spec, lse_spec]
     args = common + [lse, delta]
-    seed_arr = (jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+    seed_arr = (_seed_vec(dropout_seed, dropout_row_off,
+                          dropout_col_off)
                 if dropout_p > 0.0 else None)
     if dropout_p > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))

@@ -43,6 +43,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from jax import lax
 
 from ..ops.pallas import pallas_mode
@@ -62,30 +63,54 @@ def _chunk_bias(sq, sk, q_off, k_off, causal):
     return jnp.where(rows >= cols, 0.0, _NEG).astype(_f32)[None]
 
 
-def _chunk_fwd(q3, k3, v3, bias, scale, mode):
+def _chunk_fwd(q3, k3, v3, bias, scale, mode, dropout_p=0.0, seed=None,
+               q_off=0, k_off=0):
     """One attention block → (normalized out, logsumexp).  Finite masking
-    (-1e30) keeps every lse finite, which the merge relies on."""
+    (-1e30) keeps every lse finite, which the merge relies on.
+
+    Dropout uses the kernel's counter-based hash mask at GLOBAL
+    coordinates (``q_off``/``k_off`` shift this chunk's rows/cols): the
+    chunk's softmax sum ``l`` stays undropped, so the lse-merge across
+    chunks reconstructs exactly dropout(P_global) @ V — bit-consistent
+    masking with the single-device kernel."""
     if mode is not None:
         return _k.flash_attention_fwd(q3, k3, v3, bias, scale, False,
-                                      interpret=(mode == "interpret"))
+                                      interpret=(mode == "interpret"),
+                                      dropout_p=dropout_p,
+                                      dropout_seed=seed,
+                                      dropout_row_off=q_off,
+                                      dropout_col_off=k_off)
     s = jnp.einsum("bqd,bkd->bqk", q3.astype(_f32),
                    k3.astype(_f32)) * scale
     if bias is not None:
         s = s + bias
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bqk,bkd->bqd", p, v3.astype(_f32)) / l
+    l = jnp.sum(p, axis=-1, keepdims=True)   # undropped: full softmax sum
+    pn = p
+    if dropout_p > 0.0:
+        pn = p * _k.dropout_keep_reference(
+            q3.shape[0], q3.shape[1], k3.shape[1], seed, dropout_p,
+            row_off=q_off, col_off=k_off)
+    out = jnp.einsum("bqk,bkd->bqd", pn, v3.astype(_f32)) / l
     return out.astype(q3.dtype), (m + jnp.log(l))[..., 0]
 
 
-def _chunk_bwd(q3, k3, v3, bias, out, lse, g, scale, mode):
+def _chunk_bwd(q3, k3, v3, bias, out, lse, g, scale, mode,
+               dropout_p=0.0, seed=None, q_off=0, k_off=0):
     """Block gradients against the *global* (out, lse): p = exp(s - lse)
     already carries the full-softmax normalization, so per-chunk calls sum
-    to the exact full-attention gradient."""
+    to the exact full-attention gradient.  With dropout, delta already
+    includes the mask (it derives from the dropped ``out``); dv sees the
+    dropped probs and dp routes through the multiplier — same regenerated
+    global-coordinate mask as the forward."""
     if mode is not None:
         return _k.flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale,
-                                      False, interpret=(mode == "interpret"))
+                                      False, interpret=(mode == "interpret"),
+                                      dropout_p=dropout_p,
+                                      dropout_seed=seed,
+                                      dropout_row_off=q_off,
+                                      dropout_col_off=k_off)
     s = jnp.einsum("bqd,bkd->bqk", q3.astype(_f32),
                    k3.astype(_f32)) * scale
     if bias is not None:
@@ -93,8 +118,15 @@ def _chunk_bwd(q3, k3, v3, bias, out, lse, g, scale, mode):
     p = jnp.exp(s - lse[..., None])
     gf = g.astype(_f32)
     delta = jnp.sum(gf * out.astype(_f32), axis=-1, keepdims=True)
-    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
-    dp = jnp.einsum("bqd,bkd->bqk", gf, v3.astype(_f32))
+    if dropout_p > 0.0:
+        mult = _k.dropout_keep_reference(
+            q3.shape[0], q3.shape[1], k3.shape[1], seed, dropout_p,
+            row_off=q_off, col_off=k_off)
+        dv = jnp.einsum("bqk,bqd->bkd", p * mult, gf)
+        dp = mult * jnp.einsum("bqd,bkd->bqk", gf, v3.astype(_f32))
+    else:
+        dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+        dp = jnp.einsum("bqd,bkd->bqk", gf, v3.astype(_f32))
     ds = p * (dp - delta)
     dq = jnp.einsum("bqk,bkd->bqd", ds, k3.astype(_f32)) * scale
     dk = jnp.einsum("bqk,bqd->bkd", ds, q3.astype(_f32)) * scale
@@ -138,8 +170,8 @@ def _reduce_kv_grad(g3, groups, batch):
     return jnp.sum(g5, axis=2).reshape(bh // groups, sk, d)
 
 
-def _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode, groups,
-                   batch):
+def _ring_fwd_math(q3, k3, v3, seed, axis_name, causal, scale, mode,
+                   groups, batch, dropout_p=0.0):
     n = lax.psum(1, axis_name)          # static mesh-axis size
     idx = lax.axis_index(axis_name)
     bh, sq, d = q3.shape
@@ -157,7 +189,8 @@ def _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode, groups,
         bias = _chunk_bias(sq, sk, idx * sq, src * sk, causal)
         o_r, lse_r = _chunk_fwd(q3, _expand_kv(k_cur, groups, batch),
                                 _expand_kv(v_cur, groups, batch), bias,
-                                scale, mode)
+                                scale, mode, dropout_p, seed,
+                                q_off=idx * sq, k_off=src * sk)
         out, lse = _merge(out, lse, o_r, lse_r)
         if rotate:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
@@ -179,22 +212,25 @@ def _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode, groups,
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring(q3, k3, v3, axis_name, causal, scale, mode, groups, batch):
-    out, _ = _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode,
-                            groups, batch)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _ring(q3, k3, v3, seed, axis_name, causal, scale, mode, groups, batch,
+          dropout_p):
+    out, _ = _ring_fwd_math(q3, k3, v3, seed, axis_name, causal, scale,
+                            mode, groups, batch, dropout_p)
     return out
 
 
-def _ring_vjp_fwd(q3, k3, v3, axis_name, causal, scale, mode, groups,
-                  batch):
-    out, lse = _ring_fwd_math(q3, k3, v3, axis_name, causal, scale, mode,
-                              groups, batch)
-    return out, (q3, k3, v3, out, lse)
+def _ring_vjp_fwd(q3, k3, v3, seed, axis_name, causal, scale, mode, groups,
+                  batch, dropout_p):
+    out, lse = _ring_fwd_math(q3, k3, v3, seed, axis_name, causal, scale,
+                              mode, groups, batch, dropout_p)
+    return out, (q3, k3, v3, seed, out, lse)
 
 
-def _ring_vjp_bwd(axis_name, causal, scale, mode, groups, batch, res, g):
-    q3, k3, v3, out, lse = res
+def _ring_vjp_bwd(axis_name, causal, scale, mode, groups, batch, dropout_p,
+                  res, g):
+    q3, k3, v3, seed, out, lse = res
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     sq, sk = q3.shape[1], k3.shape[1]
@@ -216,7 +252,8 @@ def _ring_vjp_bwd(axis_name, causal, scale, mode, groups, batch, res, g):
         dq_r, dk_r, dv_r = _chunk_bwd(
             q3, _expand_kv(k_cur, groups, batch),
             _expand_kv(v_cur, groups, batch), bias, out_c, lse,
-            g_c, scale, mode)
+            g_c, scale, mode, dropout_p, seed,
+            q_off=idx * sq, k_off=src * sk)
         dq = dq + dq_r.astype(_f32)
         dk_cur = dk_cur + _reduce_kv_grad(dk_r, groups, batch).astype(_f32)
         dv_cur = dv_cur + _reduce_kv_grad(dv_r, groups, batch).astype(_f32)
@@ -237,14 +274,17 @@ def _ring_vjp_bwd(axis_name, causal, scale, mode, groups, batch, res, g):
         dq, dk_cur, dv_cur, _, _ = lax.fori_loop(
             0, n, lambda r, c: step(r, *c, rotate_kv=True),
             (dq, dk_cur, dv_cur, k3, v3))
+    dseed = None if seed is None else _np.zeros(_np.shape(seed),
+                                                jax.dtypes.float0)
     return (dq.astype(q3.dtype), dk_cur.astype(k3.dtype),
-            dv_cur.astype(v3.dtype))
+            dv_cur.astype(v3.dtype), dseed)
 
 
 _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
-def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   dropout_p=0.0, dropout_seed=None):
     """Ring self/cross attention over a sequence-sharded mesh axis.
 
     q (B, H, Sq_local, D); k/v (B, KVH, Sk_local, D) with KVH dividing H
@@ -254,7 +294,21 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     (device i holds global rows [i*S_local, (i+1)*S_local)).  Call inside
     shard_map/pjit.  Returns the local output shard (B, H, Sq_local, D)
     in q's dtype.
+
+    ``dropout_p`` > 0 drops attention probabilities with the counter-based
+    hash mask at GLOBAL coordinates: ``dropout_seed`` (an int32 scalar)
+    must be REPLICATED across the axis, and the dropped ring result is
+    then bit-consistent with the single-device flash kernel under the
+    same seed — sequence parallelism does not change which positions
+    drop (each chunk's softmax sum stays undropped, so the lse-merge
+    reconstructs exactly dropout(P_global) @ V).
     """
+    if dropout_p:
+        if not 0.0 <= dropout_p < 1.0:
+            raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed "
+                             "(replicated across the axis)")
     b, h, s, d = q.shape
     h_kv = k.shape[1]
     if h % h_kv:
@@ -267,12 +321,14 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     q3 = q.reshape(b * h, s, d)
     k3 = k.reshape(b * h_kv, k.shape[2], d)
     v3 = v.reshape(b * h_kv, v.shape[2], d)
-    out = _ring(q3, k3, v3, axis_name, causal, scale, mode, h // h_kv, b)
+    seed = None if not dropout_p else dropout_seed
+    out = _ring(q3, k3, v3, seed, axis_name, causal, scale, mode,
+                h // h_kv, b, dropout_p)
     return out.reshape(b, h, s, d).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
-                      bias=None):
+                      bias=None, dropout_p=0.0, dropout_seed=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     q/k/v (B, H, S_local, D) sequence-sharded on ``axis_name``; H must be
@@ -284,6 +340,12 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
     ``bias`` applies to the gathered sequence, so it must be *global*-shape
     (B|1, Sq_global|1, Sk_global) and replicated across the axis — a
     sequence-local bias shard would silently mask out non-local keys.
+
+    ``dropout_p`` > 0: each device attends full-sequence over its OWN
+    head block, so the hash-mask batch·head index is local — the seed
+    folds with ``axis_index`` for decorrelated per-shard streams (the
+    TP semantics, NOT the ring's bit-consistency; heads are what is
+    sharded here).
     """
     from ..contrib.multihead_attn.attn_funcs import flash_attention
     n = lax.psum(1, axis_name)
@@ -308,7 +370,13 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
                         tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
                         tiled=True)
-    out = flash_attention(qh, kh, vh, bias=bias, causal=causal, scale=scale)
+    seed = dropout_seed
+    if dropout_p and seed is not None:
+        seed = (jnp.asarray(seed).astype(jnp.uint32)
+                ^ (lax.axis_index(axis_name).astype(jnp.uint32)
+                   * jnp.uint32(0x9E3779B1))).astype(jnp.int32)
+    out = flash_attention(qh, kh, vh, bias=bias, causal=causal, scale=scale,
+                          dropout_p=dropout_p, dropout_seed=seed)
     # back to (B, H, S_loc, D)
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                           tiled=True)

@@ -496,7 +496,20 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             # under accumulation fold in the microbatch index likewise
             key = jax.random.fold_in(jax.random.PRNGKey(rng_seed), state.step)
             if axis_name is not None:
-                key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+                # fold each mesh axis EXCEPT the model's own sp_axis:
+                # the SP model families fold that one themselves
+                # (fold_shard_into_key), stashing the pre-fold key as
+                # Ctx.shared_key — the replicated seed ring-attention
+                # dropout hashes for its cross-shard-consistent mask.
+                # Folding sp here too would leave no sp-replicated key
+                # anywhere in the step.
+                sp = getattr(model, "sp_axis", None)
+                axes = (axis_name if isinstance(axis_name, (tuple, list))
+                        else (axis_name,))
+                for ax in axes:
+                    if ax != sp:
+                        key = jax.random.fold_in(key,
+                                                 jax.lax.axis_index(ax))
             if grad_accum_steps > 1:
                 key = jax.random.fold_in(key, mb_idx)
             ctx = Ctx(env={**env, **stats_env}, stats_out=stats_out,

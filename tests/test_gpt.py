@@ -247,6 +247,111 @@ def test_gpt_attn_dropout_loss_parity_across_modes(rng):
                                rtol=2e-3, atol=2e-4)
 
 
+def test_sp_gpt_attn_dropout_matches_unsharded(rng):
+    """Ring-SP GPT with ATTENTION DROPOUT ACTIVE: the mask hashes global
+    coordinates under the replicated pre-shard key (Ctx.shared_key), so
+    the sequence-sharded training forward drops the same probabilities
+    as the unsharded run and the logits match — dropout does not break
+    the SP oracle.  Residual dropout stays 0 (its per-shard keys differ
+    by design)."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.nn.modules import Ctx
+
+    S_GLOBAL = 32
+    ids = jnp.asarray(rng.integers(0, V, (2, S_GLOBAL)))
+    key = jax.random.PRNGKey(17)
+
+    def build(sp_axis):
+        nn.manual_seed(5)
+        return GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                        max_positions=S_GLOBAL, dropout=0.0,
+                        attn_dropout=0.3, sp_axis=sp_axis)
+
+    m_ref = build(None).train()
+    params_ref = list(m_ref.parameters())
+    vals = [p.data for p in params_ref]
+    ctx = Ctx(env={id(p): v for p, v in zip(params_ref, vals)},
+              training=True, key=key)
+    ref_out = m_ref.forward(ctx, ids)
+
+    m_sp = build("sp").train()
+    params_sp = list(m_sp.parameters())
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+
+    def sp_fwd(vals, ids_l):
+        c = Ctx(env={id(p): v for p, v in zip(params_sp, vals)},
+                training=True, key=key)
+        return m_sp.forward(c, ids_l)
+
+    sp_out = jax.jit(jax.shard_map(
+        sp_fwd, mesh=mesh, in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp", None), check_vma=False))(vals, ids)
+    np.testing.assert_allclose(np.asarray(sp_out), np.asarray(ref_out),
+                               rtol=2e-4, atol=2e-4)
+    # and the mask really is active: the dropout-free forward differs
+    m_ref.eval()
+    clean = m_ref(ids).value
+    assert not np.allclose(np.asarray(clean), np.asarray(ref_out))
+
+
+def test_sp_attn_dropout_through_fused_step_matches_unsharded():
+    """The DOCUMENTED SP training recipe — make_train_step(...,
+    axis_name="sp") under shard_map — with attention dropout active:
+    the step excludes the model's own sp_axis from its key fold (the
+    model folds it and stashes the pre-fold key as Ctx.shared_key), so
+    the ring mask seed is sp-replicated and per-step losses equal the
+    unsharded run's exactly-dropped losses."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    S_GLOBAL = 32
+    r = np.random.default_rng(2)
+    ids = jnp.asarray(r.integers(0, V, (2, S_GLOBAL)))
+    tgt = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1))
+
+    def lm_loss(logits, tgt):
+        return F.cross_entropy(logits.reshape((-1, V)),
+                               tgt.reshape((-1,)))
+
+    def build(sp_axis):
+        nn.manual_seed(9)
+        return GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                        max_positions=S_GLOBAL, dropout=0.0,
+                        attn_dropout=0.3, sp_axis=sp_axis)
+
+    m_ref = build(None)
+    opt = FusedAdam(list(m_ref.parameters()), lr=1e-2)
+    step_ref = make_train_step(m_ref, opt, lm_loss, half_dtype=None,
+                               loss_scale=1.0)
+    ref = [float(step_ref(ids, tgt)) for _ in range(3)]
+
+    m_sp = build("sp")
+    opt = FusedAdam(list(m_sp.parameters()), lr=1e-2)
+    step_sp = make_train_step(m_sp, opt, lm_loss, half_dtype=None,
+                              loss_scale=1.0, axis_name="sp")
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+
+    def stepper(state, ids_l, tgt_l):
+        # the step returns the LOCAL shard's loss; pmean gives the
+        # global token mean (uniform shard sizes) for the comparison
+        state, l = step_sp._step_fn(state, ids_l, tgt_l)
+        return state, jax.lax.pmean(l, "sp")
+
+    sharded = jax.jit(jax.shard_map(
+        stepper, mesh=mesh,
+        in_specs=(P(), P(None, "sp"), P(None, "sp")),
+        out_specs=(P(), P()), check_vma=False))
+    state, sp_losses = step_sp.state, []
+    for _ in range(3):
+        state, l = sharded(state, ids, tgt)
+        sp_losses.append(float(l))
+    np.testing.assert_allclose(sp_losses, ref, rtol=2e-4, atol=2e-4)
+
+
 def test_sequence_parallel_gpt_matches_unsharded(rng):
     """GptModel(sp_axis=...) under shard_map with the sequence dim sharded
     8-way: logits and parameter gradients match the unsharded model (ring
@@ -313,9 +418,9 @@ def test_sequence_parallel_gpt_matches_unsharded(rng):
 
 def test_sp_config_validation():
     import pytest
-    with pytest.raises(ValueError, match="attn_dropout"):
-        GptModel(vocab_size=V, hidden=H, layers=1, heads=HEADS,
-                 sp_axis="sp")  # default attn_dropout=0.1
+    # sp_axis with the default attn_dropout=0.1 constructs since ring
+    # dropout landed (global hash mask; the old refusal is gone)
+    GptModel(vocab_size=V, hidden=H, layers=1, heads=HEADS, sp_axis="sp")
     from apex_tpu.contrib.multihead_attn.attn_funcs import self_attn_func
     with pytest.raises(ValueError, match="seq_parallel_impl"):
         self_attn_func(False, False, 2, 1.0, jnp.zeros((4, 2, 8)),

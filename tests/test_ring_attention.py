@@ -267,3 +267,76 @@ def test_ring_gqa_matches_expanded(rng):
     for a, bb in zip(g_g, g_e):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
                                    rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_dropout_bit_consistent_with_single_device(rng, causal):
+    """Ring attention with dropout: the hash mask is a function of GLOBAL
+    (seed, head, row, col), so the 4-way sequence-sharded result equals
+    the single-device dropped attention under the same seed — sequence
+    parallelism does not change which probabilities drop.  Gradients
+    exercise the dropped ring backward (dk/dv accumulators rotating
+    through dropped chunks)."""
+    mesh = _mesh(4)
+    q, k, v = _inputs(rng)
+    scale = 1.0 / np.sqrt(D)
+    seed = jnp.int32(90210)
+    p = 0.3
+
+    ref = attention_reference(q, k, v, None, causal, scale,
+                              dropout_p=p, dropout_seed=seed)
+    out = _run_sharded(
+        functools.partial(ring_attention, axis_name="sp", causal=causal,
+                          dropout_p=p, dropout_seed=seed),
+        mesh, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_ring(q, k, v):
+        shard = jax.shard_map(
+            functools.partial(ring_attention, axis_name="sp",
+                              causal=causal, dropout_p=p,
+                              dropout_seed=seed),
+            mesh=mesh, in_specs=P(None, None, "sp", None),
+            out_specs=P(None, None, "sp", None), check_vma=False)
+        return jnp.sum(shard(q, k, v).astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(
+            q, k, v, None, causal, scale, dropout_p=p,
+            dropout_seed=seed).astype(jnp.float32) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_dropout_runs_and_decorrelates(rng):
+    """Ulysses dropout: per-head-shard streams — runs, is finite, differs
+    from the dropout-free output, and is deterministic per seed."""
+    mesh = _mesh(4)
+    q, k, v = _inputs(rng)
+    seed = jnp.int32(7)
+
+    def run(p, s):
+        return _run_sharded(
+            functools.partial(ulysses_attention, axis_name="sp",
+                              causal=False, dropout_p=p, dropout_seed=s),
+            mesh, q, k, v)
+
+    clean = run(0.0, None)
+    a = run(0.4, seed)
+    b = run(0.4, seed)
+    c = run(0.4, jnp.int32(8))
+    assert np.isfinite(np.asarray(a)).all()
+    assert not np.allclose(np.asarray(a), np.asarray(clean))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not (np.asarray(a) == np.asarray(c)).all()
+
+
+def test_ring_dropout_requires_seed():
+    q = jnp.zeros((1, 1, 8, 8), jnp.float32)
+    with pytest.raises(ValueError, match="dropout_seed"):
+        ring_attention(q, q, q, axis_name="sp", dropout_p=0.1)
