@@ -101,6 +101,18 @@ QUEUE=(
   "timeout 700 python bench.py 16 --gpt --seq-len 1024 --attn-dropout 0.1 --no-kernels"
   "timeout 700 python bench.py --bert --attn-dropout 0.1 --no-kernels"
   "timeout 900 python bench.py --spec-decode --no-kernels --budget-s 840"
+  # post-scatter-fix seq-512 re-measures (the 08:55 rows carried the
+  # regression) + the seq-2048 long-context flagship number.  (A
+  # latency-hiding-scheduler arm ran here and died at init: the flag
+  # does not exist in this XLA build — no scheduler knob to A/B.)
+  "timeout 700 python bench.py 32 --gpt --seq-len 512 --no-kernels"
+  "timeout 700 python bench.py --llama --seq-len 512 --no-kernels"
+  "timeout 900 python bench.py 8 --llama --seq-len 2048 --no-kernels --budget-s 840"
+  "timeout 700 env XLA_FLAGS=--xla_tpu_enable_latency_hiding_scheduler=true python bench.py --no-kernels"
+  # resnet profile on BOTH umbrella filters (the committed batch-128
+  # row predates the run-index filter: 52 ms of a 54 ms step sat in
+  # 'other') — the recorded backing for docs/performance.md's table
+  "timeout 700 python bench.py --profile"
 )
 
 # No separate probe client: bench.py itself exits 4 when the backend
