@@ -21,7 +21,7 @@ where a (B·S, 50257) f32 temporary is gigabytes —
 
 * the backward never materializes the one-hot/q tensor: the smoothing term
   folds into the elementwise ``probs - s/C`` and the label column is fixed
-  up with a per-row scatter-add (O(rows), not O(rows·C));
+  up with a fused iota-compare (never a scatter — see _bwd_row);
 * above ``_AUTO_ELEMS`` elements (or always, when ``APEX_TPU_XENT_BLOCK_ROWS``
   is set) both passes run row-blocked under ``lax.map(batch_size=...)`` so
   only one block of f32 temporaries is live at a time.  The GPT seq-1024
@@ -39,10 +39,29 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ...ops.pallas import pallas_mode as _pallas_mode
+
 _f32 = jnp.float32
 # Single-shot threshold, in logits elements: one f32 temporary of this size
 # is ~2.1 GB.  (16GB v5e; the backward keeps ~2 block-sized f32 temps live.)
 _AUTO_ELEMS = 1 << 29
+
+
+def _use_kernel(mode):
+    """Compiled-mode dispatch for the Pallas xentropy kernel: OFF by
+    default.  The round-4 on-chip A/B measured the kernel LOSING to
+    XLA's own fusion of the jnp expression at both LM loss shapes
+    (0.38x at (8192, 50257), 0.74x at (16384, 50257) fwd+bwd — the
+    online-softmax block sweep is VPU-bound while XLA's reduce kernels
+    are tuned; BENCH_HISTORY round 4), and the GPT seq-128 headline ran
+    8% slower with it engaged.  The kernel stays for parity coverage
+    (interpret mode always exercises it — that mode exists to test
+    kernels) and as the starting point for a future fused
+    lm-head+loss kernel; APEX_TPU_XENT_KERNEL=1 forces it on-chip."""
+    if mode == "interpret":
+        return True
+    return mode == "compiled" and \
+        os.environ.get("APEX_TPU_XENT_KERNEL", "0") == "1"
 
 
 def _block_rows(n, c):
@@ -92,6 +111,13 @@ def _fwd_math(logits, labels, smoothing, padding_idx):
     c = logits.shape[-1]
     lead = logits.shape[:-1]
     n = math.prod(lead)
+    mode = _pallas_mode()
+    if _use_kernel(mode):
+        from ...ops.pallas.xentropy import xent_forward
+        losses, lse = xent_forward(
+            logits.reshape(n, c), labels.reshape(n), smoothing,
+            padding_idx, interpret=(mode == "interpret"))
+        return losses.reshape(lead), lse.reshape(lead)
     losses, lse = _rowwise(
         lambda xs: _fwd_row(xs[0], xs[1], smoothing, padding_idx),
         (logits.reshape(n, c), labels.reshape(n)),
@@ -126,6 +152,16 @@ def _bwd(smoothing, padding_idx, half_to_float, res, g):
     logits, lse, labels = res
     c = logits.shape[-1]
     n = math.prod(logits.shape[:-1])
+    mode = _pallas_mode()
+    if _use_kernel(mode):
+        from ...ops.pallas.xentropy import xent_backward
+        lab = labels.reshape(n)
+        gm = jnp.where(lab == padding_idx, 0.0,
+                       g.reshape(n).astype(_f32))
+        grad = xent_backward(logits.reshape(n, c), lab, lse.reshape(n),
+                             gm, smoothing,
+                             interpret=(mode == "interpret"))
+        return grad.reshape(logits.shape), None
     grad = _rowwise(
         lambda xs: _bwd_row(xs[0], xs[1], xs[2], xs[3], smoothing,
                             padding_idx, logits.dtype),

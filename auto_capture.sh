@@ -113,6 +113,24 @@ QUEUE=(
   # row predates the run-index filter: 52 ms of a 54 ms step sat in
   # 'other') — the recorded backing for docs/performance.md's table
   "timeout 700 python bench.py --profile"
+  # clean LM profiles: the 09:52 gpt row showed an ~8.6 ms/exec 'while'
+  # bucket (12% of the step) worth naming, and bert was never profiled
+  "timeout 700 python bench.py --profile --gpt"
+  "timeout 700 python bench.py --profile --bert"
+  # Pallas xentropy kernel landed (block-local casts vs ~14 ms/step of
+  # materialized f32 conversions in the jnp path): kernel A/B rows at
+  # the LM loss shapes + headline re-measures on the kernel path
+  "timeout 900 python bench.py --kernels-timing --budget-s 840"
+  "timeout 700 python bench.py --gpt --no-kernels"
+  "timeout 700 python bench.py --bert --no-kernels"
+  "timeout 700 python bench.py 16 --gpt --seq-len 1024 --no-kernels"
+  "timeout 700 python bench.py --seq2seq --no-kernels"
+  "timeout 700 python bench.py --profile --gpt"
+  # the xentropy kernel A/B came back 0.38x/0.74x (it LOSES to XLA's
+  # fusion; VPU-bound block sweep) — kernel now gated off by default;
+  # these re-measures confirm the headlines restored on the jnp path
+  "timeout 700 python bench.py --gpt --no-kernels"
+  "timeout 700 python bench.py 16 --gpt --seq-len 1024 --no-kernels"
 )
 
 # No separate probe client: bench.py itself exits 4 when the backend
