@@ -69,7 +69,10 @@ def beam_generate(model, prompt_ids, max_new_tokens, num_beams,
         raise ValueError(
             f"beam_generate needs model.{missing[0]} (the GPT/Llama "
             f"cache protocol)")
-    vocab = model.tok_emb.weight.shape[0]
+    # logical vocab: a pad_vocab_multiple model's table is wider than
+    # its emittable id range (pad logits are -1e30)
+    vocab = getattr(model, 'vocab_size', None) \
+        or model.tok_emb.weight.shape[0]
     if k > vocab:
         raise ValueError(f"num_beams ({k}) exceeds vocab ({vocab})")
     if eos_id is not None and not 0 <= eos_id < vocab:

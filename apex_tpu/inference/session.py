@@ -73,7 +73,8 @@ class DecodeSession:
                 f"{model.max_positions}], got {self.capacity}")
         self._cache_dtype = cache_dtype if cache_dtype is not None \
             else model.tok_emb.weight.data.dtype
-        self._vocab = model.tok_emb.weight.shape[0]
+        self._vocab = getattr(model, 'vocab_size', None) \
+            or model.tok_emb.weight.shape[0]
         self.reset()
 
     def reset(self):

@@ -316,9 +316,32 @@ class GptModel(nn.Module):
                  attn_dropout=0.1, remat=False, sp_axis=None, tp_axis=None,
                  tp_vocab=False, moe_axis=None, moe_num_experts=None,
                  moe_every=2, moe_capacity_factor=1.25, moe_top_k=1,
-                 moe_aux_weight=0.01, attn_bias=False):
+                 moe_aux_weight=0.01, attn_bias=False,
+                 pad_vocab_multiple=None):
         super().__init__()
         intermediate = intermediate or 4 * hidden
+        # pad_vocab_multiple: the Megatron --make-vocab-size-divisible-by
+        # convention — the embedding table and tied head round the vocab
+        # up to a lane-aligned multiple (GPT-2's 50257 is not).  logits
+        # come back with padded width; pad columns are masked to -1e30,
+        # so softmax / cross-entropy / argmax over them are EXACT w.r.t.
+        # the logical vocab (labels never change).  Pad table rows are
+        # never looked up and receive zero gradient through the masked
+        # columns.  Measured on v5e (BENCH_HISTORY round 4): a WASH on
+        # the GPT headlines (912 vs 921 seq/s at seq-128) — XLA pads
+        # unaligned contraction dims internally — so this is a
+        # divisibility/parity convenience (e.g. for tp sharding), not a
+        # perf lever on this backend.
+        self.vocab_size = vocab_size
+        self.padded_vocab = vocab_size
+        if pad_vocab_multiple:
+            self.padded_vocab = -(-vocab_size // pad_vocab_multiple) \
+                * pad_vocab_multiple
+        if tp_vocab and self.padded_vocab != vocab_size:
+            raise ValueError(
+                "pad_vocab_multiple with tp_vocab is not supported: the "
+                "vocab-parallel loss would see unmasked pad columns in "
+                "the last shard")
         # attn_bias: QKV/out-proj biases on every block's attention (what
         # GPT-2 checkpoints carry — models/hf.py loads into this config);
         # selects the bias-capable 'default' attention impl per block
@@ -391,7 +414,7 @@ class GptModel(nn.Module):
         # GLOBAL coordinates under the replicated pre-shard key, so the
         # dropped positions are bit-identical to the unsharded run
         # (attn_funcs.self_attn_func; ulysses decorrelates per shard)
-        self.tok_emb = nn.Embedding(vocab_size, hidden)
+        self.tok_emb = nn.Embedding(self.padded_vocab, hidden)
         self.pos_emb = nn.Embedding(max_positions, hidden)
         # GPT initializer_range=0.02 (nn.Embedding draws std-1 normals; the
         # tied head would otherwise see logits of std ~sqrt(hidden))
@@ -464,8 +487,19 @@ class GptModel(nn.Module):
         if self.tp_vocab:
             from ..parallel.tensor_parallel import vocab_parallel_logits
             return vocab_parallel_logits(x, emb, self.tp_axis)
-        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype))
+        return self._mask_pad_logits(
+            jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype)))
 
+
+    def _mask_pad_logits(self, logits):
+        """-1e30 on vocab-pad columns: softmax/argmax/cross-entropy over
+        the padded width equal the logical-vocab results exactly."""
+        if self.padded_vocab == self.vocab_size:
+            return logits
+        cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                        logits.ndim - 1)
+        return jnp.where(cols < self.vocab_size, logits,
+                         jnp.asarray(-1e30, logits.dtype))
 
     def init_caches(self, batch, s_max, dtype=jnp.float32):
         """Per-layer (k, v) caches of shape (B, H, S_max, D).  Under
@@ -541,7 +575,8 @@ class GptModel(nn.Module):
             x, kc, vc = blk_fn(blk, x, kc, vc)
             new_caches.append((kc, vc))
         x = self.ln_f.forward(ctx, x)
-        return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype)), \
+        return self._mask_pad_logits(
+            jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype))), \
             new_caches
 
     def prefill(self, ctx, toks, caches):
@@ -732,7 +767,8 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if key is None:
         key = jax.random.PRNGKey(0)
-    vocab = model.tok_emb.weight.shape[0]
+    vocab = getattr(model, 'vocab_size', None) \
+        or model.tok_emb.weight.shape[0]
     sample = make_sampler(temperature, top_k, top_p, vocab)
     # unsupported-composition refusal (sp) wins over mesh demands;
     # then validate the mesh against the sharded axes

@@ -302,7 +302,10 @@ def gpt2_to_hf_state_dict(model):
     def np32(p):
         return _to_numpy(p.data)
 
-    sd["transformer.wte.weight"] = np32(model.tok_emb.weight)
+    # a pad_vocab_multiple model stores a lane-padded table; checkpoints
+    # carry the logical vocab (pad rows are framework-internal)
+    sd["transformer.wte.weight"] = np32(
+        model.tok_emb.weight)[:model.vocab_size]
     sd["transformer.wpe.weight"] = np32(model.pos_emb.weight)
     sd["transformer.ln_f.weight"] = np32(model.ln_f.weight)
     sd["transformer.ln_f.bias"] = np32(model.ln_f.bias)

@@ -131,6 +131,10 @@ QUEUE=(
   # these re-measures confirm the headlines restored on the jnp path
   "timeout 700 python bench.py --gpt --no-kernels"
   "timeout 700 python bench.py 16 --gpt --seq-len 1024 --no-kernels"
+  # lane-padded vocab A/B (Megatron make-vocab-size-divisible-by:
+  # 50257 -> 50304): does aligning the head matmul move the headline?
+  "timeout 700 python bench.py --gpt --pad-vocab --no-kernels"
+  "timeout 700 python bench.py 16 --gpt --seq-len 1024 --pad-vocab --no-kernels"
 )
 
 # No separate probe client: bench.py itself exits 4 when the backend

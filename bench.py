@@ -831,7 +831,7 @@ def run_seq2seq_throughput(batch, seq_len, iters, warmup,
 
 
 def build_gpt_step(batch, seq_len, remat=False, size="small",
-                   plain_loss=False, attn_dropout=0.0):
+                   plain_loss=False, attn_dropout=0.0, pad_vocab=False):
     """GPT-2 causal-LM model+step+batch: next-token loss with FusedAdam
     under the bf16 fused step (the autoregressive counterpart of the BERT
     config; no reference analogue — the reference ships no LMs)."""
@@ -855,14 +855,20 @@ def build_gpt_step(batch, seq_len, remat=False, size="small",
     # measures the historical GPT-2 recipe, which since the in-kernel
     # dropout work ALSO rides flash (hash mask, no (S,S) tensor) —
     # residual/embedding dropout stays on either way
+    # --pad-vocab: Megatron's make-vocab-size-divisible-by convention
+    # (50257 -> 50304): the head matmul tiles the MXU lane-aligned; the
+    # loss sees -1e30-masked pad columns, so numerics are exact
     model = factory(max_positions=seq_len, attn_dropout=attn_dropout,
-                    remat=remat)
+                    remat=remat,
+                    pad_vocab_multiple=128 if pad_vocab else None)
     opt = FusedAdam(list(model.parameters()), lr=6e-4, weight_decay=0.1)
 
     token_losses = _lm_loss_fns(plain_loss)
 
     def lm_loss(logits, ids):
-        flat = logits[:, :-1].reshape((-1, vocab))
+        # logits.shape[-1] is the (possibly lane-padded) vocab width;
+        # pad columns are -1e30-masked, so the loss over them is exact
+        flat = logits[:, :-1].reshape((-1, logits.shape[-1]))
         tgt = ids[:, 1:].reshape((-1,))
         return jnp.mean(token_losses(flat, tgt))
 
@@ -880,9 +886,11 @@ def build_gpt_step(batch, seq_len, remat=False, size="small",
 
 
 def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False,
-                       size="small", plain_loss=False, attn_dropout=0.0):
+                       size="small", plain_loss=False, attn_dropout=0.0,
+                       pad_vocab=False):
     step, arrays, af, paf = build_gpt_step(batch, seq_len, remat, size,
-                                           plain_loss, attn_dropout)
+                                           plain_loss, attn_dropout,
+                                           pad_vocab)
     stage("compile", f"gpt batch={batch}")
     return time_compiled_step(step, arrays, iters, warmup, af,
                               pallas_attn_flops=paf)
@@ -1350,6 +1358,10 @@ def main():
     ap.add_argument("--gpt-size", default="small",
                     choices=["small", "medium"],
                     help="with --gpt: GPT-2 geometry")
+    ap.add_argument("--pad-vocab", action="store_true",
+                    help="lane-pad the GPT vocab to a multiple of 128 "
+                         "(Megatron make-vocab-size-divisible-by; exact "
+                         "numerics via -1e30-masked pad columns)")
     ap.add_argument("--attn-dropout", type=float, default=0.0,
                     help="attention-probs dropout rate for the --gpt and "
                          "--bert configs (default 0: the stable headline "
@@ -1372,6 +1384,11 @@ def main():
                     default=float(os.environ.get("GRAFT_BENCH_BUDGET_S", 540)))
     args = ap.parse_args()
 
+    if args.pad_vocab and not args.gpt:
+        fail("pad_vocab_unsupported_config: --pad-vocab applies to the "
+             "--gpt config only (the GPT family implements "
+             "pad_vocab_multiple)")
+        return 1
     start_watchdog(args.budget_s)
     log(f"start (watchdog {args.budget_s:.0f}s)")
 
@@ -1409,8 +1426,9 @@ def main():
                     "sequences_per_sec_per_chip_ampO2",
                     "sequences/sec/chip")
         if args.gpt:
-            return (f"gpt2_{args.gpt_size}_causal_lm_seq{args.seq_len}_{ad}"
-                    "sequences_per_sec_per_chip_ampO2",
+            pv = "padvocab_" if args.pad_vocab else ""
+            return (f"gpt2_{args.gpt_size}_causal_lm_seq{args.seq_len}_"
+                    f"{ad}{pv}sequences_per_sec_per_chip_ampO2",
                     "sequences/sec/chip")
         if args.llama:
             return (f"llama_125m_causal_lm_seq{args.seq_len}_"
@@ -1598,7 +1616,8 @@ def main():
                                       args.warmup, remat=args.remat,
                                       size=args.gpt_size,
                                       plain_loss=args.plain_loss,
-                                      attn_dropout=args.attn_dropout)
+                                      attn_dropout=args.attn_dropout,
+                                      pad_vocab=args.pad_vocab)
         if args.llama:
             return run_llama_throughput(batch, args.seq_len, args.iters,
                                         args.warmup, remat=args.remat,

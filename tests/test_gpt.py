@@ -807,3 +807,56 @@ def test_generate_top_p(rng):
     with pytest.raises(ValueError, match="top_p"):
         generate(m, prompt, 2, temperature=1.0, top_p=1.5,
                  key=jax.random.PRNGKey(0))
+
+
+def test_pad_vocab_multiple_exact_numerics(rng):
+    """pad_vocab_multiple (Megatron make-vocab-size-divisible-by): the
+    lane-padded head produces logits whose pad columns are -1e30-masked,
+    so losses, argmax decode, and real-column logits are EXACT w.r.t.
+    the logical vocab; the table copies row-for-row."""
+    from apex_tpu.nn import functional as F
+    from apex_tpu.models import generate
+
+    nn.manual_seed(4)
+    m_ref = GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                     max_positions=64, dropout=0.0, attn_dropout=0.0)
+    nn.manual_seed(4)
+    m_pad = GptModel(vocab_size=V, hidden=H, layers=L, heads=HEADS,
+                     max_positions=64, dropout=0.0, attn_dropout=0.0,
+                     pad_vocab_multiple=64)
+    vp = m_pad.padded_vocab
+    assert vp == 128 and m_pad.vocab_size == V  # 97 -> 128
+    # the padded build draws a bigger table: align by copying the
+    # reference rows in (everything else drew identically up to the
+    # table, so re-seed and copy defensively)
+    for pr, pp in zip(m_ref.parameters(), m_pad.parameters()):
+        if pp.data.shape != pr.data.shape:
+            pp.data = pp.data.at[:pr.data.shape[0]].set(pr.data)
+        else:
+            pp.data = pr.data
+
+    ids = jnp.asarray(rng.integers(0, V, (2, S)))
+    lr = m_ref(ids).value
+    lp = m_pad(ids).value
+    assert lp.shape[-1] == vp
+    np.testing.assert_allclose(np.asarray(lp[..., :V]), np.asarray(lr),
+                               rtol=1e-5, atol=1e-5)
+    assert float(jnp.max(lp[..., V:])) <= -1e29
+    # losses over the padded width equal losses over the logical vocab
+    ce_ref = float(F.cross_entropy(lr.reshape((-1, V)),
+                                   ids.reshape((-1,))))
+    ce_pad = float(F.cross_entropy(lp.reshape((-1, vp)),
+                                   ids.reshape((-1,))))
+    np.testing.assert_allclose(ce_pad, ce_ref, rtol=1e-6)
+    # greedy decode identical (pads never argmax)
+    g_ref = generate(m_ref.eval(), ids[:, :4], 6)
+    g_pad = generate(m_pad.eval(), ids[:, :4], 6)
+    np.testing.assert_array_equal(np.asarray(g_pad), np.asarray(g_ref))
+
+
+def test_pad_vocab_refuses_tp_vocab():
+    import pytest
+    with pytest.raises(ValueError, match="pad_vocab_multiple"):
+        GptModel(vocab_size=V, hidden=H, layers=1, heads=HEADS,
+                 tp_vocab=True, tp_axis="tp", attn_dropout=0.0,
+                 pad_vocab_multiple=64)
