@@ -72,9 +72,11 @@ def main():
                 ("data", "tp"))
 
     nn.manual_seed(0)
+    # attn_dropout composes with tp_axis since the in-kernel hash-mask
+    # dropout (per-shard seed streams) — 0.1 here exercises it
     model = GptModel(vocab_size=args.vocab, hidden=args.hidden,
                      layers=args.layers, heads=args.heads,
-                     max_positions=args.seq_len, attn_dropout=0.0,
+                     max_positions=args.seq_len, attn_dropout=0.1,
                      tp_axis="tp")
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     print(f"model: {args.layers}L/{args.hidden}H "
