@@ -36,13 +36,13 @@ R4 = {
     "bert_base_mlm_seq128_sequences_per_sec_per_chip_ampO2": 1177.9,
     "gpt2_small_causal_lm_seq128_sequences_per_sec_per_chip_ampO2": 921.2,
     "gpt2_small_causal_lm_seq1024_sequences_per_sec_per_chip_ampO2": 73.8,
-    "llama_125m_causal_lm_seq128_sequences_per_sec_per_chip_ampO2": 1027.0,
+    "llama_125m_causal_lm_seq128_sequences_per_sec_per_chip_ampO2": 1359.5,
     "llama_125m_causal_lm_seq2048_sequences_per_sec_per_chip_ampO2": 41.7,
     "seq2seq_base_seq128_sequences_per_sec_per_chip_ampO2": 1947.9,
     "dcgan64_multi_loss_images_per_sec_per_chip_ampO1": 29178.2,
     "llama_125m_greedy_decode_tokens_per_sec_per_chip": 12620.6,
     "gpt2_small_greedy_decode_tokens_per_sec_per_chip": 5779.2,
-    "pallas_kernel_speedup_vs_xla": 1.177,
+    "pallas_kernel_speedup_vs_xla": 1.093,
 }
 
 

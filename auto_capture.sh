@@ -135,6 +135,19 @@ QUEUE=(
   # 50257 -> 50304): does aligning the head matmul move the headline?
   "timeout 700 python bench.py --gpt --pad-vocab --no-kernels"
   "timeout 700 python bench.py 16 --gpt --seq-len 1024 --pad-vocab --no-kernels"
+  # FINAL-CODE confirmation sweep (suite 684 green): every headline and
+  # the kernel table once more on the round's last commit, so
+  # BENCH_HISTORY's closing numbers and BENCH_r04 share one code state
+  "timeout 700 python bench.py --no-kernels"
+  "timeout 700 python bench.py --bert --no-kernels"
+  "timeout 700 python bench.py --gpt --no-kernels"
+  "timeout 700 python bench.py --llama --no-kernels"
+  "timeout 700 python bench.py 16 --gpt --seq-len 1024 --no-kernels"
+  "timeout 900 python bench.py --kernels-timing --budget-s 840"
+  # llama long-seq refresh: the 87-seq/s llama-1024 row (09:42) carried
+  # the scatter-era xentropy like the 1027 headline did (final clean
+  # headline: 1359.5) — one clean long-seq llama number to close on
+  "timeout 700 python bench.py 16 --llama --seq-len 1024 --no-kernels"
 )
 
 # No separate probe client: bench.py itself exits 4 when the backend
