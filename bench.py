@@ -482,7 +482,7 @@ def run_kernel_timing(iters=30):
 
     mode = "compiled"
     results = {"mode": mode, "layer_norm": {}, "rms_norm": {},
-               "attention": {}, "xentropy": {}}
+               "attention": {}, "xentropy": {}, "lm_head_xent": {}}
     rng = np.random.default_rng(0)
 
     def _sync(tree):
@@ -614,6 +614,26 @@ def run_kernel_timing(iters=30):
             os.environ.pop("APEX_TPU_XENT_KERNEL", None)
         else:
             os.environ["APEX_TPU_XENT_KERNEL"] = _prev_xk
+
+    # --- EXPERIMENTAL fused lm-head + loss (logits never in HBM):
+    # not wired into any model — this row decides whether it gets wired.
+    # jnp arm = the production chain (head matmul + fused xentropy).
+    from apex_tpu.ops.pallas.lm_head_xent import fused_lm_head_xent
+    for rows, vcb, e_ in [(8192, 50257, 768)]:
+        x_ = jnp.asarray(rng.standard_normal((rows, e_)) * 0.3,
+                         jnp.bfloat16)
+        emb_ = jnp.asarray(rng.standard_normal((vcb, e_)) * 0.1,
+                           jnp.bfloat16)
+        lab_ = jnp.asarray(rng.integers(0, vcb, (rows,)))
+
+        def build():
+            # the op dispatches internally: kernel under pallas modes,
+            # the matmul + log-softmax chain otherwise (the 'off' arm)
+            def loss(x, emb):
+                return jnp.mean(fused_lm_head_xent(x, emb, lab_))
+            return jax.jit(jax.grad(loss, argnums=(0, 1)))
+        _ab(build, (x_, emb_), f"R{rows}_V{vcb}_E{e_}_bfloat16",
+            "lm_head_xent")
 
     # gmean covers the kernels production dispatch actually ships;
     # the xentropy kernel is gated off by default (it measurably loses
