@@ -13,7 +13,15 @@ Loss semantics (xentropy_kernel.cu:404-410): with smoothing s and C classes,
 ``loss_i = lse_i - (1-s)·logit_i[y_i] - s·mean_j(logit_ij)`` — i.e. cross
 entropy against ``q = (1-s)·onehot + s/C``.  Per-sample losses are returned
 (no reduction); rows with ``label == padding_idx`` contribute zero loss and
-zero gradient (softmax_xentropy.py:10,24).
+zero gradient (softmax_xentropy.py:10,24).  One extension over the
+reference: columns masked to <= -1e29 (the -1e30 masked-vocab convention —
+lane-padded heads, nucleus filtering) are excluded from the smoothing term
+and its divisor, so smoothing over a padded head equals the unpadded
+model exactly; unmasked inputs are bit-identical to the reference
+semantics.  Out-of-range labels are garbage-in: a label >= C reads the
+clamped last column under jit (jax gather semantics), a negative label
+other than padding_idx clamps to column 0 — neither can raise under
+trace; use padding_idx for intentional ignore rows.
 
 Memory discipline (the part the CUDA kernel gets from streaming row-blocks
 through shared memory): two measures keep peak HBM bounded at LM shapes,
@@ -39,6 +47,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ...ops.pallas import MASKED_LOGIT_THR as _MASK_THR
 from ...ops.pallas import pallas_mode as _pallas_mode
 
 _f32 = jnp.float32
@@ -94,7 +103,21 @@ def _fwd_row(lf_row, label, smoothing, padding_idx):
     lf = lf_row.astype(_f32)
     m = jnp.max(lf)
     lse = m + jnp.log(jnp.sum(jnp.exp(lf - m)))
-    loss = lse - (1.0 - smoothing) * lf[label] - smoothing * jnp.mean(lf)
+    if smoothing:
+        # mask-aware smoothing: columns at the -1e30 mask convention
+        # (pad_vocab_multiple heads, nucleus_filter) are excluded from
+        # the smoothing mean and the divisor is the VALID column count —
+        # so a lane-padded head under smoothing>0 produces exactly the
+        # unpadded model's loss instead of ~1e25 garbage (a raw
+        # mean(lf) would average the ~-1e30 masked log-probs in).
+        # Unmasked inputs never reach -1e29, so plain models are
+        # untouched; smoothing==0 (static) skips all of this.
+        valid = lf > _MASK_THR
+        nv = jnp.maximum(jnp.sum(valid.astype(_f32)), 1.0)
+        smooth_mean = jnp.sum(jnp.where(valid, lf, 0.0)) / nv
+    else:
+        smooth_mean = 0.0
+    loss = lse - (1.0 - smoothing) * lf[label] - smoothing * smooth_mean
     return jnp.where(label == padding_idx, 0.0, loss), lse
 
 
@@ -143,7 +166,17 @@ def _bwd_row(lf_row, lse, label, g, smoothing, padding_idx, out_dtype):
     # the seq-128 LM headlines (BENCH_HISTORY round 4).  For a padding
     # label of -1 no column compares equal, and gm is 0 anyway.
     onehot = (jax.lax.broadcasted_iota(jnp.int32, (c,), 0) == label)
-    grad = gm * (probs - smoothing / c) \
+    if smoothing:
+        # mirror the forward's mask-aware smoothing (see _fwd_row): the
+        # s/n_valid term lands only on valid columns, so dlogits on
+        # masked columns is exactly 0 (probs there is exp(-1e30-lse)=0)
+        lf32 = lf_row.astype(_f32)
+        valid = lf32 > _MASK_THR
+        nv = jnp.maximum(jnp.sum(valid.astype(_f32)), 1.0)
+        smooth_term = jnp.where(valid, smoothing / nv, 0.0)
+    else:
+        smooth_term = 0.0
+    grad = gm * (probs - smooth_term) \
         - ((1.0 - smoothing) * gm) * onehot.astype(_f32)
     return grad.astype(out_dtype)
 

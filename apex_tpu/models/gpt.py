@@ -325,7 +325,13 @@ class GptModel(nn.Module):
         # up to a lane-aligned multiple (GPT-2's 50257 is not).  logits
         # come back with padded width; pad columns are masked to -1e30,
         # so softmax / cross-entropy / argmax over them are EXACT w.r.t.
-        # the logical vocab (labels never change).  Pad table rows are
+        # the logical vocab (labels never change).  That includes
+        # label-smoothed losses THROUGH THIS PACKAGE — F.cross_entropy
+        # and contrib.xentropy exclude <=-1e29-masked columns from the
+        # smoothing term (mask-aware smoothing) — but a third-party
+        # smoothed loss that spreads s/C over all columns would average
+        # the -1e30 pads into the loss; slice logits[..., :vocab_size]
+        # before such a loss.  Pad table rows are
         # never looked up and receive zero gradient through the masked
         # columns.  Measured on v5e (BENCH_HISTORY round 4): a WASH on
         # the GPT headlines (912 vs 921 seq/s at seq-128) — XLA pads

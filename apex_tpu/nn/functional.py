@@ -544,8 +544,17 @@ def cross_entropy(logits, target, weight=None, reduction="mean",
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=1)
     tgt = jax.nn.one_hot(target, logits.shape[1], axis=1, dtype=logp.dtype)
     if label_smoothing > 0.0:
-        c = logits.shape[1]
-        tgt = tgt * (1.0 - label_smoothing) + label_smoothing / c
+        # mask-aware smoothing: columns at the -1e30 masked-vocab
+        # convention (pad_vocab_multiple heads, nucleus_filter) get no
+        # smoothing mass and the divisor counts only valid columns —
+        # otherwise q = s/C would multiply their ~-1e30 log-probs into
+        # the loss.  Plain logits never reach the threshold, so
+        # torch-parity semantics are unchanged for unmasked inputs.
+        from ..ops.pallas import MASKED_LOGIT_THR
+        valid = (logits > MASKED_LOGIT_THR).astype(logp.dtype)
+        nv = jnp.maximum(valid.sum(axis=1, keepdims=True), 1.0)
+        tgt = tgt * (1.0 - label_smoothing) \
+            + (label_smoothing / nv) * valid
     nll = -(tgt * logp).sum(axis=1)
     if weight is not None:
         w = weight[target]

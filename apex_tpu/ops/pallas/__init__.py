@@ -47,3 +47,14 @@ def force_mode(mode):
         yield
     finally:
         _forced[0] = prev
+
+
+# The masked-vocabulary convention, in one place: logits at MASKED_FILL
+# (-1e30) mean "this column does not exist" (lane-padded heads'
+# pad columns, nucleus-filtered tokens); consumers treat anything at or
+# below MASKED_LOGIT_THR (-1e29) as masked — softmax contributions
+# underflow to 0 there, and the smoothing-aware losses
+# (nn.functional.cross_entropy, contrib.xentropy) exclude such columns
+# from the label-smoothing term and its divisor.
+MASKED_FILL = -1e30
+MASKED_LOGIT_THR = -1e29

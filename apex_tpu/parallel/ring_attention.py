@@ -327,6 +327,22 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     return out.reshape(b, h, s, d).astype(q.dtype)
 
 
+def _sp_seed_fold(seed, idx):
+    """Fold a sequence-parallel shard index into a dropout seed.
+
+    Multiply-then-avalanche, deliberately NOT the bare idx*0x9E3779B1
+    xor that ``_dropout_seed`` uses for the TP axis: if a
+    shard-replicated base seed reaches both folds on a TP×SP mesh
+    (direct API use — the make_train_step path pre-folds its keys), two
+    linear xors with the SAME constant are symmetric under (tp, sp)
+    index swap, so devices (a, b) and (b, a) would draw identical mask
+    streams.  The shift makes this fold non-linear; no index pair
+    collides."""
+    h = (idx.astype(jnp.uint32) + jnp.uint32(1)) * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 15)
+    return (jnp.asarray(seed).astype(jnp.uint32) ^ h).astype(jnp.int32)
+
+
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
                       bias=None, dropout_p=0.0, dropout_seed=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
@@ -372,9 +388,7 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
                         tiled=True)
     seed = dropout_seed
     if dropout_p and seed is not None:
-        seed = (jnp.asarray(seed).astype(jnp.uint32)
-                ^ (lax.axis_index(axis_name).astype(jnp.uint32)
-                   * jnp.uint32(0x9E3779B1))).astype(jnp.int32)
+        seed = _sp_seed_fold(seed, lax.axis_index(axis_name))
     out = flash_attention(qh, kh, vh, bias=bias, causal=causal, scale=scale,
                           dropout_p=dropout_p, dropout_seed=seed)
     # back to (B, H, S_loc, D)

@@ -848,6 +848,19 @@ def test_pad_vocab_multiple_exact_numerics(rng):
     ce_pad = float(F.cross_entropy(lp.reshape((-1, vp)),
                                    ids.reshape((-1,))))
     np.testing.assert_allclose(ce_pad, ce_ref, rtol=1e-6)
+    # ... including under label smoothing (mask-aware smoothing spreads
+    # no mass over the -1e30 pad columns — round-4 advisor finding)
+    sm_ref = float(F.cross_entropy(lr.reshape((-1, V)),
+                                   ids.reshape((-1,)), label_smoothing=0.1))
+    sm_pad = float(F.cross_entropy(lp.reshape((-1, vp)),
+                                   ids.reshape((-1,)), label_smoothing=0.1))
+    np.testing.assert_allclose(sm_pad, sm_ref, rtol=1e-6)
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+    x_ref = float(jnp.mean(softmax_cross_entropy_loss(
+        lr.reshape((-1, V)), ids.reshape((-1,)), 0.1, -1)))
+    x_pad = float(jnp.mean(softmax_cross_entropy_loss(
+        lp.reshape((-1, vp)), ids.reshape((-1,)), 0.1, -1)))
+    np.testing.assert_allclose(x_pad, x_ref, rtol=1e-6)
     # greedy decode identical (pads never argmax)
     g_ref = generate(m_ref.eval(), ids[:, :4], 6)
     g_pad = generate(m_pad.eval(), ids[:, :4], 6)

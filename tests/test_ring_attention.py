@@ -340,3 +340,33 @@ def test_ring_dropout_requires_seed():
     q = jnp.zeros((1, 1, 8, 8), jnp.float32)
     with pytest.raises(ValueError, match="dropout_seed"):
         ring_attention(q, q, q, axis_name="sp", dropout_p=0.1)
+
+
+def test_sp_seed_fold_not_symmetric_with_tp_fold():
+    """Round-4 advisor finding: the SP fold must not be the TP fold's
+    linear xor with the same constant — a shard-replicated base seed on
+    a TP x SP mesh would then give devices with swapped (tp, sp)
+    indices identical dropout streams (seed ^ a*C ^ b*C is symmetric).
+    The SP fold is multiply-then-avalanche; assert no swap collision
+    and no collision with the TP fold itself over a realistic range."""
+    from apex_tpu.parallel.ring_attention import _sp_seed_fold
+
+    def tp_fold(seed, idx):   # mirrors attn_funcs._dropout_seed's fold
+        return int(jnp.asarray(
+            (jnp.uint32(seed) ^ (jnp.uint32(idx)
+                                 * jnp.uint32(0x9E3779B1)))
+            .astype(jnp.int32)))
+
+    base = 0x12345678
+    n = 8
+    seen = {}
+    for tp in range(n):
+        for sp in range(n):
+            s = int(_sp_seed_fold(jnp.int32(tp_fold(base, tp)),
+                                  jnp.uint32(sp)))
+            assert (tp, sp) not in seen
+            for (otp, osp), os in seen.items():
+                assert s != os, (
+                    f"seed collision between (tp={tp},sp={sp}) and "
+                    f"(tp={otp},sp={osp})")
+            seen[(tp, sp)] = s
