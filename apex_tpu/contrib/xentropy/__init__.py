@@ -1,4 +1,8 @@
 """apex.contrib.xentropy equivalent (reference apex/contrib/xentropy/__init__.py)."""
+from .chunked import (  # noqa: F401
+    chunked_lm_head_loss,
+    make_chunked_lm_loss,
+)
 from .softmax_xentropy import (  # noqa: F401
     SoftmaxCrossEntropyLoss,
     softmax_cross_entropy_loss,

@@ -317,7 +317,7 @@ class GptModel(nn.Module):
                  tp_vocab=False, moe_axis=None, moe_num_experts=None,
                  moe_every=2, moe_capacity_factor=1.25, moe_top_k=1,
                  moe_aux_weight=0.01, attn_bias=False,
-                 pad_vocab_multiple=None):
+                 pad_vocab_multiple=None, output_hidden=False):
         super().__init__()
         intermediate = intermediate or 4 * hidden
         # pad_vocab_multiple: the Megatron --make-vocab-size-divisible-by
@@ -405,6 +405,16 @@ class GptModel(nn.Module):
         self.tp_vocab = tp_vocab
         if tp_vocab and tp_axis is None:
             raise ValueError("tp_vocab requires tp_axis")
+        # output_hidden: training-time option — forward returns
+        # (hidden, table) instead of logits so a chunked/fused loss can
+        # own the vocab chain (see forward).  Decode paths apply the
+        # head themselves and are unaffected.
+        self.output_hidden = output_hidden
+        if output_hidden and tp_vocab:
+            raise ValueError(
+                "output_hidden with tp_vocab is redundant: vocab-parallel "
+                "logits already never materialize whole — use "
+                "vocab_parallel_cross_entropy as the loss instead")
         # remat: rematerialize each block's activations in backward
         # (jax.checkpoint) — HBM drops from O(layers * S * E) residuals to
         # O(layers) block boundaries, the long-sequence enabler
@@ -490,6 +500,13 @@ class GptModel(nn.Module):
         x = self.ln_f.forward(ctx, x)
         x = jnp.swapaxes(x, 0, 1)          # (B, S, E)
         emb = ctx.value(self.tok_emb.weight)
+        if self.output_hidden:
+            # head deferred to the loss: (hidden (B,S,E), table (V,E)) —
+            # the chunked/fused vocab-chain losses (contrib.xentropy.
+            # chunked_lm_head_loss, ops.pallas.fused_lm_head_xent) apply
+            # the tied head themselves so (B,S,V) logits never have to
+            # materialize whole
+            return x, emb
         if self.tp_vocab:
             from ..parallel.tensor_parallel import vocab_parallel_logits
             return vocab_parallel_logits(x, emb, self.tp_axis)

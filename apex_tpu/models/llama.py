@@ -452,8 +452,14 @@ class LlamaModel(nn.Module):
                  head_dim=None, tp_axis=None, sp_axis=None, moe_axis=None,
                  moe_num_experts=None, moe_every=2,
                  moe_capacity_factor=1.25, moe_top_k=1,
-                 moe_aux_weight=0.01, sliding_window=None):
+                 moe_aux_weight=0.01, sliding_window=None,
+                 output_hidden=False):
         super().__init__()
+        # output_hidden: training-time option — forward returns
+        # (hidden, head_weight) instead of logits so a chunked/fused
+        # loss can own the vocab chain (the GptModel convention; decode
+        # paths apply the head themselves and are unaffected)
+        self.output_hidden = output_hidden
         self.hidden = hidden
         self.max_positions = max_positions
         self.rope_theta = rope_theta
@@ -556,6 +562,8 @@ class LlamaModel(nn.Module):
             else:
                 x = blk.forward(ctx, x, cos, sin)
         x = self.norm.forward(ctx, x)
+        if self.output_hidden:
+            return x, ctx.value(self.lm_head.weight)
         return self.lm_head.forward(ctx, x)
 
     def init_caches(self, batch, s_max, dtype=jnp.float32):
