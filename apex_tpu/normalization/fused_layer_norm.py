@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from ..nn.modules import Module
 from ..nn.parameter import Parameter
-from ..ops.pallas import pallas_mode
+from ..ops.pallas import norm_kernel_mode, pallas_mode
 from ..ops.pallas import layer_norm as _k
 
 _f32 = jnp.float32
@@ -64,7 +64,7 @@ def _ref_backward(g2d, x2d, mean, rstd, weight):
 
 
 def _fwd_dispatch(x2d, weight, bias, eps):
-    mode = pallas_mode()
+    mode = norm_kernel_mode()
     if mode is None:
         return _ref_forward(x2d, weight, bias, eps)
     return _k.ln_forward(x2d, weight, bias, eps,
@@ -72,7 +72,7 @@ def _fwd_dispatch(x2d, weight, bias, eps):
 
 
 def _bwd_dispatch(g2d, x2d, mean, rstd, weight):
-    mode = pallas_mode()
+    mode = norm_kernel_mode()
     if mode is None:
         return _ref_backward(g2d, x2d, mean, rstd, weight)
     return _k.ln_backward(g2d, x2d, mean, rstd, weight,

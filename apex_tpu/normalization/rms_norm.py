@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from ..nn.modules import Module
 from ..nn.parameter import Parameter
-from ..ops.pallas import pallas_mode
+from ..ops.pallas import norm_kernel_mode, pallas_mode
 from ..ops.pallas import rms_norm as _k
 from .fused_layer_norm import _flatten
 
@@ -48,7 +48,7 @@ def _ref_backward(g2d, x2d, rstd, weight):
 
 
 def _fwd_dispatch(x2d, weight, eps):
-    mode = pallas_mode()
+    mode = norm_kernel_mode()
     if mode is None:
         return _ref_forward(x2d, weight, eps)
     return _k.rms_forward(x2d, weight, eps,
@@ -56,7 +56,7 @@ def _fwd_dispatch(x2d, weight, eps):
 
 
 def _bwd_dispatch(g2d, x2d, rstd, weight):
-    mode = pallas_mode()
+    mode = norm_kernel_mode()
     if mode is None:
         return _ref_backward(g2d, x2d, rstd, weight)
     return _k.rms_backward(g2d, x2d, rstd, weight,

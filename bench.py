@@ -452,7 +452,7 @@ def run_profile(kind, batch, seq_len, top_n=15, plain_loss=False,
     }
 
 
-def run_kernel_timing(iters=30):
+def run_kernel_timing(iters=30, reps=5):
     """A/B-time the Pallas kernels against their plain-XLA (jnp fallback)
     lowerings on the attached backend: fwd+bwd step time per shape, with
     the speedup the fused kernel delivers.  This is the TPU analogue of
@@ -488,8 +488,8 @@ def run_kernel_timing(iters=30):
         for leaf in jax.tree.leaves(tree):
             float(jnp.sum(leaf).astype(jnp.float32))  # fetch = sync on axon
 
-    def _time(fn, args):
-        _sync(fn(*args))                 # compile + warm inside the mode ctx
+    def _segment(fn, args):
+        """One timed segment of ``iters`` calls, synced by value fetch."""
         t0 = time.perf_counter()
         for _ in range(iters):
             out = fn(*args)
@@ -497,15 +497,42 @@ def run_kernel_timing(iters=30):
         return (time.perf_counter() - t0) / iters
 
     def _ab(build_fn, args, label, bucket):
-        row = {}
+        """Variance-controlled A/B (VERDICT r4 #3): both arms compile
+        first, then ``reps`` timed segments run INTERLEAVED
+        (pallas/xla/pallas/xla/...), so drift — clock ramps, tunnel
+        weather, background activity — lands on both arms equally.
+        Reported per arm: median segment time and IQR; the speedup is
+        the ratio of medians.  Round-4's single-run sequential arms are
+        the method this replaces (LN bf16 swung 0.995-1.73x across
+        sessions under it)."""
+        row = {"reps": reps, "iters": iters}
+        fns = {}
         for arm, m in (("pallas", mode), ("xla", "off")):
-            stage("kernel_timing", f"{bucket} {label} {arm} arm")
+            stage("kernel_timing", f"{bucket} {label} {arm} compile")
             with pal.force_mode(m):
                 try:
-                    row[f"{arm}_ms"] = round(_time(build_fn(), args) * 1e3, 4)
+                    fn = build_fn()
+                    _sync(fn(*args))   # compile + warm inside the mode ctx
+                    # jit dispatch captured the forced mode at trace
+                    # time, so the compiled fn keeps its arm outside
+                    # the context
+                    fns[arm] = fn
                 except Exception as e:
                     row[f"{arm}_ms"] = None
                     row[f"{arm}_error"] = f"{type(e).__name__}: {e}"
+        seg = {arm: [] for arm in fns}
+        for rep in range(reps):
+            stage("kernel_timing", f"{bucket} {label} rep {rep + 1}/{reps}")
+            for arm, fn in fns.items():
+                seg[arm].append(_segment(fn, args))
+        for arm, ts in seg.items():
+            ts = sorted(ts)
+            n_ = len(ts)
+            med = ts[n_ // 2] if n_ % 2 else (ts[n_ // 2 - 1]
+                                              + ts[n_ // 2]) / 2
+            q1, q3 = ts[n_ // 4], ts[(3 * n_) // 4]
+            row[f"{arm}_ms"] = round(med * 1e3, 4)
+            row[f"{arm}_iqr_ms"] = round((q3 - q1) * 1e3, 4)
         if row.get("pallas_ms") and row.get("xla_ms"):
             row["speedup"] = round(row["xla_ms"] / row["pallas_ms"], 3)
         results[bucket][label] = row
@@ -634,14 +661,21 @@ def run_kernel_timing(iters=30):
         _ab(build, (x_, emb_), f"R{rows}_V{vcb}_E{e_}_bfloat16",
             "lm_head_xent")
 
-    # gmean covers the kernels production dispatch actually ships;
-    # the xentropy kernel is gated off by default (it measurably loses
-    # — its rows above are the evidence), so it does not drag the
-    # shipping-kernel summary
+    # THE gmean definition (one, emitted here — VERDICT r4 weak #3 had
+    # three competing values in flight): geometric mean of the
+    # median-of-reps speedups over the SHIPPING kernels' rows — the
+    # layer_norm / rms_norm / attention buckets, whose kernels
+    # production dispatch actually engages.  The xentropy and
+    # lm_head_xent buckets are measured and reported above as evidence
+    # but excluded: standalone xentropy is gated off (it loses), and
+    # lm_head_xent ships via the chunked-loss path, not this kernel.
     ups = [r["speedup"]
            for bkt in ("layer_norm", "rms_norm", "attention")
            for r in results[bkt].values() if r.get("speedup")]
     gmean = float(np.exp(np.mean(np.log(ups)))) if ups else None
+    results["gmean_definition"] = (
+        "geomean of median-of-reps speedups, shipping kernels only "
+        "(layer_norm+rms_norm+attention buckets)")
     return results, gmean
 
 

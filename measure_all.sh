@@ -30,9 +30,12 @@ run --bert
 run --gpt
 run --llama
 run --vit
-run 32 --gpt --seq-len 512
+run 16 --gpt --seq-len 512            # b16: the measured MFU peak (r5)
+run 16 --llama --seq-len 512
 run 16 --gpt --seq-len 1024
 run 8 --gpt --seq-len 2048 --remat
+run --gpt --loss-mode fused --no-kernels    # vocab-chain A/B anchor arm
+run --kernels-timing --budget-s 1600  # variance-controlled (5 reps)
 run --gpt-decode
 run --gpt-decode --int8
 run --gpt-decode --int8 --kv-int8
@@ -42,7 +45,6 @@ run 16 --llama-decode --seq-len 512 --window 128
 run --spec-decode
 run --seq2seq
 run --dcgan
-run --kernels-timing                  # Pallas vs XLA A/B per shape
 run --profile                         # resnet per-op time attribution
 run --profile --gpt                   # gpt per-op time attribution
 run --sweep 96,128,192,256            # resnet batch/MFU sweet spot
