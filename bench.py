@@ -416,6 +416,8 @@ def run_profile(kind, batch, seq_len, top_n=15, plain_loss=False,
     elif kind == "llama":
         step, arrays, _, _ = build_llama_step(batch, seq_len,
                                               remat=remat, loss_mode=lm)
+    elif kind == "vit":
+        step, arrays, _, _ = build_vit_step(batch)
     else:
         step, arrays, _, _ = build_resnet_step(batch, nhwc=nhwc)
 
@@ -1549,7 +1551,8 @@ def main():
     def config_metric():
         if args.profile:
             kind = ("bert" if args.bert else "gpt" if args.gpt
-                    else "llama" if args.llama else "resnet")
+                    else "llama" if args.llama else "vit" if args.vit
+                    else "resnet")
             return f"{kind}_step_op_time_attribution", "us_matched"
         if args.kernels_timing:
             return "pallas_kernel_speedup_vs_xla", "x_geomean"
@@ -1632,10 +1635,10 @@ def main():
              "of the resnet config (default / --sweep / --profile)")
         return 1
     if args.profile and (args.seq2seq or args.gpt_decode
-                         or args.llama_decode or args.vit
-                         or args.dcgan):
+                         or args.llama_decode or args.dcgan):
         fail("profile_unsupported_config: --profile supports the "
-             "resnet (default), --gpt, --bert and --llama configs")
+             "resnet (default), --gpt, --bert, --llama and --vit "
+             "configs")
         return 1
     sweep_batches = None
     if args.sweep:
@@ -1664,7 +1667,8 @@ def main():
     if args.profile:
         # unsupported combos already rejected before backend init
         kind = ("bert" if args.bert else "gpt" if args.gpt
-                else "llama" if args.llama else "resnet")
+                else "llama" if args.llama else "vit" if args.vit
+                else "resnet")
         batch = args.batch or (64 if kind in ("bert", "gpt", "llama")
                                else 128)
         try:
@@ -1831,8 +1835,13 @@ def main():
     # per-config default batch; an explicitly requested batch is honored
     first_batch = args.batch
     if first_batch is None:
+        # vit: 32 is the measured v5e throughput peak (BENCH_HISTORY
+        # round 5: 2735 img/s vs 1843 at the old 128 — the materializing
+        # S=197 attention's scores working set grows with batch and
+        # falls off a cliff past ~64)
         first_batch = 64 if (args.bert or args.gpt or args.llama
-                             or args.seq2seq) else 128
+                             or args.seq2seq) \
+            else 32 if args.vit else 128
         log(f"default batch: {first_batch}")
     for batch in [first_batch, first_batch // 2, first_batch // 4]:
         if batch < 1:
