@@ -1389,7 +1389,7 @@ def run_dcgan_throughput(batch, iters, warmup):
                               sync_state=sync)
 
 
-def build_resnet_step(batch, nhwc=False):
+def build_resnet_step(batch, nhwc=False, flat_optim=False):
     import jax.numpy as jnp
     import numpy as np
 
@@ -1399,7 +1399,8 @@ def build_resnet_step(batch, nhwc=False):
     from apex_tpu.optimizers import FusedSGD
     from apex_tpu.training import make_train_step
 
-    stage("model_build", f"resnet50 batch={batch} nhwc={nhwc}")
+    stage("model_build", f"resnet50 batch={batch} nhwc={nhwc} "
+                         f"flat={flat_optim}")
     nn.manual_seed(0)
     model = resnet50(num_classes=1000)
     if nhwc:
@@ -1410,7 +1411,8 @@ def build_resnet_step(batch, nhwc=False):
                    weight_decay=1e-4)
     step = make_train_step(
         model, opt, lambda out, y: F.cross_entropy(out, y),
-        half_dtype=jnp.bfloat16, loss_scale=1.0)
+        half_dtype=jnp.bfloat16, loss_scale=1.0,
+        flat_master=flat_optim)
 
     rng = np.random.default_rng(0)
     shape = (batch, 224, 224, 3) if nhwc else (batch, 3, 224, 224)
@@ -1420,8 +1422,10 @@ def build_resnet_step(batch, nhwc=False):
     return step, (x, y), (lambda: resnet50_step_flops(batch)), 0.0
 
 
-def run_throughput(batch, iters, warmup, nhwc=False):
-    step, arrays, af, _ = build_resnet_step(batch, nhwc=nhwc)
+def run_throughput(batch, iters, warmup, nhwc=False,
+                   flat_optim=False):
+    step, arrays, af, _ = build_resnet_step(batch, nhwc=nhwc,
+                                            flat_optim=flat_optim)
     stage("compile", f"batch={batch}")
     return time_compiled_step(step, arrays, iters, warmup, af)
 
@@ -1519,6 +1523,12 @@ def main():
                          "inside one compiled program (lax.scan grad "
                          "accumulation) — the program-level pipelining "
                          "arm of the vocab-chain A/B")
+    ap.add_argument("--flat-optim", action="store_true",
+                    help="resnet config: the flat_master shape-bucketed "
+                         "optimizer-state A/B arm — measured LOSING on "
+                         "v5e (2256 vs 2355 img/s; BENCH_HISTORY r5), "
+                         "kept as the reference multi_tensor_apply "
+                         "design's receipt")
     ap.add_argument("--no-kernels", action="store_true",
                     help="skip the kernel parity checks")
     ap.add_argument("--budget-s", type=float,
@@ -1792,7 +1802,8 @@ def main():
         if args.dcgan:
             return run_dcgan_throughput(batch, args.iters, args.warmup)
         return run_throughput(batch, args.iters, args.warmup,
-                              nhwc=args.nhwc)
+                              nhwc=args.nhwc,
+                              flat_optim=args.flat_optim)
 
     if args.sweep:
         # batch sweep in ONE process (warm backend shared): one JSON line

@@ -1,0 +1,147 @@
+"""flat_master=True: the flat-buffer fused update must be numerically
+identical to the per-tensor path — same losses, same synced params —
+across SGD/Adam, bf16 half casts with BN-fp32 keep, dynamic-scaler skip
+steps, and grad accumulation; invalid configs refuse loudly."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.nn import functional as F
+from apex_tpu.optimizers import FusedAdam, FusedLAMB, FusedSGD
+from apex_tpu.training import make_train_step
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.c = nn.Conv2d(3, 8, 3, padding=1)
+        self.bn = nn.BatchNorm2d(8)
+        self.fc = nn.Linear(8 * 4 * 4, 5)
+
+    def forward(self, ctx, x):
+        h = F.relu(self.bn.forward(ctx, self.c.forward(ctx, x)))
+        return self.fc.forward(ctx, h.reshape(h.shape[0], -1))
+
+
+def _build(opt_cls, flat, seed=11, **opt_kw):
+    nn.manual_seed(seed)
+    m = Net()
+    opt = opt_cls(list(m.parameters()), **opt_kw)
+    return m, opt
+
+
+def _loss(out, y):
+    return F.cross_entropy(out, y)
+
+
+@pytest.mark.parametrize("opt_cls,opt_kw", [
+    (FusedSGD, dict(lr=0.1, momentum=0.9, weight_decay=1e-4)),
+    (FusedAdam, dict(lr=1e-3, weight_decay=0.01)),
+])
+@pytest.mark.parametrize("half", [None, jnp.bfloat16])
+def test_flat_matches_per_tensor(rng, opt_cls, opt_kw, half):
+    """fp32 steps must match tightly (the update math is identical);
+    bf16 steps to bf16-training tolerance — the flat program's conv
+    gradient reductions legitimately reassociate (XLA tiles the two
+    programs differently), which shifts bf16 casts by an ulp."""
+    x = jnp.asarray(rng.standard_normal((4, 3, 4, 4)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 5, (4,)))
+    # f32: the only noise is conv-grad reduction reassociation between
+    # the two program structures, amplified by Adam's early rsqrt(v)
+    tol = dict(rtol=5e-4, atol=1e-6) if half is None \
+        else dict(rtol=1e-2, atol=2e-3)
+
+    steps = {}
+    for flat in (False, True):
+        m, opt = _build(opt_cls, flat, **opt_kw)
+        s = make_train_step(m, opt, _loss, half_dtype=half,
+                            loss_scale=1.0, flat_master=flat)
+        losses = [float(s(x, y)) for _ in range(4)]
+        s.sync_to_objects()
+        steps[flat] = (losses, [np.asarray(p.data, np.float32)
+                                for p in m.parameters()])
+
+    np.testing.assert_allclose(steps[True][0], steps[False][0], **tol)
+    for a, b in zip(steps[True][1], steps[False][1]):
+        np.testing.assert_allclose(a, b, **tol)
+
+
+def test_flat_dynamic_scaler_skip(rng):
+    """An inf gradient must trip the overflow flag and skip the update
+    on the flat path exactly as on the per-tensor path."""
+    x = jnp.asarray(rng.standard_normal((4, 3, 4, 4)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 5, (4,)))
+
+    def bad_loss(out, y_):
+        return F.cross_entropy(out, y_) * jnp.float32(1e38) * 1e38
+
+    m, opt = _build(FusedSGD, True, lr=0.1, momentum=0.9)
+    s = make_train_step(m, opt, bad_loss, half_dtype=jnp.bfloat16,
+                        loss_scale="dynamic", flat_master=True)
+    before = np.asarray(s.state.master_params[0])
+    scale0 = float(s.state.scaler.loss_scale)
+    s(x, y)
+    after = np.asarray(s.state.master_params[0])
+    np.testing.assert_array_equal(after, before)        # update skipped
+    assert float(s.state.scaler.loss_scale) < scale0    # scale backed off
+    assert int(s.state.step) == 0
+
+
+def test_flat_grad_accum_matches(rng):
+    x = jnp.asarray(rng.standard_normal((8, 3, 4, 4)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 5, (8,)))
+    losses = {}
+    for flat in (False, True):
+        m, opt = _build(FusedAdam, flat, lr=1e-3)
+        s = make_train_step(m, opt, _loss, half_dtype=None,
+                            loss_scale=1.0, grad_accum_steps=2,
+                            flat_master=flat)
+        losses[flat] = [float(s(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(losses[True], losses[False], rtol=2e-5)
+
+
+def test_flat_multi_group_hyperparams(rng):
+    """Per-group lr/wd stay per-group through the flat buffers."""
+    x = jnp.asarray(rng.standard_normal((4, 3, 4, 4)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 5, (4,)))
+    final = {}
+    for flat in (False, True):
+        nn.manual_seed(5)
+        m = Net()
+        ps = list(m.parameters())
+        opt = FusedSGD([
+            {"params": ps[:2], "lr": 0.05},
+            {"params": ps[2:], "lr": 0.2, "weight_decay": 1e-3},
+        ], lr=0.1, momentum=0.9)
+        s = make_train_step(m, opt, _loss, half_dtype=None,
+                            loss_scale=1.0, flat_master=flat)
+        for _ in range(3):
+            s(x, y)
+        s.sync_to_objects()
+        final[flat] = [np.asarray(p.data, np.float32) for p in ps]
+    for a, b in zip(final[True], final[False]):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_flat_refuses_lamb():
+    m, opt = _build(FusedLAMB, True, lr=1e-3)
+    with pytest.raises(TypeError, match="trust ratio"):
+        make_train_step(m, opt, _loss, flat_master=True)
+
+
+def test_flat_refuses_frozen_params():
+    nn.manual_seed(2)
+    m = Net()
+    ps = list(m.parameters())
+    opt = FusedSGD(ps[:-1], lr=0.1)     # last param frozen
+    with pytest.raises(ValueError, match="param_group"):
+        make_train_step(m, opt, _loss, flat_master=True)
+
+
+def test_flat_refuses_zero():
+    m, opt = _build(FusedSGD, True, lr=0.1)
+    with pytest.raises(ValueError, match="zero_sharding"):
+        make_train_step(m, opt, _loss, flat_master=True,
+                        zero_sharding=True)
