@@ -663,6 +663,57 @@ def run_kernel_timing(iters=30, reps=5):
         _ab(build, (x_, emb_), f"R{rows}_V{vcb}_E{e_}_bfloat16",
             "lm_head_xent")
 
+    # --- MLP: fused whole-chain step vs per-op eager dispatch at the
+    # reference's exact test shapes (tests/L0/run_mlp/test_mlp.py:
+    # batch 1024, sizes 480-1024-1024-512-256-1).  The reference built
+    # mlp_cuda purely to fuse Linear+bias+ReLU chains that eager torch
+    # dispatches op-by-op; the TPU analogue of "unfused" is eager jax
+    # (one dispatch per primitive), of "fused" one jitted fwd+bwd.
+    # Not a Pallas kernel — reported as its own row, outside the
+    # shipping-kernel gmean.
+    from apex_tpu.mlp import MLP
+    import apex_tpu.nn as nn_
+    nn_.manual_seed(0)
+    mlp = MLP([480, 1024, 1024, 512, 256, 1])
+    mlp_vals = [p.data.astype(jnp.bfloat16) for p in mlp.parameters()]
+    mlp_plist = list(mlp.parameters())
+    xin = jnp.asarray(rng.standard_normal((1024, 480)), jnp.bfloat16)
+
+    def mlp_loss(x, vals):
+        from apex_tpu.nn.modules import Ctx
+        env = {id(p): v for p, v in zip(mlp_plist, vals)}
+        ctx = Ctx(env=env, stats_out={}, training=True, key=None)
+        return jnp.sum(mlp.forward(ctx, x).astype(jnp.float32) ** 2)
+
+    mlp_grad = jax.grad(mlp_loss, argnums=(0, 1))
+    mlp_jit = jax.jit(mlp_grad)
+    row = {"reps": reps, "iters": iters}
+    seg = {"fused": [], "unfused": []}
+    _sync(mlp_jit(xin, mlp_vals))
+    _sync(mlp_grad(xin, mlp_vals))
+    for rep in range(reps):
+        stage("kernel_timing", f"mlp rep {rep + 1}/{reps}")
+        for arm, fn in (("fused", mlp_jit), ("unfused", mlp_grad)):
+            t0 = time.perf_counter()
+            for _ in range(max(1, iters // (1 if arm == "fused" else 3))):
+                out = fn(xin, mlp_vals)
+            _sync(out)
+            n_it = max(1, iters // (1 if arm == "fused" else 3))
+            seg[arm].append((time.perf_counter() - t0) / n_it)
+    for arm, ts in seg.items():
+        ts = sorted(ts)
+        n_ = len(ts)
+        med = ts[n_ // 2] if n_ % 2 else (ts[n_ // 2 - 1]
+                                          + ts[n_ // 2]) / 2
+        row[f"{arm}_ms"] = round(med * 1e3, 4)
+        row[f"{arm}_iqr_ms"] = round(
+            (ts[(3 * n_) // 4] - ts[n_ // 4]) * 1e3, 4)
+    row["speedup"] = round(row["unfused_ms"] / row["fused_ms"], 3)
+    results["mlp"] = {"B1024_480-1024-1024-512-256-1_bfloat16": row}
+    log(f"kernel timing mlp: {row}")
+    emit({"metric": "mlp_fused_vs_unfused_ab",
+          "shape": "B1024_480-1024-1024-512-256-1_bfloat16", **row})
+
     # THE gmean definition (one, emitted here — VERDICT r4 weak #3 had
     # three competing values in flight): geometric mean of the
     # median-of-reps speedups over the SHIPPING kernels' rows — the
@@ -952,7 +1003,7 @@ def _lm_head_loss(loss_mode, vocab, chunk_rows=None):
 
 def build_gpt_step(batch, seq_len, remat=False, size="small",
                    loss_mode="chunked", attn_dropout=0.0, pad_vocab=False,
-                   grad_accum=1, chunk_rows=None):
+                   grad_accum=1, chunk_rows=None, dynamic_scale=False):
     """GPT-2 causal-LM model+step+batch: next-token loss with FusedAdam
     under the bf16 fused step (the autoregressive counterpart of the BERT
     config; no reference analogue — the reference ships no LMs)."""
@@ -986,8 +1037,13 @@ def build_gpt_step(batch, seq_len, remat=False, size="small",
                     output_hidden=output_hidden)
     opt = FusedAdam(list(model.parameters()), lr=6e-4, weight_decay=0.1)
 
+    # --dynamic-scale: the reference's signature fp16 machinery (scaled
+    # loss, per-step unscale + overflow check + conditional skip,
+    # amp/scaler.py) priced on-chip against the bf16 loss_scale=1.0
+    # fast path that skips the non-finite reduction entirely
     step = make_train_step(model, opt, lm_loss,
-                           half_dtype=jnp.bfloat16, loss_scale=1.0,
+                           half_dtype=jnp.bfloat16,
+                           loss_scale="dynamic" if dynamic_scale else 1.0,
                            grad_accum_steps=grad_accum)
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, vocab, (batch, seq_len)))
@@ -1002,11 +1058,12 @@ def build_gpt_step(batch, seq_len, remat=False, size="small",
 
 def run_gpt_throughput(batch, seq_len, iters, warmup, remat=False,
                        size="small", loss_mode="chunked", attn_dropout=0.0,
-                       pad_vocab=False, grad_accum=1, chunk_rows=None):
+                       pad_vocab=False, grad_accum=1, chunk_rows=None,
+                       dynamic_scale=False):
     step, arrays, af, paf = build_gpt_step(batch, seq_len, remat, size,
                                            loss_mode, attn_dropout,
                                            pad_vocab, grad_accum,
-                                           chunk_rows)
+                                           chunk_rows, dynamic_scale)
     stage("compile", f"gpt batch={batch}")
     return time_compiled_step(step, arrays, iters, warmup, af,
                               pallas_attn_flops=paf,
@@ -1518,6 +1575,12 @@ def main():
     ap.add_argument("--chunk-rows", type=int, default=None,
                     help="--loss-mode chunked: rows per chunk "
                          "(default auto ~64M logits elements)")
+    ap.add_argument("--dynamic-scale", action="store_true",
+                    help="--gpt: run the step with loss_scale='dynamic' "
+                         "(full fp16-style unscale + overflow-check + "
+                         "skip machinery) instead of the bf16 1.0 fast "
+                         "path — prices the reference's signature "
+                         "scaler on-chip")
     ap.add_argument("--grad-accum", type=int, default=1,
                     help="--gpt/--llama: microbatch the step K ways "
                          "inside one compiled program (lax.scan grad "
@@ -1594,6 +1657,7 @@ def main():
         # chunked A/B superseded)
         lt = f"{lm_mode}loss_" if lm_mode != "chunked" else ""
         ga = f"ga{args.grad_accum}_" if args.grad_accum > 1 else ""
+        ga += "dynscale_" if args.dynamic_scale else ""
         if args.gpt:
             pv = "padvocab_" if args.pad_vocab else ""
             return (f"gpt2_{args.gpt_size}_causal_lm_seq{args.seq_len}_"
@@ -1790,7 +1854,8 @@ def main():
                                       attn_dropout=args.attn_dropout,
                                       pad_vocab=args.pad_vocab,
                                       grad_accum=args.grad_accum,
-                                      chunk_rows=args.chunk_rows)
+                                      chunk_rows=args.chunk_rows,
+                                      dynamic_scale=args.dynamic_scale)
         if args.llama:
             return run_llama_throughput(batch, args.seq_len, args.iters,
                                         args.warmup, remat=args.remat,
