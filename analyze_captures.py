@@ -4,11 +4,13 @@ diagnose_gpt1024.jsonl) into a markdown report.
 Run after `auto_capture.sh` has drained (or partially drained):
 
     python analyze_captures.py            # prints the report
-    python analyze_captures.py --update   # also appends it to BENCH_HISTORY.md
+    python analyze_captures.py --update   # writes it into BENCH_HISTORY.md
+                                          # (REPLACES this round's block —
+                                          # idempotent, one summary per round)
 
 What it computes:
-- per-metric best row (latest non-null value), with the round-3
-  reference number and the delta where one exists;
+- per-metric best row (latest non-null value), with the previous
+  round's reference number and the delta where one exists;
 - the kernel A/B table grouped by kernel, flagging rows <1.0x and the
   S=512 dispatch-threshold verdict (should APEX_TPU_FLASH_MIN_SK move?);
 - decode ladder: plain -> int8 -> int8+kv-int8 -> speculative ratios;
@@ -75,11 +77,11 @@ def report():
             best[r["metric"]] = r
     if best:
         out += ["## Headline metrics", "",
-                "| metric | value | unit | vs r3 | mfu |", "|---|---|---|---|---|"]
+                "| metric | value | unit | vs r4 | mfu |", "|---|---|---|---|---|"]
         for m, r in sorted(best.items()):
-            if m == "pallas_kernel_ab":
+            if m in ("pallas_kernel_ab", "mlp_fused_vs_unfused_ab"):
                 continue
-            r3 = R3.get(m)
+            r3 = R4.get(m)
             delta = (f"{(r['value'] / r3 - 1) * 100:+.1f}%"
                      if r3 else "—")
             out.append(f"| {m} | {r['value']} | {r.get('unit', '')} "
@@ -194,14 +196,33 @@ def report():
     return "\n".join(out)
 
 
+ROUND = 5
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
-                    help="append the report to BENCH_HISTORY.md")
+                    help="write the report into BENCH_HISTORY.md, "
+                         "replacing this round's block if present "
+                         "(idempotent — one summary per round)")
+    ap.add_argument("--round", type=int, default=ROUND)
     args = ap.parse_args()
     text = report()
     print(text)
     if args.update:
-        with open(os.path.join(HERE, "BENCH_HISTORY.md"), "a") as f:
-            f.write("\n" + text + "\n")
-        print("\n(appended to BENCH_HISTORY.md)")
+        path = os.path.join(HERE, "BENCH_HISTORY.md")
+        begin = f"<!-- capture-summary:r{args.round} begin -->"
+        end = f"<!-- capture-summary:r{args.round} end -->"
+        block = (f"{begin}\n# On-chip capture summary (round "
+                 f"{args.round})\n\n" + text.split("\n", 2)[2] + f"\n{end}\n")
+        cur = open(path).read() if os.path.exists(path) else ""
+        if begin in cur and end in cur:
+            head, rest = cur.split(begin, 1)
+            _, tail = rest.split(end, 1)
+            cur = head + block + tail.lstrip("\n")
+            action = "replaced"
+        else:
+            cur = cur.rstrip("\n") + "\n\n" + block
+            action = "appended"
+        open(path, "w").write(cur)
+        print(f"\n({action} round-{args.round} block in BENCH_HISTORY.md)")
