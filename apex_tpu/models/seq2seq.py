@@ -80,8 +80,13 @@ class TransformerSeq2Seq(nn.Module):
     def __init__(self, vocab_size=32000, hidden=512, enc_layers=6,
                  dec_layers=6, heads=8, intermediate=None,
                  max_positions=512, dropout=0.1, attn_dropout=0.1,
-                 tp_axis=None):
+                 tp_axis=None, output_hidden=False):
         super().__init__()
+        # output_hidden: training-time option — forward returns
+        # (decoder hidden, tied table) instead of logits so a
+        # chunked/fused loss can own the vocab chain (the GptModel
+        # convention)
+        self.output_hidden = output_hidden
         intermediate = intermediate or 4 * hidden
         self.hidden = hidden
         self.max_positions = max_positions
@@ -150,6 +155,8 @@ class TransformerSeq2Seq(nn.Module):
         x = self.dec_ln.forward(ctx, x)
         x = jnp.swapaxes(x, 0, 1)               # (B, S_tgt, E)
         emb = ctx.value(self.tok_emb.weight)
+        if self.output_hidden:
+            return x, emb
         return jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype))
 
 
