@@ -1,75 +1,40 @@
 #!/bin/bash
-# Remaining measurement matrix after the first healthy-tunnel window of
-# round 3 (which captured resnet/bert/gpt-128 before the gpt seq-1024
-# warmup hang re-wedged the tunnel).  Ordered low-risk-first so a single
-# wedge cannot block the whole matrix; the risky long-sequence configs
-# run LAST, with an automatic A/B bisect (threefry dropout / plain loss)
-# if seq-1024 hangs again, to identify which round-3 change (if any) is
-# responsible vs. plain tunnel flakiness.
+# Round-5 remaining captures: everything measure_all.sh had not yet
+# drained when the tunnel wedged mid-sweep (plus the two items that
+# failed under host-load starvation and the new BERT/seq2seq arms).
+# Waits for a healthy tunnel first; appends to measurements.jsonl.
 set -u
 LOG="${MEASURE_LOG:-measurements.jsonl}"
 cd "$(dirname "$0")"
 
-if ! ./probe_tunnel.sh; then
-  echo "tunnel not healthy; aborting" >&2
-  exit 1
-fi
+bash probe_tunnel.sh -w || exit 1
 
 run() {
   echo "=== $* ===" >&2
-  timeout 700 env "${ENVV[@]:-IGNORE=1}" python bench.py "$@" \
-    2>>"$LOG.err" | tee -a "$LOG"
+  timeout 1700 python bench.py "$@" 2>>"$LOG.err" | tee -a "$LOG"
 }
 
-# Did the MOST RECENT run() emit a fresh non-null JSON line?  A hung run
-# is killed before it writes anything, so judging by the log's last line
-# alone would credit it with the PREVIOUS config's success — count lines
-# before/after instead.
-lines() { [ -f "$LOG" ] && wc -l < "$LOG" || echo 0; }
-run_ok() {  # usage: pre=$(lines); run ...; run_ok "$pre"
-  [ "$(lines)" -gt "$1" ] && tail -1 "$LOG" | grep -q '"value": [0-9]'
-}
-
-ENVV=()
+run --bert                            # gathered-MLM default (NEW)
+run --bert --full-mlm-head --no-kernels   # all-positions A/B arm
+run --seq2seq                         # chunked vocab-chain default (NEW)
+run --seq2seq --loss-mode fused --no-kernels
+run 16 --gpt --seq-len 1024           # failed under host-load starvation
+run 8 --gpt --seq-len 2048 --remat    # failed: tunnel wedge
+run --gpt --loss-mode fused --no-kernels    # vocab-chain A/B anchor arm
+run --kernels-timing --budget-s 1600  # variance-controlled + MLP row
 run --gpt-decode
-./probe_tunnel.sh || exit 1
-run --llama --seq-len 512 --iters 30
-./probe_tunnel.sh || exit 1
-run --seq2seq
-./probe_tunnel.sh || exit 1
-run --kernels-timing
-./probe_tunnel.sh || exit 1
-run --profile
-./probe_tunnel.sh || exit 1
-run --profile --gpt
-./probe_tunnel.sh || exit 1
-run --sweep 96,128,192,256
-./probe_tunnel.sh || exit 1
-run --gpt --sweep 32,64,128
-./probe_tunnel.sh || exit 1
-
-# ---- risky: long-sequence configs ----
-pre=$(lines)
-run 16 --gpt --seq-len 1024
-if run_ok "$pre"; then
-  ./probe_tunnel.sh || exit 1
-  run 8 --gpt --seq-len 2048 --remat
-  echo "done (full)" >&2
-  exit 0
-fi
-
-# seq-1024 failed: bisect.  Each variant needs a healthy tunnel first
-# (wait up to ~4h per variant — wedges have lasted hours).
-echo "seq-1024 failed; bisecting (waiting for tunnel between variants)" >&2
-./probe_tunnel.sh -w 60 || exit 1
-ENVV=(APEX_TPU_DROPOUT_IMPL=threefry)
-pre=$(lines)
-run 16 --gpt --seq-len 1024          # variant A: threefry dropout
-a_ok=$(run_ok "$pre" && echo yes || echo no)
-ENVV=()
-
-./probe_tunnel.sh -w 60 || exit 1
-pre=$(lines)
-run 16 --gpt --seq-len 1024 --plain-loss   # variant B: plain loss path
-b_ok=$(run_ok "$pre" && echo yes || echo no)
-echo "bisect done: threefry_ok=$a_ok plain_loss_ok=$b_ok" >&2
+run --gpt-decode --int8
+run --gpt-decode --int8 --kv-int8
+run --llama-decode
+run 16 --llama-decode --seq-len 512
+run 16 --llama-decode --seq-len 512 --window 128
+run --spec-decode --budget-s 1200     # trained draft (NEW)
+run --spec-decode --draft-steps 60 --budget-s 1200  # low-acceptance point
+run --spec-decode --draft random --no-kernels  # overhead-floor arm
+run --dcgan
+run --profile                         # resnet per-op attribution
+run --profile --gpt                   # current-default (chunked) profile
+run 32 --profile --vit
+run --sweep 96,128,192,256            # resnet batch/MFU sweet spot
+run --gpt --sweep 32,64,128           # gpt batch/MFU sweet spot
+echo "done; results in $LOG" >&2
