@@ -266,6 +266,7 @@ def init():
         from ..contrib import multihead_attn as _attn_pkg
         from ..contrib.multihead_attn import attn_funcs as _attn
         from ..contrib import xentropy as _sx_pkg
+        from ..contrib.xentropy import chunked as _cx
         from ..contrib.xentropy import softmax_xentropy as _sx
         from .. import normalization as _norm_pkg
         # the package re-exports a function named like the submodule, so a
@@ -285,7 +286,8 @@ def init():
                 ((_fln, _norm_pkg), "fused_layer_norm"),
                 ((_frn, _norm_pkg), "fused_rms_norm_affine"),
                 ((_frn, _norm_pkg), "fused_rms_norm"),
-                ((_sx, _sx_pkg), "softmax_cross_entropy_loss")):
+                ((_sx, _sx_pkg), "softmax_cross_entropy_loss"),
+                ((_cx, _sx_pkg), "chunked_lm_head_loss")):
             fn = getattr(mods[0], name)
             if not hasattr(fn, "__wrapped_pyprof__"):
                 w = _wrap_fn(name, fn)

@@ -156,3 +156,28 @@ def test_llama_output_hidden_shapes(rng):
     hidden, w = m(ids).value if hasattr(m(ids), "value") else m(ids)
     assert hidden.shape == (2, 8, E)
     assert w.shape == (V, E)
+
+
+def test_chunked_composes_with_remat_and_grad_accum(rng):
+    """The chunked loss under jax.checkpoint composes with block remat
+    and grad accumulation in one compiled step (nested checkpoints +
+    scan-in-scan)."""
+    import apex_tpu.nn as nn
+    from apex_tpu.models import GptModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+    from apex_tpu.contrib.xentropy import make_chunked_lm_loss
+
+    nn.manual_seed(4)
+    m = GptModel(vocab_size=V, hidden=E, layers=2, heads=4,
+                 max_positions=16, dropout=0.0, attn_dropout=0.0,
+                 remat=True, output_hidden=True)
+    opt = FusedAdam(list(m.parameters()), lr=1e-3)
+    s = make_train_step(m, opt, make_chunked_lm_loss(chunk_rows=16,
+                                                     padding_idx=-1),
+                        half_dtype=jnp.bfloat16, loss_scale=1.0,
+                        grad_accum_steps=2)
+    ids = jnp.asarray(rng.integers(0, V, (4, 16)))
+    losses = [float(s(ids, ids)) for _ in range(5)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
