@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-5 extras beyond measure_rest.sh — run manually after it drains
+# (separate file because a RUNNING measure_rest.sh must not be edited:
+# bash reads scripts incrementally).
+set -u
+LOG="${MEASURE_LOG:-measurements.jsonl}"
+cd "$(dirname "$0")"
+bash probe_tunnel.sh -w || exit 1
+run() {
+  echo "=== $* ===" >&2
+  timeout 1700 python bench.py "$@" 2>>"$LOG.err" | tee -a "$LOG"
+}
+run 32 --bert --seq-len 512 --no-kernels   # gathered head at long seq
+run --gpt --gpt-size medium --no-kernels   # 355M family point
+run --bert --attn-dropout 0.1 --no-kernels # historical recipe re-check
+run --gpt --attn-dropout 0.1 --no-kernels
+run 16 --llama --seq-len 1024 --no-kernels
+run 8 --llama --seq-len 2048 --no-kernels
+echo "extras done" >&2
