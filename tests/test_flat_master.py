@@ -194,3 +194,45 @@ def test_flat_state_checkpoint_roundtrip(rng, tmp_path):
     assert int(s2.state.step) == 1
     loss_resumed = float(s2(x, y))
     np.testing.assert_allclose(loss_resumed, loss_next, rtol=1e-6)
+
+
+def test_flat_under_dp_shard_map(rng):
+    """flat_master composes with axis_name DP: grads psum per-tensor
+    BEFORE bucket stacking, so the sharded step matches the
+    single-device full-batch step."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("data",))
+    x = jnp.asarray(rng.standard_normal((8, 3, 4, 4)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 5, (8,)))
+
+    m1, o1 = _build(FusedSGD, True, lr=0.1, momentum=0.9)
+    ref = make_train_step(m1, o1, _loss, half_dtype=None,
+                          loss_scale=1.0, flat_master=True)
+    ref_losses = [float(ref(x, y)) for _ in range(3)]
+
+    nn.manual_seed(11)
+    m2 = Net()
+    from apex_tpu.parallel import convert_syncbn_model
+    m2 = convert_syncbn_model(m2)   # full-batch BN semantics across shards
+    o2 = FusedSGD(list(m2.parameters()), lr=0.1, momentum=0.9)
+    dp = make_train_step(m2, o2, _loss, half_dtype=None,
+                         loss_scale=1.0, flat_master=True,
+                         axis_name="data")
+    sharded = jax.jit(jax.shard_map(
+        dp._step_fn, mesh=mesh,
+        in_specs=(P(), P("data"), P("data")),
+        out_specs=(P(), P()), check_vma=False))
+    state = dp.state
+    dp_losses = []
+    for _ in range(3):
+        state, loss = sharded(state, x, y)
+        dp_losses.append(float(loss))
+    # per-shard mean losses average to the full-batch mean only when
+    # shards are homogeneous; compare the training trajectory through
+    # the PARAMS instead (psum-averaged grads == full-batch grads)
+    np.testing.assert_allclose(
+        np.asarray(state.master_params[0]),
+        np.asarray(ref.state.master_params[0]), rtol=2e-5, atol=1e-6)
