@@ -1067,6 +1067,12 @@ def build_gpt_step(batch, seq_len, remat=False, size="small",
     # --pad-vocab: Megatron's make-vocab-size-divisible-by convention
     # (50257 -> 50304): the head matmul tiles the MXU lane-aligned; the
     # loss sees -1e30-masked pad columns, so numerics are exact
+    if pad_vocab and loss_mode == "kernel":
+        raise ValueError(
+            "--loss-mode kernel with --pad-vocab is unsupported: the "
+            "fused lm-head kernel computes plain CE over the table's "
+            "full height and would treat the pad rows as real vocab "
+            "(the chunked mode masks them; use chunked or fused)")
     output_hidden, lm_loss = _lm_head_loss(loss_mode, vocab, chunk_rows)
     model = factory(max_positions=seq_len, attn_dropout=attn_dropout,
                     remat=remat,
