@@ -12,11 +12,11 @@ from __future__ import annotations
 
 import types
 
+import jax
 import jax.numpy as jnp
 
-from .. import ops
-from ..multi_tensor_apply import multi_tensor_applier
 from ..nn.parameter import Parameter
+from ..runtime import step_cache as _step_cache
 from ._amp_state import maybe_print
 
 
@@ -36,10 +36,11 @@ class AmpOptimizerState:
 def _master_params_to_model_params(self):
     stash = self._amp_stash
     if len(stash.all_fp16_params) > 0:
-        _, new_model = multi_tensor_applier(
-            ops.multi_tensor_scale, ops.zero_flag(),
-            [[p.data for p in stash.all_fp32_from_fp16_params],
-             [p.data for p in stash.all_fp16_params]], 1.0)
+        # one cached executable; the stale half copies are donated (each
+        # output aliases the buffer it replaces)
+        new_model = _step_cache.master_to_model(
+            [p.data for p in stash.all_fp32_from_fp16_params],
+            [p.data for p in stash.all_fp16_params])
         for mp, nd in zip(stash.all_fp16_params, new_model):
             mp.data = nd
 
@@ -91,10 +92,13 @@ def lazy_init_with_master_weights(self):
 
 def post_backward_models_are_masters(scaler, params, stashed_grads,
                                      scale_override=None):
-    grads_have_scale = scaler.loss_scale()
+    # device scalar, NOT loss_scale() — the reference pays one D2H sync per
+    # step here (scaler.py:197-200); the step-cache path keeps the scale on
+    # device end to end
+    grads_have_scale = scaler.device_scale
     stashed_have_scale, out_scale = 1.0, 1.0
 
-    if scaler.loss_scale() == 1.0 and not scaler.dynamic:
+    if not scaler.dynamic and scaler.static_scale == 1.0:
         for i in range(len(stashed_grads)):
             stashed_grads[i] = None
         return
@@ -167,10 +171,14 @@ def post_backward_with_master_weights(self, scaler):
             preexisting_masters.append(fp32_param)
 
     if fp16_needing_unscale:
+        # master templates only supply dtypes — ShapeDtypeStructs avoid a
+        # per-step fp32 allocation per gradient; device_scale avoids the
+        # per-step host sync of loss_scale()
         new = scaler.unscale(
             [p.grad for p in fp16_needing_unscale],
-            [jnp.zeros(p.shape, jnp.float32) for p in fp16_needing_unscale],
-            scaler.loss_scale(), models_are_masters=False)
+            [jax.ShapeDtypeStruct(tuple(p.shape), jnp.float32)
+             for p in fp16_needing_unscale],
+            scaler.device_scale, models_are_masters=False)
         for mp, g in zip(new_masters, new):
             mp.grad = g
 
@@ -295,6 +303,11 @@ def _process_optimizer(optimizer, properties):
     optimizer._amp_stash.lazy_init_called = False
     optimizer._amp_stash.already_patched = False
     optimizer._amp_stash.params_have_scaled_gradients = False
+    # step-cache integration: set when the fused step program emitted the
+    # master→model half copies itself / when scale_loss deferred the
+    # dynamic-scale update into the step program
+    optimizer._amp_stash._model_params_synced = False
+    optimizer._amp_stash._deferred_scaler = None
 
     for name in ("_lazy_init_maybe_master_weights",
                  "_master_params_to_model_params",
@@ -319,7 +332,14 @@ def _process_optimizer(optimizer, properties):
                                    "use with optimizers.")
             retval = old_step()
             if not isinstance(self, FusedSGD):
-                self._master_params_to_model_params()
+                stash = self._amp_stash
+                if getattr(stash, "_model_params_synced", False):
+                    # the step-cache program emitted the half model copies
+                    # from the same executable as the update — no separate
+                    # copyback pass
+                    stash._model_params_synced = False
+                else:
+                    self._master_params_to_model_params()
             for param in self._amp_stash.all_fp32_from_fp16_params:
                 param.grad = None
             return retval
@@ -328,7 +348,12 @@ def _process_optimizer(optimizer, properties):
 
         old_zero_grad = optimizer.zero_grad  # noqa: F841 (kept for parity)
 
-        def new_zero_grad(self, set_to_none: bool = False):
+        def new_zero_grad(self, set_to_none: bool = None):
+            if set_to_none is None:
+                # fused-path default: the step cache consumes gradients
+                # functionally, so dropping them skips the per-param
+                # zeros_like allocation entirely
+                set_to_none = getattr(self, "set_grad_none", True)
             stash = self._amp_stash
             self._amp_lazy_init()
             for param in stash.all_fp16_params:

@@ -70,6 +70,11 @@ class Properties:
             "keep_batchnorm_fp32": None,
             "master_weights": None,
             "loss_scale": 1.0,
+            # step-cache integration: fuse the overflow skip + dynamic-scale
+            # update into the optimizer's compiled step (no per-step host
+            # sync).  Off by default for reference-exact skip semantics
+            # (one-shot step patch + "Gradient overflow" print).
+            "defer_scale_update": False,
         }
 
     def _update_options_dict(self, new_options):
@@ -204,7 +209,8 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
                cast_model_type=None, patch_torch_functions=None,
                keep_batchnorm_fp32=None, master_weights=None, loss_scale=None,
                cast_model_outputs=None, num_losses=1, verbosity=1,
-               min_loss_scale=None, max_loss_scale=2.0 ** 24):
+               min_loss_scale=None, max_loss_scale=2.0 ** 24,
+               defer_scale_update=None):
     """Initialize models and optimizers for mixed-precision training
     (reference: frontend.py:195-358; same argument surface)."""
     from ._initialize import _initialize
@@ -243,7 +249,8 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
                         ("patch_torch_functions", patch_torch_functions),
                         ("keep_batchnorm_fp32", keep_batchnorm_fp32),
                         ("master_weights", master_weights),
-                        ("loss_scale", loss_scale)):
+                        ("loss_scale", loss_scale),
+                        ("defer_scale_update", defer_scale_update)):
         if value is not None:
             setattr(_amp_state.opt_properties, name, value)
 

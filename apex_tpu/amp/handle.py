@@ -60,6 +60,20 @@ def scale_loss(loss, optimizers, loss_id=0, model=None, delay_unscale=False,
         for optimizer in optimizers:
             optimizer._post_amp_backward(loss_scaler)
             optimizer._amp_stash.params_have_scaled_gradients = False
+        # deferred mode (amp.initialize(..., defer_scale_update=True)): hand
+        # the scaler to the optimizers' step-cache programs, which fuse the
+        # overflow-conditional skip (lax.cond) and the dynamic-scale update
+        # into the step executable — no per-step host sync, no step patching
+        # (and no "Gradient overflow" print; read loss_scale() to observe).
+        # (single-optimizer only: the scale update runs inside that
+        # optimizer's step program exactly once)
+        if (not delay_overflow_check
+                and len(optimizers) == 1
+                and getattr(_amp_state.opt_properties, "defer_scale_update",
+                            False)
+                and getattr(optimizers[0], "_step_cache_scaler_ok", False)):
+            optimizers[0]._amp_stash._deferred_scaler = loss_scaler
+            return
         should_skip = False if delay_overflow_check else \
             loss_scaler.update_scale()
         if should_skip:

@@ -20,8 +20,7 @@ from typing import NamedTuple, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from ..multi_tensor_apply import multi_tensor_applier
-from .. import ops
+from ..runtime import step_cache as _step_cache
 
 _f32 = jnp.float32
 
@@ -82,18 +81,19 @@ def unscale_grads(state: ScalerState, model_grads: Sequence[jax.Array],
                   scale_override=None):
     """master_grad = model_grad / loss_scale, flagging non-finites.
 
-    Functional analogue of LossScaler.unscale (scaler.py:76-124): uses
-    multi_tensor_scale with 1/scale.  Returns (new_state, master_grads).
+    Functional analogue of LossScaler.unscale (scaler.py:76-124): the whole
+    unscale + overflow sweep runs as ONE cached executable
+    (``step_cache.unscale``) instead of eager per-tensor dispatches.
+    Returns (new_state, master_grads).
     """
     scale = state.loss_scale if scale_override is None \
         else jnp.asarray(scale_override, _f32)
     inv = 1.0 / scale
-    outs = [g if master_dtypes is None else jnp.zeros(g.shape, master_dtypes[i])
-            for i, g in enumerate(model_grads)]
-    flag, masters = multi_tensor_applier(
-        ops.multi_tensor_scale, state.overflow, [list(model_grads), outs], inv)
-    if not check_overflow:
-        flag = state.overflow
+    dts = [g.dtype if master_dtypes is None else master_dtypes[i]
+           for i, g in enumerate(model_grads)]
+    flag, masters = _step_cache.unscale(
+        state.overflow, list(model_grads), dts, inv,
+        check_overflow=check_overflow)
     return ScalerState(state.loss_scale, state.unskipped, flag), masters
 
 
@@ -109,11 +109,9 @@ def unscale_with_stashed_grads(state: ScalerState, model_grads, stashed_grads,
         grads_have_scale, stashed_have_scale, out_scale = scale_override
     else:
         grads_have_scale, stashed_have_scale = state.loss_scale, 1.0
-    outs = [jnp.zeros_like(s) for s in stashed_grads]
-    flag, masters = multi_tensor_applier(
-        ops.multi_tensor_axpby, state.overflow,
-        [list(model_grads), list(stashed_grads), outs],
-        out_scale / grads_have_scale, out_scale / stashed_have_scale, 0)
+    flag, masters = _step_cache.unscale_with_stashed(
+        state.overflow, list(model_grads), list(stashed_grads),
+        out_scale / grads_have_scale, out_scale / stashed_have_scale)
     return ScalerState(state.loss_scale, state.unskipped, flag), masters
 
 
@@ -131,6 +129,8 @@ class LossScaler:
                  scale_window=2000, min_loss_scale=None,
                  max_loss_scale=2.0 ** 24):
         self.dynamic = loss_scale == "dynamic"
+        #: known-without-sync scale for static scalers (None when dynamic)
+        self.static_scale = None if self.dynamic else float(loss_scale)
         self._state = init_scaler_state(loss_scale, init_scale, max_loss_scale)
         self._max_loss_scale = max_loss_scale
         self._min_loss_scale = min_loss_scale
@@ -149,6 +149,12 @@ class LossScaler:
     # reference-compat accessors (frontend.state_dict reads these)
     def loss_scale(self):
         return float(self._state.loss_scale)
+
+    @property
+    def device_scale(self):
+        """The loss scale as a device scalar — use this on per-step paths;
+        ``loss_scale()`` is a host readback (one D2H sync)."""
+        return self._state.loss_scale
 
     @property
     def _unskipped(self):

@@ -11,58 +11,61 @@ scale into ``clip*scale`` when the reported grad norm exceeds it
 ``sqrt(v + eps)`` denominators (eps_mode 0) vs ``sqrt(v) + eps``
 (fused_adam.py:27-29,63).
 
-TPU shape: one jitted update over each param group; fp32 math regardless of
-storage dtype; the half output copy is a cast in the same fused program, not
-a second kernel.
+TPU shape: ONE step-cache executable per optimizer step covering every param
+group, with traced scalar hyperparameters (lr/betas/eps/wd/scale schedules
+never retrace) and params + both moments + the stale half output copies
+donated; fp32 math regardless of storage dtype; the half output copy is a
+cast in the same fused program, not a second kernel.
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
-from ...optimizers.base import Optimizer
+from ... import ops
+from ...optimizers.base import Optimizer, dispatch_cached_step
 
 _f32 = jnp.float32
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "beta1", "beta2", "eps", "eps_mode", "bias_correction", "weight_decay",
-    "out_dtypes"))
-def _adam_legacy_step(grads, params, ms, vs, steps, lr, combined_scale,
-                      beta1, beta2, eps, eps_mode, bias_correction,
-                      weight_decay, out_dtypes):
-    new_p, new_m, new_v, outs = [], [], [], []
-    for g, p, m, v, step, od in zip(grads, params, ms, vs, steps,
-                                    out_dtypes):
-        # bias correction is per-param: params can enter the live set at
-        # different iterations (grad=None freezing), and each carries its
-        # own state['step'] like the reference's per-tensor kernel calls
-        if bias_correction:
-            bc1 = 1.0 - beta1 ** step.astype(_f32)
-            bc2 = 1.0 - beta2 ** step.astype(_f32)
-        else:
-            bc1 = bc2 = jnp.asarray(1.0, _f32)
-        gf = g.astype(_f32) / combined_scale
-        pf = p.astype(_f32)
-        m = beta1 * m.astype(_f32) + (1 - beta1) * gf
-        v = beta2 * v.astype(_f32) + (1 - beta2) * gf * gf
-        mhat = m / bc1
-        vhat = v / bc2
-        if eps_mode == 0:        # eps inside sqrt
-            denom = jnp.sqrt(vhat + eps)
-        else:
-            denom = jnp.sqrt(vhat) + eps
-        update = mhat / denom + weight_decay * pf
-        pf = pf - lr * update
-        new_p.append(pf.astype(p.dtype))
-        new_m.append(m)
-        new_v.append(v)
-        # half write-out casts straight from fp32 to the OUTPUT's dtype —
-        # no lossy f16 intermediate for bf16 outputs
-        outs.append(pf.astype(od) if od is not None else None)
-    return new_p, new_m, new_v, outs
+def _legacy_adam_update(static_cfg, donated, grads, hyper, flag):
+    """Pure whole-optimizer legacy-Adam update (all groups, in-kernel
+    unscale via combined_scale, per-param bias correction, half write-out)."""
+    eps_mode, bias_corrections = static_cfg
+    new = []
+    for entry, gs, h, bias_correction in zip(donated, grads, hyper,
+                                             bias_corrections):
+        new_p, new_m, new_v, outs = [], [], [], []
+        for i, (g, p, m, v) in enumerate(zip(gs, entry["p"], entry["m"],
+                                             entry["v"])):
+            # bias correction is per-param: params can enter the live set at
+            # different iterations (grad=None freezing), and each carries
+            # its own state['step'] like the reference's per-tensor calls
+            if bias_correction:
+                bc1 = 1.0 - h["beta1"] ** h["steps"][i].astype(_f32)
+                bc2 = 1.0 - h["beta2"] ** h["steps"][i].astype(_f32)
+            else:
+                bc1 = bc2 = jnp.asarray(1.0, _f32)
+            gf = g.astype(_f32) / h["combined_scale"]
+            pf = p.astype(_f32)
+            mf = h["beta1"] * m.astype(_f32) + (1 - h["beta1"]) * gf
+            vf = h["beta2"] * v.astype(_f32) + (1 - h["beta2"]) * gf * gf
+            mhat = mf / bc1
+            vhat = vf / bc2
+            if eps_mode == 0:        # eps inside sqrt
+                denom = jnp.sqrt(vhat + h["eps"])
+            else:
+                denom = jnp.sqrt(vhat) + h["eps"]
+            update = mhat / denom + h["weight_decay"] * pf
+            pf = pf - h["lr"] * update
+            new_p.append(pf.astype(p.dtype))
+            new_m.append(mf)
+            new_v.append(vf)
+            # half write-out casts straight from fp32 to the OUTPUT's dtype
+            # — no lossy f16 intermediate for bf16 outputs
+            o = entry["out"][i]
+            outs.append(pf.astype(o.dtype) if o is not None else None)
+        new.append({"p": new_p, "m": new_m, "v": new_v, "out": outs})
+    return new
 
 
 class FusedAdam(Optimizer):
@@ -82,6 +85,7 @@ class FusedAdam(Optimizer):
         self.eps_mode = 0 if eps_inside_sqrt else 1
         self._amp_scale_adjustment = amp_scale_adjustment
         self._use_multi_tensor = use_mt  # recorded; batching is XLA's job
+        self._overflow_buf = ops.zero_flag()
 
     def step(self, closure=None, grads=None, output_params=None, scale=1.,
              grad_norms=None):
@@ -105,6 +109,8 @@ class FusedAdam(Optimizer):
         norms = grad_norms if grad_norms is not None else \
             [None] * len(self.param_groups)
 
+        live_groups = []
+        donated, grads_tree, hyper = [], [], []
         for group, g_this, out_this, gnorm in zip(
                 self.param_groups, grads_group, output_group, norms):
             params = group["params"]
@@ -134,26 +140,37 @@ class FusedAdam(Optimizer):
                     st["exp_avg_sq"] = jnp.zeros(p.data.shape, _f32)
                 st["step"] += 1
             beta1, beta2 = group["betas"]
-            out_dtypes = tuple(
-                str(jnp.dtype(o.data.dtype)) if o is not None else None
-                for _, _, o in live)
-            new_p, new_m, new_v, outs = _adam_legacy_step(
-                [g.data if hasattr(g, "data") else g for _, g, _ in live],
-                [p.data for p, _, _ in live],
-                [self.state[p]["exp_avg"] for p, _, _ in live],
-                [self.state[p]["exp_avg_sq"] for p, _, _ in live],
-                [jnp.asarray(self.state[p]["step"], jnp.int32)
-                 for p, _, _ in live],
-                jnp.asarray(group["lr"], _f32),
-                jnp.asarray(combined_scale, _f32),
-                beta1, beta2, group["eps"], self.eps_mode,
-                bool(group["bias_correction"]), group["weight_decay"],
-                out_dtypes)
-            for (p, _, o), np_, nm, nv, op_ in zip(live, new_p, new_m,
-                                                   new_v, outs):
-                p.data = np_
-                self.state[p]["exp_avg"] = nm
-                self.state[p]["exp_avg_sq"] = nv
+            live_groups.append((group, live))
+            donated.append({
+                "p": [p.data for p, _, _ in live],
+                "m": [self.state[p]["exp_avg"] for p, _, _ in live],
+                "v": [self.state[p]["exp_avg_sq"] for p, _, _ in live],
+                "out": [None if o is None else o.data for _, _, o in live]})
+            grads_tree.append([g.data if hasattr(g, "data") else g
+                               for _, g, _ in live])
+            hyper.append({
+                "lr": jnp.asarray(group["lr"], _f32),
+                "combined_scale": jnp.asarray(combined_scale, _f32),
+                "beta1": jnp.asarray(beta1, _f32),
+                "beta2": jnp.asarray(beta2, _f32),
+                "eps": jnp.asarray(group["eps"], _f32),
+                "weight_decay": jnp.asarray(group["weight_decay"], _f32),
+                "steps": [jnp.asarray(self.state[p]["step"], jnp.int32)
+                          for p, _, _ in live]})
+        if not live_groups:
+            return loss
+
+        static_cfg = (self.eps_mode,
+                      tuple(bool(g["bias_correction"])
+                            for g, _ in live_groups))
+        new = dispatch_cached_step(self, "contrib_fused_adam", static_cfg,
+                                   _legacy_adam_update, donated, grads_tree,
+                                   hyper)
+        for (group, live), entry in zip(live_groups, new):
+            for i, (p, _, o) in enumerate(live):
+                p.data = entry["p"][i]
+                self.state[p]["exp_avg"] = entry["m"][i]
+                self.state[p]["exp_avg_sq"] = entry["v"][i]
                 if o is not None:
-                    o.data = op_
+                    o.data = entry["out"][i]
         return loss

@@ -5,61 +5,60 @@ csrc/multi_tensor_lamb_stage_1.cu and _stage_2.cu).
 Stage 1 per tensor: moment updates + Adam-style step direction ``u`` with
 the *per-tensor* grad norm divided out of the decay term and the global
 clip folded into the grad scale.  Stage 2: trust-ratio apply
-``p -= lr · (‖p‖/‖u‖) · u``.  Kept as two jitted passes (with the
-per-tensor norms between them) to mirror the observable two-call structure;
-XLA fuses each pass across the group.
+``p -= lr · (‖p‖/‖u‖) · u``.  Both stages — plus the global grad norm that
+feeds the clip — now compile into ONE step-cache executable per optimizer
+with traced hyperparameters and donated params/moments (the observable
+two-call structure of the reference collapses the way its two kernels would
+under XLA fusion anyway).
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
 from ... import ops
-from ...multi_tensor_apply import multi_tensor_applier
-from ...optimizers.base import Optimizer, split_by_dtype
+from ...optimizers.base import (Optimizer, dispatch_cached_step,
+                                split_by_dtype)
 
 _f32 = jnp.float32
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "beta1", "beta2", "eps", "bias_correction", "weight_decay",
-    "grad_averaging"))
-def _stage1(grads, params, ms, vs, step, clip_scale, beta1, beta2, eps,
-            bias_correction, weight_decay, grad_averaging):
-    """→ (new_m, new_v, updates u)."""
-    beta3 = (1 - beta1) if grad_averaging else 1.0
-    if bias_correction:
-        bc1 = 1.0 - beta1 ** step.astype(_f32)
-        bc2 = 1.0 - beta2 ** step.astype(_f32)
-    else:
-        bc1 = bc2 = jnp.asarray(1.0, _f32)
-    new_m, new_v, us = [], [], []
-    for g, p, m, v in zip(grads, params, ms, vs):
-        gf = g.astype(_f32) * clip_scale
-        m = beta1 * m + beta3 * gf
-        v = beta2 * v + (1 - beta2) * gf * gf
-        u = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + \
-            weight_decay * p.astype(_f32)
-        new_m.append(m)
-        new_v.append(v)
-        us.append(u)
-    return new_m, new_v, us
-
-
-@jax.jit
-def _stage2(params, us, lr):
-    """Trust-ratio apply (csrc/multi_tensor_lamb_stage_2.cu): per-tensor
-    ``ratio = ‖p‖/‖u‖`` (1 where either norm is 0)."""
-    new_p = []
-    for p, u in zip(params, us):
-        pf = p.astype(_f32)
-        pn = jnp.sqrt(jnp.sum(pf * pf))
-        un = jnp.sqrt(jnp.sum(u * u))
-        ratio = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
-        new_p.append((pf - lr * ratio * u).astype(p.dtype))
-    return new_p
+def _contrib_lamb_update(static_cfg, donated, grads, hyper, flag):
+    """Pure whole-optimizer two-stage LAMB update: global grad norm →
+    per-group clip → stage 1 (moments + u) → stage 2 (trust-ratio apply)."""
+    bias_corrections, grad_avgs, max_norms = static_cfg
+    all_grads = [g for gs in grads for g in gs]
+    _, gnorm, _ = ops.multi_tensor_l2norm(flag, [all_grads])
+    new_steps = [s + 1 for s in donated["steps"]]
+    new_groups = []
+    for entry, gs, h, bc, ga, max_norm, step in zip(
+            donated["groups"], grads, hyper, bias_corrections, grad_avgs,
+            max_norms, new_steps):
+        clip = jnp.where(gnorm > max_norm, max_norm / gnorm, 1.0) \
+            if max_norm > 0 else jnp.asarray(1.0, _f32)
+        beta3 = (1 - h["beta1"]) if ga else jnp.asarray(1.0, _f32)
+        if bc:
+            bc1 = 1.0 - h["beta1"] ** step.astype(_f32)
+            bc2 = 1.0 - h["beta2"] ** step.astype(_f32)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, _f32)
+        new_p, new_m, new_v = [], [], []
+        for g, p, m, v in zip(gs, entry["p"], entry["m"], entry["v"]):
+            gf = g.astype(_f32) * clip
+            mf = h["beta1"] * m + beta3 * gf
+            vf = h["beta2"] * v + (1 - h["beta2"]) * gf * gf
+            pf = p.astype(_f32)
+            u = (mf / bc1) / (jnp.sqrt(vf / bc2) + h["eps"]) + \
+                h["weight_decay"] * pf
+            # stage 2 (csrc/multi_tensor_lamb_stage_2.cu): per-tensor
+            # ratio = ‖p‖/‖u‖, 1 where either norm is 0
+            pn = jnp.sqrt(jnp.sum(pf * pf))
+            un = jnp.sqrt(jnp.sum(u * u))
+            ratio = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
+            new_p.append((pf - h["lr"] * ratio * u).astype(p.dtype))
+            new_m.append(mf)
+            new_v.append(vf)
+        new_groups.append({"p": new_p, "m": new_m, "v": new_v})
+    return {"steps": new_steps, "groups": new_groups}
 
 
 class FusedLAMB(Optimizer):
@@ -85,48 +84,57 @@ class FusedLAMB(Optimizer):
         self.set_grad_none = set_grad_none
         self._overflow_buf = ops.zero_flag()
 
-    def zero_grad(self, set_to_none=None):
-        super().zero_grad(self.set_grad_none if set_to_none is None
-                          else set_to_none)
-
     def step(self, closure=None):
         loss = closure() if closure is not None else None
 
-        # global grad norm across every group/dtype (fused_lamb.py:106-125)
-        all_grads = [p.grad for g in self.param_groups for p in g["params"]
-                     if p.grad is not None]
-        if not all_grads:
-            return loss
-        _, gnorm, _ = multi_tensor_applier(
-            ops.multi_tensor_l2norm, self._overflow_buf, [all_grads], False)
-
+        live_groups = []
         for group in self.param_groups:
             plist = [p for p in group["params"] if p.grad is not None]
             if not plist:
                 continue
-            group["step"] = group.get("step", 0) + 1
-            beta1, beta2 = group["betas"]
-            max_norm = group["max_grad_norm"]
-            clip = jnp.where(gnorm > max_norm, max_norm / gnorm, 1.0) \
-                if max_norm > 0 else jnp.asarray(1.0, _f32)
-            for dtype, sub in split_by_dtype(plist).items():
+            # dtype split kept for state-init order parity; stage math is
+            # fp32 regardless of storage dtype so the update itself is flat
+            for sub in split_by_dtype(plist).values():
                 for p in sub:
                     st = self.state[p]
                     if len(st) == 0:
                         st["exp_avg"] = jnp.zeros(p.data.shape, _f32)
                         st["exp_avg_sq"] = jnp.zeros(p.data.shape, _f32)
-                new_m, new_v, us = _stage1(
-                    [p.grad for p in sub], [p.data for p in sub],
-                    [self.state[p]["exp_avg"] for p in sub],
-                    [self.state[p]["exp_avg_sq"] for p in sub],
-                    jnp.asarray(group["step"], jnp.int32), clip,
-                    beta1, beta2, group["eps"],
-                    bool(group["bias_correction"]), group["weight_decay"],
-                    bool(group["grad_averaging"]))
-                new_p = _stage2([p.data for p in sub], us,
-                                jnp.asarray(group["lr"], _f32))
-                for p, np_, nm, nv in zip(sub, new_p, new_m, new_v):
-                    p.data = np_
-                    self.state[p]["exp_avg"] = nm
-                    self.state[p]["exp_avg_sq"] = nv
+            live_groups.append((group, plist))
+        if not live_groups:
+            return loss
+
+        donated = {"steps": [jnp.asarray(g.get("step", 0), jnp.int32)
+                             for g, _ in live_groups],
+                   "groups": []}
+        grads_tree, hyper = [], []
+        for group, plist in live_groups:
+            beta1, beta2 = group["betas"]
+            donated["groups"].append({
+                "p": [p.data for p in plist],
+                "m": [self.state[p]["exp_avg"] for p in plist],
+                "v": [self.state[p]["exp_avg_sq"] for p in plist]})
+            grads_tree.append([p.grad for p in plist])
+            hyper.append({
+                "lr": jnp.asarray(group["lr"], _f32),
+                "beta1": jnp.asarray(beta1, _f32),
+                "beta2": jnp.asarray(beta2, _f32),
+                "eps": jnp.asarray(group["eps"], _f32),
+                "weight_decay": jnp.asarray(group["weight_decay"], _f32)})
+
+        static_cfg = (tuple(bool(g["bias_correction"])
+                            for g, _ in live_groups),
+                      tuple(bool(g["grad_averaging"]) for g, _ in live_groups),
+                      tuple(g["max_grad_norm"] for g, _ in live_groups))
+        new = dispatch_cached_step(self, "contrib_fused_lamb", static_cfg,
+                                   _contrib_lamb_update, donated, grads_tree,
+                                   hyper)
+
+        for (group, plist), entry, s in zip(live_groups, new["groups"],
+                                            new["steps"]):
+            group["step"] = s
+            for i, p in enumerate(plist):
+                p.data = entry["p"][i]
+                self.state[p]["exp_avg"] = entry["m"][i]
+                self.state[p]["exp_avg_sq"] = entry["v"][i]
         return loss

@@ -15,8 +15,15 @@ class MultiTensorApply:
 
     def __init__(self, chunk_size: int = 2048 * 32):
         self.chunk_size = chunk_size
+        self._record = None
 
     def __call__(self, op, noop_flag_buffer, tensor_lists, *args, **kwargs):
+        # launch-count observability: the step cache's stats() reports these
+        # as the analogue of the reference's per-step kernel-launch count
+        if self._record is None:
+            from ..runtime.step_cache import record_multi_tensor_call
+            self._record = record_multi_tensor_call
+        self._record()
         return op(noop_flag_buffer, tensor_lists, *args, **kwargs)
 
 

@@ -42,6 +42,17 @@ def _nonfinite(x) -> jax.Array:
     return (~jnp.isfinite(x.astype(_f32))).any().astype(jnp.int32)
 
 
+def _static_nonzero(x) -> bool:
+    """Whether a scalar hyperparameter must enter the program.
+
+    False only for a concrete Python zero; traced device scalars (the step
+    cache passes lr/wd/betas as traced f32 so schedules never retrace)
+    always count as nonzero and the term compiles in — multiplying by a
+    runtime 0.0 is then a numeric no-op.
+    """
+    return not (isinstance(x, (int, float)) and x == 0.0)
+
+
 def _or_flags(noop_flag, flags):
     out = noop_flag
     for f in flags:
@@ -165,7 +176,7 @@ def multi_tensor_sgd(noop_flag, tensor_lists, wd, momentum, dampening, lr,
         gf = g.astype(_f32) * jnp.asarray(scale, _f32)
         pf = p.astype(_f32)
         mf = m.astype(_f32)
-        if wd != 0.0 and not wd_after_momentum:
+        if _static_nonzero(wd) and not wd_after_momentum:
             gf = gf + wd * pf
         if momentum != 0.0:
             if first_run:
@@ -175,7 +186,7 @@ def multi_tensor_sgd(noop_flag, tensor_lists, wd, momentum, dampening, lr,
             upd = gf + momentum * mf if nesterov else mf
         else:
             upd = gf
-        if wd != 0.0 and wd_after_momentum:
+        if _static_nonzero(wd) and wd_after_momentum:
             upd = upd + wd * pf
         pf = pf - lr * upd
         new_ps.append(jnp.where(skip, p, pf.astype(p.dtype)))
@@ -225,12 +236,12 @@ def multi_tensor_adam(noop_flag, tensor_lists, lr, beta1, beta2, eps, step,
     for g, p, m, v in zip(gs, ps, ms, vs):
         gf, pf = g.astype(_f32), p.astype(_f32)
         mf, vf = m.astype(_f32), v.astype(_f32)
-        if mode == ADAM_MODE_L2 and weight_decay != 0.0:
+        if mode == ADAM_MODE_L2 and _static_nonzero(weight_decay):
             gf = gf + weight_decay * pf
         mf = beta1 * mf + (1.0 - beta1) * gf
         vf = beta2 * vf + (1.0 - beta2) * gf * gf
         update = (mf / bc1) / (jnp.sqrt(vf / bc2) + eps)
-        if mode == ADAM_MODE_DECOUPLED and weight_decay != 0.0:
+        if mode == ADAM_MODE_DECOUPLED and _static_nonzero(weight_decay):
             update = update + weight_decay * pf
         pf = pf - lr * update
         new_ps.append(pf.astype(p.dtype))
@@ -345,12 +356,12 @@ def multi_tensor_lamb(noop_flag, tensor_lists, lr, beta1, beta2, eps, step,
     for g, p, m, v in zip(gs, ps, ms, vs):
         gf = g.astype(_f32) / clip
         pf, mf, vf = p.astype(_f32), m.astype(_f32), v.astype(_f32)
-        if mode == ADAM_MODE_L2 and weight_decay != 0.0:
+        if mode == ADAM_MODE_L2 and _static_nonzero(weight_decay):
             gf = gf + weight_decay * pf
         mf = beta1 * mf + beta3 * gf
         vf = beta2 * vf + (1.0 - beta2) * gf * gf
         u = (mf / bc1) / (jnp.sqrt(vf / bc2) + eps)
-        if mode == ADAM_MODE_DECOUPLED and weight_decay != 0.0:
+        if mode == ADAM_MODE_DECOUPLED and _static_nonzero(weight_decay):
             u = u + weight_decay * pf
         # stage 2: trust ratio (multi_tensor_lamb.cu:166)
         p_norm = jnp.sqrt(jnp.sum(pf * pf))

@@ -19,6 +19,14 @@ required = object()  # sentinel, as torch.optim.optimizer.required
 
 
 class Optimizer:
+    #: default for ``zero_grad(set_to_none=None)``.  The fused optimizers
+    #: consume gradients functionally through the step cache (grads are
+    #: inputs of the compiled step, never written back), so dropping them is
+    #: free while ``jnp.zeros_like`` per param per step is real allocation
+    #: churn — True is the effective default on the whole fused path (torch
+    #: >= 2.0 semantics; subclasses may override per instance).
+    set_grad_none: bool = True
+
     def __init__(self, params, defaults: Dict[str, Any]):
         self.defaults = defaults
         self.state: Dict[Parameter, Dict[str, Any]] = defaultdict(dict)
@@ -58,7 +66,9 @@ class Optimizer:
                              "parameter group")
         self.param_groups.append(param_group)
 
-    def zero_grad(self, set_to_none: bool = False):
+    def zero_grad(self, set_to_none: bool = None):
+        if set_to_none is None:
+            set_to_none = self.set_grad_none
         for group in self.param_groups:
             for p in group["params"]:
                 if set_to_none:
@@ -112,6 +122,66 @@ class Optimizer:
 
     def step(self, closure=None):
         raise NotImplementedError
+
+
+def group_buckets(param_groups):
+    """Eager-order ``(group_index, [Parameter, ...])`` dtype buckets across
+    ALL param groups — the unit the step-cache program compiles over (the
+    reference dispatches one kernel launch per group × dtype; the step cache
+    folds every bucket into one executable)."""
+    out = []
+    for gi, group in enumerate(param_groups):
+        for plist in split_by_dtype(group["params"]).values():
+            out.append((gi, plist))
+    return out
+
+
+def amp_model_copy_map(optimizer):
+    """master-Parameter-id → half model Parameter, when ``optimizer`` has
+    been processed by amp with master weights.  Lets the step cache emit the
+    master→model half copies from the SAME executable as the update (the
+    amp-patched ``step`` then skips its separate copyback pass).  None when
+    there is nothing to sync."""
+    stash = getattr(optimizer, "_amp_stash", None)
+    if stash is None or not getattr(stash, "lazy_init_called", False):
+        return None
+    masters = getattr(stash, "all_fp32_from_fp16_params", None)
+    if not masters:
+        return None
+    return {id(mp): hp for mp, hp in zip(masters, stash.all_fp16_params)}
+
+
+def dispatch_cached_step(optimizer, kind, static_cfg, update, donated, grads,
+                         hyper):
+    """Route one whole-optimizer step through the step cache.
+
+    When ``amp.initialize(..., defer_scale_update=True)`` handed this
+    optimizer a pending scaler (``_amp_stash._deferred_scaler``), the
+    overflow-conditional skip AND the dynamic-loss-scale update fuse into
+    the same executable with the scaler state donated; otherwise the plain
+    program conditions on the optimizer's own overflow buffer.
+    Returns the new donated tree; the caller rebinds every leaf.
+    """
+    from ..runtime import step_cache
+
+    stash = getattr(optimizer, "_amp_stash", None)
+    scaler = getattr(stash, "_deferred_scaler", None) if stash is not None \
+        else None
+    if scaler is not None:
+        scaler_cfg = (("dynamic", scaler.dynamic),
+                      ("scale_factor", scaler._scale_factor),
+                      ("scale_window", scaler._scale_seq_len),
+                      ("min_loss_scale", scaler._min_loss_scale),
+                      ("max_loss_scale", scaler._max_loss_scale))
+        new_state, new_donated = step_cache.optimizer_step_with_scaler(
+            kind, static_cfg, update, scaler.state, scaler_cfg, donated,
+            grads, hyper)
+        scaler.state = new_state
+        stash._deferred_scaler = None
+        return new_donated
+    return step_cache.optimizer_step(
+        kind, static_cfg, update, optimizer._overflow_buf, donated, grads,
+        hyper)
 
 
 def split_by_dtype(params: Iterable[Parameter]):

@@ -1,32 +1,56 @@
 """FusedAdam (reference: apex/optimizers/fused_adam.py) — Adam/AdamW with the
-whole per-dtype-bucket update compiled into one XLA executable."""
+ENTIRE step (every param group × dtype bucket, overflow-conditional skip,
+optional fused master→model half copy under amp) compiled into one XLA
+executable by the step cache (``apex_tpu.runtime.step_cache``).
+
+All scalar hyperparameters — lr, betas, eps, weight_decay, step — enter the
+program as traced device scalars, so lr/wd/beta schedules never retrace;
+params and both moments are donated, so steady-state stepping allocates
+nothing (the reference's ``multi_tensor_adam`` launch amortisation, taken to
+its XLA conclusion).
+"""
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
 from .. import ops
 from ..multi_tensor_apply import multi_tensor_applier
-from .base import Optimizer, split_by_dtype
+from .base import (Optimizer, amp_model_copy_map, dispatch_cached_step,
+                   group_buckets)
+
+_f32 = jnp.float32
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("beta1", "beta2", "eps", "mode", "bias_correction",
-                     "weight_decay"))
-def _adam_step(flag, lists, lr, step, beta1, beta2, eps, mode,
-               bias_correction, weight_decay):
-    return multi_tensor_applier(
-        ops.multi_tensor_adam, flag, lists, lr, beta1, beta2, eps, step,
-        mode, bias_correction, weight_decay)
+def _adam_update(static_cfg, donated, grads, hyper, flag):
+    """Pure whole-optimizer Adam/AdamW update; traced once per structure by
+    the step cache, then dispatched as one executable per step."""
+    mode, bucket_gis, bias_correction = static_cfg
+    new_steps = [s + 1 for s in donated["steps"]]
+    new_buckets = []
+    for entry, gs, gi in zip(donated["buckets"], grads, bucket_gis):
+        h = hyper[gi]
+        _, new_ps, new_ms, new_vs = multi_tensor_applier(
+            ops.multi_tensor_adam, flag,
+            [gs, entry["p"], entry["m"], entry["v"]],
+            h["lr"], h["beta1"], h["beta2"], h["eps"], new_steps[gi],
+            mode, bias_correction[gi], h["weight_decay"])
+        out = {"p": new_ps, "m": new_ms, "v": new_vs}
+        if "model" in entry:
+            out["model"] = [
+                None if mp is None else np_.astype(mp.dtype)
+                for np_, mp in zip(new_ps, entry["model"])]
+        new_buckets.append(out)
+    return {"steps": new_steps, "buckets": new_buckets}
 
 
 class FusedAdam(Optimizer):
     """Drop-in replacement for torch.optim.Adam / AdamW
     (``adam_w_mode=True`` selects decoupled weight decay, reference
     fused_adam.py:52-54,75)."""
+
+    # the step-cache program can fuse the deferred dynamic-scale update
+    # (amp.initialize(..., defer_scale_update=True))
+    _step_cache_scaler_ok = True
 
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
@@ -41,11 +65,6 @@ class FusedAdam(Optimizer):
         self.set_grad_none = set_grad_none
         self._overflow_buf = ops.zero_flag()
 
-    def zero_grad(self, set_to_none: bool = None):
-        if set_to_none is None:
-            set_to_none = self.set_grad_none
-        super().zero_grad(set_to_none)
-
     def step(self, closure=None, grads=None, output_params=None, scale=None,
              grad_norms=None):
         if any(x is not None for x in [grads, output_params, scale,
@@ -56,29 +75,57 @@ class FusedAdam(Optimizer):
                 "arguments.")
         loss = closure() if closure is not None else None
 
-        for group in self.param_groups:
-            bias_correction = bool(group["bias_correction"])
-            beta1, beta2 = group["betas"]
-            group["step"] = group.get("step", 0) + 1
+        buckets = group_buckets(self.param_groups)
+        if not buckets:
+            return loss
+        for _, plist in buckets:
+            for p in plist:
+                state = self.state[p]
+                if len(state) == 0:
+                    state["exp_avg"] = jnp.zeros_like(p.data)
+                    state["exp_avg_sq"] = jnp.zeros_like(p.data)
 
-            for dtype, plist in split_by_dtype(group["params"]).items():
-                for p in plist:
-                    state = self.state[p]
-                    if len(state) == 0:
-                        state["exp_avg"] = jnp.zeros_like(p.data)
-                        state["exp_avg_sq"] = jnp.zeros_like(p.data)
-                lists = [[p.grad for p in plist],
-                         [p.data for p in plist],
-                         [self.state[p]["exp_avg"] for p in plist],
-                         [self.state[p]["exp_avg_sq"] for p in plist]]
-                _, new_ps, new_ms, new_vs = _adam_step(
-                    self._overflow_buf, lists,
-                    jnp.asarray(group["lr"], jnp.float32),
-                    jnp.asarray(group["step"], jnp.int32),
-                    beta1, beta2, group["eps"], self.adam_w_mode,
-                    bias_correction, group["weight_decay"])
-                for p, nd, nm, nv in zip(plist, new_ps, new_ms, new_vs):
-                    p.data = nd
-                    self.state[p]["exp_avg"] = nm
-                    self.state[p]["exp_avg_sq"] = nv
+        model_map = amp_model_copy_map(self)
+        donated = {"steps": [jnp.asarray(g.get("step", 0), jnp.int32)
+                             for g in self.param_groups],
+                   "buckets": []}
+        grads_tree = []
+        for _, plist in buckets:
+            entry = {"p": [p.data for p in plist],
+                     "m": [self.state[p]["exp_avg"] for p in plist],
+                     "v": [self.state[p]["exp_avg_sq"] for p in plist]}
+            if model_map is not None:
+                models = [model_map.get(id(p)) for p in plist]
+                entry["model"] = [None if mp is None else mp.data
+                                  for mp in models]
+            donated["buckets"].append(entry)
+            grads_tree.append([p.grad for p in plist])
+
+        hyper = []
+        for group in self.param_groups:
+            beta1, beta2 = group["betas"]
+            hyper.append({
+                "lr": jnp.asarray(group["lr"], _f32),
+                "beta1": jnp.asarray(beta1, _f32),
+                "beta2": jnp.asarray(beta2, _f32),
+                "eps": jnp.asarray(group["eps"], _f32),
+                "weight_decay": jnp.asarray(group["weight_decay"], _f32)})
+
+        static_cfg = (self.adam_w_mode, tuple(gi for gi, _ in buckets),
+                      tuple(bool(g["bias_correction"])
+                            for g in self.param_groups))
+        new = dispatch_cached_step(self, "fused_adam", static_cfg,
+                                   _adam_update, donated, grads_tree, hyper)
+
+        for group, s in zip(self.param_groups, new["steps"]):
+            group["step"] = s
+        for (_, plist), entry in zip(buckets, new["buckets"]):
+            for i, p in enumerate(plist):
+                p.data = entry["p"][i]
+                self.state[p]["exp_avg"] = entry["m"][i]
+                self.state[p]["exp_avg_sq"] = entry["v"][i]
+                if model_map is not None and entry["model"][i] is not None:
+                    model_map[id(p)].data = entry["model"][i]
+        if model_map is not None:
+            self._amp_stash._model_params_synced = True
         return loss

@@ -1,38 +1,53 @@
 """FusedLAMB (reference: apex/optimizers/fused_lamb.py).
 
 As in the reference host function (csrc/multi_tensor_lamb.cu:241-247), the
-gradient norm for clipping is computed over the launched list — one fused
-program per dtype bucket: l2norm + stage1 + per-tensor norms + stage2.
+gradient norm for clipping is computed over the launched list — but here the
+per-bucket l2norm + stage1 + per-tensor norms + stage2 for EVERY group and
+dtype bucket compile into one step-cache executable with traced
+hyperparameters and donated params/moments.
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
 from .. import ops
 from ..multi_tensor_apply import multi_tensor_applier
-from .base import Optimizer, split_by_dtype
+from .base import (Optimizer, amp_model_copy_map, dispatch_cached_step,
+                   group_buckets)
+
+_f32 = jnp.float32
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("beta1", "beta2", "eps", "bias_correction",
-                     "weight_decay", "grad_averaging", "mode",
-                     "max_grad_norm"))
-def _lamb_step(flag, lists, lr, step, beta1, beta2, eps, bias_correction,
-               weight_decay, grad_averaging, mode, max_grad_norm):
-    flag, grad_norm, _ = ops.multi_tensor_l2norm(flag, [lists[0]])
-    return multi_tensor_applier(
-        ops.multi_tensor_lamb, flag, lists, lr, beta1, beta2, eps, step,
-        bias_correction, weight_decay, grad_averaging, mode, grad_norm,
-        max_grad_norm)
+def _lamb_update(static_cfg, donated, grads, hyper, flag):
+    """Pure whole-optimizer LAMB update (grad-norm clip per bucket, Adam
+    moments, per-tensor trust ratios) across every group × dtype bucket."""
+    mode, bucket_gis, bias_correction, grad_averaging, max_grad_norm = \
+        static_cfg
+    new_steps = [s + 1 for s in donated["steps"]]
+    new_buckets = []
+    for entry, gs, gi in zip(donated["buckets"], grads, bucket_gis):
+        h = hyper[gi]
+        _, grad_norm, _ = ops.multi_tensor_l2norm(flag, [gs])
+        _, new_ps, new_ms, new_vs = multi_tensor_applier(
+            ops.multi_tensor_lamb, flag,
+            [gs, entry["p"], entry["m"], entry["v"]],
+            h["lr"], h["beta1"], h["beta2"], h["eps"], new_steps[gi],
+            bias_correction[gi], h["weight_decay"], grad_averaging[gi],
+            mode, grad_norm, max_grad_norm[gi])
+        out = {"p": new_ps, "m": new_ms, "v": new_vs}
+        if "model" in entry:
+            out["model"] = [
+                None if mp is None else np_.astype(mp.dtype)
+                for np_, mp in zip(new_ps, entry["model"])]
+        new_buckets.append(out)
+    return {"steps": new_steps, "buckets": new_buckets}
 
 
 class FusedLAMB(Optimizer):
     """LAMB with global-grad-norm clipping and per-tensor trust ratios
     (reference fused_lamb.py:4,92-175)."""
+
+    _step_cache_scaler_ok = True
 
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
@@ -50,39 +65,63 @@ class FusedLAMB(Optimizer):
         self.set_grad_none = set_grad_none
         self._overflow_buf = ops.zero_flag()
 
-    def zero_grad(self, set_to_none: bool = None):
-        if set_to_none is None:
-            set_to_none = self.set_grad_none
-        super().zero_grad(set_to_none)
-
     def step(self, closure=None):
         loss = closure() if closure is not None else None
 
-        for group in self.param_groups:
-            bias_correction = bool(group["bias_correction"])
-            beta1, beta2 = group["betas"]
-            grad_averaging = 1 if group["grad_averaging"] else 0
-            group["step"] = group.get("step", 0) + 1
+        buckets = group_buckets(self.param_groups)
+        if not buckets:
+            return loss
+        for _, plist in buckets:
+            for p in plist:
+                state = self.state[p]
+                if len(state) == 0:
+                    state["exp_avg"] = jnp.zeros_like(p.data)
+                    state["exp_avg_sq"] = jnp.zeros_like(p.data)
 
-            for dtype, plist in split_by_dtype(group["params"]).items():
-                for p in plist:
-                    state = self.state[p]
-                    if len(state) == 0:
-                        state["exp_avg"] = jnp.zeros_like(p.data)
-                        state["exp_avg_sq"] = jnp.zeros_like(p.data)
-                lists = [[p.grad for p in plist],
-                         [p.data for p in plist],
-                         [self.state[p]["exp_avg"] for p in plist],
-                         [self.state[p]["exp_avg_sq"] for p in plist]]
-                _, new_ps, new_ms, new_vs = _lamb_step(
-                    self._overflow_buf, lists,
-                    jnp.asarray(group["lr"], jnp.float32),
-                    jnp.asarray(group["step"], jnp.int32),
-                    beta1, beta2, group["eps"], bias_correction,
-                    group["weight_decay"], grad_averaging, self.adam_w_mode,
-                    group["max_grad_norm"])
-                for p, nd, nm, nv in zip(plist, new_ps, new_ms, new_vs):
-                    p.data = nd
-                    self.state[p]["exp_avg"] = nm
-                    self.state[p]["exp_avg_sq"] = nv
+        model_map = amp_model_copy_map(self)
+        donated = {"steps": [jnp.asarray(g.get("step", 0), jnp.int32)
+                             for g in self.param_groups],
+                   "buckets": []}
+        grads_tree = []
+        for _, plist in buckets:
+            entry = {"p": [p.data for p in plist],
+                     "m": [self.state[p]["exp_avg"] for p in plist],
+                     "v": [self.state[p]["exp_avg_sq"] for p in plist]}
+            if model_map is not None:
+                entry["model"] = [
+                    None if model_map.get(id(p)) is None
+                    else model_map[id(p)].data for p in plist]
+            donated["buckets"].append(entry)
+            grads_tree.append([p.grad for p in plist])
+
+        hyper = []
+        for group in self.param_groups:
+            beta1, beta2 = group["betas"]
+            hyper.append({
+                "lr": jnp.asarray(group["lr"], _f32),
+                "beta1": jnp.asarray(beta1, _f32),
+                "beta2": jnp.asarray(beta2, _f32),
+                "eps": jnp.asarray(group["eps"], _f32),
+                "weight_decay": jnp.asarray(group["weight_decay"], _f32)})
+
+        static_cfg = (self.adam_w_mode, tuple(gi for gi, _ in buckets),
+                      tuple(bool(g["bias_correction"])
+                            for g in self.param_groups),
+                      tuple(1 if g["grad_averaging"] else 0
+                            for g in self.param_groups),
+                      tuple(g["max_grad_norm"] for g in self.param_groups))
+        new = dispatch_cached_step(self, "fused_lamb", static_cfg,
+                                   _lamb_update, donated, grads_tree, hyper)
+
+        for group, s in zip(self.param_groups, new["steps"]):
+            group["step"] = s
+        for (_, plist), entry in zip(buckets, new["buckets"]):
+            for i, p in enumerate(plist):
+                p.data = entry["p"][i]
+                self.state[p]["exp_avg"] = entry["m"][i]
+                self.state[p]["exp_avg_sq"] = entry["v"][i]
+                if model_map is not None and entry["model"][i] is not None:
+                    model_map[id(p)].data = entry["model"][i]
+        if model_map is not None:
+            self._amp_stash._model_params_synced = True
         return loss

@@ -5,32 +5,49 @@ one-per-param in ``self.state[p]["exp_avg_sq"]`` (a scalar) instead of two
 flat per-group tensors (``group['exp_avg_sq'][0/1]``, fused_novograd.py:158-177)
 — same math, but state_dict round-trips through the standard per-param
 packing and a third bf16 bucket needs no special casing.
+
+The whole step (all groups × dtype buckets) runs as one step-cache
+executable with traced hyperparameters and donated params/moments/norms;
+the first-step norm seed stays eager (it happens exactly once).
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
 from .. import ops
 from ..multi_tensor_apply import multi_tensor_applier
-from .base import Optimizer, split_by_dtype
+from .base import (Optimizer, amp_model_copy_map, dispatch_cached_step,
+                   group_buckets)
+
+_f32 = jnp.float32
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("beta1", "beta2", "eps", "bias_correction",
-                     "weight_decay", "grad_averaging", "moment_mode",
-                     "norm_type"))
-def _novograd_step(flag, lists, lr, step, beta1, beta2, eps, bias_correction,
-                   weight_decay, grad_averaging, moment_mode, norm_type):
-    return multi_tensor_applier(
-        ops.multi_tensor_novograd, flag, lists, lr, beta1, beta2, eps, step,
-        bias_correction, weight_decay, grad_averaging, moment_mode, norm_type)
+def _novograd_update(static_cfg, donated, grads, hyper, flag):
+    """Pure whole-optimizer NovoGrad update across every group × bucket."""
+    bucket_gis, bias_correction, grad_averaging, moment_mode, norm_type = \
+        static_cfg
+    new_steps = [s + 1 for s in donated["steps"]]
+    new_buckets = []
+    for entry, gs, gi in zip(donated["buckets"], grads, bucket_gis):
+        h = hyper[gi]
+        _, new_ps, new_ms, new_norms = multi_tensor_applier(
+            ops.multi_tensor_novograd, flag,
+            [gs, entry["p"], entry["m"], entry["v"]],
+            h["lr"], h["beta1"], h["beta2"], h["eps"], new_steps[gi],
+            bias_correction[gi], h["weight_decay"], grad_averaging[gi],
+            moment_mode, norm_type[gi])
+        out = {"p": new_ps, "m": new_ms, "v": new_norms}
+        if "model" in entry:
+            out["model"] = [
+                None if mp is None else np_.astype(mp.dtype)
+                for np_, mp in zip(new_ps, entry["model"])]
+        new_buckets.append(out)
+    return {"steps": new_steps, "buckets": new_buckets}
 
 
 class FusedNovoGrad(Optimizer):
+    _step_cache_scaler_ok = True
+
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.95, 0.98), eps=1e-8, weight_decay=0.0,
                  amsgrad=False, reg_inside_moment=False, grad_averaging=True,
@@ -49,11 +66,6 @@ class FusedNovoGrad(Optimizer):
         self.set_grad_none = set_grad_none
         self._overflow_buf = ops.zero_flag()
 
-    def zero_grad(self, set_to_none: bool = None):
-        if set_to_none is None:
-            set_to_none = self.set_grad_none
-        super().zero_grad(set_to_none)
-
     def _init_norm(self, p, group):
         """First-step norm init so the first blend is a no-op, or zero
         (reference fused_novograd.py:158-174)."""
@@ -69,32 +81,64 @@ class FusedNovoGrad(Optimizer):
     def step(self, closure=None):
         loss = closure() if closure is not None else None
 
-        for group in self.param_groups:
-            bias_correction = bool(group["bias_correction"])
-            beta1, beta2 = group["betas"]
-            grad_averaging = 1 if group["grad_averaging"] else 0
-            group["step"] = group.get("step", 0) + 1
+        buckets = group_buckets(self.param_groups)
+        if not buckets:
+            return loss
+        for gi, plist in buckets:
+            group = self.param_groups[gi]
+            for p in plist:
+                state = self.state[p]
+                if "exp_avg" not in state:
+                    state["exp_avg"] = jnp.zeros_like(p.data)
+                if "exp_avg_sq" not in state:
+                    state["exp_avg_sq"] = self._init_norm(p, group)
 
-            for dtype, plist in split_by_dtype(group["params"]).items():
-                for p in plist:
-                    state = self.state[p]
-                    if "exp_avg" not in state:
-                        state["exp_avg"] = jnp.zeros_like(p.data)
-                    if "exp_avg_sq" not in state:
-                        state["exp_avg_sq"] = self._init_norm(p, group)
-                lists = [[p.grad for p in plist],
-                         [p.data for p in plist],
-                         [self.state[p]["exp_avg"] for p in plist],
-                         [self.state[p]["exp_avg_sq"] for p in plist]]
-                _, new_ps, new_ms, new_norms = _novograd_step(
-                    self._overflow_buf, lists,
-                    jnp.asarray(group["lr"], jnp.float32),
-                    jnp.asarray(group["step"], jnp.int32),
-                    beta1, beta2, group["eps"], bias_correction,
-                    group["weight_decay"], grad_averaging, self.moment_mode,
-                    group["norm_type"])
-                for p, nd, nm, nv in zip(plist, new_ps, new_ms, new_norms):
-                    p.data = nd
-                    self.state[p]["exp_avg"] = nm
-                    self.state[p]["exp_avg_sq"] = nv
+        model_map = amp_model_copy_map(self)
+        donated = {"steps": [jnp.asarray(g.get("step", 0), jnp.int32)
+                             for g in self.param_groups],
+                   "buckets": []}
+        grads_tree = []
+        for _, plist in buckets:
+            entry = {"p": [p.data for p in plist],
+                     "m": [self.state[p]["exp_avg"] for p in plist],
+                     "v": [self.state[p]["exp_avg_sq"] for p in plist]}
+            if model_map is not None:
+                entry["model"] = [
+                    None if model_map.get(id(p)) is None
+                    else model_map[id(p)].data for p in plist]
+            donated["buckets"].append(entry)
+            grads_tree.append([p.grad for p in plist])
+
+        hyper = []
+        for group in self.param_groups:
+            beta1, beta2 = group["betas"]
+            hyper.append({
+                "lr": jnp.asarray(group["lr"], _f32),
+                "beta1": jnp.asarray(beta1, _f32),
+                "beta2": jnp.asarray(beta2, _f32),
+                "eps": jnp.asarray(group["eps"], _f32),
+                "weight_decay": jnp.asarray(group["weight_decay"], _f32)})
+
+        static_cfg = (tuple(gi for gi, _ in buckets),
+                      tuple(bool(g["bias_correction"])
+                            for g in self.param_groups),
+                      tuple(1 if g["grad_averaging"] else 0
+                            for g in self.param_groups),
+                      self.moment_mode,
+                      tuple(g["norm_type"] for g in self.param_groups))
+        new = dispatch_cached_step(self, "fused_novograd", static_cfg,
+                                   _novograd_update, donated, grads_tree,
+                                   hyper)
+
+        for group, s in zip(self.param_groups, new["steps"]):
+            group["step"] = s
+        for (_, plist), entry in zip(buckets, new["buckets"]):
+            for i, p in enumerate(plist):
+                p.data = entry["p"][i]
+                self.state[p]["exp_avg"] = entry["m"][i]
+                self.state[p]["exp_avg_sq"] = entry["v"][i]
+                if model_map is not None and entry["model"][i] is not None:
+                    model_map[id(p)].data = entry["model"][i]
+        if model_map is not None:
+            self._amp_stash._model_params_synced = True
         return loss

@@ -1,31 +1,47 @@
 """FusedSGD (reference: apex/optimizers/fused_sgd.py).
 
-The whole per-dtype-bucket update — momentum, weight decay, nesterov, grad
-unscale via ``scale``, and the optional half model-copy writeback — compiles
-into one XLA executable per bucket structure (the reference batches it into
-one ``multi_tensor_sgd`` launch; XLA fuses the same way).
+The whole step — momentum, weight decay, nesterov, grad unscale via
+``scale``, and the optional half model-copy writeback for EVERY launch set —
+compiles into one step-cache executable with lr/weight_decay/dampening/scale
+traced (schedules never retrace) and params/momenta donated.  ``momentum``,
+``nesterov`` and ``first_run`` shape the program and stay static;
+``first_run`` flips False after the first step, so an SGD instance compiles
+exactly twice over its lifetime (the reference re-launches kernels every
+step).
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
 from .. import ops
 from ..multi_tensor_apply import multi_tensor_applier
-from .base import Optimizer, required, split_by_dtype
+from .base import Optimizer, dispatch_cached_step, required, split_by_dtype
+
+_f32 = jnp.float32
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("weight_decay", "momentum", "dampening", "nesterov",
-                     "first_run", "wd_after_momentum"))
-def _sgd_step(flag, lists, lr, scale, weight_decay, momentum, dampening,
-              nesterov, first_run, wd_after_momentum):
-    return multi_tensor_applier(
-        ops.multi_tensor_sgd, flag, lists, weight_decay, momentum, dampening,
-        lr, nesterov, first_run, wd_after_momentum, scale)
+def _sgd_update(static_cfg, donated, grads, hyper, flag):
+    """Pure whole-optimizer SGD update over every launch set."""
+    set_infos, wd_after_momentum, group_static = static_cfg
+    new_sets = []
+    for entry, gs, (gid, first_run, has_model) in zip(
+            donated["sets"], grads, set_infos):
+        h = hyper["groups"][gid]
+        momentum, dampening, nesterov = group_static[gid]
+        lists = [gs, entry["p"], entry["m"]]
+        if has_model:
+            lists.append(entry["model"])
+        out = multi_tensor_applier(
+            ops.multi_tensor_sgd, flag, lists, h["weight_decay"], momentum,
+            dampening, h["lr"], nesterov, first_run, wd_after_momentum,
+            hyper["scale"])
+        if has_model:
+            _, new_ps, new_ms, new_model = out
+            new_sets.append({"p": new_ps, "m": new_ms, "model": new_model})
+        else:
+            _, new_ps, new_ms = out
+            new_sets.append({"p": new_ps, "m": new_ms})
+    return {"sets": new_sets}
 
 
 class FusedSGD(Optimizer):
@@ -81,17 +97,12 @@ class FusedSGD(Optimizer):
             hasattr(self, "_amp_stash")
             and hasattr(self._amp_stash, "fp32_from_fp16_groups"))
 
+        launch_params: list = []   # parallel to launch sets
+        launch_sets: list = []
+        set_infos: list = []       # (group_index, first_run, has_model)
+        model_param_sets: list = []
+
         for gid, group in enumerate(self.param_groups):
-            wd = group["weight_decay"]
-            momentum = group["momentum"]
-            dampening = group["dampening"]
-            nesterov = group["nesterov"]
-
-            launch_params: list = []   # parallel to launch sets
-            launch_sets: list = []
-            first_runs: list = []
-            model_param_sets: list = []
-
             if explicit_master_params:
                 stash = self._amp_stash
 
@@ -124,13 +135,13 @@ class FusedSGD(Optimizer):
                                         [p.data for p in fp16_model]])
                 launch_params.append(masters)
                 model_param_sets.append(fp16_model)
-                first_runs.append(fr16)
+                set_infos.append((gid, fr16, True))
 
                 launch_sets.append([fp32_grads,
                                     [p.data for p in fp32_params], fp32_mom])
                 launch_params.append(fp32_params)
                 model_param_sets.append(None)
-                first_runs.append(fr32)
+                set_infos.append((gid, fr32, False))
             else:
                 for dtype, plist in split_by_dtype(group["params"]).items():
                     moms, fr = self.get_momentums(plist)
@@ -138,27 +149,48 @@ class FusedSGD(Optimizer):
                                         [p.data for p in plist], moms])
                     launch_params.append(plist)
                     model_param_sets.append(None)
-                    first_runs.append(fr)
+                    set_infos.append((gid, fr, False))
 
-            for plist, launch_set, model_plist, first_run in zip(
-                    launch_params, launch_sets, model_param_sets, first_runs):
-                if not launch_set[0]:
-                    continue
-                out = _sgd_step(
-                    self._overflow_buf, launch_set,
-                    jnp.asarray(group["lr"], jnp.float32),
-                    jnp.asarray(1.0 / self.most_recent_scale, jnp.float32),
-                    wd, momentum, dampening, nesterov, first_run,
-                    self.wd_after_momentum)
-                if model_plist is not None:
-                    _, new_ps, new_ms, new_model = out
-                    for mp, nd in zip(model_plist, new_model):
-                        mp.data = nd
-                else:
-                    _, new_ps, new_ms = out
-                for p, nd, nm in zip(plist, new_ps, new_ms):
-                    p.data = nd
-                    self.state[p]["momentum_buffer"] = nm
+        # drop empty launch sets (their static info goes with them)
+        keep = [i for i, ls in enumerate(launch_sets) if ls[0]]
+        launch_sets = [launch_sets[i] for i in keep]
+        launch_params = [launch_params[i] for i in keep]
+        model_param_sets = [model_param_sets[i] for i in keep]
+        set_infos = [set_infos[i] for i in keep]
+        if not launch_sets:
+            self.most_recent_scale = 1.0
+            self.scale_set_by_backward = False
+            return loss
+
+        donated = {"sets": []}
+        grads_tree = []
+        for ls, (gid, fr, has_model) in zip(launch_sets, set_infos):
+            entry = {"p": ls[1], "m": ls[2]}
+            if has_model:
+                entry["model"] = ls[3]
+            donated["sets"].append(entry)
+            grads_tree.append(ls[0])
+
+        hyper = {"groups": [
+            {"lr": jnp.asarray(g["lr"], _f32),
+             "weight_decay": jnp.asarray(g["weight_decay"], _f32)}
+            for g in self.param_groups],
+            "scale": jnp.asarray(1.0 / self.most_recent_scale, _f32)}
+
+        static_cfg = (tuple(set_infos), self.wd_after_momentum,
+                      tuple((g["momentum"], g["dampening"], g["nesterov"])
+                            for g in self.param_groups))
+        new = dispatch_cached_step(self, "fused_sgd", static_cfg,
+                                   _sgd_update, donated, grads_tree, hyper)
+
+        for plist, model_plist, entry in zip(launch_params, model_param_sets,
+                                             new["sets"]):
+            for i, p in enumerate(plist):
+                p.data = entry["p"][i]
+                self.state[p]["momentum_buffer"] = entry["m"][i]
+            if model_plist is not None:
+                for mp, nd in zip(model_plist, entry["model"]):
+                    mp.data = nd
 
         self.most_recent_scale = 1.0
         self.scale_set_by_backward = False

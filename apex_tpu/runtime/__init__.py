@@ -15,6 +15,9 @@ Public surface:
   f32_to_bf16(x)                            — bulk host cast (RNE)
   available()                               — True when the native lib loads
   DataPrefetcher                            — apex_tpu.runtime.data
+  step_cache                                — compiled step-program cache for
+                                              the eager optimizer surface
+                                              (apex_tpu.runtime.step_cache)
 """
 from __future__ import annotations
 
@@ -210,7 +213,8 @@ def f32_to_bf16(x, threads: int = 0):
 
 
 from .data import DataPrefetcher  # noqa: E402,F401
+from . import step_cache  # noqa: E402,F401
 
 __all__ = ["flatten", "unflatten", "normalize_u8_nhwc_to_f32_nchw",
            "normalize_u8_nhwc_to_f32_nhwc", "f32_to_bf16", "available",
-           "DataPrefetcher"]
+           "DataPrefetcher", "step_cache"]

@@ -121,3 +121,18 @@ def test_trained_draft_raises_spec_acceptance():
     acc_trained = stats1["draft_acceptance"]
     assert acc_trained > max(0.5, acc_random + 0.3), \
         (acc_random, acc_trained)
+
+
+def test_opt_microbench_records_schema():
+    """--opt-microbench stage: runs on the cpu backend and emits the
+    step_cache / per_bucket / schedule-retrace arms plus a speedup line."""
+    recs = bench.opt_microbench_records(sizes=(4096,), n_tensors=4,
+                                        warmup=1, timed_steps=2)
+    modes = {r["mode"] for r in recs if r["metric"] == "opt_step_us"}
+    assert modes == {"step_cache", "per_bucket",
+                     "per_bucket_wd_schedule_retrace"}
+    assert all(r["opt_step_us"] > 0 for r in recs
+               if r["metric"] == "opt_step_us")
+    (speedup,) = [r for r in recs if r["metric"] == "opt_step_us_speedup"]
+    assert speedup["value"] > 0
+    assert speedup["step_cache_stats"]["compiles"] >= 1

@@ -1,0 +1,395 @@
+"""Step-cache regression tests (apex_tpu/runtime/step_cache.py).
+
+Pins the three tentpole properties of the eager optimizer surface:
+* ONE XLA compile per optimizer across many steps even under lr AND
+  weight-decay schedules (hyperparameters are traced device scalars);
+* numerics bitwise-identical to the pre-cache per-dtype-bucket dispatch
+  (the old ``_adam_step`` per-bucket jit) across the fp32/bf16/fp16
+  storage cross-product;
+* buffer donation on params/optimizer state reflected as input→output
+  aliasing in the lowered HLO.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import ops
+from apex_tpu.nn import Parameter
+from apex_tpu.optimizers import FusedAdam, FusedLAMB, FusedNovoGrad, FusedSGD
+from apex_tpu.runtime import step_cache
+
+SHAPES = [(7,), (5, 3)]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    step_cache.clear()
+    step_cache.reset_stats()
+    yield
+    step_cache.clear()
+    step_cache.reset_stats()
+
+
+def _params(rng, dtypes=(jnp.float32,)):
+    out = []
+    for dtype in dtypes:
+        for s in SHAPES:
+            p = Parameter(jnp.asarray(rng.standard_normal(s), dtype))
+            p.grad = jnp.asarray(rng.standard_normal(s), dtype)
+            out.append(p)
+    return out
+
+
+def _regrad(params, rngs):
+    for p in params:
+        p.grad = jnp.asarray(rngs.standard_normal(p.shape), p.dtype)
+
+
+# ---------------------------------------------------------------------------
+# retrace regression: 1 compile across >= 10 scheduled steps
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("make_opt,kind,expected_compiles", [
+    (lambda ps: FusedAdam(ps, lr=1e-2, weight_decay=0.01), "fused_adam", 1),
+    (lambda ps: FusedLAMB(ps, lr=1e-2, weight_decay=0.01), "fused_lamb", 1),
+    (lambda ps: FusedNovoGrad(ps, lr=1e-2, weight_decay=0.01),
+     "fused_novograd", 1),
+    # FusedSGD compiles exactly twice over its lifetime: the static
+    # first_run flag flips False after the first step
+    (lambda ps: FusedSGD(ps, lr=1e-2, momentum=0.9, weight_decay=0.01),
+     "fused_sgd", 2),
+])
+def test_one_compile_under_lr_and_wd_schedule(rng, make_opt, kind,
+                                              expected_compiles):
+    params = _params(rng)
+    opt = make_opt(params)
+    rngs = np.random.default_rng(7)
+    step_cache.reset_stats()
+    for i in range(10):
+        # cosine lr schedule AND a weight-decay schedule: both are traced
+        # scalars, neither may retrace
+        opt.param_groups[0]["lr"] = 1e-2 * 0.5 * (1 + math.cos(math.pi * i / 10))
+        opt.param_groups[0]["weight_decay"] = 0.01 * (1 + i / 10.0)
+        opt.step()
+        _regrad(params, rngs)
+    s = step_cache.stats()
+    assert s["by_kind"][kind]["compiles"] == expected_compiles
+    assert s["by_kind"][kind]["dispatches"] == 10
+    for p in params:
+        assert bool(jnp.isfinite(p.data.astype(jnp.float32)).all())
+
+
+def test_jit_cache_agrees_with_stats(rng):
+    """The cache key covers everything jit retraces on: the one cached
+    program's internal jit cache holds exactly one entry after 10 steps."""
+    params = _params(rng)
+    opt = FusedAdam(params, lr=1e-2)
+    rngs = np.random.default_rng(3)
+    for i in range(10):
+        opt.param_groups[0]["lr"] = 1e-2 / (i + 1)
+        opt.step()
+        _regrad(params, rngs)
+    (entry,) = [e for e in step_cache.step_cache.entries()
+                if e["kind"] == "fused_adam"]
+    assert entry["fn"]._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# numerics: bitwise-identical to the pre-cache per-bucket dispatch
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("mode", "bias_correction"))
+def _prebucket_adam_step(flag, lists, lr, step, beta1, beta2, eps, mode,
+                         bias_correction, weight_decay):
+    """The pre-cache dispatch shape — one jitted executable per dtype
+    bucket (old fused_adam.py:15-24) — with satellite-1's traced-scalar fix
+    applied (betas/eps/wd enter traced, as they now do everywhere)."""
+    return ops.multi_tensor_adam(flag, lists, lr, beta1, beta2, eps, step,
+                                 mode, bias_correction, weight_decay)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("beta1", "beta2", "eps", "mode", "bias_correction",
+                     "weight_decay"))
+def _prebucket_adam_step_static(flag, lists, lr, step, beta1, beta2, eps,
+                                mode, bias_correction, weight_decay):
+    """The ORIGINAL pre-cache dispatch with static hyperparameters (the
+    retracing bug satellite 1 removes).  Differs from the traced path by at
+    most 1 ulp in the beta complements: ``1.0 - 0.9`` rounds differently
+    computed in host double vs on-device f32."""
+    return ops.multi_tensor_adam(flag, lists, lr, beta1, beta2, eps, step,
+                                 mode, bias_correction, weight_decay)
+
+
+def _run_prebucket_path(params0, grads0, n_steps, lr_of, wd, dtype,
+                        static_hyper, betas=(0.9, 0.999), eps=1e-8):
+    ps = [jnp.asarray(w, dtype) for w in params0]
+    gs = [jnp.asarray(g, dtype) for g in grads0]
+    ms = [jnp.zeros_like(p) for p in ps]
+    vs = [jnp.zeros_like(p) for p in ps]
+    flag = ops.zero_flag()
+    rngs = np.random.default_rng(999)
+    for i in range(n_steps):
+        if static_hyper:
+            _, ps, ms, vs = _prebucket_adam_step_static(
+                flag, [gs, ps, ms, vs], jnp.asarray(lr_of(i), jnp.float32),
+                jnp.asarray(i + 1, jnp.int32), betas[0], betas[1], eps, 1,
+                True, wd)
+        else:
+            _, ps, ms, vs = _prebucket_adam_step(
+                flag, [gs, ps, ms, vs], jnp.asarray(lr_of(i), jnp.float32),
+                jnp.asarray(i + 1, jnp.int32),
+                jnp.asarray(betas[0], jnp.float32),
+                jnp.asarray(betas[1], jnp.float32),
+                jnp.asarray(eps, jnp.float32), 1, True,
+                jnp.asarray(wd, jnp.float32))
+        gs = [jnp.asarray(rngs.standard_normal(p.shape), dtype) for p in ps]
+    return ps
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.float16])
+def test_numerics_identical_to_prebucket_path(rng, dtype):
+    ws = [rng.standard_normal(s).astype(np.float32) for s in SHAPES]
+    gs = [rng.standard_normal(s).astype(np.float32) for s in SHAPES]
+    lr_of = lambda i: 1e-2 * 0.5 * (1 + math.cos(math.pi * i / 6))  # noqa: E731
+
+    params = []
+    for w, g in zip(ws, gs):
+        p = Parameter(jnp.asarray(w, dtype))
+        p.grad = jnp.asarray(g, dtype)
+        params.append(p)
+    opt = FusedAdam(params, lr=1e-2, weight_decay=0.01)
+    rngs = np.random.default_rng(999)
+    for i in range(6):
+        opt.param_groups[0]["lr"] = lr_of(i)
+        opt.step()
+        _regrad(params, rngs)
+
+    # bitwise vs the per-bucket dispatch: folding every bucket into one
+    # donated executable (plus the lax.cond skip) changes NOTHING numerically
+    ref = _run_prebucket_path(ws, gs, 6, lr_of, 0.01, dtype,
+                              static_hyper=False)
+    for p, r in zip(params, ref):
+        np.testing.assert_array_equal(np.asarray(p.data), np.asarray(r))
+
+    # and within float tolerance of the original static-hyper dispatch (the
+    # only delta is the documented 1-ulp beta-complement rounding)
+    ref_static = _run_prebucket_path(ws, gs, 6, lr_of, 0.01, dtype,
+                                     static_hyper=True)
+    for p, r in zip(params, ref_static):
+        np.testing.assert_allclose(
+            np.asarray(p.data, np.float32), np.asarray(r, np.float32),
+            rtol=1e-5, atol=1e-6)
+
+
+def test_mixed_dtype_buckets_one_executable(rng):
+    """fp32+bf16+fp16 params in one optimizer: still one compile, each
+    bucket bitwise-identical to its own pre-cache dispatch."""
+    dtypes = (jnp.float32, jnp.bfloat16, jnp.float16)
+    params = _params(rng, dtypes)
+    opt = FusedAdam(params, lr=1e-2, weight_decay=0.01)
+    rngs = np.random.default_rng(5)
+    step_cache.reset_stats()
+    for _ in range(5):
+        opt.step()
+        _regrad(params, rngs)
+    s = step_cache.stats()["by_kind"]["fused_adam"]
+    assert s["compiles"] == 1 and s["dispatches"] == 5
+    for p in params:
+        assert p.dtype in dtypes
+        assert bool(jnp.isfinite(p.data.astype(jnp.float32)).all())
+
+
+# ---------------------------------------------------------------------------
+# donation: input→output aliasing visible in the lowered HLO
+# ---------------------------------------------------------------------------
+
+def test_donation_alias_in_lowered_hlo(rng):
+    # donation is "auto" (off on the copy-on-donate cpu backend); force it
+    # on to inspect the aliasing the accelerator path compiles with
+    step_cache.set_donation(True)
+    try:
+        params = _params(rng)
+        opt = FusedAdam(params, lr=1e-2)
+        opt.step()
+        (entry,) = [e for e in step_cache.step_cache.entries()
+                    if e["kind"] == "fused_adam"]
+        txt = entry["fn"].lower(*entry["example"]).as_text()
+        # donated leaves: params + exp_avg + exp_avg_sq per bucket + the
+        # step counter — every one must alias an output buffer
+        n_donated = 3 * len(params) + 1
+        assert txt.count("tf.aliasing_output") >= n_donated
+    finally:
+        step_cache.set_donation("auto")
+
+
+def test_sgd_momentum_buffers_donated(rng):
+    step_cache.set_donation(True)
+    try:
+        params = _params(rng)
+        opt = FusedSGD(params, lr=0.1, momentum=0.9)
+        opt.step()
+        entries = [e for e in step_cache.step_cache.entries()
+                   if e["kind"] == "fused_sgd"]
+        txt = entries[0]["fn"].lower(*entries[0]["example"]).as_text()
+        assert txt.count("tf.aliasing_output") >= 2 * len(params)
+    finally:
+        step_cache.set_donation("auto")
+
+
+def test_donation_auto_off_on_cpu(rng):
+    """Under the cpu test backend the auto policy must NOT donate: XLA cpu
+    accepts donate_argnums but degrades it to defensive copies (~2x step
+    time), so the compiled program carries no aliasing."""
+    assert step_cache.donation_enabled() is False
+    params = _params(rng)
+    opt = FusedAdam(params, lr=1e-2)
+    opt.step()
+    (entry,) = [e for e in step_cache.step_cache.entries()
+                if e["kind"] == "fused_adam"]
+    txt = entry["fn"].lower(*entry["example"]).as_text()
+    assert "tf.aliasing_output" not in txt
+
+
+# ---------------------------------------------------------------------------
+# satellite: zero_grad drops grads on the fused path (no zeros_like churn)
+# ---------------------------------------------------------------------------
+
+def test_zero_grad_drops_grads_by_default(rng):
+    for make in (lambda ps: FusedSGD(ps, lr=0.1),
+                 lambda ps: FusedAdam(ps, lr=1e-3),
+                 lambda ps: FusedLAMB(ps, lr=1e-3),
+                 lambda ps: FusedNovoGrad(ps, lr=1e-3)):
+        params = _params(rng)
+        opt = make(params)
+        opt.zero_grad()
+        assert all(p.grad is None for p in params)
+
+
+def test_zero_grad_explicit_false_still_zeroes(rng):
+    params = _params(rng)
+    opt = FusedSGD(params, lr=0.1)
+    opt.zero_grad(set_to_none=False)
+    for p in params:
+        assert p.grad is not None
+        np.testing.assert_array_equal(np.asarray(p.grad), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# amp integration: fused master→model copy + deferred scale update
+# ---------------------------------------------------------------------------
+
+def _amp_reset():
+    from apex_tpu.amp._amp_state import reset
+    reset()
+
+
+def _small_train(defer, steps=4, sabotage_at=None):
+    import apex_tpu.nn as nn
+    from apex_tpu import amp
+    from apex_tpu.amp._amp_state import _amp_state
+
+    _amp_reset()
+    nn.manual_seed(7)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = FusedAdam(list(model.parameters()), lr=1e-3)
+    kw = {"defer_scale_update": True} if defer else {}
+    model, opt = amp.initialize(model, opt, opt_level="O2", verbosity=0, **kw)
+    crit = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (8,)))
+    losses = []
+    for i in range(steps):
+        out = model(x)
+        loss = crit(out, y)
+        with amp.scale_loss(loss, opt) as scaled:
+            scaled.backward()
+            if sabotage_at == i:
+                p16 = opt._amp_stash.all_fp16_params[0]
+                p16.grad = p16.grad.at[(0,) * p16.grad.ndim].set(np.inf)
+        opt.step()
+        opt.zero_grad()
+        losses.append(float(loss))
+    scaler = _amp_state.loss_scalers[0]
+    _amp_reset()
+    return model, opt, losses, scaler
+
+
+def test_amp_O2_fuses_model_copy_into_step():
+    """Under amp O2 the half model copies come out of the step executable —
+    no separate master→model program is ever dispatched."""
+    _, _, losses, _ = _small_train(defer=False)
+    assert losses[-1] < losses[0]
+    by_kind = step_cache.stats()["by_kind"]
+    assert "amp_master_to_model" not in by_kind
+    assert by_kind["fused_adam"]["dispatches"] == 4
+
+
+def test_deferred_scale_update_trains():
+    _, _, losses, scaler = _small_train(defer=True, steps=6)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # 6 clean steps: scale untouched, unskipped counted on device
+    assert scaler.loss_scale() == 2.0 ** 16
+    assert scaler._unskipped == 6
+
+
+def test_deferred_overflow_skips_on_device_and_halves_scale():
+    model, opt, _, scaler = _small_train(defer=True, steps=3, sabotage_at=2)
+    assert scaler.loss_scale() == 2.0 ** 15
+    # the skipped step must not advance the (device-side) step counter
+    assert int(opt.param_groups[0]["step"]) == 2
+
+
+def test_deferred_matches_sync_path_numerics():
+    m_sync, opt_sync, losses_sync, _ = _small_train(defer=False, steps=4)
+    params_sync = [np.asarray(p.data)
+                   for p in opt_sync.param_groups[0]["params"]]
+    m_def, opt_def, losses_def, _ = _small_train(defer=True, steps=4)
+    params_def = [np.asarray(p.data)
+                  for p in opt_def.param_groups[0]["params"]]
+    np.testing.assert_allclose(losses_sync, losses_def, rtol=1e-6)
+    for a, b in zip(params_sync, params_def):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# stats / observability
+# ---------------------------------------------------------------------------
+
+def test_stats_counters(rng):
+    params = _params(rng)
+    opt = FusedAdam(params, lr=1e-2)
+    rngs = np.random.default_rng(1)
+    step_cache.reset_stats()
+    for _ in range(3):
+        opt.step()
+        _regrad(params, rngs)
+    s = step_cache.stats()
+    assert s["compiles"] == 1
+    assert s["dispatches"] == 3
+    assert s["cache_hits"] == 2
+    assert s["programs"] == 1
+    # eager multi-tensor op calls happen only at trace time now: 1, not 3
+    assert s["multi_tensor_calls"] == 1
+
+
+def test_unscale_is_one_cached_program(rng):
+    from apex_tpu.amp.scaler import LossScaler
+    s = LossScaler(1024.0)
+    step_cache.reset_stats()
+    for _ in range(4):
+        grads = [jnp.asarray(rng.standard_normal((8,)), jnp.float16),
+                 jnp.asarray(rng.standard_normal((4, 4)), jnp.float16)]
+        masters = [jax.ShapeDtypeStruct((8,), jnp.float32),
+                   jax.ShapeDtypeStruct((4, 4), jnp.float32)]
+        out = s.unscale(grads, masters)
+        assert out[0].dtype == jnp.float32
+    st = step_cache.stats()["by_kind"]["amp_unscale"]
+    assert st["compiles"] == 1 and st["dispatches"] == 4
