@@ -52,6 +52,25 @@ def scale_loss(loss, optimizers, loss_id=0, model=None, delay_unscale=False,
 
     yield loss.float() * loss_scale
 
+    from ..runtime import chaos as _chaos
+    if _chaos.active() and _chaos.hook(
+            "amp.backward", loss_id=loss_id) == "nonfinite_grads":
+        # chaos: poison every produced gradient so the scaler's own
+        # overflow machinery (flag → skip → halve) fires — the eager
+        # surface's analogue of the fused step's batch taint
+        for optimizer in optimizers:
+            stash = getattr(optimizer, "_amp_stash", None)
+            param_lists = [g["params"] for g in optimizer.param_groups]
+            for name in ("all_fp16_params", "all_fp32_params",
+                         "all_fp32_from_fp32_params"):
+                lst = getattr(stash, name, None)
+                if lst:
+                    param_lists.append(lst)
+            for params in param_lists:
+                for p in params:
+                    if getattr(p, "grad", None) is not None:
+                        p.grad = p.grad * float("nan")
+
     if delay_unscale:
         for optimizer in optimizers:
             optimizer._amp_stash.params_have_scaled_gradients = True
@@ -101,6 +120,15 @@ def scale_loss(loss, optimizers, loss_id=0, model=None, delay_unscale=False,
                                 opt.scale_set_by_backward = False
                             opt.step = opt_step
                             opt._amp_stash.already_patched = False
+                            # resilience.BadStepGuard (attach_optimizer):
+                            # a skip on this reference-exact path never
+                            # reaches the guard's step wrapper (THIS
+                            # function replaced it for the skipped call),
+                            # so notify it here — the skip decision is
+                            # host-known, no device flag involved
+                            guard = getattr(opt._amp_stash, "_guard", None)
+                            if guard is not None:
+                                guard.observe(1)
 
                         return skip_step
 

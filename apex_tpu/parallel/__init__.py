@@ -12,7 +12,7 @@ import jax
 from ..nn.modules import _BatchNorm
 from .distributed import (  # noqa: F401
     DistributedDataParallel, Reducer, all_reduce_mean, flat_dist_call,
-    init_distributed, rank, world_size)
+    init_distributed, rank, timed_flat_dist_call, world_size)
 from .LARC import LARC  # noqa: F401
 from .ring_attention import (  # noqa: F401
     ring_attention, ulysses_attention)

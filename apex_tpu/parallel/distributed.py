@@ -125,27 +125,194 @@ def all_reduce_mean(tensors, mesh: Optional[Mesh] = None,
     return out
 
 
+#: presence registry key prefix in the coordinator's KV store — each rank
+#: announces itself after a successful init so a later collective timeout
+#: can NAME the ranks that never arrived (or died) instead of hanging
+_PRESENCE_PREFIX = "apex_tpu/presence/"
+
+#: test seam: when set, a callable returning the list of missing rank ids
+#: (production path queries the coordinator KV store)
+_PRESENCE_PROBE = None
+
+
+def _kv_client():
+    try:
+        from jax._src import distributed as _jd
+        return _jd.global_state.client
+    except Exception:
+        return None
+
+
+def announce_presence():
+    """Record this process in the coordinator's presence registry
+    (best-effort; no-op single-process).  ``init_distributed`` calls it
+    after a successful initialize."""
+    client = _kv_client()
+    if client is None:
+        return
+    import socket
+    try:
+        client.key_value_set(f"{_PRESENCE_PREFIX}{jax.process_index()}",
+                             socket.gethostname())
+    except Exception:
+        pass
+
+
+def missing_ranks() -> Optional[list]:
+    """Ranks with no presence-registry entry, or None when undeterminable
+    (single process / no coordinator client)."""
+    if _PRESENCE_PROBE is not None:
+        return _PRESENCE_PROBE()
+    client = _kv_client()
+    if client is None:
+        return None
+    out = []
+    for r in range(jax.process_count()):
+        try:
+            client.key_value_try_get(f"{_PRESENCE_PREFIX}{r}")
+        except Exception:
+            out.append(r)
+    return out
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None):
+                     process_id: Optional[int] = None,
+                     timeout_s: Optional[float] = None,
+                     max_retries: Optional[int] = None,
+                     backoff_s: float = 1.0,
+                     backoff_factor: float = 2.0,
+                     max_backoff_s: float = 30.0,
+                     _initialize=None):
     """Initialize ``jax.distributed`` from explicit args or the environment
-    the ``apex_tpu.parallel.multiproc`` launcher exports.
+    the ``apex_tpu.parallel.multiproc`` launcher exports — with a bounded
+    retry loop instead of the bare ``jax.distributed.initialize``'s
+    block-forever default.
 
     jax itself consumes only ``JAX_COORDINATOR_ADDRESS`` from the
     environment (jax/_src/distributed.py); the process count/id must be
     passed explicitly, which is what this helper does with the launcher's
     ``APEX_TPU_NUM_PROCESSES``/``APEX_TPU_PROCESS_ID``.
+
+    Robustness contract (pods preempt; coordinators restart slowly):
+    attempts are retried with exponential backoff (``backoff_s`` doubling
+    by ``backoff_factor`` up to ``max_backoff_s``) until either
+    ``max_retries`` attempts (env ``APEX_TPU_INIT_RETRIES``, default 4) or
+    the overall ``timeout_s`` deadline (env ``APEX_TPU_INIT_TIMEOUT``,
+    default 300s) is exhausted, whichever comes first; each attempt's own
+    ``initialization_timeout`` is capped by the remaining deadline.  On
+    exhaustion a :class:`~apex_tpu.runtime.resilience.DistributedInitError`
+    names the coordinator, the rank, the attempt count, and the last
+    underlying error — the diagnostic a 2am page needs, not a hung
+    process.  Chaos hook ``dist.init`` fires before every attempt
+    (``"fail"`` exercises the retry path; ``"kill"`` is preemption and
+    propagates).  ``_initialize`` is a test seam defaulting to
+    ``jax.distributed.initialize``.
     """
     import os
+    import time as _time
+
+    from ..runtime import chaos as _chaos
+    from ..runtime.resilience import DistributedInitError
+
     coordinator_address = coordinator_address or \
         os.environ.get("APEX_TPU_COORDINATOR")
     if num_processes is None and "APEX_TPU_NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["APEX_TPU_NUM_PROCESSES"])
     if process_id is None and "APEX_TPU_PROCESS_ID" in os.environ:
         process_id = int(os.environ["APEX_TPU_PROCESS_ID"])
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("APEX_TPU_INIT_TIMEOUT", 300.0))
+    if max_retries is None:
+        max_retries = int(os.environ.get("APEX_TPU_INIT_RETRIES", 4))
+    if _initialize is None:
+        _initialize = jax.distributed.initialize
+
+    deadline = _time.monotonic() + timeout_s
+    delay = backoff_s
+    last_exc = None
+    attempt = -1
+    for attempt in range(max_retries + 1):
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            break
+        try:
+            if _chaos.active():
+                _chaos.hook("dist.init", attempt=attempt)
+            _initialize(coordinator_address=coordinator_address,
+                        num_processes=num_processes,
+                        process_id=process_id,
+                        initialization_timeout=max(1, int(remaining)))
+            announce_presence()
+            return
+        except _chaos.ChaosKilled:
+            raise           # simulated preemption: die like the real thing
+        except Exception as e:  # noqa: BLE001 — every init failure retries
+            last_exc = e
+            sleep = min(delay, max_backoff_s, max(deadline - _time.monotonic(),
+                                                  0.0))
+            if sleep > 0 and attempt < max_retries:
+                _time.sleep(sleep)
+            delay *= backoff_factor
+    raise DistributedInitError(
+        f"init_distributed gave up after {attempt + 1} attempt(s) / "
+        f"{timeout_s:.0f}s deadline (coordinator="
+        f"{coordinator_address!r}, process_id={process_id}, "
+        f"num_processes={num_processes}): {last_exc}") from last_exc
+
+
+def timed_flat_dist_call(tensors, call, extra_args=None,
+                         timeout_s: float = 60.0):
+    """:func:`flat_dist_call` with a deadline and a *named-suspect*
+    diagnostic.
+
+    A collective against a dead/slow peer blocks forever with no
+    indication of WHICH rank is missing.  This wrapper runs the collective
+    on a worker thread, and on deadline raises
+    :class:`~apex_tpu.runtime.resilience.CollectiveTimeoutError` naming
+    this rank, the world size, and — when the coordinator's presence
+    registry (:func:`announce_presence`) can identify them — the ranks
+    that never checked in.  Chaos hook ``dist.collective`` fires inside
+    the worker (``"delay"`` simulates the slow peer the timeout exists
+    for).
+
+    The abandoned worker thread is daemonic: if the collective later
+    completes its result is discarded; if it never does, process exit is
+    not held up — the caller is expected to checkpoint-and-die or
+    re-init, not to retry the wedged collective in place.
+    """
+    import threading
+
+    from ..runtime import chaos as _chaos
+    from ..runtime.resilience import CollectiveTimeoutError
+
+    box = {}
+
+    def worker():
+        try:
+            if _chaos.active():
+                _chaos.hook("dist.collective")
+            box["out"] = flat_dist_call(tensors, call, extra_args)
+        except BaseException as e:  # surfaced below
+            box["exc"] = e
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name="apex-tpu-collective")
+    t.start()
+    t.join(timeout_s)
+    if "exc" in box:
+        raise box["exc"]
+    if "out" in box:
+        return box["out"]
+    missing = missing_ranks()
+    suspect = (f"ranks never present in the coordinator registry: "
+               f"{missing}" if missing
+               else "missing rank unknown (no coordinator presence "
+                    "registry — single process or init_distributed not "
+                    "used)")
+    raise CollectiveTimeoutError(
+        f"collective did not complete within {timeout_s:g}s on rank "
+        f"{rank()} of {jax.process_count()} process(es); {suspect}")
 
 
 class Reducer:

@@ -18,6 +18,11 @@ Public surface:
   step_cache                                — compiled step-program cache for
                                               the eager optimizer surface
                                               (apex_tpu.runtime.step_cache)
+  resilience                                — atomic/async CheckpointManager,
+                                              auto-resume, BadStepGuard
+                                              (apex_tpu.runtime.resilience)
+  chaos                                     — deterministic fault injection
+                                              (apex_tpu.runtime.chaos)
 """
 from __future__ import annotations
 
@@ -214,7 +219,14 @@ def f32_to_bf16(x, threads: int = 0):
 
 from .data import DataPrefetcher  # noqa: E402,F401
 from . import step_cache  # noqa: E402,F401
+from . import chaos  # noqa: E402,F401
+from . import resilience  # noqa: E402,F401
+from .resilience import (  # noqa: E402,F401
+    BadStepGuard, CheckpointCorruptError, CheckpointManager, SaveHandle,
+    TrainingDivergedError)
 
 __all__ = ["flatten", "unflatten", "normalize_u8_nhwc_to_f32_nchw",
            "normalize_u8_nhwc_to_f32_nhwc", "f32_to_bf16", "available",
-           "DataPrefetcher", "step_cache"]
+           "DataPrefetcher", "step_cache", "chaos", "resilience",
+           "CheckpointManager", "CheckpointCorruptError", "SaveHandle",
+           "BadStepGuard", "TrainingDivergedError"]

@@ -1,0 +1,674 @@
+"""Resilience runtime: atomic/async checkpointing, preemption-safe
+auto-resume, and escalation on overflow storms.
+
+The reference documents a "bitwise accurate" save/resume workflow
+(README.md:59-99 there) but its durability story ends at ``torch.save``:
+a preemption mid-write corrupts the only copy, and nothing validates a
+checkpoint before unpickling it.  On a TPU pod, preemption is routine —
+this module makes the save/resume loop survive it:
+
+* :func:`write_checkpoint_file` / :func:`read_checkpoint_file` — THE one
+  checkpoint write path (the legacy ``apex_tpu.utils.save_checkpoint``
+  delegates here).  Writes are atomic (tmp file + fsync + ``os.rename``);
+  every file carries a manifest (schema version + per-component CRC32
+  checksums) validated on load, raising the typed
+  :class:`CheckpointCorruptError` instead of feeding garbage to
+  ``load_state_dict``.  Pre-manifest pickles still load, with a warning.
+* :class:`CheckpointManager` — rolling ``keep_n`` retention over a
+  directory of step-numbered checkpoints, synchronous or async save
+  (device→host transfer on the caller thread — one sync, exactly like the
+  blocking path — then pickling + IO on a background thread behind a
+  :class:`SaveHandle` that surfaces errors on ``wait()``), and
+  :meth:`CheckpointManager.restore_or_initialize` auto-resume that scans
+  newest→oldest past corrupt/partial checkpoints to the latest *valid*
+  one.
+* :class:`BadStepGuard` — escalation above the ``ScalerState`` skip logic
+  (`apex_tpu/amp/scaler.py`): the scaler already halves the scale and
+  skips the step on overflow, silently and forever; the guard counts
+  *consecutive* skipped steps and after ``patience`` of them escalates
+  per policy — warn → snapshot-rollback to the last good step → raise
+  :class:`TrainingDivergedError`.  Wired into the fused
+  ``training.step.TrainStep`` (observes the on-device skip flag the step
+  now carries in ``state.scaler.overflow``) and the eager step-cache
+  surface (``guard.attach_optimizer``) without adding host syncs or
+  step-cache dispatches to the clean-step hot path: flags are consumed
+  lazily via ``jax.Array.is_ready`` polling, blocking only when the
+  pending queue exceeds its bound (which on a healthy run it never does).
+
+Typed failures for the distributed layer
+(:class:`DistributedInitError`, :class:`CollectiveTimeoutError`) live here
+too; ``apex_tpu.parallel.distributed`` raises them from its bounded-retry
+init and collective-timeout wrappers.
+
+Every failure path is exercised in tier-1 tests through the
+:mod:`apex_tpu.runtime.chaos` hook points (``ckpt.mid_write``,
+``ckpt.pre_rename``, ``train.step``, ``dist.init``, ``dist.collective``).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import pickle
+import re
+import threading
+import warnings
+import zlib
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from . import chaos as _chaos
+
+#: bump when the container layout changes; readers accept <= this
+SCHEMA_VERSION = 1
+_MAGIC = "__apex_tpu_checkpoint__"
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.pkl$")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed manifest/schema/checksum validation (partial
+    write, bit rot, or a future schema).  ``restore_or_initialize`` falls
+    back past these to the newest checkpoint that validates."""
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised by :class:`BadStepGuard` when an overflow-skip streak
+    exhausts the escalation ladder: the loss scale has collapsed and the
+    run is not making progress."""
+
+
+class DistributedInitError(RuntimeError):
+    """``init_distributed`` exhausted its retry budget / deadline."""
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A collective did not complete within its deadline — typically a
+    missing or wedged peer; the message names the suspect ranks when the
+    coordinator's presence registry can identify them."""
+
+
+# ---------------------------------------------------------------------------
+# the one checkpoint write path
+# ---------------------------------------------------------------------------
+
+
+def _to_host(tree):
+    """Fetch device arrays anywhere in a pytree to host numpy (one sync,
+    like ``torch.save``); everything else passes through."""
+    def conv(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def _fsync_dir(path):
+    # rename durability: fsync the containing directory so the new entry
+    # survives power loss, not just process death (best-effort on
+    # filesystems that refuse O_RDONLY dir fds)
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def serialize_checkpoint(components: dict, *, to_host: bool = True) -> bytes:
+    """Pickle ``components`` into the manifested container format:
+    ``{_MAGIC: schema, "manifest": {...}, "payload": {name: bytes}}``.
+    Each component is pickled separately so the manifest can carry a
+    per-component CRC32 the loader verifies before unpickling anything."""
+    if to_host:
+        components = {k: _to_host(v) for k, v in components.items()}
+    payload = {k: pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
+               for k, v in components.items()}
+    manifest = {
+        "schema": SCHEMA_VERSION,
+        "components": {k: {"crc32": zlib.crc32(b), "nbytes": len(b)}
+                       for k, b in payload.items()},
+    }
+    return pickle.dumps({_MAGIC: SCHEMA_VERSION, "manifest": manifest,
+                         "payload": payload},
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_checkpoint(blob, *, source: str = "<bytes>") -> dict:
+    """Validate + unpickle a container produced by
+    :func:`serialize_checkpoint` (or a legacy manifest-less pickle, with a
+    warning).  ``blob`` may be bytes or an already-unpickled object."""
+    if isinstance(blob, (bytes, bytearray, memoryview)):
+        try:
+            obj = pickle.loads(bytes(blob))
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"{source}: not a readable pickle "
+                f"(partial write?): {e}") from e
+    else:
+        obj = blob
+    if not (isinstance(obj, dict) and _MAGIC in obj):
+        warnings.warn(
+            f"{source}: legacy manifest-less checkpoint — loaded without "
+            f"checksum validation (re-save with save_checkpoint / "
+            f"CheckpointManager to get integrity checking)",
+            stacklevel=2)
+        return obj
+    schema = obj[_MAGIC]
+    if not isinstance(schema, int) or schema > SCHEMA_VERSION:
+        raise CheckpointCorruptError(
+            f"{source}: checkpoint schema {schema!r} is newer than this "
+            f"library supports (<= {SCHEMA_VERSION})")
+    manifest = obj.get("manifest")
+    payload = obj.get("payload")
+    if not isinstance(manifest, dict) or not isinstance(payload, dict):
+        raise CheckpointCorruptError(
+            f"{source}: container missing manifest/payload")
+    declared = manifest.get("components", {})
+    if set(declared) != set(payload):
+        raise CheckpointCorruptError(
+            f"{source}: manifest names components "
+            f"{sorted(declared)} but payload holds {sorted(payload)}")
+    out = {}
+    for name, blob_i in payload.items():
+        meta = declared[name]
+        if len(blob_i) != meta["nbytes"] or \
+                zlib.crc32(blob_i) != meta["crc32"]:
+            raise CheckpointCorruptError(
+                f"{source}: component {name!r} failed checksum validation "
+                f"(expected crc32={meta['crc32']:#010x} over "
+                f"{meta['nbytes']} bytes)")
+        out[name] = pickle.loads(blob_i)
+    return out
+
+
+def write_checkpoint_file(path: str, components: dict, *,
+                          to_host: bool = True) -> str:
+    """Atomically write ``components`` to ``path``: serialize, write to a
+    sibling tmp file, flush + fsync, then one ``os.rename``.  A crash at
+    ANY point leaves ``path`` either absent or a complete previous
+    checkpoint — never a partial file.  Chaos hooks: ``ckpt.mid_write``
+    (payload half-written in the tmp file), ``ckpt.pre_rename`` (payload
+    durable, rename pending), ``ckpt.post_rename``."""
+    blob = serialize_checkpoint(components, to_host=to_host)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            mid = len(blob) // 2
+            f.write(blob[:mid])
+            if _chaos.active():
+                _chaos.hook("ckpt.mid_write", path=path, tmp=tmp)
+            f.write(blob[mid:])
+            f.flush()
+            os.fsync(f.fileno())
+        if _chaos.active():
+            _chaos.hook("ckpt.pre_rename", path=path, tmp=tmp)
+        os.rename(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+        if _chaos.active():
+            _chaos.hook("ckpt.post_rename", path=path)
+    except _chaos.ChaosKilled:
+        # simulated process death: leave the honest debris a real SIGKILL
+        # would (a partial tmp file, the final path untouched) — this is
+        # the state the recovery tests assert on; _sweep_tmp collects it
+        # on the next manager save
+        raise
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def read_checkpoint_file(path: str) -> dict:
+    """Read + validate a checkpoint written by
+    :func:`write_checkpoint_file` (legacy pickles load with a warning).
+    Raises :class:`CheckpointCorruptError` on any validation failure and
+    ``FileNotFoundError`` when ``path`` does not exist."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    return deserialize_checkpoint(blob, source=path)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+
+class SaveHandle:
+    """Error-surfacing handle for one (possibly async) save.
+
+    ``wait()`` blocks until the write is durable and re-raises anything
+    the background thread hit — a save error silently swallowed is a run
+    that discovers at *restore* time it has no checkpoints."""
+
+    def __init__(self, step: int, path: str):
+        self.step = step
+        self.path = path
+        self._done = threading.Event()
+        self._exc: Optional[BaseException] = None
+
+    def _finish(self, exc: Optional[BaseException] = None):
+        self._exc = exc
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"checkpoint save for step {self.step} still in flight "
+                f"after {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self.path
+
+
+class CheckpointManager:
+    """Atomic, rolling, optionally-async checkpoints under one directory.
+
+    Layout: ``<directory>/ckpt_<step>.pkl`` in the manifested container
+    format of :func:`write_checkpoint_file`.  ``keep_n`` newest VALID-path
+    files are retained; retention runs after each successful save and
+    never deletes the checkpoint just written.
+
+    ``save(step=n, **components)`` is synchronous; ``save_async`` fetches
+    device arrays to host on the caller thread (the same one sync the
+    blocking path pays — mandatory: the caller may donate/overwrite the
+    device buffers on the very next step) and returns a
+    :class:`SaveHandle` while a single background worker pickles and
+    writes.  One save is in flight at a time; a second ``save_async``
+    enqueues behind it.  Call :meth:`wait` (or :meth:`close`, or use as a
+    context manager) before reading checkpoints or exiting.
+
+    :meth:`restore_or_initialize` is the preemption-safe resume entry:
+    scan newest→oldest, skip anything that fails validation (the partial
+    tmp files an interrupted save leaves are never even candidates — the
+    atomic rename means an invalid *final* file can only be bit rot), and
+    fall back to ``initialize`` when nothing valid exists.
+    """
+
+    def __init__(self, directory: str, keep_n: int = 3):
+        if keep_n < 1:
+            raise ValueError(f"keep_n must be >= 1, got {keep_n}")
+        self.directory = directory
+        self.keep_n = keep_n
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._queue: collections.deque = collections.deque()
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- paths -------------------------------------------------------------
+    def path_for(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{int(step):08d}.pkl")
+
+    def all_steps(self) -> list:
+        """Step numbers with a (final-path) checkpoint file, ascending.
+        Presence only — validity is decided at restore time."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            m = _CKPT_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _sweep_tmp(self):
+        # debris from killed writers (ours or a predecessor's)
+        for name in os.listdir(self.directory):
+            if ".pkl.tmp." in name:
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+    def _retain(self, just_wrote: int):
+        steps = self.all_steps()
+        for s in steps[:-self.keep_n] if len(steps) > self.keep_n else []:
+            if s == just_wrote:
+                continue
+            try:
+                os.unlink(self.path_for(s))
+            except OSError:
+                pass
+
+    # -- save --------------------------------------------------------------
+    def _write(self, step: int, host_components: dict) -> str:
+        self._sweep_tmp()
+        path = write_checkpoint_file(self.path_for(step), host_components,
+                                     to_host=False)
+        self._retain(step)
+        return path
+
+    def save(self, step: int, /, **components) -> str:
+        """Blocking atomic save; returns the final path."""
+        handle = SaveHandle(step, self.path_for(step))
+        try:
+            self._write(step, {k: _to_host(v) for k, v in components.items()})
+        except BaseException as e:
+            handle._finish(e)
+            raise
+        handle._finish()
+        return handle.path
+
+    def save_async(self, step: int, /, **components) -> SaveHandle:
+        """Async atomic save.  Device→host transfer happens HERE, on the
+        caller thread (so the step loop may immediately reuse/donate the
+        device buffers); pickling + IO run on the manager's worker
+        thread.  Returns a :class:`SaveHandle`; errors surface on its
+        ``wait()`` (and on :meth:`wait`/:meth:`close`)."""
+        if self._closed:
+            raise RuntimeError("CheckpointManager is closed")
+        host = {k: _to_host(v) for k, v in components.items()}
+        handle = SaveHandle(step, self.path_for(step))
+        with self._lock:
+            self._queue.append((step, host, handle))
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._drain, name="apex-tpu-ckpt-writer",
+                    daemon=True)
+                self._worker.start()
+        return handle
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                step, host, handle = self._queue.popleft()
+            try:
+                self._write(step, host)
+            except BaseException as e:  # surfaced via handle.wait()
+                handle._finish(e)
+            else:
+                handle._finish()
+
+    def wait(self):
+        """Block until every queued save is durable; re-raise the first
+        error encountered (each handle also carries its own)."""
+        while True:
+            with self._lock:
+                pending = list(self._queue)
+                worker = self._worker
+            if worker is not None:
+                worker.join()
+            with self._lock:
+                if not self._queue and (self._worker is None
+                                        or not self._worker.is_alive()):
+                    break
+        for _, _, handle in pending:
+            if handle.done() and handle._exc is not None:
+                raise handle._exc
+
+    def close(self):
+        self.wait()
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, step: Optional[int] = None) -> dict:
+        """Load + validate one checkpoint (latest when ``step`` is None)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory!r}")
+        return read_checkpoint_file(self.path_for(step))
+
+    def restore_or_initialize(self, initialize: Optional[Callable] = None):
+        """Auto-resume: ``(step, components)`` from the newest checkpoint
+        that VALIDATES, scanning past corrupt/partial ones with a warning;
+        ``(None, initialize())`` — or ``(None, None)`` — when no valid
+        checkpoint exists.  This is the call a preempted job makes
+        unconditionally at startup."""
+        for step in reversed(self.all_steps()):
+            try:
+                return step, self.restore(step)
+            except CheckpointCorruptError as e:
+                warnings.warn(
+                    f"skipping corrupt checkpoint for step {step}: {e}",
+                    stacklevel=2)
+            except FileNotFoundError:
+                continue
+        return None, (initialize() if initialize is not None else None)
+
+
+# ---------------------------------------------------------------------------
+# BadStepGuard
+# ---------------------------------------------------------------------------
+
+
+def snapshot_state(state):
+    """Host copy of a device-state pytree (one sync) — the rollback
+    anchor :class:`BadStepGuard` refreshes on clean steps."""
+    return jax.tree_util.tree_map(
+        lambda x: np.array(x) if isinstance(x, jax.Array) else x, state)
+
+
+def restore_state(host_state):
+    """Re-device a :func:`snapshot_state` copy."""
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x,
+        host_state)
+
+
+class BadStepGuard:
+    """Escalation above the scaler's silent skip loop.
+
+    ``ScalerState`` handles a *transient* overflow correctly — halve the
+    scale, skip the step, move on.  What it cannot see is a *storm*: a
+    diverging run overflows every step, the scale collapses to
+    ``min_loss_scale``, and training silently stops making progress while
+    burning pod-hours.  The guard watches consecutive skipped steps and
+    after ``patience`` of them escalates through ``policy`` — one stage
+    per escalation event, last stage sticky:
+
+    * ``"warn"`` — log loudly, keep going (storms sometimes pass);
+    * ``"rollback"`` — restore the last known-good snapshot (params,
+      optimizer slots, step counter; the CURRENT — already-halved — loss
+      scale is kept so the same storm is not immediately re-entered) and
+      continue;
+    * ``"raise"`` — :class:`TrainingDivergedError`; let the operator (or
+      the auto-resume wrapper) decide.
+
+    Clean-path cost: ``observe`` appends the step's on-device skip flag
+    (an i32 scalar the fused step already computes) to a deque and
+    consumes only flags whose buffers report ``is_ready()`` — no host
+    sync, no extra dispatch (verified against ``step_cache.stats()``).
+    Blocking reads happen only when the pending deque exceeds
+    ``max_pending`` (default ``4 * patience``) — i.e. only under storms,
+    where a sync is the least of the run's problems.
+
+    Fused path::
+
+        guard = BadStepGuard(patience=8, policy=("warn", "rollback",
+                                                 "raise"))
+        guard.attach(step)           # TrainStep notifies the guard per call
+        for x, y in loader:
+            loss = step(x, y)        # guard escalates as configured
+
+    Eager step-cache path (``amp.initialize`` + ``optimizer.step()``)::
+
+        guard.attach_optimizer(optimizer)   # observes the scaler skip flag
+    """
+
+    def __init__(self, patience: int = 5,
+                 policy: Sequence[str] | str = ("warn", "rollback", "raise"),
+                 snapshot_interval: int = 100,
+                 max_pending: Optional[int] = None,
+                 on_event: Optional[Callable] = None):
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        if isinstance(policy, str):
+            policy = (policy,)
+        policy = tuple(policy)
+        for stage in policy:
+            if stage not in ("warn", "rollback", "raise"):
+                raise ValueError(f"unknown guard policy stage {stage!r}")
+        if not policy:
+            raise ValueError("policy must name at least one stage")
+        self.patience = patience
+        self.policy = policy
+        self.snapshot_interval = snapshot_interval
+        self.max_pending = (4 * patience if max_pending is None
+                            else max_pending)
+        self.on_event = on_event
+        self._pending: collections.deque = collections.deque()
+        self._streak = 0
+        self._escalations = 0
+        self._clean_since_snapshot = 0
+        self._snapshot = None
+        self._step = None       # attached TrainStep (fused path)
+        self.stats = {"observed": 0, "skipped": 0, "escalations": 0,
+                      "rollbacks": 0}
+
+    # -- wiring ------------------------------------------------------------
+    def attach(self, train_step):
+        """Attach to a fused ``TrainStep`` (or any object with a mutable
+        ``.state`` carrying ``scaler.overflow``): the step notifies the
+        guard after each call; an initial rollback snapshot is taken now."""
+        self._step = train_step
+        train_step._guard = self
+        if "rollback" in self.policy:
+            self._snapshot = snapshot_state(train_step.state)
+        return train_step
+
+    def attach_optimizer(self, optimizer):
+        """Attach to an amp-processed optimizer on the eager step-cache
+        surface.  The skip flag comes for free on both eager modes: under
+        ``defer_scale_update=True`` it is the deferred scaler's on-device
+        overflow flag (captured BEFORE the step program donates it — no
+        added sync, no added dispatch); in reference-exact mode the skip
+        decision is already host-known — ``scale_loss``'s one-shot
+        ``skip_step`` patch REPLACES the wrapper below for skipped calls,
+        so it notifies ``stash._guard`` directly (amp/handle.py).
+        Rollback needs a state snapshot the eager surface does not own,
+        so the rollback stage degrades to warn here unless the caller
+        layers its own snapshot management."""
+        guard = self
+        stash = getattr(optimizer, "_amp_stash", None)
+        if stash is not None:
+            stash._guard = self
+        orig_step = optimizer.step
+
+        def guarded_step(closure=None):
+            flag = 0
+            if stash is not None:
+                deferred = getattr(stash, "_deferred_scaler", None)
+                if deferred is not None:
+                    flag = deferred.state.overflow
+            ret = orig_step() if closure is None else orig_step(closure)
+            guard.observe(flag)
+            return ret
+
+        optimizer.step = guarded_step
+        return optimizer
+
+    # -- observation -------------------------------------------------------
+    def observe(self, skip_flag):
+        """Record one step's skip flag (device i32 scalar, python int, or
+        bool).  Device flags are consumed lazily — see class docstring."""
+        self.stats["observed"] += 1
+        self._pending.append(skip_flag)
+        self._drain(block=False)
+        while len(self._pending) > self.max_pending:
+            self._consume(self._pending.popleft())
+
+    def flush(self):
+        """Consume every pending flag (blocking).  Call at loop end, or
+        before trusting ``stats`` in a test."""
+        self._drain(block=True)
+
+    def _drain(self, block: bool):
+        while self._pending:
+            flag = self._pending[0]
+            if not block:
+                ready = getattr(flag, "is_ready", None)
+                if ready is not None and not ready():
+                    return
+            self._consume(self._pending.popleft())
+
+    def _consume(self, flag):
+        skipped = bool(int(flag))
+        if skipped:
+            self.stats["skipped"] += 1
+            self._streak += 1
+            self._clean_since_snapshot = 0
+            if self._streak >= self.patience:
+                self._streak = 0
+                self._escalate()
+        else:
+            self._streak = 0
+            self._clean_since_snapshot += 1
+            if (self._step is not None and "rollback" in self.policy
+                    and self._clean_since_snapshot
+                    >= self.snapshot_interval):
+                self._refresh_snapshot()
+
+    def _refresh_snapshot(self):
+        # the pending deque is empty here (we are inside a drain), so the
+        # current state is at least as new as every observed flag;
+        # snapshotting it can only capture MORE confirmed-clean steps
+        self._snapshot = snapshot_state(self._step.state)
+        self._clean_since_snapshot = 0
+
+    # -- escalation --------------------------------------------------------
+    def _escalate(self):
+        stage = self.policy[min(self._escalations, len(self.policy) - 1)]
+        self._escalations += 1
+        self.stats["escalations"] += 1
+        event = {"stage": stage, "escalation": self._escalations,
+                 "patience": self.patience}
+        if self.on_event is not None:
+            self.on_event(event)
+        msg = (f"BadStepGuard: {self.patience} consecutive overflow-skipped "
+               f"steps (escalation #{self._escalations}, stage {stage!r})")
+        if stage == "raise":
+            raise TrainingDivergedError(
+                msg + " — loss scale has collapsed; training is diverging")
+        warnings.warn(msg, stacklevel=3)
+        if stage == "rollback":
+            self._rollback()
+
+    def _rollback(self):
+        if self._step is None or self._snapshot is None:
+            warnings.warn(
+                "BadStepGuard: rollback requested but no snapshot is "
+                "available (eager surface, or attach() not called) — "
+                "degrading to warn", stacklevel=4)
+            return
+        restored = restore_state(self._snapshot)
+        current = self._step.state
+        # keep the CURRENT (post-halving) loss scale: restoring the
+        # snapshot's larger scale would walk straight back into the storm
+        if hasattr(restored, "scaler") and hasattr(current, "scaler"):
+            restored = restored._replace(
+                scaler=restored.scaler._replace(
+                    loss_scale=current.scaler.loss_scale,
+                    unskipped=jax.numpy.zeros((), jax.numpy.int32),
+                    overflow=jax.numpy.zeros((), jax.numpy.int32)))
+        self._step.state = restored
+        self.stats["rollbacks"] += 1

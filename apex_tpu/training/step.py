@@ -52,13 +52,32 @@ class TrainStep:
         #: compilation is synchronous at dispatch, execution is async).
         #: Round-1 lesson: compile cost was invisible until it timed out.
         self.compile_s = None
+        #: 0-based count of dispatched calls (chaos `at=` indices key on it)
+        self.calls = 0
+        #: resilience.BadStepGuard attached via guard.attach(step), or None
+        self._guard = None
 
     def __call__(self, *batch):
+        from ..runtime import chaos as _chaos
+        if _chaos.active():
+            batch = _chaos_taint(self, batch)
         t0 = time.perf_counter() if self.compile_s is None else None
         self.state, loss = self._step_fn(self.state, *batch)
         if t0 is not None:
             self.compile_s = time.perf_counter() - t0
+        self.calls += 1
+        if self._guard is not None:
+            # the on-device skip flag apply_fused_update carried out in
+            # scaler.overflow — handing the array over costs nothing; the
+            # guard reads it lazily (is_ready polling)
+            self._guard.observe(self.state.scaler.overflow)
         return loss
+
+    @property
+    def last_step_skipped(self):
+        """Device i32 scalar: 1 when the most recent call overflow-skipped
+        (reading it as ``int(...)`` is a host sync)."""
+        return self.state.scaler.overflow
 
     def sync_to_objects(self):
         """Write device state back into the model/scaler objects.
@@ -83,6 +102,27 @@ class TrainStep:
         from ..amp._amp_state import _amp_state
         if _amp_state.loss_scalers:
             _amp_state.loss_scalers[0].state = st.scaler
+
+
+def _chaos_taint(train_step, batch):
+    """``train.step`` chaos hook: ``"nonfinite_grads"`` multiplies every
+    floating batch leaf by NaN, so the scaled loss — and therefore every
+    gradient — goes non-finite and the fused step's own overflow machinery
+    (flag → skip → scale halving) fires exactly as it would in a real
+    overflow storm.  ``"kill"``/``"fail"`` raise from the hook itself."""
+    from ..runtime import chaos as _chaos
+
+    action = _chaos.hook("train.step", step=train_step.calls)
+    if action != "nonfinite_grads":
+        return batch
+
+    def taint(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x) * jnp.asarray(float("nan"),
+                                                jnp.asarray(x).dtype)
+        return x
+    return tuple(jax.tree_util.tree_map(taint, b) for b in batch)
 
 
 def match_param_groups(optimizer, params, caller="make_train_step"):
@@ -182,6 +222,12 @@ def apply_fused_update(sub: StepState, grads, opt_update, model_dtypes, *,
     new_scaler, _ = update_scale_state(
         scaler_state, dynamic=dynamic, scale_window=scale_window,
         min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale)
+    # carry THIS step's skip flag out in the returned scaler state: the
+    # fused path never reads `overflow` on entry (the flag is recomputed
+    # from the gradients each step), so the slot is free to make "did the
+    # step skip" observable on device — BadStepGuard consumes it without
+    # adding a host sync to the step
+    new_scaler = new_scaler._replace(overflow=flag)
     return StepState(masters, model_params, slots, new_scaler, sub.stats,
                      step_count)
 
@@ -512,6 +558,8 @@ def apply_fused_update_flat(sub: StepState, grads, meta: FlatMeta,
     new_scaler, _ = update_scale_state(
         scaler_state, dynamic=dynamic, scale_window=scale_window,
         min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale)
+    # skip-flag carry-out, as in apply_fused_update
+    new_scaler = new_scaler._replace(overflow=flag)
     return StepState(masters, flat_model_params(meta, masters, model_dtypes),
                      slots, new_scaler, sub.stats, step_count)
 

@@ -1,3 +1,5 @@
 from .checkpoint import (  # noqa: F401
-    AsyncTrainStateSaver, load_checkpoint, restore_train_state,
-    save_checkpoint, save_train_state)
+    AsyncTrainStateSaver, CheckpointCorruptError, load_checkpoint,
+    restore_train_state, save_checkpoint, save_train_state)
+from ..runtime.resilience import (  # noqa: F401 — resilience surface
+    BadStepGuard, CheckpointManager, TrainingDivergedError)

@@ -17,34 +17,36 @@ fp32 groups explicitly.
 """
 from __future__ import annotations
 
-import pickle
-
 import jax
-import numpy as np
 
-
-def _to_host(tree):
-    def conv(x):
-        if isinstance(x, jax.Array):
-            return np.asarray(x)
-        return x
-    return jax.tree_util.tree_map(conv, tree)
+from ..runtime.resilience import (  # noqa: F401 — re-exported surface
+    CheckpointCorruptError, _to_host, read_checkpoint_file,
+    write_checkpoint_file)
 
 
 def save_checkpoint(path: str, **components):
     """``save_checkpoint(path, model=model.state_dict(), optimizer=
     opt.state_dict(), amp=amp.state_dict(), epoch=...)`` — any picklable
-    values; jax arrays anywhere in the trees are fetched to host first."""
-    with open(path, "wb") as f:
-        pickle.dump({k: _to_host(v) for k, v in components.items()}, f)
+    values; jax arrays anywhere in the trees are fetched to host first.
+
+    One write path with :class:`apex_tpu.runtime.CheckpointManager`: the
+    write is atomic (tmp + fsync + rename — a preemption mid-save leaves
+    the previous file intact, never a partial one) and the file carries a
+    manifest (schema version + per-component checksums) that
+    :func:`load_checkpoint` validates."""
+    write_checkpoint_file(path, dict(components))
 
 
 def load_checkpoint(path: str) -> dict:
     """Load a checkpoint written by :func:`save_checkpoint`.  Arrays come
     back as host numpy; feed the sub-dicts to the matching
-    ``load_state_dict`` (model / optimizer / amp), which re-device them."""
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    ``load_state_dict`` (model / optimizer / amp), which re-device them.
+
+    The manifest is validated before anything is unpickled —
+    :class:`~apex_tpu.runtime.resilience.CheckpointCorruptError` on
+    checksum/schema mismatch; pre-manifest legacy pickles still load,
+    with a warning."""
+    return read_checkpoint_file(path)
 
 
 def save_train_state(path: str, step) -> None:
@@ -60,16 +62,35 @@ def save_train_state(path: str, step) -> None:
     restores SHARDED — no gather on save, no re-scatter on load.  Resume
     is exact: unlike the state_dict path (O2 masters lazily re-derived
     from fp16), the fp32 masters round-trip bit-for-bit.
+
+    Atomicity (same contract as :func:`save_checkpoint`): the write lands
+    in a sibling tmp directory and is renamed over ``path`` only once
+    fully durable, so a preemption mid-save leaves the previous
+    checkpoint directory readable instead of a half-written tree.
     """
     import os
+    import shutil
 
     import orbax.checkpoint as ocp
 
+    final = os.path.abspath(path)
+    tmp = f"{final}.tmp.{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
     ckptr = ocp.StandardCheckpointer()
     # force=True: periodic checkpointing to one path (the normal loop
     # pattern) overwrites instead of raising 'Destination already exists'
-    ckptr.save(os.path.abspath(path), step.state, force=True)
+    ckptr.save(tmp, step.state, force=True)
     ckptr.wait_until_finished()
+    old = None
+    if os.path.exists(final):
+        # rename-aside + rename-in: never a moment where `final` is a
+        # partial tree (os.rename cannot replace a non-empty directory)
+        old = f"{final}.old.{os.getpid()}"
+        os.rename(final, old)
+    os.rename(tmp, final)
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
 
 
 class AsyncTrainStateSaver:

@@ -15,7 +15,6 @@ Usage (mirrors the reference README):
 """
 import argparse
 import os
-import pickle
 import time
 
 import numpy as np
@@ -40,6 +39,12 @@ def parse_args():
                         "checkpoint (.pth state dict or the reference "
                         "example's resume format)")
     p.add_argument("--checkpoint", default="checkpoint.pkl")
+    p.add_argument("--ckpt-dir", default="",
+                   help="rolling checkpoint directory (CheckpointManager): "
+                        "atomic async per-epoch saves + automatic resume "
+                        "from the newest valid checkpoint after preemption")
+    p.add_argument("--keep-n", type=int, default=3,
+                   help="checkpoints retained in --ckpt-dir")
     p.add_argument("--opt-level", default="O2",
                    choices=["O0", "O1", "O2", "O3"])
     p.add_argument("--loss-scale", default=None)
@@ -144,18 +149,32 @@ def main():
     model = parallel.DistributedDataParallel(model)
     criterion = nn.CrossEntropyLoss()
 
-    start_epoch = 0
-    if args.resume and os.path.exists(args.resume):
-        with open(args.resume, "rb") as f:
-            ck = pickle.load(f)
+    def load_ck(ck, source):
         for p, d in zip(model.parameters(), ck["model"]):
             p.data = jnp.asarray(d, p.data.dtype)
         for b, d in zip(model.buffers(), ck["buffers"]):
             b.data = jnp.asarray(d, b.data.dtype)
         optimizer.load_state_dict(ck["optimizer"])
         amp.load_state_dict(ck["amp"])
-        start_epoch = ck["epoch"]
-        print(f"=> resumed from {args.resume} (epoch {start_epoch})")
+        print(f"=> resumed from {source} (epoch {ck['epoch']})")
+        return ck["epoch"]
+
+    # preemption-safe auto-resume: every epoch lands atomically in the
+    # rolling --ckpt-dir, and restore_or_initialize() scans back past any
+    # save a preemption interrupted — rerunning the same command after a
+    # kill continues from the newest VALID epoch with no flags needed.
+    manager = runtime.CheckpointManager(args.ckpt_dir, keep_n=args.keep_n) \
+        if args.ckpt_dir else None
+    start_epoch = 0
+    if args.resume and os.path.exists(args.resume):
+        # --resume reads one explicit file (legacy pickles still load,
+        # with a warning; corrupt manifested files fail typed)
+        from apex_tpu.utils import load_checkpoint
+        start_epoch = load_ck(load_checkpoint(args.resume), args.resume)
+    elif manager is not None:
+        epoch, ck = manager.restore_or_initialize()
+        if ck is not None:
+            start_epoch = load_ck(ck, manager.path_for(epoch))
 
     if args.prof:
         from apex_tpu import pyprof
@@ -211,9 +230,17 @@ def main():
             "optimizer": optimizer.state_dict(),
             "amp": amp.state_dict(),
         }
-        with open(args.checkpoint, "wb") as f:
-            pickle.dump(ck, f)
-        print(f"=> saved {args.checkpoint}")
+        if manager is not None:
+            # async: pickling/IO overlap the next epoch; atomic + rolling
+            manager.save_async(epoch + 1, **ck)
+            print(f"=> checkpointing epoch {epoch + 1} to {args.ckpt_dir} "
+                  f"(async)")
+        else:
+            from apex_tpu.utils import save_checkpoint
+            save_checkpoint(args.checkpoint, **ck)   # atomic tmp+rename
+            print(f"=> saved {args.checkpoint}")
+    if manager is not None:
+        manager.close()     # block until the last write is durable
 
 
 def folder_loader(args):

@@ -123,6 +123,70 @@ def test_overflow_skips_step_and_halves_scale():
     assert opt._skip_next[0] is False  # reset by step()
 
 
+def test_overflow_streak_halves_scale_each_skip():
+    """Scaler edge dynamics BadStepGuard layers on: a STREAK of overflows
+    halves the scale once per skipped step (2^16 → 2^13 after three) and
+    never touches the params; the first clean step then applies."""
+    handle = amp.init()
+    model = _model()
+    params = list(model.parameters())
+    opt = handle.wrap_optimizer(FusedSGD(params, lr=0.1))
+    crit = nn.CrossEntropyLoss()
+    x, y = _data()
+    before = [np.asarray(p.data) for p in params]
+
+    for k in range(1, 4):
+        loss = crit(model(x), y) * 1.0e38
+        with opt.scale_loss(loss) as scaled:
+            scaled.backward()
+        opt.step()
+        opt.zero_grad()
+        assert opt._loss_scaler[0].loss_scale() == 2.0 ** (16 - k)
+        for p, b in zip(params, before):
+            np.testing.assert_array_equal(np.asarray(p.data), b)
+
+    loss = crit(model(x), y)
+    with opt.scale_loss(loss) as scaled:
+        scaled.backward()
+    opt.step()
+    handle._deactivate()
+    assert opt._loss_scaler[0].loss_scale() == 2.0 ** 13  # unchanged: clean
+    assert any(not np.array_equal(np.asarray(p.data), b)
+               for p, b in zip(params, before))           # step applied
+
+
+def test_guard_observes_reference_exact_skip_patching():
+    """BadStepGuard on the NON-deferred eager surface: the skip decision
+    is host-known (the one-shot step patch), so the guard sees the streak
+    without any device flag in the picture."""
+    from apex_tpu.runtime.resilience import (BadStepGuard,
+                                             TrainingDivergedError)
+
+    from apex_tpu import amp as amp_mod
+    from apex_tpu.amp._amp_state import reset
+    from apex_tpu.optimizers import FusedAdam
+
+    reset()
+    model = _model()
+    opt = FusedAdam(list(model.parameters()), lr=1e-3)
+    model, opt = amp_mod.initialize(model, opt, opt_level="O2", verbosity=0)
+    guard = BadStepGuard(patience=2, policy="raise")
+    guard.attach_optimizer(opt)
+    crit = nn.CrossEntropyLoss()
+    x, y = _data()
+
+    with pytest.raises(TrainingDivergedError):
+        for _ in range(4):
+            loss = crit(model(x), y) * 1.0e38
+            with amp_mod.scale_loss(loss, opt) as scaled:
+                scaled.backward()
+            opt.step()
+            opt.zero_grad()
+        guard.flush()
+    assert guard.stats["skipped"] >= 2
+    reset()
+
+
 def test_disabled_handle_is_passthrough():
     handle = amp.init(enabled=False)
     assert not handle.is_active()

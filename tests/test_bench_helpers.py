@@ -136,3 +136,16 @@ def test_opt_microbench_records_schema():
     (speedup,) = [r for r in recs if r["metric"] == "opt_step_us_speedup"]
     assert speedup["value"] > 0
     assert speedup["step_cache_stats"]["compiles"] >= 1
+
+
+def test_ckpt_microbench_records_schema(tmp_path):
+    """--ckpt-microbench stage: sync / async_submit / async_drain arms
+    plus the overlap factor, all on a small state so the test is quick."""
+    recs = bench.ckpt_microbench_records(total_mb=2, n_tensors=4,
+                                         repeats=2,
+                                         directory=str(tmp_path))
+    modes = {r["mode"] for r in recs if r["metric"] == "ckpt_save_ms"}
+    assert modes == {"sync", "async_submit", "async_drain"}
+    assert all(r["value"] >= 0 for r in recs)
+    (overlap,) = [r for r in recs if r["metric"] == "ckpt_save_overlap_x"]
+    assert overlap["value"] > 0

@@ -95,6 +95,83 @@ def test_arrays_come_back_as_host_numpy(tmp_path):
     assert isinstance(out["nested"][0], np.ndarray)
 
 
+def test_save_checkpoint_is_atomic_and_validated(tmp_path):
+    """The legacy surface rides the one resilience write path: tmp+rename
+    (a kill mid-write preserves the previous file), manifested content,
+    and a typed error — not garbage state dicts — on corruption."""
+    from apex_tpu.runtime import chaos
+    from apex_tpu.utils import CheckpointCorruptError
+
+    path = os.path.join(tmp_path, "c.pkl")
+    save_checkpoint(path, epoch=1)
+    with chaos.session() as c:
+        c.on("ckpt.mid_write", action="kill")
+        with pytest.raises(chaos.ChaosKilled):
+            save_checkpoint(path, epoch=2)
+    assert load_checkpoint(path)["epoch"] == 1    # previous copy intact
+
+    blob = bytearray(open(path, "rb").read())
+    blob[-5] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(path)
+
+
+def test_zero_grad_set_to_none_resume_exact_fused_adam(tmp_path):
+    """Regression: ``zero_grad(set_to_none=True)`` (the fused-path default
+    since PR 1 — grads dropped to None between steps, not zeroed) must not
+    perturb save→kill→restore: an O1 FusedAdam run under dynamic loss
+    scaling resumes EXACTLY (O1 keeps fp32 params, so unlike O2 there is
+    no lazily re-derived master to round-trip through fp16)."""
+    from apex_tpu.amp._amp_state import reset
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.runtime import chaos
+    from apex_tpu.runtime.resilience import CheckpointManager
+
+    def make():
+        reset()
+        nn.manual_seed(21)
+        m = nn.Sequential(nn.Linear(12, 24), nn.ReLU(), nn.Linear(24, 3))
+        opt = FusedAdam(list(m.parameters()), lr=0.01)
+        return amp.initialize(m, opt, opt_level="O1", verbosity=0)
+
+    x, y = _data()
+
+    def one_step(m, opt):
+        loss = nn.CrossEntropyLoss()(m(x), y)
+        with amp.scale_loss(loss, opt) as scaled:
+            scaled.backward()
+        opt.step()
+        opt.zero_grad(set_to_none=True)
+        for p in opt.param_groups[0]["params"]:
+            assert p.grad is None          # set_to_none really dropped them
+        return float(loss)
+
+    model, opt = make()
+    base = [one_step(model, opt) for _ in range(6)]
+
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    model, opt = make()
+    first = [one_step(model, opt) for _ in range(3)]
+    mgr.save(3, model=model.state_dict(), optimizer=opt.state_dict(),
+             amp=amp.state_dict())
+    # the NEXT save dies mid-write (chaos preemption): step-3 must survive
+    with chaos.session() as c:
+        c.on("ckpt.mid_write", action="kill")
+        with pytest.raises(chaos.ChaosKilled):
+            mgr.save(4, model=model.state_dict(),
+                     optimizer=opt.state_dict(), amp=amp.state_dict())
+
+    model, opt = make()                    # process restart
+    step, ckpt = mgr.restore_or_initialize()
+    assert step == 3
+    model.load_state_dict(ckpt["model"])
+    opt.load_state_dict(ckpt["optimizer"])
+    amp.load_state_dict(ckpt["amp"])
+    rest = [one_step(model, opt) for _ in range(3)]
+    np.testing.assert_array_equal(first + rest, base)
+
+
 def _fused_step(zero=False):
     import apex_tpu.nn as nn
     from apex_tpu.nn import functional as F

@@ -1,0 +1,637 @@
+"""Resilience runtime (apex_tpu/runtime/{resilience,chaos}.py): atomic
+checkpoint writes that survive a mid-write kill, manifest/checksum
+validation with fallback past corrupt files, async save with error
+surfacing, BadStepGuard escalation over the scaler's skip logic, and
+bounded-retry distributed init — every recovery path driven by the
+deterministic chaos harness."""
+import os
+import pickle
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.runtime import chaos
+from apex_tpu.runtime.resilience import (
+    BadStepGuard, CheckpointCorruptError, CheckpointManager,
+    CollectiveTimeoutError, DistributedInitError, SCHEMA_VERSION,
+    TrainingDivergedError, read_checkpoint_file, restore_state,
+    write_checkpoint_file)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_controller():
+    yield
+    chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# chaos harness semantics
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_deterministic_at_times_after():
+    c = chaos.ChaosController(seed=0)
+    c.on("p", action="fail", at=(1, 3))
+    c.on("q", action="fail", after=2, times=2)
+    fired = []
+    for i in range(5):
+        try:
+            c.fire("p")
+            fired.append(0)
+        except chaos.ChaosInjectedFailure:
+            fired.append(1)
+    assert fired == [0, 1, 0, 1, 0]
+    fired = []
+    for i in range(6):
+        try:
+            c.fire("q")
+            fired.append(0)
+        except chaos.ChaosInjectedFailure:
+            fired.append(1)
+    # after=2, times=2: fires on calls 2 and 3 only
+    assert fired == [0, 0, 1, 1, 0, 0]
+    assert [entry[0] for entry in c.log] == ["p", "p", "q", "q"]
+
+
+def test_chaos_session_installs_and_uninstalls():
+    assert not chaos.active()
+    with chaos.session() as c:
+        assert chaos.active()
+        c.on("x", action="kill")
+        with pytest.raises(chaos.ChaosKilled):
+            chaos.hook("x")
+    assert not chaos.active()
+    assert chaos.hook("x") is None  # no controller → no-op
+
+
+def test_chaos_callable_action_gets_context():
+    seen = {}
+    with chaos.session() as c:
+        c.on("pt", action=lambda ctx: seen.update(ctx) or "custom")
+        assert chaos.hook("pt", foo=7) == "custom"
+    assert seen["foo"] == 7 and seen["point"] == "pt" and seen["call"] == 0
+
+
+# ---------------------------------------------------------------------------
+# atomic writes + validation
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_write_leaves_no_tmp_and_roundtrips(tmp_path):
+    path = str(tmp_path / "c.pkl")
+    write_checkpoint_file(path, {"model": {"w": jnp.arange(4.0)}, "step": 7})
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+    out = read_checkpoint_file(path)
+    assert out["step"] == 7
+    np.testing.assert_array_equal(out["model"]["w"], np.arange(4.0))
+    assert isinstance(out["model"]["w"], np.ndarray)  # host numpy
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("point", ["ckpt.mid_write", "ckpt.pre_rename"])
+def test_kill_during_save_preserves_previous_checkpoint(tmp_path, point):
+    """THE atomicity claim: a save killed mid-write (or pre-rename) leaves
+    the previous checkpoint at the final path, bit-for-bit loadable."""
+    path = str(tmp_path / "c.pkl")
+    write_checkpoint_file(path, {"v": 1})
+    with chaos.session() as c:
+        c.on(point, action="kill")
+        with pytest.raises(chaos.ChaosKilled):
+            write_checkpoint_file(path, {"v": 2})
+    assert read_checkpoint_file(path)["v"] == 1
+
+
+def test_corrupt_checkpoint_raises_typed_error(tmp_path):
+    path = str(tmp_path / "c.pkl")
+    write_checkpoint_file(path, {"model": {"w": np.zeros(64)}})
+    blob = bytearray(open(path, "rb").read())
+    blob[-30] ^= 0xFF                      # bit rot inside the payload
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(CheckpointCorruptError):
+        read_checkpoint_file(path)
+
+
+def test_truncated_checkpoint_raises_typed_error(tmp_path):
+    path = str(tmp_path / "c.pkl")
+    write_checkpoint_file(path, {"model": {"w": np.zeros(64)}})
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:len(blob) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        read_checkpoint_file(path)
+
+
+def test_future_schema_raises(tmp_path):
+    path = str(tmp_path / "c.pkl")
+    with open(path, "wb") as f:
+        pickle.dump({"__apex_tpu_checkpoint__": SCHEMA_VERSION + 1,
+                     "manifest": {}, "payload": {}}, f)
+    with pytest.raises(CheckpointCorruptError, match="schema"):
+        read_checkpoint_file(path)
+
+
+def test_legacy_manifestless_pickle_loads_with_warning(tmp_path):
+    path = str(tmp_path / "legacy.pkl")
+    with open(path, "wb") as f:
+        pickle.dump({"model": {"w": np.ones(3)}, "epoch": 2}, f)
+    with pytest.warns(UserWarning, match="legacy"):
+        out = read_checkpoint_file(path)
+    assert out["epoch"] == 2
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+
+def test_manager_retention_keeps_newest(tmp_path):
+    m = CheckpointManager(str(tmp_path), keep_n=2)
+    for s in range(1, 6):
+        m.save(s, value=s)
+    assert m.all_steps() == [4, 5]
+    assert m.latest_step() == 5
+    assert m.restore()["value"] == 5
+    assert m.restore(step=4)["value"] == 4
+
+
+def test_manager_restore_or_initialize_empty(tmp_path):
+    m = CheckpointManager(str(tmp_path))
+    step, out = m.restore_or_initialize(lambda: {"fresh": True})
+    assert step is None and out == {"fresh": True}
+    step, out = m.restore_or_initialize()
+    assert step is None and out is None
+
+
+@pytest.mark.chaos
+def test_manager_survives_midwrite_kill_and_sweeps_tmp(tmp_path):
+    m = CheckpointManager(str(tmp_path), keep_n=3)
+    m.save(1, value=1)
+    with chaos.session() as c:
+        c.on("ckpt.mid_write", action="kill")
+        with pytest.raises(chaos.ChaosKilled):
+            m.save(2, value=2)
+    # honest kill debris: a partial tmp file, the final path untouched
+    assert any(".tmp." in f for f in os.listdir(tmp_path))
+    assert m.all_steps() == [1]
+    step, out = m.restore_or_initialize()
+    assert (step, out["value"]) == (1, 1)
+    m.save(3, value=3)                     # next save sweeps the debris
+    assert not any(".tmp." in f for f in os.listdir(tmp_path))
+
+
+def test_manager_falls_back_past_corrupt_to_latest_valid(tmp_path):
+    m = CheckpointManager(str(tmp_path), keep_n=5)
+    for s in (1, 2, 3):
+        m.save(s, value=s)
+    blob = bytearray(open(m.path_for(3), "rb").read())
+    blob[-10] ^= 0xFF
+    open(m.path_for(3), "wb").write(bytes(blob))
+    with pytest.warns(UserWarning, match="corrupt"):
+        step, out = m.restore_or_initialize()
+    assert (step, out["value"]) == (2, 2)
+
+
+def test_async_save_returns_immediately_and_surfaces_result(tmp_path):
+    m = CheckpointManager(str(tmp_path), keep_n=4)
+    handles = [m.save_async(s, value=jnp.full((8,), float(s)))
+               for s in (1, 2, 3)]
+    for h in handles:
+        h.wait(timeout=30)
+    assert m.all_steps() == [1, 2, 3]
+    np.testing.assert_array_equal(m.restore(2)["value"], np.full((8,), 2.0))
+    m.close()
+
+
+@pytest.mark.chaos
+def test_async_save_error_surfaces_on_wait(tmp_path):
+    m = CheckpointManager(str(tmp_path))
+    with chaos.session() as c:
+        c.on("ckpt.mid_write", action="fail")
+        h = m.save_async(1, value=1)
+        with pytest.raises(chaos.ChaosInjectedFailure):
+            h.wait(timeout=30)
+    assert m.all_steps() == []             # failed write cleaned its tmp
+    assert not any(".tmp." in f for f in os.listdir(tmp_path))
+
+
+def test_async_save_snapshot_isolated_from_later_mutation(tmp_path):
+    """The device→host transfer happens on the caller thread at submit
+    time: mutating the source dict (or advancing training) afterwards
+    must not change what lands on disk."""
+    m = CheckpointManager(str(tmp_path))
+    tree = {"w": jnp.ones((4,))}
+    h = m.save_async(1, model=tree)
+    tree["w"] = jnp.zeros((4,))
+    h.wait(timeout=30)
+    np.testing.assert_array_equal(m.restore(1)["model"]["w"], np.ones(4))
+    m.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: preemption-safe resume of a fused train step
+# ---------------------------------------------------------------------------
+
+
+def _fused_step():
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    nn.manual_seed(11)
+    model = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 8))
+    opt = FusedAdam(list(model.parameters()), lr=5e-3)
+    return make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=jnp.bfloat16, loss_scale="dynamic")
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.standard_normal((32, 16)), jnp.float32),
+            jnp.asarray(rng.integers(0, 8, (32,))))
+
+
+@pytest.mark.chaos
+def test_chaos_resume_matches_uninterrupted_run(tmp_path):
+    """The acceptance scenario: periodic saves, one killed mid-write by
+    chaos, 'process restart', restore_or_initialize() lands on the last
+    valid checkpoint and the resumed run's losses equal the uninterrupted
+    run's exactly."""
+    x, y = _batch()
+
+    base = _fused_step()
+    ref = [float(base(x, y)) for _ in range(8)]
+
+    m = CheckpointManager(str(tmp_path), keep_n=3)
+    s1 = _fused_step()
+    for i in range(1, 6):
+        s1(x, y)
+        if i == 3:
+            m.save(i, state=s1.state)
+        if i == 5:                         # preempted mid-save at step 5
+            with chaos.session() as c:
+                c.on("ckpt.mid_write", action="kill")
+                with pytest.raises(chaos.ChaosKilled):
+                    m.save(i, state=s1.state)
+    del s1                                 # the process is gone
+
+    s2 = _fused_step()                     # restart: fresh objects
+    step, comp = m.restore_or_initialize()
+    assert step == 3
+    s2.state = restore_state(comp["state"])
+    resumed = [float(s2(x, y)) for _ in range(5)]
+    np.testing.assert_array_equal(resumed, ref[3:])
+
+
+# ---------------------------------------------------------------------------
+# BadStepGuard
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_guard_escalates_warn_rollback_raise_on_fused_step():
+    step = _fused_step()
+    x, y = _batch(1)
+    guard = BadStepGuard(patience=3, policy=("warn", "rollback", "raise"),
+                         snapshot_interval=2)
+    guard.attach(step)
+    for _ in range(5):
+        step(x, y)
+    guard.flush()
+    assert guard.stats == {"observed": 5, "skipped": 0, "escalations": 0,
+                           "rollbacks": 0}
+    step_before_storm = int(step.state.step)
+
+    with chaos.session() as c:
+        c.on("train.step", action="nonfinite_grads", after=0, times=6)
+        with pytest.warns(UserWarning, match="BadStepGuard"):
+            for _ in range(6):
+                step(x, y)
+            guard.flush()
+    assert guard.stats["skipped"] == 6
+    assert guard.stats["escalations"] == 2     # warn, then rollback
+    assert guard.stats["rollbacks"] == 1
+    # rollback restored the last clean snapshot: the step counter is back
+    # at (or before) the pre-storm count, never past it
+    assert int(step.state.step) <= step_before_storm
+    # ...but the halved loss scale is KEPT (no immediate re-entry)
+    assert float(step.state.scaler.loss_scale) == 2.0 ** 16 / 2 ** 6
+
+    with chaos.session() as c:
+        c.on("train.step", action="nonfinite_grads", after=0, times=-1)
+        with pytest.raises(TrainingDivergedError), \
+                warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for _ in range(8):
+                step(x, y)
+            guard.flush()
+
+
+@pytest.mark.chaos
+def test_guard_rollback_resumes_trainable_state():
+    """After a rollback the step must keep training (state shapes/donation
+    intact) and losses must be finite again once the storm passes."""
+    step = _fused_step()
+    x, y = _batch(2)
+    guard = BadStepGuard(patience=2, policy="rollback", snapshot_interval=1)
+    guard.attach(step)
+    for _ in range(3):
+        step(x, y)
+    with chaos.session() as c:
+        c.on("train.step", action="nonfinite_grads", after=0, times=2)
+        with pytest.warns(UserWarning, match="BadStepGuard"):
+            for _ in range(2):
+                step(x, y)
+            guard.flush()
+    assert guard.stats["rollbacks"] == 1
+    post = [float(step(x, y)) for _ in range(3)]
+    guard.flush()
+    assert np.all(np.isfinite(post))
+    assert guard.stats["skipped"] == 2
+
+
+def test_guard_policy_validation():
+    with pytest.raises(ValueError):
+        BadStepGuard(patience=0)
+    with pytest.raises(ValueError):
+        BadStepGuard(policy="retrain-from-scratch")
+    with pytest.raises(ValueError):
+        BadStepGuard(policy=())
+
+
+def test_guard_single_stage_policy_is_sticky():
+    g = BadStepGuard(patience=2, policy="warn")
+    with pytest.warns(UserWarning, match="BadStepGuard"):
+        for _ in range(8):
+            g.observe(1)
+    assert g.stats["escalations"] == 4     # every 2 skips, never raises
+
+
+@pytest.mark.chaos
+def test_guard_adds_no_step_cache_dispatches_on_clean_path():
+    """Acceptance: the guard on the eager step-cache surface must not add
+    dispatches (= no extra cached executables launched) to the clean-step
+    hot path.  Runs the same loop with and without the guard and compares
+    step_cache dispatch counts."""
+    import apex_tpu.nn as nn
+    from apex_tpu import amp
+    from apex_tpu.amp._amp_state import reset
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.runtime import step_cache
+
+    def loop(guarded, steps=6):
+        reset()
+        nn.manual_seed(7)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+        opt = FusedAdam(list(model.parameters()), lr=1e-3)
+        model, opt = amp.initialize(model, opt, opt_level="O2",
+                                    verbosity=0, defer_scale_update=True)
+        guard = BadStepGuard(patience=3, policy="raise")
+        if guarded:
+            guard.attach_optimizer(opt)
+        crit = nn.CrossEntropyLoss()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 4, (8,)))
+        step_cache.reset_stats()
+        for _ in range(steps):
+            loss = crit(model(x), y)
+            with amp.scale_loss(loss, opt) as scaled:
+                scaled.backward()
+            opt.step()
+            opt.zero_grad()
+        guard.flush()
+        reset()
+        return step_cache.stats()["dispatches"], guard
+
+    base_dispatches, _ = loop(False)
+    guarded_dispatches, guard = loop(True)
+    assert guarded_dispatches == base_dispatches
+    assert guard.stats["observed"] == 6 and guard.stats["skipped"] == 0
+
+
+@pytest.mark.chaos
+def test_guard_escalates_on_eager_overflow_storm():
+    """Forced non-finite grads on the eager amp surface (chaos
+    ``amp.backward`` hook) drive the scaler's real skip machinery and the
+    guard's escalation."""
+    import apex_tpu.nn as nn
+    from apex_tpu import amp
+    from apex_tpu.amp._amp_state import _amp_state, reset
+    from apex_tpu.optimizers import FusedAdam
+
+    reset()
+    nn.manual_seed(7)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = FusedAdam(list(model.parameters()), lr=1e-3)
+    model, opt = amp.initialize(model, opt, opt_level="O2", verbosity=0,
+                                defer_scale_update=True)
+    guard = BadStepGuard(patience=3, policy=("warn", "raise"))
+    guard.attach_optimizer(opt)
+    crit = nn.CrossEntropyLoss()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (8,)))
+
+    with chaos.session() as c:
+        c.on("amp.backward", action="nonfinite_grads", after=0, times=-1)
+        with pytest.raises(TrainingDivergedError), \
+                warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for _ in range(10):
+                loss = crit(model(x), y)
+                with amp.scale_loss(loss, opt) as scaled:
+                    scaled.backward()
+                opt.step()
+                opt.zero_grad()
+            guard.flush()
+    # the storm really went through the scaler: scale halved per skip
+    assert _amp_state.loss_scalers[0].loss_scale() < 2.0 ** 16
+    assert guard.stats["skipped"] >= 6
+    reset()
+
+
+# ---------------------------------------------------------------------------
+# scaler edge dynamics the guard depends on
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_overflow_streak_clamps_at_min_loss_scale():
+    """A streak longer than any patience keeps halving only down to the
+    min_loss_scale floor — the state BadStepGuard escalates out of."""
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.training import make_train_step
+
+    nn.manual_seed(5)
+    model = nn.Sequential(nn.Linear(8, 8))
+    opt = FusedAdam(list(model.parameters()), lr=1e-3)
+    step = make_train_step(model, opt,
+                           lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=jnp.float16, loss_scale="dynamic",
+                           min_loss_scale=2.0 ** 10)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 8, (16,)))
+    with chaos.session() as c:
+        c.on("train.step", action="nonfinite_grads", after=0, times=-1)
+        for _ in range(12):                # 12 > log2(2^16/2^10) = 6
+            step(x, y)
+    assert float(step.state.scaler.loss_scale) == 2.0 ** 10
+    assert int(step.state.step) == 0       # every step skipped
+
+
+def test_scale_window_doubling_boundary():
+    """Growth fires at EXACTLY scale_window clean steps (and the counter
+    resets); an overflow at window-1 resets the streak without growth."""
+    from apex_tpu.amp import init_scaler_state, update_scale_state
+
+    state = init_scaler_state("dynamic")
+    for i in range(4):
+        state, skip = update_scale_state(state, dynamic=True, scale_window=5)
+        assert float(state.loss_scale) == 2.0 ** 16
+    state, skip = update_scale_state(state, dynamic=True, scale_window=5)
+    assert float(state.loss_scale) == 2.0 ** 17     # the boundary step
+    assert int(state.unskipped) == 0
+
+    # overflow one step short of the next window: halve + reset, no growth
+    for i in range(4):
+        state, _ = update_scale_state(state, dynamic=True, scale_window=5)
+    state = state._replace(overflow=jnp.ones((), jnp.int32))
+    state, skip = update_scale_state(state, dynamic=True, scale_window=5)
+    assert bool(skip)
+    assert float(state.loss_scale) == 2.0 ** 16
+    assert int(state.unskipped) == 0
+
+
+def test_long_streak_then_recovery_counts():
+    """update_scale_state over an overflow streak longer than a guard's
+    patience: scale halves per overflow (clamped), and the first clean
+    step restarts the unskipped counter from zero."""
+    from apex_tpu.amp import init_scaler_state, update_scale_state
+
+    state = init_scaler_state("dynamic")
+    for i in range(9):
+        state = state._replace(overflow=jnp.ones((), jnp.int32))
+        state, skip = update_scale_state(
+            state, dynamic=True, min_loss_scale=2.0 ** 12)
+        assert bool(skip)
+    assert float(state.loss_scale) == 2.0 ** 12     # clamped after 4 halvings
+    state, skip = update_scale_state(state, dynamic=True)
+    assert not bool(skip)
+    assert int(state.unskipped) == 1
+
+
+# ---------------------------------------------------------------------------
+# bounded-retry distributed init + collective timeout
+# ---------------------------------------------------------------------------
+
+
+def test_init_distributed_retries_until_success():
+    from apex_tpu.parallel import distributed as D
+
+    calls = []
+
+    def stub(**kw):
+        calls.append(kw)
+        if len(calls) < 3:
+            raise RuntimeError("coordinator not up yet")
+
+    D.init_distributed(coordinator_address="host:1234", num_processes=2,
+                       process_id=0, timeout_s=30, backoff_s=0.01,
+                       _initialize=stub)
+    assert len(calls) == 3
+    # per-attempt timeout is capped by the remaining overall deadline
+    assert all(kw["initialization_timeout"] <= 30 for kw in calls)
+    assert calls[0]["coordinator_address"] == "host:1234"
+
+
+def test_init_distributed_exhaustion_names_the_coordinator():
+    from apex_tpu.parallel import distributed as D
+
+    calls = []
+
+    def stub(**kw):
+        calls.append(kw)
+        raise RuntimeError("connection refused")
+
+    with pytest.raises(DistributedInitError) as ei:
+        D.init_distributed(coordinator_address="badhost:99",
+                           num_processes=4, process_id=2, timeout_s=5,
+                           max_retries=2, backoff_s=0.01, _initialize=stub)
+    assert len(calls) == 3                 # max_retries+1 attempts
+    msg = str(ei.value)
+    assert "badhost:99" in msg and "process_id=2" in msg \
+        and "connection refused" in msg
+
+
+def test_init_distributed_deadline_bounds_attempts():
+    from apex_tpu.parallel import distributed as D
+
+    def stub(**kw):
+        raise RuntimeError("down")
+
+    with pytest.raises(DistributedInitError):
+        # zero budget: must raise immediately, not sleep through retries
+        D.init_distributed(coordinator_address="h:1", num_processes=2,
+                           process_id=0, timeout_s=0.0, _initialize=stub)
+
+
+@pytest.mark.chaos
+def test_init_distributed_absorbs_chaos_failures_and_dies_to_kill():
+    from apex_tpu.parallel import distributed as D
+
+    calls = []
+    with chaos.session() as c:
+        c.on("dist.init", action="fail", times=2)
+        D.init_distributed(coordinator_address="h:1", num_processes=2,
+                           process_id=0, timeout_s=30, backoff_s=0.01,
+                           _initialize=lambda **kw: calls.append(kw))
+    assert len(calls) == 1                 # two injected failures absorbed
+
+    with chaos.session() as c:
+        c.on("dist.init", action="kill")
+        with pytest.raises(chaos.ChaosKilled):   # preemption ≠ flaky init
+            D.init_distributed(coordinator_address="h:1", num_processes=2,
+                               process_id=0, timeout_s=30, backoff_s=0.01,
+                               _initialize=lambda **kw: None)
+
+
+@pytest.mark.chaos
+def test_timed_flat_dist_call_timeout_names_missing_ranks():
+    from apex_tpu.parallel import distributed as D
+
+    tensors = [jnp.ones((4,)), jnp.ones((2, 2))]
+    D._PRESENCE_PROBE = lambda: [1, 3]
+    try:
+        with chaos.session() as c:
+            c.on("dist.collective", action="delay", delay_s=5.0)
+            with pytest.raises(CollectiveTimeoutError) as ei:
+                D.timed_flat_dist_call(tensors, lambda t: t * 2,
+                                       timeout_s=0.2)
+        assert "[1, 3]" in str(ei.value)
+    finally:
+        D._PRESENCE_PROBE = None
+
+
+def test_timed_flat_dist_call_passes_through():
+    from apex_tpu.parallel import distributed as D
+
+    tensors = [jnp.ones((4,)), jnp.full((2, 2), 3.0)]
+    out = D.timed_flat_dist_call(tensors, lambda t: t * 2, timeout_s=30)
+    np.testing.assert_array_equal(out[0], np.full((4,), 2.0))
+    np.testing.assert_array_equal(out[1], np.full((2, 2), 6.0))
+
+
+def test_timed_flat_dist_call_propagates_worker_errors():
+    from apex_tpu.parallel import distributed as D
+
+    def bad_call(t):
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        D.timed_flat_dist_call([jnp.ones((4,))], bad_call, timeout_s=30)
