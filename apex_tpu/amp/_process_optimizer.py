@@ -286,6 +286,53 @@ def post_backward_no_master_weights_FusedSGD(self, scaler):
     post_backward_no_master_weights(self, scaler)
 
 
+def finalize_delayed_unscale(optimizer, scaler=None):
+    """Settle gradients left scaled by ``scale_loss(delay_unscale=True)``.
+
+    The delayed-accumulation contract is that the LAST backward of the
+    window runs under a non-delayed ``scale_loss``, whose exit unscales
+    the accumulated gradients.  When the caller instead goes straight to
+    ``optimizer.step()`` the gradients are still scaled — stepping on
+    them would apply a loss_scale-times-too-large update, and a later
+    non-delayed ``scale_loss`` over the same buffers would unscale a
+    SECOND time.  The step wrappers call this first: it performs the one
+    pending unscale + dynamic-scale update and clears the stash flag, so
+    the window's unscale runs exactly once no matter how the caller ends
+    the window.  Returns ``(finalized, should_skip, scaler)``.
+    """
+    stash = optimizer._amp_stash
+    if not getattr(stash, "params_have_scaled_gradients", False):
+        return False, False, None
+    if scaler is None:
+        scaler = getattr(stash, "_delayed_scaler", None)
+    if scaler is None:
+        from ._amp_state import _amp_state
+        scaler = _amp_state.loss_scalers[0]
+    scaler.clear_overflow_state()
+    optimizer._post_amp_backward(scaler)
+    stash.params_have_scaled_gradients = False
+    stash._delayed_scaler = None
+    return True, scaler.update_scale(), scaler
+
+
+def _skip_delayed_overflow_step(optimizer, scaler):
+    """The overflow-skip behavior of handle.patch_step, for a window whose
+    unscale was finalized at step() time instead of at scale_loss exit."""
+    stash = optimizer._amp_stash
+    maybe_print(
+        "Gradient overflow.  Skipping step, loss scaler reducing loss "
+        f"scale to {scaler.loss_scale()}")
+    if hasattr(stash, "all_fp32_from_fp16_params"):
+        for param in stash.all_fp32_from_fp16_params:
+            param.grad = None
+    if hasattr(optimizer, "most_recent_scale"):
+        optimizer.most_recent_scale = 1.0
+        optimizer.scale_set_by_backward = False
+    guard = getattr(stash, "_guard", None)
+    if guard is not None:
+        guard.observe(1)
+
+
 def _amp_lazy_init(self):
     stash = self._amp_stash
     if not stash.lazy_init_called:
@@ -308,6 +355,9 @@ def _process_optimizer(optimizer, properties):
     # dynamic-scale update into the step program
     optimizer._amp_stash._model_params_synced = False
     optimizer._amp_stash._deferred_scaler = None
+    # scaler whose scaled gradients are pending from scale_loss
+    # (delay_unscale=True) — consumed by finalize_delayed_unscale
+    optimizer._amp_stash._delayed_scaler = None
 
     for name in ("_lazy_init_maybe_master_weights",
                  "_master_params_to_model_params",
@@ -330,6 +380,10 @@ def _process_optimizer(optimizer, properties):
             if closure is not None:
                 raise RuntimeError("Currently, Amp does not support closure "
                                    "use with optimizers.")
+            _, should_skip, scaler = finalize_delayed_unscale(self)
+            if should_skip:
+                _skip_delayed_overflow_step(self, scaler)
+                return None
             retval = old_step()
             if not isinstance(self, FusedSGD):
                 stash = self._amp_stash
@@ -382,6 +436,21 @@ def _process_optimizer(optimizer, properties):
     else:
         optimizer._lazy_init_maybe_master_weights = types.MethodType(
             lazy_init_no_master_weights, optimizer)
+
+        old_step_nm = optimizer.step
+
+        def new_step_nm(self, closure=None):
+            # delayed-unscale guard, as on the master-weights path: a
+            # step() closing an all-delayed accumulation window finalizes
+            # the pending unscale exactly once
+            _, should_skip, scaler = finalize_delayed_unscale(self)
+            if should_skip:
+                _skip_delayed_overflow_step(self, scaler)
+                return None
+            return old_step_nm() if closure is None else old_step_nm(closure)
+
+        optimizer.step = types.MethodType(new_step_nm, optimizer)
+
         if isinstance(optimizer, FusedSGD):
             optimizer._prepare_amp_backward = types.MethodType(
                 prepare_backward_no_master_weights_FusedSGD, optimizer)

@@ -74,11 +74,18 @@ def scale_loss(loss, optimizers, loss_id=0, model=None, delay_unscale=False,
     if delay_unscale:
         for optimizer in optimizers:
             optimizer._amp_stash.params_have_scaled_gradients = True
+            # remember WHICH scaler the scaled gradients carry, so a
+            # ``step()`` issued without a final non-delayed scale_loss can
+            # finalize the unscale itself (exactly once) instead of
+            # stepping on scaled gradients — see
+            # _process_optimizer.finalize_delayed_unscale
+            optimizer._amp_stash._delayed_scaler = loss_scaler
     else:
         loss_scaler.clear_overflow_state()
         for optimizer in optimizers:
             optimizer._post_amp_backward(loss_scaler)
             optimizer._amp_stash.params_have_scaled_gradients = False
+            optimizer._amp_stash._delayed_scaler = None
         # deferred mode (amp.initialize(..., defer_scale_update=True)): hand
         # the scaler to the optimizers' step-cache programs, which fuse the
         # overflow-conditional skip (lax.cond) and the dynamic-scale update

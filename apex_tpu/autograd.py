@@ -511,7 +511,18 @@ _COMPILED_CACHE_MAX = 64
 
 
 def backward(root: Tensor):
-    """Compute d(root)/d(params) and accumulate into ``.grad``."""
+    """Compute d(root)/d(params) and accumulate into ``.grad``.
+
+    Accumulation is part of the one compiled program: the existing
+    ``.grad`` arrays enter as (donated, where the backend supports
+    aliasing) inputs and the executable returns ``prev + new`` directly —
+    a K-microbatch gradient-accumulation loop
+    (``amp.scale_loss(..., delay_unscale=True)``) therefore costs K
+    backward dispatches and nothing else: no per-parameter eager adds, no
+    per-parameter dtype-cast dispatches, no extra buffers beyond the
+    running sums.  (jax retraces the same jitted callable for the
+    first-backward case, where every prev grad is None.)
+    """
     if root.value.size != 1:
         raise RuntimeError("backward() requires a scalar loss")
     program = _linearize(root)
@@ -520,11 +531,24 @@ def backward(root: Tensor):
 
     cached = _compiled_cache.get(program.cache_key)
     if cached is None:
+        grad_dtypes = tuple(jnp.dtype(p.dtype).name for p in program.params)
+
         def f(param_vals, const_vals, key_vals, prog=program):
             out = _execute(prog, param_vals, const_vals, key_vals)
             return out.astype(jnp.float32).reshape(())
 
-        cached = jax.jit(jax.value_and_grad(f))
+        def run(param_vals, prev_grads, const_vals, key_vals):
+            loss_val, grads = jax.value_and_grad(f)(param_vals, const_vals,
+                                                    key_vals)
+            out = []
+            for g, prev, d in zip(grads, prev_grads, grad_dtypes):
+                g = g.astype(d)
+                out.append(g if prev is None else prev + g)
+            return loss_val, out
+
+        from .runtime.step_cache import donation_enabled
+        cached = jax.jit(run,
+                         donate_argnums=(1,) if donation_enabled() else ())
         _compiled_cache[program.cache_key] = cached
         while len(_compiled_cache) > _COMPILED_CACHE_MAX:
             _compiled_cache.popitem(last=False)
@@ -533,11 +557,11 @@ def backward(root: Tensor):
         # module/param identities match (enforced by the id-based cache_key)
         _compiled_cache.move_to_end(program.cache_key)
 
-    loss_val, grads = cached([p.data for p in program.params],
+    prev_grads = [p.grad if p.requires_grad else None
+                  for p in program.params]
+    loss_val, grads = cached([p.data for p in program.params], prev_grads,
                              program.consts, program.key_consts)
     root.value = loss_val.astype(root.value.dtype)
     for p, g in zip(program.params, grads):
-        if not p.requires_grad:
-            continue
-        g = g.astype(p.dtype)
-        p.grad = g if p.grad is None else p.grad + g
+        if p.requires_grad:
+            p.grad = g

@@ -308,7 +308,8 @@ def speculative_generate(target, draft, prompt_ids, max_new_tokens,
             # replicated, and an unsharded counterpart model simply
             # computes replicated inside the same region
             from jax.sharding import PartitionSpec as _P
-            return jax.jit(jax.shard_map(
+            from ..compat import shard_map as _shard_map
+            return jax.jit(_shard_map(
                 run, mesh=mesh, in_specs=(_P(), _P(), _P(), _P()),
                 out_specs=(_P(), _P()), check_vma=False))
         return jax.jit(run)

@@ -136,7 +136,8 @@ class BertModel(nn.Module):
             # s is the LOCAL shard; guard the GLOBAL length — jax gather
             # clamps out-of-range indices, so an oversized sequence would
             # silently reuse the last position embedding (mirrors gpt.py)
-            n = jax.lax.axis_size(self.sp_axis)
+            from ..compat import axis_size as _axis_size
+            n = _axis_size(self.sp_axis)
             if s * n > self.max_positions:
                 raise ValueError(
                     f"global sequence length {s * n} exceeds "

@@ -466,7 +466,8 @@ class GptModel(nn.Module):
         if self.sp_axis is not None:
             ctx = _fold_shard_into_key(ctx, self.sp_axis)
             # s is the LOCAL shard; global position = shard offset + local
-            n = jax.lax.axis_size(self.sp_axis)
+            from ..compat import axis_size as _axis_size
+            n = _axis_size(self.sp_axis)
             if s * n > self.max_positions:
                 raise ValueError(
                     f"global sequence length {s * n} exceeds "
@@ -862,7 +863,8 @@ def generate(model: GptModel, prompt_ids, max_new_tokens, temperature=0.0,
             # everything replicated in and out; the TP sharding lives in
             # the trace-time head-block slices inside the blocks
             from jax.sharding import PartitionSpec as _P
-            return jax.jit(jax.shard_map(
+            from ..compat import shard_map as _shard_map
+            return jax.jit(_shard_map(
                 run, mesh=mesh, in_specs=(_P(), _P(), _P()),
                 out_specs=_P(), check_vma=False))
         return jax.jit(run)

@@ -541,7 +541,8 @@ class LlamaModel(nn.Module):
         head_dim = self.blocks[0].head_dim
         if self.sp_axis is not None:
             # ``s`` is the LOCAL shard; RoPE rotates by global positions
-            n = jax.lax.axis_size(self.sp_axis)
+            from ..compat import axis_size as _axis_size
+            n = _axis_size(self.sp_axis)
             if s * n > self.max_positions:
                 raise ValueError(
                     f"global sequence {s} x {n} shards exceeds "

@@ -278,7 +278,8 @@ def seq2seq_generate(model: TransformerSeq2Seq, src_ids, max_new_tokens,
     def build():
         if mesh is not None:
             from jax.sharding import PartitionSpec as _P
-            return jax.jit(jax.shard_map(
+            from ..compat import shard_map as _shard_map
+            return jax.jit(_shard_map(
                 run, mesh=mesh, in_specs=(_P(), _P(), _P(), _P()),
                 out_specs=_P(), check_vma=False))
         return jax.jit(run)

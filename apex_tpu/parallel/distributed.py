@@ -117,7 +117,8 @@ def all_reduce_mean(tensors, mesh: Optional[Mesh] = None,
         # one shard_map over the whole list: a single dispatch whose
         # collectives XLA's combiner can coalesce (the reference's bucketing,
         # distributed.py:425-475, done by the compiler)
-        fn = jax.shard_map(
+        from ..compat import shard_map as _shard_map
+        fn = _shard_map(
             lambda ts: [exchange(g) for g in ts], mesh=mesh,
             in_specs=P(axis), out_specs=P(axis), check_vma=False)
         for i, r in zip(todo, fn([tensors[i] for i in todo])):
@@ -459,6 +460,40 @@ class DistributedDataParallel(Module):
             average=self.gradient_average)
         for p, g in zip(params, new):
             p.grad = g
+
+    def attach_optimizer(self, optimizer):
+        """Wire the deferred gradient exchange into ``optimizer.step()``.
+
+        Requires ``delay_allreduce=True`` — the knob whose reference
+        meaning is "one exchange at the end of backward, no per-bucket
+        overlap" (apex/parallel/distributed.py:363-380).  Here the
+        boundary moves one step further, to the optimizer step: each
+        ``step()`` first runs ONE :meth:`allreduce_gradients` over the
+        accumulated ``.grad``s, then updates.  Under K-microbatch gradient
+        accumulation (``amp.scale_loss(delay_unscale=True)`` × K, one
+        ``step()``) that is exactly one exchange per window instead of
+        one per microbatch — gradient-exchange bytes drop by K×.  The
+        wrapper composes with amp's step patching (amp wraps first, DDP
+        attaches after, as in the examples): an amp overflow-skip replaces
+        ``optimizer.step`` for that one call, so a skipped window also
+        skips its exchange.  Returns the optimizer.
+        """
+        if not self.delay_allreduce:
+            raise ValueError(
+                "attach_optimizer requires delay_allreduce=True — with "
+                "eager per-backward exchange semantics a step-boundary "
+                "allreduce would exchange the same gradients twice")
+        if getattr(optimizer, "_ddp_attached", None) is self:
+            return optimizer
+        inner_step = optimizer.step
+
+        def step_with_exchange(closure=None):
+            self.allreduce_gradients()
+            return inner_step() if closure is None else inner_step(closure)
+
+        optimizer.step = step_with_exchange
+        optimizer._ddp_attached = self
+        return optimizer
 
     # DDP delegates module protocol (parameters/state_dict/etc. come from
     # Module via the registered child)

@@ -42,10 +42,16 @@ global batch, i.e. SyncBatchNorm semantics for free.
 """
 from __future__ import annotations
 
+import itertools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: per-wrapper token in the step_cache static key (two ZeroTrainSteps with
+#: identical signatures close over different base steps)
+_ZERO_TOKENS = itertools.count()
 
 
 def _leaf_sharding(x, mesh, axis, n):
@@ -113,6 +119,7 @@ class ZeroTrainStep:
                                              param_shard)
         self.state = jax.device_put(step.state, self.shardings)
         self._rep = NamedSharding(mesh, P())
+        self._token = next(_ZERO_TOKENS)
         self._jits = {}
         self._donate = donate
         self.compile_s = None
@@ -125,23 +132,41 @@ class ZeroTrainStep:
         return tuple(_leaf_sharding(b, self.mesh, self.axis, n)
                      for b in batch)
 
-    def _jitted(self, batch_shs):
-        f = self._jits.get(batch_shs)
-        if f is None:
-            f = jax.jit(
-                self._base._raw_step_fn,
-                in_shardings=(self.shardings,) + batch_shs,
-                out_shardings=(self.shardings, self._rep),
-                donate_argnums=(0,) if self._donate else ())
-            self._jits[batch_shs] = f
-        return f
+    def _jitted(self, batch_shs, args=None):
+        # the GSPMD window program is registered in the runtime
+        # step-program cache (kind "zero_train_step"), so cache stats pin
+        # compiles/dispatches per window exactly as on the plain fused
+        # path — under accum_steps=K the one dispatch carries the
+        # boundary-only reduce-scatter / all-gather pair GSPMD derives
+        # for the window.  ``args=None`` is the diagnostic surface (tests
+        # lower the returned callable themselves) and skips the counters.
+        from ..runtime import step_cache as _step_cache
+
+        def build():
+            f = self._jits.get(batch_shs)
+            if f is None:
+                f = jax.jit(
+                    self._base._raw_step_fn,
+                    in_shardings=(self.shardings,) + batch_shs,
+                    out_shardings=(self.shardings, self._rep),
+                    donate_argnums=(0,) if self._donate else ())
+                self._jits[batch_shs] = f
+            return f
+
+        if args is None:
+            return build()
+        fn = _step_cache.step_cache.program(
+            "zero_train_step", (self._token, batch_shs), args, build)
+        _step_cache.step_cache._bump("dispatches", "zero_train_step")
+        return fn
 
     def __call__(self, *batch):
         import time
         t0 = time.perf_counter() if self.compile_s is None else None
         shs = self._batch_shardings(batch)
         batch = tuple(jax.device_put(b, s) for b, s in zip(batch, shs))
-        self.state, loss = self._jitted(shs)(self.state, *batch)
+        args = (self.state,) + batch
+        self.state, loss = self._jitted(shs, args)(*args)
         if t0 is not None:
             self.compile_s = time.perf_counter() - t0
         return loss

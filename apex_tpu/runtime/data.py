@@ -26,11 +26,26 @@ class DataPrefetcher:
     fused native normalize→NCHW path; ``half_dtype`` additionally casts to
     bf16/fp16 on host before transfer (halving H2D bytes).  Iteration
     protocol matches the reference: ``next()`` returns (None, None) at end.
+
+    ``accum_steps=K`` delivers pre-stacked ``(K, B, ...)`` microbatch
+    blocks for the fused accumulation step
+    (``make_train_step(accum_steps=K, accum_stacked=True)``): K
+    consecutive loader batches are normalized/cast individually, stacked
+    on a new leading axis on the host, and transferred as one block — one
+    ``device_put`` (and one step dispatch) per accumulation window instead
+    of K.  The bounded queue keeps ``depth`` whole windows in flight, so
+    block N+1's host byte-work and transfer overlap window N's compute
+    exactly as with single batches.  A trailing partial window (loader
+    exhausted mid-block) is dropped, like a ``drop_last`` loader — the
+    step program's (K, B, ...) signature is static.
     """
 
     def __init__(self, loader, mean=None, std=None, half_dtype=None,
                  device=None, depth: int = 2, threads: int = 0,
-                 channels_last: bool = False):
+                 channels_last: bool = False, accum_steps: int = 1):
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self.accum_steps = accum_steps
         self.loader = iter(loader)
         # channels_last: keep uint8 batches NHWC through the normalize
         # (for nn.to_channels_last models — the decode layout IS the
@@ -82,14 +97,30 @@ class DataPrefetcher:
     def _run(self):
         import jax
         try:
+            window = []
             for images, target in self.loader:
                 if self._stop.is_set():
                     return
                 images = self._prepare(images)
-                images = jax.device_put(images, self.device)
-                target = jax.device_put(np.asarray(target), self.device)
-                if not self._put((images, target)):
+                if self.accum_steps == 1:
+                    images = jax.device_put(images, self.device)
+                    target = jax.device_put(np.asarray(target), self.device)
+                    if not self._put((images, target)):
+                        return
+                    continue
+                window.append((images, np.asarray(target)))
+                if len(window) < self.accum_steps:
+                    continue
+                # host-side stack into the (K, B, ...) block the fused
+                # accumulation step scans — one transfer per window
+                block = np.stack([w[0] for w in window])
+                tgt = np.stack([w[1] for w in window])
+                window = []
+                block = jax.device_put(block, self.device)
+                tgt = jax.device_put(tgt, self.device)
+                if not self._put((block, tgt)):
                     return
+            # a partial trailing window is dropped (drop_last semantics)
         except Exception as e:  # surface in the consumer thread
             self._put(e)
         self._put(None)

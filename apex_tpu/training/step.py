@@ -14,6 +14,7 @@ API-parity path.
 from __future__ import annotations
 
 import functools
+import itertools
 import time
 from typing import Callable, NamedTuple, Optional
 
@@ -21,8 +22,14 @@ import jax
 import jax.numpy as jnp
 
 from ..amp.scaler import ScalerState, update_scale_state
+from ..compat import axis_size as _axis_size
 from ..nn.modules import Ctx
 from ..nn.parameter import Parameter
+
+#: per-make_train_step token in the step_cache static key — two step
+#: programs with identical signatures but different closures (model /
+#: optimizer / loss_fn objects) must never share a cache entry
+_STEP_TOKENS = itertools.count()
 
 
 class StepState(NamedTuple):
@@ -602,6 +609,8 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     allreduce_always_fp32: bool = False,
                     donate_state: bool = True,
                     grad_accum_steps: int = 1,
+                    accum_steps: Optional[int] = None,
+                    accum_stacked: bool = False,
                     lr_schedule: Optional[Callable] = None,
                     rng_seed: int = 0,
                     zero_sharding: bool = False,
@@ -615,10 +624,21 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     output.  The step signature is ``step(state, *batch) -> (state, loss)``
     where ``batch[0]`` feeds the model and the full batch feeds ``loss_fn``.
 
-    ``grad_accum_steps=K`` runs the batch as K sequential microbatches
-    inside the SAME compiled step (a ``lax.scan``), accumulating gradients
-    in fp32 and applying one optimizer update — peak activation memory is
-    that of one microbatch.  Reported loss is the microbatch mean.  Batch
+    ``accum_steps=K`` (preferred name; ``grad_accum_steps`` is the
+    original spelling and stays accepted) runs the batch as K sequential
+    microbatches inside the SAME compiled step (a ``lax.scan``),
+    accumulating gradients in fp32 and applying one optimizer update —
+    peak activation memory is that of one microbatch.  By default the
+    step splits a flat ``(K*B, ...)`` batch itself; with
+    ``accum_stacked=True`` it consumes pre-stacked ``(K, B, ...)``
+    microbatch blocks (what ``runtime.DataPrefetcher(accum_steps=K)``
+    delivers) with no reshape.  Everything that follows the window —
+    optimizer update, master→half cast, dynamic-scale update, and the
+    DP/TP gradient exchange — happens exactly once at the window
+    boundary, and an overflow in ANY microbatch skips the whole window
+    (the flag ORs across microbatches through the fp32 accumulator: a
+    non-finite microbatch gradient keeps the sum non-finite).  Reported
+    loss is the microbatch mean.  Batch
     elements sharing the model input's leading dim are split; anything
     else (scalars, per-step constants, custom containers) is broadcast to
     every microbatch.  The step matches the full-batch step up to
@@ -694,6 +714,17 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     persistent gradient buffer — gradients are intermediates of the one
     jitted program and already land reduce-scattered into master shards.
     """
+    if accum_steps is not None:
+        if grad_accum_steps not in (1, accum_steps):
+            raise ValueError(
+                f"accum_steps={accum_steps} conflicts with "
+                f"grad_accum_steps={grad_accum_steps} — they are the same "
+                f"knob (accum_steps is the preferred spelling); pass one")
+        grad_accum_steps = int(accum_steps)
+    if accum_stacked and grad_accum_steps == 1:
+        raise ValueError(
+            "accum_stacked=True requires accum_steps > 1 — stacked "
+            "(K, B, ...) blocks only exist under accumulation")
     if flat_master and zero_sharding:
         raise ValueError(
             "flat_master=True excludes zero_sharding: ZeRO's win is "
@@ -721,7 +752,8 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             scale_window=scale_window, min_loss_scale=min_loss_scale,
             max_loss_scale=max_loss_scale, loss_scale=loss_scale,
             donate_state=False,
-            grad_accum_steps=grad_accum_steps, lr_schedule=lr_schedule,
+            grad_accum_steps=grad_accum_steps, accum_stacked=accum_stacked,
+            lr_schedule=lr_schedule,
             rng_seed=rng_seed)
         if zero_mesh is None:
             import numpy as _np
@@ -835,6 +867,16 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             def split(b):
                 def leaf(a):
                     n = a.shape[0]
+                    if accum_stacked:
+                        # (K, B, ...) blocks from the data pipeline: the
+                        # microbatch axis already leads, scan consumes it
+                        if n != grad_accum_steps:
+                            raise ValueError(
+                                f"accum_stacked=True with accum_steps="
+                                f"{grad_accum_steps}: batch leading dim "
+                                f"{n} is not the microbatch count — "
+                                f"expected (K, B, ...) stacked blocks")
+                        return a
                     if n % grad_accum_steps:
                         raise ValueError(
                             f"grad_accum_steps={grad_accum_steps}: batch "
@@ -890,7 +932,7 @@ def make_train_step(model, optimizer, loss_fn: Callable,
 
         # DP gradient exchange (psum over the mapped axis), with DDP knobs
         if axis_name is not None:
-            n = jax.lax.axis_size(axis_name)
+            n = _axis_size(axis_name)
             pre = gradient_predivide_factor
             post = n / gradient_predivide_factor
 
@@ -934,8 +976,30 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                                      opt_init, init_scale)
 
     if axis_name is None and tp_axis is None:
-        jit_step = jax.jit(step_fn,
+        # route through the runtime's step-program cache: the compiled
+        # window program is keyed on (per-builder token, K, stacking,
+        # donation) plus the argument signature, so step_cache.stats()
+        # pins exactly 1 compile and 1 dispatch per accumulation window —
+        # K is part of the STATIC key (a K=4 and a K=16 window are
+        # different executables), and the donated state means the scan's
+        # fp32 gradient accumulator and the carried masters/slots update
+        # in place across windows
+        from ..runtime import step_cache as _step_cache
+
+        token = next(_STEP_TOKENS)
+        static_key = (token, grad_accum_steps, accum_stacked,
+                      bool(donate_state))
+
+        def _build():
+            return jax.jit(step_fn,
                            donate_argnums=(0,) if donate_state else ())
+
+        def jit_step(state, *batch):
+            args = (state,) + batch
+            fn = _step_cache.step_cache.program("train_step", static_key,
+                                                args, _build)
+            _step_cache.step_cache._bump("dispatches", "train_step")
+            return fn(*args)
     else:
         jit_step = step_fn  # caller wraps in shard_map/pjit
 

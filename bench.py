@@ -1725,6 +1725,82 @@ def run_opt_microbench(args):
     return 0
 
 
+def accum_microbench_records(ks=(1, 4, 16), dim=256, micro_batch=8,
+                             warmup=2, timed_windows=10):
+    """``accum_step_us`` microbench: the one-executable accumulation window
+    (``make_train_step(accum_steps=K)``) at K ∈ {1, 4, 16}.
+
+    CPU-forced like ``--opt-microbench``: the quantities under test are
+    host dispatch count and program count per window — ``step_cache``
+    pins dispatches-per-window at 1 for every K, which is the tentpole
+    claim (K microbatches of work, O(1) dispatches).  ``accum_step_us``
+    is the wall time of one whole window (so it grows ~linearly in K on
+    CPU; the win is the flat dispatch/exchange count, not window time).
+    Returns a list of JSON-able records.
+    """
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.runtime import step_cache
+    from apex_tpu.training import make_train_step
+
+    records = []
+    rng = np.random.default_rng(0)
+    for k in ks:
+        nn.manual_seed(0)
+        model = nn.Sequential(nn.Linear(dim, dim), nn.ReLU(),
+                              nn.Linear(dim, dim), nn.ReLU(),
+                              nn.Linear(dim, 10))
+        opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+        step = make_train_step(model, opt,
+                               lambda o, t: F.cross_entropy(o, t),
+                               half_dtype=jnp.bfloat16,
+                               loss_scale="dynamic",
+                               accum_steps=k, accum_stacked=(k > 1))
+        if k > 1:
+            x = jnp.asarray(rng.standard_normal((k, micro_batch, dim)),
+                            jnp.float32)
+            y = jnp.asarray(rng.integers(0, 10, (k, micro_batch)))
+        else:
+            x = jnp.asarray(rng.standard_normal((micro_batch, dim)),
+                            jnp.float32)
+            y = jnp.asarray(rng.integers(0, 10, (micro_batch,)))
+        for _ in range(warmup):
+            step(x, y)
+        jax.block_until_ready(step.state.master_params[0])
+        step_cache.reset_stats()
+        t0 = time.perf_counter()
+        for _ in range(timed_windows):
+            step(x, y)
+        jax.block_until_ready(step.state.master_params[0])
+        dt = time.perf_counter() - t0
+        st = step_cache.stats()["by_kind"].get("train_step", {})
+        records.append({
+            "metric": "accum_step_us", "config": f"mlp_accum_k{k}",
+            "accum_steps": k, "micro_batch": micro_batch,
+            "platform": "cpu",
+            "accum_step_us": round(dt / timed_windows * 1e6, 1),
+            "accum_step_us_per_microbatch":
+                round(dt / timed_windows / k * 1e6, 1),
+            "dispatches_per_window":
+                round(st.get("dispatches", 0) / timed_windows, 3),
+            "compiles_in_timed_region": st.get("compiles", 0)})
+    return records
+
+
+def run_accum_microbench(args):
+    stage("accum_microbench",
+          "one-executable accumulation window, K in {1,4,16}, cpu")
+    for rec in accum_microbench_records():
+        emit(rec)
+    return 0
+
+
 def ckpt_microbench_records(total_mb=64, n_tensors=32, repeats=3,
                             directory=None):
     """``ckpt_save_ms`` microbench: CheckpointManager sync save vs async
@@ -1943,6 +2019,13 @@ def main():
                          "dispatch) at 1M/10M params, forced onto the CPU "
                          "backend so it reports even when the axon tunnel "
                          "is wedged")
+    ap.add_argument("--accum-microbench", action="store_true",
+                    help="accum_step_us stage: the one-executable "
+                         "gradient-accumulation window at K in {1,4,16} "
+                         "(make_train_step(accum_steps=K)); reports "
+                         "dispatches-per-window from step_cache.stats() "
+                         "— pinned at 1 for every K — CPU-forced like "
+                         "--opt-microbench")
     ap.add_argument("--ckpt-microbench", action="store_true",
                     help="ckpt_save_ms stage: CheckpointManager sync vs "
                          "async save (submit/drain split + overlap factor) "
@@ -1955,6 +2038,10 @@ def main():
     if args.opt_microbench:
         start_watchdog(args.budget_s)
         return run_opt_microbench(args)
+
+    if args.accum_microbench:
+        start_watchdog(args.budget_s)
+        return run_accum_microbench(args)
 
     if args.ckpt_microbench:
         start_watchdog(args.budget_s)

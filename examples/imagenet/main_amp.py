@@ -33,6 +33,12 @@ def parse_args():
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--print-freq", type=int, default=10)
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="gradient accumulation: treat every K consecutive "
+                        "batches as one effective batch — K delayed "
+                        "backwards (amp.scale_loss(delay_unscale=True)), "
+                        "ONE optimizer step / gradient exchange / scale "
+                        "update per window (docs/accumulation.md)")
     p.add_argument("--resume", default="", help="checkpoint to resume from")
     p.add_argument("--load-torch", default="",
                    help="initialize from a torch/torchvision ResNet "
@@ -146,7 +152,13 @@ def main():
     model, optimizer = amp.initialize(
         model, optimizer, opt_level=args.opt_level, loss_scale=loss_scale,
         keep_batchnorm_fp32=kbf)
-    model = parallel.DistributedDataParallel(model)
+    # under accumulation the explicit per-replica gradient exchange (if
+    # any) belongs at the step boundary: one allreduce per K-microbatch
+    # window, not one per backward
+    model = parallel.DistributedDataParallel(
+        model, delay_allreduce=(args.accum_steps > 1))
+    if args.accum_steps > 1:
+        model.attach_optimizer(optimizer)
     criterion = nn.CrossEntropyLoss()
 
     def load_ck(ck, source):
@@ -198,10 +210,19 @@ def main():
                 cap.__enter__()
             out = model(inp)
             loss = criterion(out, target)
-            with amp.scale_loss(loss, optimizer) as scaled_loss:
+            if args.accum_steps > 1:
+                # sum of K (loss/K)-gradients == the effective-batch mean
+                loss = loss / args.accum_steps
+            window_end = (i + 1) % args.accum_steps == 0
+            # delayed backwards accumulate scaled grads in the one
+            # compiled backward; the window-closing scale_loss unscales
+            # once and step() applies one update (docs/accumulation.md)
+            with amp.scale_loss(loss, optimizer,
+                                delay_unscale=not window_end) as scaled_loss:
                 scaled_loss.backward()
-            optimizer.step()
-            optimizer.zero_grad()
+            if window_end:
+                optimizer.step()
+                optimizer.zero_grad()
             if args.prof and i == 1:
                 cap.__exit__(None, None, None)
                 rows = pyprof.analyze()

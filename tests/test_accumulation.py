@@ -1,0 +1,431 @@
+"""One-executable gradient accumulation (ISSUE 4 tentpole).
+
+Pins the four acceptance properties of ``make_train_step(accum_steps=K)``:
+
+* numerics: the K-microbatch on-device scan matches K eager
+  ``scale_loss(delay_unscale=True)`` backwards + one ``optimizer.step()``
+  — bitwise for FusedSGD fp32, within tolerance for FusedAdam and the
+  bf16/fp16 master configurations (the eager surface accumulates in the
+  model's half dtype where the scan accumulates fp32);
+* overflow: a non-finite gradient in ANY single microbatch skips the
+  WHOLE window and halves the dynamic scale exactly once;
+* dispatch: one accumulation window is ONE cached XLA dispatch — 1
+  compile and 1 dispatch per window in ``step_cache.stats()`` even under
+  an on-device cosine lr schedule;
+* ZeRO: ``zero_sharding=True`` + ``accum_steps`` matches the plain
+  accumulated step (the reduce-scatter/all-gather pair fires once per
+  window inside the same one program).
+
+Plus the satellite guards: the delayed-unscale finalize at ``step()``
+(no double-unscale, no scaled-gradient step), DDP's
+``attach_optimizer`` one-exchange-per-window wiring, and the stacked
+``(K, B, ...)`` data-pipeline path.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu import amp
+from apex_tpu.nn import functional as F
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+from apex_tpu.runtime import step_cache
+from apex_tpu.training import make_train_step
+
+K, B, D, C = 4, 4, 6, 3
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    from apex_tpu.amp._amp_state import reset
+    step_cache.clear()
+    step_cache.reset_stats()
+    reset()
+    yield
+    step_cache.clear()
+    step_cache.reset_stats()
+    reset()
+
+
+def _block(seed=0):
+    rng = np.random.default_rng(seed)
+    xs = jnp.asarray(rng.standard_normal((K, B, D)), jnp.float32)
+    ys = jnp.asarray(rng.integers(0, C, (K, B)))
+    return xs, ys
+
+
+def _model(seed=7):
+    nn.manual_seed(seed)
+    return nn.Sequential(nn.Linear(D, 8), nn.ReLU(), nn.Linear(8, C))
+
+
+def _fused_masters(opt_cls, half, scale, lr=0.05, **kw):
+    """One fused accum_steps=K step over the stacked block → fp32 masters."""
+    xs, ys = _block()
+    m = _model()
+    opt = opt_cls(list(m.parameters()), lr=lr)
+    step = make_train_step(m, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=half, loss_scale=scale,
+                           accum_steps=K, accum_stacked=True, **kw)
+    step(xs, ys)
+    return [np.asarray(v, np.float32) for v in step.state.master_params]
+
+
+def _eager_masters(opt_cls, half, scale, lr=0.05):
+    """The reference pattern: K delayed backwards (loss/K) + one step."""
+    xs, ys = _block()
+    m = _model()
+    opt = opt_cls(list(m.parameters()), lr=lr)
+    m, opt = amp.initialize(m, opt, opt_level="O0" if half is None else "O2",
+                            loss_scale=scale, verbosity=0)
+    crit = nn.CrossEntropyLoss()
+    for i in range(K):
+        loss = crit(m(xs[i]), ys[i]) / K
+        with amp.scale_loss(loss, opt, delay_unscale=(i < K - 1)) as sl:
+            sl.backward()
+    opt.step()
+    return [np.asarray(p.data, np.float32)
+            for g in opt.param_groups for p in g["params"]]
+
+
+# ---------------------------------------------------------------------------
+# (a) numerics vs the eager K-step reference
+# ---------------------------------------------------------------------------
+
+def test_accum_matches_eager_sgd_fp32_bitwise():
+    fused = _fused_masters(FusedSGD, None, 1.0)
+    eager = _eager_masters(FusedSGD, None, 1.0)
+    for a, b in zip(fused, eager):
+        assert np.array_equal(a, b)
+
+
+@pytest.mark.parametrize("opt_cls,half,scale,tol", [
+    (FusedSGD, jnp.bfloat16, 128.0, 2e-3),
+    (FusedSGD, jnp.float16, 128.0, 2e-3),
+    (FusedAdam, None, 1.0, 1e-5),
+    (FusedAdam, jnp.bfloat16, 128.0, 5e-3),
+    (FusedAdam, jnp.float16, 128.0, 5e-3),
+], ids=["sgd-bf16", "sgd-fp16", "adam-fp32", "adam-bf16", "adam-fp16"])
+def test_accum_matches_eager_within_tol(opt_cls, half, scale, tol):
+    """Halves accumulate in half dtype on the eager surface and in fp32
+    inside the scan, so parity is tolerance-bounded, not bitwise."""
+    fused = _fused_masters(opt_cls, half, scale)
+    eager = _eager_masters(opt_cls, half, scale)
+    for a, b in zip(fused, eager):
+        np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+
+def test_flat_batch_equals_stacked_block():
+    """accum_steps over a flat (K*B, ...) batch and accum_stacked over the
+    pre-stacked (K, B, ...) block are the same program modulo the one
+    reshape — numerics identical."""
+    xs, ys = _block()
+
+    def run(stacked):
+        m = _model()
+        opt = FusedSGD(list(m.parameters()), lr=0.05)
+        step = make_train_step(m, opt,
+                               lambda o, t: F.cross_entropy(o, t),
+                               half_dtype=None, loss_scale=1.0,
+                               accum_steps=K, accum_stacked=stacked)
+        if stacked:
+            step(xs, ys)
+        else:
+            step(xs.reshape(K * B, D), ys.reshape(K * B))
+        return [np.asarray(v) for v in step.state.master_params]
+
+    for a, b in zip(run(True), run(False)):
+        assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# (b) overflow in any single microbatch
+# ---------------------------------------------------------------------------
+
+def test_overflow_in_one_microbatch_skips_window_halves_once():
+    xs, ys = _block()
+    # poison ONE microbatch: under fp16 with a 2**15 scale the scaled loss
+    # overflows, so that microbatch's gradients are non-finite — the flag
+    # must OR across the window
+    xs = xs.at[2].set(xs[2] * 1e4)
+    m = _model()
+    opt = FusedSGD(list(m.parameters()), lr=0.05)
+    step = make_train_step(m, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=jnp.float16, loss_scale="dynamic",
+                           accum_steps=K, accum_stacked=True)
+    before = [np.asarray(v) for v in step.state.master_params]
+    scale0 = float(step.state.scaler.loss_scale)
+    step(xs, ys)
+    # whole window skipped: masters untouched, step counter not advanced
+    for a, b in zip(before, step.state.master_params):
+        assert np.array_equal(a, np.asarray(b))
+    assert int(step.state.step) == 0
+    assert int(step.state.scaler.overflow) == 1
+    # the scale halves exactly ONCE for the window (not once per overflowed
+    # microbatch)
+    assert float(step.state.scaler.loss_scale) == scale0 / 2.0
+    # a clean follow-up window applies and does not touch the scale again
+    xs2, ys2 = _block(1)
+    step(xs2, ys2)
+    assert int(step.state.step) == 1
+    assert float(step.state.scaler.loss_scale) == scale0 / 2.0
+
+
+# ---------------------------------------------------------------------------
+# (c) one compile, one dispatch per window
+# ---------------------------------------------------------------------------
+
+def test_one_compile_one_dispatch_per_window_under_cosine_lr():
+    """The acceptance pin: a K=16 window is ONE cached XLA dispatch, and
+    an on-device cosine lr schedule never retraces it."""
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.standard_normal((16, 2, D)), jnp.float32)
+    ys = jnp.asarray(rng.integers(0, C, (16, 2)))
+    m = _model()
+    opt = FusedSGD(list(m.parameters()), lr=0.1)
+
+    def cosine(step_count):
+        return 0.5 * (1.0 + jnp.cos(step_count / 100.0 * math.pi))
+
+    step = make_train_step(m, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=None, loss_scale=1.0,
+                           accum_steps=16, accum_stacked=True,
+                           lr_schedule=cosine)
+    step_cache.reset_stats()
+    windows = 5
+    for _ in range(windows):
+        step(xs, ys)
+    stats = step_cache.stats()["by_kind"]["train_step"]
+    assert stats["compiles"] == 1
+    assert stats["dispatches"] == windows
+    assert stats["cache_hits"] == windows - 1
+
+
+def test_k_joins_the_static_cache_key():
+    """A K=2 and a K=4 window over byte-identical (K*B, ...) batches are
+    different executables — K is part of the static key, so flipping K
+    can never silently reuse the wrong program."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, D)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, C, (8,)))
+    step_cache.reset_stats()
+    for k in (2, 4):
+        m = _model()
+        opt = FusedSGD(list(m.parameters()), lr=0.05)
+        step = make_train_step(m, opt,
+                               lambda o, t: F.cross_entropy(o, t),
+                               half_dtype=None, loss_scale=1.0,
+                               accum_steps=k)
+        step(x, y)
+    stats = step_cache.stats()["by_kind"]["train_step"]
+    assert stats["compiles"] == 2 and stats["dispatches"] == 2
+
+
+# ---------------------------------------------------------------------------
+# (d) ZeRO + accumulation
+# ---------------------------------------------------------------------------
+
+def test_zero_accum_numerics_parity_and_dispatch():
+    xs, ys = _block()
+
+    def build(zero):
+        m = _model()
+        opt = FusedAdam(list(m.parameters()), lr=1e-3)
+        return make_train_step(m, opt,
+                               lambda o, t: F.cross_entropy(o, t),
+                               half_dtype=jnp.bfloat16, loss_scale=1.0,
+                               accum_steps=K, accum_stacked=True,
+                               zero_sharding=zero)
+
+    plain = build(False)
+    zstep = build(True)
+    step_cache.reset_stats()
+    for _ in range(3):
+        plain(xs, ys)
+        zstep(xs, ys)
+    for a, b in zip(plain.state.master_params, zstep.state.master_params):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-6, atol=2e-6)
+    # the whole K-microbatch ZeRO window is one dispatch of one program
+    zstats = step_cache.stats()["by_kind"]["zero_train_step"]
+    assert zstats["compiles"] == 1 and zstats["dispatches"] == 3
+
+
+# ---------------------------------------------------------------------------
+# data pipeline: stacked (K, B, ...) blocks
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_stacked_blocks_feed_the_fused_step():
+    from apex_tpu.runtime import DataPrefetcher
+
+    rng = np.random.default_rng(0)
+    batches = [(rng.standard_normal((B, D)).astype(np.float32),
+                rng.integers(0, C, (B,))) for _ in range(2 * K + 1)]
+    pre = DataPrefetcher(iter(batches), accum_steps=K)
+    m = _model()
+    opt = FusedSGD(list(m.parameters()), lr=0.05)
+    step = make_train_step(m, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=None, loss_scale=1.0,
+                           accum_steps=K, accum_stacked=True)
+    n = 0
+    for xb, yb in pre:
+        assert xb.shape == (K, B, D) and yb.shape == (K, B)
+        loss = step(xb, yb)
+        assert np.isfinite(float(loss))
+        n += 1
+    # 2K+1 loader batches = 2 whole windows; the partial tail is dropped
+    assert n == 2
+    assert int(step.state.step) == 2
+
+
+def test_prefetcher_accum_steps_validation():
+    from apex_tpu.runtime import DataPrefetcher
+    with pytest.raises(ValueError, match="accum_steps"):
+        DataPrefetcher(iter([]), accum_steps=0)
+
+
+# ---------------------------------------------------------------------------
+# argument validation
+# ---------------------------------------------------------------------------
+
+def test_accum_steps_conflicts_and_stacked_validation():
+    m = _model()
+    opt = FusedSGD(list(m.parameters()), lr=0.05)
+    loss_fn = lambda o, t: F.cross_entropy(o, t)  # noqa: E731
+    with pytest.raises(ValueError, match="same\\s+knob"):
+        make_train_step(m, opt, loss_fn, accum_steps=4, grad_accum_steps=2)
+    with pytest.raises(ValueError, match="accum_stacked"):
+        make_train_step(m, opt, loss_fn, accum_stacked=True)
+    step = make_train_step(m, opt, loss_fn, half_dtype=None, loss_scale=1.0,
+                           accum_steps=K, accum_stacked=True)
+    with pytest.raises(ValueError, match="microbatch count"):
+        step(jnp.zeros((K + 1, B, D)), jnp.zeros((K + 1, B), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# eager surface: delayed-unscale guard + DDP boundary exchange
+# ---------------------------------------------------------------------------
+
+def _eager_setup(scale=128.0):
+    m = _model()
+    opt = FusedSGD(list(m.parameters()), lr=0.05)
+    m, opt = amp.initialize(m, opt, opt_level="O2", loss_scale=scale,
+                            verbosity=0)
+    return m, opt, nn.CrossEntropyLoss(), _block()
+
+
+def test_step_finalizes_all_delayed_window_no_double_unscale():
+    """step() on an all-delayed window unscales exactly once — same result
+    as the canonical final-non-delayed pattern, and the NEXT window is
+    unaffected (the flag was cleared, nothing unscales twice)."""
+    def run(all_delayed):
+        m, opt, crit, (xs, ys) = _eager_setup()
+        for i in range(K):
+            delay = True if all_delayed else (i < K - 1)
+            loss = crit(m(xs[i]), ys[i]) / K
+            with amp.scale_loss(loss, opt, delay_unscale=delay) as sl:
+                sl.backward()
+        opt.step()
+        opt.zero_grad()
+        # follow-up single-batch window exercises the post-guard state
+        loss = crit(m(xs[0]), ys[0])
+        with amp.scale_loss(loss, opt) as sl:
+            sl.backward()
+        opt.step()
+        return [np.asarray(p.data, np.float32)
+                for g in opt.param_groups for p in g["params"]]
+
+    for a, b in zip(run(True), run(False)):
+        assert np.array_equal(a, b)
+
+
+def test_step_finalize_overflow_skips_and_halves():
+    m, opt, crit, (xs, ys) = _eager_setup(scale="dynamic")
+    from apex_tpu.amp._amp_state import _amp_state
+    scaler = _amp_state.loss_scalers[0]
+    scale0 = scaler.loss_scale()
+    before = [np.asarray(p.data, np.float32)
+              for g in opt.param_groups for p in g["params"]]
+    for i in range(K):
+        loss = crit(m(xs[i]), ys[i]) / K
+        with amp.scale_loss(loss, opt, delay_unscale=True) as sl:
+            sl.backward()
+    # poison one accumulated gradient: the finalize-unscale at step() must
+    # flag it, skip the update, and halve the scale once
+    opt._amp_lazy_init()
+    stash = opt._amp_stash
+    p0 = stash.all_fp16_params[0]
+    p0.grad = jnp.full_like(p0.grad, jnp.inf)
+    opt.step()
+    after = [np.asarray(p.data, np.float32)
+             for g in opt.param_groups for p in g["params"]]
+    for a, b in zip(before, after):
+        assert np.array_equal(a, b)
+    assert scaler.loss_scale() == scale0 / 2.0
+
+
+def test_ddp_attach_optimizer_one_exchange_per_window():
+    from apex_tpu.parallel import DistributedDataParallel
+
+    nn.manual_seed(3)
+    m = nn.Linear(D, C)
+    opt = FusedSGD(list(m.parameters()), lr=0.05)
+    ddp = DistributedDataParallel(m, delay_allreduce=True)
+    calls = []
+    orig = ddp.allreduce_gradients
+    ddp.allreduce_gradients = lambda: (calls.append(1), orig())[1]
+    ddp.attach_optimizer(opt)
+    crit = nn.CrossEntropyLoss()
+    # microbatch size divisible by the 8-device test mesh (DDP shards the
+    # incoming batch over the data axis)
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.standard_normal((K, 16, D)), jnp.float32)
+    ys = jnp.asarray(rng.integers(0, C, (K, 16)))
+    for i in range(K):
+        loss = crit(ddp(xs[i]), ys[i]) / K
+        loss.backward()
+    opt.step()
+    assert calls == [1]          # one exchange for the K-microbatch window
+    # attaching twice must not stack a second exchange
+    ddp.attach_optimizer(opt)
+    opt.zero_grad()
+    loss = crit(ddp(xs[0]), ys[0])
+    loss.backward()
+    opt.step()
+    assert calls == [1, 1]
+
+
+def test_ddp_attach_requires_delay_allreduce():
+    from apex_tpu.parallel import DistributedDataParallel
+
+    nn.manual_seed(3)
+    m = nn.Linear(D, C)
+    opt = FusedSGD(list(m.parameters()), lr=0.05)
+    with pytest.raises(ValueError, match="delay_allreduce"):
+        DistributedDataParallel(m).attach_optimizer(opt)
+
+
+def test_eager_accumulation_adds_no_per_param_dispatches():
+    """The fused backward returns ``prev + new`` from the ONE compiled
+    program: an accumulating backward is still exactly one executable
+    (second call is a cache hit on the same jitted callable)."""
+    from apex_tpu import autograd
+
+    nn.manual_seed(5)
+    m = nn.Linear(D, C)
+    crit = nn.CrossEntropyLoss()
+    xs, ys = _block()
+    autograd._compiled_cache.clear()
+    loss = crit(m(xs[0]), ys[0])
+    loss.backward()
+    g0 = [np.asarray(p.grad, np.float32) for p in m.parameters()]
+    assert len(autograd._compiled_cache) == 1
+    loss = crit(m(xs[1]), ys[1])
+    loss.backward()          # accumulates inside the same cached program
+    assert len(autograd._compiled_cache) == 1
+    g1 = [np.asarray(p.grad, np.float32) for p in m.parameters()]
+    for a, b in zip(g0, g1):
+        assert not np.array_equal(a, b)   # it DID accumulate
