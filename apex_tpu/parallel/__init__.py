@@ -26,6 +26,10 @@ from .pipeline import (PipelinedStack, build_1f1b_schedule,  # noqa: F401
                        pipeline_apply, ring_slots)
 from .expert_parallel import switch_moe  # noqa: F401
 from .zero import ZeroTrainStep, zero_state_sharding  # noqa: F401
+from . import auto  # noqa: F401
+from .auto import (  # noqa: F401
+    ChipSpec, ModelProfile, Plan, PlanReport, chip_spec, plan_training,
+    profile_model)
 
 
 def convert_syncbn_model(module, process_group=None, channel_last=False,

@@ -1,0 +1,1035 @@
+"""``parallel="auto"`` — an analytical parallelism planner + cost model.
+
+Every parallelism primitive in this framework is a manual knob on
+:func:`~apex_tpu.training.make_train_step` (``axis_name``, ``tp_axis``,
+``zero_sharding``/``zero_stage``, ``accum_steps``) or a model build option
+(``tp_axis=``, ``sp_axis=``, the chunked LM loss).  Picking the
+configuration is worth double-digit throughput (BENCH_HISTORY round 5:
++13–15% from the chunked vocab chain alone, batch-size plateaus that
+invert per model), and the AMP (arXiv:2210.07297) / Galvatron
+(arXiv:2504.03662) line of work shows an analytical cost model over
+(compute FLOPs, collective bytes, memory footprint) ranks parallel plans
+reliably without exhaustive on-device search.  This module is that brain:
+
+1. **enumerate** candidate plans — mesh factorizations dp × sp × tp, ZeRO
+   stage 0/1/3, gradient-accumulation K, chunked-loss on/off;
+2. **prune** memory-infeasible ones with an explicit HBM model (masters +
+   optimizer slots under the chosen ZeRO stage + half model copies +
+   gradient carry + activation peak under accumulation + the vocab-logits
+   working set vs the chunked-loss lever) — every rejection carries a
+   stated reason, nothing is pruned silently;
+3. **rank** the survivors with a roofline step-time model: per-device
+   FLOPs at the chip's derated peak, HBM bytes at its bandwidth, and
+   ring-model ICI time for every collective the plan will emit (psum /
+   reduce-scatter / all-gather / ppermute on the candidate mesh axes);
+4. **return** a :class:`Plan` whose ``describe()`` prints the predicted
+   ms/step, predicted HBM breakdown, the collectives it emits, and — via
+   :meth:`PlanReport.describe` — why rejected plans lost.
+
+The planner is pure host-side Python over static shapes.  Its model
+constants come from two places: the per-model FLOP/activation profile is
+measured from XLA's own cost analysis (``lower().cost_analysis()`` /
+``compile().memory_analysis()`` of the unsharded forward+backward at two
+probe batch sizes, linearly fitted), and the per-chip constants (peak
+FLOP/s, HBM bytes/bandwidth, ICI bandwidth/latency) live in the
+:data:`CHIPS` table, checked against ``bench.py --plan``'s
+predicted-vs-measured output.
+
+The planner only *drives* primitives that already exist and are tested:
+dp/ZeRO plans run through the GSPMD global-view path
+(:class:`~apex_tpu.parallel.zero.ZeroTrainStep`, stage 0 = replicated
+state / pure data parallelism), tp/sp plans through the
+``shard_map``-wrapped explicit-axis path — there are no new execution
+paths, and the step-program cache keys carry the plan so cache stats stay
+per-plan observables.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+#: per-wrap token in the step-program cache key — two planned steps with
+#: identical signatures close over different model/optimizer objects
+_PLAN_TOKENS = itertools.count()
+
+
+# ---------------------------------------------------------------------------
+# Chip constants (the calibration table — see docs/auto_parallel.md)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Per-device hardware constants the cost model prices against.
+
+    ``efficiency`` derates the spec-sheet peak to the sustained fraction a
+    well-tuned fused step reaches (the bench-measured MFU band, not the
+    marketing number).  ``shared_host=True`` marks *virtual* devices
+    (``--xla_force_host_platform_device_count``): they split one host's
+    cores and memory bus, so spreading work over more of them never buys
+    compute time — only memory-model wins — and every collective is a
+    host memcpy.  That inversion is deliberate: on the CPU test mesh the
+    planner must predict the order a CPU measurement produces.
+    """
+    name: str
+    peak_flops: float        # per device (bf16/fp16 ALU peak, FLOP/s)
+    hbm_bytes: float         # per device
+    hbm_bw: float            # bytes/s
+    ici_bw: float            # bytes/s per link direction
+    ici_latency_s: float     # per-hop collective latency
+    overhead_s: float        # fixed per-microbatch dispatch/loop overhead
+    efficiency: float = 0.45
+    shared_host: bool = False
+
+    def sustained_flops(self) -> float:
+        return self.peak_flops * self.efficiency
+
+
+#: bf16 peaks from public spec sheets; HBM/ICI figures are the same
+#: per-chip constants bench.py's MFU math uses.  The "cpu" entry models
+#: the 8-virtual-device test mesh: one shared host, collectives as
+#: memcpys, generous per-collective latency (thread rendezvous).
+CHIPS = {
+    "v6":  ChipSpec("v6",  918.0e12, 32e9, 1640e9, 180e9, 1e-6, 2e-6),
+    "v5p": ChipSpec("v5p", 459.0e12, 95e9, 2765e9, 200e9, 1e-6, 2e-6),
+    "v5e": ChipSpec("v5e", 197.0e12, 16e9,  819e9,  50e9, 1e-6, 2e-6),
+    "v4":  ChipSpec("v4",  275.0e12, 32e9, 1228e9, 100e9, 1e-6, 2e-6),
+    "v3":  ChipSpec("v3",  123.0e12, 32e9,  900e9,  70e9, 1e-6, 2e-6),
+    "cpu": ChipSpec("cpu",   40.0e9,  4e9,   20e9,   4e9, 30e-6, 150e-6,
+                    efficiency=1.0, shared_host=True),
+}
+
+
+def chip_spec(devices=None) -> ChipSpec:
+    """Match the running device kind to the constants table (cpu
+    fallback; unknown accelerators borrow the v4 numbers)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    kind = (getattr(devices[0], "device_kind", "") or
+            devices[0].platform or "").lower()
+    if "cpu" in kind or devices[0].platform == "cpu":
+        return CHIPS["cpu"]
+    for key in ("v6", "v5p", "v5e", "v5 lite", "v4", "v3"):
+        if key in kind:
+            return CHIPS.get(key, CHIPS["v5e"]) if key != "v5 lite" \
+                else CHIPS["v5e"]
+    return CHIPS["v4"]
+
+
+# ---------------------------------------------------------------------------
+# Model profile — XLA-measured FLOPs/activation footprint + capabilities
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelProfile:
+    """Static-shape profile the cost model scales per plan.
+
+    ``flops_per_example`` / ``act_bytes_per_example`` /
+    ``hbm_bytes_per_example`` are linear-fit slopes over the batch dim
+    measured from XLA's own cost analysis of the unsharded
+    forward+backward at two probe batch sizes (``source="xla"``), or the
+    6·N·tokens fallback when the model cannot lower unsharded
+    (``source="analytic"``).  The ``*_fixed`` intercepts capture the
+    batch-independent part (weights traffic, per-call scratch).
+    """
+    n_params: int
+    param_shapes: tuple
+    param_bytes_fp32: int
+    half_itemsize: int                 # 0 when params stay fp32
+    slots_per_param: int               # fp32 optimizer slot multiplicity
+    batch_ref: int                     # global batch the plan prices for
+    batch_bytes_per_example: float
+    flops_per_example: float
+    flops_fixed: float
+    act_bytes_per_example: float
+    act_bytes_fixed: float
+    hbm_bytes_per_example: float
+    hbm_bytes_fixed: float
+    logits_bytes_per_example: float    # vocab-head working set (chunk lever)
+    seq_len: Optional[int]
+    vocab: Optional[int]
+    hidden: Optional[int]
+    layers: Optional[int]
+    heads: Optional[int]
+    tp_axis: Optional[str]             # model capability (build option)
+    sp_axis: Optional[str]
+    source: str = "xla"
+
+
+def _optimizer_slots(optimizer) -> int:
+    from ..optimizers import FusedAdam, FusedLAMB, FusedNovoGrad, FusedSGD
+    if isinstance(optimizer, (FusedAdam, FusedLAMB)):
+        return 2
+    if isinstance(optimizer, (FusedSGD, FusedNovoGrad)):
+        return 1
+    return 2        # unknown: price like Adam, the common case
+
+
+def _batch_leaves(batch_el):
+    return [a for a in jax.tree_util.tree_leaves(batch_el)
+            if hasattr(a, "shape")]
+
+
+def _global_batch_of(example_batch) -> int:
+    leaves = _batch_leaves(example_batch[0])
+    if not leaves or not leaves[0].shape:
+        raise ValueError(
+            "example_batch[0] (the model input) has no leading batch "
+            "dimension — the planner needs the global batch size")
+    return int(leaves[0].shape[0])
+
+
+def _resize_batch(example_batch, b):
+    """ShapeDtypeStruct copy of the batch with splittable elements'
+    leading dim set to ``b`` (same broadcast rule as the fused step:
+    elements whose every leaf shares the model input's batch dim
+    split, anything else is carried whole)."""
+    n0 = _global_batch_of(example_batch)
+
+    def splittable(el):
+        leaves = _batch_leaves(el)
+        return bool(leaves) and all(
+            len(a.shape) >= 1 and a.shape[0] == n0 for a in leaves)
+
+    def resize(el, do):
+        def leaf(a):
+            shape = ((b,) + tuple(a.shape[1:])) if do else tuple(a.shape)
+            return jax.ShapeDtypeStruct(shape, jnp.dtype(a.dtype))
+        return jax.tree_util.tree_map(leaf, el)
+
+    return tuple(resize(el, i == 0 or splittable(el))
+                 for i, el in enumerate(example_batch))
+
+
+def _introspect(model):
+    blocks = getattr(model, "blocks", None)
+    layers = len(blocks) if blocks is not None else None
+    heads = None
+    if blocks is not None and len(blocks):
+        for attr in ("heads", "num_heads", "n_heads"):
+            heads = getattr(blocks[0], attr, None)
+            if heads is None:
+                attn = getattr(blocks[0], "attn", None)
+                heads = getattr(attn, "heads", None) if attn is not None \
+                    else None
+            if heads is not None:
+                break
+    return dict(
+        vocab=getattr(model, "vocab_size", None),
+        hidden=getattr(model, "hidden", None),
+        layers=layers, heads=heads,
+        tp_axis=getattr(model, "tp_axis", None),
+        sp_axis=getattr(model, "sp_axis", None))
+
+
+def profile_model(model, optimizer, loss_fn: Callable, example_batch, *,
+                  half_dtype=None, keep_batchnorm_fp32: bool = True,
+                  rng_seed: int = 0) -> ModelProfile:
+    """Measure the model's per-example FLOPs / activation / HBM-traffic
+    slopes from XLA's own cost analysis of the unsharded fwd+bwd, at two
+    probe batch sizes (pure lower+compile, nothing executes).
+
+    A model built with ``tp_axis=``/``sp_axis=`` cannot trace unsharded
+    (its forward psums over mesh axes), so it falls back to the analytic
+    6·N FLOP estimate with ``source="analytic"``.
+    """
+    from ..training.step import _model_dtypes
+    from ..nn.modules import Ctx
+
+    params = [p for p in model.parameters() if p is not None]
+    buffers = list(model.buffers())
+    model_dtypes = _model_dtypes(model, params, half_dtype,
+                                 keep_batchnorm_fp32)
+    n_params = sum(int(np.prod(p.data.shape)) for p in params)
+    param_bytes = n_params * 4
+    half_itemsize = 0 if half_dtype is None else jnp.dtype(half_dtype).itemsize
+    info = _introspect(model)
+    b_hi = _global_batch_of(example_batch)
+    act_itemsize = half_itemsize or 4
+    batch_bytes = sum(
+        int(np.prod(a.shape)) * jnp.dtype(a.dtype).itemsize
+        for el in example_batch for a in _batch_leaves(el)) / max(b_hi, 1)
+
+    leaves0 = _batch_leaves(example_batch[0])
+    seq_len = (int(leaves0[0].shape[1])
+               if leaves0 and len(leaves0[0].shape) >= 2
+               and np.issubdtype(np.dtype(leaves0[0].dtype), np.integer)
+               else info["layers"] and getattr(model, "max_positions", None))
+    logits_bpe = (float(seq_len) * info["vocab"] * 4.0
+                  if seq_len and info["vocab"] else 0.0)
+
+    def fwd(vals, *batch):
+        env = {id(p): v for p, v in zip(params, vals)}
+        env.update({id(bf): jnp.asarray(bf.data) for bf in buffers})
+        ctx = Ctx(env=env, stats_out={}, training=True,
+                  key=jax.random.PRNGKey(rng_seed))
+        x = batch[0]
+        if half_dtype is not None:
+            from ..amp.policy import _cast_tree
+            x = _cast_tree(x, jnp.dtype(half_dtype))
+        out = model.forward(ctx, x)
+        loss = loss_fn(out, *batch[1:])
+        if ctx.aux_losses:
+            loss = loss + sum(ctx.aux_losses)
+        return loss.astype(jnp.float32)
+
+    vals_struct = [jax.ShapeDtypeStruct(tuple(p.data.shape), jnp.dtype(d))
+                   for p, d in zip(params, model_dtypes)]
+    b_lo = max(1, b_hi // 2)
+    if b_lo == b_hi:
+        b_hi = b_lo + 1
+
+    def probe(b):
+        batch = _resize_batch(example_batch, b)
+        lowered = jax.jit(jax.value_and_grad(fwd)).lower(
+            vals_struct, *batch)
+        ca = lowered.cost_analysis()
+        if not isinstance(ca, dict):        # older jax returns [dict]
+            ca = ca[0]
+        ma = lowered.compile().memory_analysis()
+        return (float(ca.get("flops", 0.0)),
+                float(ca.get("bytes accessed", 0.0)),
+                float(ma.temp_size_in_bytes))
+
+    common = dict(
+        n_params=n_params,
+        param_shapes=tuple(tuple(p.data.shape) for p in params),
+        param_bytes_fp32=param_bytes,
+        half_itemsize=half_itemsize,
+        slots_per_param=_optimizer_slots(optimizer),
+        batch_ref=_global_batch_of(example_batch),
+        batch_bytes_per_example=batch_bytes,
+        logits_bytes_per_example=logits_bpe,
+        seq_len=seq_len, **info)
+
+    if info["tp_axis"] is not None or info["sp_axis"] is not None:
+        tokens = float(seq_len or 1)
+        flops_pe = 6.0 * n_params * tokens
+        return ModelProfile(
+            flops_per_example=flops_pe, flops_fixed=0.0,
+            act_bytes_per_example=12.0 * act_itemsize * (
+                (info["layers"] or 1) * (info["hidden"] or n_params ** 0.5)
+                * tokens) + logits_bpe,
+            act_bytes_fixed=0.0,
+            hbm_bytes_per_example=flops_pe / 50.0, hbm_bytes_fixed=0.0,
+            source="analytic", **common)
+
+    f_lo, h_lo, a_lo = probe(b_lo)
+    f_hi, h_hi, a_hi = probe(b_hi)
+    db = b_hi - b_lo
+
+    def fit(lo, hi):
+        slope = max((hi - lo) / db, 0.0)
+        return slope, max(lo - slope * b_lo, 0.0)
+
+    f_s, f_0 = fit(f_lo, f_hi)
+    h_s, h_0 = fit(h_lo, h_hi)
+    a_s, a_0 = fit(a_lo, a_hi)
+    return ModelProfile(
+        flops_per_example=f_s, flops_fixed=f_0,
+        act_bytes_per_example=a_s, act_bytes_fixed=a_0,
+        hbm_bytes_per_example=h_s, hbm_bytes_fixed=h_0,
+        source="xla", **common)
+
+
+# ---------------------------------------------------------------------------
+# Plan
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One point in the (dp × sp × tp × zero × accum × chunked) space,
+    with the cost model's predictions attached.  Hashable — the
+    structural part (:meth:`key`) is embedded in step-program cache keys
+    so compiled executables are per-plan observables."""
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    zero_stage: int = 0
+    accum: int = 1
+    chunked_loss: bool = False
+    dp_axis: str = "data"
+    tp_axis: Optional[str] = None
+    sp_axis: Optional[str] = None
+    n_devices: int = 1                   # devices the planner priced for
+    predicted_ms: Optional[float] = None
+    predicted_hbm: Optional[int] = None
+    breakdown: tuple = ()                # ((name, value), ...) — hashable
+    collectives: tuple = ()
+    measured_ms: Optional[float] = None
+
+    def key(self):
+        """The structural identity embedded in program cache keys."""
+        return (self.dp, self.tp, self.sp, self.zero_stage, self.accum,
+                self.chunked_loss)
+
+    @property
+    def n_used(self) -> int:
+        return self.dp * self.tp * self.sp
+
+    def name(self) -> str:
+        parts = [f"dp{self.dp}"]
+        if self.sp > 1:
+            parts.append(f"sp{self.sp}")
+        if self.tp > 1:
+            parts.append(f"tp{self.tp}")
+        if self.zero_stage:
+            parts.append(f"zero{self.zero_stage}")
+        if self.accum > 1:
+            parts.append(f"K{self.accum}")
+        if self.chunked_loss:
+            parts.append("chunked")
+        return "·".join(parts)
+
+    def step_kwargs(self, devices=None) -> dict:
+        """The existing make_train_step knobs this plan threads — the
+        planner drives tested primitives, it adds no execution path."""
+        kw = {}
+        if self.accum > 1:
+            kw["accum_steps"] = self.accum
+        if self.tp == 1 and self.sp == 1:
+            if self.dp > 1:
+                kw.update(zero_sharding=True, zero_stage=self.zero_stage,
+                          zero_axis=self.dp_axis)
+                if devices is not None:
+                    kw["zero_mesh"] = Mesh(
+                        np.array(list(devices)[:self.dp]), (self.dp_axis,))
+        else:
+            axes = []
+            if self.dp > 1:
+                axes.append(self.dp_axis)
+            if self.sp > 1:
+                axes.append(self.sp_axis)
+            if axes:
+                kw["axis_name"] = axes[0] if len(axes) == 1 else tuple(axes)
+            if self.tp > 1:
+                kw["tp_axis"] = self.tp_axis
+        return kw
+
+    def _fmt_bytes(self, b):
+        return f"{b / 2**30:.2f} GiB" if b >= 2**30 else \
+            f"{b / 2**20:.1f} MiB"
+
+    def describe(self) -> str:
+        bd = dict(self.breakdown)
+        lines = [
+            f"Plan {self.name()}  (mesh dp={self.dp} sp={self.sp} "
+            f"tp={self.tp}, {self.n_used} of {self.n_devices} devices, "
+            f"ZeRO stage {self.zero_stage}, accum K={self.accum}, "
+            f"chunked_loss={'on' if self.chunked_loss else 'off'})"]
+        if self.predicted_ms is not None:
+            lines.append(f"  predicted {self.predicted_ms:.3f} ms/step"
+                         + (f" (measured {self.measured_ms:.3f})"
+                            if self.measured_ms is not None else ""))
+            lines.append(
+                "  time: compute {:.3f} + hbm {:.3f} (roofline max) "
+                "+ collectives {:.3f} + overhead {:.3f} ms".format(
+                    bd.get("compute_ms", 0.0), bd.get("hbm_ms", 0.0),
+                    bd.get("collective_ms", 0.0),
+                    bd.get("overhead_ms", 0.0)))
+        if self.predicted_hbm is not None:
+            mem = " + ".join(
+                f"{k[4:]} {self._fmt_bytes(v)}"
+                for k, v in self.breakdown if k.startswith("mem_"))
+            lines.append(f"  predicted HBM {self._fmt_bytes(self.predicted_hbm)}"
+                         f"/device = {mem}")
+        if self.collectives:
+            lines.append("  collectives: " + "; ".join(self.collectives))
+        else:
+            lines.append("  collectives: none (single-device program)")
+        kw = self.step_kwargs()
+        if kw:
+            lines.append("  knobs: " + ", ".join(
+                f"{k}={v!r}" for k, v in kw.items()))
+        if self.chunked_loss:
+            lines.append(
+                "  note: priced with the chunked LM head+loss "
+                "(contrib.chunked_lm_loss) — the plan does not swap your "
+                "loss_fn; see docs/auto_parallel.md")
+        return "\n".join(lines)
+
+
+def static_plan_key(plan):
+    """Hashable normalization used by the step-program cache keys (re-
+    exported by runtime.step_cache); None passes through for unplanned
+    steps."""
+    return None if plan is None else plan.key()
+
+
+# ---------------------------------------------------------------------------
+# Cost model: memory feasibility + roofline step time
+# ---------------------------------------------------------------------------
+
+#: chunked LM loss default chunk count: the working-set divisor the
+#: memory lever is priced at (contrib's default chunking)
+CHUNKS = 8
+
+#: fraction of HBM the planner refuses to plan into (XLA scratch,
+#: fragmentation, the runtime's own buffers)
+HBM_RESERVE = 0.08
+
+
+def _zero_shard_bytes(prof: ModelProfile, itemsize: int, n: int) -> int:
+    """Exact per-tensor ZeRO sharding: dim-0-divisible tensors shard n
+    ways, the rest stay replicated (zero.py's `_leaf_sharding` rule)."""
+    total = 0
+    for shape in prof.param_shapes:
+        b = int(np.prod(shape)) * itemsize
+        if n > 1 and shape and shape[0] >= n and shape[0] % n == 0:
+            b //= n
+        total += b
+    return total
+
+
+def predict_memory(plan: Plan, prof: ModelProfile, spec: ChipSpec,
+                   global_batch: int):
+    """Per-device steady-state training footprint: returns
+    ``(total_bytes, breakdown)`` with one entry per component."""
+    shard_n = plan.dp if plan.zero_stage >= 1 else 1
+    masters = _zero_shard_bytes(prof, 4, shard_n)
+    slots = prof.slots_per_param * masters
+    half = 0
+    if prof.half_itemsize:
+        half = _zero_shard_bytes(
+            prof, prof.half_itemsize,
+            plan.dp if plan.zero_stage == 3 else 1)
+    # gradient carry/working set, per path: the K>1 scan holds a full
+    # replicated fp32 accumulator; a K=1 ZeRO program's gradients land
+    # reduce-scattered (per-device 1/dp); a stage-0 all-reduce holds
+    # grad + collective double buffer; single-device holds one grad set
+    if plan.accum > 1:
+        # window accumulator + the per-microbatch gradient it adds
+        grads = 2 * prof.param_bytes_fp32
+    elif plan.zero_stage >= 1 and plan.dp > 1:
+        # reduce-scattered shards, double-buffered through the collective
+        grads = 2 * _zero_shard_bytes(prof, 4, plan.dp)
+    elif plan.dp > 1:
+        # full grads + the all-reduce double buffer
+        grads = 2 * prof.param_bytes_fp32
+    else:
+        grads = prof.param_bytes_fp32
+    micro_b = global_batch / (plan.dp * plan.accum)
+    tp_act = (1.0 + 1.0 / plan.tp) / 2.0   # sharded FFN/heads, full residual
+    acts = (prof.act_bytes_per_example * micro_b / plan.sp * tp_act
+            + prof.act_bytes_fixed)
+    if plan.chunked_loss and prof.logits_bytes_per_example:
+        acts -= (prof.logits_bytes_per_example * micro_b / plan.sp
+                 * (1.0 - 1.0 / CHUNKS))
+        acts = max(acts, 0.0)
+    batch = prof.batch_bytes_per_example * global_batch / plan.dp / plan.sp
+    bd = [("mem_masters", masters), ("mem_slots", slots),
+          ("mem_half", half), ("mem_grads", grads),
+          ("mem_acts", int(acts)), ("mem_batch", int(batch))]
+    return int(masters + slots + half + grads + acts + batch), bd
+
+
+def _ring_all_reduce_s(bytes_, n, spec):
+    if n <= 1 or bytes_ <= 0:
+        return 0.0
+    return 2 * (n - 1) / n * bytes_ / spec.ici_bw \
+        + 2 * (n - 1) * spec.ici_latency_s
+
+
+def _ring_half_s(bytes_, n, spec):
+    """One reduce-scatter OR all-gather pass."""
+    if n <= 1 or bytes_ <= 0:
+        return 0.0
+    return (n - 1) / n * bytes_ / spec.ici_bw + (n - 1) * spec.ici_latency_s
+
+
+def predict_time(plan: Plan, prof: ModelProfile, spec: ChipSpec,
+                 global_batch: int):
+    """Roofline step time: ``max(compute, HBM) + collectives + overhead``.
+    Returns ``(ms, breakdown, collectives)``."""
+    n_used = plan.n_used
+    micro_b = global_batch / (plan.dp * plan.accum)
+    act_itemsize = prof.half_itemsize or 4
+    w_itemsize = prof.half_itemsize or 4
+
+    flops = (prof.flops_per_example * global_batch / n_used
+             + plan.accum * prof.flops_fixed)
+    # virtual devices split one host: per-plan sustained rate is the
+    # host's, not n_used × the host's
+    sustained = spec.sustained_flops() / (n_used if spec.shared_host else 1)
+    compute_s = flops / sustained
+
+    weight_traffic = plan.accum * prof.n_params * w_itemsize / plan.tp
+    if plan.zero_stage == 3:
+        weight_traffic /= plan.dp
+    hbm_bytes = (prof.hbm_bytes_per_example * global_batch / n_used
+                 + plan.accum * prof.hbm_bytes_fixed + weight_traffic)
+    if plan.chunked_loss and prof.logits_bytes_per_example:
+        hbm_bytes -= (prof.logits_bytes_per_example * global_batch / n_used
+                      * (1.0 - 1.0 / CHUNKS))
+    hbm_bw = spec.hbm_bw / (n_used if spec.shared_host else 1)
+    hbm_s = max(hbm_bytes, 0.0) / hbm_bw
+
+    coll_s, colls = 0.0, []
+    gbytes = prof.param_bytes_fp32
+    if plan.dp > 1:
+        if plan.zero_stage == 0:
+            coll_s += _ring_all_reduce_s(gbytes, plan.dp, spec)
+            colls.append(f"all-reduce fp32 grads ({_mib(gbytes)}) over "
+                         f"{plan.dp_axis}({plan.dp}) at the window boundary")
+        else:
+            coll_s += _ring_half_s(gbytes, plan.dp, spec)
+            colls.append(f"reduce-scatter fp32 grads ({_mib(gbytes)}) into "
+                         f"master shards over {plan.dp_axis}({plan.dp})")
+            ag = prof.n_params * w_itemsize
+            coll_s += _ring_half_s(ag, plan.dp, spec)
+            colls.append(f"all-gather updated params ({_mib(ag)}) over "
+                         f"{plan.dp_axis}({plan.dp})")
+        if plan.zero_stage == 3:
+            ag3 = plan.accum * prof.n_params * w_itemsize
+            coll_s += plan.accum * _ring_half_s(
+                prof.n_params * w_itemsize, plan.dp, spec)
+            colls.append(f"per-microbatch param all-gather (stage 3, "
+                         f"K×{_mib(prof.n_params * w_itemsize)} = "
+                         f"{_mib(ag3)}/step)")
+    if plan.tp > 1:
+        if prof.layers and prof.hidden and prof.seq_len:
+            per_micro = (4.0 * prof.layers * micro_b * prof.seq_len
+                         / plan.sp * prof.hidden * act_itemsize)
+        else:
+            per_micro = 0.5 * prof.act_bytes_per_example * micro_b
+        tp_bytes = plan.accum * per_micro
+        coll_s += plan.accum * _ring_all_reduce_s(per_micro, plan.tp, spec)
+        colls.append(f"activation all-reduce (row-parallel psum, "
+                     f"{_mib(tp_bytes)}/step) over "
+                     f"{plan.tp_axis or 'tp'}({plan.tp})")
+        shard_grads = 0.66 * gbytes     # head/FFN block fraction
+        coll_s += _ring_all_reduce_s(shard_grads, plan.tp, spec)
+        colls.append(f"block-sparse grad assembly psum "
+                     f"({_mib(shard_grads)}) over "
+                     f"{plan.tp_axis or 'tp'}({plan.tp})")
+    if plan.sp > 1:
+        if prof.layers and prof.hidden and prof.seq_len:
+            kv = (2.0 * prof.layers * micro_b * prof.seq_len
+                  * prof.hidden * act_itemsize)
+        else:
+            kv = 0.3 * prof.act_bytes_per_example * micro_b
+        coll_s += plan.accum * _ring_all_reduce_s(kv, plan.sp, spec)
+        colls.append(f"ring ppermute of K/V blocks ({_mib(kv)}/microbatch) "
+                     f"over {plan.sp_axis or 'sp'}({plan.sp})")
+        coll_s += _ring_all_reduce_s(gbytes, plan.sp, spec)
+        colls.append(f"all-reduce fp32 grads ({_mib(gbytes)}) over "
+                     f"{plan.sp_axis or 'sp'}({plan.sp})")
+
+    overhead_s = plan.accum * spec.overhead_s
+    total_s = max(compute_s, hbm_s) + coll_s + overhead_s
+    bd = [("compute_ms", compute_s * 1e3), ("hbm_ms", hbm_s * 1e3),
+          ("collective_ms", coll_s * 1e3), ("overhead_ms", overhead_s * 1e3)]
+    return total_s * 1e3, bd, colls
+
+
+def _mib(b):
+    return f"{b / 2**20:.1f} MiB"
+
+
+# ---------------------------------------------------------------------------
+# Enumeration + ranking
+# ---------------------------------------------------------------------------
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def enumerate_plans(n_devices: int, *, chunked_loss=False,
+                    accum_max: int = 32, global_batch: int):
+    """Yield the raw candidate space: full-mesh dp×sp×tp factorizations
+    plus partial pure-dp meshes (for batch-divisibility limits), ZeRO
+    stages where the framework supports them (dp-only meshes — the
+    GSPMD ZeRO path excludes explicit tp/sp axes), accumulation K over
+    divisors of the local batch, and the chunked-loss lever."""
+    meshes = set()
+    for dp in _divisors(n_devices):
+        rest = n_devices // dp
+        for sp in _divisors(rest):
+            meshes.add((dp, sp, rest // sp))
+        meshes.add((dp, 1, 1))       # partial mesh: idle devices allowed
+    chunk_opts = (False, True) if chunked_loss is None else (chunked_loss,)
+    for dp, sp, tp in sorted(meshes):
+        zero_opts = (0, 1, 3) if (dp > 1 and sp == 1 and tp == 1) else (0,)
+        local = global_batch // dp if dp and global_batch % dp == 0 else 1
+        ks = [k for k in _divisors(max(local, 1))
+              if k <= accum_max and (k & (k - 1)) == 0]
+        for zero in zero_opts:
+            for k in ks or [1]:
+                for ch in chunk_opts:
+                    yield Plan(dp=dp, sp=sp, tp=tp, zero_stage=zero,
+                               accum=k, chunked_loss=ch,
+                               n_devices=n_devices)
+
+
+@dataclasses.dataclass
+class PlanReport:
+    """Planner output: the ranked feasible plans, and every rejected
+    plan with its stated reason — nothing is pruned silently."""
+    best: Optional[Plan]
+    ranked: list
+    rejected: list                      # [(Plan, reason)]
+    profile: ModelProfile
+    chip: ChipSpec
+    global_batch: int
+    hbm_cap: float
+
+    def describe(self, top: int = 5) -> str:
+        out = [f"auto-parallel plan report — {self.chip.name}, "
+               f"global batch {self.global_batch}, HBM cap "
+               f"{self.hbm_cap / 2**30:.2f} GiB/device, model "
+               f"{self.profile.n_params / 1e6:.2f}M params "
+               f"(profile: {self.profile.source})"]
+        if self.best is None:
+            out.append("NO FEASIBLE PLAN — every candidate was rejected:")
+        else:
+            out.append(f"chosen: {self.best.name()}")
+            out.append(self.best.describe())
+            out.append(f"runners-up (of {len(self.ranked)} feasible):")
+            for p in self.ranked[1:top]:
+                why = (f"+{p.predicted_ms - self.best.predicted_ms:.3f} ms "
+                       f"predicted vs chosen"
+                       if p.predicted_ms is not None else "")
+                out.append(f"  {p.name():<24} {p.predicted_ms:9.3f} ms  "
+                           f"{(p.predicted_hbm or 0) / 2**20:9.1f} MiB  "
+                           f"{why}")
+        shown = self.rejected[:max(top * 3, 12)]
+        if shown:
+            out.append(f"rejected ({len(self.rejected)}):")
+            for p, reason in shown:
+                out.append(f"  {p.name():<24} {reason}")
+            if len(self.rejected) > len(shown):
+                out.append(f"  ... {len(self.rejected) - len(shown)} more "
+                           f"(same reason classes)")
+        return "\n".join(out)
+
+
+def plan_training(model, optimizer, loss_fn: Callable, example_batch, *,
+                  devices=None, half_dtype=None,
+                  keep_batchnorm_fp32: bool = True,
+                  chip: Optional[ChipSpec] = None,
+                  hbm_cap_bytes: Optional[float] = None,
+                  hbm_reserve: float = HBM_RESERVE,
+                  accum_max: int = 32,
+                  chunked_loss=False,
+                  profile: Optional[ModelProfile] = None) -> PlanReport:
+    """Enumerate → prune (memory, capability) → rank (roofline).
+
+    ``chunked_loss``: what the caller's ``loss_fn`` actually is (the
+    planner cannot swap it) — pass ``None`` to enumerate both and see
+    the lever's predicted effect in the report.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    spec = chip or chip_spec(devices)
+    prof = profile or profile_model(
+        model, optimizer, loss_fn, example_batch, half_dtype=half_dtype,
+        keep_batchnorm_fp32=keep_batchnorm_fp32)
+    global_batch = _global_batch_of(example_batch)
+    cap = hbm_cap_bytes if hbm_cap_bytes is not None \
+        else spec.hbm_bytes * (1.0 - hbm_reserve)
+
+    feasible, rejected = [], []
+    for plan in enumerate_plans(len(devices), chunked_loss=chunked_loss,
+                                accum_max=accum_max,
+                                global_batch=global_batch):
+        reason = _structural_reject(plan, prof, global_batch)
+        if reason is not None:
+            rejected.append((plan, reason))
+            continue
+        plan = dataclasses.replace(
+            plan,
+            tp_axis=prof.tp_axis if plan.tp > 1 else None,
+            sp_axis=prof.sp_axis if plan.sp > 1 else None)
+        mem, mem_bd = predict_memory(plan, prof, spec, global_batch)
+        if mem > cap:
+            over = dict(mem_bd)
+            reason = (
+                f"memory-infeasible: needs {mem / 2**20:.1f} MiB/device > "
+                f"cap {cap / 2**20:.1f} MiB (masters "
+                f"{over['mem_masters'] / 2**20:.1f} + slots "
+                f"{over['mem_slots'] / 2**20:.1f} + half "
+                f"{over['mem_half'] / 2**20:.1f} + grads "
+                f"{over['mem_grads'] / 2**20:.1f} + acts "
+                f"{over['mem_acts'] / 2**20:.1f} + batch "
+                f"{over['mem_batch'] / 2**20:.1f})")
+            rejected.append((dataclasses.replace(
+                plan, predicted_hbm=mem, breakdown=tuple(mem_bd)), reason))
+            continue
+        ms, time_bd, colls = predict_time(plan, prof, spec, global_batch)
+        feasible.append(dataclasses.replace(
+            plan, predicted_ms=ms, predicted_hbm=mem,
+            breakdown=tuple(time_bd + mem_bd), collectives=tuple(colls)))
+
+    # deterministic rank: predicted time, then fewer devices, lower
+    # stage, smaller K (simpler plans win ties)
+    feasible.sort(key=lambda p: (p.predicted_ms, p.n_used, p.zero_stage,
+                                 p.accum, p.tp, p.sp))
+    return PlanReport(best=feasible[0] if feasible else None,
+                      ranked=feasible, rejected=rejected, profile=prof,
+                      chip=spec, global_batch=global_batch, hbm_cap=cap)
+
+
+def _structural_reject(plan: Plan, prof: ModelProfile,
+                       global_batch: int) -> Optional[str]:
+    if plan.dp > 1 and global_batch % plan.dp:
+        return (f"global batch {global_batch} not divisible by "
+                f"dp={plan.dp}")
+    if plan.tp > 1:
+        if prof.tp_axis is None:
+            return (f"tp={plan.tp} needs a model built with tp_axis= "
+                    f"(this one was built unsharded — rebuild with "
+                    f"tp_axis='tp' to enable tensor parallelism)")
+        if prof.heads and prof.heads % plan.tp:
+            return (f"tp={plan.tp} does not divide the model's "
+                    f"{prof.heads} attention heads")
+    if plan.sp > 1:
+        if prof.sp_axis is None:
+            return (f"sp={plan.sp} needs a model built with sp_axis= "
+                    f"(ring attention) — rebuild to enable sequence "
+                    f"parallelism")
+        if prof.seq_len and prof.seq_len % plan.sp:
+            return (f"sp={plan.sp} does not divide sequence length "
+                    f"{prof.seq_len}")
+    if plan.chunked_loss and not prof.logits_bytes_per_example:
+        return ("chunked_loss priced but the model exposes no vocab head "
+                "(no logits working set to chunk)")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Applying a plan: thread the existing knobs / wrap the explicit-axis path
+# ---------------------------------------------------------------------------
+
+
+def _resolve_devices(devices):
+    if devices is None:
+        return list(jax.devices())
+    if isinstance(devices, int):
+        ds = list(jax.devices())
+        if devices > len(ds):
+            raise ValueError(f"asked to plan for {devices} devices, "
+                             f"have {len(ds)}")
+        return ds[:devices]
+    return list(devices)
+
+
+def apply_plan(plan: Plan, model, optimizer, loss_fn, devices=None,
+               **base_kwargs):
+    """Build the train step a plan describes by threading the existing
+    make_train_step knobs (dp/ZeRO plans run the GSPMD global-view path,
+    tp/sp plans the explicit shard_map path).  The returned step carries
+    ``.plan``."""
+    from ..training.step import make_train_step
+    devices = _resolve_devices(devices)
+    if plan.n_used > len(devices):
+        raise ValueError(f"plan {plan.name()} needs {plan.n_used} devices, "
+                         f"have {len(devices)}")
+    kw = dict(base_kwargs)
+    kw.pop("parallel", None)
+    for knob in ("axis_name", "tp_axis", "zero_sharding", "zero_mesh"):
+        if kw.pop(knob, None):
+            raise ValueError(
+                f"parallel= owns the {knob} knob — pass one or the other")
+    kw.update(plan.step_kwargs(devices))
+
+    if plan.tp == 1 and plan.sp == 1:
+        step = make_train_step(model, optimizer, loss_fn, _plan=plan, **kw)
+        step.plan = plan
+        return step
+
+    # explicit-axis path: the tested shard_map wrap (tp / sp / dp×tp)
+    if plan.tp > 1 and getattr(model, "tp_axis", None) is None:
+        raise ValueError(
+            f"plan {plan.name()} uses tensor parallelism but the model "
+            f"was built without tp_axis= — rebuild the model with "
+            f"tp_axis={plan.tp_axis or 'tp'!r}")
+    if plan.sp > 1 and getattr(model, "sp_axis", None) is None:
+        raise ValueError(
+            f"plan {plan.name()} uses sequence parallelism but the model "
+            f"was built without sp_axis= — rebuild the model with "
+            f"sp_axis={plan.sp_axis or 'sp'!r}")
+    donate = bool(kw.get("donate_state", True))
+    step = make_train_step(model, optimizer, loss_fn, _plan=plan, **kw)
+    axis_dims = [(plan.dp_axis, plan.dp)]
+    if plan.sp > 1:
+        axis_dims.append((model.sp_axis, plan.sp))
+    if plan.tp > 1:
+        axis_dims.append((model.tp_axis, plan.tp))
+    axis_dims = [(n, s) for n, s in axis_dims if s > 1] or \
+        [(plan.dp_axis, 1)]
+    names = tuple(n for n, _ in axis_dims)
+    shape = tuple(s for _, s in axis_dims)
+    mesh = Mesh(np.array(devices[:plan.n_used]).reshape(shape), names)
+    mean_axes = tuple(n for n, s in axis_dims
+                      if s > 1 and n != (model.tp_axis if plan.tp > 1
+                                         else None))
+
+    from .. import compat
+    from ..runtime import step_cache as _step_cache
+
+    raw = step._raw_step_fn
+    plan_key = plan.key()
+    token = next(_PLAN_TOKENS)
+
+    def _batch_spec(el):
+        def leaf(a):
+            dims = []
+            if plan.dp > 1 and getattr(a, "ndim", 0) >= 1:
+                dims.append(plan.dp_axis)
+            else:
+                dims.append(None)
+            if plan.sp > 1 and getattr(a, "ndim", 0) >= 2:
+                dims.append(model.sp_axis)
+            return P(*dims)
+        return jax.tree_util.tree_map(leaf, el)
+
+    def dispatch(state, *batch):
+        specs = tuple(_batch_spec(b) for b in batch)
+
+        def build():
+            def run(state, *b):
+                new_state, loss = raw(state, *b)
+                if mean_axes:
+                    # the in-step loss is one shard's local mean; make
+                    # the reported number the global mean (grads are
+                    # already psum-exchanged inside the step)
+                    loss = jax.lax.pmean(
+                        loss, mean_axes if len(mean_axes) > 1
+                        else mean_axes[0])
+                return new_state, loss
+            fn = compat.shard_map(run, mesh=mesh,
+                                  in_specs=(P(),) + specs,
+                                  out_specs=(P(), P()), check_vma=False)
+            return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+        args = (state,) + batch
+        fn = _step_cache.step_cache.program(
+            "train_step", (token, plan_key, specs, donate), args, build)
+        _step_cache.step_cache._bump("dispatches", "train_step")
+        return fn(*args)
+
+    step._step_fn = dispatch
+    step.plan = plan
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Measured refinement (auto_tune) + the make_train_step entry point
+# ---------------------------------------------------------------------------
+
+
+def _concrete_batch(example_batch):
+    """Concrete arrays for trial runs: the example's own arrays where
+    concrete, zeros of the right shape/dtype where abstract."""
+    def leaf(a):
+        if isinstance(a, jax.ShapeDtypeStruct):
+            return jnp.zeros(a.shape, a.dtype)
+        return jnp.asarray(a)
+    return tuple(jax.tree_util.tree_map(leaf, el) for el in example_batch)
+
+
+def measure_plan(plan: Plan, model, optimizer, loss_fn, example_batch,
+                 devices=None, steps: int = 3, **base_kwargs):
+    """Compile + time a plan through the real step (the step-program
+    cache does the compiling).  Returns min ms/step over ``steps`` timed
+    calls, or None with the failure recorded on the exception."""
+    batch = _concrete_batch(example_batch)
+    step = apply_plan(plan, model, optimizer, loss_fn, devices=devices,
+                      **base_kwargs)
+    float(step(*batch))              # compile + warm
+    best = math.inf
+    for _ in range(max(steps, 1)):
+        t0 = time.perf_counter()
+        float(step(*batch))          # scalar fetch = device sync
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def auto_tune_report(report: PlanReport, model, optimizer, loss_fn,
+                     example_batch, devices=None, k: int = 3,
+                     steps: int = 3, **base_kwargs) -> PlanReport:
+    """Measured refinement: compile and time the top-k predicted plans
+    and re-rank by measurement (prediction breaks ties / fills gaps)."""
+    measured = []
+    for plan in report.ranked[:max(k, 1)]:
+        try:
+            ms = measure_plan(plan, model, optimizer, loss_fn,
+                              example_batch, devices=devices, steps=steps,
+                              **base_kwargs)
+            measured.append(dataclasses.replace(plan, measured_ms=ms))
+        except Exception as e:        # a plan that fails to run loses
+            report.rejected.append(
+                (plan, f"auto_tune trial failed: {type(e).__name__}: {e}"))
+    measured.sort(key=lambda p: (p.measured_ms, p.predicted_ms))
+    ranked = measured + [p for p in report.ranked
+                         if p.key() not in {m.key() for m in measured}]
+    return dataclasses.replace(
+        report, best=ranked[0] if ranked else None, ranked=ranked)
+
+
+def build_planned_step(model, optimizer, loss_fn, parallel, *,
+                       example_batch=None, devices=None, auto_tune: int = 0,
+                       plan_options=None, **base_kwargs):
+    """The ``make_train_step(parallel=...)`` entry point: resolve
+    "auto" (or a Plan) into knobs and build the step.  The returned step
+    carries ``.plan`` and (for "auto") ``.plan_report``."""
+    devices = _resolve_devices(devices)
+    report = None
+    if isinstance(parallel, str):
+        if parallel != "auto":
+            raise ValueError(
+                f"parallel= accepts 'auto' or a parallel.auto.Plan, "
+                f"got {parallel!r}")
+        if example_batch is None:
+            raise ValueError(
+                "parallel='auto' needs example_batch=(x, y, ...) — a "
+                "tuple of arrays (or ShapeDtypeStructs) shaped like one "
+                "global training batch, so the planner knows the batch "
+                "and sequence geometry")
+        opts = dict(plan_options or {})
+        report = plan_training(
+            model, optimizer, loss_fn, example_batch, devices=devices,
+            half_dtype=base_kwargs.get("half_dtype"),
+            keep_batchnorm_fp32=base_kwargs.get("keep_batchnorm_fp32",
+                                                True),
+            **opts)
+        if report.best is None:
+            raise RuntimeError(
+                "parallel='auto': no feasible plan\n" + report.describe())
+        if auto_tune:
+            report = auto_tune_report(
+                report, model, optimizer, loss_fn, example_batch,
+                devices=devices, k=auto_tune, **base_kwargs)
+            if report.best is None:
+                raise RuntimeError(
+                    "parallel='auto': every auto_tune trial failed\n"
+                    + report.describe())
+        plan = report.best
+    elif isinstance(parallel, Plan):
+        plan = parallel
+    else:
+        raise TypeError(
+            f"parallel= accepts 'auto' or a parallel.auto.Plan, got "
+            f"{type(parallel).__name__}")
+    step = apply_plan(plan, model, optimizer, loss_fn, devices=devices,
+                      **base_kwargs)
+    step.plan_report = report
+    return step
+
+
+def measured_step_memory(compiled) -> int:
+    """Per-device footprint of a compiled step program, donation-aware:
+    arguments + outputs + temps − aliased (donated buffers counted
+    once).  The validation target for :func:`predict_memory`."""
+    ma = compiled.memory_analysis()
+    return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
+               + ma.temp_size_in_bytes - ma.alias_size_in_bytes)

@@ -62,19 +62,26 @@ def _leaf_sharding(x, mesh, axis, n):
 
 
 def zero_state_sharding(state, mesh: Mesh, axis: str = "data",
-                        param_shard: bool = False):
+                        param_shard: bool = False, stage: int = None):
     """A StepState-shaped pytree of ``NamedSharding``s: fp32 masters and
     optimizer slots shard on dim 0 over ``axis`` where divisible; the half
     model copies replicate (stage 1) or shard the same way
-    (``param_shard=True``, stage 3); buffers / scaler scalars replicate."""
+    (``param_shard=True``, stage 3); buffers / scaler scalars replicate.
+    ``stage=0`` replicates EVERYTHING — only the batch shards, i.e. pure
+    GSPMD data parallelism through the same wrapper."""
+    if stage is None:
+        stage = 3 if param_shard else 1
     n = mesh.shape[axis]
     rep = NamedSharding(mesh, P())
+    if stage == 0:
+        # tree_map preserves the None placeholders in model_params
+        return jax.tree.map(lambda _: rep, state)
     return state._replace(
         master_params=[_leaf_sharding(m, mesh, axis, n)
                        for m in state.master_params],
         model_params=[None if mp is None
                       else (_leaf_sharding(mp, mesh, axis, n)
-                            if param_shard else rep)
+                            if stage == 3 else rep)
                       for mp in state.model_params],
         opt_state={k: [_leaf_sharding(s, mesh, axis, n) for s in v]
                    for k, v in state.opt_state.items()},
@@ -92,7 +99,8 @@ class ZeroTrainStep:
     all-gathered at use, never stored whole)."""
 
     def __init__(self, step, mesh: Mesh, axis: str = "data",
-                 donate: bool = True, param_shard: bool = False):
+                 donate: bool = True, param_shard: bool = False,
+                 stage: int = None, plan=None):
         raw = getattr(step, "_raw_step_fn", None)
         if raw is None:
             raise ValueError(
@@ -114,9 +122,13 @@ class ZeroTrainStep:
         self._base = step
         self.mesh = mesh
         self.axis = axis
-        self.param_shard = param_shard
+        self.stage = (3 if param_shard else 1) if stage is None else stage
+        self.param_shard = self.stage == 3
+        #: the parallel.auto.Plan that built this step (or None); its
+        #: structural key is embedded in the program cache key
+        self.plan = plan
         self.shardings = zero_state_sharding(step.state, mesh, axis,
-                                             param_shard)
+                                             stage=self.stage)
         self.state = jax.device_put(step.state, self.shardings)
         self._rep = NamedSharding(mesh, P())
         self._token = next(_ZERO_TOKENS)
@@ -156,7 +168,9 @@ class ZeroTrainStep:
         if args is None:
             return build()
         fn = _step_cache.step_cache.program(
-            "zero_train_step", (self._token, batch_shs), args, build)
+            "zero_train_step",
+            (self._token, batch_shs, _step_cache.static_plan_key(self.plan)),
+            args, build)
         _step_cache.step_cache._bump("dispatches", "zero_train_step")
         return fn
 

@@ -189,6 +189,20 @@ def record_multi_tensor_call():
     step_cache._bump("multi_tensor_calls")
 
 
+def static_plan_key(plan):
+    """Normalize a ``parallel.auto.Plan`` (or None) into the hashable
+    tuple program keys embed — ``(dp, tp, sp, zero_stage, accum,
+    chunked_loss)``.  Threading the plan through the STATIC key keeps
+    compiled executables per-plan observables: two plans that would
+    otherwise collide on signature (same shapes, different mesh
+    factorization driven by the wrapper) never share a program entry,
+    and ``stats()['by_kind']`` stays meaningful under ``parallel=``.
+    None (an unplanned step) passes through as None."""
+    if plan is None:
+        return None
+    return tuple(plan.key())
+
+
 # ---------------------------------------------------------------------------
 # Whole-optimizer step programs
 # ---------------------------------------------------------------------------

@@ -63,6 +63,10 @@ class TrainStep:
         self.calls = 0
         #: resilience.BadStepGuard attached via guard.attach(step), or None
         self._guard = None
+        #: the parallel.auto.Plan that built this step (parallel=), or None
+        self.plan = None
+        #: the PlanReport behind parallel="auto", or None
+        self.plan_report = None
 
     def __call__(self, *batch):
         from ..runtime import chaos as _chaos
@@ -595,6 +599,34 @@ def init_step_state_flat(params, buffers, meta: FlatMeta, model_dtypes,
         step=jnp.zeros((), jnp.int32))
 
 
+def _default_zero_mesh(zero_axis):
+    """Default ZeRO mesh: the ambient mesh context when one is active
+    (a step built inside ``with Mesh(...):`` must not silently rebuild a
+    1-D mesh over ALL ``jax.devices()`` — on a dp×tp submesh that would
+    shard masters across devices the step never runs on), else a 1-D
+    mesh over every device."""
+    ambient = None
+    try:
+        from jax._src import mesh as _mesh_lib
+        m = _mesh_lib.thread_resources.env.physical_mesh
+        if m is not None and not m.empty:
+            ambient = m
+    except Exception:       # private surface moved: fall back to global
+        ambient = None
+    if ambient is not None:
+        if zero_axis in ambient.shape:
+            return ambient
+        raise ValueError(
+            f"zero_sharding=True inside an active mesh context whose axes "
+            f"{tuple(ambient.shape)} do not include zero_axis="
+            f"{zero_axis!r} — pass zero_mesh= (and zero_axis=) explicitly; "
+            f"the default no longer rebuilds a 1-D mesh over all "
+            f"jax.devices() when the step already runs on a submesh")
+    import numpy as _np
+    from jax.sharding import Mesh as _Mesh
+    return _Mesh(_np.array(jax.devices()), (zero_axis,))
+
+
 def make_train_step(model, optimizer, loss_fn: Callable,
                     half_dtype=None,
                     keep_batchnorm_fp32: bool = True,
@@ -617,7 +649,13 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     zero_mesh=None,
                     zero_axis: str = "data",
                     zero_stage: int = 1,
-                    flat_master: bool = False):
+                    flat_master: bool = False,
+                    parallel=None,
+                    example_batch=None,
+                    devices=None,
+                    auto_tune: int = 0,
+                    plan_options=None,
+                    _plan=None):
     """Build a fully-fused O2-style train step.
 
     ``loss_fn(outputs..., *batch_tail) -> scalar``: called with the model
@@ -713,7 +751,46 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     residency).  There is no stage 2 switch: the fused step holds no
     persistent gradient buffer — gradients are intermediates of the one
     jitted program and already land reduce-scattered into master shards.
+    ``zero_stage=0`` keeps the whole state replicated and only shards the
+    batch — pure GSPMD data parallelism through the same wrapper (what a
+    ``parallel.auto`` plan with ``dp>1, zero=0`` threads).
+
+    ``parallel``: ``"auto"`` or a :class:`apex_tpu.parallel.auto.Plan` —
+    the analytical parallelism planner picks (or the given plan fixes)
+    dp × sp × tp, ZeRO stage, accumulation K, and threads exactly the
+    knobs above; ``parallel="auto"`` needs ``example_batch=`` (one global
+    batch of arrays or ShapeDtypeStructs) so the planner knows the batch/
+    sequence geometry, and ``auto_tune=k`` compiles and times the top-k
+    predicted plans and re-ranks by measurement.  See
+    ``docs/auto_parallel.md``.
     """
+    if parallel is not None:
+        if axis_name is not None or tp_axis is not None or zero_sharding:
+            raise ValueError(
+                "parallel= owns the parallelism knobs — do not also pass "
+                "axis_name / tp_axis / zero_sharding (the plan threads "
+                "them; spell the config fully by hand instead if you "
+                "want manual control)")
+        if accum_steps is not None or grad_accum_steps != 1:
+            raise ValueError(
+                "parallel= owns gradient accumulation — the plan's K is "
+                "threaded as accum_steps; drop accum_steps/"
+                "grad_accum_steps")
+        from ..parallel import auto as _auto
+        return _auto.build_planned_step(
+            model, optimizer, loss_fn, parallel,
+            example_batch=example_batch, devices=devices,
+            auto_tune=auto_tune, plan_options=plan_options,
+            half_dtype=half_dtype,
+            keep_batchnorm_fp32=keep_batchnorm_fp32,
+            dynamic_loss_scale=dynamic_loss_scale,
+            scale_window=scale_window, min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale, loss_scale=loss_scale,
+            gradient_predivide_factor=gradient_predivide_factor,
+            allreduce_always_fp32=allreduce_always_fp32,
+            donate_state=donate_state, accum_stacked=accum_stacked,
+            lr_schedule=lr_schedule, rng_seed=rng_seed,
+            zero_axis=zero_axis, flat_master=flat_master)
     if accum_steps is not None:
         if grad_accum_steps not in (1, accum_steps):
             raise ValueError(
@@ -731,10 +808,11 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             "per-parameter sharding of exactly the buffers flat_master "
             "concatenates")
     if zero_sharding:
-        if zero_stage not in (1, 3):
+        if zero_stage not in (0, 1, 3):
             raise ValueError(
-                f"zero_stage must be 1 (optimizer-state sharding) or 3 "
-                f"(+ parameter sharding); got {zero_stage!r}.  Stage 2 "
+                f"zero_stage must be 1 (optimizer-state sharding), 3 "
+                f"(+ parameter sharding), or 0 (replicated state — pure "
+                f"GSPMD data parallelism); got {zero_stage!r}.  Stage 2 "
                 f"has no separate switch: the fused step never holds a "
                 f"persistent gradient buffer, so sharded masters already "
                 f"imply reduce-scattered gradients")
@@ -756,16 +834,14 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             lr_schedule=lr_schedule,
             rng_seed=rng_seed)
         if zero_mesh is None:
-            import numpy as _np
-            from jax.sharding import Mesh as _Mesh
-            zero_mesh = _Mesh(_np.array(jax.devices()), (zero_axis,))
+            zero_mesh = _default_zero_mesh(zero_axis)
         elif zero_axis not in zero_mesh.shape:
             raise ValueError(
                 f"zero_axis {zero_axis!r} is not an axis of zero_mesh "
                 f"(axes: {tuple(zero_mesh.shape)})")
         return ZeroTrainStep(base, zero_mesh, zero_axis,
                              donate=donate_state,
-                             param_shard=(zero_stage == 3))
+                             stage=zero_stage, plan=_plan)
     params = [p for p in model.parameters() if p is not None]
     buffers = [b for b in model.buffers()]
     group_idxs = match_param_groups(optimizer, params)
@@ -987,8 +1063,11 @@ def make_train_step(model, optimizer, loss_fn: Callable,
         from ..runtime import step_cache as _step_cache
 
         token = next(_STEP_TOKENS)
+        # the plan (when this step was built by parallel.auto) is part of
+        # the STATIC key: compiled executables stay per-plan observables
         static_key = (token, grad_accum_steps, accum_stacked,
-                      bool(donate_state))
+                      bool(donate_state),
+                      _step_cache.static_plan_key(_plan))
 
         def _build():
             return jax.jit(step_fn,

@@ -67,6 +67,16 @@ def parse_args():
                         "pipeline skips its layout transpose — the TPU "
                         "conv-layout lever (docs/performance.md)")
     p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--parallel", default=None, choices=["auto"],
+                   help="auto: let the analytical parallelism planner "
+                        "(apex_tpu.parallel.auto) pick the fastest "
+                        "feasible dp x zero x accum plan for the visible "
+                        "devices and train through the fused step it "
+                        "configures; prints the chosen Plan.describe() "
+                        "(docs/auto_parallel.md)")
+    p.add_argument("--auto-tune", type=int, default=0,
+                   help="with --parallel auto: compile+time the top-K "
+                        "predicted plans and re-rank by measurement")
     return p.parse_args()
 
 
@@ -106,8 +116,65 @@ def adjust_learning_rate(optimizer, epoch, args):
         group["lr"] = lr
 
 
+def train_auto(args):
+    """--parallel auto: the planner configures the fused train step
+    (ZeRO/dp/accum knobs threaded from the chosen plan); the eager
+    amp/DDP objects are not used — the fused step IS the amp-O2 path."""
+    import jax
+    import jax.numpy as jnp
+
+    import apex_tpu.nn as nn
+    from apex_tpu import models
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedSGD
+
+    nn.manual_seed(0)
+    model = getattr(models, args.arch)(num_classes=1000)
+    if args.channels_last:
+        model = nn.to_channels_last(model)
+    optimizer = FusedSGD(list(model.parameters()), lr=args.lr,
+                         momentum=args.momentum,
+                         weight_decay=args.weight_decay)
+    half = jnp.bfloat16 if args.opt_level in ("O2", "O3") else None
+    loader = list(synthetic_loader(args))
+    x0 = jnp.asarray(loader[0][0], jnp.float32) / 255.0
+    if not args.channels_last:
+        x0 = jnp.transpose(x0, (0, 3, 1, 2))
+    y0 = jnp.asarray(loader[0][1])
+    from apex_tpu.training import make_train_step
+    step = make_train_step(
+        model, optimizer, lambda o, t: F.cross_entropy(o, t),
+        half_dtype=half, loss_scale="dynamic" if half else 1.0,
+        parallel="auto", example_batch=(x0, y0),
+        auto_tune=args.auto_tune)
+    print(step.plan_report.describe() if step.plan_report is not None
+          else step.plan.describe())
+    batch_time, losses = AverageMeter(), AverageMeter()
+    for epoch in range(args.epochs):
+        end = time.time()
+        for i, (inp, target) in enumerate(loader):
+            x = jnp.asarray(inp, jnp.float32) / 255.0
+            if not args.channels_last:
+                x = jnp.transpose(x, (0, 3, 1, 2))
+            loss = step(x, jnp.asarray(target))
+            losses.update(float(loss), n=args.batch_size)
+            batch_time.update(time.time() - end)
+            end = time.time()
+            if i % args.print_freq == 0:
+                ips = args.batch_size / max(batch_time.avg, 1e-9)
+                print(f"Epoch [{epoch}][{i}] loss {losses.val:.4f} "
+                      f"({losses.avg:.4f})  {ips:.1f} img/s  "
+                      f"[plan {step.plan.name()}]")
+    step.sync_to_objects()
+
+
 def main():
     args = parse_args()
+    if args.parallel == "auto":
+        if not args.synthetic:
+            raise SystemExit("--parallel auto currently pairs with "
+                             "--synthetic (the fused-step demo path)")
+        return train_auto(args)
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,296 @@
+"""The analytical parallelism planner (parallel/auto.py): profile
+measurement from XLA cost analysis, plan enumeration, memory-feasibility
+pruning with stated reasons (no silent pruning), roofline ranking on
+CPU-measurable scenarios, and describe() diagnostics."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.nn import functional as F
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+from apex_tpu.parallel import auto
+
+
+def _build(hidden=512):
+    nn.manual_seed(0)
+    model = nn.Sequential(nn.Linear(64, hidden), nn.ReLU(),
+                          nn.Linear(hidden, hidden), nn.ReLU(),
+                          nn.Linear(hidden, 8))
+    opt = FusedAdam(list(model.parameters()), lr=1e-2)
+    return model, opt
+
+
+def _loss(o, t):
+    return F.cross_entropy(o, t)
+
+
+def _batch(rng, b=64):
+    x = jnp.asarray(rng.standard_normal((b, 64)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 8, (b,)))
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def profiled():
+    rng = np.random.default_rng(7)
+    model, opt = _build()
+    batch = _batch(rng)
+    prof = auto.profile_model(model, opt, _loss, batch)
+    return model, opt, batch, prof
+
+
+def test_chip_spec_cpu_is_shared_host():
+    spec = auto.chip_spec(jax.devices())
+    assert spec.name == "cpu" and spec.shared_host
+
+
+def test_profile_measures_from_xla(profiled):
+    _, _, _, prof = profiled
+    assert prof.source == "xla"
+    assert prof.flops_per_example > 0
+    assert prof.act_bytes_per_example > 0
+    assert prof.hbm_bytes_per_example > 0
+    assert prof.n_params == sum(
+        int(np.prod(s)) for s in prof.param_shapes)
+    assert prof.slots_per_param == 2        # Adam: m + v
+    assert prof.tp_axis is None and prof.sp_axis is None
+
+
+def test_profile_slots_for_sgd():
+    model, _ = _build(hidden=32)
+    opt = FusedSGD(list(model.parameters()), lr=0.1)
+    rng = np.random.default_rng(0)
+    prof = auto.profile_model(model, opt, _loss, _batch(rng, 8))
+    assert prof.slots_per_param == 1
+
+
+def test_enumeration_covers_mesh_factorizations():
+    plans = list(auto.enumerate_plans(8, global_batch=64))
+    meshes = {(p.dp, p.sp, p.tp) for p in plans}
+    assert (8, 1, 1) in meshes and (1, 1, 8) in meshes \
+        and (2, 2, 2) in meshes and (1, 8, 1) in meshes
+    assert (2, 1, 1) in meshes          # partial mesh (idle devices)
+    assert {p.zero_stage for p in plans if p.dp == 8 and p.tp == 1
+            and p.sp == 1} == {0, 1, 3}
+    assert {p.accum for p in plans if (p.dp, p.sp, p.tp) == (8, 1, 1)
+            and p.zero_stage == 0} == {1, 2, 4, 8}
+    # ZeRO stays on dp-only meshes (the GSPMD path excludes tp/sp axes)
+    assert all(p.zero_stage == 0 for p in plans if p.tp > 1 or p.sp > 1)
+
+
+def test_no_silent_pruning(profiled):
+    """Every enumerated plan is either ranked feasible or rejected WITH a
+    reason — the two lists partition the candidate space."""
+    model, opt, batch, prof = profiled
+    rep = auto.plan_training(model, opt, _loss, batch, profile=prof)
+    n_enumerated = len(list(auto.enumerate_plans(
+        len(jax.devices()), global_batch=rep.global_batch)))
+    assert len(rep.ranked) + len(rep.rejected) == n_enumerated
+    assert all(isinstance(r, str) and r for _, r in rep.rejected)
+
+
+def test_capability_rejections_have_reasons(profiled):
+    model, opt, batch, prof = profiled
+    rep = auto.plan_training(model, opt, _loss, batch, profile=prof)
+    tp_reasons = [r for p, r in rep.rejected if p.tp > 1]
+    sp_reasons = [r for p, r in rep.rejected if p.sp > 1 and p.tp == 1]
+    assert tp_reasons and all("tp_axis" in r for r in tp_reasons)
+    assert sp_reasons and all("sp_axis" in r for r in sp_reasons)
+
+
+def test_batch_divisibility_rejected_with_reason():
+    model, opt = _build(hidden=32)
+    rng = np.random.default_rng(0)
+    batch = _batch(rng, b=12)           # 12 % 8 != 0
+    rep = auto.plan_training(model, opt, _loss, batch)
+    bad = [r for p, r in rep.rejected if p.dp == 8]
+    assert bad and all("not divisible" in r for r in bad)
+
+
+def test_memory_infeasible_rejected_with_breakdown(profiled):
+    """A cap below the replicated state forces memory rejections whose
+    reason states the predicted need and its component breakdown."""
+    model, opt, batch, prof = profiled
+    cap = prof.param_bytes_fp32         # masters alone fill it
+    rep = auto.plan_training(model, opt, _loss, batch, profile=prof,
+                             hbm_cap_bytes=cap)
+    mem_rejects = [(p, r) for p, r in rep.rejected
+                   if "memory-infeasible" in r]
+    assert mem_rejects
+    p, r = mem_rejects[0]
+    assert "MiB/device > cap" in r and "masters" in r and "acts" in r
+    assert p.predicted_hbm is not None and p.predicted_hbm > cap
+    # the replicated single-device plan specifically must be among them
+    assert any(p.dp == 1 and p.zero_stage == 0 for p, _ in mem_rejects)
+    # and ZeRO plans survive
+    assert rep.best is not None and rep.best.zero_stage >= 1
+
+
+def test_scenario_memory_order_replicated_vs_zero3(profiled):
+    """ISSUE scenario: memory-infeasible replicated plan vs ZeRO-3 — the
+    predicted order (replicated loses) matches the measured per-device
+    footprint order from XLA's memory_analysis of the real programs."""
+    from apex_tpu.training import make_train_step
+
+    model, opt, batch, prof = profiled
+    spec = auto.chip_spec()
+    x, y = batch
+    B = int(x.shape[0])
+    rep_plan = auto.Plan(dp=1, n_devices=8)
+    z3_plan = auto.Plan(dp=8, zero_stage=3, n_devices=8)
+    pred_rep, _ = auto.predict_memory(rep_plan, prof, spec, B)
+    pred_z3, _ = auto.predict_memory(z3_plan, prof, spec, B)
+    assert pred_z3 < pred_rep
+
+    def measured(plan):
+        m, o = _build()
+        step = make_train_step(m, o, _loss, half_dtype=None,
+                               loss_scale=1.0, parallel=plan)
+        step(x, y)
+        if plan.dp > 1:
+            shs = step._batch_shardings((x, y))
+            comp = step._jitted(shs).lower(step.state, x, y).compile()
+        else:
+            from apex_tpu.runtime.step_cache import step_cache
+            ent = [e for e in step_cache.entries()
+                   if e["kind"] == "train_step"][-1]
+            comp = ent["fn"].lower(*ent["example"]).compile()
+        return auto.measured_step_memory(comp)
+
+    meas_rep, meas_z3 = measured(rep_plan), measured(z3_plan)
+    assert meas_z3 < meas_rep
+    # a cap between them rejects exactly the replicated plan
+    cap = (meas_rep + meas_z3) / 2
+    assert auto.predict_memory(rep_plan, prof, spec, B)[0] > cap * 0.85
+    assert auto.predict_memory(z3_plan, prof, spec, B)[0] < cap * 1.15
+
+
+def test_scenario_dp1_vs_dp8_predicted_matches_measured(profiled):
+    """On the shared-host CPU mesh, spreading a fixed global batch over
+    8 virtual devices buys no compute and adds collectives: the cost
+    model predicts dp1 faster, and measurement agrees (margin ~2x)."""
+    model, opt, batch, prof = profiled
+    spec = auto.chip_spec()
+    B = int(batch[0].shape[0])
+    p1 = auto.Plan(dp=1, n_devices=8)
+    p8 = auto.Plan(dp=8, zero_stage=1, n_devices=8)
+    pred1, _, _ = auto.predict_time(p1, prof, spec, B)
+    pred8, _, _ = auto.predict_time(p8, prof, spec, B)
+    assert pred1 < pred8
+
+    def measure(plan):
+        m, o = _build()
+        return auto.measure_plan(plan, m, o, _loss, batch, steps=5,
+                                 half_dtype=None, loss_scale=1.0)
+
+    assert measure(p1) < measure(p8)
+
+
+def test_scenario_accum_overhead_predicted_matches_measured(profiled):
+    """K=8 microbatching at the same global batch costs scan overhead and
+    K x weight re-reads: predicted slower than K=1, measured slower."""
+    model, opt, batch, prof = profiled
+    spec = auto.chip_spec()
+    B = int(batch[0].shape[0])
+    k1 = auto.Plan(dp=1, accum=1, n_devices=8)
+    k8 = auto.Plan(dp=1, accum=8, n_devices=8)
+    pred1, _, _ = auto.predict_time(k1, prof, spec, B)
+    pred8, _, _ = auto.predict_time(k8, prof, spec, B)
+    assert pred1 < pred8
+
+    def measure(plan):
+        m, o = _build()
+        return auto.measure_plan(plan, m, o, _loss, batch, steps=5,
+                                 half_dtype=None, loss_scale=1.0)
+
+    assert measure(k1) < measure(k8)
+
+
+def test_tpu_spec_inverts_dp_preference(profiled):
+    """Same model, same batch, priced for a real chip (per-device peaks,
+    ICI instead of host memcpys): dp=8 beats dp=1 — the shared-host
+    inversion is a property of the CPU test mesh, not of the model.
+    (At the test's tiny batch even a v5e prefers dp=1: the grad
+    all-reduce costs more than the compute it spreads — the batch-size
+    plateau inversion the round-5 benches measured.)"""
+    _, _, batch, prof = profiled
+    spec = auto.CHIPS["v5e"]
+    B = 8192
+    pred1, _, _ = auto.predict_time(auto.Plan(dp=1, n_devices=8), prof,
+                                    spec, B)
+    pred8, _, _ = auto.predict_time(
+        auto.Plan(dp=8, zero_stage=1, n_devices=8), prof, spec, B)
+    assert pred8 < pred1
+
+
+def test_chunked_loss_lever_priced(profiled):
+    """With a vocab head, chunked_loss=None enumerates both settings and
+    the chunked twin predicts strictly less activation memory."""
+    from apex_tpu.models import GptModel
+
+    nn.manual_seed(1)
+    model = GptModel(vocab_size=512, hidden=32, layers=2, heads=4,
+                     max_positions=32, dropout=0.0, attn_dropout=0.0)
+    opt = FusedAdam(list(model.parameters()), lr=1e-3)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 512, (8, 32)))
+    tgt = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1))
+
+    def lm_loss(logits, tgt):
+        return F.cross_entropy(logits.reshape((-1, 512)),
+                               tgt.reshape((-1,)))
+
+    rep = auto.plan_training(model, opt, lm_loss, (ids, tgt),
+                             chunked_loss=None)
+    by_key = {}
+    for p in rep.ranked:
+        by_key.setdefault(p.key()[:5], {})[p.chunked_loss] = p
+    pairs = [v for v in by_key.values() if len(v) == 2]
+    assert pairs, "chunked/unchunked twins must both be priced"
+    assert all(v[True].predicted_hbm < v[False].predicted_hbm
+               for v in pairs)
+    chunked_best = [p for p in rep.ranked if p.chunked_loss][0]
+    assert "chunked" in chunked_best.describe()
+
+
+def test_plan_step_kwargs_mapping():
+    devs = jax.devices()
+    z = auto.Plan(dp=4, zero_stage=1, accum=2, n_devices=8)
+    kw = z.step_kwargs(devs)
+    assert kw["zero_sharding"] and kw["zero_stage"] == 1
+    assert kw["accum_steps"] == 2
+    assert tuple(kw["zero_mesh"].shape.values()) == (4,)
+    t = auto.Plan(dp=2, tp=4, tp_axis="tp", n_devices=8)
+    kw = t.step_kwargs()
+    assert kw["axis_name"] == "data" and kw["tp_axis"] == "tp"
+    assert "zero_sharding" not in kw
+
+
+def test_describe_contents(profiled):
+    model, opt, batch, prof = profiled
+    rep = auto.plan_training(model, opt, _loss, batch, profile=prof,
+                             hbm_cap_bytes=prof.param_bytes_fp32 * 4)
+    text = rep.describe()
+    assert "chosen:" in text and "rejected" in text
+    assert "memory-infeasible" in text        # reasons are printed
+    best = rep.best.describe()
+    assert "predicted" in best and "ms/step" in best
+    assert "knobs:" in best
+    z = [p for p in rep.ranked if p.dp > 1 and p.zero_stage >= 1]
+    if z:
+        d = z[0].describe()
+        assert "reduce-scatter" in d and "all-gather" in d
+
+
+def test_static_plan_key():
+    from apex_tpu.runtime import step_cache
+    assert step_cache.static_plan_key(None) is None
+    p = auto.Plan(dp=4, zero_stage=3, accum=2, n_devices=8)
+    assert step_cache.static_plan_key(p) == (4, 1, 1, 3, 2, False)
+    # prediction fields do not change the structural identity
+    q = dataclasses.replace(p, predicted_ms=1.0, predicted_hbm=7)
+    assert step_cache.static_plan_key(q) == step_cache.static_plan_key(p)

@@ -363,3 +363,37 @@ def test_zero_stage_validation():
     with pytest.raises(ValueError, match="zero_stage must be 1"):
         make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t),
                         zero_sharding=True, zero_stage=2)
+
+
+def test_zero_default_mesh_derives_from_ambient_context(rng):
+    """The default zero_mesh must come from the active mesh context, not
+    unconditionally from ALL jax.devices(): a step built inside
+    ``with Mesh(...):`` on a dp x tp submesh shards over THAT mesh's
+    data axis (replicating over tp), so the state lands only on devices
+    the step runs on."""
+    model, opt = _build()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "tp"))
+    with mesh:
+        step = make_train_step(model, opt,
+                               lambda o, t: F.cross_entropy(o, t),
+                               half_dtype=None, loss_scale=1.0,
+                               zero_sharding=True)
+    assert step.mesh is mesh
+    x, y = _batch(rng)
+    assert np.isfinite(float(step(x, y)))
+    # Linear(16,64).weight shards 2-way (the ambient data axis), NOT the
+    # 8-way a silently rebuilt global 1-D mesh would produce
+    w0 = step.state.master_params[0]
+    assert w0.sharding.shard_shape(w0.shape)[0] == w0.shape[0] // 2
+
+
+def test_zero_default_mesh_ambient_mismatch_errors():
+    """A genuine mismatch — ambient mesh without the zero axis — is a
+    loud error naming the fix, not a silent global-mesh fallback."""
+    model, opt = _build()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("x", "y"))
+    with mesh:
+        with pytest.raises(ValueError, match="do not include zero_axis"):
+            make_train_step(model, opt,
+                            lambda o, t: F.cross_entropy(o, t),
+                            zero_sharding=True)
