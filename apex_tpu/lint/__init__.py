@@ -1,0 +1,34 @@
+"""``apex_tpu.lint`` — AST-based TPU-hazard analyzer.
+
+The repo's hot paths are guarded by *conventions* the reference enforced
+with hand-written CUDA plumbing: tracing discipline (no hyperparameter in
+a static jit key — the ~200x retrace pathology PR 1 killed), donation
+discipline (never read a buffer after the step that donated it), and
+boundary-only collectives (PR 3's one-exchange-per-accumulation-window
+invariant).  These are structural properties of the program text, so they
+are checkable *before* execution — this package turns each one into a
+:class:`~apex_tpu.lint.rules.Rule` over the Python AST, generalizing the
+ad-hoc source greps that used to live in ``tests/test_compat.py``.
+
+Surface:
+
+* ``python -m apex_tpu.lint [paths]`` / the ``apex-tpu-lint`` console
+  script — exit 0 when the tree is clean, 1 on findings;
+* :func:`run` — the programmatic entry (tests, ``bench.py --lint``);
+* inline suppressions — ``# tpu-lint: disable=RULE-ID reason`` on the
+  flagged line (or the comment line just above it), and
+  ``# tpu-lint: disable-file=RULE-ID reason`` anywhere for a whole file;
+* a checked-in baseline (:data:`DEFAULT_BASELINE`) grandfathering
+  pre-existing findings so new code can't add more.
+
+See ``docs/lint.md`` for the rule catalog with the historical bug behind
+each rule.
+"""
+from .engine import (DEFAULT_BASELINE, Finding, LintResult, load_baseline,
+                     run, write_baseline)
+from .rules import REGISTRY, Rule, rule_ids
+
+__all__ = [
+    "DEFAULT_BASELINE", "Finding", "LintResult", "REGISTRY", "Rule",
+    "load_baseline", "run", "rule_ids", "write_baseline",
+]

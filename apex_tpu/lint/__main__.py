@@ -1,0 +1,83 @@
+"""``python -m apex_tpu.lint`` / the ``apex-tpu-lint`` console script.
+
+Exit status: 0 = clean (no unsuppressed, non-baselined findings),
+1 = findings (including files that failed to parse), 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import engine, report, rules
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="apex-tpu-lint",
+        description="AST-based TPU-hazard analyzer (rule catalog: "
+                    "docs/lint.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: apex_tpu "
+                         "and examples under the cwd, else the cwd)")
+    ap.add_argument("--format", choices=["human", "json"], default="human")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--ignore", default=None,
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--baseline", default=engine.DEFAULT_BASELINE,
+                    help="baseline file of grandfathered findings "
+                         "(default: the checked-in package baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings as live")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather every current finding into "
+                         "--baseline and exit 0")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed/baselined findings")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in rules.rule_ids():
+            r = rules.REGISTRY[rid]
+            print(f"{rid}: {r.summary}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        paths = [p for p in ("apex_tpu", "examples") if os.path.isdir(p)]
+        if not paths:
+            paths = ["."]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"apex-tpu-lint: no such path(s): {missing}",
+              file=sys.stderr)
+        return 2
+
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+    baseline = None if args.no_baseline else args.baseline
+    try:
+        result = engine.run(paths, select=select, ignore=ignore,
+                            baseline=baseline)
+    except KeyError as e:
+        print(f"apex-tpu-lint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        n = engine.write_baseline(args.baseline, result,
+                                  result._modules_by_rel)
+        print(f"apex-tpu-lint: baselined {n} finding(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    if args.format == "json":
+        print(report.as_json(result, args.show_suppressed))
+    else:
+        print(report.human(result, args.show_suppressed))
+    return 1 if result.active() else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

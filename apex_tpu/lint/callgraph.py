@@ -1,0 +1,295 @@
+"""Lightweight intra-package call graph for reachability-scoped rules.
+
+HOST-SYNC only fires *inside code that XLA traces*: a ``float(loss)`` in
+an eager logging loop is normal, the same call inside a jitted train
+step is a device round-trip per step.  Statically approximating "traced"
+needs (a) the set of functions handed to jax's tracing entry points
+(``jax.jit``/``pjit``/``shard_map``/``lax.scan``/``grad``/...), and
+(b) the closure of intra-package calls from those — which this module
+computes over whatever file set the engine was pointed at, resolving
+bare-name calls within a module and ``alias.func`` calls through the
+module's import table.  Deliberately conservative: unresolvable calls
+(methods, higher-order parameters) are dropped rather than guessed, so
+reachability under-approximates and the rule never flags eager code.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+#: callables whose function-valued argument(s) are traced by jax.
+#: value = indices of the positional args that are functions.
+_TRACERS: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,), "pjit": (0,), "shard_map": (0,), "checkpoint": (0,),
+    "remat": (0,), "grad": (0,), "value_and_grad": (0,), "vjp": (0,),
+    "jvp": (0,), "custom_vjp": (0,), "vmap": (0,), "pmap": (0,),
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,), "map": (0,),
+    "cond": (1, 2), "switch": (1, 2, 3, 4),
+}
+
+#: tracer names that are only jax tracers when spelled through jax.lax —
+#: a bare/other-owner `map`/`cond`/`scan` (builtin map, jax.tree.map,
+#: itertools chains) traces nothing
+_LAX_ONLY = {"scan", "while_loop", "fori_loop", "map", "cond", "switch"}
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    """`jax.jit` -> "jit", `lax.scan` -> "scan", `jit` -> "jit"."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _static_argnames_of(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+            return {e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return set()
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    module_path: str
+    qualname: str
+    name: str
+    node: ast.AST               # FunctionDef / AsyncFunctionDef
+    params: Set[str]
+    parent: Optional[str]       # enclosing function qualname, if nested
+
+
+def _params_of(node) -> Set[str]:
+    a = node.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            names.append(extra.arg)
+    return set(names)
+
+
+class _ImportTable:
+    """Per-module view of what names resolve to inside the analyzed set."""
+
+    def __init__(self, module, dotted_to_path: Dict[str, str]):
+        self.mod_alias: Dict[str, str] = {}    # local name -> module path
+        self.func_alias: Dict[str, Tuple[str, str]] = {}  # -> (path, fn)
+        self.ext_alias: Dict[str, str] = {}    # local name -> ext dotted
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    local = al.asname or al.name.split(".")[0]
+                    target = al.name if al.asname else al.name.split(".")[0]
+                    if target in dotted_to_path:
+                        self.mod_alias[local] = dotted_to_path[target]
+                    else:
+                        self.ext_alias[local] = al.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # relative import: climb `level` packages from here
+                    anchor = (module.dotted or "").split(".")
+                    anchor = anchor[:max(0, len(anchor) - node.level)]
+                    base = ".".join(anchor + ([base] if base else []))
+                for al in node.names:
+                    local = al.asname or al.name
+                    sub = f"{base}.{al.name}" if base else al.name
+                    if sub in dotted_to_path:
+                        self.mod_alias[local] = dotted_to_path[sub]
+                    elif base in dotted_to_path:
+                        self.func_alias[local] = (dotted_to_path[base],
+                                                  al.name)
+                    else:
+                        self.ext_alias[local] = sub
+
+
+class CallGraph:
+    """Functions, traced-entry set, and the reachable closure."""
+
+    def __init__(self, modules):
+        self.modules = {m.path: m for m in modules}
+        dotted_to_path = {}
+        for m in modules:
+            if m.dotted:
+                dotted_to_path[m.dotted] = m.path
+        self.imports = {m.path: _ImportTable(m, dotted_to_path)
+                        for m in modules}
+        self.functions: Dict[Tuple[str, str], FunctionInfo] = {}
+        self.by_name: Dict[str, Dict[str, List[str]]] = {}
+        for m in modules:
+            self._collect_functions(m)
+        self._children: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+        for key, fi in self.functions.items():
+            if fi.parent:
+                self._children.setdefault(
+                    (fi.module_path, fi.parent), []).append(key)
+        self._entries: Set[Tuple[str, str]] = set()
+        self._entry_static: Dict[Tuple[str, str], Set[str]] = {}
+        for m in modules:
+            self._collect_entries(m)
+        self.reachable = self._closure()
+
+    # -- collection --------------------------------------------------------
+
+    def _collect_functions(self, module):
+        per_name = self.by_name.setdefault(module.path, {})
+
+        def visit(node, prefix, parent):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    self.functions[(module.path, qn)] = FunctionInfo(
+                        module.path, qn, child.name, child,
+                        _params_of(child), parent)
+                    per_name.setdefault(child.name, []).append(qn)
+                    visit(child, qn + ".", qn)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.", parent)
+                else:
+                    visit(child, prefix, parent)
+
+        visit(module.tree, "", None)
+
+    def _fn_args_of_call(self, call: ast.Call, module_path=None):
+        name = _terminal_name(call.func)
+        if name not in _TRACERS:
+            return []
+        if name in _LAX_ONLY:
+            # require the jax.lax spelling: `lax.scan` / `jax.lax.scan`,
+            # or a bare name imported from jax.lax
+            func = call.func
+            if isinstance(func, ast.Attribute):
+                owner = func.value
+                ok = (isinstance(owner, ast.Name) and owner.id == "lax") \
+                    or (isinstance(owner, ast.Attribute)
+                        and owner.attr == "lax")
+                if not ok:
+                    return []
+            elif isinstance(func, ast.Name):
+                table = self.imports.get(module_path)
+                target = table.ext_alias.get(func.id, "") if table else ""
+                if not target.startswith("jax.lax"):
+                    return []
+        out = []
+        for idx in _TRACERS[name]:
+            if idx < len(call.args):
+                out.append(call.args[idx])
+        return out
+
+    def _collect_entries(self, module):
+        per_name = self.by_name.get(module.path, {})
+
+        def mark_name(fname, static_names):
+            for qn in per_name.get(fname, ()):
+                key = (module.path, qn)
+                self._entries.add(key)
+                # a param is static only if EVERY marking says so
+                prev = self._entry_static.get(key)
+                self._entry_static[key] = (
+                    set(static_names) if prev is None
+                    else prev & set(static_names))
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    is_call = isinstance(dec, ast.Call)
+                    tn = _terminal_name(dec.func if is_call else dec)
+                    static = _static_argnames_of(dec) if is_call else set()
+                    if tn in ("jit", "pjit"):
+                        mark_name(node.name, static)
+                    # @partial(jax.jit, ...) / @functools.partial(jit, ...)
+                    if is_call and tn == "partial" and dec.args:
+                        inner = _terminal_name(dec.args[0])
+                        if inner in ("jit", "pjit"):
+                            mark_name(node.name, static)
+            elif isinstance(node, ast.Call):
+                tn = _terminal_name(node.func)
+                static = _static_argnames_of(node) \
+                    if tn in ("jit", "pjit") else set()
+                for arg in self._fn_args_of_call(node, module.path):
+                    if isinstance(arg, ast.Name):
+                        mark_name(arg.id, static)
+                    # jax.jit(partial(f, ...)) and jax.checkpoint(f)(...)
+                    elif isinstance(arg, ast.Call) and arg.args and \
+                            _terminal_name(arg.func) == "partial" and \
+                            isinstance(arg.args[0], ast.Name):
+                        mark_name(arg.args[0].id, static)
+
+    # -- closure -----------------------------------------------------------
+
+    def _callees(self, info: FunctionInfo):
+        table = self.imports[info.module_path]
+        per_name = self.by_name.get(info.module_path, {})
+        out: Set[Tuple[str, str]] = set()
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name):
+                for qn in per_name.get(func.id, ()):
+                    out.add((info.module_path, qn))
+                if func.id in table.func_alias:
+                    path, fn = table.func_alias[func.id]
+                    for qn in self.by_name.get(path, {}).get(fn, ()):
+                        out.add((path, qn))
+            elif isinstance(func, ast.Attribute) and \
+                    isinstance(func.value, ast.Name):
+                owner = func.value.id
+                if owner in table.mod_alias:
+                    path = table.mod_alias[owner]
+                    for qn in self.by_name.get(path, {}).get(func.attr, ()):
+                        out.add((path, qn))
+            # functions handed onward to tracers from inside traced code
+            for arg in self._fn_args_of_call(node, info.module_path):
+                if isinstance(arg, ast.Name):
+                    for qn in per_name.get(arg.id, ()):
+                        out.add((info.module_path, qn))
+        # lexically nested defs close over the tracing context: treat
+        # them as called (the common `def run(...)` inside `build()`)
+        out.update(self._children.get((info.module_path, info.qualname),
+                                      ()))
+        return out
+
+    def _closure(self):
+        seen: Set[Tuple[str, str]] = set()
+        frontier = [k for k in self._entries if k in self.functions]
+        while frontier:
+            key = frontier.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            for nxt in self._callees(self.functions[key]):
+                if nxt not in seen and nxt in self.functions:
+                    frontier.append(nxt)
+        return seen
+
+    # -- queries -----------------------------------------------------------
+
+    def reachable_functions(self, module_path: str):
+        """FunctionInfo for every traced-reachable function in a file."""
+        return [self.functions[k] for k in self.reachable
+                if k[0] == module_path]
+
+    def is_entry(self, module_path: str, qualname: str) -> bool:
+        return (module_path, qualname) in self._entries
+
+    def traced_params(self, info: FunctionInfo) -> Set[str]:
+        """Parameters PROVABLY traced: an entry function's own params
+        minus any the jit site declared static.  Callee/closure params
+        may be trace-time Python config, so they return empty — the
+        under-approximation that keeps HOST-SYNC's value checks quiet
+        on config branching."""
+        key = (info.module_path, info.qualname)
+        if key not in self._entries:
+            return set()
+        return info.params - self._entry_static.get(key, set())

@@ -1,0 +1,299 @@
+"""Analyzer core: file discovery, AST parsing, suppressions, baseline,
+and the run loop that drives the rule registry.
+
+Pure stdlib — parsing a tree of a few hundred files plus running every
+rule stays well under the tier-1 gate's 10s budget because nothing here
+touches jax; the rules reason about *source text*, not live programs.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: the checked-in grandfather file shipped with the package; findings
+#: fingerprinted here are reported as ``baselined`` and do not fail the
+#: CLI / the tier-1 gate.  Regenerate with ``--write-baseline``.
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_\-*]+(?:\s*,\s*[A-Za-z0-9_\-*]+)*)\s*(.*)$")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a source location."""
+    rule: str
+    path: str               # as reported (relative to the lint root)
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    suppressed: bool = False
+    suppress_reason: str = ""
+    baselined: bool = False
+
+    def format(self) -> str:
+        state = ""
+        if self.suppressed:
+            state = f" [suppressed: {self.suppress_reason or 'no reason'}]"
+        elif self.baselined:
+            state = " [baselined]"
+        out = f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+              f"{self.message}{state}"
+        if self.hint and not (self.suppressed or self.baselined):
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file plus its suppression table."""
+    path: str               # absolute
+    relpath: str            # posix-style, relative to the lint root
+    source: str
+    tree: ast.Module
+    lines: List[str]
+    # line -> [(rule-id-or-*, reason)]
+    suppressions: Dict[int, List[Tuple[str, str]]]
+    file_suppressions: List[Tuple[str, str]]
+    dotted: Optional[str]   # best-effort dotted module name
+    in_apex_package: bool
+
+    def suppression_for(self, rule: str, line: int):
+        """The (rule, reason) suppressing ``rule`` at ``line``: a
+        file-wide directive, a directive on the flagged line itself, or
+        one anywhere in the contiguous comment-only block directly above
+        it (so reasons can wrap) — else None."""
+        for ent in self.file_suppressions:
+            if ent[0] in ("*", rule):
+                return ent
+        cand = line
+        while cand == line or self._comment_only(cand):
+            for ent in self.suppressions.get(cand, ()):
+                if ent[0] in ("*", rule):
+                    return ent
+            cand -= 1
+            if cand < 1:
+                break
+        return None
+
+    def _comment_only(self, line: int) -> bool:
+        if not (1 <= line <= len(self.lines)):
+            return False
+        return self.lines[line - 1].lstrip().startswith("#")
+
+
+class LintResult:
+    """Everything one analyzer run produced.
+
+    ``findings`` carries every finding including suppressed/baselined
+    ones (reporters show them on request); :meth:`active` is the set
+    that fails a build.  ``files`` is the full scanned set — the
+    walk-coverage guarantee tests assert membership against it.
+    """
+
+    def __init__(self, findings, files, rules, elapsed_s):
+        self.findings: List[Finding] = findings
+        self.files: List[str] = files
+        self.rules: List[str] = rules
+        self.elapsed_s: float = elapsed_s
+
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    def counts(self) -> dict:
+        return {
+            "findings": len(self.active()),
+            "suppressed": sum(1 for f in self.findings if f.suppressed),
+            "baselined": sum(1 for f in self.findings if f.baselined),
+            "files": len(self.files),
+            "rules_run": list(self.rules),
+            "lint_ms": round(self.elapsed_s * 1000.0, 2),
+        }
+
+
+def iter_py_files(paths: Iterable[str]):
+    """Yield every .py file under ``paths`` (files pass through),
+    skipping __pycache__, hidden directories, and build trees."""
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+                and d not in ("build", "dist"))
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def _parse_suppressions(source: str):
+    per_line: Dict[int, List[Tuple[str, str]]] = {}
+    file_wide: List[Tuple[str, str]] = []
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        kind, ids, reason = m.group(1), m.group(2), m.group(3).strip()
+        for rid in (s.strip() for s in ids.split(",")):
+            ent = (rid, reason)
+            if kind == "disable-file":
+                file_wide.append(ent)
+            else:
+                per_line.setdefault(i, []).append(ent)
+    return per_line, file_wide
+
+
+def _dotted_name(path: str) -> Optional[str]:
+    """Best-effort dotted module name: climb while __init__.py exists."""
+    path = os.path.abspath(path)
+    base = os.path.basename(path)
+    parts = [] if base == "__init__.py" else [base[:-3]]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        d = os.path.dirname(d)
+    return ".".join(parts) if parts else None
+
+
+def load_module(path: str, root: str):
+    """Parse one file.  Returns (Module, None) or (None, Finding) when
+    the file does not parse — a PARSE-ERROR is itself a finding (a file
+    the analyzer cannot read is a file it cannot vouch for)."""
+    relpath = os.path.relpath(path, root).replace(os.sep, "/")
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        line = getattr(e, "lineno", 1) or 1
+        return None, Finding("PARSE-ERROR", relpath, line, 0,
+                             f"could not parse: {e}")
+    per_line, file_wide = _parse_suppressions(source)
+    parts = os.path.abspath(path).replace(os.sep, "/").split("/")
+    return Module(
+        path=os.path.abspath(path), relpath=relpath, source=source,
+        tree=tree, lines=source.splitlines(), suppressions=per_line,
+        file_suppressions=file_wide, dotted=_dotted_name(path),
+        in_apex_package="apex_tpu" in parts), None
+
+
+# -- baseline ---------------------------------------------------------------
+#
+# A baselined finding is matched by CONTENT fingerprint — (rule, path,
+# stripped source line text, k-th occurrence of that triple) — so pure
+# line-number drift (edits above the finding) does not un-baseline it,
+# while touching the flagged line itself does.
+
+
+def _fingerprint(f: Finding, text: str, k: int) -> str:
+    return f"{f.rule}::{f.path}::{text.strip()}::{k}"
+
+
+def _finding_fingerprints(findings, modules_by_rel):
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out = []
+    for f in findings:
+        mod = modules_by_rel.get(f.path)
+        text = ""
+        if mod is not None and 1 <= f.line <= len(mod.lines):
+            text = mod.lines[f.line - 1]
+        key = (f.rule, f.path, text.strip())
+        k = seen.get(key, 0)
+        seen[key] = k + 1
+        out.append(_fingerprint(f, text, k))
+    return out
+
+
+def load_baseline(path: Optional[str]):
+    if not path or not os.path.isfile(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return set(data.get("findings", []))
+
+
+def write_baseline(path: str, result: "LintResult", modules_by_rel) -> int:
+    """Write every currently-unsuppressed finding as the new baseline;
+    returns the number grandfathered."""
+    fps = _finding_fingerprints(
+        [f for f in result.findings if not f.suppressed],
+        modules_by_rel)
+    payload = {"version": 1, "tool": "apex_tpu.lint",
+               "findings": sorted(fps)}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return len(fps)
+
+
+# -- run loop ---------------------------------------------------------------
+
+
+def run(paths, select=None, ignore=None, baseline=DEFAULT_BASELINE,
+        root=None):
+    """Run the rule registry over ``paths``.
+
+    ``select`` / ``ignore`` are iterables of rule ids; ``baseline`` a
+    path (or None to disable).  ``root`` anchors reported relative paths
+    and baseline fingerprints (default: cwd).  Returns a
+    :class:`LintResult`; the caller decides what exit status
+    ``result.active()`` maps to.
+    """
+    from . import rules as _rules
+    from .callgraph import CallGraph
+
+    t0 = time.perf_counter()
+    root = os.path.abspath(root or os.getcwd())
+    active_rules = _rules.resolve(select, ignore)
+
+    modules: List[Module] = []
+    findings: List[Finding] = []
+    files: List[str] = []
+    for path in iter_py_files(paths):
+        files.append(os.path.abspath(path))
+        mod, err = load_module(path, root)
+        if err is not None:
+            findings.append(err)
+        else:
+            modules.append(mod)
+
+    ctx = _rules.LintContext(modules=modules,
+                             callgraph=CallGraph(modules))
+    for rule in active_rules:
+        for mod in modules:
+            for f in rule.check(mod, ctx):
+                ent = mod.suppression_for(f.rule, f.line)
+                if ent is not None:
+                    f.suppressed = True
+                    f.suppress_reason = ent[1]
+                findings.append(f)
+
+    by_rel = {m.relpath: m for m in modules}
+    baselined = load_baseline(baseline)
+    if baselined:
+        for f, fp in zip(findings,
+                         _finding_fingerprints(findings, by_rel)):
+            if not f.suppressed and fp in baselined:
+                f.baselined = True
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    result = LintResult(findings, files,
+                        [r.id for r in active_rules],
+                        time.perf_counter() - t0)
+    result._modules_by_rel = by_rel      # for --write-baseline
+    return result

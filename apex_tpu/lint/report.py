@@ -1,0 +1,26 @@
+"""Reporters: human-readable (default) and JSON (tooling/CI)."""
+from __future__ import annotations
+
+import json
+
+
+def human(result, show_suppressed=False) -> str:
+    lines = []
+    shown = [f for f in result.findings
+             if show_suppressed or not (f.suppressed or f.baselined)]
+    for f in shown:
+        lines.append(f.format())
+    c = result.counts()
+    tail = (f"{c['findings']} finding(s), {c['suppressed']} suppressed, "
+            f"{c['baselined']} baselined — {c['files']} files, "
+            f"{len(c['rules_run'])} rules, {c['lint_ms']:.0f} ms")
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def as_json(result, show_suppressed=False) -> str:
+    out = result.counts()
+    out["findings_list"] = [
+        f.to_json() for f in result.findings
+        if show_suppressed or not (f.suppressed or f.baselined)]
+    return json.dumps(out, indent=1)

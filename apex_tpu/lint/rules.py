@@ -1,0 +1,668 @@
+"""The TPU-hazard rule set.
+
+Every rule encodes an invariant this repo already paid to learn (the PR
+that paid is named in each docstring); ``docs/lint.md`` carries the full
+catalog with the historical incident behind each one.  Rules are pure
+AST passes — conservative by construction: an expression a rule cannot
+resolve is dropped, never guessed, so a finding is worth reading.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding, Module
+
+#: scalar hyperparameters that must enter compiled steps TRACED.  Exact
+#: identifier / attribute matches only ("grad_accum_steps" is a program
+#: *shape* and belongs in static keys; "lr" never does).
+HYPERPARAM_NAMES = {
+    "lr", "learning_rate", "beta1", "beta2", "betas", "eps",
+    "weight_decay", "wd", "momentum", "step", "loss_scale",
+}
+
+#: mapped-axis collectives (jax.lax) that must not sit inside an
+#: accumulation scan body
+COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+               "all_to_all", "ppermute", "psum_scatter"}
+
+#: metadata attributes that are static under tracing — reading them off a
+#: traced value is NOT a host sync / traced branch
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type",
+                 "callable", "format", "repr", "str"}
+
+
+def _terminal(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for the matching Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    """String constants in a static_argnames value (str or tuple/list)."""
+    out = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+    return out
+
+
+def _static_key_exprs(call: ast.Call) -> List[ast.AST]:
+    """Expressions landing in hashable program-key positions: the
+    ``static_key`` of ``step_cache.program``, the ``static_cfg`` /
+    ``scaler_cfg`` of the optimizer-step dispatchers, and any keyword
+    spelled like one of those anywhere."""
+    name = _terminal(call.func)
+    out = []
+    if name == "program" and len(call.args) >= 2:
+        out.append(call.args[1])
+    elif name in ("optimizer_step", "optimizer_step_with_scaler"):
+        if len(call.args) >= 2:
+            out.append(call.args[1])
+        if name == "optimizer_step_with_scaler" and len(call.args) >= 5:
+            out.append(call.args[4])
+    for kw in call.keywords:
+        if kw.arg in ("static_key", "static_cfg", "scaler_cfg"):
+            out.append(kw.value)
+    return out
+
+
+@dataclasses.dataclass
+class LintContext:
+    modules: List[Module]
+    callgraph: object           # callgraph.CallGraph
+
+
+class Rule:
+    """Base: subclasses set ``id``/``summary``/``hint`` and implement
+    :meth:`check` yielding :class:`Finding`."""
+    id: str = ""
+    summary: str = ""
+    hint: str = ""
+
+    def check(self, module: Module, ctx: LintContext):
+        raise NotImplementedError
+
+    def finding(self, module: Module, node: ast.AST, message: str,
+                hint: Optional[str] = None) -> Finding:
+        return Finding(self.id, module.relpath,
+                       getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message,
+                       self.hint if hint is None else hint)
+
+
+REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    REGISTRY[cls.id] = cls()
+    return cls
+
+
+def rule_ids() -> List[str]:
+    return sorted(REGISTRY)
+
+
+def resolve(select=None, ignore=None) -> List[Rule]:
+    ids = list(select) if select else rule_ids()
+    unknown = [i for i in list(ids) + list(ignore or [])
+               if i not in REGISTRY]
+    if unknown:
+        raise KeyError(f"unknown rule id(s): {unknown}; "
+                       f"known: {rule_ids()}")
+    ignore = set(ignore or ())
+    return [REGISTRY[i] for i in ids if i not in ignore]
+
+
+# ---------------------------------------------------------------------------
+# RETRACE-STATIC
+# ---------------------------------------------------------------------------
+
+
+@register
+class RetraceStatic(Rule):
+    """Traced hyperparameters in static jit keys — PR 1's ~200x bug.
+
+    A value in ``static_argnames`` (or any hashable program-cache key)
+    becomes part of the executable's identity: an lr *schedule* then
+    compiles a fresh XLA program every step.  PR 1 measured ~200x step
+    overhead from exactly this in the fused optimizers.  Hyperparameters
+    must enter as traced device scalars.
+    """
+    id = "RETRACE-STATIC"
+    summary = ("hyperparameter in a static jit key (retraces every "
+               "schedule tick)")
+    hint = ("pass lr/betas/eps/weight_decay/step as traced device "
+            "scalars (jnp.asarray) — see runtime/step_cache.py's hyper "
+            "tree; static keys are for program *shape* only")
+
+    def check(self, module, ctx):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tn = _terminal(node.func)
+            # jax.jit(f, static_argnames=...) and partial(jax.jit, ...)
+            calls = []
+            if tn in ("jit", "pjit"):
+                calls.append(node)
+            elif tn == "partial" and node.args and \
+                    _terminal(node.args[0]) in ("jit", "pjit"):
+                calls.append(node)
+            for c in calls:
+                for kw in c.keywords:
+                    if kw.arg != "static_argnames":
+                        continue
+                    bad = [s for s in _const_strs(kw.value)
+                           if s in HYPERPARAM_NAMES]
+                    if bad:
+                        yield self.finding(
+                            module, kw.value,
+                            f"hyperparameter(s) {bad} in static_argnames "
+                            f"— every schedule change recompiles")
+            # hashable step-cache key positions
+            for expr in _static_key_exprs(node):
+                for sub in ast.walk(expr):
+                    name = None
+                    if isinstance(sub, ast.Name) and \
+                            sub.id in HYPERPARAM_NAMES:
+                        name = sub.id
+                    elif isinstance(sub, ast.Attribute) and \
+                            sub.attr in HYPERPARAM_NAMES:
+                        name = _dotted(sub) or sub.attr
+                    if name:
+                        yield self.finding(
+                            module, sub,
+                            f"hyperparameter '{name}' embedded in a "
+                            f"static program key — one executable per "
+                            f"value (schedules recompile every step)")
+
+
+# ---------------------------------------------------------------------------
+# HOST-SYNC
+# ---------------------------------------------------------------------------
+
+
+@register
+class HostSync(Rule):
+    """Host synchronization inside traced code.
+
+    ``.item()`` / ``jax.device_get`` / ``np.asarray`` / Python ``float()``
+    or ``if`` on a traced value blocks dispatch on a device round-trip —
+    per call, per step.  Scoped by the intra-package call graph to
+    functions reachable from jit entry points, so eager logging loops
+    never flag.
+    """
+    id = "HOST-SYNC"
+    summary = "host round-trip inside a jit-reachable function"
+    hint = ("keep the value on device (jnp ops, lax.cond on traced "
+            "flags); fetch for logging OUTSIDE the compiled step — see "
+            "the on-device overflow flag in amp/scaler.py for the "
+            "pattern")
+
+    def _traced_refs(self, node, params, out):
+        """Name nodes referring to traced params, skipping contexts that
+        are static under tracing (.shape/.dtype, len(), `is None`)."""
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return
+        if isinstance(node, ast.Call) and \
+                _terminal(node.func) in _STATIC_CALLS:
+            return
+        if isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load) and node.id in params:
+                out.append(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._traced_refs(child, params, out)
+
+    def _walk_own(self, root):
+        """Walk a function body without descending into nested defs
+        (each reachable nested def is visited as its own function)."""
+        stack = list(ast.iter_child_nodes(root))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, module, ctx):
+        table = ctx.callgraph.imports.get(module.path)
+        np_aliases = {a for a, d in table.ext_alias.items()
+                      if d == "numpy"} if table else {"np"}
+        for info in ctx.callgraph.reachable_functions(module.path):
+            # value-sensitive checks key on provably-traced params (an
+            # entry's own args minus static_argnames); .item()/device_get
+            # are flagged in every reachable function regardless
+            params = ctx.callgraph.traced_params(info)
+            for node in self._walk_own(info.node):
+                if isinstance(node, ast.Call):
+                    yield from self._check_call(module, node, params,
+                                                np_aliases)
+                elif isinstance(node, (ast.If, ast.While)):
+                    refs = []
+                    self._traced_refs(node.test, params, refs)
+                    if refs:
+                        yield self.finding(
+                            module, node.test,
+                            f"Python `{type(node).__name__.lower()}` on "
+                            f"traced value '{refs[0].id}' — the branch "
+                            f"forces a device fetch at trace boundaries "
+                            f"(use jnp.where / lax.cond)")
+
+    def _check_call(self, module, node, params, np_aliases):
+        tn = _terminal(node.func)
+        if tn == "item" and isinstance(node.func, ast.Attribute) and \
+                not node.args:
+            yield self.finding(
+                module, node,
+                ".item() inside traced code — blocks on a device "
+                "round-trip every step")
+            return
+        if tn == "device_get":
+            yield self.finding(
+                module, node,
+                "jax.device_get inside traced code — host transfer on "
+                "the step's critical path")
+            return
+        if tn in ("asarray", "array") and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in np_aliases and node.args:
+            refs = []
+            self._traced_refs(node.args[0], params, refs)
+            if refs:
+                yield self.finding(
+                    module, node,
+                    f"np.{tn} of traced value '{refs[0].id}' — "
+                    f"materializes on host (use jnp.{tn})")
+            return
+        if tn in ("float", "int", "bool") and \
+                isinstance(node.func, ast.Name) and len(node.args) == 1 \
+                and not isinstance(node.args[0], ast.Constant):
+            refs = []
+            self._traced_refs(node.args[0], params, refs)
+            if refs:
+                yield self.finding(
+                    module, node,
+                    f"{tn}() of traced value '{refs[0].id}' — host sync "
+                    f"(keep it a device scalar)")
+
+
+# ---------------------------------------------------------------------------
+# SCAN-COLLECTIVE
+# ---------------------------------------------------------------------------
+
+
+@register
+class ScanCollective(Rule):
+    """Collectives inside a ``lax.scan`` body — PR 3's boundary-only
+    invariant.
+
+    ``make_train_step(accum_steps=K)`` exists so a K-microbatch window
+    costs ONE gradient exchange at the boundary; a ``psum`` inside the
+    scan body pays K exchanges.  Syntactic: flags collectives written
+    directly in the scanned function (scan bodies that legitimately hop
+    per tick — ring attention, pipeline stages — suppress with the
+    algorithmic reason).
+    """
+    id = "SCAN-COLLECTIVE"
+    summary = "collective inside a lax.scan body (per-microbatch exchange)"
+    hint = ("hoist the collective to the scan boundary (accumulate in "
+            "fp32 in the carry, exchange once) — see "
+            "training/step.py's accumulation window; if the algorithm "
+            "truly hops per step, suppress with the reason")
+
+    def _body_ast(self, module, call: ast.Call):
+        body = call.args[0] if call.args else None
+        if isinstance(body, ast.Lambda):
+            return body
+        if isinstance(body, ast.Name):
+            # nearest definition ABOVE the scan call (same-name bodies in
+            # sibling scopes — e.g. two schedules each with a `tick`)
+            best = None
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        node.name == body.id and \
+                        node.lineno <= call.lineno:
+                    if best is None or node.lineno > best.lineno:
+                        best = node
+            return best
+        return None
+
+    def check(self, module, ctx):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or \
+                    _terminal(node.func) != "scan":
+                continue
+            body = self._body_ast(module, node)
+            if body is None:
+                continue
+            for sub in ast.walk(body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                tn = _terminal(sub.func)
+                if tn not in COLLECTIVES:
+                    continue
+                # lax.psum(1, axis) is the axis-size idiom: constant-
+                # folded to the mesh size, no collective is emitted
+                if tn in ("psum", "pmean", "pmax", "pmin") and sub.args \
+                        and isinstance(sub.args[0], ast.Constant):
+                    continue
+                yield self.finding(
+                    module, sub,
+                    f"lax.{tn} inside the lax.scan body at line "
+                    f"{node.lineno} — one collective PER scan step, "
+                    f"not per window")
+
+
+# ---------------------------------------------------------------------------
+# DONATED-REUSE
+# ---------------------------------------------------------------------------
+
+
+@register
+class DonatedReuse(Rule):
+    """Reading an argument after donating it.
+
+    ``donate_argnums`` lets XLA write outputs into the input buffers;
+    the step-cache donates params/moments/scaler state every step.  Any
+    later read of the donated reference sees freed (or overwritten)
+    memory.  Tracks, per function, names passed at donated positions of
+    a jit-with-donation call site and flags later loads.
+    """
+    id = "DONATED-REUSE"
+    summary = "argument read after being donated to a jit call"
+    hint = ("rebind every output of a donating call and drop the input "
+            "reference (state = fn(state, ...)); copy first "
+            "(jnp.copy) if the pre-step value is really needed")
+
+    def _donated_positions(self, call: ast.Call):
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = tuple(e.value for e in v.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int))
+                if out:
+                    return out
+            # conditional spellings ((0,) if donate else ()) are dynamic
+            # — resolved conservatively as "maybe donates nothing"
+            return ()
+        return None
+
+    def check(self, module, ctx):
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(module, fn)
+
+    def _check_function(self, module, fn):
+        jitted: Dict[str, Tuple[int, ...]] = {}
+        consumed: List[Tuple[str, int, str]] = []  # (name, line, via)
+        stores: List[Tuple[str, int]] = []
+        loads: List[ast.Name] = []
+
+        def record_call(call, positions):
+            for p in positions:
+                if p < len(call.args) and \
+                        isinstance(call.args[p], ast.Name):
+                    consumed.append((call.args[p].id, call.lineno,
+                                     _terminal(call.func) or "<fn>"))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                tn = _terminal(node.value.func)
+                if tn in ("jit", "pjit"):
+                    pos = self._donated_positions(node.value)
+                    if pos:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                jitted[tgt.id] = pos
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in jitted:
+                    record_call(node, jitted[node.func.id])
+                elif isinstance(node.func, ast.Call) and \
+                        _terminal(node.func.func) in ("jit", "pjit"):
+                    pos = self._donated_positions(node.func)
+                    if pos:
+                        record_call(node, pos)
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    stores.append((node.id, node.lineno))
+                elif isinstance(node.ctx, ast.Load):
+                    loads.append(node)
+
+        # ast.walk is breadth-first; reads must be considered in source
+        # order or a late rebind would mask an earlier stale read
+        loads.sort(key=lambda n: (n.lineno, n.col_offset))
+        for name, cline, via in consumed:
+            for load in loads:
+                if load.id != name or load.lineno <= cline:
+                    continue
+                # a store on the consuming line itself (`x = fn(x)`) is
+                # the sanctioned rebind pattern
+                if any(s == name and cline <= sl <= load.lineno
+                       for s, sl in stores):
+                    break       # rebound; later loads see the new value
+                yield self.finding(
+                    module, load,
+                    f"'{name}' read after being donated to '{via}' at "
+                    f"line {cline} — the buffer was invalidated by the "
+                    f"call")
+                break           # one finding per consumed name
+
+
+# ---------------------------------------------------------------------------
+# COMPAT-SHIM
+# ---------------------------------------------------------------------------
+
+
+@register
+class CompatShim(Rule):
+    """Direct ``jax.shard_map`` / ``lax.axis_size`` in package code.
+
+    Both are jax>=0.5 spellings: on the 0.4.x runtimes this repo
+    supports they are AttributeErrors (the PR 3 satellite that fixed
+    ~120 tier-1 failures).  Package code goes through
+    ``apex_tpu.compat``; user code may use the modern names because
+    ``compat.install()`` polyfills them — so this rule only applies
+    inside the apex_tpu package.
+    """
+    id = "COMPAT-SHIM"
+    summary = "direct jax.shard_map / lax.axis_size (breaks on jax 0.4.x)"
+    hint = ("use apex_tpu.compat.shard_map / compat.axis_size — the shim "
+            "translates check_vma<->check_rep and polyfills 0.4.x")
+
+    def check(self, module, ctx):
+        if not module.in_apex_package or \
+                module.path.endswith("compat.py"):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute):
+                d = _dotted(node)
+                if d == "jax.shard_map":
+                    yield self.finding(
+                        module, node,
+                        "direct jax.shard_map — AttributeError on "
+                        "jax 0.4.x (compat.shard_map translates the "
+                        "check_vma knob)")
+                elif d in ("jax.lax.axis_size", "lax.axis_size"):
+                    yield self.finding(
+                        module, node,
+                        "direct lax.axis_size — does not exist on "
+                        "jax 0.4.x (compat.axis_size uses the psum(1) "
+                        "idiom there)")
+                elif d and d.startswith("jax.experimental.shard_map"):
+                    yield self.finding(
+                        module, node,
+                        "jax.experimental.shard_map referenced directly "
+                        "— removed on modern jax; the shim owns version "
+                        "dispatch")
+            elif isinstance(node, ast.ImportFrom) and \
+                    (node.module or "").startswith(
+                        "jax.experimental.shard_map"):
+                yield self.finding(
+                    module, node,
+                    "import from jax.experimental.shard_map — removed "
+                    "on modern jax; route through apex_tpu.compat")
+
+
+# ---------------------------------------------------------------------------
+# UNBOUNDED-COLLECTIVE
+# ---------------------------------------------------------------------------
+
+
+@register
+class UnboundedCollective(Rule):
+    """Process-wide collectives outside the bounded wrapper — PR 2.
+
+    ``multihost_utils`` calls block until EVERY process arrives; one
+    preempted host hangs the job forever with no diagnosis.  PR 2's
+    ``timed_flat_dist_call`` (parallel/distributed.py) wraps them with a
+    deadline and names the missing ranks on timeout — everything
+    process-wide goes through it.
+    """
+    id = "UNBOUNDED-COLLECTIVE"
+    summary = "raw multihost collective (no deadline, no missing-rank "\
+              "diagnosis)"
+    hint = ("route through apex_tpu.parallel.timed_flat_dist_call "
+            "(deadline + CollectiveTimeoutError naming absent ranks) — "
+            "see runtime/resilience.py's bounded init")
+
+    def check(self, module, ctx):
+        if module.path.replace("\\", "/").endswith(
+                "apex_tpu/parallel/distributed.py"):
+            return      # the sanctioned wrapper home
+        locals_from_mhu: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom):
+                m = node.module or ""
+                if "multihost_utils" in m:
+                    yield self.finding(
+                        module, node,
+                        f"import from {m} — unbounded process-wide "
+                        f"collective surface")
+                    locals_from_mhu |= {al.asname or al.name
+                                        for al in node.names}
+                elif m == "jax.experimental":
+                    for al in node.names:
+                        if al.name == "multihost_utils":
+                            yield self.finding(
+                                module, node,
+                                "import of jax.experimental."
+                                "multihost_utils — unbounded collective "
+                                "surface")
+            elif isinstance(node, ast.Import):
+                for al in node.names:
+                    if "multihost_utils" in al.name:
+                        yield self.finding(
+                            module, node,
+                            f"import {al.name} — unbounded collective "
+                            f"surface")
+            elif isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                tn = _terminal(node.func)
+                if "multihost_utils" in d:
+                    yield self.finding(
+                        module, node,
+                        f"{d} call — blocks until every process "
+                        f"arrives, with no deadline")
+                elif tn in locals_from_mhu and \
+                        isinstance(node.func, ast.Name):
+                    yield self.finding(
+                        module, node,
+                        f"{tn}() (from multihost_utils) — blocks until "
+                        f"every process arrives, with no deadline")
+
+
+# ---------------------------------------------------------------------------
+# IMPURE-STATIC-KEY
+# ---------------------------------------------------------------------------
+
+_IMPURE_OWNERS = {"random", "secrets"}
+_IMPURE_CALLS = {
+    ("time", "time"), ("time", "time_ns"), ("time", "monotonic"),
+    ("time", "monotonic_ns"), ("time", "perf_counter"),
+    ("time", "perf_counter_ns"), ("os", "urandom"),
+    ("uuid", "uuid1"), ("uuid", "uuid4"),
+    ("datetime", "now"), ("datetime", "utcnow"),
+}
+
+
+@register
+class ImpureStaticKey(Rule):
+    """Wall-clock / RNG values feeding program-cache keys.
+
+    A static key exists to make "same program" hashable; ``time.time()``
+    or ``random.*`` in that position makes every call a distinct program
+    — silent unbounded recompilation (and cache-stats that lie).  Also
+    flags ``id(...)``: stable within a process but not across restarts,
+    so resumed runs silently recompile everything.
+    """
+    id = "IMPURE-STATIC-KEY"
+    summary = "impure value (time/random/id) in a static program key"
+    hint = ("key on stable program *shape* (config tuples, treedefs, "
+            "shapes/dtypes, monotonic builder tokens) — see "
+            "training/step.py's _STEP_TOKENS for the per-builder "
+            "pattern")
+
+    def _impure_calls(self, expr, module) -> Iterable[ast.Call]:
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            tn = _terminal(sub.func)
+            if isinstance(sub.func, ast.Name) and tn == "id":
+                yield sub
+                continue
+            if isinstance(sub.func, ast.Attribute):
+                d = _dotted(sub.func) or ""
+                parts = d.split(".")
+                if len(parts) >= 2:
+                    owner, leaf = parts[-2], parts[-1]
+                    if (owner, leaf) in _IMPURE_CALLS or \
+                            owner in _IMPURE_OWNERS or \
+                            (owner == "random" or
+                             ".random." in f".{d}"):
+                        yield sub
+
+    def check(self, module, ctx):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for expr in _static_key_exprs(node):
+                for bad in self._impure_calls(expr, module):
+                    d = _dotted(bad.func) or _terminal(bad.func)
+                    yield self.finding(
+                        module, bad,
+                        f"{d}(...) inside a static program key — every "
+                        f"call keys a new executable (unbounded "
+                        f"recompilation)")

@@ -1029,7 +1029,33 @@ def build_planned_step(model, optimizer, loss_fn, parallel, *,
 def measured_step_memory(compiled) -> int:
     """Per-device footprint of a compiled step program, donation-aware:
     arguments + outputs + temps − aliased (donated buffers counted
-    once).  The validation target for :func:`predict_memory`."""
+    once).  The validation target for :func:`predict_memory`.
+
+    Compile the program with :func:`compile_uncached`: when jax 0.4.x's
+    persistent compilation cache is enabled, executables that pass
+    through its (de)serialization layer report ``alias_size_in_bytes=0``
+    — a donated program then measures its outputs double, and whether a
+    given compile passes through the layer depends on the
+    ``min_compile_time_secs`` threshold, i.e. on machine load.
+    """
     ma = compiled.memory_analysis()
     return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
                + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+
+
+def compile_uncached(lowered):
+    """``lowered.compile()`` with the persistent compilation cache
+    disabled for the duration — the donation-aware companion of
+    :func:`measured_step_memory` (see its note on alias metadata)."""
+    try:
+        prev = jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        prev = None
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:       # knob absent on this jax: nothing to bypass
+        return lowered.compile()
+    try:
+        return lowered.compile()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)

@@ -84,7 +84,7 @@ def make_gan_train_step(netD, netG, optD, optG,
                         scale_window: int = 2000,
                         min_loss_scale: Optional[float] = None,
                         max_loss_scale: float = 2.0 ** 24,
-                        donate_state: bool = True,
+                        donate_state="auto",
                         lr_schedule: Optional[Callable] = None,
                         rng_seed: int = 0):
     """Build the fused GAN iteration.
@@ -95,6 +95,12 @@ def make_gan_train_step(netD, netG, optD, optG,
     (errD, errG))``.  ``lr_schedule`` applies to both optimizers from
     each network's own step counter (as in make_train_step).
     """
+    if donate_state == "auto":
+        # the step cache's donation policy: donate on tpu/gpu, skip on
+        # cpu (defensive copies + the jax-0.4.x cached-executable
+        # aliasing hazard — see make_train_step's donate_state doc)
+        from ..runtime.step_cache import donation_enabled
+        donate_state = donation_enabled()
     d_parts = _net_parts(netD, optD, half_dtype, keep_batchnorm_fp32,
                          "make_gan_train_step(netD)")
     g_parts = _net_parts(netG, optG, half_dtype, keep_batchnorm_fp32,

@@ -639,7 +639,7 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     tp_axis: Optional[str] = None,
                     gradient_predivide_factor: float = 1.0,
                     allreduce_always_fp32: bool = False,
-                    donate_state: bool = True,
+                    donate_state="auto",
                     grad_accum_steps: int = 1,
                     accum_steps: Optional[int] = None,
                     accum_stacked: bool = False,
@@ -763,7 +763,18 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     sequence geometry, and ``auto_tune=k`` compiles and times the top-k
     predicted plans and re-ranks by measurement.  See
     ``docs/auto_parallel.md``.
+
+    ``donate_state``: "auto" (default) follows the step cache's donation
+    policy — donate on tpu/gpu (in-place buffer reuse), skip on cpu,
+    where XLA degrades donation to defensive copies (measured 2x step
+    time, and jax 0.4.x's persistently-cached CPU executables resolve
+    the input→output aliasing of deserialized donated programs
+    incorrectly — stale outputs on cache hits).  Pass True/False to
+    force.
     """
+    if donate_state == "auto":
+        from ..runtime.step_cache import donation_enabled
+        donate_state = donation_enabled()
     if parallel is not None:
         if axis_name is not None or tp_axis is not None or zero_sharding:
             raise ValueError(

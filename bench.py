@@ -2002,6 +2002,43 @@ def run_plan_bench(args):
     return 0
 
 
+def lint_records():
+    """``--lint``: analyzer health alongside the perf metrics.
+
+    Runs the full apex_tpu.lint rule set (docs/lint.md) over the package
+    and the examples — the same scope as the tier-1 gate
+    (tests/test_lint_clean.py) — so a multichip bench round also records
+    whether the tree it measured was hazard-clean, and how much the
+    analyzer itself costs.  Pure-AST: needs no backend, so it reports
+    even when the TPU tunnel is wedged.
+    """
+    from apex_tpu import lint as tpu_lint
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    targets = [p for p in (os.path.join(repo, "apex_tpu"),
+                           os.path.join(repo, "examples"))
+               if os.path.isdir(p)]
+    res = tpu_lint.run(targets, root=repo)
+    c = res.counts()
+    return [{
+        "metric": "lint_findings",
+        "value": c["findings"], "unit": "findings",
+        "lint_findings": c["findings"],
+        "lint_ms": c["lint_ms"],
+        "rules_run": c["rules_run"],
+        "files_scanned": c["files"],
+        "suppressed": c["suppressed"],
+        "baselined": c["baselined"],
+    }]
+
+
+def run_lint(args):
+    stage("lint", "apex_tpu + examples, full rule set")
+    for rec in lint_records():
+        emit(rec)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("batch", nargs="?", type=int, default=None)
@@ -2146,6 +2183,13 @@ def main():
                          "its top-3 plans and emit predicted-vs-measured "
                          "per plan — the CHIPS constants calibration "
                          "loop (docs/auto_parallel.md)")
+    ap.add_argument("--lint", action="store_true",
+                    help="lint_findings stage: run the apex_tpu.lint "
+                         "TPU-hazard analyzer (docs/lint.md) over "
+                         "apex_tpu/ and examples/ and emit "
+                         "{lint_findings, lint_ms, rules_run} — records "
+                         "analyzer health alongside perf; pure-AST, no "
+                         "backend needed")
     ap.add_argument("--ckpt-microbench", action="store_true",
                     help="ckpt_save_ms stage: CheckpointManager sync vs "
                          "async save (submit/drain split + overlap factor) "
@@ -2162,6 +2206,10 @@ def main():
     if args.accum_microbench:
         start_watchdog(args.budget_s)
         return run_accum_microbench(args)
+
+    if args.lint:
+        start_watchdog(args.budget_s)
+        return run_lint(args)
 
     if args.ckpt_microbench:
         start_watchdog(args.budget_s)

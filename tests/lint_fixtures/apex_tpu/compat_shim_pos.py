@@ -1,0 +1,19 @@
+"""COMPAT-SHIM positive (scoped: this file sits under a directory named
+apex_tpu, so the rule treats it as package code)."""
+import jax
+from jax.experimental.shard_map import shard_map as legacy_sm   # BAD
+from jax.sharding import PartitionSpec as P
+
+
+def wrap(f, mesh):
+    # BAD: jax.shard_map is an AttributeError on jax 0.4.x
+    return jax.shard_map(f, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P("dp"))
+
+
+def world(axis):
+    # BAD: jax.lax.axis_size does not exist on jax 0.4.x
+    return jax.lax.axis_size(axis)
+
+
+del legacy_sm

@@ -1,0 +1,18 @@
+"""DONATED-REUSE negative: the sanctioned rebind pattern — every output
+rebound, no reads of the donated reference."""
+import jax
+import jax.numpy as jnp
+
+
+def train_loop(update, state, batches, log):
+    step = jax.jit(update, donate_argnums=(0,))
+    for batch in batches:
+        state = step(state, batch)      # consumed and rebound
+        log(batch)
+    return state
+
+
+def with_copy(update, params, grads):
+    before = jnp.stack([jnp.copy(p) for p in params])
+    new_params = jax.jit(update, donate_argnums=(0,))(params, grads)
+    return new_params, before           # the copy, not the donated ref

@@ -1,0 +1,18 @@
+"""DONATED-REUSE positive: reading a buffer after the step donated it."""
+import jax
+
+
+def train_loop(update, state, batches, log):
+    step = jax.jit(update, donate_argnums=(0,))
+    for batch in batches:
+        new_state = step(state, batch)
+        # BAD: `state` was donated to step() — this reads freed memory
+        log(state)
+        state = new_state
+    return state
+
+
+def one_shot(update, params, grads):
+    # BAD: inline donating call, then the stale reference
+    out = jax.jit(update, donate_argnums=(0,))(params, grads)
+    return out, params

@@ -1,0 +1,31 @@
+"""HOST-SYNC negative: syncs in eager code are fine; traced code keeps
+values on device; static_argnames config may branch."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean_step(params, grads, flag):
+    # on-device conditional: no round-trip
+    keep = flag > 0
+    return [jnp.where(keep, p, p - 0.1 * g)
+            for p, g in zip(params, grads)]
+
+
+def branchy_step(params, grads, mode):
+    if mode == "sgd":           # fine: mode is static at the jit site
+        return [p - 0.1 * g for p, g in zip(params, grads)]
+    return params
+
+
+jitted = jax.jit(branchy_step, static_argnames=("mode",))
+
+
+def eager_train_loop(step, params, batches):
+    """Eager driver — host syncs for logging are exactly where they
+    belong, OUTSIDE the compiled step."""
+    for batch in batches:
+        params, loss = step(params, batch)
+        print("loss:", float(loss), np.asarray(loss).shape)
+    return params, loss.item()

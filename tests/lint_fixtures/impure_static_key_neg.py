@@ -1,0 +1,18 @@
+"""IMPURE-STATIC-KEY negative: keys built from stable program shape —
+config tuples and monotonic builder tokens (training/step.py's
+_STEP_TOKENS pattern)."""
+import itertools
+
+_TOKENS = itertools.count()
+
+
+def make_step(step_cache, accum_steps, donate, build):
+    token = next(_TOKENS)
+
+    def step(params, grads):
+        args = (params, grads)
+        fn = step_cache.program(
+            "train_step", (token, accum_steps, bool(donate)), args, build)
+        return fn(*args)
+
+    return step

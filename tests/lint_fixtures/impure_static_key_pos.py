@@ -1,0 +1,25 @@
+"""IMPURE-STATIC-KEY positive: wall-clock / RNG / object identity in
+program-cache keys — every call becomes a distinct executable."""
+import random
+import time
+
+
+def timed_step(step_cache, params, grads, build):
+    args = (params, grads)
+    # BAD: time.time() keys a fresh program every call
+    fn = step_cache.program("sgd", ("cfg", time.time()), args, build)
+    return fn(*args)
+
+
+def jittered_step(step_cache, params, grads, build):
+    args = (params, grads)
+    # BAD: random jitter in the key — unbounded recompilation
+    fn = step_cache.program("sgd", ("cfg", random.random()), args, build)
+    return fn(*args)
+
+
+def identity_step(step_cache, optimizer, params, grads, build):
+    args = (params, grads)
+    # BAD: id() is not stable across restarts — resumed runs recompile
+    fn = step_cache.program("sgd", (id(optimizer),), args, build)
+    return fn(*args)

@@ -1,0 +1,33 @@
+"""Dynamic-oracle BAD optimizer: the PR 1 retrace pathology, distilled.
+
+``lr`` lands in the hashable step-cache key, so every schedule tick
+compiles a fresh XLA executable.  ``tests/test_lint.py`` both lints
+this file (RETRACE-STATIC must fire) and RUNS it (``step_cache.stats()``
+must show one compile per distinct lr) — proving the static verdict
+matches runtime behavior.
+"""
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.runtime import step_cache
+
+
+def sgd_step(params, grads, lr):
+    def build():
+        def run(params, grads):
+            return [p - lr * g for p, g in zip(params, grads)]
+        return jax.jit(run)
+
+    args = (params, grads)
+    # BAD: lr in the static key — one executable per lr value
+    fn = step_cache.step_cache.program("oracle_bad", ("sgd", lr),
+                                       args, build)
+    return fn(*args)
+
+
+def train(steps=4, lr0=0.1):
+    params = [jnp.ones((4,), jnp.float32)]
+    grads = [jnp.full((4,), 0.5, jnp.float32)]
+    for i in range(steps):
+        params = sgd_step(params, grads, lr0 * (0.5 ** i))
+    return params

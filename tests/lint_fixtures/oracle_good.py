@@ -1,0 +1,30 @@
+"""Dynamic-oracle GOOD optimizer: lr enters as a traced device scalar.
+
+The static key carries program shape only, so a whole lr schedule runs
+against ONE compiled executable — ``step_cache.stats()`` pins 1 compile
+however many steps run, and RETRACE-STATIC stays silent.
+"""
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.runtime import step_cache
+
+
+def sgd_step(params, grads, lr):
+    def build():
+        def run(params, grads, lr):
+            return [p - lr * g for p, g in zip(params, grads)]
+        return jax.jit(run)
+
+    args = (params, grads, jnp.asarray(lr, jnp.float32))
+    fn = step_cache.step_cache.program("oracle_good", ("sgd",),
+                                       args, build)
+    return fn(*args)
+
+
+def train(steps=4, lr0=0.1):
+    params = [jnp.ones((4,), jnp.float32)]
+    grads = [jnp.full((4,), 0.5, jnp.float32)]
+    for i in range(steps):
+        params = sgd_step(params, grads, lr0 * (0.5 ** i))
+    return params

@@ -1,0 +1,16 @@
+"""RETRACE-STATIC negative: hyperparameters traced, static keys carry
+program shape only."""
+import jax
+import jax.numpy as jnp
+
+
+def make_update(update):
+    # shape knobs may be static; hyperparams enter as traced args
+    return jax.jit(update, static_argnames=("accum_steps", "donate"))
+
+
+def cached_step(step_cache, params, grads, lr, build):
+    # lr rides in the traced argument tuple, not the key
+    args = (params, grads, jnp.asarray(lr, jnp.float32))
+    fn = step_cache.program("sgd", ("cfg", True), args, build)
+    return fn(*args)

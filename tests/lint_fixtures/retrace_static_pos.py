@@ -1,0 +1,22 @@
+"""RETRACE-STATIC positive: hyperparameters in static jit keys — both
+spellings (static_argnames and a hashable step-cache key)."""
+import functools
+
+import jax
+
+
+def make_update(update):
+    # BAD: lr/weight_decay static — every schedule tick recompiles
+    return jax.jit(update, static_argnames=("lr", "weight_decay"))
+
+
+def make_update_partial(update):
+    # BAD: the functools.partial spelling of the same bug
+    return functools.partial(jax.jit, static_argnames=["lr"])(update)
+
+
+def cached_step(step_cache, params, grads, lr, build):
+    args = (params, grads)
+    # BAD: lr in the hashable program key — one executable per lr value
+    fn = step_cache.program("sgd", ("cfg", lr), args, build)
+    return fn(*args)

@@ -1,0 +1,18 @@
+"""SCAN-COLLECTIVE negative: boundary-only exchange (PR 3's invariant)
+— accumulate in the carry, one psum after the scan."""
+import jax
+from jax import lax
+
+
+def accum_window(grad_fn, params, micro, axis_name):
+    def body(carry, mb):
+        # the axis-size idiom: psum of the literal 1 constant-folds,
+        # no collective is emitted
+        n = lax.psum(1, axis_name)
+        g = grad_fn(params, mb)
+        return [c + gi / n for c, gi in zip(carry, g)], None
+
+    acc0 = [0.0 * p for p in params]
+    acc, _ = lax.scan(body, acc0, micro)
+    # ONE exchange at the window boundary
+    return [lax.psum(a, axis_name) for a in acc]

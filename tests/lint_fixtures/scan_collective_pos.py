@@ -1,0 +1,23 @@
+"""SCAN-COLLECTIVE positive: gradient exchange inside the accumulation
+scan body — K collectives per window instead of one."""
+import jax
+from jax import lax
+
+
+def accum_window(grad_fn, params, micro, axis_name):
+    def body(carry, mb):
+        g = grad_fn(params, mb)
+        # BAD: per-microbatch exchange
+        g = lax.psum(g, axis_name)
+        return [c + gi for c, gi in zip(carry, g)], None
+
+    acc0 = [0.0 * p for p in params]
+    acc, _ = lax.scan(body, acc0, micro)
+    return acc
+
+
+def mean_window(vals, xs, axis_name):
+    # BAD: lambda body with a per-step pmean
+    out, _ = jax.lax.scan(
+        lambda c, x: (c + lax.pmean(x, axis_name), None), vals, xs)
+    return out

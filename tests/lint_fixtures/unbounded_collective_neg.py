@@ -1,0 +1,8 @@
+"""UNBOUNDED-COLLECTIVE negative: process-wide calls through PR 2's
+bounded wrapper (deadline + CollectiveTimeoutError naming absent
+ranks)."""
+from apex_tpu.parallel import timed_flat_dist_call
+
+
+def distributed_init(tensors, collective):
+    return timed_flat_dist_call(tensors, collective, timeout_s=60.0)

@@ -1,0 +1,10 @@
+"""UNBOUNDED-COLLECTIVE positive: raw multihost_utils — blocks forever
+if one process never arrives, with no diagnosis."""
+from jax.experimental import multihost_utils                     # BAD
+from jax.experimental.multihost_utils import broadcast_one_to_all  # BAD
+
+
+def distributed_init(seed):
+    # BAD: no deadline, no missing-rank report
+    multihost_utils.sync_global_devices("init_barrier")
+    return broadcast_one_to_all(seed)

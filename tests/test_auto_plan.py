@@ -148,17 +148,23 @@ def test_scenario_memory_order_replicated_vs_zero3(profiled):
 
     def measured(plan):
         m, o = _build()
+        # donate_state=True: the memory ordering under test is that of
+        # the donated steady state the HBM model prices (the "auto"
+        # default resolves to no-donation on this cpu backend)
         step = make_train_step(m, o, _loss, half_dtype=None,
-                               loss_scale=1.0, parallel=plan)
+                               loss_scale=1.0, parallel=plan,
+                               donate_state=True)
         step(x, y)
         if plan.dp > 1:
             shs = step._batch_shardings((x, y))
-            comp = step._jitted(shs).lower(step.state, x, y).compile()
+            comp = auto.compile_uncached(
+            step._jitted(shs).lower(step.state, x, y))
         else:
             from apex_tpu.runtime.step_cache import step_cache
             ent = [e for e in step_cache.entries()
                    if e["kind"] == "train_step"][-1]
-            comp = ent["fn"].lower(*ent["example"]).compile()
+            comp = auto.compile_uncached(
+            ent["fn"].lower(*ent["example"]))
         return auto.measured_step_memory(comp)
 
     meas_rep, meas_z3 = measured(rep_plan), measured(z3_plan)

@@ -206,3 +206,15 @@ def test_ckpt_microbench_records_schema(tmp_path):
     assert all(r["value"] >= 0 for r in recs)
     (overlap,) = [r for r in recs if r["metric"] == "ckpt_save_overlap_x"]
     assert overlap["value"] > 0
+
+
+def test_lint_records_schema():
+    """--lint stage: one lint_findings record with the analyzer-health
+    fields (the r06 multichip rerun records hazard-cleanliness next to
+    perf), and a clean shipped tree."""
+    (rec,) = bench.lint_records()
+    assert rec["metric"] == "lint_findings"
+    assert rec["value"] == rec["lint_findings"] == 0   # tree ships clean
+    assert rec["lint_ms"] > 0
+    assert len(rec["rules_run"]) >= 7
+    assert rec["files_scanned"] > 100      # apex_tpu + examples

@@ -10,7 +10,6 @@ modern names onto the ``jax`` module so user code written against them
 runs unchanged.
 """
 import os
-import re
 
 import jax
 import jax.numpy as jnp
@@ -19,62 +18,44 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu import compat
+from apex_tpu import lint as tpu_lint
 
 PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "apex_tpu")
 
 
-def _source_files():
-    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
-        if "__pycache__" in dirpath:
-            continue
-        for f in filenames:
-            if f.endswith(".py"):
-                yield os.path.join(dirpath, f)
-
-
-def _strip_comments(text):
-    return "\n".join(line.split("#", 1)[0] for line in text.splitlines())
+def _compat_findings():
+    """One source of truth: the COMPAT-SHIM rule of the apex_tpu.lint
+    engine (these tests used to be ad-hoc regex greps; they are now thin
+    wrappers asserting the engine reports zero findings)."""
+    return tpu_lint.run([PKG_ROOT], select=["COMPAT-SHIM"], baseline=None)
 
 
 def test_lint_no_direct_jax_shard_map_references():
     """Every shard_map call site goes through apex_tpu.compat — a direct
     ``jax.shard_map`` reference is an AttributeError on jax 0.4.x."""
-    offenders = []
-    pat = re.compile(r"\bjax\.shard_map\b")
-    for path in _source_files():
-        if os.path.basename(path) == "compat.py":
-            continue        # the shim itself is the one allowed resolver
-        with open(path) as f:
-            text = _strip_comments(f.read())
-        if pat.search(text):
-            offenders.append(os.path.relpath(path, PKG_ROOT))
-    assert not offenders, (
-        f"direct jax.shard_map references (use apex_tpu.compat.shard_map): "
-        f"{offenders}")
+    bad = [f for f in _compat_findings().active()
+           if "shard_map" in f.message]
+    assert not bad, (
+        "direct jax.shard_map references (use apex_tpu.compat.shard_map): "
+        + "\n".join(f.format() for f in bad))
 
 
 def test_lint_no_direct_lax_axis_size_references():
-    offenders = []
-    pat = re.compile(r"\bjax\.lax\.axis_size\b")
-    for path in _source_files():
-        if os.path.basename(path) == "compat.py":
-            continue
-        with open(path) as f:
-            text = _strip_comments(f.read())
-        if pat.search(text):
-            offenders.append(os.path.relpath(path, PKG_ROOT))
-    assert not offenders, (
-        f"direct jax.lax.axis_size references (use apex_tpu.compat."
-        f"axis_size): {offenders}")
+    bad = [f for f in _compat_findings().active()
+           if "axis_size" in f.message]
+    assert not bad, (
+        "direct lax.axis_size references (use apex_tpu.compat.axis_size): "
+        + "\n".join(f.format() for f in bad))
 
 
 def test_lint_walk_covers_auto_planner():
-    """The no-direct-reference lint must actually SCAN the parallelism
-    planner (parallel/auto.py drives shard_map through the compat shim;
-    a lint that silently skipped it could not enforce the jax-0.4.37
-    invariant there)."""
-    files = {os.path.relpath(p, PKG_ROOT) for p in _source_files()}
+    """The engine must actually SCAN the parallelism planner
+    (parallel/auto.py drives shard_map through the compat shim; a lint
+    that silently skipped it could not enforce the jax-0.4.37 invariant
+    there)."""
+    files = {os.path.relpath(p, PKG_ROOT)
+             for p in _compat_findings().files}
     assert os.path.join("parallel", "auto.py") in files
     assert os.path.join("runtime", "step_cache.py") in files
 
@@ -86,7 +67,8 @@ def test_auto_planner_uses_compat_shard_map():
     and the module carries no direct jax.experimental.shard_map use)."""
     path = os.path.join(PKG_ROOT, "parallel", "auto.py")
     with open(path) as f:
-        text = _strip_comments(f.read())
+        text = "\n".join(line.split("#", 1)[0]
+                         for line in f.read().splitlines())
     assert "compat" in text and "compat.shard_map" in text
     assert "jax.experimental.shard_map" not in text
 

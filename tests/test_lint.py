@@ -1,0 +1,242 @@
+"""The apex_tpu.lint analyzer: every rule against its paired fixtures,
+engine machinery (suppressions, baseline, reporters, CLI exit codes),
+and the dynamic oracle proving RETRACE-STATIC's static verdict matches
+``step_cache.stats()`` compile counts at runtime."""
+import importlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from apex_tpu import lint as tpu_lint
+from apex_tpu.lint import engine, report, rules
+from apex_tpu.lint.__main__ import main as lint_main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+REPO = os.path.dirname(HERE)
+
+pytestmark = pytest.mark.lint
+
+#: rule id -> fixture stem (pos/neg pair)
+RULE_FIXTURES = {
+    "RETRACE-STATIC": "retrace_static",
+    "HOST-SYNC": "host_sync",
+    "SCAN-COLLECTIVE": "scan_collective",
+    "DONATED-REUSE": "donated_reuse",
+    "COMPAT-SHIM": os.path.join("apex_tpu", "compat_shim"),
+    "UNBOUNDED-COLLECTIVE": "unbounded_collective",
+    "IMPURE-STATIC-KEY": "impure_static_key",
+}
+
+
+def _fixture(stem, kind):
+    return os.path.join(FIXTURES, f"{stem}_{kind}.py")
+
+
+def _run(paths, **kw):
+    kw.setdefault("baseline", None)
+    return tpu_lint.run(paths, **kw)
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_registry_covers_required_rules():
+    assert set(RULE_FIXTURES) <= set(rules.rule_ids())
+    assert len(rules.rule_ids()) >= 7
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+def test_positive_fixture_flags(rule_id):
+    res = _run([_fixture(RULE_FIXTURES[rule_id], "pos")],
+               select=[rule_id])
+    assert res.active(), f"{rule_id}: positive fixture produced no finding"
+    assert all(f.rule == rule_id for f in res.active())
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+def test_negative_fixture_clean(rule_id):
+    res = _run([_fixture(RULE_FIXTURES[rule_id], "neg")],
+               select=[rule_id])
+    assert not res.active(), (
+        f"{rule_id}: negative fixture flagged:\n"
+        + "\n".join(f.format() for f in res.active()))
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+def test_cli_exits_nonzero_on_positive_fixture(rule_id, capsys):
+    rc = lint_main([_fixture(RULE_FIXTURES[rule_id], "pos"),
+                    "--select", rule_id, "--no-baseline"])
+    assert rc == 1
+    assert rule_id in capsys.readouterr().out
+
+
+def test_cli_module_entry_runs_positive_fixture():
+    """The acceptance-spelled invocation: ``python -m apex_tpu.lint``
+    exits non-zero on a positive fixture (one subprocess smoke test;
+    per-rule coverage runs in-process above)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.lint",
+         _fixture("retrace_static", "pos"), "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RETRACE-STATIC" in proc.stdout
+
+
+def test_finding_locations_are_exact():
+    res = _run([_fixture("retrace_static", "pos")],
+               select=["RETRACE-STATIC"])
+    lines = {f.line for f in res.active()}
+    src = open(_fixture("retrace_static", "pos")).read().splitlines()
+    for ln in lines:
+        assert "lr" in src[ln - 1]
+
+
+# ---------------------------------------------------------------------------
+# engine machinery
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_on_line_and_comment_block(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text(
+        "import jax\n"
+        "def mk(u):\n"
+        "    a = jax.jit(u, static_argnames=('lr',))"
+        "  # tpu-lint: disable=RETRACE-STATIC fixture reason\n"
+        "    # tpu-lint: disable=RETRACE-STATIC block reason\n"
+        "    # (wrapped continuation of the reason)\n"
+        "    b = jax.jit(u, static_argnames=('lr',))\n"
+        "    c = jax.jit(u, static_argnames=('lr',))\n"
+        "    return a, b, c\n")
+    res = _run([str(f)], select=["RETRACE-STATIC"])
+    assert len(res.findings) == 3
+    live = res.active()
+    assert len(live) == 1 and live[0].line == 7   # c: no directive
+    sup = [x for x in res.findings if x.suppressed]
+    assert {s.suppress_reason for s in sup} == {"fixture reason",
+                                                "block reason"}
+
+
+def test_file_wide_suppression(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text(
+        "# tpu-lint: disable-file=RETRACE-STATIC generated file\n"
+        "import jax\n"
+        "def mk(u):\n"
+        "    return jax.jit(u, static_argnames=('lr',))\n")
+    res = _run([str(f)], select=["RETRACE-STATIC"])
+    assert not res.active()
+    assert any(x.suppressed for x in res.findings)
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def oops(:\n")
+    res = _run([str(f)])
+    assert any(x.rule == "PARSE-ERROR" for x in res.active())
+
+
+def test_baseline_roundtrip(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text(
+        "import jax\n"
+        "def mk(u):\n"
+        "    return jax.jit(u, static_argnames=('lr',))\n")
+    bl = tmp_path / "baseline.json"
+    res = _run([str(src)], select=["RETRACE-STATIC"])
+    assert len(res.active()) == 1
+    n = engine.write_baseline(str(bl), res, res._modules_by_rel)
+    assert n == 1
+    res2 = tpu_lint.run([str(src)], select=["RETRACE-STATIC"],
+                        baseline=str(bl))
+    assert not res2.active()
+    assert any(f.baselined for f in res2.findings)
+    # a NEW finding is not grandfathered
+    src.write_text(src.read_text()
+                   + "def mk2(u):\n"
+                   "    return jax.jit(u, static_argnames=('wd',))\n")
+    res3 = tpu_lint.run([str(src)], select=["RETRACE-STATIC"],
+                        baseline=str(bl))
+    assert len(res3.active()) == 1 and res3.active()[0].line == 5
+
+
+def test_unknown_rule_id_is_usage_error(capsys):
+    assert lint_main(["--select", "NOT-A-RULE", FIXTURES]) == 2
+
+
+def test_json_reporter_schema():
+    res = _run([_fixture("scan_collective", "pos")],
+               select=["SCAN-COLLECTIVE"])
+    data = json.loads(report.as_json(res))
+    assert data["findings"] == len(res.active()) > 0
+    row = data["findings_list"][0]
+    assert {"rule", "path", "line", "col", "message",
+            "hint"} <= set(row)
+    assert data["rules_run"] == ["SCAN-COLLECTIVE"]
+
+
+def test_list_rules_cli(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULE_FIXTURES:
+        assert rid in out
+
+
+def test_engine_scans_nested_package_dirs():
+    """Walk-coverage: pointing the engine at apex_tpu/ provably visits
+    the planner and the step cache (the guarantee test_compat.py's
+    wrappers rely on)."""
+    res = _run([os.path.join(REPO, "apex_tpu")], select=["COMPAT-SHIM"])
+    rel = {os.path.relpath(p, REPO) for p in res.files}
+    assert os.path.join("apex_tpu", "parallel", "auto.py") in rel
+    assert os.path.join("apex_tpu", "runtime", "step_cache.py") in rel
+    assert os.path.join("apex_tpu", "lint", "rules.py") in rel
+
+
+# ---------------------------------------------------------------------------
+# the dynamic oracle
+# ---------------------------------------------------------------------------
+
+
+def _import_fixture(name):
+    sys.path.insert(0, FIXTURES)
+    try:
+        mod = importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+    return mod
+
+
+def test_retrace_static_dynamic_oracle():
+    """The static verdict matches runtime behavior: the fixture optimizer
+    RETRACE-STATIC flags recompiles on every schedule tick; the clean
+    one pins 1 compile over the same schedule."""
+    from apex_tpu.runtime import step_cache
+
+    bad_res = _run([os.path.join(FIXTURES, "oracle_bad.py")],
+                   select=["RETRACE-STATIC"])
+    good_res = _run([os.path.join(FIXTURES, "oracle_good.py")],
+                    select=["RETRACE-STATIC"])
+    assert len(bad_res.active()) == 1     # static verdict: bad
+    assert not good_res.active()          # static verdict: clean
+
+    bad = _import_fixture("oracle_bad")
+    good = _import_fixture("oracle_good")
+    steps = 4
+    step_cache.reset_stats()
+    bad.train(steps=steps)
+    good.train(steps=steps)
+    by_kind = step_cache.stats()["by_kind"]
+    # the flagged optimizer compiled once PER STEP (distinct lr values
+    # key distinct programs — the PR 1 pathology)
+    assert by_kind["oracle_bad"]["compiles"] == steps
+    assert by_kind["oracle_bad"]["cache_hits"] == 0
+    # the clean one compiled once and then hit the cache every step
+    assert by_kind["oracle_good"]["compiles"] == 1
+    assert by_kind["oracle_good"]["cache_hits"] == steps - 1

@@ -132,8 +132,11 @@ def test_zero_with_half_and_dynamic_scale(rng):
 
 def test_zero_rejects_donating_step():
     model, opt = _build()
+    # force donation: the default is "auto", which resolves to False on
+    # the cpu backend (step_cache's donation policy)
     step = make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t),
-                           half_dtype=None, loss_scale=1.0)  # donates
+                           half_dtype=None, loss_scale=1.0,
+                           donate_state=True)
     with pytest.raises(ValueError, match="donate_state=False"):
         ZeroTrainStep(step, Mesh(np.array(jax.devices()), ("data",)))
 
