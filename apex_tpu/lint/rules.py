@@ -666,3 +666,90 @@ class ImpureStaticKey(Rule):
                         f"{d}(...) inside a static program key — every "
                         f"call keys a new executable (unbounded "
                         f"recompilation)")
+
+
+# ---------------------------------------------------------------------------
+# CKPT-ATOMIC
+# ---------------------------------------------------------------------------
+
+_CKPT_PATH_RE_SRC = r"(ckpt|checkpoint|\.pkl)"
+
+
+@register
+class CkptAtomic(Rule):
+    """Checkpoint bytes written outside the atomic path — PR 8 (elastic).
+
+    ``runtime/resilience.py``'s ``write_checkpoint_file`` is THE
+    checkpoint write path: tmp file + fsync + one ``os.rename`` (+
+    directory fsync), a manifest with per-component CRC32, and — since
+    schema 2 — the sharding layout the elastic restore reshards by.  A
+    raw ``pickle.dump`` / ``open(..., "wb")`` checkpoint write has none
+    of that: a preemption mid-write corrupts the only copy at its final
+    path, and the file can be neither validated nor resharded after a
+    topology change.  The elastic recovery cycle (re-plan + reshard)
+    only works when every checkpoint carries the schema-2 metadata, so
+    every write must go through the one path."""
+
+    id = "CKPT-ATOMIC"
+    summary = "checkpoint written outside the atomic tmp+fsync+rename path"
+    hint = ("route through runtime/resilience.py: write_checkpoint_file / "
+            "CheckpointManager.save (atomic rename, CRC32 manifest, "
+            "schema-2 sharding layout for elastic restore)")
+
+    def check(self, module: Module, ctx) -> Iterable[Finding]:
+        if module.path.replace("\\", "/").endswith(
+                "apex_tpu/runtime/resilience.py"):
+            return      # the sanctioned write path itself
+        import re as _re
+        ckpt_re = _re.compile(_CKPT_PATH_RE_SRC, _re.IGNORECASE)
+        dump_aliases: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module in ("pickle", "cPickle", "dill"):
+                dump_aliases |= {al.asname or al.name
+                                 for al in node.names if al.name == "dump"}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func) or ""
+            tn = _terminal(node.func)
+            if d.endswith("pickle.dump") or d == "dill.dump" or \
+                    (isinstance(node.func, ast.Name)
+                     and tn in dump_aliases):
+                yield self.finding(
+                    module, node,
+                    f"{d or tn}(...) writes a pickle stream straight to "
+                    f"a file — no atomic rename, no manifest, no "
+                    f"checksum, no sharding layout")
+            elif isinstance(node.func, ast.Name) and tn == "open" \
+                    and self._binary_write_mode(node) \
+                    and self._names_checkpoint(node, ckpt_re):
+                yield self.finding(
+                    module, node,
+                    "binary-mode open() of a checkpoint path — a "
+                    "preemption mid-write leaves a partial file at the "
+                    "final path")
+
+    @staticmethod
+    def _binary_write_mode(call: ast.Call) -> bool:
+        mode = call.args[1] if len(call.args) >= 2 else None
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            m = mode.value
+            return "b" in m and any(c in m for c in "wax+")
+        return False
+
+    @staticmethod
+    def _names_checkpoint(call: ast.Call, ckpt_re) -> bool:
+        # conservative: only const path expressions (f-strings included)
+        # can be matched; a variable path is dropped, never guessed
+        if not call.args:
+            return False
+        for sub in ast.walk(call.args[0]):
+            if isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, str) and \
+                    ckpt_re.search(sub.value):
+                return True
+        return False

@@ -465,6 +465,18 @@ def static_plan_key(plan):
     return None if plan is None else plan.key()
 
 
+def plan_from_key(key, n_devices: int = 1) -> Plan:
+    """Rebuild a structural :class:`Plan` from a saved manifest key —
+    the inverse of :meth:`Plan.key` for the structural fields (cost-model
+    predictions are not identity and come back unset).  The elastic
+    restore path uses this to describe the plan a schema-2 checkpoint
+    was saved under (``manifest["plan"]["key"]``)."""
+    dp, tp, sp, zero_stage, accum, chunked_loss = key
+    return Plan(dp=int(dp), tp=int(tp), sp=int(sp),
+                zero_stage=int(zero_stage), accum=int(accum),
+                chunked_loss=bool(chunked_loss), n_devices=int(n_devices))
+
+
 # ---------------------------------------------------------------------------
 # Cost model: memory feasibility + roofline step time
 # ---------------------------------------------------------------------------

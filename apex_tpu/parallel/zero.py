@@ -191,6 +191,15 @@ class ZeroTrainStep:
         self._base.state = self.state
         self._base.sync_to_objects()
 
+    def load_state(self, host_state):
+        """Re-lay a host checkpoint state out under THIS step's ZeRO
+        shardings (elastic cross-plan restore: saved arrays are full —
+        gathered at save time — and the ``device_put`` inside
+        ``reshard_state`` hands each device exactly its shard)."""
+        from ..runtime.resilience import reshard_state
+        self.state = reshard_state(host_state, self.state)
+        return self
+
     def shard_sizes(self):
         """Per-device byte footprint of masters + optimizer slots + half
         model copies (diagnostic: the ZeRO memory win — ~1/n_shards of

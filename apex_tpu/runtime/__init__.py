@@ -222,11 +222,16 @@ from . import step_cache  # noqa: E402,F401
 from . import chaos  # noqa: E402,F401
 from . import resilience  # noqa: E402,F401
 from .resilience import (  # noqa: E402,F401
-    BadStepGuard, CheckpointCorruptError, CheckpointManager, SaveHandle,
-    TrainingDivergedError)
+    BadStepGuard, CheckpointCorruptError, CheckpointManager,
+    CheckpointReshardError, SaveHandle, TrainingDivergedError)
+from . import elastic  # noqa: E402,F401
+from .elastic import (  # noqa: E402,F401
+    ElasticTrainer, current_devices, elastic_restore)
 
 __all__ = ["flatten", "unflatten", "normalize_u8_nhwc_to_f32_nchw",
            "normalize_u8_nhwc_to_f32_nhwc", "f32_to_bf16", "available",
            "DataPrefetcher", "step_cache", "chaos", "resilience",
            "CheckpointManager", "CheckpointCorruptError", "SaveHandle",
-           "BadStepGuard", "TrainingDivergedError"]
+           "BadStepGuard", "TrainingDivergedError", "elastic",
+           "CheckpointReshardError", "ElasticTrainer", "elastic_restore",
+           "current_devices"]

@@ -17,6 +17,17 @@ point                 fires
                       file has partial bytes; final path untouched)
 ``ckpt.pre_rename``   payload fully written + fsynced, rename not yet done
 ``ckpt.post_rename``  checkpoint durable at its final path
+``ckpt.reshard``      start of each component's cross-plan reshard during
+                      elastic restore (disk already read; device
+                      placement pending — a kill here must leave the
+                      checkpoint loadable by the next attempt)
+``device.loss``       each elastic device-set detection
+                      (``runtime.elastic.current_devices``); a CALLABLE
+                      action's return value replaces the device set — an
+                      int ``k`` keeps the first ``k`` devices, a sequence
+                      becomes the set verbatim — simulating
+                      preempt→shrink→regrow deterministically on the
+                      8-virtual-CPU-device mesh
 ``dist.init``         before each ``jax.distributed.initialize`` attempt
 ``dist.collective``   inside ``timed_flat_dist_call``'s worker thread
 ``train.step``        before each fused ``TrainStep.__call__`` dispatch

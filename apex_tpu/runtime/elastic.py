@@ -1,0 +1,202 @@
+"""Elastic training: preemption-driven re-planning with cross-plan
+checkpoint resharding.
+
+The resilience runtime survives a kill and resumes — onto the SAME
+topology.  On preemptible TPU capacity the dominant real-world failure
+is the pod slice coming back smaller (8 chips → 4): the old plan no
+longer fits the device set, and the checkpoint's layout no longer
+matches any step that device set can build.  Poplar (arXiv:2408.12596)
+and AMP (arXiv:2210.07297) make the case that the planner must be
+elasticity-aware — on a device-set change, re-plan and *reshard*
+persisted state into the new layout rather than abort.  This module
+composes the two subsystems the repo already owns —
+``runtime.resilience`` and ``parallel.auto`` — into that recovery loop
+(ROADMAP item 3):
+
+1. detect the CURRENT device set (:func:`current_devices`; the
+   ``device.loss`` chaos hook lets tier-1 tests shrink/regrow the
+   8-virtual-CPU-device mesh deterministically);
+2. re-plan for it (``parallel.auto.plan_training`` — the same
+   analytical cost model behind ``parallel="auto"``);
+3. rebuild the step through ``make_train_step(parallel=plan)``, so the
+   step-program cache keys (which carry ``static_plan_key``) distinguish
+   the new plan from the old one's programs;
+4. reshard the newest valid checkpoint into the new layout
+   (:meth:`~apex_tpu.runtime.resilience.CheckpointManager.
+   restore_resharded` — fp32 masters bit-exact) and resume.
+
+Usage — the whole point is that the SAME script, rerun after a
+preemption, recovers onto whatever came back::
+
+    trainer = ElasticTrainer("ckpts/", model, opt, loss_fn,
+                             example_batch=(x, y))
+    start = trainer.restore()          # detect → plan → build → reshard
+    for i, (x, y) in enumerate(loader, start=start):
+        loss = trainer(x, y)
+        if i % 1000 == 0:
+            trainer.save(i)
+
+``trainer.telemetry`` reports ``{replan_ms, reshard_ms, resume_step,
+n_devices, plan}`` after each :meth:`~ElasticTrainer.restore` — the
+quantities ``bench.py --elastic`` publishes.
+"""
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Callable, Optional
+
+from . import chaos as _chaos
+from .resilience import CheckpointCorruptError, CheckpointManager
+
+
+def current_devices(devices=None) -> list:
+    """The device set the elastic layer plans for: ``jax.devices()`` (or
+    the caller's explicit subset) filtered through the ``device.loss``
+    chaos hook.  A callable chaos action's return value replaces the
+    set — an int ``k`` keeps the first ``k`` devices, a sequence becomes
+    the set verbatim — so tier-1 tests simulate preempt→shrink→regrow
+    deterministically without ever owning real preemptible capacity."""
+    from ..parallel.auto import _resolve_devices
+    devs = _resolve_devices(devices)
+    if _chaos.active():
+        res = _chaos.hook("device.loss", n=len(devs), devices=tuple(devs))
+        if isinstance(res, int) and not isinstance(res, bool):
+            if not 1 <= res <= len(devs):
+                raise ValueError(
+                    f"device.loss hook kept {res} of {len(devs)} devices")
+            devs = devs[:res]
+        elif isinstance(res, (list, tuple)):
+            devs = list(res)
+    return devs
+
+
+class ElasticTrainer:
+    """The restore→train→save loop that survives topology changes.
+
+    Construction is cheap and does no planning; :meth:`restore` runs one
+    full recovery cycle and must be called before training.  ``manager``
+    may be a :class:`~apex_tpu.runtime.resilience.CheckpointManager` or
+    a directory path.  ``example_batch`` feeds the planner (concrete
+    arrays or ``ShapeDtypeStruct``\\ s — the GLOBAL batch; the plan
+    shards it).  ``plan_filter``, when given, restricts the planner's
+    ranked feasible plans (e.g. pin ``zero_stage`` so checkpoint-parity
+    tests stay deterministic); the best surviving plan wins.
+    ``plan_options`` passes through to ``plan_training`` (memory caps,
+    ``accum_max``, ...), and remaining keyword arguments go to
+    ``make_train_step`` (``half_dtype``, ``loss_scale``, ...)."""
+
+    def __init__(self, manager, model, optimizer, loss_fn: Callable, *,
+                 example_batch, plan_options: Optional[dict] = None,
+                 plan_filter: Optional[Callable] = None, **step_kwargs):
+        if isinstance(manager, str):
+            manager = CheckpointManager(manager)
+        self.manager = manager
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.example_batch = example_batch
+        self.plan_options = dict(plan_options or {})
+        self.plan_filter = plan_filter
+        self.step_kwargs = dict(step_kwargs)
+        self.step = None            # the live (planned) train step
+        self.plan = None
+        self.report = None
+        self.devices = None
+        self.resume_step = None     # checkpoint step restored, or None
+        self.extras = {}
+        self.telemetry = {}
+
+    def restore(self, devices=None) -> int:
+        """One elastic recovery cycle: detect devices → re-plan → build
+        the step → reshard the newest valid checkpoint into it.  Returns
+        the step number training continues FROM (0 on a fresh start,
+        ``checkpoint_step + 1`` after a restore).  Corrupt checkpoints
+        are scanned past with a warning (``restore_or_initialize``
+        semantics); a structurally incompatible one raises
+        :class:`~apex_tpu.runtime.resilience.CheckpointReshardError` —
+        that is a config error, not damage, so no fallback."""
+        from ..parallel import auto as _auto
+        from ..training.step import make_train_step
+
+        devs = current_devices(devices)
+        t0 = time.perf_counter()
+        report = _auto.plan_training(
+            self.model, self.optimizer, self.loss_fn, self.example_batch,
+            devices=devs,
+            half_dtype=self.step_kwargs.get("half_dtype"),
+            keep_batchnorm_fp32=self.step_kwargs.get(
+                "keep_batchnorm_fp32", True),
+            **self.plan_options)
+        ranked = report.ranked if self.plan_filter is None else \
+            [p for p in report.ranked if self.plan_filter(p)]
+        if not ranked:
+            raise RuntimeError(
+                f"elastic restore: no feasible plan for {len(devs)} "
+                f"device(s)"
+                + (" passed plan_filter" if self.plan_filter else "")
+                + "\n" + report.describe())
+        plan = ranked[0]
+        step = make_train_step(self.model, self.optimizer, self.loss_fn,
+                               parallel=plan, devices=devs,
+                               **self.step_kwargs)
+        step.plan_report = report
+        replan_ms = (time.perf_counter() - t0) * 1e3
+
+        reshard_ms = 0.0
+        resume = None
+        extras = {}
+        for s in reversed(self.manager.all_steps()):
+            t1 = time.perf_counter()
+            try:
+                resume, extras = self.manager.restore_resharded(step,
+                                                                step=s)
+                reshard_ms = (time.perf_counter() - t1) * 1e3
+                break
+            except CheckpointCorruptError as e:
+                warnings.warn(
+                    f"elastic restore: skipping corrupt checkpoint for "
+                    f"step {s}: {e}", stacklevel=2)
+            except FileNotFoundError:
+                continue
+        self.step, self.plan, self.report = step, plan, report
+        self.devices = devs
+        self.resume_step = resume
+        self.extras = extras
+        self.telemetry = {
+            "n_devices": len(devs),
+            "plan": plan.name(),
+            "plan_key": plan.key(),
+            "replan_ms": round(replan_ms, 3),
+            "reshard_ms": round(reshard_ms, 3),
+            "resume_step": resume,
+        }
+        return 0 if resume is None else resume + 1
+
+    def save(self, step_no: int, **extra) -> str:
+        """Sharded atomic save through the one write path: the schema-2
+        manifest records the live layout + plan for the next restore."""
+        if self.step is None:
+            raise RuntimeError("call restore() before save()")
+        return self.manager.save_sharded(step_no, self.step, **extra)
+
+    def __call__(self, *batch):
+        if self.step is None:
+            raise RuntimeError("call restore() before training")
+        return self.step(*batch)
+
+
+def elastic_restore(manager, model, optimizer, loss_fn: Callable, *,
+                    example_batch, devices=None,
+                    plan_options: Optional[dict] = None,
+                    plan_filter: Optional[Callable] = None,
+                    **step_kwargs) -> ElasticTrainer:
+    """Functional entry point: build an :class:`ElasticTrainer` and run
+    one :meth:`~ElasticTrainer.restore` cycle.  Returns the trainer —
+    read ``.resume_step`` / ``.telemetry``, then call it to train."""
+    trainer = ElasticTrainer(manager, model, optimizer, loss_fn,
+                             example_batch=example_batch,
+                             plan_options=plan_options,
+                             plan_filter=plan_filter, **step_kwargs)
+    trainer.restore(devices)
+    return trainer

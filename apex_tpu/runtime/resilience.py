@@ -22,6 +22,16 @@ this module makes the save/resume loop survive it:
   :meth:`CheckpointManager.restore_or_initialize` auto-resume that scans
   newest→oldest past corrupt/partial checkpoints to the latest *valid*
   one.
+* Elastic restore (schema 2): the manifest additionally records each
+  component's sharding layout (per-leaf partition specs + mesh shape,
+  captured BEFORE the host transfer gathers the shards) and the
+  ``parallel.auto`` plan identity the state was saved under.
+  :func:`reshard_state` / :meth:`CheckpointManager.restore_resharded`
+  load a checkpoint saved under plan A into plan B's layout (fp32
+  masters bit-exact), raising the typed :class:`CheckpointReshardError`
+  naming the incompatible component when they can't;
+  :mod:`apex_tpu.runtime.elastic` orchestrates the full
+  detect→re-plan→reshard→resume cycle.
 * :class:`BadStepGuard` — escalation above the ``ScalerState`` skip logic
   (`apex_tpu/amp/scaler.py`): the scaler already halves the scale and
   skips the step on overflow, silently and forever; the guard counts
@@ -42,7 +52,8 @@ init and collective-timeout wrappers.
 
 Every failure path is exercised in tier-1 tests through the
 :mod:`apex_tpu.runtime.chaos` hook points (``ckpt.mid_write``,
-``ckpt.pre_rename``, ``train.step``, ``dist.init``, ``dist.collective``).
+``ckpt.pre_rename``, ``ckpt.reshard``, ``train.step``, ``dist.init``,
+``dist.collective``).
 """
 from __future__ import annotations
 
@@ -60,8 +71,10 @@ import numpy as np
 
 from . import chaos as _chaos
 
-#: bump when the container layout changes; readers accept <= this
-SCHEMA_VERSION = 1
+#: bump when the container layout changes; readers accept <= this.
+#: Schema 2 adds OPTIONAL manifest fields only (per-component "layout",
+#: top-level "plan") — schema-1 files keep loading unchanged.
+SCHEMA_VERSION = 2
 _MAGIC = "__apex_tpu_checkpoint__"
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.pkl$")
 
@@ -70,6 +83,15 @@ class CheckpointCorruptError(RuntimeError):
     """A checkpoint failed manifest/schema/checksum validation (partial
     write, bit rot, or a future schema).  ``restore_or_initialize`` falls
     back past these to the newest checkpoint that validates."""
+
+
+class CheckpointReshardError(RuntimeError):
+    """A checkpoint VALIDATED but cannot be laid out under the target
+    step's plan: pytree structure, leaf shape, or leaf dtype differs —
+    i.e. the checkpoint comes from a different model/optimizer config,
+    not a different parallelism plan.  The message names the component
+    and leaf.  Unlike :class:`CheckpointCorruptError` this is a config
+    error, so elastic restore does NOT scan past it."""
 
 
 class TrainingDivergedError(RuntimeError):
@@ -119,29 +141,93 @@ def _fsync_dir(path):
         os.close(fd)
 
 
-def serialize_checkpoint(components: dict, *, to_host: bool = True) -> bytes:
+def capture_layout(tree) -> Optional[dict]:
+    """The sharding layout of a device pytree, as plain JSON-able data
+    for the schema-2 manifest.  Must run BEFORE :func:`_to_host`: the
+    host transfer gathers every shard into a full numpy array and the
+    layout is gone.  ``specs`` aligns with ``jax.tree_util`` leaf order —
+    ``None`` for a leaf not placed on a mesh, else one entry per array
+    dimension in the partition-spec prefix (``["data"]`` = dim 0 sharded
+    over the "data" mesh axis, ``[]`` = replicated on the mesh).
+    Returns None when no leaf carries a NamedSharding (single-device or
+    already-host state: nothing to record)."""
+    specs = []
+    mesh = None
+    for x in jax.tree_util.tree_leaves(tree):
+        s = getattr(x, "sharding", None)
+        if isinstance(x, jax.Array) and \
+                isinstance(s, jax.sharding.NamedSharding):
+            if mesh is None:
+                mesh = s.mesh
+            specs.append([list(p) if isinstance(p, tuple) else p
+                          for p in s.spec])
+        else:
+            specs.append(None)
+    if mesh is None:
+        return None
+    return {"specs": specs,
+            "mesh_shape": [int(d) for d in mesh.devices.shape],
+            "mesh_axes": [str(a) for a in mesh.axis_names]}
+
+
+def _plan_meta(plan) -> Optional[dict]:
+    """Manifest entry for the ``parallel.auto.Plan`` a state was saved
+    under.  Duck-typed (anything with ``key()``/``name()`` works) so this
+    module never imports the planner; rebuild with
+    ``parallel.auto.plan_from_key(meta["key"], meta["n_devices"])``."""
+    if plan is None:
+        return None
+    try:
+        return {"key": list(plan.key()), "name": plan.name(),
+                "zero_stage": int(getattr(plan, "zero_stage", 0)),
+                "n_devices": int(getattr(plan, "n_devices", 1))}
+    except Exception:
+        return None
+
+
+def serialize_checkpoint(components: dict, *, to_host: bool = True,
+                         layouts: Optional[dict] = None,
+                         plan=None) -> bytes:
     """Pickle ``components`` into the manifested container format:
     ``{_MAGIC: schema, "manifest": {...}, "payload": {name: bytes}}``.
     Each component is pickled separately so the manifest can carry a
-    per-component CRC32 the loader verifies before unpickling anything."""
+    per-component CRC32 the loader verifies before unpickling anything.
+
+    Schema 2: the manifest also records each component's device-side
+    sharding layout (``layouts`` — captured here via
+    :func:`capture_layout` when ``to_host=True`` and not supplied by the
+    caller, who must capture it themselves when passing pre-fetched host
+    trees) and, when ``plan`` is given, the parallel plan's structural
+    identity.  This is the metadata
+    :meth:`CheckpointManager.restore_resharded` reshards by."""
+    if layouts is None:
+        layouts = {k: capture_layout(v) for k, v in components.items()}
     if to_host:
         components = {k: _to_host(v) for k, v in components.items()}
     payload = {k: pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
                for k, v in components.items()}
-    manifest = {
-        "schema": SCHEMA_VERSION,
-        "components": {k: {"crc32": zlib.crc32(b), "nbytes": len(b)}
-                       for k, b in payload.items()},
-    }
+    comp_meta = {}
+    for k, b in payload.items():
+        comp_meta[k] = {"crc32": zlib.crc32(b), "nbytes": len(b)}
+        if layouts.get(k) is not None:
+            comp_meta[k]["layout"] = layouts[k]
+    manifest = {"schema": SCHEMA_VERSION, "components": comp_meta}
+    plan_meta = _plan_meta(plan)
+    if plan_meta is not None:
+        manifest["plan"] = plan_meta
     return pickle.dumps({_MAGIC: SCHEMA_VERSION, "manifest": manifest,
                          "payload": payload},
                         protocol=pickle.HIGHEST_PROTOCOL)
 
 
-def deserialize_checkpoint(blob, *, source: str = "<bytes>") -> dict:
+def deserialize_checkpoint(blob, *, source: str = "<bytes>",
+                           return_manifest: bool = False):
     """Validate + unpickle a container produced by
     :func:`serialize_checkpoint` (or a legacy manifest-less pickle, with a
-    warning).  ``blob`` may be bytes or an already-unpickled object."""
+    warning).  ``blob`` may be bytes or an already-unpickled object.
+    With ``return_manifest=True`` returns ``(components, manifest)`` —
+    manifest is None for legacy pickles — so elastic restore can read the
+    saved layout/plan without a second parse."""
     if isinstance(blob, (bytes, bytearray, memoryview)):
         try:
             obj = pickle.loads(bytes(blob))
@@ -157,7 +243,7 @@ def deserialize_checkpoint(blob, *, source: str = "<bytes>") -> dict:
             f"checksum validation (re-save with save_checkpoint / "
             f"CheckpointManager to get integrity checking)",
             stacklevel=2)
-        return obj
+        return (obj, None) if return_manifest else obj
     schema = obj[_MAGIC]
     if not isinstance(schema, int) or schema > SCHEMA_VERSION:
         raise CheckpointCorruptError(
@@ -183,18 +269,21 @@ def deserialize_checkpoint(blob, *, source: str = "<bytes>") -> dict:
                 f"(expected crc32={meta['crc32']:#010x} over "
                 f"{meta['nbytes']} bytes)")
         out[name] = pickle.loads(blob_i)
-    return out
+    return (out, manifest) if return_manifest else out
 
 
 def write_checkpoint_file(path: str, components: dict, *,
-                          to_host: bool = True) -> str:
+                          to_host: bool = True,
+                          layouts: Optional[dict] = None,
+                          plan=None) -> str:
     """Atomically write ``components`` to ``path``: serialize, write to a
     sibling tmp file, flush + fsync, then one ``os.rename``.  A crash at
     ANY point leaves ``path`` either absent or a complete previous
     checkpoint — never a partial file.  Chaos hooks: ``ckpt.mid_write``
     (payload half-written in the tmp file), ``ckpt.pre_rename`` (payload
     durable, rename pending), ``ckpt.post_rename``."""
-    blob = serialize_checkpoint(components, to_host=to_host)
+    blob = serialize_checkpoint(components, to_host=to_host,
+                                layouts=layouts, plan=plan)
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
@@ -226,14 +315,84 @@ def write_checkpoint_file(path: str, components: dict, *,
     return path
 
 
-def read_checkpoint_file(path: str) -> dict:
+def read_checkpoint_file(path: str, *, return_manifest: bool = False):
     """Read + validate a checkpoint written by
     :func:`write_checkpoint_file` (legacy pickles load with a warning).
     Raises :class:`CheckpointCorruptError` on any validation failure and
-    ``FileNotFoundError`` when ``path`` does not exist."""
+    ``FileNotFoundError`` when ``path`` does not exist.  See
+    :func:`deserialize_checkpoint` for ``return_manifest``."""
     with open(path, "rb") as f:
         blob = f.read()
-    return deserialize_checkpoint(blob, source=path)
+    return deserialize_checkpoint(blob, source=path,
+                                  return_manifest=return_manifest)
+
+
+# ---------------------------------------------------------------------------
+# cross-plan reshard (elastic restore)
+# ---------------------------------------------------------------------------
+
+
+def reshard_state(host_state, target_state, *, component: str = "state",
+                  source: str = "<checkpoint>"):
+    """Lay a host checkpoint pytree out under ``target_state``'s CURRENT
+    shardings — the plan-B half of elastic restore.
+
+    The container stores every array gathered to full host numpy
+    (:func:`_to_host` runs before pickling), so "gather shards per the
+    saved spec" already happened at save time; resharding is re-slicing:
+    each leaf is ``jax.device_put`` under the matching target leaf's
+    sharding, which hands every device exactly the shard it owns under
+    the new plan.  No arithmetic touches the values, so fp32 masters
+    round-trip bit-exact across any plan A → plan B.
+
+    Chaos hook ``ckpt.reshard`` fires once per component before any
+    device placement; the path is read-only on disk, so a kill here
+    leaves the checkpoint loadable by the next attempt.
+
+    Raises :class:`CheckpointReshardError` naming the component (and
+    leaf, where one is identifiable) when the structures are
+    incompatible — a checkpoint from a different model/optimizer config
+    cannot be resharded, only retrained."""
+    if _chaos.active():
+        _chaos.hook("ckpt.reshard", component=component, source=source)
+    tgt_paths, tgt_def = jax.tree_util.tree_flatten_with_path(target_state)
+    src_leaves, src_def = jax.tree_util.tree_flatten(host_state)
+    if src_def != tgt_def:
+        raise CheckpointReshardError(
+            f"{source}: component {component!r}: checkpoint pytree "
+            f"structure does not match the target step "
+            f"({src_def.num_leaves} vs {tgt_def.num_leaves} leaves) — "
+            f"different model/optimizer config")
+    out = []
+    for (path, tgt), src in zip(tgt_paths, src_leaves):
+        if not isinstance(tgt, jax.Array):
+            out.append(src)
+            continue
+        name = jax.tree_util.keystr(path)
+        shp = tuple(getattr(src, "shape", ()))
+        if shp != tuple(tgt.shape):
+            raise CheckpointReshardError(
+                f"{source}: component {component!r} leaf {name}: saved "
+                f"shape {shp} cannot be resharded into target shape "
+                f"{tuple(tgt.shape)}")
+        sdt = getattr(src, "dtype", None)
+        if sdt is not None and np.dtype(sdt) != np.dtype(tgt.dtype):
+            raise CheckpointReshardError(
+                f"{source}: component {component!r} leaf {name}: saved "
+                f"dtype {np.dtype(sdt)} != target dtype "
+                f"{np.dtype(tgt.dtype)} (reshard never casts — masters "
+                f"must stay bit-exact)")
+        if isinstance(tgt.sharding, jax.sharding.NamedSharding):
+            out.append(jax.device_put(src, tgt.sharding))
+        else:
+            # single-device / replicated target (plain jit or the
+            # shard_map tp path, whose state stays whole): re-device
+            # UNCOMMITTED so the step's own dispatch placement wins —
+            # committing to the fresh state's literal device would pin a
+            # shard_map's replicated operand to one device and fail
+            import jax.numpy as jnp
+            out.append(jnp.asarray(src))
+    return jax.tree_util.tree_unflatten(tgt_def, out)
 
 
 # ---------------------------------------------------------------------------
@@ -348,18 +507,50 @@ class CheckpointManager:
                 pass
 
     # -- save --------------------------------------------------------------
-    def _write(self, step: int, host_components: dict) -> str:
+    def _write(self, step: int, host_components: dict,
+               layouts: Optional[dict] = None, plan=None) -> str:
         self._sweep_tmp()
         path = write_checkpoint_file(self.path_for(step), host_components,
-                                     to_host=False)
+                                     to_host=False, layouts=layouts,
+                                     plan=plan)
         self._retain(step)
         return path
 
     def save(self, step: int, /, **components) -> str:
-        """Blocking atomic save; returns the final path."""
+        """Blocking atomic save; returns the final path.  Sharding
+        layouts are captured (before the host fetch) into the schema-2
+        manifest whenever components carry mesh-placed arrays."""
+        handle = SaveHandle(step, self.path_for(step))
+        layouts = {k: capture_layout(v) for k, v in components.items()}
+        try:
+            self._write(step,
+                        {k: _to_host(v) for k, v in components.items()},
+                        layouts=layouts)
+        except BaseException as e:
+            handle._finish(e)
+            raise
+        handle._finish()
+        return handle.path
+
+    def save_sharded(self, step: int, train_step, /, **extra) -> str:
+        """Blocking atomic save of a live train step WITH its elastic
+        metadata: component ``"state"`` is ``train_step.state``, and the
+        schema-2 manifest records each leaf's partition spec plus the
+        step's parallel plan (``train_step.plan``) — everything
+        :meth:`restore_resharded` needs to load this checkpoint into a
+        DIFFERENT plan after the device set changes.  Extra components
+        (epoch counters, rng, ...) ride along as in :meth:`save`."""
+        if "state" in extra:
+            raise ValueError("save_sharded owns the 'state' component; "
+                             "pass other data under different names")
+        components = {"state": train_step.state, **extra}
+        layouts = {k: capture_layout(v) for k, v in components.items()}
         handle = SaveHandle(step, self.path_for(step))
         try:
-            self._write(step, {k: _to_host(v) for k, v in components.items()})
+            self._write(step,
+                        {k: _to_host(v) for k, v in components.items()},
+                        layouts=layouts,
+                        plan=getattr(train_step, "plan", None))
         except BaseException as e:
             handle._finish(e)
             raise
@@ -374,10 +565,11 @@ class CheckpointManager:
         ``wait()`` (and on :meth:`wait`/:meth:`close`)."""
         if self._closed:
             raise RuntimeError("CheckpointManager is closed")
+        layouts = {k: capture_layout(v) for k, v in components.items()}
         host = {k: _to_host(v) for k, v in components.items()}
         handle = SaveHandle(step, self.path_for(step))
         with self._lock:
-            self._queue.append((step, host, handle))
+            self._queue.append((step, host, layouts, handle))
             if self._worker is None or not self._worker.is_alive():
                 self._worker = threading.Thread(
                     target=self._drain, name="apex-tpu-ckpt-writer",
@@ -390,9 +582,9 @@ class CheckpointManager:
             with self._lock:
                 if not self._queue:
                     return
-                step, host, handle = self._queue.popleft()
+                step, host, layouts, handle = self._queue.popleft()
             try:
-                self._write(step, host)
+                self._write(step, host, layouts=layouts)
             except BaseException as e:  # surfaced via handle.wait()
                 handle._finish(e)
             else:
@@ -411,7 +603,7 @@ class CheckpointManager:
                 if not self._queue and (self._worker is None
                                         or not self._worker.is_alive()):
                     break
-        for _, _, handle in pending:
+        for *_, handle in pending:
             if handle.done() and handle._exc is not None:
                 raise handle._exc
 
@@ -427,14 +619,54 @@ class CheckpointManager:
         return False
 
     # -- restore -----------------------------------------------------------
-    def restore(self, step: Optional[int] = None) -> dict:
-        """Load + validate one checkpoint (latest when ``step`` is None)."""
+    def restore(self, step: Optional[int] = None, *,
+                return_manifest: bool = False):
+        """Load + validate one checkpoint (latest when ``step`` is
+        None).  See :func:`deserialize_checkpoint` for
+        ``return_manifest``."""
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(
                     f"no checkpoints under {self.directory!r}")
-        return read_checkpoint_file(self.path_for(step))
+        return read_checkpoint_file(self.path_for(step),
+                                    return_manifest=return_manifest)
+
+    def restore_resharded(self, train_step, step: Optional[int] = None):
+        """Elastic restore: load one checkpoint (latest when ``step`` is
+        None) into ``train_step``'s CURRENT layout, whatever plan it was
+        saved under, and return ``(step_no, extras)`` — the non-"state"
+        components.  ``train_step.state`` is replaced in place via
+        :func:`reshard_state`.
+
+        A legacy / schema-1 checkpoint carries no sharding metadata; its
+        arrays were still gathered at save time, so it restores the same
+        way, with a warning (no save-side layout to cross-check).
+        Raises :class:`CheckpointReshardError` when the checkpoint is
+        structurally incompatible with the step and
+        :class:`CheckpointCorruptError` when it fails validation."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory!r}")
+        path = self.path_for(step)
+        comps, manifest = read_checkpoint_file(path, return_manifest=True)
+        if "state" not in comps:
+            raise CheckpointReshardError(
+                f"{path}: no 'state' component to reshard (components: "
+                f"{sorted(comps)}) — written by save_sharded / "
+                f"ElasticTrainer.save?")
+        schema = (manifest or {}).get("schema", 0)
+        if schema < 2:
+            warnings.warn(
+                f"{path}: schema-{schema or 'legacy'} checkpoint predates "
+                f"sharding metadata — restoring its (gathered, full) "
+                f"arrays into the target layout without save-side "
+                f"validation", stacklevel=2)
+        train_step.state = reshard_state(comps["state"], train_step.state,
+                                         component="state", source=path)
+        return step, {k: v for k, v in comps.items() if k != "state"}
 
     def restore_or_initialize(self, initialize: Optional[Callable] = None):
         """Auto-resume: ``(step, components)`` from the newest checkpoint

@@ -114,6 +114,16 @@ class TrainStep:
         if _amp_state.loss_scalers:
             _amp_state.loss_scalers[0].state = st.scaler
 
+    def load_state(self, host_state):
+        """Re-device a host checkpoint state into this step, laying each
+        leaf out under its CURRENT placement (the elastic cross-plan
+        restore entry; ``runtime.resilience.reshard_state`` holds the
+        validation contract — typed ``CheckpointReshardError`` on a
+        structural mismatch, values never touched by arithmetic)."""
+        from ..runtime.resilience import reshard_state
+        self.state = reshard_state(host_state, self.state)
+        return self
+
 
 def _chaos_taint(train_step, batch):
     """``train.step`` chaos hook: ``"nonfinite_grads"`` multiplies every

@@ -5,7 +5,11 @@ same opt_level for bitwise-accurate resume).
 
 Device arrays are fetched to host numpy at save time (one sync, like
 torch.save) and the container is pickled; loaders re-device through the
-existing ``load_state_dict`` paths which call ``jnp.asarray``.
+existing ``load_state_dict`` paths which call ``jnp.asarray``.  The
+container is the schema-2 manifest format (``resilience.SCHEMA_VERSION``):
+per-component checksums plus — when the components hold sharded device
+arrays — the sharding layout and parallelism-plan identity that
+``runtime.elastic`` reshards by on a topology change.
 
 Resume exactness: scaler state, fp32 model weights (O2's fp32 state-dict
 hook) and optimizer slots round-trip exactly; O2 *master* weights are

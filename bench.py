@@ -1914,6 +1914,104 @@ def run_ckpt_microbench(args):
     return 0
 
 
+def elastic_bench_records(dim=32, batch=8, pre_steps=3, lost_steps=2,
+                          directory=None):
+    """``--elastic``: the preempt→shrink→replan→reshard→resume cycle on
+    the host mesh, timed.  CPU-forced like the ckpt microbench — the
+    quantities under test (planner latency, host-side reshard, resume
+    gap) touch no accelerator math.  One record per topology transition
+    (shrink to half the devices, then regrow to all of them), each
+    carrying ``{replan_ms, reshard_ms, resume_gap_steps}``.
+    """
+    import shutil
+    import tempfile
+
+    # standalone runs need the 8-virtual-device host mesh or the shrink
+    # transition degenerates to 1→1; only effective before the backend
+    # initializes (under pytest, conftest.py already forced it)
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.parallel import auto
+    from apex_tpu.runtime import CheckpointManager, chaos
+    from apex_tpu.runtime.elastic import ElasticTrainer
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, dim)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (batch,)))
+
+    nn.manual_seed(0)
+    model = nn.Sequential(nn.Linear(dim, dim), nn.ReLU(),
+                          nn.Linear(dim, 10))
+    opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+
+    def rec(event, from_n, trainer, steps_done, next_step):
+        t = trainer.telemetry
+        saved = trainer.manager.restore(
+            trainer.resume_step, return_manifest=True)[1] or {}
+        saved_plan = saved.get("plan")
+        return {"metric": "elastic_recovery", "event": event,
+                "platform": "cpu",
+                "from_devices": from_n, "to_devices": t["n_devices"],
+                "plan": t["plan"],
+                "ckpt_plan": (auto.plan_from_key(
+                    saved_plan["key"], saved_plan["n_devices"]).name()
+                    if saved_plan else None),
+                "replan_ms": t["replan_ms"],
+                "reshard_ms": t["reshard_ms"],
+                "resume_gap_steps": int(steps_done - next_step)}
+
+    base = directory or tempfile.mkdtemp(prefix="apex_tpu_elastic_bench_")
+    records = []
+    try:
+        mgr = CheckpointManager(os.path.join(base, "ckpts"), keep_n=2)
+        trainer = ElasticTrainer(
+            mgr, model, opt, lambda o, t: F.cross_entropy(o, t),
+            example_batch=(x, y), half_dtype=None, loss_scale=1.0,
+            plan_filter=lambda p: p.dp == p.n_devices and p.accum == 1)
+        n_full = len(jax.devices())
+        trainer.restore()
+        for _ in range(pre_steps):
+            trainer(x, y)
+        trainer.save(pre_steps - 1)
+        for _ in range(lost_steps):     # un-checkpointed: the resume gap
+            trainer(x, y)
+        done = pre_steps + lost_steps
+
+        # preemption: the slice comes back at half size
+        half = max(1, n_full // 2)
+        with chaos.session(seed=0) as c:
+            c.on("device.loss", action=lambda ctx: half, at=0)
+            next_step = trainer.restore()
+        records.append(rec("shrink", n_full, trainer, done, next_step))
+
+        trainer(x, y)                   # one step on the small mesh
+        trainer.save(next_step)
+        done = next_step + 1
+        next_step = trainer.restore()   # regrow: full mesh is back
+        records.append(rec("regrow", half, trainer, done, next_step))
+    finally:
+        if directory is None:
+            shutil.rmtree(base, ignore_errors=True)
+    return records
+
+
+def run_elastic(args):
+    stage("elastic", "preempt→shrink→replan→reshard→resume cycle, cpu")
+    for r in elastic_bench_records():
+        emit(r)
+    return 0
+
+
 def plan_bench_records(vocab=2048, hidden=192, layers=4, heads=6, seq=128,
                        batch=16, topk=3, timed_steps=3):
     """``--plan``: the parallelism planner's predicted-vs-measured
@@ -2195,6 +2293,11 @@ def main():
                          "async save (submit/drain split + overlap factor) "
                          "on a 64MB state, CPU-forced — tracks checkpoint "
                          "overhead next to the training metrics")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic_recovery stage: the preempt→shrink→"
+                         "replan→reshard→resume cycle on the CPU host "
+                         "mesh, emitting {replan_ms, reshard_ms, "
+                         "resume_gap_steps} per topology transition")
     ap.add_argument("--budget-s", type=float,
                     default=float(os.environ.get("GRAFT_BENCH_BUDGET_S", 540)))
     args = ap.parse_args()
@@ -2214,6 +2317,10 @@ def main():
     if args.ckpt_microbench:
         start_watchdog(args.budget_s)
         return run_ckpt_microbench(args)
+
+    if args.elastic:
+        start_watchdog(args.budget_s)
+        return run_elastic(args)
 
     if args.plan:
         start_watchdog(args.budget_s)

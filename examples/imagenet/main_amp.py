@@ -236,6 +236,18 @@ def main():
         optimizer.load_state_dict(ck["optimizer"])
         amp.load_state_dict(ck["amp"])
         print(f"=> resumed from {source} (epoch {ck['epoch']})")
+        # elastic sanity: a preempted job can come back on a different
+        # slice.  These torch-style state_dicts re-replicate on load, so
+        # resume still works — but say so, and point at the full
+        # re-plan + reshard path for sharded fused-step state.
+        saved_n = ck.get("n_devices")
+        n_now = len(runtime.elastic.current_devices())
+        if saved_n is not None and saved_n != n_now:
+            print(f"=> elastic: checkpoint was written on {saved_n} "
+                  f"devices, now running on {n_now}; state_dicts "
+                  f"re-replicate so this resume is fine — for sharded "
+                  f"(ZeRO/tp) step state use runtime.ElasticTrainer, "
+                  f"which re-plans and reshards")
         return ck["epoch"]
 
     # preemption-safe auto-resume: every epoch lands atomically in the
@@ -312,6 +324,7 @@ def main():
 
         ck = {
             "epoch": epoch + 1,
+            "n_devices": jax.device_count(),   # elastic-resume check
             "model": [np.asarray(p.data, np.float32)
                       for p in model.parameters()],
             "buffers": [np.asarray(b.data) for b in model.buffers()],

@@ -1,0 +1,30 @@
+"""CKPT-ATOMIC negative fixture: the sanctioned write paths, in-memory
+pickling, non-checkpoint binary IO, and checkpoint READS all stay
+clean."""
+import pickle
+
+from apex_tpu.runtime import CheckpointManager
+from apex_tpu.runtime.resilience import write_checkpoint_file
+
+
+def save_model(state, path):
+    # the one write path: atomic rename + CRC32 manifest + layout
+    write_checkpoint_file(path, {"model": state})
+
+
+def save_rolling(state, directory):
+    CheckpointManager(directory, keep_n=3).save(0, model=state)
+
+
+def serialize_in_memory(state):
+    return pickle.dumps(state)      # bytes in memory, not a file write
+
+
+def write_plot(png_bytes):
+    with open("training_curve.png", "wb") as f:   # binary, not a ckpt
+        f.write(png_bytes)
+
+
+def read_checkpoint(path="ckpt_00000001.pkl"):
+    with open(path, "rb") as f:     # read mode: no durability hazard
+        return pickle.load(f)

@@ -1,0 +1,22 @@
+"""CKPT-ATOMIC positive fixture: raw checkpoint writes that bypass the
+atomic tmp+fsync+rename path (every call below must be flagged)."""
+import pickle
+
+
+def save_model_raw(state, path="model_ckpt.pkl"):
+    with open(path, "wb") as f:                 # flagged: ckpt path, "wb"
+        pickle.dump(state, f)                   # flagged: raw pickle.dump
+
+
+def save_with_imported_dump(state, step):
+    from pickle import dump
+    with open(f"/tmp/run/checkpoint_{step:08d}.bin", "wb") as f:  # flagged
+        dump(state, f)                          # flagged: aliased dump
+
+
+def save_mode_kwarg(state):
+    f = open("latest.ckpt.pkl", mode="w+b")     # flagged: mode= spelling
+    try:
+        pickle.dump(state, f)                   # flagged
+    finally:
+        f.close()

@@ -208,6 +208,28 @@ def test_ckpt_microbench_records_schema(tmp_path):
     assert overlap["value"] > 0
 
 
+def test_elastic_bench_records_schema(tmp_path):
+    """--elastic stage: one record per topology transition (shrink,
+    regrow) carrying the recovery-latency fields {replan_ms, reshard_ms,
+    resume_gap_steps} plus the plan the checkpoint was saved under."""
+    recs = bench.elastic_bench_records(dim=16, batch=8, pre_steps=2,
+                                       lost_steps=1,
+                                       directory=str(tmp_path))
+    assert {r["event"] for r in recs} == {"shrink", "regrow"}
+    for r in recs:
+        assert r["metric"] == "elastic_recovery"
+        assert r["platform"] == "cpu"
+        assert r["replan_ms"] > 0
+        assert r["reshard_ms"] > 0
+        assert r["resume_gap_steps"] >= 0
+        assert r["to_devices"] >= 1 and r["from_devices"] >= 1
+        assert r["ckpt_plan"]       # schema-2 manifest carried the plan
+    (shrink,) = [r for r in recs if r["event"] == "shrink"]
+    assert shrink["to_devices"] < shrink["from_devices"]
+    # exactly the un-checkpointed steps are replayed after the preempt
+    assert shrink["resume_gap_steps"] == 1
+
+
 def test_lint_records_schema():
     """--lint stage: one lint_findings record with the analyzer-health
     fields (the r06 multichip rerun records hazard-cleanliness next to

@@ -1,0 +1,440 @@
+"""Elastic training end to end (ROADMAP item 3): schema-2 manifests
+with sharding layout + plan identity, cross-plan reshard-on-restore
+(ZeRO-3 dp8 → dp2×tp4, masters bit-exact), and the chaos-driven
+preempt→shrink→replan→resume→regrow cycle on the 8-CPU-device mesh."""
+import pickle
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.nn import functional as F
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+from apex_tpu.parallel import auto
+from apex_tpu.runtime import CheckpointManager, chaos, resilience
+from apex_tpu.runtime.elastic import (ElasticTrainer, current_devices,
+                                      elastic_restore)
+from apex_tpu.runtime.resilience import (CheckpointReshardError,
+                                         reshard_state)
+from apex_tpu.training import make_train_step
+
+pytestmark = pytest.mark.elastic
+
+DIM, CLASSES = 16, 10
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_controller():
+    yield
+    chaos.uninstall()
+
+
+def _mlp(seed=0):
+    nn.manual_seed(seed)
+    model = nn.Sequential(nn.Linear(DIM, 32), nn.GELU(),
+                          nn.Linear(32, CLASSES))
+    opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+    return model, opt
+
+
+def _loss(o, t):
+    return F.cross_entropy(o, t)
+
+
+def _batch(seed, b=8):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.standard_normal((b, DIM)), jnp.float32),
+            jnp.asarray(rng.integers(0, CLASSES, (b,))))
+
+
+#: pin the plan family so shrink/regrow trajectories are deterministic:
+#: pure data-parallel over every surviving device, ZeRO-1, no accum
+def _dp_only(p):
+    return (p.dp == p.n_devices and p.zero_stage == 1 and p.accum == 1
+            and not p.chunked_loss)
+
+
+def _trainer(path, seed=0, **kw):
+    model, opt = _mlp(seed)
+    kw.setdefault("plan_filter", _dp_only)
+    return ElasticTrainer(str(path), model, opt, _loss,
+                          example_batch=_batch(0), half_dtype=None,
+                          loss_scale=1.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# device.loss chaos hook
+# ---------------------------------------------------------------------------
+
+
+def test_device_loss_hook_shrinks_then_disarms():
+    n = len(jax.devices())
+    with chaos.session(seed=0) as c:
+        c.on("device.loss", action=lambda ctx: ctx["n"] // 2, at=0)
+        assert len(current_devices()) == n // 2
+        # one-shot fault: the next detection sees the full mesh again
+        assert len(current_devices()) == n
+        assert c.log[0][0] == "device.loss"
+    assert len(current_devices()) == n      # no controller, no filtering
+
+
+def test_device_loss_hook_explicit_list_and_validation():
+    devs = jax.devices()
+    with chaos.session(seed=0) as c:
+        c.on("device.loss", action=lambda ctx: list(ctx["devices"][2:5]),
+             at=0)
+        assert current_devices() == list(devs[2:5])
+    with chaos.session(seed=0) as c:
+        c.on("device.loss", action=lambda ctx: 0, at=0)
+        with pytest.raises(ValueError, match="device.loss"):
+            current_devices()
+
+
+# ---------------------------------------------------------------------------
+# schema 2 manifest: layout + plan metadata, legacy compat
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_v2_records_layout_and_plan(tmp_path):
+    model, opt = _mlp()
+    plan = auto.Plan(dp=8, zero_stage=3, n_devices=8)
+    step = make_train_step(model, opt, _loss, half_dtype=None,
+                           loss_scale=1.0, parallel=plan)
+    step(*_batch(1))
+    mgr = CheckpointManager(str(tmp_path), keep_n=2)
+    mgr.save_sharded(0, step, epoch=3)
+
+    comps, manifest = resilience.read_checkpoint_file(
+        mgr.path_for(0), return_manifest=True)
+    assert manifest["schema"] == 2
+    assert manifest["plan"]["key"] == list(plan.key())
+    assert manifest["plan"]["zero_stage"] == 3
+    assert manifest["plan"]["n_devices"] == 8
+    # plan_from_key round-trips the structural identity
+    rebuilt = auto.plan_from_key(manifest["plan"]["key"],
+                                 manifest["plan"]["n_devices"])
+    assert rebuilt.key() == plan.key()
+
+    layout = manifest["components"]["state"]["layout"]
+    assert layout["mesh_axes"] == ["data"]
+    assert layout["mesh_shape"] == [8]
+    # ZeRO-3: dim-0-divisible leaves carry the "data" partition spec
+    assert any(spec == ["data"] for spec in layout["specs"])
+    # schema-1 integrity fields unchanged
+    meta = manifest["components"]["state"]
+    assert meta["nbytes"] > 0 and isinstance(meta["crc32"], int)
+    # non-array components carry no layout
+    assert "layout" not in manifest["components"]["epoch"]
+    assert comps["epoch"] == 3
+    # the payload stores GATHERED full arrays, not shards
+    host = comps["state"]
+    assert host.master_params[0].shape == \
+        tuple(step.state.master_params[0].shape)
+
+
+def _write_schema1(path, components):
+    """A byte-accurate schema-1 (pre-layout) container, as the previous
+    release wrote them."""
+    payload = {k: pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
+               for k, v in components.items()}
+    manifest = {"schema": 1,
+                "components": {k: {"crc32": zlib.crc32(b),
+                                   "nbytes": len(b)}
+                               for k, b in payload.items()}}
+    blob = pickle.dumps({"__apex_tpu_checkpoint__": 1,
+                         "manifest": manifest, "payload": payload})
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+def test_schema1_roundtrip_and_elastic_warning(tmp_path):
+    """Backward compat both ways: a schema-1 checkpoint still loads via
+    restore_or_initialize with no warning, restores elastically with a
+    'predates sharding metadata' warning, and a fresh save through the
+    same manager writes schema 2."""
+    mgr = CheckpointManager(str(tmp_path), keep_n=3)
+    model, opt = _mlp()
+    step = make_train_step(model, opt, _loss, half_dtype=None,
+                           loss_scale=1.0)
+    step(*_batch(2))
+    host = resilience.snapshot_state(step.state)
+    _write_schema1(mgr.path_for(7), {"state": host, "epoch": 1})
+
+    s, comps = mgr.restore_or_initialize()
+    assert s == 7 and comps["epoch"] == 1
+    np.testing.assert_array_equal(comps["state"].master_params[0],
+                                  host.master_params[0])
+
+    model2, opt2 = _mlp(seed=1)
+    step2 = make_train_step(model2, opt2, _loss, half_dtype=None,
+                            loss_scale=1.0)
+    with pytest.warns(UserWarning, match="sharding metadata"):
+        got, extras = mgr.restore_resharded(step2, step=7)
+    assert got == 7 and extras == {"epoch": 1}
+    np.testing.assert_array_equal(
+        np.asarray(step2.state.master_params[0]), host.master_params[0])
+
+    mgr.save(8, state=host)
+    _, manifest = resilience.read_checkpoint_file(mgr.path_for(8),
+                                                  return_manifest=True)
+    assert manifest["schema"] == 2
+
+
+# ---------------------------------------------------------------------------
+# cross-plan reshard
+# ---------------------------------------------------------------------------
+
+
+def test_zero3_dp8_checkpoint_into_dp2_tp4(tmp_path):
+    """Acceptance: a ZeRO-3 dp8 checkpoint restores into a dp2×tp4 plan
+    — fp32 masters bit-exact vs the source, and the post-restore step
+    output matches the same checkpoint restored into its native plan."""
+    from apex_tpu.models import GptModel
+    V, S = 64, 8
+
+    def mk(tp_axis=None):
+        nn.manual_seed(5)
+        m = GptModel(vocab_size=V, hidden=32, layers=1, heads=4,
+                     max_positions=S, dropout=0.0, attn_dropout=0.0,
+                     tp_axis=tp_axis)
+        return m, FusedAdam(list(m.parameters()), lr=1e-2)
+
+    def lm_loss(logits, tgt):
+        return F.cross_entropy(logits.reshape((-1, V)),
+                               tgt.reshape((-1,)))
+
+    rng = np.random.default_rng(9)
+    ids = jnp.asarray(rng.integers(0, V, (8, S)))
+    tgt = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1))
+
+    m1, o1 = mk()
+    src = make_train_step(m1, o1, lm_loss, half_dtype=None,
+                          loss_scale=1.0,
+                          parallel=auto.Plan(dp=8, zero_stage=3,
+                                             n_devices=8))
+    src(ids, tgt)
+    src(ids, tgt)
+    mgr = CheckpointManager(str(tmp_path), keep_n=2)
+    mgr.save_sharded(1, src)
+
+    # native restore: the same plan, fresh objects
+    m2, o2 = mk()
+    native = make_train_step(m2, o2, lm_loss, half_dtype=None,
+                             loss_scale=1.0,
+                             parallel=auto.Plan(dp=8, zero_stage=3,
+                                                n_devices=8))
+    assert mgr.restore_resharded(native)[0] == 1
+
+    # cross-plan restore: dp2×tp4 through the explicit shard_map path
+    m3, o3 = mk(tp_axis="tp")
+    cross = make_train_step(m3, o3, lm_loss, half_dtype=None,
+                            loss_scale=1.0,
+                            parallel=auto.Plan(dp=2, tp=4, tp_axis="tp",
+                                               n_devices=8))
+    got_step, _ = mgr.restore_resharded(cross)
+    assert got_step == 1
+
+    # fp32 masters bit-exact across the plan change (np.asarray gathers
+    # the source's ZeRO shards)
+    for a, b in zip(cross.state.master_params, src.state.master_params):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # post-restore step parity: native continues bit-exact with the
+    # source run; the tp plan tracks within the established tp-vs-oracle
+    # numerics envelope (test_auto_parallel's rtol)
+    l_src = float(src(ids, tgt))
+    l_native = float(native(ids, tgt))
+    l_cross = float(cross(ids, tgt))
+    np.testing.assert_array_equal(l_native, l_src)
+    np.testing.assert_allclose(l_cross, l_src, rtol=3e-3, atol=3e-3)
+
+
+def test_load_state_reshards_into_current_layout():
+    """TrainStep/ZeroTrainStep.load_state: a host snapshot lands back
+    under the step's live shardings, not replicated."""
+    model, opt = _mlp()
+    plan = auto.Plan(dp=4, zero_stage=1, n_devices=8)
+    z = make_train_step(model, opt, _loss, half_dtype=None,
+                        loss_scale=1.0, parallel=plan)
+    z(*_batch(4))
+    host = resilience.snapshot_state(z.state)
+
+    model2, opt2 = _mlp(seed=1)
+    z2 = make_train_step(model2, opt2, _loss, half_dtype=None,
+                         loss_scale=1.0, parallel=plan)
+    z2.load_state(host)
+    for a, b in zip(z2.state.master_params, z.state.master_params):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert (z2.state.master_params[0].sharding.spec
+            == z.state.master_params[0].sharding.spec)
+
+
+def test_reshard_error_names_incompatible_component(tmp_path):
+    model, opt = _mlp()
+    step = make_train_step(model, opt, _loss, half_dtype=None,
+                           loss_scale=1.0)
+    step(*_batch(3))
+    mgr = CheckpointManager(str(tmp_path), keep_n=2)
+    mgr.save_sharded(0, step)
+
+    nn.manual_seed(1)
+    other = nn.Sequential(nn.Linear(DIM, 48), nn.GELU(),
+                          nn.Linear(48, CLASSES))    # different hidden
+    opt2 = FusedSGD(list(other.parameters()), lr=0.1, momentum=0.9)
+    tgt = make_train_step(other, opt2, _loss, half_dtype=None,
+                          loss_scale=1.0)
+    with pytest.raises(CheckpointReshardError) as ei:
+        mgr.restore_resharded(tgt)
+    msg = str(ei.value)
+    assert "'state'" in msg                  # names the component
+    assert "(48, 16)" in msg or "(32, 16)" in msg    # and the shapes
+    # the failed reshard never touched the target's state
+    assert np.isfinite(
+        float(np.asarray(tgt.state.master_params[0]).sum()))
+
+
+def test_reshard_rejects_dtype_change():
+    a = {"w": jnp.zeros((4,), jnp.float32)}
+    b = {"w": jnp.zeros((4,), jnp.bfloat16)}
+    with pytest.raises(CheckpointReshardError, match="never casts"):
+        reshard_state(resilience._to_host(a), b)
+
+
+# ---------------------------------------------------------------------------
+# the full elastic cycle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_elastic_cycle_preempt_shrink_replan_resume_regrow(tmp_path):
+    """Acceptance: deterministic preempt→shrink(8→4)→replan→reshard→
+    resume→regrow(4→8) with loss-trajectory parity against an
+    uninterrupted 8-device run (fp32 SGD; the shrink segment runs a
+    different dp degree, so parity is to reduction-order tolerance)."""
+    n = len(jax.devices())
+    assert n == 8
+    batches = [_batch(10 + i) for i in range(9)]
+
+    ref = _trainer(tmp_path / "ref")
+    assert ref.restore() == 0
+    ref_losses = [float(ref(*b)) for b in batches]
+
+    el = _trainer(tmp_path / "el")
+    assert el.restore() == 0 and el.plan.dp == n
+    got = [float(el(*b)) for b in batches[:3]]
+    el.save(2)
+    for b in batches[3:5]:
+        el(*b)                  # steps 3-4 run but die un-checkpointed
+
+    # preemption: the job restarts and the slice comes back at half size
+    el2 = _trainer(tmp_path / "el")
+    with chaos.session(seed=0) as c:
+        c.on("device.loss", action=lambda ctx: ctx["n"] // 2, at=0)
+        resume = el2.restore()
+    assert resume == 3          # replays exactly the un-checkpointed steps
+    assert el2.plan.dp == n // 2 and len(el2.devices) == n // 2
+    assert el2.telemetry["reshard_ms"] > 0
+    assert el2.telemetry["plan_key"] != el.plan.key()
+    got += [float(el2(*b)) for b in batches[3:6]]
+    el2.save(5)
+
+    # regrow: the next restart sees the full mesh again
+    el3 = _trainer(tmp_path / "el")
+    resume = el3.restore()
+    assert resume == 6
+    assert el3.plan.dp == n and len(el3.devices) == n
+    got += [float(el3(*b)) for b in batches[6:]]
+
+    np.testing.assert_allclose(got, ref_losses, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.chaos
+def test_same_topology_resume_is_bit_exact(tmp_path):
+    """fp32-SGD acceptance arm: preempt + resume on the SAME topology is
+    bit-exact — masters AND the continued loss trajectory — through
+    save_sharded → schema-2 manifest → reshard."""
+    batches = [_batch(30 + i) for i in range(6)]
+    ref = _trainer(tmp_path / "ref")
+    ref.restore()
+    ref_losses = [float(ref(*b)) for b in batches]
+
+    el = _trainer(tmp_path / "el")
+    el.restore()
+    for b in batches[:4]:
+        el(*b)
+    el.save(3)
+
+    el2 = _trainer(tmp_path / "el", seed=1)    # fresh (different) init
+    assert el2.restore() == 4
+    for a, b in zip(el2.step.state.master_params,
+                    el.step.state.master_params):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    tail = [float(el2(*b)) for b in batches[4:]]
+    np.testing.assert_array_equal(tail, ref_losses[4:])
+
+
+@pytest.mark.chaos
+def test_kill_during_reshard_previous_checkpoint_survives(tmp_path):
+    """Reshard is read-only on disk: a kill mid-reshard leaves the
+    checkpoint byte-identical and the next restore succeeds from it."""
+    el = _trainer(tmp_path / "el")
+    el.restore()
+    for i in range(3):
+        el(*_batch(40 + i))
+    el.save(2)
+    ckpt_path = el.manager.path_for(2)
+    with open(ckpt_path, "rb") as f:
+        before = f.read()
+
+    el2 = _trainer(tmp_path / "el", seed=1)
+    with chaos.session(seed=0) as c:
+        c.on("ckpt.reshard", action="kill", at=0)
+        with pytest.raises(chaos.ChaosKilled):
+            el2.restore()
+        assert ("ckpt.reshard", 0, "kill") in [tuple(e) for e in c.log]
+
+    with open(ckpt_path, "rb") as f:
+        assert f.read() == before
+    el3 = _trainer(tmp_path / "el", seed=1)
+    assert el3.restore() == 3
+    for a, b in zip(el3.step.state.master_params,
+                    el.step.state.master_params):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.chaos
+def test_elastic_scans_past_corrupt_newest(tmp_path):
+    """restore_or_initialize semantics carry over: a corrupt newest
+    checkpoint is skipped with a warning and the older valid one is
+    resharded instead."""
+    el = _trainer(tmp_path / "el")
+    el.restore()
+    for i in range(2):
+        el(*_batch(50 + i))
+    el.save(0)
+    el(*_batch(52))
+    el.save(1)
+    path = el.manager.path_for(1)
+    with open(path, "rb") as f:
+        blob = bytearray(f.read())
+    blob[len(blob) // 2] ^= 0xFF                # flip a payload bit
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+
+    el2 = _trainer(tmp_path / "el", seed=1)
+    with pytest.warns(UserWarning, match="skipping corrupt checkpoint"):
+        resume = el2.restore()
+    assert resume == 1 and el2.resume_step == 0
+
+
+def test_elastic_restore_functional_entry(tmp_path):
+    tr = elastic_restore(str(tmp_path / "ck"), *_mlp(), _loss,
+                         example_batch=_batch(0), half_dtype=None,
+                         loss_scale=1.0, plan_filter=_dp_only)
+    assert tr.resume_step is None and tr.step is not None
+    assert np.isfinite(float(tr(*_batch(1))))
+    tr.save(0)
+    assert tr.manager.all_steps() == [0]

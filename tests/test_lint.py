@@ -29,6 +29,7 @@ RULE_FIXTURES = {
     "COMPAT-SHIM": os.path.join("apex_tpu", "compat_shim"),
     "UNBOUNDED-COLLECTIVE": "unbounded_collective",
     "IMPURE-STATIC-KEY": "impure_static_key",
+    "CKPT-ATOMIC": "ckpt_atomic",
 }
 
 

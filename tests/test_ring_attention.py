@@ -192,20 +192,18 @@ def test_ring_pallas_interpret_grads(rng, causal):
                                    rtol=3e-4, atol=3e-5)
 
 
-@pytest.mark.parametrize("causal", [
-    pytest.param(False, marks=pytest.mark.xfail(
-        reason="jaxlib 0.4.37 CPU: SPMD partitioner rejects the "
-               "PartitionId instruction this program shape leaves in the "
-               "fori ring body when causal masking (its only live "
-               "axis-index consumer) is off; the unrolled path — the "
-               "production path for rings <= UNROLL_LIMIT — is "
-               "unaffected")),
-    True,
-])
+@pytest.mark.parametrize("causal", [False, True])
 def test_ring_fori_loop_path(rng, causal, monkeypatch):
     """Large-ring fallback: with UNROLL_LIMIT forced to 0 the fwd and bwd
     ring loops run as lax.fori_loop (O(1) HLO per pass) and must match the
-    reference exactly like the unrolled path does."""
+    reference exactly like the unrolled path does.
+
+    causal=False (+ no dropout) exercises ``_must_unroll``: on jaxlib
+    0.4.x the SPMD partitioner rejects the PartitionId instruction the
+    fori lowering leaves in the ring body when causal masking (the only
+    live axis-index consumer) is off, so production routes those cases
+    to the unrolled path — identical math, and this parametrization
+    proves the routing keeps the case working rather than xfailing."""
     import importlib
     ra_mod = importlib.import_module("apex_tpu.parallel.ring_attention")
     monkeypatch.setattr(ra_mod, "UNROLL_LIMIT", 0)
