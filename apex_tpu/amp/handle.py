@@ -81,11 +81,16 @@ def scale_loss(loss, optimizers, loss_id=0, model=None, delay_unscale=False,
             # _process_optimizer.finalize_delayed_unscale
             optimizer._amp_stash._delayed_scaler = loss_scaler
     else:
+        from ..observe import spans as _spans
         loss_scaler.clear_overflow_state()
-        for optimizer in optimizers:
-            optimizer._post_amp_backward(loss_scaler)
-            optimizer._amp_stash.params_have_scaled_gradients = False
-            optimizer._amp_stash._delayed_scaler = None
+        # the eager surface's unscale+overflow-check region — span'd so
+        # device profiles separate it from the backward that produced the
+        # scaled gradients
+        with _spans.span("amp.backward", loss_id=loss_id):
+            for optimizer in optimizers:
+                optimizer._post_amp_backward(loss_scaler)
+                optimizer._amp_stash.params_have_scaled_gradients = False
+                optimizer._amp_stash._delayed_scaler = None
         # deferred mode (amp.initialize(..., defer_scale_update=True)): hand
         # the scaler to the optimizers' step-cache programs, which fuse the
         # overflow-conditional skip (lax.cond) and the dynamic-scale update

@@ -753,3 +753,124 @@ class CkptAtomic(Rule):
                     ckpt_re.search(sub.value):
                 return True
         return False
+
+
+# ---------------------------------------------------------------------------
+# OBS-IN-JIT
+# ---------------------------------------------------------------------------
+
+#: observe names that are jit-safe BY DESIGN: the pure on-device telemetry
+#: constructors the fused step folds into its donated carry.
+_OBS_JIT_SAFE = {"accumulate", "init_telemetry", "StepTelemetry"}
+
+#: the host-side observe submodules (telemetry — the on-device surface —
+#: is deliberately absent)
+_OBS_SUBMODULES = {"registry", "spans", "watchdog"}
+
+
+@register
+class ObsInJit(Rule):
+    """Host-side observe calls inside traced code — the observe PR.
+
+    Every ``apex_tpu.observe`` surface except the telemetry carry is
+    host machinery: registry counters take locks and append to deques,
+    spans read wall clocks and write JSONL sinks, the watchdog heartbeat
+    touches thread state.  Traced, such a call runs ONCE at trace time
+    and never again — silently dead telemetry (the counter sticks at its
+    trace-time value, the span measures tracing, not execution) — and
+    draining the telemetry carry inside jit would force the host sync
+    the carry exists to avoid.  On-device accumulation belongs in
+    ``observe.telemetry`` (jit-safe by construction); spans, counters,
+    events, heartbeats and drains belong in the eager driver.
+    """
+    id = "OBS-IN-JIT"
+    summary = "host-side observe call inside a jit-reachable function"
+    hint = ("accumulate on device via observe.telemetry (the fused "
+            "step's telem carry) and log OUTSIDE the compiled step — "
+            "spans/counters/events/drains belong in the eager driver; "
+            "see TrainStep.drain_telemetry for the boundary")
+
+    def _observe_bindings(self, module, ctx):
+        """Local names bound to the host-side observe surface:
+        ``mods`` (alias -> observe submodule) and ``funcs`` (alias ->
+        imported observe callable).  Resolved through the analyzed set
+        when the package is in it, through external dotted names when
+        the engine is pointed at a file outside it."""
+        mods: Dict[str, str] = {}
+        funcs: Dict[str, str] = {}
+        table = ctx.callgraph.imports.get(module.path)
+        if table is None:
+            return mods, funcs
+
+        def _host_observe_path(p):
+            p = p.replace("\\", "/")
+            if p.endswith("/observe/telemetry.py"):
+                return None         # the jit-safe on-device surface
+            return p if "/observe/" in p else None
+
+        for local, path in table.mod_alias.items():
+            if _host_observe_path(path):
+                mods[local] = path
+        for local, (path, fn) in table.func_alias.items():
+            if path.replace("\\", "/").endswith("/observe/__init__.py") \
+                    and fn not in _OBS_JIT_SAFE and fn != "telemetry":
+                funcs[local] = fn
+        for local, dotted in table.ext_alias.items():
+            if dotted.endswith(".observe") or dotted == "observe":
+                mods[local] = dotted
+            elif ".observe." in f".{dotted}":
+                tail = dotted.rsplit(".", 1)[1]
+                if tail in _OBS_SUBMODULES:
+                    mods[local] = dotted
+                elif tail not in _OBS_JIT_SAFE and tail != "telemetry":
+                    funcs[local] = tail
+        return mods, funcs
+
+    def _walk_own(self, root):
+        """Function body sans nested defs (each reachable nested def is
+        visited as its own function)."""
+        stack = list(ast.iter_child_nodes(root))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, module, ctx):
+        mods, funcs = self._observe_bindings(module, ctx)
+        for info in ctx.callgraph.reachable_functions(module.path):
+            for node in self._walk_own(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = self.flag_for(node, mods, funcs)
+                if f is not None:
+                    yield self.finding(module, node, f)
+
+    def flag_for(self, node: ast.Call, mods, funcs) -> Optional[str]:
+        tn = _terminal(node.func)
+        if tn == "drain_telemetry":
+            # any spelling, including self.drain_telemetry(): the drain
+            # fetches the carry to host BY DESIGN — only legal outside
+            return ("drain_telemetry() inside traced code — the drain "
+                    "is the host fetch the telemetry carry defers; it "
+                    "belongs outside the compiled step")
+        if isinstance(node.func, ast.Name) and node.func.id in funcs:
+            return (f"observe.{funcs[node.func.id]}(...) inside traced "
+                    f"code — runs once at trace time, never per step "
+                    f"(dead telemetry)")
+        if isinstance(node.func, ast.Attribute):
+            owner = node.func.value
+            if isinstance(owner, ast.Name) and owner.id in mods and \
+                    tn not in _OBS_JIT_SAFE:
+                return (f"{owner.id}.{tn}(...) resolves into "
+                        f"apex_tpu.observe's host surface inside traced "
+                        f"code — runs once at trace time, never per "
+                        f"step (dead telemetry)")
+            d = _dotted(node.func) or ""
+            if ".observe." in f".{d}" and ".telemetry." not in d and \
+                    tn not in _OBS_JIT_SAFE:
+                return (f"{d}(...) inside traced code — the observe "
+                        f"host surface runs once at trace time, never "
+                        f"per step (dead telemetry)")
+        return None

@@ -1,0 +1,68 @@
+"""Trace spans: one context manager, two outputs.
+
+``span("ckpt.save")`` emits (a) a structured event + latency histogram
+into the metrics registry and (b) a ``jax.profiler.TraceAnnotation`` so
+the same region shows up in device profiles — host events and XLA
+timelines line up by name.
+
+Spans are host-side instrumentation; entering one from jit-traced code
+is a host round-trip and is flagged by the OBS-IN-JIT lint rule.
+Thread-safe: the prefetch worker and async-checkpoint writer open spans
+on their own threads, and the watchdog reads ``last_span()`` from its
+heartbeat thread.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from . import registry as _registry
+
+_state_lock = threading.Lock()
+_last_span: Optional[Dict[str, Any]] = None
+
+_trace_annotation = None
+_trace_annotation_probed = False
+
+
+def _get_trace_annotation():
+    """Resolve jax.profiler.TraceAnnotation lazily; spans must work (as
+    log-only) even when jax or its profiler is unavailable."""
+    global _trace_annotation, _trace_annotation_probed
+    if not _trace_annotation_probed:
+        _trace_annotation_probed = True
+        try:
+            from jax import profiler as _profiler
+            _trace_annotation = _profiler.TraceAnnotation
+        except Exception:
+            _trace_annotation = None
+    return _trace_annotation
+
+
+def last_span() -> Optional[Dict[str, Any]]:
+    """Most recently *started* span (it may still be open) — the stall
+    watchdog reports this as "where the runtime was last seen"."""
+    with _state_lock:
+        return dict(_last_span) if _last_span else None
+
+
+@contextlib.contextmanager
+def span(name: str, **fields: Any):
+    """Time a region; emit a ``span`` event and a ``span.<name>_ms``
+    histogram sample on exit, wrapped in a profiler TraceAnnotation."""
+    global _last_span
+    t0 = time.monotonic()
+    with _state_lock:
+        _last_span = {"span": name, "started_ms": t0 * 1e3, **fields}
+    annotation = _get_trace_annotation()
+    cm = annotation(name) if annotation is not None \
+        else contextlib.nullcontext()
+    try:
+        with cm:
+            yield
+    finally:
+        dur_ms = (time.monotonic() - t0) * 1e3
+        _registry.histogram(f"span.{name}_ms").observe(dur_ms)
+        _registry.event("span", span=name, dur_ms=dur_ms, **fields)

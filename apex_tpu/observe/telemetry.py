@@ -1,0 +1,77 @@
+"""Zero-dispatch on-device step telemetry.
+
+The fused train step (PR 3) already carries a donated ``StepState``
+through an on-device microbatch scan with skip-flag discipline — the
+whole point is 1 compile + 1 dispatch per K-microbatch window and no
+host syncs inside the window. Telemetry must not break that, so the
+observable quantities (per-window loss, global grad-norm, loss scale,
+overflow count) are *accumulated into the same donated carry* with pure
+``jnp`` arithmetic and drained to host only every ``drain_every``
+windows, from eager code outside jit (``TrainStep.drain_telemetry``).
+
+Everything in this module is jit-safe by construction — it is the one
+piece of `apex_tpu.observe` that is *meant* to run inside traced code,
+which is why the OBS-IN-JIT lint rule deliberately does not flag
+``accumulate``/``init_telemetry``.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class StepTelemetry(NamedTuple):
+    """On-device accumulator riding in ``StepState.telem``.
+
+    - ``loss_sum``: sum of per-window mean losses since the last drain
+      (host divides by ``windows`` for the mean).
+    - ``grad_norm``: global L2 norm of the *last* window's master grads
+      (a sum across drains would be meaningless; last-value is what a
+      dashboard wants).
+    - ``loss_scale``: loss scale after the last window's update.
+    - ``overflow_count``: number of overflow-skipped windows since the
+      last drain.
+    - ``windows``: windows accumulated since the last drain.
+    """
+    loss_sum: jnp.ndarray
+    grad_norm: jnp.ndarray
+    loss_scale: jnp.ndarray
+    overflow_count: jnp.ndarray
+    windows: jnp.ndarray
+
+
+def init_telemetry() -> StepTelemetry:
+    f32 = jnp.float32
+    return StepTelemetry(
+        loss_sum=jnp.zeros((), f32),
+        grad_norm=jnp.zeros((), f32),
+        loss_scale=jnp.ones((), f32),
+        overflow_count=jnp.zeros((), jnp.int32),
+        windows=jnp.zeros((), jnp.int32),
+    )
+
+
+def accumulate(telem: StepTelemetry, *, loss, master_grads, flag,
+               loss_scale) -> StepTelemetry:
+    """Fold one window's observables into the carry (traced code).
+
+    ``master_grads`` are the f32 (unscaled) gradients the optimizer
+    consumed; ``flag`` is the window's overflow flag (True = skipped).
+    The grad norm is computed in f32 over the master grads, so at
+    ``loss_scale == 1.0`` it is bitwise-identical to an eager
+    ``sqrt(sum(g*g))`` over the same gradients.
+    """
+    gsq = jnp.zeros((), jnp.float32)
+    for g in master_grads:
+        gsq = gsq + jnp.sum(g * g)
+    gnorm = jnp.sqrt(gsq)
+    loss = jnp.asarray(loss, jnp.float32) if loss is not None \
+        else jnp.zeros((), jnp.float32)
+    return StepTelemetry(
+        loss_sum=telem.loss_sum + loss,
+        grad_norm=gnorm,
+        loss_scale=jnp.asarray(loss_scale, jnp.float32),
+        overflow_count=telem.overflow_count + flag.astype(jnp.int32),
+        windows=telem.windows + 1,
+    )

@@ -56,6 +56,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..observe import registry as _obs
+
 #: per-wrap token in the step-program cache key — two planned steps with
 #: identical signatures close over different model/optimizer objects
 _PLAN_TOKENS = itertools.count()
@@ -978,9 +980,18 @@ def auto_tune_report(report: PlanReport, model, optimizer, loss_fn,
                               example_batch, devices=devices, steps=steps,
                               **base_kwargs)
             measured.append(dataclasses.replace(plan, measured_ms=ms))
+            # each trial measurement is a ledger entry: the seed of
+            # ROADMAP item 2's calibration ledger (predicted vs measured
+            # per plan, queryable from the one event stream)
+            _obs.event("plan.auto_tune", plan=plan.name(),
+                       plan_key=plan.key(), measured_ms=ms,
+                       predicted_ms=plan.predicted_ms)
         except Exception as e:        # a plan that fails to run loses
             report.rejected.append(
                 (plan, f"auto_tune trial failed: {type(e).__name__}: {e}"))
+            _obs.event("plan.auto_tune", plan=plan.name(),
+                       plan_key=plan.key(), measured_ms=None,
+                       error=f"{type(e).__name__}: {e}")
     measured.sort(key=lambda p: (p.measured_ms, p.predicted_ms))
     ranked = measured + [p for p in report.ranked
                          if p.key() not in {m.key() for m in measured}]
@@ -1032,6 +1043,13 @@ def build_planned_step(model, optimizer, loss_fn, parallel, *,
         raise TypeError(
             f"parallel= accepts 'auto' or a parallel.auto.Plan, got "
             f"{type(parallel).__name__}")
+    _obs.event("plan.decision", plan=plan.name(), plan_key=plan.key(),
+               source="auto" if report is not None else "explicit",
+               n_devices=len(devices),
+               predicted_ms=plan.predicted_ms,
+               measured_ms=plan.measured_ms,
+               feasible=len(report.ranked) if report is not None else None,
+               rejected=len(report.rejected) if report is not None else None)
     step = apply_plan(plan, model, optimizer, loss_fn, devices=devices,
                       **base_kwargs)
     step.plan_report = report

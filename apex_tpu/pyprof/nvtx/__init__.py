@@ -2,7 +2,25 @@
 
 The name is kept for drop-in parity; on TPU the "marker" is the trace-time
 annotator + ``jax.named_scope`` HLO tagging (see ..annotate).
+
+``nvtx.annotate("region")`` — the reference's range-marker context manager
+(torch.cuda.nvtx.range_push/pop) — delegates to
+:func:`apex_tpu.observe.span`: one runtime span surface feeds both the
+structured event log and ``jax.profiler.TraceAnnotation``, so pyprof
+markers and the rest of the library's telemetry land in the same stream.
 """
 from ..annotate import init, set_enabled, events, clear  # noqa: F401
 
-__all__ = ["init", "set_enabled", "events", "clear"]
+
+def annotate(name: str, **fields):
+    """Range marker context manager: ``with nvtx.annotate("fwd"): ...``.
+
+    Delegates to ``apex_tpu.observe.span`` — emits a ``span`` event into
+    the observe registry and a profiler TraceAnnotation.  Host-side only
+    (OBS-IN-JIT applies), like the nvtx ranges it mimics.
+    """
+    from ...observe import span as _span
+    return _span(name, **fields)
+
+
+__all__ = ["init", "set_enabled", "events", "clear", "annotate"]

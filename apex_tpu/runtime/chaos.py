@@ -176,10 +176,16 @@ class ChaosController:
                     break
             if fault is None:
                 return None
-            self.log.append((point, count,
-                             fault.action if not callable(fault.action)
-                             else getattr(fault.action, "__name__",
-                                          "callable")))
+            action_name = (fault.action if not callable(fault.action)
+                           else getattr(fault.action, "__name__",
+                                        "callable"))
+            self.log.append((point, count, action_name))
+        # mirror the receipt into the observe registry (outside the lock:
+        # a JSONL sink may do IO) so chaos injections land in the same
+        # event stream as the telemetry they perturb
+        from ..observe import registry as _obs
+        _obs.event("chaos.inject", point=point, call=count,
+                   action=action_name)
         if callable(fault.action):
             return fault.action(dict(ctx, point=point, call=count))
         if fault.action == "delay":

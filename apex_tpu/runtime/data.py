@@ -96,6 +96,8 @@ class DataPrefetcher:
 
     def _run(self):
         import jax
+
+        from ..observe import spans as _spans
         try:
             window = []
             for images, target in self.loader:
@@ -103,8 +105,10 @@ class DataPrefetcher:
                     return
                 images = self._prepare(images)
                 if self.accum_steps == 1:
-                    images = jax.device_put(images, self.device)
-                    target = jax.device_put(np.asarray(target), self.device)
+                    with _spans.span("h2d"):
+                        images = jax.device_put(images, self.device)
+                        target = jax.device_put(np.asarray(target),
+                                                self.device)
                     if not self._put((images, target)):
                         return
                     continue
@@ -116,8 +120,9 @@ class DataPrefetcher:
                 block = np.stack([w[0] for w in window])
                 tgt = np.stack([w[1] for w in window])
                 window = []
-                block = jax.device_put(block, self.device)
-                tgt = jax.device_put(tgt, self.device)
+                with _spans.span("h2d", accum_steps=self.accum_steps):
+                    block = jax.device_put(block, self.device)
+                    tgt = jax.device_put(tgt, self.device)
                 if not self._put((block, tgt)):
                     return
             # a partial trailing window is dropped (drop_last semantics)

@@ -36,9 +36,11 @@ preemption, recovers onto whatever came back::
         if i % 1000 == 0:
             trainer.save(i)
 
-``trainer.telemetry`` reports ``{replan_ms, reshard_ms, resume_step,
-n_devices, plan}`` after each :meth:`~ElasticTrainer.restore` — the
-quantities ``bench.py --elastic`` publishes.
+Each :meth:`~ElasticTrainer.restore` emits one ``elastic.restore``
+event (plus ``elastic.replan``/``elastic.reshard`` spans) into the
+``apex_tpu.observe`` registry — the quantities ``bench.py --elastic``
+publishes.  ``trainer.telemetry`` keeps the same fields as a plain dict
+alias for one release.
 """
 from __future__ import annotations
 
@@ -46,6 +48,8 @@ import time
 import warnings
 from typing import Callable, Optional
 
+from ..observe import registry as _obs
+from ..observe import spans as _spans
 from . import chaos as _chaos
 from .resilience import CheckpointCorruptError, CheckpointManager
 
@@ -121,26 +125,27 @@ class ElasticTrainer:
 
         devs = current_devices(devices)
         t0 = time.perf_counter()
-        report = _auto.plan_training(
-            self.model, self.optimizer, self.loss_fn, self.example_batch,
-            devices=devs,
-            half_dtype=self.step_kwargs.get("half_dtype"),
-            keep_batchnorm_fp32=self.step_kwargs.get(
-                "keep_batchnorm_fp32", True),
-            **self.plan_options)
-        ranked = report.ranked if self.plan_filter is None else \
-            [p for p in report.ranked if self.plan_filter(p)]
-        if not ranked:
-            raise RuntimeError(
-                f"elastic restore: no feasible plan for {len(devs)} "
-                f"device(s)"
-                + (" passed plan_filter" if self.plan_filter else "")
-                + "\n" + report.describe())
-        plan = ranked[0]
-        step = make_train_step(self.model, self.optimizer, self.loss_fn,
-                               parallel=plan, devices=devs,
-                               **self.step_kwargs)
-        step.plan_report = report
+        with _spans.span("elastic.replan", n_devices=len(devs)):
+            report = _auto.plan_training(
+                self.model, self.optimizer, self.loss_fn,
+                self.example_batch, devices=devs,
+                half_dtype=self.step_kwargs.get("half_dtype"),
+                keep_batchnorm_fp32=self.step_kwargs.get(
+                    "keep_batchnorm_fp32", True),
+                **self.plan_options)
+            ranked = report.ranked if self.plan_filter is None else \
+                [p for p in report.ranked if self.plan_filter(p)]
+            if not ranked:
+                raise RuntimeError(
+                    f"elastic restore: no feasible plan for {len(devs)} "
+                    f"device(s)"
+                    + (" passed plan_filter" if self.plan_filter else "")
+                    + "\n" + report.describe())
+            plan = ranked[0]
+            step = make_train_step(self.model, self.optimizer,
+                                   self.loss_fn, parallel=plan,
+                                   devices=devs, **self.step_kwargs)
+            step.plan_report = report
         replan_ms = (time.perf_counter() - t0) * 1e3
 
         reshard_ms = 0.0
@@ -149,8 +154,9 @@ class ElasticTrainer:
         for s in reversed(self.manager.all_steps()):
             t1 = time.perf_counter()
             try:
-                resume, extras = self.manager.restore_resharded(step,
-                                                                step=s)
+                with _spans.span("elastic.reshard", ckpt_step=s):
+                    resume, extras = self.manager.restore_resharded(
+                        step, step=s)
                 reshard_ms = (time.perf_counter() - t1) * 1e3
                 break
             except CheckpointCorruptError as e:
@@ -163,6 +169,8 @@ class ElasticTrainer:
         self.devices = devs
         self.resume_step = resume
         self.extras = extras
+        # one release of dict-alias compatibility; the registry event is
+        # the durable surface (bench --elastic consumes it)
         self.telemetry = {
             "n_devices": len(devs),
             "plan": plan.name(),
@@ -171,6 +179,10 @@ class ElasticTrainer:
             "reshard_ms": round(reshard_ms, 3),
             "resume_step": resume,
         }
+        _obs.event("elastic.restore", **self.telemetry)
+        _obs.histogram("elastic.replan_ms").observe(replan_ms)
+        if resume is not None:
+            _obs.histogram("elastic.reshard_ms").observe(reshard_ms)
         return 0 if resume is None else resume + 1
 
     def save(self, step_no: int, **extra) -> str:

@@ -70,6 +70,7 @@ import jax
 import numpy as np
 
 from . import chaos as _chaos
+from ..observe import spans as _spans
 
 #: bump when the container layout changes; readers accept <= this.
 #: Schema 2 adds OPTIONAL manifest fields only (per-component "layout",
@@ -523,9 +524,10 @@ class CheckpointManager:
         handle = SaveHandle(step, self.path_for(step))
         layouts = {k: capture_layout(v) for k, v in components.items()}
         try:
-            self._write(step,
-                        {k: _to_host(v) for k, v in components.items()},
-                        layouts=layouts)
+            with _spans.span("ckpt.save", step=step, mode="sync"):
+                self._write(step,
+                            {k: _to_host(v) for k, v in components.items()},
+                            layouts=layouts)
         except BaseException as e:
             handle._finish(e)
             raise
@@ -547,10 +549,11 @@ class CheckpointManager:
         layouts = {k: capture_layout(v) for k, v in components.items()}
         handle = SaveHandle(step, self.path_for(step))
         try:
-            self._write(step,
-                        {k: _to_host(v) for k, v in components.items()},
-                        layouts=layouts,
-                        plan=getattr(train_step, "plan", None))
+            with _spans.span("ckpt.save", step=step, mode="sharded"):
+                self._write(step,
+                            {k: _to_host(v) for k, v in components.items()},
+                            layouts=layouts,
+                            plan=getattr(train_step, "plan", None))
         except BaseException as e:
             handle._finish(e)
             raise
@@ -566,7 +569,10 @@ class CheckpointManager:
         if self._closed:
             raise RuntimeError("CheckpointManager is closed")
         layouts = {k: capture_layout(v) for k, v in components.items()}
-        host = {k: _to_host(v) for k, v in components.items()}
+        # the caller-thread cost of an async save is exactly this fetch —
+        # span it separately from the worker's write
+        with _spans.span("ckpt.save.submit", step=step):
+            host = {k: _to_host(v) for k, v in components.items()}
         handle = SaveHandle(step, self.path_for(step))
         with self._lock:
             self._queue.append((step, host, layouts, handle))
@@ -584,7 +590,8 @@ class CheckpointManager:
                     return
                 step, host, layouts, handle = self._queue.popleft()
             try:
-                self._write(step, host, layouts=layouts)
+                with _spans.span("ckpt.save", step=step, mode="async"):
+                    self._write(step, host, layouts=layouts)
             except BaseException as e:  # surfaced via handle.wait()
                 handle._finish(e)
             else:
@@ -629,8 +636,9 @@ class CheckpointManager:
             if step is None:
                 raise FileNotFoundError(
                     f"no checkpoints under {self.directory!r}")
-        return read_checkpoint_file(self.path_for(step),
-                                    return_manifest=return_manifest)
+        with _spans.span("ckpt.restore", step=step):
+            return read_checkpoint_file(self.path_for(step),
+                                        return_manifest=return_manifest)
 
     def restore_resharded(self, train_step, step: Optional[int] = None):
         """Elastic restore: load one checkpoint (latest when ``step`` is
@@ -651,7 +659,9 @@ class CheckpointManager:
                 raise FileNotFoundError(
                     f"no checkpoints under {self.directory!r}")
         path = self.path_for(step)
-        comps, manifest = read_checkpoint_file(path, return_manifest=True)
+        with _spans.span("ckpt.restore", step=step, mode="resharded"):
+            comps, manifest = read_checkpoint_file(path,
+                                                   return_manifest=True)
         if "state" not in comps:
             raise CheckpointReshardError(
                 f"{path}: no 'state' component to reshard (components: "

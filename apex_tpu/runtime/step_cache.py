@@ -40,7 +40,23 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..observe import registry as _obs
+from ..observe import spans as _spans
+
 _f32 = jnp.float32
+
+#: opt-in ``span("dispatch")`` around every eager step-cache dispatch.
+#: Off by default: the eager optimizer hot path is microbenchmarked
+#: (``bench.py --opt-microbench``) and a per-step span event would be a
+#: measurable fraction of a small fused step; the dispatch *counters*
+#: always flow through the observe registry regardless.
+_DISPATCH_SPANS = False
+
+
+def set_dispatch_spans(enable: bool) -> None:
+    """Enable/disable ``span("dispatch")`` around eager cache dispatches."""
+    global _DISPATCH_SPANS
+    _DISPATCH_SPANS = bool(enable)
 
 
 def _leaf_sig(leaf):
@@ -69,31 +85,32 @@ class StepCache:
     LRU-capped so dead parameter sets cannot pin executables forever.
     """
 
-    def __init__(self, cap: int = 128):
+    _TOP_COUNTERS = ("compiles", "cache_hits", "dispatches",
+                     "multi_tensor_calls")
+    _KIND_COUNTERS = ("compiles", "cache_hits", "dispatches")
+
+    def __init__(self, cap: int = 128, metrics_prefix: str = "step_cache."):
         self._cap = cap
+        self._prefix = metrics_prefix
+        self._registry = _obs.get_registry()
         self._lock = threading.RLock()
         self._programs: OrderedDict = OrderedDict()
         self.reset_stats()
 
     # -- stats -------------------------------------------------------------
-    def reset_stats(self):
-        with self._lock:
-            self._counters = {"compiles": 0, "cache_hits": 0,
-                              "dispatches": 0, "multi_tensor_calls": 0}
-            self._by_kind: dict = {}
+    # Counters live in the apex_tpu.observe registry (names
+    # ``step_cache.<counter>`` / ``step_cache.kind.<kind>.<counter>``);
+    # ``stats()`` reconstructs the historical dict shape from them so the
+    # public surface — and every test pinned to it — is unchanged.
 
-    def _kind_counters(self, kind):
-        c = self._by_kind.get(kind)
-        if c is None:
-            c = {"compiles": 0, "cache_hits": 0, "dispatches": 0}
-            self._by_kind[kind] = c
-        return c
+    def reset_stats(self):
+        self._registry.remove(self._prefix)
 
     def _bump(self, name, kind=None):
-        with self._lock:
-            self._counters[name] += 1
-            if kind is not None:
-                self._kind_counters(kind)[name] += 1
+        self._registry.counter(self._prefix + name).inc()
+        if kind is not None:
+            self._registry.counter(
+                f"{self._prefix}kind.{kind}.{name}").inc()
 
     def stats(self) -> dict:
         """Counters for regression tracking.
@@ -105,11 +122,22 @@ class StepCache:
         ``multi_tensor_calls`` counts eager multi-tensor op invocations for
         a direct launch-count comparison with the reference.
         """
+        counters = self._registry.snapshot()["counters"]
+        out = {n: counters.get(self._prefix + n, 0)
+               for n in self._TOP_COUNTERS}
+        by_kind: dict = {}
+        kind_prefix = self._prefix + "kind."
+        for full, value in counters.items():
+            if not full.startswith(kind_prefix):
+                continue
+            kind, _, cname = full[len(kind_prefix):].rpartition(".")
+            if kind and cname in self._KIND_COUNTERS:
+                by_kind.setdefault(
+                    kind, {n: 0 for n in self._KIND_COUNTERS})[cname] = value
         with self._lock:
-            out = dict(self._counters)
             out["programs"] = len(self._programs)
-            out["by_kind"] = {k: dict(v) for k, v in self._by_kind.items()}
-            return out
+        out["by_kind"] = by_kind
+        return out
 
     # -- cache -------------------------------------------------------------
     def program(self, kind: str, static_key, args, build):
@@ -203,6 +231,15 @@ def static_plan_key(plan):
     return tuple(plan.key())
 
 
+def _dispatch(fn, args, kind):
+    """Count (and, when enabled, span-wrap) one program dispatch."""
+    step_cache._bump("dispatches", kind)
+    if _DISPATCH_SPANS:
+        with _spans.span("dispatch", kind=kind):
+            return fn(*args)
+    return fn(*args)
+
+
 # ---------------------------------------------------------------------------
 # Whole-optimizer step programs
 # ---------------------------------------------------------------------------
@@ -240,8 +277,7 @@ def optimizer_step(kind: str, static_cfg, update, flag, donated, grads,
 
     args = (flag, donated, grads, hyper)
     fn = step_cache.program(kind, (static_cfg, donate), args, build)
-    step_cache._bump("dispatches", kind)
-    return fn(*args)
+    return _dispatch(fn, args, kind)
 
 
 def optimizer_step_with_scaler(kind: str, static_cfg, update, scaler_state,
@@ -275,8 +311,7 @@ def optimizer_step_with_scaler(kind: str, static_cfg, update, scaler_state,
     args = (scaler_state, donated, grads, hyper)
     fn = step_cache.program(kind, (static_cfg, scaler_cfg, donate), args,
                             build)
-    step_cache._bump("dispatches", kind)
-    return fn(*args)
+    return _dispatch(fn, args, kind)
 
 
 # ---------------------------------------------------------------------------
@@ -306,8 +341,7 @@ def unscale(flag, model_grads, out_dtypes, inv_scale,
     args = (flag, grads, jnp.asarray(inv_scale, _f32))
     fn = step_cache.program("amp_unscale", (out_names, bool(check_overflow)),
                             args, build)
-    step_cache._bump("dispatches", "amp_unscale")
-    return fn(*args)
+    return _dispatch(fn, args, "amp_unscale")
 
 
 def unscale_with_stashed(flag, model_grads, stashed_grads, a, b):
@@ -328,8 +362,7 @@ def unscale_with_stashed(flag, model_grads, stashed_grads, a, b):
 
     args = (flag, model, stashed, jnp.asarray(a, _f32), jnp.asarray(b, _f32))
     fn = step_cache.program("amp_axpby", (), args, build)
-    step_cache._bump("dispatches", "amp_axpby")
-    return fn(*args)
+    return _dispatch(fn, args, "amp_axpby")
 
 
 def master_to_model(masters, model_vals):
@@ -345,5 +378,4 @@ def master_to_model(masters, model_vals):
 
     args = (list(masters), list(model_vals))
     fn = step_cache.program("amp_master_to_model", (donate,), args, build)
-    step_cache._bump("dispatches", "amp_master_to_model")
-    return fn(*args)
+    return _dispatch(fn, args, "amp_master_to_model")

@@ -25,6 +25,10 @@ from ..amp.scaler import ScalerState, update_scale_state
 from ..compat import axis_size as _axis_size
 from ..nn.modules import Ctx
 from ..nn.parameter import Parameter
+from ..observe import registry as _obs_registry
+from ..observe import spans as _obs_spans
+from ..observe import telemetry as _obs_telemetry
+from ..observe import watchdog as _obs_watchdog
 
 #: per-make_train_step token in the step_cache static key — two step
 #: programs with identical signatures but different closures (model /
@@ -40,6 +44,10 @@ class StepState(NamedTuple):
     scaler: ScalerState
     stats: list                  # module buffer values (BN running stats)
     step: jax.Array              # i32
+    #: observe.StepTelemetry accumulator, or None (telemetry off).  None
+    #: flattens to an empty subtree, so the leaf signature — and every
+    #: checkpoint saved before this field existed — is unchanged when off.
+    telem: Optional[object] = None
 
 
 class TrainStep:
@@ -67,22 +75,67 @@ class TrainStep:
         self.plan = None
         #: the PlanReport behind parallel="auto", or None
         self.plan_report = None
+        #: on-device telemetry accumulation (make_train_step telemetry=)
+        self._telemetry = False
+        #: windows between host drains of the on-device accumulator
+        self._drain_every = 1
 
     def __call__(self, *batch):
         from ..runtime import chaos as _chaos
         if _chaos.active():
             batch = _chaos_taint(self, batch)
         t0 = time.perf_counter() if self.compile_s is None else None
-        self.state, loss = self._step_fn(self.state, *batch)
+        with _obs_spans.span("dispatch"):
+            self.state, loss = self._step_fn(self.state, *batch)
         if t0 is not None:
             self.compile_s = time.perf_counter() - t0
         self.calls += 1
+        # dispatch returned == the host made forward progress (execution is
+        # async; a heartbeat after enqueue is exactly the liveness signal
+        # the stall watchdog wants — a wedged backend blocks the dispatch)
+        _obs_watchdog.heartbeat(step=self.calls)
         if self._guard is not None:
             # the on-device skip flag apply_fused_update carried out in
             # scaler.overflow — handing the array over costs nothing; the
             # guard reads it lazily (is_ready polling)
             self._guard.observe(self.state.scaler.overflow)
+        if self._telemetry and self.calls % self._drain_every == 0:
+            self.drain_telemetry()
         return loss
+
+    def drain_telemetry(self):
+        """Host-sync the on-device telemetry accumulator and reset it.
+
+        This is the ONE deliberate host round-trip of the telemetry path,
+        and it lives here — eager code outside jit — so the HOST-SYNC
+        invariant holds and the compiled window program stays
+        1 compile + 1 dispatch.  Emits a ``train.telemetry`` event and
+        returns the record (None when telemetry is off or no window has
+        completed since the last drain).
+        """
+        telem = self.state.telem
+        if telem is None:
+            return None
+        host = jax.device_get(telem)
+        windows = int(host.windows)
+        if windows == 0:
+            return None
+        rec = _obs_registry.event(
+            "train.telemetry",
+            step=self.calls,
+            windows=windows,
+            loss_mean=float(host.loss_sum) / windows,
+            grad_norm=float(host.grad_norm),
+            loss_scale=float(host.loss_scale),
+            overflow_count=int(host.overflow_count))
+        _obs_registry.gauge("train.loss").set(rec["loss_mean"])
+        _obs_registry.gauge("train.grad_norm").set(rec["grad_norm"])
+        _obs_registry.gauge("train.loss_scale").set(rec["loss_scale"])
+        _obs_registry.counter("train.overflow_windows").inc(
+            rec["overflow_count"])
+        self.state = self.state._replace(
+            telem=_obs_telemetry.init_telemetry())
+        return rec
 
     @property
     def last_step_skipped(self):
@@ -193,7 +246,8 @@ def _model_dtypes(model, params, half_dtype, keep_batchnorm_fp32):
 
 def apply_fused_update(sub: StepState, grads, opt_update, model_dtypes, *,
                        dynamic, init_scale, scale_window,
-                       min_loss_scale, max_loss_scale, lr_schedule=None):
+                       min_loss_scale, max_loss_scale, lr_schedule=None,
+                       loss=None):
     """The post-gradient half of a fused step: unscale into fp32 master
     grads + overflow flag, fused optimizer update, skip-on-overflow
     (lax.select keeps it fused), model-dtype re-cast, loss-scale update.
@@ -249,8 +303,16 @@ def apply_fused_update(sub: StepState, grads, opt_update, model_dtypes, *,
     # step skip" observable on device — BadStepGuard consumes it without
     # adding a host sync to the step
     new_scaler = new_scaler._replace(overflow=flag)
+    telem = sub.telem
+    if telem is not None:
+        # fold this window's observables into the donated carry — pure
+        # jnp, stays inside the one compiled program, drained by
+        # TrainStep.drain_telemetry from eager code
+        telem = _obs_telemetry.accumulate(
+            telem, loss=loss, master_grads=master_grads, flag=flag,
+            loss_scale=new_scaler.loss_scale)
     return StepState(masters, model_params, slots, new_scaler, sub.stats,
-                     step_count)
+                     step_count, telem)
 
 
 def init_step_state(params, buffers, model_dtypes, opt_init, init_scale):
@@ -543,7 +605,7 @@ def apply_fused_update_flat(sub: StepState, grads, meta: FlatMeta,
                             opt_update, model_dtypes, *,
                             dynamic, init_scale, scale_window,
                             min_loss_scale, max_loss_scale,
-                            lr_schedule=None):
+                            lr_schedule=None, loss=None):
     """Stacked twin of :func:`apply_fused_update`: per-tensor grads
     stack once per shape bucket (layout-preserving leading-axis
     concat), then unscale/overflow, update, and the skip select each
@@ -581,8 +643,15 @@ def apply_fused_update_flat(sub: StepState, grads, meta: FlatMeta,
         min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale)
     # skip-flag carry-out, as in apply_fused_update
     new_scaler = new_scaler._replace(overflow=flag)
+    telem = sub.telem
+    if telem is not None:
+        # the stacked buckets cover every master grad exactly once, so the
+        # sum-of-squares over buckets IS the global norm
+        telem = _obs_telemetry.accumulate(
+            telem, loss=loss, master_grads=flat_grads, flag=flag,
+            loss_scale=new_scaler.loss_scale)
     return StepState(masters, flat_model_params(meta, masters, model_dtypes),
-                     slots, new_scaler, sub.stats, step_count)
+                     slots, new_scaler, sub.stats, step_count, telem)
 
 
 def init_step_state_flat(params, buffers, meta: FlatMeta, model_dtypes,
@@ -665,6 +734,8 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     devices=None,
                     auto_tune: int = 0,
                     plan_options=None,
+                    telemetry: bool = False,
+                    drain_every: int = 1,
                     _plan=None):
     """Build a fully-fused O2-style train step.
 
@@ -774,6 +845,18 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     predicted plans and re-ranks by measurement.  See
     ``docs/auto_parallel.md``.
 
+    ``telemetry=True``: accumulate per-window loss, global master-grad
+    L2 norm, loss scale, and overflow count ON DEVICE inside the same
+    compiled program (5 extra scalar slots in the donated carry — the
+    PR 3 skip-flag discipline), drained to host by
+    ``TrainStep.drain_telemetry`` every ``drain_every`` windows from
+    eager code.  The window program stays 1 compile + 1 dispatch; the
+    drain is the one (amortized) host sync.  See ``docs/observability.md``.
+    Single-program path only — under ``axis_name``/``tp_axis``/
+    ``zero_sharding``/``parallel=`` the carry crosses shard_map/GSPMD
+    wrappers that own the state layout, so telemetry there refuses
+    rather than silently changing sharding.
+
     ``donate_state``: "auto" (default) follows the step cache's donation
     policy — donate on tpu/gpu (in-place buffer reuse), skip on cpu,
     where XLA degrades donation to defensive copies (measured 2x step
@@ -785,6 +868,16 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     if donate_state == "auto":
         from ..runtime.step_cache import donation_enabled
         donate_state = donation_enabled()
+    if telemetry:
+        if drain_every < 1:
+            raise ValueError(f"drain_every must be >= 1, got {drain_every}")
+        if (axis_name is not None or tp_axis is not None or zero_sharding
+                or parallel is not None):
+            raise ValueError(
+                "telemetry=True is supported on the single-program step "
+                "only — under axis_name/tp_axis/zero_sharding/parallel= "
+                "the state carry is owned by the shard_map/GSPMD wrapper; "
+                "drop telemetry= or the parallelism knobs")
     if parallel is not None:
         if axis_name is not None or tp_axis is not None or zero_sharding:
             raise ValueError(
@@ -1054,14 +1147,16 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 opt_update, model_dtypes,
                 dynamic=dynamic, init_scale=init_scale,
                 scale_window=scale_window, min_loss_scale=min_loss_scale,
-                max_loss_scale=max_loss_scale, lr_schedule=lr_schedule)
+                max_loss_scale=max_loss_scale, lr_schedule=lr_schedule,
+                loss=loss)
         else:
             new_state = apply_fused_update(
                 state._replace(stats=new_stats), grads, opt_update,
                 model_dtypes,
                 dynamic=dynamic, init_scale=init_scale,
                 scale_window=scale_window, min_loss_scale=min_loss_scale,
-                max_loss_scale=max_loss_scale, lr_schedule=lr_schedule)
+                max_loss_scale=max_loss_scale, lr_schedule=lr_schedule,
+                loss=loss)
         return new_state, loss
 
     if flat_master:
@@ -1071,6 +1166,9 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     else:
         init_state = init_step_state(params, buffers, model_dtypes,
                                      opt_init, init_scale)
+    if telemetry:
+        init_state = init_state._replace(
+            telem=_obs_telemetry.init_telemetry())
 
     if axis_name is None and tp_axis is None:
         # route through the runtime's step-program cache: the compiled
@@ -1087,7 +1185,7 @@ def make_train_step(model, optimizer, loss_fn: Callable,
         # the plan (when this step was built by parallel.auto) is part of
         # the STATIC key: compiled executables stay per-plan observables
         static_key = (token, grad_accum_steps, accum_stacked,
-                      bool(donate_state),
+                      bool(donate_state), bool(telemetry),
                       _step_cache.static_plan_key(_plan))
 
         def _build():
@@ -1111,4 +1209,6 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     ts._donate_state = donate_state and axis_name is None and tp_axis is None
     ts._flat_meta = flat_meta
     ts._flat_dtypes = model_dtypes
+    ts._telemetry = bool(telemetry)
+    ts._drain_every = int(drain_every)
     return ts

@@ -6,6 +6,7 @@ Run: ``python main_amp.py --steps 50 --batch 16 --seq-len 256``
 (synthetic token streams).
 """
 import argparse
+import contextlib
 import sys
 import time
 
@@ -13,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import apex_tpu.nn as nn
+from apex_tpu import observe
 from apex_tpu.models import GptModel
 from apex_tpu.nn import functional as F
 from apex_tpu.optimizers import FusedAdam
@@ -50,6 +52,19 @@ def parse_args():
                         "chunked vocab-chain loss (docs/performance.md "
                         "'The LM vocab chain': +13%% step throughput "
                         "at this geometry on v5e)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="accumulate loss/grad-norm/overflows ON DEVICE "
+                        "in the step's donated carry and drain every "
+                        "--drain-every steps (docs/observability.md); "
+                        "the print loop then reads the drained gauges "
+                        "instead of forcing a device sync per print")
+    p.add_argument("--drain-every", type=int, default=16)
+    p.add_argument("--events-jsonl", default=None,
+                   help="append the observe event log (telemetry "
+                        "drains, spans, stalls) to this JSONL file")
+    p.add_argument("--watchdog-s", type=float, default=0.0,
+                   help="fire a stall diagnostic if no step completes "
+                        "for this many seconds (0 = off)")
     return p.parse_args()
 
 
@@ -92,7 +107,14 @@ def main():
     step = make_train_step(model, opt, loss_fn, half_dtype=half,
                            loss_scale=loss_scale,
                            grad_accum_steps=args.grad_accum,
-                           lr_schedule=sched)
+                           lr_schedule=sched,
+                           telemetry=args.telemetry,
+                           drain_every=args.drain_every)
+
+    if args.events_jsonl:
+        observe.get_registry().add_jsonl_sink(args.events_jsonl)
+    watchdog = observe.StallWatchdog(args.watchdog_s) \
+        if args.watchdog_s > 0 else contextlib.nullcontext()
 
     rng = np.random.default_rng(0)
 
@@ -100,23 +122,29 @@ def main():
         return jnp.asarray(rng.integers(0, VOCAB,
                                         (args.batch, args.seq_len)))
 
-    ids = batch()
-    t0 = time.perf_counter()
-    loss = step(ids, ids)
-    print(f"compile+first step: {time.perf_counter() - t0:.1f}s "
-          f"loss {float(loss):.4f}")
-
-    seen, t_mark = 0, time.perf_counter()
-    final = loss
-    for i in range(1, args.steps):
+    with watchdog:
         ids = batch()
-        final = step(ids, ids)
-        seen += args.batch
-        if i % args.print_freq == 0:
-            lv = float(final)  # fetch = device sync on this platform
-            dt = time.perf_counter() - t_mark
-            print(f"step {i}: loss {lv:.4f}  {seen / dt:.1f} seq/s")
-            seen, t_mark = 0, time.perf_counter()
+        t0 = time.perf_counter()
+        loss = step(ids, ids)
+        print(f"compile+first step: {time.perf_counter() - t0:.1f}s "
+              f"loss {float(loss):.4f}")
+
+        seen, t_mark = 0, time.perf_counter()
+        final = loss
+        for i in range(1, args.steps):
+            ids = batch()
+            final = step(ids, ids)
+            seen += args.batch
+            if i % args.print_freq == 0:
+                if args.telemetry:
+                    # the drained gauge: no device sync, K steps stale
+                    lv = observe.gauge("train.loss").value or float("nan")
+                else:
+                    lv = float(final)  # fetch = device sync here
+                dt = time.perf_counter() - t_mark
+                print(f"step {i}: loss {lv:.4f}  {seen / dt:.1f} seq/s")
+                seen, t_mark = 0, time.perf_counter()
+    step.drain_telemetry()             # flush the partial last window
     print("final loss:", float(final))
 
 

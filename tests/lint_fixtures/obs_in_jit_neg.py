@@ -1,0 +1,32 @@
+"""OBS-IN-JIT negative: on-device telemetry accumulates in the carry
+inside jit; spans, counters, events and drains live in the eager driver."""
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.observe import span, counter, event
+from apex_tpu.observe import telemetry as obs_telemetry
+from apex_tpu.observe.telemetry import init_telemetry
+
+
+@jax.jit
+def clean_step(telem, grads, loss):
+    # fine: the telemetry surface is jit-safe by construction — pure jnp
+    # accumulation into the donated carry, drained outside the step
+    telem = telem if telem is not None else init_telemetry()
+    return obs_telemetry.accumulate(
+        telem, loss=loss, master_grads=grads,
+        flag=jnp.zeros((), jnp.bool_),
+        loss_scale=jnp.ones((), jnp.float32))
+
+
+def eager_train_loop(step, state, batches):
+    """Eager driver — spans and counters belong exactly here, outside
+    the compiled step."""
+    loss = None
+    for batch in batches:
+        with span("dispatch"):
+            state, loss = step(state, batch)
+        counter("train.steps").inc()
+        step.drain_telemetry()
+    event("epoch.done", loss=float(loss))
+    return state

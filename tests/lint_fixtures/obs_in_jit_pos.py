@@ -1,0 +1,39 @@
+"""OBS-IN-JIT positive: host-side observe calls inside traced code."""
+import jax
+
+from apex_tpu.observe import span, counter, event
+from apex_tpu.observe import registry as obs_registry
+
+
+@jax.jit
+def bad_span_step(params, grads):
+    # BAD: span reads wall clocks and writes JSONL — traced, it times
+    # the trace, not the execution
+    with span("update"):
+        out = [p - 0.1 * g for p, g in zip(params, grads)]
+    return out
+
+
+def bad_counter_step(params, grads):
+    # BAD: registry counters take a lock and mutate host state; traced,
+    # the count sticks at its trace-time value
+    counter("train.steps").inc()
+    event("step", n=len(params))
+    return [p - 0.1 * g for p, g in zip(params, grads)]
+
+
+def bad_registry_step(state, batch):
+    # BAD: module-alias spelling of the same hazard
+    obs_registry.event("batch", size=batch.shape[0])
+    return state
+
+
+def bad_drain_step(train_step, state):
+    # BAD: the drain IS the host fetch the telemetry carry defers
+    train_step.drain_telemetry()
+    return state
+
+
+train = jax.jit(bad_counter_step)
+stepped = jax.jit(bad_registry_step)
+drained = jax.jit(bad_drain_step)

@@ -230,6 +230,29 @@ def test_elastic_bench_records_schema(tmp_path):
     assert shrink["resume_gap_steps"] == 1
 
 
+def test_observe_microbench_records_schema():
+    """--observe-microbench stage: the fused step with the on-device
+    telemetry carry vs telemetry off, and the observe claim — at
+    drain_every >= 16 the telemetry costs under 2% of step time."""
+    # the perf bound is a difference of ~20ms timings; under a loaded
+    # single-core CI box one round can smear past the bound, so retry
+    # the measurement (schema asserts stay strict on every round)
+    for attempt in range(3):
+        recs = bench.observe_microbench_records(timed_steps=5,
+                                                repeats=2 + attempt)
+        assert {r["drain_every"] for r in recs} == {1, 16}
+        for r in recs:
+            assert r["metric"] == "telemetry_overhead_us"
+            assert r["platform"] == "cpu"
+            assert r["step_us_base"] > 0 and r["step_us_telemetry"] > 0
+            assert r["telemetry_overhead_us"] == \
+                round(r["step_us_telemetry"] - r["step_us_base"], 1)
+        (d16,) = [r for r in recs if r["drain_every"] >= 16]
+        if d16["overhead_pct"] < 2.0:
+            break
+    assert d16["overhead_pct"] < 2.0
+
+
 def test_lint_records_schema():
     """--lint stage: one lint_findings record with the analyzer-health
     fields (the r06 multichip rerun records hazard-cleanliness next to

@@ -30,6 +30,7 @@ RULE_FIXTURES = {
     "UNBOUNDED-COLLECTIVE": "unbounded_collective",
     "IMPURE-STATIC-KEY": "impure_static_key",
     "CKPT-ATOMIC": "ckpt_atomic",
+    "OBS-IN-JIT": "obs_in_jit",
 }
 
 

@@ -1,0 +1,253 @@
+"""apex_tpu.observe: metrics registry / JSONL schema round-trip, the
+zero-dispatch on-device telemetry carry (bitwise grad-norm parity with an
+eager recompute, 1-compile/1-dispatch pin under accumulation), trace
+spans, and the stall watchdog (fires under an injected chaos stall, stays
+silent on a clean run)."""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu import observe
+from apex_tpu.nn import functional as F
+from apex_tpu.nn.modules import Ctx
+from apex_tpu.observe import (MetricsRegistry, SCHEMA_VERSION, StallWatchdog,
+                              get_registry, heartbeat, last_span, span)
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.runtime import chaos, step_cache
+from apex_tpu.training import make_train_step
+
+pytestmark = pytest.mark.observe
+
+
+def _mlp(seed=0, din=8, hidden=16, dout=4):
+    nn.manual_seed(seed)
+    return nn.Sequential(nn.Linear(din, hidden), nn.ReLU(),
+                         nn.Linear(hidden, dout))
+
+
+def _data(n=4, din=8, dout=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((n, din)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, dout, (n,)))
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# registry + event log
+# ---------------------------------------------------------------------------
+
+
+def test_registry_jsonl_schema_roundtrip(tmp_path):
+    reg = MetricsRegistry()
+    path = str(tmp_path / "events.jsonl")
+    reg.add_jsonl_sink(path)
+    reg.counter("c").inc(3)
+    reg.gauge("g").set(2.5)
+    reg.histogram("h").observe(1.0)
+    reg.histogram("h").observe(3.0)
+    reg.event("alpha", k=1)
+    reg.event("beta", arr=jnp.zeros(2))     # non-JSON value -> default=str
+    reg.remove_jsonl_sink(path)
+
+    lines = [json.loads(line) for line in open(path)]
+    assert [ln["event"] for ln in lines] == ["alpha", "beta"]
+    for ln in lines:
+        assert ln["schema"] == SCHEMA_VERSION
+        assert isinstance(ln["ts_ms"], float)
+    assert lines[0]["k"] == 1
+    assert isinstance(lines[1]["arr"], str)
+    # monotonic timestamps order the stream
+    assert lines[1]["ts_ms"] >= lines[0]["ts_ms"]
+    # the in-memory buffer carries the same records
+    assert reg.events("alpha")[0]["k"] == 1
+    snap = reg.snapshot()
+    assert snap["schema"] == SCHEMA_VERSION
+    assert snap["counters"]["c"] == 3
+    assert snap["gauges"]["g"] == 2.5
+    h = snap["histograms"]["h"]
+    assert h["count"] == 2 and h["min"] == 1.0 and h["max"] == 3.0 \
+        and h["mean"] == 2.0
+    # prefix removal resets one subsystem's slice only
+    reg.remove("c")
+    snap = reg.snapshot()
+    assert "c" not in snap["counters"] and "g" in snap["gauges"]
+
+
+def test_span_emits_event_histogram_and_last_span():
+    reg = get_registry()
+    reg.clear_events()
+    with span("test.region", phase="fwd"):
+        pass
+    (ev,) = [e for e in reg.events("span") if e["span"] == "test.region"]
+    assert ev["phase"] == "fwd" and ev["dur_ms"] >= 0
+    assert ev["schema"] == SCHEMA_VERSION
+    assert last_span()["span"] == "test.region"
+    assert reg.histogram("span.test.region_ms").count >= 1
+
+
+# ---------------------------------------------------------------------------
+# the on-device telemetry carry
+# ---------------------------------------------------------------------------
+
+
+def test_drained_grad_norm_bitwise_matches_eager_recompute():
+    """At loss_scale=1.0 (static) the master grads are the raw f32 grads,
+    so the carry's on-device sqrt(sum(g*g)) must be bitwise-identical to
+    an eager jax.grad recompute over the same forward/env/key."""
+    get_registry().clear_events()
+    model = _mlp()
+    params = [p for p in model.parameters()]
+    opt = FusedSGD(params, lr=0.1, momentum=0.9)
+    step = make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=None, loss_scale=1.0,
+                           telemetry=True, drain_every=1)
+    x, y = _data()
+
+    # eager reference from the PRE-step masters, replicating step_fn's
+    # forward exactly: same env substitution, same step-derived RNG key,
+    # same f32 cast + loss-scale multiply
+    masters = [jnp.asarray(m) for m in step.state.master_params]
+    step_ctr = step.state.step
+
+    def scaled_loss(vals):
+        env = {id(p): v for p, v in zip(params, vals)}
+        key = jax.random.fold_in(jax.random.PRNGKey(0), step_ctr)
+        ctx = Ctx(env=env, stats_out={}, training=True, key=key)
+        out = model.forward(ctx, x)
+        return F.cross_entropy(out, y).astype(jnp.float32) * \
+            jnp.asarray(1.0, jnp.float32)
+
+    grads = jax.grad(scaled_loss)(masters)
+    gsq = jnp.zeros((), jnp.float32)
+    for g in grads:
+        gsq = gsq + jnp.sum(g * g)
+    ref_norm = float(jnp.sqrt(gsq))
+
+    loss = float(step(x, y))            # drain_every=1: drains immediately
+    assert np.isfinite(loss)
+    (rec,) = get_registry().events("train.telemetry")
+    assert rec["windows"] == 1
+    assert rec["grad_norm"] == ref_norm          # bitwise, not allclose
+    assert rec["loss_scale"] == 1.0
+    assert rec["overflow_count"] == 0
+
+
+def test_telemetry_keeps_one_compile_one_dispatch_per_window():
+    """The tentpole pin: with telemetry ON and a K-microbatch window, the
+    step stays one executable and one dispatch per window; the drain
+    happens outside jit and keys no new program."""
+    get_registry().clear_events()
+    model = _mlp(din=8)
+    opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+    step = make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t),
+                           half_dtype=jnp.bfloat16, loss_scale="dynamic",
+                           accum_steps=4, accum_stacked=True,
+                           telemetry=True, drain_every=2)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 4, 8)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (4, 4)))
+
+    step_cache.reset_stats()
+    windows = 6
+    for _ in range(windows):
+        step(x, y)
+    st = step_cache.stats()["by_kind"]["train_step"]
+    assert st["compiles"] == 1
+    assert st["dispatches"] == windows
+    assert st["cache_hits"] == windows - 1
+
+    recs = get_registry().events("train.telemetry")
+    assert [r["step"] for r in recs] == [2, 4, 6]    # drain_every=2
+    for r in recs:
+        assert r["windows"] == 2
+        assert np.isfinite(r["loss_mean"]) and np.isfinite(r["grad_norm"])
+    # drained gauges track the last drain
+    assert get_registry().gauge("train.grad_norm").value == \
+        recs[-1]["grad_norm"]
+
+
+def test_telemetry_off_leaves_state_signature_unchanged():
+    """telemetry=False (the default) keeps StepState.telem=None — an
+    empty pytree subtree, so signatures and checkpoints are identical to
+    pre-observe builds."""
+    model = _mlp()
+    opt = FusedSGD(list(model.parameters()), lr=0.1)
+    step = make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t))
+    assert step.state.telem is None
+    assert step.drain_telemetry() is None
+    x, y = _data()
+    step(x, y)
+    assert step.state.telem is None
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_watchdog_fires_on_injected_stall():
+    """A chaos train.step delay wedges the dispatch loop; the watchdog
+    must emit exactly one typed diagnostic carrying the last step, the
+    last span, the backend, and the stale-tunnel remediation hint."""
+    get_registry().clear_events()
+    model = _mlp()
+    opt = FusedSGD(list(model.parameters()), lr=0.1)
+    step = make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t))
+    x, y = _data()
+    step(x, y)                          # compile outside the timed window
+
+    heartbeat()                         # fresh anchor for THIS test
+    wd = StallWatchdog(deadline_s=0.12, poll_s=0.03)
+    with wd:
+        with chaos.session(seed=0) as c:
+            c.on("train.step", action="delay", delay_s=0.6, at=1)
+            step(x, y)                  # call 1 (fast), beats
+            step(x, y)                  # call 2: delayed 0.6s -> stall
+    assert len(wd.stalls) == 1          # one diagnostic per stall, not per poll
+    diag = wd.stalls[0]
+    assert diag["deadline_s"] == 0.12
+    assert diag["since_last_step_s"] >= 0.12
+    assert diag["last_step"] == 2       # heartbeats carry the call count
+    assert diag["backend"] == "cpu"
+    assert diag["last_span"] is not None and "span" in diag["last_span"]
+    assert "stale axon tunnel claim" in diag["hint"]
+    (ev,) = get_registry().events("watchdog.stall")
+    assert ev["hint"] == diag["hint"]
+
+
+def test_watchdog_silent_on_clean_run():
+    model = _mlp()
+    opt = FusedSGD(list(model.parameters()), lr=0.1)
+    step = make_train_step(model, opt, lambda o, t: F.cross_entropy(o, t))
+    x, y = _data()
+    step(x, y)                          # compile outside the timed window
+
+    heartbeat()
+    wd = StallWatchdog(deadline_s=0.6, poll_s=0.05)
+    with wd:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 1.0:   # longer than the deadline
+            step(x, y)                  # each dispatch beats
+            time.sleep(0.05)
+    assert wd.stalls == []
+
+
+def test_watchdog_rejects_nonpositive_deadline():
+    with pytest.raises(ValueError):
+        StallWatchdog(deadline_s=0.0)
+
+
+def test_observe_exports():
+    """The public surface other subsystems wire against."""
+    for name in ("span", "last_span", "counter", "gauge", "histogram",
+                 "event", "events", "get_registry", "MetricsRegistry",
+                 "StallWatchdog", "heartbeat", "last_heartbeat",
+                 "StepTelemetry", "init_telemetry", "accumulate",
+                 "SCHEMA_VERSION", "STALL_HINT"):
+        assert hasattr(observe, name), name

@@ -191,11 +191,12 @@ def test_analyze_in_process(rng):
     assert all("flops" in r and "est_us" in r for r in rows)
 
 
-@pytest.mark.xfail(
-    reason="jax.profiler on this CPU-only jaxlib 0.4.37 image emits no "
-           "XLA thunk-duration events, so the trace<->HLO join yields "
-           "zero measured rows (the pipeline is exercised end-to-end on "
-           "real TPU, where the device plane produces them)")
+@pytest.mark.skipif(
+    not pyprof.thunk_events_available(),
+    reason="backend capability: jax.profiler on this backend emits no "
+           "XLA thunk-duration events (pyprof.thunk_events_available() "
+           "probed false — CPU jaxlib 0.4.x), so the trace<->HLO join "
+           "has nothing to measure; runs on real TPU")
 def test_profile_step_measured_durations(rng, tmp_path):
     """The measured pipeline (VERDICT round 1 #5): profile a tiny jitted
     step, join jax.profiler thunk events to annotate ops through the HLO
@@ -256,9 +257,10 @@ def test_correlate_unattributed_breakdown():
                   "op:convert_element_type": 1.5}
 
 
-@pytest.mark.xfail(
-    reason="same root cause as test_profile_step_measured_durations: no "
-           "thunk-duration events from jax.profiler on this CPU jaxlib, "
+@pytest.mark.skipif(
+    not pyprof.thunk_events_available(),
+    reason="same capability probe as test_profile_step_measured_durations:"
+           " no thunk-duration events from jax.profiler on this backend, "
            "so the CLI's dur_us column is empty")
 def test_parse_cli_with_trace(tmp_path, rng):
     """CLI join path: parse --trace --hlo produces dur_us columns."""
@@ -474,3 +476,37 @@ def test_rms_norm_annotated_and_modeled(rng):
            "params": {"normalized_shape": [16]}}
     f, b, _ = model_row(row)
     assert f == 6 * 8 * 16 and b == 3 * 8 * 16 * 4
+
+
+def test_nvtx_annotate_delegates_to_observe_span():
+    """The replacement for the dead thunk-event path on thunk-less
+    backends: nvtx.annotate is observe.span, so pyprof range markers land
+    in the observe event stream (and TraceAnnotation) with durations
+    measured on the host — available on EVERY backend."""
+    from apex_tpu import observe
+    from apex_tpu.pyprof import nvtx
+
+    before = len(observe.events("span"))
+    with nvtx.annotate("pyprof.region", phase="fwd"):
+        jnp.ones((4, 4)).sum().block_until_ready()
+    spans = observe.events("span")[before:]
+    ours = [e for e in spans if e["span"] == "pyprof.region"]
+    assert len(ours) == 1
+    rec = ours[0]
+    assert rec["schema"] == observe.SCHEMA_VERSION
+    assert rec["dur_ms"] >= 0
+    assert rec["phase"] == "fwd"
+    # the open span was recorded for the stall watchdog's diagnostics
+    last = observe.last_span()
+    assert last is not None and "span" in last
+
+
+def test_thunk_capability_probe_is_cached_and_boolean():
+    """The capability gate the two measured-pipeline tests now key on:
+    a plain bool, probed once per process (second call hits the cache)."""
+    r1 = pyprof.thunk_events_available()
+    r2 = pyprof.thunk_events_available()
+    assert isinstance(r1, bool) and r1 is r2
+    # on the CPU-forced test image the probe must come back False —
+    # exactly the condition that skips the measured-duration tests
+    assert r1 is False
