@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..nn.parameter import Parameter
-from ..runtime import step_cache as _step_cache
+from ..runtime import executor as _executor
 from ._amp_state import maybe_print
 
 
@@ -38,7 +38,7 @@ def _master_params_to_model_params(self):
     if len(stash.all_fp16_params) > 0:
         # one cached executable; the stale half copies are donated (each
         # output aliases the buffer it replaces)
-        new_model = _step_cache.master_to_model(
+        new_model = _executor.master_to_model(
             [p.data for p in stash.all_fp32_from_fp16_params],
             [p.data for p in stash.all_fp16_params])
         for mp, nd in zip(stash.all_fp16_params, new_model):

@@ -13,6 +13,10 @@ validates kwarg overrides against the chosen preset and delegates to
   still works and is exercised for parity testing (SURVEY.md §7 hard parts).
 * ``patch_torch_functions`` keeps its name (it now toggles the trace-time cast
   policy rather than monkey-patching torch).
+* the patched ``optimizer.step()`` / ``scale_loss`` machinery compiles its
+  unscale + update programs through ``runtime.executor`` (one dispatch choke
+  point shared with the fused step — see docs/executor.md); ``initialize``
+  itself only configures the cast/scaling properties.
 """
 from __future__ import annotations
 

@@ -92,7 +92,8 @@ def scale_loss(loss, optimizers, loss_id=0, model=None, delay_unscale=False,
                 optimizer._amp_stash.params_have_scaled_gradients = False
                 optimizer._amp_stash._delayed_scaler = None
         # deferred mode (amp.initialize(..., defer_scale_update=True)): hand
-        # the scaler to the optimizers' step-cache programs, which fuse the
+        # the scaler to the optimizers' executor programs
+        # (runtime.executor.optimizer_step_with_scaler), which fuse the
         # overflow-conditional skip (lax.cond) and the dynamic-scale update
         # into the step executable — no per-step host sync, no step patching
         # (and no "Gradient overflow" print; read loss_scale() to observe).

@@ -20,7 +20,7 @@ from typing import NamedTuple, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from ..runtime import step_cache as _step_cache
+from ..runtime import executor as _executor
 
 _f32 = jnp.float32
 
@@ -83,7 +83,7 @@ def unscale_grads(state: ScalerState, model_grads: Sequence[jax.Array],
 
     Functional analogue of LossScaler.unscale (scaler.py:76-124): the whole
     unscale + overflow sweep runs as ONE cached executable
-    (``step_cache.unscale``) instead of eager per-tensor dispatches.
+    (``executor.unscale``) instead of eager per-tensor dispatches.
     Returns (new_state, master_grads).
     """
     scale = state.loss_scale if scale_override is None \
@@ -91,7 +91,7 @@ def unscale_grads(state: ScalerState, model_grads: Sequence[jax.Array],
     inv = 1.0 / scale
     dts = [g.dtype if master_dtypes is None else master_dtypes[i]
            for i, g in enumerate(model_grads)]
-    flag, masters = _step_cache.unscale(
+    flag, masters = _executor.unscale(
         state.overflow, list(model_grads), dts, inv,
         check_overflow=check_overflow)
     return ScalerState(state.loss_scale, state.unskipped, flag), masters
@@ -109,7 +109,7 @@ def unscale_with_stashed_grads(state: ScalerState, model_grads, stashed_grads,
         grads_have_scale, stashed_have_scale, out_scale = scale_override
     else:
         grads_have_scale, stashed_have_scale = state.loss_scale, 1.0
-    flag, masters = _step_cache.unscale_with_stashed(
+    flag, masters = _executor.unscale_with_stashed(
         state.overflow, list(model_grads), list(stashed_grads),
         out_scale / grads_have_scale, out_scale / stashed_have_scale)
     return ScalerState(state.loss_scale, state.unskipped, flag), masters

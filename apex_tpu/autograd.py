@@ -546,9 +546,9 @@ def backward(root: Tensor):
                 out.append(g if prev is None else prev + g)
             return loss_val, out
 
-        from .runtime.step_cache import donation_enabled
+        from .runtime.executor import donation
         cached = jax.jit(run,
-                         donate_argnums=(1,) if donation_enabled() else ())
+                         donate_argnums=(1,) if donation.enabled else ())
         _compiled_cache[program.cache_key] = cached
         while len(_compiled_cache) > _COMPILED_CACHE_MAX:
             _compiled_cache.popitem(last=False)

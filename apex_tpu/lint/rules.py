@@ -874,3 +874,87 @@ class ObsInJit(Rule):
                         f"host surface runs once at trace time, never "
                         f"per step (dead telemetry)")
         return None
+
+
+# ---------------------------------------------------------------------------
+# EXEC-BYPASS
+# ---------------------------------------------------------------------------
+
+#: function names that are, by this repo's convention, whole train/opt
+#: step programs.  Exact matches plus the ``*_step_fn`` suffix — the
+#: conservative set: inference ``run`` closures and generic helpers never
+#: match.
+_STEP_FN_NAMES = {"step_fn", "jit_step", "train_step", "zero_train_step"}
+
+#: executor modules that legitimately compile/count dispatches: the
+#: executor itself and the cache whose counters it bumps
+_EXEC_HOMES = ("apex_tpu/runtime/executor.py",
+               "apex_tpu/runtime/step_cache.py")
+
+
+@register
+class ExecBypass(Rule):
+    """Step programs compiled or dispatched outside the one-runtime
+    executor — the one-runtime PR.
+
+    Before the executor, the eager optimizer surface and the fused train
+    step each had their own route into the step-program cache; donation
+    policy, dispatch counters and span/heartbeat plumbing drifted apart
+    (the eager path had no heartbeats at all, so the stall watchdog was
+    blind to half the library).  ``runtime/executor.py`` is now the one
+    place ``jax.jit`` is called on a step program and the one place
+    dispatches are counted.  Flags, outside the executor: direct
+    ``step_cache.program(...)`` compile-or-hit calls, manual
+    ``_bump("dispatches", ...)`` counter writes, and ``jax.jit`` of a
+    function named like a train step.  Wrappers describe a
+    ``Program`` and ``executor.submit`` it instead.
+    """
+    id = "EXEC-BYPASS"
+    summary = ("step program compiled/dispatched outside "
+               "runtime/executor.py")
+    hint = ("describe the step as a runtime.executor.Program (static_key, "
+            "donate_argnums, optional wrap/shardings) and dispatch via "
+            "executor.submit — compiles, counters, dispatch spans and "
+            "watchdog heartbeats then come uniformly; see "
+            "docs/executor.md's migration table")
+
+    @staticmethod
+    def _is_step_name(name: Optional[str]) -> bool:
+        return bool(name) and (name in _STEP_FN_NAMES
+                               or name.endswith("_step_fn"))
+
+    def check(self, module, ctx):
+        path = module.path.replace("\\", "/")
+        if path.endswith(_EXEC_HOMES):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tn = _terminal(node.func)
+            d = _dotted(node.func) or ""
+            if tn == "program" and "step_cache" in d.split("."):
+                yield self.finding(
+                    module, node,
+                    f"{d}(...) — direct step-cache compile-or-hit "
+                    f"outside the executor (no dispatch count, no "
+                    f"span, no heartbeat)")
+            elif tn == "_bump" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value == "dispatches":
+                yield self.finding(
+                    module, node,
+                    "manual _bump('dispatches', ...) — dispatch "
+                    "counting belongs to executor.submit")
+            elif tn in ("jit", "pjit") and node.args:
+                target = node.args[0]
+                name = None
+                if isinstance(target, ast.Name):
+                    name = target.id
+                elif isinstance(target, ast.Attribute):
+                    name = target.attr
+                if self._is_step_name(name):
+                    yield self.finding(
+                        module, node,
+                        f"jax.jit of step function '{name}' outside the "
+                        f"executor — the program bypasses the cache "
+                        f"stats, donation policy and observability")

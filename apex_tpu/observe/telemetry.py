@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 
@@ -53,7 +54,7 @@ def init_telemetry() -> StepTelemetry:
 
 
 def accumulate(telem: StepTelemetry, *, loss, master_grads, flag,
-               loss_scale) -> StepTelemetry:
+               loss_scale, mean_axes=()) -> StepTelemetry:
     """Fold one window's observables into the carry (traced code).
 
     ``master_grads`` are the f32 (unscaled) gradients the optimizer
@@ -61,6 +62,16 @@ def accumulate(telem: StepTelemetry, *, loss, master_grads, flag,
     The grad norm is computed in f32 over the master grads, so at
     ``loss_scale == 1.0`` it is bitwise-identical to an eager
     ``sqrt(sum(g*g))`` over the same gradients.
+
+    ``mean_axes``: mapped mesh axis names to pmean the loss over —
+    the cross-mesh reduction for steps running under ``shard_map``
+    (dp / sp axes; the fused step threads them).  Only the loss needs
+    it: by the time ``accumulate`` runs, the gradients have been
+    through the DP psum-average / TP block psum, so every device holds
+    the same replicated values and the grad norm — like the overflow
+    flag and the loss scale — is already mesh-wide.  Under GSPMD
+    (ZeRO) the step is a single global-view program and the loss is
+    global already; pass no axes there.
     """
     gsq = jnp.zeros((), jnp.float32)
     for g in master_grads:
@@ -68,6 +79,8 @@ def accumulate(telem: StepTelemetry, *, loss, master_grads, flag,
     gnorm = jnp.sqrt(gsq)
     loss = jnp.asarray(loss, jnp.float32) if loss is not None \
         else jnp.zeros((), jnp.float32)
+    for ax in tuple(mean_axes):
+        loss = jax.lax.pmean(loss, ax)
     return StepTelemetry(
         loss_sum=telem.loss_sum + loss,
         grad_norm=gnorm,

@@ -153,7 +153,7 @@ def amp_model_copy_map(optimizer):
 
 def dispatch_cached_step(optimizer, kind, static_cfg, update, donated, grads,
                          hyper):
-    """Route one whole-optimizer step through the step cache.
+    """Route one whole-optimizer step through the runtime executor.
 
     When ``amp.initialize(..., defer_scale_update=True)`` handed this
     optimizer a pending scaler (``_amp_stash._deferred_scaler``), the
@@ -162,7 +162,7 @@ def dispatch_cached_step(optimizer, kind, static_cfg, update, donated, grads,
     program conditions on the optimizer's own overflow buffer.
     Returns the new donated tree; the caller rebinds every leaf.
     """
-    from ..runtime import step_cache
+    from ..runtime import executor
 
     stash = getattr(optimizer, "_amp_stash", None)
     scaler = getattr(stash, "_deferred_scaler", None) if stash is not None \
@@ -173,13 +173,13 @@ def dispatch_cached_step(optimizer, kind, static_cfg, update, donated, grads,
                       ("scale_window", scaler._scale_seq_len),
                       ("min_loss_scale", scaler._min_loss_scale),
                       ("max_loss_scale", scaler._max_loss_scale))
-        new_state, new_donated = step_cache.optimizer_step_with_scaler(
+        new_state, new_donated = executor.optimizer_step_with_scaler(
             kind, static_cfg, update, scaler.state, scaler_cfg, donated,
             grads, hyper)
         scaler.state = new_state
         stash._deferred_scaler = None
         return new_donated
-    return step_cache.optimizer_step(
+    return executor.optimizer_step(
         kind, static_cfg, update, optimizer._overflow_buf, donated, grads,
         hyper)
 

@@ -603,12 +603,23 @@ def predict_time(plan: Plan, prof: ModelProfile, spec: ChipSpec,
             colls.append(f"all-gather updated params ({_mib(ag)}) over "
                          f"{plan.dp_axis}({plan.dp})")
         if plan.zero_stage == 3:
-            ag3 = plan.accum * prof.n_params * w_itemsize
-            coll_s += plan.accum * _ring_half_s(
-                prof.n_params * w_itemsize, plan.dp, spec)
-            colls.append(f"per-microbatch param all-gather (stage 3, "
-                         f"K×{_mib(prof.n_params * w_itemsize)} = "
-                         f"{_mib(ag3)}/step)")
+            from ..runtime import executor as _executor
+            ag1 = prof.n_params * w_itemsize
+            ag3 = plan.accum * ag1
+            if plan.accum > 1 and _executor.overlap_enabled("gather"):
+                # executor gather prefetch: the scanned window issues
+                # microbatch i+1's param gather under microbatch i's
+                # compute, so only the prologue gather stays exposed
+                coll_s += _ring_half_s(ag1, plan.dp, spec)
+                colls.append(
+                    f"per-microbatch param all-gather (stage 3, "
+                    f"K×{_mib(ag1)} = {_mib(ag3)}/step; prefetch "
+                    f"overlaps all but the prologue gather)")
+            else:
+                coll_s += plan.accum * _ring_half_s(ag1, plan.dp, spec)
+                colls.append(f"per-microbatch param all-gather (stage 3, "
+                             f"K×{_mib(ag1)} = "
+                             f"{_mib(ag3)}/step)")
     if plan.tp > 1:
         if prof.layers and prof.hidden and prof.seq_len:
             per_micro = (4.0 * prof.layers * micro_b * prof.seq_len
@@ -888,11 +899,13 @@ def apply_plan(plan: Plan, model, optimizer, loss_fn, devices=None,
                                          else None))
 
     from .. import compat
-    from ..runtime import step_cache as _step_cache
+    from ..runtime import executor as _executor
 
     raw = step._raw_step_fn
     plan_key = plan.key()
     token = next(_PLAN_TOKENS)
+    dispatch_no = itertools.count(1)
+    programs = {}
 
     def _batch_spec(el):
         def leaf(a):
@@ -906,32 +919,40 @@ def apply_plan(plan: Plan, model, optimizer, loss_fn, devices=None,
             return P(*dims)
         return jax.tree_util.tree_map(leaf, el)
 
+    def _program(specs):
+        prog = programs.get(specs)
+        if prog is not None:
+            return prog
+
+        def run(state, *b):
+            new_state, loss = raw(state, *b)
+            if mean_axes:
+                # the in-step loss is one shard's local mean; make
+                # the reported number the global mean (grads are
+                # already psum-exchanged inside the step)
+                loss = jax.lax.pmean(
+                    loss, mean_axes if len(mean_axes) > 1
+                    else mean_axes[0])
+            return new_state, loss
+
+        def wrap(f):
+            return compat.shard_map(f, mesh=mesh,
+                                    in_specs=(P(),) + specs,
+                                    out_specs=(P(), P()), check_vma=False)
+
+        prog = _executor.Program(
+            "train_step", (token, plan_key, specs, donate), run,
+            donate_argnums=(0,) if donate else (), wrap=wrap)
+        programs[specs] = prog
+        return prog
+
     def dispatch(state, *batch):
         specs = tuple(_batch_spec(b) for b in batch)
-
-        def build():
-            def run(state, *b):
-                new_state, loss = raw(state, *b)
-                if mean_axes:
-                    # the in-step loss is one shard's local mean; make
-                    # the reported number the global mean (grads are
-                    # already psum-exchanged inside the step)
-                    loss = jax.lax.pmean(
-                        loss, mean_axes if len(mean_axes) > 1
-                        else mean_axes[0])
-                return new_state, loss
-            fn = compat.shard_map(run, mesh=mesh,
-                                  in_specs=(P(),) + specs,
-                                  out_specs=(P(), P()), check_vma=False)
-            return jax.jit(fn, donate_argnums=(0,) if donate else ())
-
-        args = (state,) + batch
-        fn = _step_cache.step_cache.program(
-            "train_step", (token, plan_key, specs, donate), args, build)
-        _step_cache.step_cache._bump("dispatches", "train_step")
-        return fn(*args)
+        return _executor.executor.submit(
+            _program(specs), (state,) + batch, step=next(dispatch_no))
 
     step._step_fn = dispatch
+    step._via_executor = True
     step.plan = plan
     return step
 

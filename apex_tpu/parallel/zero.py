@@ -87,6 +87,10 @@ def zero_state_sharding(state, mesh: Mesh, axis: str = "data",
                    for k, v in state.opt_state.items()},
         scaler=jax.tree.map(lambda _: rep, state.scaler),
         stats=[rep for _ in state.stats],
+        # telemetry scalars replicate (the global-view program already
+        # accumulates global values — no collective needed at drain)
+        telem=(None if state.telem is None
+               else jax.tree.map(lambda _: rep, state.telem)),
         step=rep)
 
 
@@ -135,6 +139,13 @@ class ZeroTrainStep:
         self._jits = {}
         self._donate = donate
         self.compile_s = None
+        self.calls = 0
+        self._guard = None
+        # telemetry rides the ZeRO carry like any other state leaf (the
+        # accumulator scalars replicate); the drain cadence comes from the
+        # base step's build flags
+        self._telemetry = getattr(step, "_telemetry", False)
+        self._drain_every = getattr(step, "_drain_every", 1)
 
     def _batch_shardings(self, batch):
         """Shard batch elements on dim 0 where the axis divides it;
@@ -144,46 +155,63 @@ class ZeroTrainStep:
         return tuple(_leaf_sharding(b, self.mesh, self.axis, n)
                      for b in batch)
 
-    def _jitted(self, batch_shs, args=None):
-        # the GSPMD window program is registered in the runtime
-        # step-program cache (kind "zero_train_step"), so cache stats pin
-        # compiles/dispatches per window exactly as on the plain fused
-        # path — under accum_steps=K the one dispatch carries the
-        # boundary-only reduce-scatter / all-gather pair GSPMD derives
-        # for the window.  ``args=None`` is the diagnostic surface (tests
-        # lower the returned callable themselves) and skips the counters.
+    def _program(self, batch_shs):
+        """The GSPMD window :class:`~apex_tpu.runtime.executor.Program`
+        for one batch-sharding signature (memoized: the executor's
+        per-Program jit memo makes diagnostics and dispatch share one
+        jitted callable) — registered in the runtime step-program cache
+        under kind "zero_train_step", so cache stats pin
+        compiles/dispatches per window exactly as on the plain fused
+        path.  Under accum_steps=K the one dispatch carries the
+        boundary-only reduce-scatter / all-gather pair GSPMD derives for
+        the window."""
+        from ..runtime import executor as _executor
         from ..runtime import step_cache as _step_cache
 
-        def build():
-            f = self._jits.get(batch_shs)
-            if f is None:
-                f = jax.jit(
-                    self._base._raw_step_fn,
-                    in_shardings=(self.shardings,) + batch_shs,
-                    out_shardings=(self.shardings, self._rep),
-                    donate_argnums=(0,) if self._donate else ())
-                self._jits[batch_shs] = f
-            return f
+        prog = self._jits.get(batch_shs)
+        if prog is None:
+            prog = _executor.Program(
+                "zero_train_step",
+                (self._token, batch_shs,
+                 _step_cache.static_plan_key(self.plan)),
+                self._base._raw_step_fn,
+                donate_argnums=(0,) if self._donate else (),
+                in_shardings=(self.shardings,) + batch_shs,
+                out_shardings=(self.shardings, self._rep))
+            self._jits[batch_shs] = prog
+        return prog
 
-        if args is None:
-            return build()
-        fn = _step_cache.step_cache.program(
-            "zero_train_step",
-            (self._token, batch_shs, _step_cache.static_plan_key(self.plan)),
-            args, build)
-        _step_cache.step_cache._bump("dispatches", "zero_train_step")
-        return fn
+    def _jitted(self, batch_shs):
+        """Diagnostic surface: the jitted callable for one batch-sharding
+        signature, built without counting a compile or dispatch (tests
+        ``.lower()`` the result to inspect collectives / aliasing)."""
+        from ..runtime import executor as _executor
+        return _executor.executor.jit(self._program(batch_shs))
 
     def __call__(self, *batch):
         import time
+        from ..runtime import executor as _executor
         t0 = time.perf_counter() if self.compile_s is None else None
         shs = self._batch_shardings(batch)
         batch = tuple(jax.device_put(b, s) for b, s in zip(batch, shs))
         args = (self.state,) + batch
-        self.state, loss = self._jitted(shs, args)(*args)
+        self.calls += 1
+        self.state, loss = _executor.executor.submit(
+            self._program(shs), args, step=self.calls)
         if t0 is not None:
             self.compile_s = time.perf_counter() - t0
+        if self._guard is not None:
+            self._guard.observe(self.state.scaler.overflow)
+        if self._telemetry and self._drain_every \
+                and self.calls % self._drain_every == 0:
+            self.drain_telemetry()
         return loss
+
+    def drain_telemetry(self):
+        """Host-sync the on-device telemetry accumulator (see
+        :func:`apex_tpu.runtime.executor.drain_telemetry`)."""
+        from ..runtime import executor as _executor
+        return _executor.drain_telemetry(self)
 
     def sync_to_objects(self):
         """Write the (sharded) device state back into the model objects —

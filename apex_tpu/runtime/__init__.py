@@ -15,9 +15,12 @@ Public surface:
   f32_to_bf16(x)                            — bulk host cast (RNE)
   available()                               — True when the native lib loads
   DataPrefetcher                            — apex_tpu.runtime.data
-  step_cache                                — compiled step-program cache for
-                                              the eager optimizer surface
+  step_cache                                — compiled step-program cache
                                               (apex_tpu.runtime.step_cache)
+  executor                                  — the one dispatch choke point:
+                                              Program descriptors, donation
+                                              policy, overlap knobs
+                                              (apex_tpu.runtime.executor)
   resilience                                — atomic/async CheckpointManager,
                                               auto-resume, BadStepGuard
                                               (apex_tpu.runtime.resilience)
@@ -219,6 +222,9 @@ def f32_to_bf16(x, threads: int = 0):
 
 from .data import DataPrefetcher  # noqa: E402,F401
 from . import step_cache  # noqa: E402,F401
+from . import executor  # noqa: E402,F401
+from .executor import (  # noqa: E402,F401
+    Executor, Program, set_overlap, overlap_enabled)
 from . import chaos  # noqa: E402,F401
 from . import resilience  # noqa: E402,F401
 from .resilience import (  # noqa: E402,F401
@@ -230,7 +236,9 @@ from .elastic import (  # noqa: E402,F401
 
 __all__ = ["flatten", "unflatten", "normalize_u8_nhwc_to_f32_nchw",
            "normalize_u8_nhwc_to_f32_nhwc", "f32_to_bf16", "available",
-           "DataPrefetcher", "step_cache", "chaos", "resilience",
+           "DataPrefetcher", "step_cache", "executor", "Executor",
+           "Program", "set_overlap", "overlap_enabled", "chaos",
+           "resilience",
            "CheckpointManager", "CheckpointCorruptError", "SaveHandle",
            "BadStepGuard", "TrainingDivergedError", "elastic",
            "CheckpointReshardError", "ElasticTrainer", "elastic_restore",

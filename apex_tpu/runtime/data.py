@@ -38,6 +38,11 @@ class DataPrefetcher:
     exactly as with single batches.  A trailing partial window (loader
     exhausted mid-block) is dropped, like a ``drop_last`` loader — the
     step program's (K, B, ...) signature is static.
+
+    ``depth`` is the double-buffering knob: ``Executor.drive`` picks 2
+    (next window's transfer in flight under the current dispatch) or 1
+    (serialized, the overlap-off arm) from the executor's ``h2d``
+    overlap setting — see ``runtime/executor.py``.
     """
 
     def __init__(self, loader, mean=None, std=None, half_dtype=None,

@@ -18,9 +18,10 @@ composes the two subsystems the repo already owns —
    8-virtual-CPU-device mesh deterministically);
 2. re-plan for it (``parallel.auto.plan_training`` — the same
    analytical cost model behind ``parallel="auto"``);
-3. rebuild the step through ``make_train_step(parallel=plan)``, so the
-   step-program cache keys (which carry ``static_plan_key``) distinguish
-   the new plan from the old one's programs;
+3. rebuild the step through ``make_train_step(parallel=plan)`` — the
+   rebuilt step re-submits through ``runtime.executor`` under a new
+   ``static_plan_key``, so the executor's cache distinguishes the new
+   plan from the old one's programs (both stay warm across regrows);
 4. reshard the newest valid checkpoint into the new layout
    (:meth:`~apex_tpu.runtime.resilience.CheckpointManager.
    restore_resharded` — fp32 masters bit-exact) and resume.

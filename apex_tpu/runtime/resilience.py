@@ -744,6 +744,11 @@ class BadStepGuard:
     ``max_pending`` (default ``4 * patience``) — i.e. only under storms,
     where a sync is the least of the run's problems.
 
+    Both surfaces dispatch through ``runtime.executor`` now, so the
+    guard is step-kind agnostic: the skip flag it observes rides the
+    same carry whether the program is the fused ``train_step``, the
+    GSPMD ``zero_train_step``, or an eager optimizer program.
+
     Fused path::
 
         guard = BadStepGuard(patience=8, policy=("warn", "rollback",

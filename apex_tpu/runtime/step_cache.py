@@ -38,12 +38,8 @@ from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from ..observe import registry as _obs
-from ..observe import spans as _spans
-
-_f32 = jnp.float32
 
 #: opt-in ``span("dispatch")`` around every eager step-cache dispatch.
 #: Off by default: the eager optimizer hot path is microbenchmarked
@@ -178,27 +174,22 @@ class StepCache:
 #: process-global cache shared by every optimizer / amp hook
 step_cache = StepCache()
 
-#: buffer-donation policy: "auto" donates on backends with real input→output
-#: buffer aliasing (tpu/gpu) and skips donation on cpu, where XLA accepts
-#: donate_argnums but degrades it to defensive copies (measured 2× eager
-#: FusedAdam step time at 10M params).  Tests force True to inspect the
-#: aliasing in lowered HLO; the flag is part of every program cache key.
-_DONATE = "auto"
-
 
 def set_donation(mode):
-    """Set the donation policy: True, False, or "auto" (default)."""
-    global _DONATE
-    if mode not in (True, False, "auto"):
-        raise ValueError(f"donation mode must be True/False/'auto', "
-                         f"got {mode!r}")
-    _DONATE = mode
+    """Set the donation policy: True, False, or "auto" (default).
+
+    Delegate onto :data:`apex_tpu.runtime.executor.donation` — the one
+    :class:`~apex_tpu.runtime.executor.DonationPolicy` every surface
+    shares (the policy used to be re-derived here, in training/step.py
+    and in the amp handle).  Kept under the historical name.
+    """
+    from . import executor
+    executor.donation.set(mode)
 
 
 def donation_enabled() -> bool:
-    if _DONATE == "auto":
-        return jax.default_backend() not in ("cpu",)
-    return bool(_DONATE)
+    from . import executor
+    return executor.donation.enabled
 
 
 def stats() -> dict:
@@ -231,151 +222,9 @@ def static_plan_key(plan):
     return tuple(plan.key())
 
 
-def _dispatch(fn, args, kind):
-    """Count (and, when enabled, span-wrap) one program dispatch."""
-    step_cache._bump("dispatches", kind)
-    if _DISPATCH_SPANS:
-        with _spans.span("dispatch", kind=kind):
-            return fn(*args)
-    return fn(*args)
-
-
-# ---------------------------------------------------------------------------
-# Whole-optimizer step programs
-# ---------------------------------------------------------------------------
-#
-# ``update(static_cfg, donated, grads, hyper, flag) -> new_donated`` is a
-# module-level pure function supplied by each optimizer; ``donated`` holds
-# params + optimizer state (+ fp16 model copies under amp O2), ``grads`` the
-# consumed gradients, ``hyper`` the traced scalar hyperparameters.  The
-# whole update sits inside ``lax.cond`` on the overflow flag, so a flagged
-# step leaves every buffer untouched without leaving the executable.
-
-
-def optimizer_step(kind: str, static_cfg, update, flag, donated, grads,
-                   hyper):
-    """Dispatch one optimizer step as a single cached XLA executable.
-
-    Donates ``donated`` (params + optimizer state): the caller must rebind
-    every returned leaf and drop references to the inputs.
-
-    No ``lax.cond`` here: on this path the overflow flag is reference-exact
-    semantics — the Adam/LAMB/NovoGrad kernels deliberately ignore it
-    (multi_tensor_adam.cu:40-41) and the SGD op gates on it internally —
-    and an XLA conditional would copy the whole donated tree at the branch
-    boundary every step.  The fused amp path
-    (:func:`optimizer_step_with_scaler`), where a skip can actually occur,
-    is the one that wraps the update in ``lax.cond``.
-    """
-
-    donate = donation_enabled()
-
-    def build():
-        def run(flag, donated, grads, hyper):
-            return update(static_cfg, donated, grads, hyper, flag)
-        return jax.jit(run, donate_argnums=(1,) if donate else ())
-
-    args = (flag, donated, grads, hyper)
-    fn = step_cache.program(kind, (static_cfg, donate), args, build)
-    return _dispatch(fn, args, kind)
-
-
-def optimizer_step_with_scaler(kind: str, static_cfg, update, scaler_state,
-                               scaler_cfg, donated, grads, hyper):
-    """The fully-fused amp step: overflow-conditional optimizer update AND
-    dynamic-loss-scale update in one executable, with the scaler state
-    donated alongside params/optimizer state.  Zero host round-trips: the
-    skip decision is ``lax.cond`` on the scaler's on-device overflow flag.
-
-    ``scaler_cfg``: hashable kwargs tuple for
-    :func:`apex_tpu.amp.scaler.update_scale_state`.
-    Returns ``(new_scaler_state, new_donated)``.
-    """
-    from ..amp.scaler import update_scale_state
-
-    donate = donation_enabled()
-
-    def build():
-        kw = dict(scaler_cfg)
-
-        def run(sstate, donated, grads, hyper):
-            flag = sstate.overflow
-            new_d = lax.cond(
-                flag > 0, lambda d: d,
-                lambda d: update(static_cfg, d, grads, hyper,
-                                 jnp.zeros((), jnp.int32)), donated)
-            new_s, _ = update_scale_state(sstate, **kw)
-            return new_s, new_d
-        return jax.jit(run, donate_argnums=(0, 1) if donate else ())
-
-    args = (scaler_state, donated, grads, hyper)
-    fn = step_cache.program(kind, (static_cfg, scaler_cfg, donate), args,
-                            build)
-    return _dispatch(fn, args, kind)
-
-
-# ---------------------------------------------------------------------------
-# amp programs: unscale / grad-accumulate / master→model copy
-# ---------------------------------------------------------------------------
-
-
-def unscale(flag, model_grads, out_dtypes, inv_scale,
-            check_overflow: bool = True):
-    """Whole-step grad unscale + overflow check as one executable
-    (``master = model_grad * inv_scale``, flag set on non-finite inputs).
-    Returns ``(new_flag, master_grads)``.
-    """
-    out_names = tuple(jnp.dtype(d).name for d in out_dtypes)
-    grads = list(model_grads)
-
-    def build():
-        from .. import ops
-
-        def run(flag, grads, inv):
-            outs = [jnp.zeros(g.shape, d) for g, d in zip(grads, out_names)]
-            new_flag, new = ops.multi_tensor_scale(
-                flag, [list(grads), outs], inv)
-            return (new_flag if check_overflow else flag), new
-        return jax.jit(run)
-
-    args = (flag, grads, jnp.asarray(inv_scale, _f32))
-    fn = step_cache.program("amp_unscale", (out_names, bool(check_overflow)),
-                            args, build)
-    return _dispatch(fn, args, "amp_unscale")
-
-
-def unscale_with_stashed(flag, model_grads, stashed_grads, a, b):
-    """Fused ``out = a*model + b*stashed`` accumulation (one executable),
-    flagging non-finite model grads.  Returns ``(new_flag, master_grads)``.
-    """
-    model = list(model_grads)
-    stashed = list(stashed_grads)
-
-    def build():
-        from .. import ops
-
-        def run(flag, model, stashed, a, b):
-            outs = [jnp.zeros(s.shape, s.dtype) for s in stashed]
-            return ops.multi_tensor_axpby(
-                flag, [list(model), list(stashed), outs], a, b, 0)
-        return jax.jit(run)
-
-    args = (flag, model, stashed, jnp.asarray(a, _f32), jnp.asarray(b, _f32))
-    fn = step_cache.program("amp_axpby", (), args, build)
-    return _dispatch(fn, args, "amp_axpby")
-
-
-def master_to_model(masters, model_vals):
-    """fp32 master → half model copy as one executable, donating the stale
-    model buffers (each output aliases the old copy it replaces)."""
-
-    donate = donation_enabled()
-
-    def build():
-        def run(masters, old):
-            return [m.astype(o.dtype) for m, o in zip(masters, old)]
-        return jax.jit(run, donate_argnums=(1,) if donate else ())
-
-    args = (list(masters), list(model_vals))
-    fn = step_cache.program("amp_master_to_model", (donate,), args, build)
-    return _dispatch(fn, args, "amp_master_to_model")
+# The whole-optimizer / amp step programs that used to live here
+# (optimizer_step, optimizer_step_with_scaler, unscale,
+# unscale_with_stashed, master_to_model) moved to
+# ``apex_tpu.runtime.executor`` — the one dispatch choke point both the
+# eager and the fused surface now submit Program descriptors to.  This
+# module keeps only the cache itself and its stats surface.

@@ -21,6 +21,7 @@ runs against the old D.
 """
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Callable, NamedTuple, Optional
 
@@ -31,6 +32,11 @@ from ..nn.modules import Ctx
 from .step import (StepState, apply_fused_update, build_opt_update,
                    init_step_state, match_param_groups, model_vals_of,
                    _model_dtypes)
+
+
+#: per-builder token in the executor program key (two GAN steps with
+#: identical signatures close over different nets/losses)
+_GAN_TOKENS = itertools.count()
 
 
 class GanStepState(NamedTuple):
@@ -95,12 +101,11 @@ def make_gan_train_step(netD, netG, optD, optG,
     (errD, errG))``.  ``lr_schedule`` applies to both optimizers from
     each network's own step counter (as in make_train_step).
     """
-    if donate_state == "auto":
-        # the step cache's donation policy: donate on tpu/gpu, skip on
-        # cpu (defensive copies + the jax-0.4.x cached-executable
-        # aliasing hazard — see make_train_step's donate_state doc)
-        from ..runtime.step_cache import donation_enabled
-        donate_state = donation_enabled()
+    from ..runtime import executor as _executor
+    # the executor's donation policy: donate on tpu/gpu, skip on cpu
+    # (defensive copies + the jax-0.4.x cached-executable aliasing
+    # hazard — see make_train_step's donate_state doc)
+    donate_state = _executor.donation.resolve(donate_state)
     d_parts = _net_parts(netD, optD, half_dtype, keep_batchnorm_fp32,
                          "make_gan_train_step(netD)")
     g_parts = _net_parts(netG, optG, half_dtype, keep_batchnorm_fp32,
@@ -199,7 +204,18 @@ def make_gan_train_step(netD, netG, optD, optG,
         g=init_step_state(g_params, g_buffers, g_dtypes, g_opt_init,
                           init_scale))
 
-    jit_step = jax.jit(step_fn, donate_argnums=(0,) if donate_state else ())
+    # the GAN iteration dispatches through the runtime executor like
+    # every other step kind: cached compile, dispatch span + counters,
+    # watchdog heartbeats
+    program = _executor.Program(
+        "gan_train_step", (next(_GAN_TOKENS), bool(donate_state)), step_fn,
+        donate_argnums=(0,) if donate_state else ())
+    dispatch_no = itertools.count(1)
+
+    def jit_step(state, real, z):
+        return _executor.executor.submit(
+            program, (state, real, z), step=next(dispatch_no))
+
     return GanTrainStep(netD, netG, optD, optG, jit_step,
                         (d_params, d_buffers), (g_params, g_buffers),
                         init_state)

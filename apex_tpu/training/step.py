@@ -25,7 +25,6 @@ from ..amp.scaler import ScalerState, update_scale_state
 from ..compat import axis_size as _axis_size
 from ..nn.modules import Ctx
 from ..nn.parameter import Parameter
-from ..observe import registry as _obs_registry
 from ..observe import spans as _obs_spans
 from ..observe import telemetry as _obs_telemetry
 from ..observe import watchdog as _obs_watchdog
@@ -79,21 +78,32 @@ class TrainStep:
         self._telemetry = False
         #: windows between host drains of the on-device accumulator
         self._drain_every = 1
+        #: True when _step_fn submits through runtime.executor, which
+        #: then owns the dispatch span + watchdog heartbeat; False for
+        #: steps dispatched by other wrappers (pipeline, manual
+        #: shard_map), where this facade emits them itself
+        self._via_executor = False
 
     def __call__(self, *batch):
         from ..runtime import chaos as _chaos
         if _chaos.active():
             batch = _chaos_taint(self, batch)
         t0 = time.perf_counter() if self.compile_s is None else None
-        with _obs_spans.span("dispatch"):
+        if self._via_executor:
             self.state, loss = self._step_fn(self.state, *batch)
+        else:
+            with _obs_spans.span("dispatch"):
+                self.state, loss = self._step_fn(self.state, *batch)
         if t0 is not None:
             self.compile_s = time.perf_counter() - t0
         self.calls += 1
-        # dispatch returned == the host made forward progress (execution is
-        # async; a heartbeat after enqueue is exactly the liveness signal
-        # the stall watchdog wants — a wedged backend blocks the dispatch)
-        _obs_watchdog.heartbeat(step=self.calls)
+        if not self._via_executor:
+            # dispatch returned == the host made forward progress
+            # (execution is async; a heartbeat after enqueue is exactly
+            # the liveness signal the stall watchdog wants — a wedged
+            # backend blocks the dispatch).  The executor path emits
+            # this itself at submit time.
+            _obs_watchdog.heartbeat(step=self.calls)
         if self._guard is not None:
             # the on-device skip flag apply_fused_update carried out in
             # scaler.overflow — handing the array over costs nothing; the
@@ -106,36 +116,16 @@ class TrainStep:
     def drain_telemetry(self):
         """Host-sync the on-device telemetry accumulator and reset it.
 
-        This is the ONE deliberate host round-trip of the telemetry path,
-        and it lives here — eager code outside jit — so the HOST-SYNC
-        invariant holds and the compiled window program stays
-        1 compile + 1 dispatch.  Emits a ``train.telemetry`` event and
-        returns the record (None when telemetry is off or no window has
-        completed since the last drain).
+        The drain lives in :func:`apex_tpu.runtime.executor.
+        drain_telemetry` — the carry-drain shared by every step kind —
+        and stays eager code outside jit, so the HOST-SYNC invariant
+        holds and the compiled window program stays 1 compile +
+        1 dispatch.  Emits a ``train.telemetry`` event and returns the
+        record (None when telemetry is off or no window has completed
+        since the last drain).
         """
-        telem = self.state.telem
-        if telem is None:
-            return None
-        host = jax.device_get(telem)
-        windows = int(host.windows)
-        if windows == 0:
-            return None
-        rec = _obs_registry.event(
-            "train.telemetry",
-            step=self.calls,
-            windows=windows,
-            loss_mean=float(host.loss_sum) / windows,
-            grad_norm=float(host.grad_norm),
-            loss_scale=float(host.loss_scale),
-            overflow_count=int(host.overflow_count))
-        _obs_registry.gauge("train.loss").set(rec["loss_mean"])
-        _obs_registry.gauge("train.grad_norm").set(rec["grad_norm"])
-        _obs_registry.gauge("train.loss_scale").set(rec["loss_scale"])
-        _obs_registry.counter("train.overflow_windows").inc(
-            rec["overflow_count"])
-        self.state = self.state._replace(
-            telem=_obs_telemetry.init_telemetry())
-        return rec
+        from ..runtime import executor as _executor
+        return _executor.drain_telemetry(self)
 
     @property
     def last_step_skipped(self):
@@ -247,7 +237,7 @@ def _model_dtypes(model, params, half_dtype, keep_batchnorm_fp32):
 def apply_fused_update(sub: StepState, grads, opt_update, model_dtypes, *,
                        dynamic, init_scale, scale_window,
                        min_loss_scale, max_loss_scale, lr_schedule=None,
-                       loss=None):
+                       loss=None, telem_axes=()):
     """The post-gradient half of a fused step: unscale into fp32 master
     grads + overflow flag, fused optimizer update, skip-on-overflow
     (lax.select keeps it fused), model-dtype re-cast, loss-scale update.
@@ -310,7 +300,7 @@ def apply_fused_update(sub: StepState, grads, opt_update, model_dtypes, *,
         # TrainStep.drain_telemetry from eager code
         telem = _obs_telemetry.accumulate(
             telem, loss=loss, master_grads=master_grads, flag=flag,
-            loss_scale=new_scaler.loss_scale)
+            loss_scale=new_scaler.loss_scale, mean_axes=telem_axes)
     return StepState(masters, model_params, slots, new_scaler, sub.stats,
                      step_count, telem)
 
@@ -605,7 +595,7 @@ def apply_fused_update_flat(sub: StepState, grads, meta: FlatMeta,
                             opt_update, model_dtypes, *,
                             dynamic, init_scale, scale_window,
                             min_loss_scale, max_loss_scale,
-                            lr_schedule=None, loss=None):
+                            lr_schedule=None, loss=None, telem_axes=()):
     """Stacked twin of :func:`apply_fused_update`: per-tensor grads
     stack once per shape bucket (layout-preserving leading-axis
     concat), then unscale/overflow, update, and the skip select each
@@ -649,7 +639,7 @@ def apply_fused_update_flat(sub: StepState, grads, meta: FlatMeta,
         # sum-of-squares over buckets IS the global norm
         telem = _obs_telemetry.accumulate(
             telem, loss=loss, master_grads=flat_grads, flag=flag,
-            loss_scale=new_scaler.loss_scale)
+            loss_scale=new_scaler.loss_scale, mean_axes=telem_axes)
     return StepState(masters, flat_model_params(meta, masters, model_dtypes),
                      slots, new_scaler, sub.stats, step_count, telem)
 
@@ -736,7 +726,12 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                     plan_options=None,
                     telemetry: bool = False,
                     drain_every: int = 1,
-                    _plan=None):
+                    overlap="auto",
+                    _plan=None,
+                    _gather_prefetch_mesh=None,
+                    _gather_prefetch_axis="data",
+                    _gather_prefetch_sharded=True,
+                    _gather_prefetch_on=False):
     """Build a fully-fused O2-style train step.
 
     ``loss_fn(outputs..., *batch_tail) -> scalar``: called with the model
@@ -852,32 +847,36 @@ def make_train_step(model, optimizer, loss_fn: Callable,
     ``TrainStep.drain_telemetry`` every ``drain_every`` windows from
     eager code.  The window program stays 1 compile + 1 dispatch; the
     drain is the one (amortized) host sync.  See ``docs/observability.md``.
-    Single-program path only — under ``axis_name``/``tp_axis``/
-    ``zero_sharding``/``parallel=`` the carry crosses shard_map/GSPMD
-    wrappers that own the state layout, so telemetry there refuses
-    rather than silently changing sharding.
+    Works on every kind: under ``axis_name``/``tp_axis`` the accumulator
+    pmeans the per-shard loss over the batch axes inside the step (the
+    exchanged gradients are already replicated, so the grad norm needs
+    no extra collective); under ``zero_sharding`` the global-view
+    program carries the scalars replicated; ``parallel=`` threads it
+    through whichever kind the plan picks.
 
-    ``donate_state``: "auto" (default) follows the step cache's donation
-    policy — donate on tpu/gpu (in-place buffer reuse), skip on cpu,
-    where XLA degrades donation to defensive copies (measured 2x step
-    time, and jax 0.4.x's persistently-cached CPU executables resolve
-    the input→output aliasing of deserialized donated programs
-    incorrectly — stale outputs on cache hits).  Pass True/False to
-    force.
+    ``overlap``: True/False/"auto" — ZeRO all-gather prefetch inside the
+    scanned accumulation window (the replicated parameter view for
+    microbatch i+1 is issued under microbatch i's compute, the
+    weight-update-sharding overlap of arXiv:2004.13336).  "auto" defers
+    to :func:`apex_tpu.runtime.executor.overlap_enabled` — on for
+    backends with async collectives, off on cpu, where XLA runs
+    collectives synchronously (forcing it on there is bitwise-identical,
+    just not faster; the parity tests do exactly that).  Only meaningful
+    with ``zero_sharding`` (stage 1/3) and ``accum_steps > 1``.
+
+    ``donate_state``: "auto" (default) follows the executor's
+    :class:`~apex_tpu.runtime.executor.DonationPolicy` — donate on
+    tpu/gpu (in-place buffer reuse), skip on cpu, where XLA degrades
+    donation to defensive copies (measured 2x step time, and jax 0.4.x's
+    persistently-cached CPU executables resolve the input→output
+    aliasing of deserialized donated programs incorrectly — stale
+    outputs on cache hits).  Pass True/False to force.
     """
-    if donate_state == "auto":
-        from ..runtime.step_cache import donation_enabled
-        donate_state = donation_enabled()
-    if telemetry:
-        if drain_every < 1:
-            raise ValueError(f"drain_every must be >= 1, got {drain_every}")
-        if (axis_name is not None or tp_axis is not None or zero_sharding
-                or parallel is not None):
-            raise ValueError(
-                "telemetry=True is supported on the single-program step "
-                "only — under axis_name/tp_axis/zero_sharding/parallel= "
-                "the state carry is owned by the shard_map/GSPMD wrapper; "
-                "drop telemetry= or the parallelism knobs")
+    from ..runtime import executor as _executor
+
+    donate_state = _executor.donation.resolve(donate_state)
+    if telemetry and drain_every < 1:
+        raise ValueError(f"drain_every must be >= 1, got {drain_every}")
     if parallel is not None:
         if axis_name is not None or tp_axis is not None or zero_sharding:
             raise ValueError(
@@ -904,7 +903,9 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             allreduce_always_fp32=allreduce_always_fp32,
             donate_state=donate_state, accum_stacked=accum_stacked,
             lr_schedule=lr_schedule, rng_seed=rng_seed,
-            zero_axis=zero_axis, flat_master=flat_master)
+            zero_axis=zero_axis, flat_master=flat_master,
+            telemetry=telemetry, drain_every=drain_every,
+            overlap=overlap)
     if accum_steps is not None:
         if grad_accum_steps not in (1, accum_steps):
             raise ValueError(
@@ -937,6 +938,22 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 "program (no shard_map/psum); TP's explicit mesh axes "
                 "belong to the shard_map path")
         from ..parallel.zero import ZeroTrainStep
+        if zero_mesh is None:
+            zero_mesh = _default_zero_mesh(zero_axis)
+        elif zero_axis not in zero_mesh.shape:
+            raise ValueError(
+                f"zero_axis {zero_axis!r} is not an axis of zero_mesh "
+                f"(axes: {tuple(zero_mesh.shape)})")
+        # ZeRO all-gather prefetch: resolved here (the one place that
+        # knows mesh + stage + K) and threaded into the recursive base
+        # build.  The base always gathers the replicated parameter view
+        # explicitly per microbatch; the executor's overlap knob only
+        # moves where the gather is issued (inline at use vs pipelined
+        # one iteration early through the scan carry), so overlap on/off
+        # is bitwise-identical.  Stage 0 keeps everything replicated —
+        # there is no gather to prefetch.
+        prefetch_mesh = zero_mesh if (
+            zero_stage in (1, 3) and grad_accum_steps > 1) else None
         base = make_train_step(
             model, optimizer, loss_fn, half_dtype=half_dtype,
             keep_batchnorm_fp32=keep_batchnorm_fp32,
@@ -946,13 +963,17 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             donate_state=False,
             grad_accum_steps=grad_accum_steps, accum_stacked=accum_stacked,
             lr_schedule=lr_schedule,
-            rng_seed=rng_seed)
-        if zero_mesh is None:
-            zero_mesh = _default_zero_mesh(zero_axis)
-        elif zero_axis not in zero_mesh.shape:
-            raise ValueError(
-                f"zero_axis {zero_axis!r} is not an axis of zero_mesh "
-                f"(axes: {tuple(zero_mesh.shape)})")
+            rng_seed=rng_seed,
+            telemetry=telemetry, drain_every=drain_every,
+            _gather_prefetch_mesh=prefetch_mesh,
+            _gather_prefetch_axis=zero_axis,
+            # the model-consumed values travel sharded when they ARE the
+            # sharded buffers (stage 3 copies, or the masters themselves
+            # when half_dtype is None); stage-1 half copies replicate
+            _gather_prefetch_sharded=(zero_stage == 3
+                                      or half_dtype is None),
+            _gather_prefetch_on=_executor.overlap_enabled("gather",
+                                                          overlap))
         return ZeroTrainStep(base, zero_mesh, zero_axis,
                              donate=donate_state,
                              stage=zero_stage, plan=_plan)
@@ -994,10 +1015,62 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 "block-sparse")
         tp_ids = frozenset(id(p) for p in getter())
 
+    # telemetry loss reduction: under shard_map the per-device loss is
+    # the local shard mean, so the accumulator pmeans it over the batch
+    # axes (the exchanged gradients are already replicated across every
+    # axis — the grad norm needs no extra collective)
+    telem_axes = ()
+    if telemetry and axis_name is not None:
+        telem_axes = (tuple(axis_name)
+                      if isinstance(axis_name, (tuple, list))
+                      else (axis_name,))
+
     def step_fn(state: StepState, *batch):
         model_vals = (flat_param_values(flat_meta, state.master_params,
                                         state.model_params, model_dtypes)
                       if flat_master else model_vals_of(state))
+
+        prefetch = None
+        prefetch_on = False
+        if _gather_prefetch_mesh is not None and grad_accum_steps > 1:
+            # ZeRO gather prefetch (executor overlap knob): the scanned
+            # window consumes an EXPLICIT replicated view of the
+            # (sharded) parameters each microbatch.  With the knob off
+            # the gather is issued inline at the point of use; with it
+            # on the view travels in the scan carry, gathered one
+            # iteration EARLIER — the all-gather overlaps compute
+            # instead of stalling the forward.  Both arms compile the
+            # same math DAG (gather → forward → backward →
+            # reduce-scattered grads); only the issue slot moves, so
+            # overlap on/off is bitwise-identical — the parity the
+            # executor tests pin by forcing the knob on under cpu.
+            rep = jax.sharding.NamedSharding(
+                _gather_prefetch_mesh, jax.sharding.PartitionSpec())
+            _n_ax = _gather_prefetch_mesh.shape[_gather_prefetch_axis]
+            _shd = jax.sharding.NamedSharding(
+                _gather_prefetch_mesh,
+                jax.sharding.PartitionSpec(_gather_prefetch_axis))
+            prefetch_on = bool(_gather_prefetch_on)
+
+            def prefetch(vals):
+                return [jax.lax.with_sharding_constraint(v, rep)
+                        for v in vals]
+
+            def reshard_grads(grads):
+                # pin each microbatch gradient back to the consumed
+                # buffer's OWN zero sharding (dim-0 where divisible, the
+                # zero_state_sharding rule): the backward of the gathered
+                # view stays a reduce-scatter into a sharded
+                # accumulator, not an all-reduce into a replicated one —
+                # deterministic reduction order on both arms and no
+                # full-gradient replica (the ZeRO memory win)
+                if not _gather_prefetch_sharded:
+                    return grads
+                return [jax.lax.with_sharding_constraint(
+                            g, _shd if (getattr(g, "ndim", 0) >= 1
+                                        and g.shape[0] % _n_ax == 0)
+                            else rep)
+                        for g in grads]
 
         def forward(model_vals_in, stats_in, mb_idx, *b):
             env = {id(p): v for p, v in zip(params, model_vals_in)}
@@ -1099,24 +1172,51 @@ def make_train_step(model, optimizer, loss_fn: Callable,
             micro = tuple(split(b) for b, s in zip(batch, splits) if s)
 
             def micro_step(carry, mb):
-                acc, stats_in, loss_sum, i = carry
+                if prefetch is not None and prefetch_on:
+                    acc, stats_in, loss_sum, i, vals = carry
+                elif prefetch is not None:
+                    # overlap off: same explicit gather, issued inline
+                    # at the point of use — stalls the forward, but the
+                    # math DAG is identical to the pipelined arm
+                    acc, stats_in, loss_sum, i = carry
+                    vals = prefetch(model_vals)
+                else:
+                    acc, stats_in, loss_sum, i = carry
+                    vals = model_vals
                 mb_it = iter(mb)
                 full = tuple(next(mb_it) if s else b
                              for b, s in zip(batch, splits))
                 (_, (l, ns)), g = jax.value_and_grad(
-                    forward, has_aux=True)(model_vals, stats_in, i, *full)
+                    forward, has_aux=True)(vals, stats_in, i, *full)
+                if prefetch is not None:
+                    g = reshard_grads(g)
+                if prefetch is not None and prefetch_on:
+                    # issue the gather for microbatch i+1's view NOW,
+                    # pinned after this microbatch's grads by the
+                    # barrier (no CSE with the view just consumed, no
+                    # hoist out of the scan) — the async collective
+                    # overlaps the accumulate below and the next
+                    # iteration's early compute
+                    next_vals, g = jax.lax.optimization_barrier(
+                        (prefetch(model_vals), g))
                 acc = [a + gi.astype(jnp.float32)
                        for a, gi in zip(acc, g)]
-                return (acc, ns, loss_sum + l.astype(jnp.float32),
-                        i + 1), None
+                out = (acc, ns, loss_sum + l.astype(jnp.float32), i + 1)
+                if prefetch is not None and prefetch_on:
+                    out = out + (next_vals,)
+                return out, None
 
             carry0 = ([jnp.zeros(v.shape, jnp.float32)
                        for v in model_vals],
                       list(state.stats),
                       jnp.zeros((), jnp.float32),
                       jnp.zeros((), jnp.int32))
-            (acc, new_stats, loss_sum, _), _ = jax.lax.scan(
-                micro_step, carry0, micro)
+            if prefetch is not None and prefetch_on:
+                # prologue gather: microbatch 0's view rides in the
+                # initial carry
+                carry0 = carry0 + (prefetch(model_vals),)
+            final_carry, _ = jax.lax.scan(micro_step, carry0, micro)
+            acc, new_stats, loss_sum = final_carry[:3]
             grads = [a / grad_accum_steps for a in acc]
             loss = loss_sum / grad_accum_steps
 
@@ -1148,7 +1248,7 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 dynamic=dynamic, init_scale=init_scale,
                 scale_window=scale_window, min_loss_scale=min_loss_scale,
                 max_loss_scale=max_loss_scale, lr_schedule=lr_schedule,
-                loss=loss)
+                loss=loss, telem_axes=telem_axes)
         else:
             new_state = apply_fused_update(
                 state._replace(stats=new_stats), grads, opt_update,
@@ -1156,7 +1256,7 @@ def make_train_step(model, optimizer, loss_fn: Callable,
                 dynamic=dynamic, init_scale=init_scale,
                 scale_window=scale_window, min_loss_scale=min_loss_scale,
                 max_loss_scale=max_loss_scale, lr_schedule=lr_schedule,
-                loss=loss)
+                loss=loss, telem_axes=telem_axes)
         return new_state, loss
 
     if flat_master:
@@ -1170,15 +1270,16 @@ def make_train_step(model, optimizer, loss_fn: Callable,
         init_state = init_state._replace(
             telem=_obs_telemetry.init_telemetry())
 
-    if axis_name is None and tp_axis is None:
-        # route through the runtime's step-program cache: the compiled
-        # window program is keyed on (per-builder token, K, stacking,
-        # donation) plus the argument signature, so step_cache.stats()
-        # pins exactly 1 compile and 1 dispatch per accumulation window —
-        # K is part of the STATIC key (a K=4 and a K=16 window are
-        # different executables), and the donated state means the scan's
-        # fp32 gradient accumulator and the carried masters/slots update
-        # in place across windows
+    via_executor = axis_name is None and tp_axis is None
+    if via_executor:
+        # submit through the runtime executor (which compiles via the
+        # step-program cache): the compiled window program is keyed on
+        # (per-builder token, K, stacking, donation) plus the argument
+        # signature, so step_cache.stats() pins exactly 1 compile and
+        # 1 dispatch per accumulation window — K is part of the STATIC
+        # key (a K=4 and a K=16 window are different executables), and
+        # the donated state means the scan's fp32 gradient accumulator
+        # and the carried masters/slots update in place across windows
         from ..runtime import step_cache as _step_cache
 
         token = next(_STEP_TOKENS)
@@ -1187,22 +1288,20 @@ def make_train_step(model, optimizer, loss_fn: Callable,
         static_key = (token, grad_accum_steps, accum_stacked,
                       bool(donate_state), bool(telemetry),
                       _step_cache.static_plan_key(_plan))
-
-        def _build():
-            return jax.jit(step_fn,
-                           donate_argnums=(0,) if donate_state else ())
+        program = _executor.Program(
+            "train_step", static_key, step_fn,
+            donate_argnums=(0,) if donate_state else ())
+        dispatch_no = itertools.count(1)
 
         def jit_step(state, *batch):
-            args = (state,) + batch
-            fn = _step_cache.step_cache.program("train_step", static_key,
-                                                args, _build)
-            _step_cache.step_cache._bump("dispatches", "train_step")
-            return fn(*args)
+            return _executor.executor.submit(
+                program, (state,) + batch, step=next(dispatch_no))
     else:
         jit_step = step_fn  # caller wraps in shard_map/pjit
 
     ts = TrainStep(model, optimizer, loss_fn, jit_step, params, buffers,
                    init_state)
+    ts._via_executor = via_executor
     # the un-jitted step for wrappers that jit with their own shardings /
     # donation (parallel/zero.py)
     ts._raw_step_fn = step_fn

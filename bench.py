@@ -770,13 +770,23 @@ def time_compiled_step(step, batch_arrays, iters, warmup, analytic_flops,
     block_until_ready workaround); default reads master_params[0] —
     states shaped differently (the GAN step's d/g pair) pass their
     own."""
+    import jax
     import jax.numpy as jnp
 
     if sync_state is None:
         sync_state = lambda s: float(jnp.sum(s.master_params[0]))
 
     tc = time.perf_counter()
-    compiled = step._step_fn.lower(step.state, *batch_arrays).compile()
+    fn = step._step_fn
+    if not hasattr(fn, "lower"):
+        # executor-routed steps hold a submit closure, not the jitted
+        # fn: AOT-compile the raw step under the same donation the
+        # executor's program carries, so the timed executable matches
+        # what step() dispatches
+        fn = jax.jit(step._raw_step_fn,
+                     donate_argnums=(0,)
+                     if getattr(step, "_donate_state", False) else ())
+    compiled = fn.lower(step.state, *batch_arrays).compile()
     compile_s = time.perf_counter() - tc
     log(f"compiled in {compile_s:.1f}s")
 
@@ -1920,6 +1930,122 @@ def run_observe_microbench(args):
     return 0
 
 
+def overlap_microbench_records(ks=(1, 4, 16), dim=256, micro_batch=8,
+                               warmup=2, timed_windows=6, n_batches=None):
+    """``window_step_us`` microbench: the executor's two overlap knobs —
+    ZeRO all-gather prefetch and async H2D double-buffering — each timed
+    with overlap off vs on at K ∈ {1, 4, 16} microbatches per window.
+
+    CPU-forced like the other microbenches.  Both arms of each knob
+    compile the *same math DAG* (the gather arm is pinned bitwise by
+    ``tests/test_executor.py``); the knob only moves where the gather /
+    transfer is issued, so ``*_overlap_factor`` (off time / on time) is
+    ~1.0 on CPU, where XLA runs collectives synchronously and the
+    prefetcher's depth-2 queue has no async dispatch to hide under.  The
+    record schema is the contract: multichip rounds replay this stage on
+    the TPU backend and the factors become the overlap win.
+    """
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.runtime import executor as rex
+    from apex_tpu.training import make_train_step
+
+    rng = np.random.default_rng(0)
+
+    def build_zero(k):
+        nn.manual_seed(0)
+        model = nn.Sequential(nn.Linear(dim, dim), nn.ReLU(),
+                              nn.Linear(dim, 10))
+        opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+        return make_train_step(model, opt,
+                               lambda o, t: F.cross_entropy(o, t),
+                               grad_accum_steps=k, zero_stage=1,
+                               zero_sharding=True, donate_state=False)
+
+    def build_fused(k):
+        nn.manual_seed(0)
+        model = nn.Sequential(nn.Linear(dim, dim), nn.ReLU(),
+                              nn.Linear(dim, 10))
+        opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+        return make_train_step(model, opt,
+                               lambda o, t: F.cross_entropy(o, t),
+                               accum_steps=k, accum_stacked=(k > 1))
+
+    def time_gather_us(k, on):
+        rex.set_overlap(gather=on)
+        try:
+            step = build_zero(k)
+            x = jnp.asarray(
+                rng.standard_normal((micro_batch * k, dim)), jnp.float32)
+            y = jnp.asarray(rng.integers(0, 10, (micro_batch * k,)))
+            for _ in range(warmup):
+                step(x, y)
+            jax.block_until_ready(step.state.master_params[0])
+            t0 = time.perf_counter()
+            for _ in range(timed_windows):
+                step(x, y)
+            jax.block_until_ready(step.state.master_params[0])
+            return (time.perf_counter() - t0) / timed_windows * 1e6
+        finally:
+            rex.set_overlap(gather="auto")
+
+    def time_h2d_us(k, on):
+        rex.set_overlap(h2d=on)
+        try:
+            step = build_fused(k)
+            nb = n_batches if n_batches is not None \
+                else k * (warmup + timed_windows)
+            batches = [
+                (rng.standard_normal((micro_batch, dim)).astype(np.float32),
+                 rng.integers(0, 10, (micro_batch,)))
+                for _ in range(nb)]
+            kw = {"accum_steps": k} if k > 1 else {}
+            rex.executor.drive(step, batches[:k * warmup], **dict(kw))
+            jax.block_until_ready(step.state.master_params[0])
+            t0 = time.perf_counter()
+            losses = rex.executor.drive(step, batches[k * warmup:],
+                                        **dict(kw))
+            jax.block_until_ready(step.state.master_params[0])
+            return (time.perf_counter() - t0) / max(len(losses), 1) * 1e6
+        finally:
+            rex.set_overlap(h2d="auto")
+
+    records = []
+    for k in ks:
+        g_off = time_gather_us(k, False)
+        g_on = time_gather_us(k, True)
+        h_off = time_h2d_us(k, False)
+        h_on = time_h2d_us(k, True)
+        records.append({
+            "metric": "window_step_us", "config": f"overlap_k{k}",
+            "accum_steps": k, "micro_batch": micro_batch,
+            "platform": "cpu",
+            "window_step_us": round(g_on, 1),
+            "gather_window_us_off": round(g_off, 1),
+            "gather_window_us_on": round(g_on, 1),
+            "gather_overlap_factor": round(g_off / g_on, 3),
+            "h2d_window_us_off": round(h_off, 1),
+            "h2d_window_us_on": round(h_on, 1),
+            "h2d_overlap_factor": round(h_off / h_on, 3)})
+    return records
+
+
+def run_overlap_microbench(args):
+    stage("overlap_microbench",
+          "executor overlap knobs (gather prefetch, h2d double-buffer) "
+          "off vs on, K in {1,4,16}, cpu")
+    for rec in overlap_microbench_records():
+        emit(rec)
+        register_record(rec)
+    return 0
+
+
 def ckpt_microbench_records(total_mb=64, n_tensors=32, repeats=3,
                             directory=None):
     """``ckpt_save_ms`` microbench: CheckpointManager sync save vs async
@@ -2401,6 +2527,15 @@ def main():
                          "off, at drain_every in {1,16}, CPU-forced — the "
                          "observe claim is <2%% overhead at "
                          "drain_every>=16")
+    ap.add_argument("--overlap-microbench", action="store_true",
+                    help="window_step_us stage: the executor overlap "
+                         "knobs (ZeRO all-gather prefetch, async H2D "
+                         "double-buffering) off vs on at K in {1,4,16}, "
+                         "CPU-forced — emits {gather_overlap_factor, "
+                         "h2d_overlap_factor, window_step_us}; both "
+                         "arms are the same math DAG, so the factors "
+                         "are ~1.0 on cpu and become the overlap win "
+                         "on the async backends")
     ap.add_argument("--budget-s", type=float,
                     default=float(os.environ.get("GRAFT_BENCH_BUDGET_S", 540)))
     args = ap.parse_args()
@@ -2428,6 +2563,10 @@ def main():
     if args.observe_microbench:
         start_watchdog(args.budget_s)
         return run_observe_microbench(args)
+
+    if args.overlap_microbench:
+        start_watchdog(args.budget_s)
+        return run_overlap_microbench(args)
 
     if args.plan:
         start_watchdog(args.budget_s)

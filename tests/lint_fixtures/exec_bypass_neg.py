@@ -1,0 +1,31 @@
+"""EXEC-BYPASS negative: steps described as Program descriptors and
+submitted through the runtime executor; non-step jits stay legal."""
+import itertools
+
+import jax
+
+from apex_tpu.runtime import executor as _executor
+
+_TOKENS = itertools.count()
+
+
+def make_step(step_fn, donate):
+    # GOOD: describe the program, let the executor compile/count/span
+    program = _executor.Program(
+        "train_step", (next(_TOKENS), bool(donate)), step_fn,
+        donate_argnums=(0,) if donate else ())
+    dispatch_no = itertools.count(1)
+
+    def jit_step(state, *batch):
+        return _executor.executor.submit(
+            program, (state,) + batch, step=next(dispatch_no))
+
+    return jit_step
+
+
+def decode_fn(logits_fn):
+    # GOOD: jit of a non-step function (inference helper) is not a
+    # dispatch bypass
+    def run(tokens):
+        return logits_fn(tokens)
+    return jax.jit(run)

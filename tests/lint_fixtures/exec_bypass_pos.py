@@ -1,0 +1,23 @@
+"""EXEC-BYPASS positive: step programs compiled/dispatched around the
+one-runtime executor — no dispatch count, no span, no heartbeat."""
+import jax
+
+
+def cached_dispatch(step_cache, key, args, build):
+    # BAD: direct compile-or-hit against the step cache
+    fn = step_cache.program("train_step", key, args, build)
+    # BAD: hand-rolled dispatch counting
+    step_cache._bump("dispatches", "train_step")
+    return fn(*args)
+
+
+def make_step(step_fn, donate):
+    # BAD: jitting a train step directly — bypasses the program cache,
+    # the donation policy and the dispatch observability
+    jit_step = jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+    return jit_step
+
+
+def wrap_raw(wrapper):
+    # BAD: same bypass through an attribute spelling
+    return jax.jit(wrapper._raw_step_fn, donate_argnums=(0,))

@@ -253,6 +253,28 @@ def test_observe_microbench_records_schema():
     assert d16["overhead_pct"] < 2.0
 
 
+def test_overlap_microbench_records_schema():
+    """--overlap-microbench stage: the executor overlap knobs (ZeRO
+    all-gather prefetch, async H2D double-buffering) off vs on per K.
+    Both arms compile the same math DAG — the bitwise parity is pinned
+    in tests/test_executor.py — so on cpu this asserts the record
+    schema and that the factors are sane ratios, not a perf win (that
+    claim belongs to the multichip rounds)."""
+    recs = bench.overlap_microbench_records(ks=(1, 4), timed_windows=2,
+                                            warmup=1)
+    assert {r["accum_steps"] for r in recs} == {1, 4}
+    for r in recs:
+        assert r["metric"] == "window_step_us"
+        assert r["platform"] == "cpu"
+        assert r["window_step_us"] > 0
+        for knob in ("gather", "h2d"):
+            assert r[f"{knob}_window_us_off"] > 0
+            assert r[f"{knob}_window_us_on"] > 0
+            # same DAG both arms: a ratio far from 1 on cpu means an
+            # arm compiled something else entirely
+            assert 0.2 < r[f"{knob}_overlap_factor"] < 5.0
+
+
 def test_lint_records_schema():
     """--lint stage: one lint_findings record with the analyzer-health
     fields (the r06 multichip rerun records hazard-cleanliness next to

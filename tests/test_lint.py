@@ -31,6 +31,7 @@ RULE_FIXTURES = {
     "IMPURE-STATIC-KEY": "impure_static_key",
     "CKPT-ATOMIC": "ckpt_atomic",
     "OBS-IN-JIT": "obs_in_jit",
+    "EXEC-BYPASS": "exec_bypass",
 }
 
 

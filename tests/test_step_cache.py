@@ -209,26 +209,10 @@ def test_mixed_dtype_buckets_one_executable(rng):
 # donation: input→output aliasing visible in the lowered HLO
 # ---------------------------------------------------------------------------
 
-def test_donation_alias_in_lowered_hlo(rng):
-    # donation is "auto" (off on the copy-on-donate cpu backend); force it
-    # on to inspect the aliasing the accelerator path compiles with
-    step_cache.set_donation(True)
-    try:
-        params = _params(rng)
-        opt = FusedAdam(params, lr=1e-2)
-        opt.step()
-        (entry,) = [e for e in step_cache.step_cache.entries()
-                    if e["kind"] == "fused_adam"]
-        txt = entry["fn"].lower(*entry["example"]).as_text()
-        # donated leaves: params + exp_avg + exp_avg_sq per bucket + the
-        # step counter — every one must alias an output buffer
-        n_donated = 3 * len(params) + 1
-        assert txt.count("tf.aliasing_output") >= n_donated
-    finally:
-        step_cache.set_donation("auto")
-
-
 def test_sgd_momentum_buffers_donated(rng):
+    # the full donated-leaf aliasing census moved to the executor suite
+    # (tests/test_executor.py::test_donation_alias_in_lowered_hlo) with
+    # the policy itself; the per-optimizer probes below stay here
     step_cache.set_donation(True)
     try:
         params = _params(rng)
