@@ -4,8 +4,8 @@ speculative decoding (speculative.py), beam search (beam.py), the
 rolling sliding-window KV cache (rolling.py), and stateful multi-turn
 decode sessions (session.py)."""
 from .beam import beam_generate  # noqa: F401
-from .session import DecodeSession  # noqa: F401
-from .quant import (QuantKV, QuantTensor, gather_rows,  # noqa: F401
-                    kv_value, kv_write, make_kv_cache,
+from .session import DecodeSession, PagedSession  # noqa: F401
+from .quant import (QuantKV, QuantTensor, absmax_int8,  # noqa: F401
+                    gather_rows, kv_value, kv_write, make_kv_cache,
                     quantize_int8, quantize_tensor_int8)
 from .speculative import speculative_generate  # noqa: F401

@@ -75,6 +75,13 @@ def _absmax_int8(xf, axis, scale_dtype):
     return q, scale
 
 
+#: public name for the shared absmax core — the serve engine's paged
+#: pool quantizes per-position writes through the SAME function the
+#: contiguous QuantKV cache uses, so a block-pooled int8 cache stores
+#: byte-identical values to the private-buffer one
+absmax_int8 = _absmax_int8
+
+
 def quantize_tensor_int8(x, dtype=None):
     """Absmax-per-row symmetric int8: ``x (rows, ...)`` -> QuantTensor
     with one scale per leading row (for a torch-layout ``(out, in)``

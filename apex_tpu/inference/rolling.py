@@ -45,6 +45,23 @@ def rolling_slot_positions(n_slots, t_hi):
     return last - jnp.mod(last - s, n_slots)
 
 
+def window_retired_blocks(t_hi, window, block_size):
+    """Block-table generalization of the rolling cache's eviction
+    arithmetic: with positions ``0 .. t_hi-1`` written and a sliding
+    window ``w``, every future query sits at position ``>= t_hi - 1``,
+    so the earliest key any of them can reach is
+    ``t_hi - w`` (band: ``t - w < key <= t``).  A logical block ``b``
+    (positions ``[b·bs, (b+1)·bs)``) is *retired* — freeable, its
+    physical block returnable to the pool — once its LAST position
+    falls below that reach: ``(b+1)·bs - 1 < t_hi - w``.  Returns the
+    count of retired leading blocks (host int math; the serve
+    scheduler frees exactly that prefix of a windowed session's table
+    and nulls the entries, which the band mask already excludes)."""
+    if window is None:
+        return 0
+    return max(0, (int(t_hi) - int(window)) // int(block_size))
+
+
 def rolling_kv_write(cache, new, t0):
     """Write chunk ``new (B, H, S_c, D)`` at global positions
     ``t0 ..`` into the W-slot rolling cache (slot = position mod W).

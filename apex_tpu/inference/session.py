@@ -199,3 +199,148 @@ class DecodeSession:
             self._last_logits, key)
         self.position += max_new_tokens
         return toks
+
+
+class PagedSession:
+    """A decode session whose KV state is a BLOCK TABLE into a shared
+    :class:`~apex_tpu.serve.ServeEngine` pool — no private cache
+    buffer.
+
+    Where :class:`DecodeSession` allocates ``(B, H, capacity, D)``
+    caches per layer up front (capacity paid even for a two-turn
+    chat), a PagedSession holds only the integer ids of the pool
+    blocks its history actually fills, growing block-by-block as the
+    conversation does; hundreds of sessions share the engine's one
+    preallocated buffer.  The compiled programs are the ENGINE's
+    prefill/decode programs — the same executables its continuous-
+    batching loop dispatches — so an interactive session and the
+    batch-serving path cannot drift numerically, and opening a session
+    compiles nothing new after the engine has warmed its buckets.
+
+    Same surface as DecodeSession (``append`` / ``generate`` /
+    ``reset`` / ``position``), batch 1, greedy-only ``generate``
+    (the serve programs sample in-program; sampled decode stays on
+    DecodeSession, the single-session compatibility path).  ``append``
+    returns only the LAST position's logits ``(1, V)`` — the paged
+    prefill never materializes per-position logits for the whole
+    chunk.  Use as a context manager (or call ``close()``) so the
+    blocks return to the pool.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._table = []
+        self.position = 0
+        self._last_logits = None
+
+    # -- block-table state -------------------------------------------------
+
+    @property
+    def block_table(self):
+        """The session's logical→physical block ids (read-only view)."""
+        return tuple(self._table)
+
+    def _ensure(self, n_positions, what):
+        from ..serve.pool import blocks_for
+        eng = self.engine
+        if n_positions > eng.model.max_positions:
+            raise ValueError(
+                f"{what}: {n_positions} positions exceed max_positions "
+                f"{eng.model.max_positions}")
+        need = blocks_for(n_positions, eng.block_size) - len(self._table)
+        if need > 0:
+            ids = eng.block_pool.alloc(need)
+            if ids is None:
+                raise RuntimeError(
+                    f"{what}: block pool exhausted "
+                    f"({eng.block_pool.in_use}/{eng.block_pool.capacity}"
+                    f" in use) — close idle sessions or build the "
+                    f"engine with more num_blocks")
+            self._table.extend(ids)
+
+    # -- public ------------------------------------------------------------
+
+    def append(self, tokens):
+        """Ingest ``tokens`` (a 1-D sequence, or ``(1, S)``) at the
+        cursor through the engine's chunked prefill program; returns
+        the final ingested position's logits ``(1, V)``."""
+        from ..serve.scheduler import bucket
+        import numpy as np
+        eng = self.engine
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        if toks.size == 0:
+            raise ValueError("append of zero tokens")
+        prefill_prog, _ = eng._programs()
+        chunk = eng.scheduler.prefill_chunk
+        done = 0
+        while done < toks.size:
+            n = int(min(chunk, toks.size - done))
+            self._ensure(self.position + n, "append")
+            nb = bucket(len(self._table))
+            padded = np.zeros((1, chunk), np.int32)
+            padded[0, :n] = toks[done:done + n]
+            table = np.asarray(
+                [self._table + [0] * (nb - len(self._table))], np.int32)
+            from ..runtime import executor as _executor
+            last, eng.pool = _executor.executor.submit(
+                prefill_prog,
+                (eng._vals(), eng.pool, padded, table,
+                 np.int32(self.position), np.int32(n)),
+                step=next(eng._dispatch_no))
+            self.position += n
+            done += n
+        self._last_logits = last
+        return last
+
+    def generate(self, max_new_tokens):
+        """Greedily continue by ``max_new_tokens`` (emitted tokens are
+        ingested, like a model turn); returns ``(1, n)`` token ids."""
+        from ..serve.scheduler import bucket
+        import numpy as np
+        eng = self.engine
+        if self.position == 0:
+            raise ValueError(
+                "generate on an empty session — append a prompt first")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        _, decode_prog = eng._programs()
+        from ..runtime import executor as _executor
+        tok = int(jnp.argmax(self._last_logits[0]))
+        out = [tok]
+        for i in range(max_new_tokens):
+            # ingest the token at the cursor; the final iteration only
+            # refreshes _last_logits (its sampled successor is the
+            # NEXT generate's first token)
+            self._ensure(self.position + 1, "generate")
+            nb = bucket(len(self._table))
+            table = np.asarray(
+                [self._table + [0] * (nb - len(self._table))], np.int32)
+            nxt, logits, eng.pool = _executor.executor.submit(
+                decode_prog,
+                (eng._vals(), eng.pool,
+                 np.asarray([out[-1]], np.int32),
+                 np.asarray([self.position], np.int32), table),
+                step=next(eng._dispatch_no))
+            self.position += 1
+            self._last_logits = logits
+            if i < max_new_tokens - 1:
+                out.append(int(np.asarray(nxt)[0]))
+        return jnp.asarray([out], jnp.int32)
+
+    def reset(self):
+        """Drop the decode state and return the blocks to the pool;
+        the session object stays usable."""
+        if self._table:
+            self.engine.block_pool.free(self._table)
+        self._table = []
+        self.position = 0
+        self._last_logits = None
+
+    close = reset
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.reset()

@@ -958,3 +958,132 @@ class ExecBypass(Rule):
                         f"jax.jit of step function '{name}' outside the "
                         f"executor — the program bypasses the cache "
                         f"stats, donation policy and observability")
+
+
+# ---------------------------------------------------------------------------
+# SERVE-SHAPE
+# ---------------------------------------------------------------------------
+
+#: the serving program kinds (runtime/executor.py SERVE_KINDS) — string
+#: literals only; a kind the rule cannot resolve is not guessed
+_SERVE_PROGRAM_KINDS = {"prefill_step", "decode_step"}
+
+#: attribute reads that surface a request-dependent extent
+_SHAPE_ATTRS = {"shape", "size", "ndim"}
+
+
+def _serve_kind_of(call: ast.Call) -> Optional[str]:
+    """The serving kind a ``Program(...)`` construction names, when the
+    kind (first positional or ``kind=``) is a serve-kind string literal;
+    else None."""
+    if _terminal(call.func) != "Program":
+        return None
+    kind = call.args[0] if call.args else None
+    for kw in call.keywords:
+        if kw.arg == "kind":
+            kind = kw.value
+    if isinstance(kind, ast.Constant) and kind.value in _SERVE_PROGRAM_KINDS:
+        return kind.value
+    return None
+
+
+def _serve_static_key(call: ast.Call) -> Optional[ast.AST]:
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "static_key":
+            return kw.value
+    return None
+
+
+@register
+class ServeShape(Rule):
+    """Request-dependent shapes reaching serving programs — PR 12.
+
+    A serving engine sees arbitrary prompt lengths, batch occupancies
+    and block-table lengths; the step cache keys programs by (kind,
+    static_key, operand signature).  Let a raw per-request extent —
+    ``len(prompt)``, ``tokens.shape``, ``len(table)`` — reach a
+    ``prefill_step`` / ``decode_step`` static key (or steer which
+    program gets built) and every distinct request length compiles a
+    fresh executable: recompilation scales with TRAFFIC, not with
+    config, and tail latency spikes exactly when load does.  The serve
+    engine's discipline is a bucket table: every dynamic extent is
+    rounded up through ``serve.scheduler.bucket`` (powers of two capped
+    at the config maximum) before it touches program identity, so the
+    shape set is ``O(log·log)`` and decode is recompile-free after
+    warmup.  Flags, on serve-kind ``Program(...)`` constructions:
+    ``len(...)`` / ``.shape`` / ``.size`` / ``.ndim`` inside the static
+    key unless routed through a ``bucket*`` call, and ``if``/``while``
+    tests on those extents inside the functions that build the
+    programs (per-request program selection is the same recompile
+    surface by another route).
+    """
+    id = "SERVE-SHAPE"
+    summary = ("request-dependent shape in a serving program key / "
+               "build path (recompiles per request, not per bucket)")
+    hint = ("round every request-dependent extent through the bucket "
+            "table (serve.scheduler.bucket: next power of two, capped "
+            "at the config maximum) before it reaches a Program static "
+            "key or build-time branch — operand signatures then "
+            "complete the cache key and decode re-hits after warmup; "
+            "see docs/serving.md's keying discipline")
+
+    def _dynamic_exprs(self, expr):
+        """``len()`` calls and ``.shape``/``.size``/``.ndim`` reads in
+        ``expr`` that are NOT routed through a ``bucket*`` call —
+        descent stops at any call whose name contains ``bucket``: its
+        result is by construction one of O(log) values."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Call):
+                tn = _terminal(node.func) or ""
+                if "bucket" in tn:
+                    continue
+                if isinstance(node.func, ast.Name) and tn == "len":
+                    yield node, "len(...)"
+                    continue
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in _SHAPE_ATTRS:
+                yield node, f".{node.attr}"
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, module, ctx):
+        serve_calls = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and _serve_kind_of(node):
+                serve_calls.append(node)
+        if not serve_calls:
+            return
+        for call in serve_calls:
+            kind = _serve_kind_of(call)
+            key = _serve_static_key(call)
+            if key is None:
+                continue
+            for bad, what in self._dynamic_exprs(key):
+                yield self.finding(
+                    module, bad,
+                    f"{what} in the '{kind}' program's static key — "
+                    f"the key tracks a per-request extent, so every "
+                    f"new request length compiles a fresh executable")
+        # build-time branches on raw extents, in the functions that
+        # lexically construct the serving programs
+        ids = {id(c) for c in serve_calls}
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(id(n) in ids for n in ast.walk(fn)):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                for bad, what in self._dynamic_exprs(node.test):
+                    yield self.finding(
+                        module, bad,
+                        f"{what} steering a "
+                        f"{'while' if isinstance(node, ast.While) else 'if'}"
+                        f" in serving-program build code — per-request "
+                        f"program selection recompiles per request "
+                        f"length, not per bucket")

@@ -55,6 +55,13 @@ _f32 = jnp.float32
 TRAIN_KINDS = frozenset({"train_step", "zero_train_step",
                          "gan_train_step"})
 
+#: serving-loop kinds (apex_tpu.serve): like train kinds they are the
+#: unit of forward progress — every tick spans and heartbeats, so the
+#: stall watchdog guards the decode loop the same way it guards the
+#: train loop.  Unlike eager kinds there is no microbenchmarked
+#: hot path concern: a serve dispatch covers a whole batched tick.
+SERVE_KINDS = frozenset({"prefill_step", "decode_step"})
+
 _UNSET = object()
 
 
@@ -227,21 +234,21 @@ class Executor:
         """Compile-or-hit, count, span, heartbeat, dispatch.
 
         ``step``: the caller's 1-based step count for the watchdog
-        heartbeat (train kinds; dispatch returning means the host made
-        forward progress — execution is async, a wedged backend blocks
-        the dispatch itself).  Eager kinds pass None: they span only
-        under ``step_cache.set_dispatch_spans(True)`` and never
-        heartbeat.
+        heartbeat (train and serve kinds; dispatch returning means the
+        host made forward progress — execution is async, a wedged
+        backend blocks the dispatch itself).  Eager kinds pass None:
+        they span only under ``step_cache.set_dispatch_spans(True)``
+        and never heartbeat.
         """
         fn = self.compile(program, args)
         self._cache._bump("dispatches", program.kind)
-        train = program.kind in TRAIN_KINDS
-        if train or _sc._DISPATCH_SPANS:
+        beat = program.kind in TRAIN_KINDS or program.kind in SERVE_KINDS
+        if beat or _sc._DISPATCH_SPANS:
             with _spans.span("dispatch", kind=program.kind):
                 out = fn(*args)
         else:
             out = fn(*args)
-        if train and step is not None:
+        if beat and step is not None:
             _obs_watchdog.heartbeat(step=step)
         return out
 

@@ -196,6 +196,15 @@ def stats() -> dict:
     return step_cache.stats()
 
 
+def kind_stats(kind: str) -> dict:
+    """One kind's ``{compiles, cache_hits, dispatches}`` (zeros if the
+    kind never dispatched) — the serve engine's recompile-free-decode
+    bound reads ``kind_stats("decode_step")["compiles"]`` and asserts
+    it stays <= the bucket count after warmup."""
+    return stats()["by_kind"].get(
+        kind, {n: 0 for n in StepCache._KIND_COUNTERS})
+
+
 def reset_stats():
     step_cache.reset_stats()
 

@@ -1,0 +1,328 @@
+"""ServeEngine: the continuous-batching serving loop.
+
+One engine owns one model, one paged KV pool, one scheduler, and a
+small fixed family of compiled programs — two *kinds* (``prefill_step``,
+``decode_step``) dispatched through the one-runtime executor
+(runtime/executor.py), so serving inherits the whole training-side
+runtime for free: step-cache keying (``stats()['by_kind']`` pins
+compiles per kind; the bench's ``decode_compiles <= buckets`` bound is
+exactly the training side's 1-compile-per-window discipline), dispatch
+spans, watchdog heartbeats, and the donation policy (the pool is the
+donated carry — on tpu/gpu each tick rewrites KV in place).
+
+The tick loop (:meth:`ServeEngine.step`):
+
+1. **admit** — the scheduler moves queue-head requests into the live
+   set while batch slots / blocks / prefill backlog allow;
+2. **one prefill chunk** — the oldest prefilling session ingests up to
+   ``prefill_chunk`` prompt tokens (ONE chunk per tick, so a long
+   prompt interleaves with everyone else's decode instead of stalling
+   it); completing prefill emits the first token from the chunk's last
+   logits — no decode dispatch spent on it;
+3. **one decode tick** — every decoding session advances one token in
+   a single bucketed dispatch; sessions that hit ``max_new_tokens`` or
+   their ``eos`` free their blocks this same tick.
+
+Per-request lifecycle telemetry (``serve.request`` events with phases
+queued→prefill→first_token→done, TTFT/e2e/tick-latency histograms,
+queue-depth and pool-occupancy gauges) flows through the observe
+registry; ``run()`` can wrap the loop in a stall watchdog — the
+executor's per-dispatch heartbeats make a wedged backend fire a typed
+``watchdog.stall`` diagnostic instead of hanging silently.
+
+Greedy decoding only, by design: serving parity is pinned bitwise
+against ``inference.DecodeSession``, and a sampled path would need
+per-session PRNG threading through the bucketed programs — a later
+PR's satellite, not this one's.
+"""
+from __future__ import annotations
+
+import inspect
+import itertools
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt import _sharded_decode_axes
+from ..observe import registry as _obs
+from ..observe import watchdog as _watchdog
+from ..runtime import executor as _executor
+from . import kernels as _kernels
+from .pool import BlockPool, init_pool_buffer
+from .scheduler import DECODE, Request, Scheduler, Session, bucket
+
+#: per-engine token in the serve program static keys — two engines over
+#: identically-shaped models must never share a cache entry (their
+#: program closures hold different parameter objects)
+_SERVE_TOKENS = itertools.count()
+
+
+class ServeEngine:
+    """Continuous-batching paged-KV serving over a GPT-protocol model.
+
+    ``num_blocks`` sizes the shared pool (one block =
+    ``block_size × layers × 2 × heads × head_dim`` KV rows; block 0 is
+    the reserved null block).  ``cache_dtype`` follows the session
+    convention — default the token-embedding dtype, ``"int8"`` for the
+    quantized pool.  ``window`` enables sliding-window attention with
+    block-table retirement (rolling.py's band, generalized).
+    """
+
+    def __init__(self, model, *, num_blocks, block_size=16, max_batch=8,
+                 prefill_chunk=32, cache_dtype=None,
+                 max_prefill_backlog=None, window=None):
+        self._validate_model(model)
+        self.model = model
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.window = window
+        blk0 = model.blocks[0]
+        self._params = list(model.parameters()) + list(model.buffers())
+        dtype = cache_dtype if cache_dtype is not None \
+            else model.tok_emb.weight.data.dtype
+        self._dtype_name = dtype if isinstance(dtype, str) \
+            else jnp.dtype(dtype).name
+        self.pool = init_pool_buffer(
+            len(model.blocks), blk0.attn.num_heads, blk0.attn.head_dim,
+            self.num_blocks, self.block_size, dtype)
+        self.block_pool = BlockPool(self.num_blocks, self.block_size)
+        if max_prefill_backlog is None:
+            max_prefill_backlog = 4 * prefill_chunk
+        self.scheduler = Scheduler(
+            self.block_pool, max_batch=max_batch,
+            prefill_chunk=prefill_chunk,
+            max_prefill_backlog=max_prefill_backlog,
+            max_positions=model.max_positions)
+        self._token = next(_SERVE_TOKENS)
+        self._donate = _executor.donation.enabled
+        self._decode_prog = None
+        self._prefill_prog = None
+        self._dispatch_no = itertools.count(1)
+        self._tick = 0
+        self.results: Dict[str, List[int]] = {}
+
+    @staticmethod
+    def _validate_model(model):
+        for a in ("blocks", "tok_emb", "pos_emb", "ln_f",
+                  "_mask_pad_logits", "max_positions"):
+            if not hasattr(model, a):
+                raise ValueError(
+                    f"ServeEngine needs model.{a} (the GPT decode "
+                    f"protocol)")
+        blk = model.blocks[0]
+        for a in ("_chunk_qkv", "_attn_mlp_tail"):
+            if not hasattr(blk, a):
+                raise ValueError(
+                    f"ServeEngine needs block.{a} — paged attention "
+                    f"reuses the model's own decode projections")
+        # Llama's _chunk_qkv(ctx, x, pos) applies RoPE inside the
+        # projection — the paged bodies would silently skip it
+        if len(inspect.signature(blk._chunk_qkv).parameters) != 2:
+            raise NotImplementedError(
+                "ServeEngine supports the GPT-family cache protocol "
+                "(_chunk_qkv(ctx, x)); rotary-position families need "
+                "position-aware paged projections — use the "
+                "single-request decode paths for now")
+        axes = _sharded_decode_axes(model)
+        if axes:
+            names = ", ".join(f"{a}='{v}'" for a, v in axes)
+            raise NotImplementedError(
+                f"ServeEngine runs single-shard; the model was built "
+                f"with {names}")
+
+    # -- programs ----------------------------------------------------------
+    # One Program instance per kind: operand shapes (bucketed batch /
+    # blocks / chunk) complete the step-cache key through the argument
+    # signature, so each bucket compiles once and session churn re-hits.
+
+    def _programs(self):
+        if self._decode_prog is None:
+            key = (self._token, self.block_size, self._dtype_name,
+                   self.window, self._donate)
+            self._decode_prog = _executor.Program(
+                "decode_step", key,
+                _kernels.build_decode_fn(
+                    self.model, self._params, self.block_size,
+                    self.num_blocks, self.window),
+                donate_argnums=(1,) if self._donate else ())
+            self._prefill_prog = _executor.Program(
+                "prefill_step", key,
+                _kernels.build_prefill_fn(
+                    self.model, self._params, self.block_size,
+                    self.num_blocks, self.window),
+                donate_argnums=(1,) if self._donate else ())
+        return self._prefill_prog, self._decode_prog
+
+    def _vals(self):
+        return [p.data for p in self._params]
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        self.scheduler.submit(request)
+        sess = self.scheduler.queue[-1]
+        sess.t_queued = time.monotonic()
+        _obs.event("serve.request", rid=request.rid, phase="queued",
+                   tick=self._tick, prompt_len=len(request.prompt),
+                   max_new=request.max_new_tokens)
+
+    # -- the tick ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One engine tick: admit, one prefill chunk, one decode tick.
+        Returns True while any request is live or queued."""
+        self._tick += 1
+        t0 = time.monotonic()
+        for s in self.scheduler.admit():
+            _obs.event("serve.request", rid=s.rid, phase="prefill",
+                       tick=self._tick, blocks=len(s.table))
+        ps = self.scheduler.next_prefill()
+        if ps is not None:
+            self._prefill_chunk(ps)
+        self._ensure_decode_blocks()
+        ds = self.scheduler.decode_sessions()
+        if ds:
+            self._decode_tick(ds)
+            _obs.histogram("serve.decode_tick_ms").observe(
+                (time.monotonic() - t0) * 1e3)
+        _obs.gauge("serve.queue_depth").set(len(self.scheduler.queue))
+        _obs.gauge("serve.active_sessions").set(
+            len(self.scheduler.sessions))
+        return self.scheduler.has_work()
+
+    def run(self, requests: Sequence[Request], arrivals=None,
+            watchdog_deadline_s=None, max_ticks=None):
+        """Serve ``requests`` to completion; returns ``{rid: tokens}``.
+
+        ``arrivals``: optional per-request tick indices (an open-loop
+        trace — request i becomes visible at tick ``arrivals[i]``);
+        None submits everything up front.  ``watchdog_deadline_s`` arms
+        a stall watchdog over the loop: every dispatch heartbeats, so
+        a wedged backend fires ``watchdog.stall`` instead of hanging."""
+        pending = sorted(
+            zip(arrivals if arrivals is not None else [0] * len(requests),
+                range(len(requests))),
+            key=lambda p: (p[0], p[1]))
+        wd = _watchdog.StallWatchdog(watchdog_deadline_s) \
+            if watchdog_deadline_s else None
+        if wd is not None:
+            wd.start()
+        try:
+            i = 0
+            while True:
+                while i < len(pending) and pending[i][0] <= self._tick:
+                    self.submit(requests[pending[i][1]])
+                    i += 1
+                more = self.step()
+                if not more and i >= len(pending):
+                    break
+                if max_ticks is not None and self._tick >= max_ticks:
+                    break
+        finally:
+            if wd is not None:
+                wd.stop()
+        return dict(self.results)
+
+    # -- internals ---------------------------------------------------------
+
+    def _prefill_chunk(self, s: Session) -> None:
+        prefill_prog, _ = self._programs()
+        chunk = self.scheduler.prefill_chunk
+        n = min(chunk, s.prefill_remaining)
+        toks = list(s.prefill_src[s.position:s.position + n])
+        toks += [0] * (chunk - n)
+        nb = bucket(len(s.table))
+        table = s.table + [0] * (nb - len(s.table))
+        last, self.pool = _executor.executor.submit(
+            prefill_prog,
+            (self._vals(), self.pool,
+             np.asarray([toks], np.int32), np.asarray([table], np.int32),
+             np.int32(s.position), np.int32(n)),
+            step=next(self._dispatch_no))
+        s.position += n
+        if self.window is not None:
+            self.scheduler.retire_window_blocks(s, self.window)
+        if s.prefill_remaining > 0:
+            return
+        s.state = DECODE
+        if s.emit_on_prefill:
+            tok = int(jnp.argmax(last[0]))
+            s.out.append(tok)
+            s.pending_tok = tok
+            s.t_first = time.monotonic()
+            _obs.histogram("serve.ttft_ms").observe(
+                (s.t_first - s.t_queued) * 1e3)
+            _obs.event("serve.request", rid=s.rid, phase="first_token",
+                       tick=self._tick)
+            if s.finished():
+                self._finish(s)
+
+    def _ensure_decode_blocks(self) -> None:
+        """Every decoding session needs its table to cover the row this
+        tick writes; a dry pool preempts the newest session (recompute
+        mode) until the survivors fit."""
+        for s in list(self.scheduler.decode_sessions()):
+            if s.state != DECODE:
+                continue                     # preempted below us
+            while not self.scheduler.grow(s, s.position + 1):
+                victim = self.scheduler.preempt_for(s)
+                _obs.counter("serve.preemptions").inc()
+                _obs.event("serve.request", rid=victim.rid,
+                           phase="preempted", tick=self._tick,
+                           generated=len(victim.out))
+                if victim is s:
+                    break
+
+    def _decode_tick(self, sessions: List[Session]) -> None:
+        _, decode_prog = self._programs()
+        b, nb, tokens, positions, tables = \
+            self.scheduler.pack_decode(sessions)
+        nxt, _logits, self.pool = _executor.executor.submit(
+            decode_prog,
+            (self._vals(), self.pool,
+             np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
+             np.asarray(tables, np.int32)),
+            step=next(self._dispatch_no))
+        nxt = np.asarray(nxt)
+        for i, s in enumerate(sessions):
+            s.position += 1
+            tok = int(nxt[i])
+            s.out.append(tok)
+            s.pending_tok = tok
+            if self.window is not None:
+                self.scheduler.retire_window_blocks(s, self.window)
+            if s.finished():
+                self._finish(s)
+
+    def _finish(self, s: Session) -> None:
+        self.results[s.rid] = list(s.out)
+        s.t_done = time.monotonic()
+        _obs.histogram("serve.e2e_ms").observe(
+            (s.t_done - s.t_queued) * 1e3)
+        _obs.event("serve.request", rid=s.rid, phase="done",
+                   tick=self._tick, generated=len(s.out))
+        self.scheduler.finish(s)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def tick(self) -> int:
+        """Ticks executed so far — the loop's logical clock (open-loop
+        arrival traces index into it)."""
+        return self._tick
+
+    def metrics(self) -> dict:
+        """SLO snapshot: compile/dispatch counters per serve kind plus
+        the engine's own gauges/histograms."""
+        from ..runtime import step_cache as _sc
+        snap = _obs.get_registry().snapshot()
+        return {
+            "decode": _sc.kind_stats("decode_step"),
+            "prefill": _sc.kind_stats("prefill_step"),
+            "pool_occupancy": self.block_pool.occupancy,
+            "queue_depth": len(self.scheduler.queue),
+            "histograms": {k: v for k, v in snap["histograms"].items()
+                           if k.startswith("serve.")},
+        }

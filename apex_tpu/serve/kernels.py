@@ -1,0 +1,260 @@
+"""Paged-attention program bodies: the traced code the serve engine
+submits through the one-runtime executor.
+
+Everything here is shape-static by construction — operand shapes are
+functions of the POOL geometry (layers/heads/block_size/head_dim) and
+the BUCKET dims (batch, blocks, chunk) baked into the builder, never of
+live request state.  Request state (which sessions, at which positions,
+holding which blocks) enters as *traced integer arrays* (tokens,
+positions, block tables), so session churn re-dispatches the same
+compiled program instead of retracing — the serving analogue of the
+step-cache keying discipline, enforced by the SERVE-SHAPE lint rule.
+
+The attention math deliberately reuses the model's own decode pieces —
+``GptBlock._chunk_qkv`` (LN1 + interleaved QKV projection),
+``GptBlock._attn_mlp_tail`` (out-proj + residual + FFN), the fp32
+score einsum + ``-1e30`` mask + softmax of ``GptBlock.decode_chunk``,
+and the int8-aware ``gather_rows`` embedding lookup — so the paged
+path cannot drift numerically from the contiguous-cache path it is
+parity-tested against (tests/test_serve.py).  The only new math is the
+index plumbing: block-table gathers into a per-tick linear cache view,
+and position→(block, offset) scatters of fresh KV.
+
+Dead batch rows (bucket padding) are encoded as ``position == -1``:
+their tables are all-null (gathers read zeros the mask excludes), their
+embedding lookups clip to row 0 (outputs discarded), and their KV
+scatter targets are redirected past the pool so ``mode="drop"``
+discards the write — padding never touches the null block's zeros.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..inference.quant import QuantKV, absmax_int8, gather_rows
+from ..nn.modules import Ctx
+
+_f32 = jnp.float32
+
+
+def _ctx(params, vals):
+    return Ctx(env={id(p): v for p, v in zip(params, vals)},
+               stats_out={}, training=False)
+
+
+# ---------------------------------------------------------------------------
+# Pool indexing: block-table gather / position scatter
+# ---------------------------------------------------------------------------
+
+
+def gather_pool(pool, tables):
+    """Gather each session's blocks into a LINEAR cache view.
+
+    ``tables (B, nb)`` physical ids -> per-layer reader ``read(l)``
+    returning ``(k, v)`` of shape ``(B, H, nb*block_size, D)`` fp32,
+    where linear slot ``s`` holds the KV of logical position ``s`` (the
+    table is logical-block-ordered, so the gather IS the
+    logical→physical translation).  Null-padded table entries read the
+    zero block — masked out by the caller's position-validity mask.
+    QuantKV pools gather int8 payload + scales and dequantize after the
+    gather (only the selected blocks' bytes move)."""
+    def lin(g):
+        # (B, nb, H, bs, D) -> (B, H, nb*bs, D)
+        b, nb, h, bs, d = g.shape
+        return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(b, h, nb * bs, d)
+
+    if isinstance(pool, QuantKV):
+        q = pool.q[:, :, tables]          # (L, 2, B, nb, H, bs, D)
+        s = pool.scale[:, :, tables]      # (L, 2, B, nb, H, bs, 1)
+
+        def read(layer):
+            return (lin(q[layer, 0]).astype(_f32) * lin(s[layer, 0]),
+                    lin(q[layer, 1]).astype(_f32) * lin(s[layer, 1]))
+        return read
+    g = pool[:, :, tables]                # (L, 2, B, nb, H, bs, D)
+
+    def read(layer):
+        return lin(g[layer, 0]).astype(_f32), lin(g[layer, 1]).astype(_f32)
+    return read
+
+
+def scatter_pool(pool, layer, kv, blk_ids, offs, vals):
+    """Write ``vals (R, H, D)`` into ``pool[layer, kv]`` at physical
+    block ``blk_ids (R,)``, in-block offset ``offs (R,)``.  Rows whose
+    ``blk_ids`` point past the pool are dropped (``mode="drop"``) —
+    the caller encodes dead/pad rows that way.  QuantKV pools quantize
+    per position (absmax over D — identical stored bytes to the
+    contiguous int8 cache's write path)."""
+    if isinstance(pool, QuantKV):
+        q, scale = absmax_int8(vals.astype(_f32), -1, pool.scale.dtype)
+        return QuantKV(
+            pool.q.at[layer, kv, blk_ids, :, offs, :].set(
+                q, mode="drop"),
+            pool.scale.at[layer, kv, blk_ids, :, offs, :].set(
+                scale, mode="drop"))
+    return pool.at[layer, kv, blk_ids, :, offs, :].set(
+        vals.astype(pool.dtype), mode="drop")
+
+
+def insert_row(pool, k_lin, v_lin, k_new, v_new, own):
+    """Splice the just-projected KV row(s) into the gathered linear
+    view so the current query attends its own fresh keys (the paged
+    analogue of decode_chunk's write-then-read).  Through an int8 pool
+    the inserted rows take the quantize→dequantize round trip FIRST, so
+    attention reads exactly the bytes the scatter will store."""
+    if isinstance(pool, QuantKV):
+        kq, ks = absmax_int8(k_new.astype(_f32), -1, pool.scale.dtype)
+        vq, vs = absmax_int8(v_new.astype(_f32), -1, pool.scale.dtype)
+        k_new = kq.astype(_f32) * ks
+        v_new = vq.astype(_f32) * vs
+    return (jnp.where(own, k_new.astype(_f32), k_lin),
+            jnp.where(own, v_new.astype(_f32), v_lin))
+
+
+# ---------------------------------------------------------------------------
+# Program bodies
+# ---------------------------------------------------------------------------
+
+
+def _paged_attend(blk, x, q, k_lin, v_lin, positions, slots, window):
+    """decode_chunk's score/mask/softmax/combine against a gathered
+    linear cache: ``q (B, H, Q, D)``, per-row query positions
+    ``positions (B, Q)``.  ``window`` adds the sliding-window band term
+    (rolling.py's mask, generalized to block tables)."""
+    scores = jnp.einsum("bhqd,bhsd->bhqs", q.astype(_f32),
+                        k_lin) * blk.attn.scaling
+    valid = slots[None, None, :] <= positions[:, :, None]   # (B, Q, S)
+    if window is not None:
+        valid = valid & (slots[None, None, :]
+                         > positions[:, :, None] - window)
+    scores = jnp.where(valid[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhqs,bhsd->bhqd", probs, v_lin).astype(x.dtype)
+    b, h, s_q, d = q.shape
+    return jnp.swapaxes(o, 1, 2).reshape(b, s_q, h * d)
+
+
+def _embed(ctx, model, toks, positions):
+    """Token + position embedding with int8-aware row gathers;
+    ``positions`` clip to the table (pad rows only — real positions are
+    range-checked at admission, where the bound is a host decision, not
+    here where a clamp would silently corrupt)."""
+    n_pos = model.pos_emb.weight.shape[0]
+    pos = jnp.clip(positions, 0, n_pos - 1)
+    return gather_rows(ctx, model.tok_emb.weight, toks) \
+        + gather_rows(ctx, model.pos_emb.weight, pos)
+
+
+def _head(ctx, model, x):
+    emb = ctx.value(model.tok_emb.weight)
+    return model._mask_pad_logits(
+        jnp.matmul(x, jnp.swapaxes(emb, 0, 1).astype(x.dtype)))
+
+
+def build_decode_fn(model, params, block_size, num_blocks, window=None):
+    """The decode-tick program body: one token per live session.
+
+    ``fn(vals, pool, tokens, positions, tables) ->
+    (next_tokens, logits, pool)`` with ``tokens (B,)`` the last emitted
+    token per session, ``positions (B,)`` its ingest position (``-1`` =
+    dead pad row), ``tables (B, nb)``.  Greedy sampling happens
+    in-program (argmax over the masked logits — the same reduction the
+    session path's ``make_sampler(0, ...)`` runs), so the engine's host
+    round-trip per tick is one small int array; the logits ride along
+    as an un-fetched device array for clients (PagedSession) that
+    continue from them."""
+    bs = block_size
+
+    def fn(vals, pool, tokens, positions, tables):
+        ctx = _ctx(params, vals)
+        x = _embed(ctx, model, tokens[:, None], positions[:, None])
+        read = gather_pool(pool, tables)
+        slots = jnp.arange(tables.shape[1] * bs, dtype=jnp.int32)
+        fresh = []
+        for layer, blk in enumerate(model.blocks):
+            q, k_new, v_new = blk._chunk_qkv(ctx, x)      # (B, H, 1, D)
+            k_lin, v_lin = read(layer)
+            own = (slots[None, :]
+                   == positions[:, None])[:, None, :, None]
+            k_lin, v_lin = insert_row(pool, k_lin, v_lin, k_new, v_new,
+                                      own)
+            o = _paged_attend(blk, x, q, k_lin, v_lin,
+                              positions[:, None], slots, window)
+            x = blk._attn_mlp_tail(ctx, x, o)
+            fresh.append((k_new, v_new))
+        x = model.ln_f.forward(ctx, x)
+        logits = _head(ctx, model, x)[:, 0]               # (B, V)
+        nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+        # position -> (physical block, offset); dead rows drop
+        p = jnp.clip(positions, 0)
+        tgt = jnp.take_along_axis(
+            tables, jnp.minimum(p // bs, tables.shape[1] - 1)[:, None],
+            axis=1)[:, 0]
+        tgt = jnp.where(positions >= 0, tgt, num_blocks)
+        offs = p % bs
+        for layer, (k_new, v_new) in enumerate(fresh):
+            pool = scatter_pool(pool, layer, 0, tgt, offs,
+                                k_new[:, :, 0, :])
+            pool = scatter_pool(pool, layer, 1, tgt, offs,
+                                v_new[:, :, 0, :])
+        return nxt, logits, pool
+    return fn
+
+
+def build_prefill_fn(model, params, block_size, num_blocks,
+                     window=None):
+    """The prefill-chunk program body: ingest one fixed-width chunk of
+    ONE session's prompt per dispatch (long prompts run as several
+    chunks, interleaved with decode ticks so they never stall the
+    batch).
+
+    ``fn(vals, pool, toks, table, t0, n_real) -> (last_logits, pool)``
+    with ``toks (1, chunk)`` zero-padded past ``n_real``, ``table
+    (1, nb)``, ``t0`` the chunk's first position, ``n_real`` the live
+    prefix length (both traced i32 — the bucketed chunk width, not the
+    prompt length, keys compilation).  ``last_logits (1, V)`` is row
+    ``n_real - 1`` — the next-token distribution once the final chunk
+    lands."""
+    bs = block_size
+
+    def fn(vals, pool, toks, table, t0, n_real):
+        ctx = _ctx(params, vals)
+        chunk = toks.shape[1]
+        rows = jnp.arange(chunk, dtype=jnp.int32)
+        pos = t0 + rows                                   # (chunk,)
+        x = _embed(ctx, model, toks, pos[None, :])
+        read = gather_pool(pool, table)
+        nb = table.shape[1]
+        slots = jnp.arange(nb * bs, dtype=jnp.int32)
+        # chunk row d lands in linear slot t0 + d; live rows only
+        # (the rolling_kv_write masked-select technique, block-tabled)
+        d = slots - t0                                    # (S,)
+        own = ((d >= 0) & (d < n_real))[None, None, :, None]
+        src = jnp.clip(d, 0, chunk - 1)
+        fresh = []
+        for layer, blk in enumerate(model.blocks):
+            q, k_new, v_new = blk._chunk_qkv(ctx, x)   # (B, H, chunk, D)
+            k_lin, v_lin = read(layer)
+            k_ins = jnp.take(k_new, src, axis=2)       # (B, H, S, D)
+            v_ins = jnp.take(v_new, src, axis=2)
+            k_lin, v_lin = insert_row(pool, k_lin, v_lin, k_ins, v_ins,
+                                      own)
+            o = _paged_attend(blk, x, q, k_lin, v_lin, pos[None, :],
+                              slots, window)
+            x = blk._attn_mlp_tail(ctx, x, o)
+            fresh.append((k_new, v_new))
+        x = model.ln_f.forward(ctx, x)
+        logits = _head(ctx, model, x)                  # (1, chunk, V)
+        last = jax.lax.dynamic_index_in_dim(
+            logits, jnp.clip(n_real - 1, 0), axis=1, keepdims=False)
+        live = rows < n_real
+        tgt = table[0, jnp.minimum(pos // bs, nb - 1)]  # (chunk,)
+        tgt = jnp.where(live, tgt, num_blocks)
+        offs = pos % bs
+        for layer, (k_new, v_new) in enumerate(fresh):
+            pool = scatter_pool(pool, layer, 0, tgt, offs,
+                                jnp.swapaxes(k_new[0], 0, 1))
+            pool = scatter_pool(pool, layer, 1, tgt, offs,
+                                jnp.swapaxes(v_new[0], 0, 1))
+        return last, pool
+    return fn

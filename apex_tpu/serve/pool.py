@@ -1,0 +1,158 @@
+"""Paged KV block pool: one preallocated HBM buffer for every session.
+
+The single-session decode paths (inference/session.py, models.gpt
+generate) each allocate private ``(B, H, S_max, D)`` caches sized for
+their own worst case — at serving concurrency that is the classic
+fragmentation failure: a thousand mostly-short sessions reserve a
+thousand full-context caches.  vLLM's paged-attention observation is
+that KV state is append-only and block-granular, so sessions can share
+ONE fixed pool of ``block_size``-position blocks and hold only an
+integer block table (logical block i -> physical block id).  HBM for
+the serving tier becomes a single static allocation; admission control
+is an integer free-list; and — the property the whole serve engine is
+built around — the decode program's operand shapes depend only on the
+POOL geometry and the bucket dims, never on which sessions are resident,
+so session churn cannot force a recompile.
+
+Layout: ``(layers, 2, num_blocks, heads, block_size, head_dim)`` —
+k/v interleaved on axis 1 so one gather serves both, block id on axis 2
+so a session's table indexes one axis.  **Physical block 0 is the null
+block**: it is never allocated, stays all-zeros, and pads every block
+table out to its bucket width — gathers through it read zeros that the
+position-validity mask already excludes, so padding is free instead of
+a branch.  ``dtype="int8"`` builds the quantized pool as a
+:class:`~apex_tpu.inference.quant.QuantKV` (int8 payload + one fp32
+scale per cached position — the same per-position absmax convention as
+the contiguous int8 cache, via :func:`~apex_tpu.inference.quant.
+absmax_int8`).
+
+The host side (:class:`BlockPool`) is deliberately dumb: a LIFO
+free-list with leak accounting.  Policy (who gets blocks, who is
+preempted) lives in the scheduler; device-side index arithmetic lives
+in serve/kernels.py.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from ..inference.quant import QuantKV
+from ..observe import registry as _obs
+
+#: physical id of the all-zeros block every table pads with
+NULL_BLOCK = 0
+
+
+def blocks_for(n_positions: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_positions`` KV rows."""
+    return -(-max(int(n_positions), 0) // block_size)
+
+
+def init_pool_buffer(layers, heads, head_dim, num_blocks, block_size,
+                     dtype=jnp.float32):
+    """The device-side pool array
+    ``(layers, 2, num_blocks, heads, block_size, head_dim)`` — zeros, so
+    the null block is born valid.  ``dtype="int8"``/``jnp.int8`` builds
+    the :class:`QuantKV` pair (scales fp32, one per position)."""
+    shape = (layers, 2, num_blocks, heads, block_size, head_dim)
+    if jnp.dtype(dtype) == jnp.dtype("int8"):
+        return QuantKV(jnp.zeros(shape, jnp.int8),
+                       jnp.zeros(shape[:-1] + (1,), jnp.float32))
+    return jnp.zeros(shape, dtype)
+
+
+class BlockPool:
+    """Host-side free-list over physical block ids ``1 .. num_blocks-1``
+    (id 0 is :data:`NULL_BLOCK`, never handed out).
+
+    ``alloc(n)`` returns ``n`` ids or None (all-or-nothing — a partial
+    grant would deadlock two half-admitted sessions against each
+    other); ``free(ids)`` returns them.  Every transition keeps the
+    ``serve.pool_occupancy`` gauge current and double-free / foreign-id
+    frees raise — leaked blocks are the serving analogue of a memory
+    leak and the churn tests pin ``in_use == 0`` after drain.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 metrics_prefix: str = "serve."):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the reserved null "
+                f"block), got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._prefix = metrics_prefix
+        self._lock = threading.Lock()
+        # LIFO: recently freed blocks are re-issued first (their pool
+        # rows are hottest in cache on CPU runs; on TPU it is a wash)
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._held = set()
+        self._gauge()
+
+    # -- accounting --------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (the null block is not one)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return len(self._held)
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of allocatable blocks currently held."""
+        with self._lock:
+            return len(self._held) / (self.num_blocks - 1)
+
+    def _gauge(self):
+        _obs.gauge(self._prefix + "pool_occupancy").set(
+            len(self._held) / (self.num_blocks - 1))
+        _obs.gauge(self._prefix + "pool_free_blocks").set(len(self._free))
+
+    # -- alloc / free ------------------------------------------------------
+
+    def alloc(self, n: int):
+        """``n`` physical block ids, or None if the pool cannot cover
+        the whole request (nothing is taken on refusal)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        with self._lock:
+            if n > len(self._free):
+                return None
+            ids = [self._free.pop() for _ in range(n)]
+            self._held.update(ids)
+            self._gauge()
+        return ids
+
+    def free(self, ids) -> None:
+        with self._lock:
+            for b in ids:
+                if b not in self._held:
+                    raise ValueError(
+                        f"free of block {b} not held by this pool "
+                        f"(double free or foreign id) — block tables "
+                        f"and the free list have diverged")
+                self._held.discard(b)
+                self._free.append(b)
+            self._gauge()
+
+    def check_no_leaks(self) -> None:
+        """Raise unless every allocatable block is back on the free
+        list — the post-drain invariant of the churn tests."""
+        with self._lock:
+            if self._held or len(self._free) != self.num_blocks - 1:
+                raise AssertionError(
+                    f"block pool leak: {len(self._held)} blocks still "
+                    f"held, free list {len(self._free)}/"
+                    f"{self.num_blocks - 1}")

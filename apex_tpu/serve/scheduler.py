@@ -1,0 +1,286 @@
+"""Continuous-batching scheduler: requests -> per-tick packed batches.
+
+Static-batch serving admits N requests, runs them in lockstep, and
+returns when the LAST one finishes — short requests pay the longest
+request's latency and the batch slots they vacate idle.  Continuous
+batching (Orca's iteration-level scheduling, vLLM's default) re-packs
+the live set every tick: a session that finishes frees its batch slot
+and its KV blocks *this* tick, and a queued request can take them the
+next.  This module is the host-side half of that loop — pure Python
+over integers, deterministic for a given request/arrival stream (the
+packing-determinism test replays a seeded Poisson trace twice and
+diffs the decisions).
+
+Three policies live here, and only here (the device programs in
+serve/kernels.py are policy-free):
+
+* **admission** — FIFO, gated on three budgets: batch slots
+  (``max_batch``), KV blocks (the prompt plus one decode block of
+  headroom must fit the pool *whole* — half-admitted sessions would
+  deadlock), and prefill backlog (``max_prefill_backlog`` tokens not
+  yet ingested across admitted sessions — the queue-depth/token-budget
+  backpressure that keeps time-to-first-token bounded under load:
+  admitting a 30th long prompt helps nobody's SLO).
+* **packing** — every decode tick takes ALL decoding sessions (in
+  admission order), padded to the next batch bucket; block tables pad
+  to the next block bucket.  Buckets are powers of two, so the set of
+  decode program shapes is ``O(log(max_batch) · log(max_blocks))`` —
+  the recompile-free-after-warmup property the step cache pins.
+* **preemption** — when a decode tick needs a block and the pool is
+  dry, the LAST-admitted session is evicted (LIFO victim: it has the
+  least sunk prefill work and FIFO fairness protects the oldest),
+  its blocks freed, and it re-queues at the queue's FRONT in recompute
+  mode: on re-admission it re-prefills prompt + tokens generated so
+  far, then continues decoding — greedy decode makes the recomputed
+  continuation identical to the one the eviction interrupted.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from .pool import BlockPool, NULL_BLOCK, blocks_for
+
+QUEUED, PREFILL, DECODE, DONE = "queued", "prefill", "decode", "done"
+
+
+def bucket(n: int, cap: Optional[int] = None) -> int:
+    """Next power of two >= n (>= 1); ``cap`` bounds it (a request that
+    legitimately needs more than cap is the caller's validation bug)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b if cap is None else min(b, cap)
+
+
+@dataclass
+class Request:
+    """One serving request: ``prompt`` token ids, up to
+    ``max_new_tokens`` generated (greedy), optional ``eos`` stop id
+    (emitted, then the session finishes)."""
+    rid: str
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    eos: Optional[int] = None
+
+    def __post_init__(self):
+        self.prompt = tuple(int(t) for t in self.prompt)
+        if not self.prompt:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.rid}: max_new_tokens must be >= 1, got "
+                f"{self.max_new_tokens}")
+
+
+@dataclass
+class Session:
+    """Scheduler-side state of one admitted request.  The KV state a
+    session owns is exactly ``table`` (physical block ids) plus
+    ``position`` (KV rows written) — no private cache buffer; the pool
+    holds the bytes."""
+    request: Request
+    seq: int                               # admission order (preemption)
+    table: List[int] = field(default_factory=list)
+    position: int = 0                      # KV rows written so far
+    state: str = PREFILL
+    prefill_src: Tuple[int, ...] = ()      # tokens still to ingest
+    emit_on_prefill: bool = True           # fresh: 1st token from logits
+    pending_tok: Optional[int] = None      # next token to ingest
+    out: List[int] = field(default_factory=list)
+    # lifecycle timestamps (engine-stamped, telemetry only — no
+    # scheduling decision reads them, so packing stays deterministic)
+    t_queued: float = 0.0
+    t_first: Optional[float] = None
+    t_done: Optional[float] = None
+
+    @property
+    def rid(self) -> str:
+        return self.request.rid
+
+    @property
+    def prefill_remaining(self) -> int:
+        return len(self.prefill_src) - self.position
+
+    def finished(self) -> bool:
+        r = self.request
+        return len(self.out) >= r.max_new_tokens or \
+            (r.eos is not None and self.out and self.out[-1] == r.eos)
+
+
+class Scheduler:
+    """The per-tick policy engine.  Owns the request queue and the live
+    session set; the serve engine calls, in tick order: ``admit()``,
+    ``next_prefill()``, ``decode_sessions()`` (+ ``grow()`` /
+    ``preempt_for()`` when blocks run out), and ``finish()``."""
+
+    def __init__(self, pool: BlockPool, *, max_batch: int,
+                 prefill_chunk: int, max_prefill_backlog: int,
+                 max_positions: int):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.pool = pool
+        self.max_batch = max_batch
+        self.prefill_chunk = prefill_chunk
+        self.max_prefill_backlog = max_prefill_backlog
+        self.max_positions = max_positions
+        self.queue: deque = deque()
+        self.sessions: List[Session] = []      # admission order
+        self._seq = 0
+        self.rejected: List[str] = []
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        """Queue a request (FIFO).  Requests that can NEVER fit — more
+        positions than the model or the whole pool can hold — are
+        rejected now, loudly, instead of deadlocking the queue head."""
+        need = len(request.prompt) + request.max_new_tokens
+        cap_blocks = self.pool.capacity
+        if need > self.max_positions or \
+                blocks_for(need, self.pool.block_size) > cap_blocks:
+            self.rejected.append(request.rid)
+            raise ValueError(
+                f"request {request.rid}: {need} positions exceed "
+                f"max_positions {self.max_positions} / pool capacity "
+                f"{cap_blocks * self.pool.block_size}")
+        self.queue.append(Session(request, -1))
+
+    def _backlog_tokens(self) -> int:
+        return sum(s.prefill_remaining for s in self.sessions
+                   if s.state == PREFILL)
+
+    def admit(self) -> List[Session]:
+        """Move queue-head sessions into the live set while every budget
+        (batch slots, whole-prompt blocks + headroom, prefill backlog)
+        holds.  All-or-nothing per session; FIFO order preserved."""
+        admitted = []
+        while self.queue:
+            s = self.queue[0]
+            if len(self.sessions) >= self.max_batch:
+                break
+            # fresh sessions ingest the prompt; preempted ones carry
+            # their recompute source from preempt_for
+            src = s.prefill_src if s.pending_tok is not None \
+                else s.request.prompt
+            need = blocks_for(len(src) + 1, self.pool.block_size)
+            if self._backlog_tokens() + len(src) \
+                    > self.max_prefill_backlog and self.sessions:
+                break
+            ids = self.pool.alloc(need)
+            if ids is None:
+                break
+            self.queue.popleft()
+            s.seq = self._seq
+            self._seq += 1
+            s.table = ids
+            s.position = 0
+            s.state = PREFILL
+            s.prefill_src = src
+            self.sessions.append(s)
+            admitted.append(s)
+        return admitted
+
+    # -- per-tick views ----------------------------------------------------
+
+    def next_prefill(self) -> Optional[Session]:
+        for s in self.sessions:
+            if s.state == PREFILL:
+                return s
+        return None
+
+    def decode_sessions(self) -> List[Session]:
+        return [s for s in self.sessions if s.state == DECODE]
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or bool(self.sessions)
+
+    # -- block growth / preemption ----------------------------------------
+
+    def grow(self, s: Session, n_positions: int) -> bool:
+        """Extend ``s.table`` to cover ``n_positions`` KV rows; False if
+        the pool is dry (caller preempts and retries)."""
+        need = blocks_for(n_positions, self.pool.block_size) \
+            - len(s.table)
+        if need <= 0:
+            return True
+        ids = self.pool.alloc(need)
+        if ids is None:
+            return False
+        s.table.extend(ids)
+        return True
+
+    def preempt_for(self, needy: Session) -> Optional[Session]:
+        """Evict the last-admitted live session other than ``needy``
+        (or ``needy`` itself if it is alone — it re-queues with its
+        progress and re-admits when blocks exist).  Freed state:
+        ALL the victim's blocks; the victim re-enters the queue FRONT
+        in recompute mode."""
+        victims = [s for s in self.sessions if s is not needy]
+        victim = max(victims, key=lambda s: s.seq) if victims else needy
+        self.pool.free(b for b in victim.table if b != NULL_BLOCK)
+        self.sessions.remove(victim)
+        victim.table = []
+        victim.position = 0
+        victim.state = QUEUED
+        if victim.out:
+            # recompute mode: re-prefill prompt + generated-so-far
+            # except the last token, which is still waiting to be
+            # ingested — it becomes pending again after re-prefill
+            victim.prefill_src = victim.request.prompt \
+                + tuple(victim.out[:-1])
+            victim.emit_on_prefill = False
+            victim.pending_tok = victim.out[-1]
+        else:
+            victim.prefill_src = victim.request.prompt
+            victim.emit_on_prefill = True
+            victim.pending_tok = None
+        self.queue.appendleft(victim)
+        return victim
+
+    def finish(self, s: Session) -> None:
+        self.pool.free(b for b in s.table if b != NULL_BLOCK)
+        s.table = []
+        s.state = DONE
+        self.sessions.remove(s)
+
+    def retire_window_blocks(self, s: Session, window: int) -> int:
+        """Free the leading blocks of a sliding-window session that no
+        future query's band can reach (rolling.py's closed form,
+        block-tabled).  Retired table entries become NULL — logical
+        indexing is positional, so the prefix stays, pointing at the
+        zero block the band mask already excludes.  Returns the number
+        of blocks returned to the pool."""
+        from ..inference.rolling import window_retired_blocks
+        n = window_retired_blocks(s.position, window,
+                                  self.pool.block_size)
+        freed = [b for b in s.table[:n] if b != NULL_BLOCK]
+        if freed:
+            self.pool.free(freed)
+            for i in range(n):
+                s.table[i] = NULL_BLOCK
+        return len(freed)
+
+    # -- packing -----------------------------------------------------------
+
+    def pack_decode(self, sessions: List[Session]):
+        """Bucketed operand arrays for one decode tick:
+        ``(bucket_batch, bucket_blocks, tokens, positions, tables)``
+        as host int32 lists — dead rows carry ``position = -1`` and
+        all-null tables (the kernels' drop encoding)."""
+        b = bucket(len(sessions), self.max_batch)
+        nb = bucket(max(len(s.table) for s in sessions))
+        tokens, positions, tables = [], [], []
+        for s in sessions:
+            tokens.append(s.pending_tok)
+            positions.append(s.position)
+            tables.append(s.table + [NULL_BLOCK] * (nb - len(s.table)))
+        for _ in range(b - len(sessions)):
+            tokens.append(0)
+            positions.append(-1)
+            tables.append([NULL_BLOCK] * nb)
+        return b, nb, tokens, positions, tables

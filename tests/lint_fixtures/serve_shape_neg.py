@@ -1,0 +1,32 @@
+"""SERVE-SHAPE negative: serving programs keyed on config only, with
+every request-dependent extent rounded through the bucket table before
+it reaches program identity; operand signatures complete the cache key."""
+import itertools
+
+from apex_tpu.runtime import executor as _executor
+from apex_tpu.serve.scheduler import bucket
+
+_TOKENS = itertools.count()
+
+
+def make_programs(block_size, dtype_name, window, build_decode,
+                  build_prefill):
+    # GOOD: static key is pure config + a monotonic builder token —
+    # bucketed operand shapes complete the key via the signature
+    key = (next(_TOKENS), block_size, dtype_name, window)
+    decode = _executor.Program("decode_step", key, build_decode)
+    prefill = _executor.Program("prefill_step", key, build_prefill)
+    return prefill, decode
+
+
+def pack_batch(sessions, max_batch):
+    # GOOD: len() rounded through the bucket table before it can
+    # influence any program shape — O(log) distinct values
+    b = bucket(len(sessions), max_batch)
+    nb = bucket(max(len(s.table) for s in sessions))
+    return b, nb
+
+
+def train_key(batch):
+    # GOOD: non-serve kinds are out of scope for this rule
+    return _executor.Program("train_step", (len(batch),), lambda x: x)

@@ -1,0 +1,21 @@
+"""SERVE-SHAPE positive: raw per-request extents keying / steering
+serving programs — every distinct request length compiles a fresh
+executable, so recompilation scales with traffic."""
+from apex_tpu.runtime import executor as _executor
+
+
+def make_decode_program(tokens, tables, build_fn):
+    # BAD: operand extents straight into the static key — one program
+    # per occupancy x table length, unbounded over a request stream
+    key = (tokens.shape[0], len(tables[0]))
+    return _executor.Program("decode_step", key, build_fn)
+
+
+def make_prefill_program(prompt, build_fn):
+    # BAD: prompt length steers which program gets built — the same
+    # recompile surface as keying on it
+    if len(prompt) > 32:
+        key = ("long", len(prompt))
+    else:
+        key = ("short", len(prompt))
+    return _executor.Program("prefill_step", key, build_fn)

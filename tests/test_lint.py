@@ -32,6 +32,7 @@ RULE_FIXTURES = {
     "CKPT-ATOMIC": "ckpt_atomic",
     "OBS-IN-JIT": "obs_in_jit",
     "EXEC-BYPASS": "exec_bypass",
+    "SERVE-SHAPE": "serve_shape",
 }
 
 
@@ -51,7 +52,7 @@ def _run(paths, **kw):
 
 def test_registry_covers_required_rules():
     assert set(RULE_FIXTURES) <= set(rules.rule_ids())
-    assert len(rules.rule_ids()) >= 7
+    assert len(rules.rule_ids()) >= 11
 
 
 @pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
