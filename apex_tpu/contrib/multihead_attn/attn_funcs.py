@@ -32,8 +32,8 @@ import jax
 import jax.numpy as jnp
 
 from ...nn.functional import dropout_mask
-from ...ops.pallas import pallas_mode
-from ...ops.pallas import attention as _k
+from ...kernels.dispatch import pallas_mode
+from ...kernels import attention as _k
 
 _f32 = jnp.float32
 _NEG = -1e30
@@ -41,32 +41,22 @@ _NEG = -1e30
 
 def _flash_min_sk():
     """Key-length threshold below which compiled dispatch prefers XLA's
-    own attention over the Pallas flash kernel.
-
-    Measured on v5e (bench --kernels-timing, fwd+bwd).  Round 3, before
-    causal block skipping: S=256 ran 0.82x XLA.  Round 4, with skipping
-    (BENCH_HISTORY round-4 A/B table): S=256 1.06x, S=512 0.96x (both
-    noise-level), S=1024 causal 1.24x, S=2048/D=128 1.19x, banded
-    S=2048/w=256 1.82x — flash decisively wins the shapes it exists
-    for, and the 256-512 boundary is a wash (the score-byte cap below
-    routes big-batch S=512 to flash regardless).  Override with
-    APEX_TPU_FLASH_MIN_SK (0 forces flash everywhere)."""
-    import os
-    return int(os.environ.get("APEX_TPU_FLASH_MIN_SK", 512))
+    own attention over the Pallas flash kernel — the kernel module owns
+    the measured boundary (env override > ledger-measured win > the 512
+    round-4 prior; see :func:`apex_tpu.kernels.attention.flash_min_sk`
+    for the v5e receipts)."""
+    return _k.flash_min_sk()
 
 
-# the XLA fallback's score tensor (fwd scores + softmax residual for
-# backward, f32) must also stay SMALL in absolute terms — key length
-# alone ignores the B*H factor.  128 MB keeps the fallback's footprint
-# noise-level next to activations; beyond it flash's O(S) memory is the
-# point even where it is a little slower per-FLOP.
-_XLA_SCORES_BYTE_CAP = 128 * 1024 * 1024
+_XLA_SCORES_BYTE_CAP = _k.XLA_SCORES_BYTE_CAP
 
 
 def _use_xla_attention(b, h, sq, sk):
     """Compiled-mode dispatch: take the materializing XLA path only when
     it is both faster (short keys) and memory-harmless (small total
-    score tensor)."""
+    score tensor).  Kept as the shape-level oracle; ``flash_attention``
+    itself decides through ``kernels.dispatch`` so ledger entries can
+    override per shape."""
     return sk < _flash_min_sk() and \
         b * h * sq * sk * 4 <= _XLA_SCORES_BYTE_CAP
 
@@ -190,12 +180,16 @@ def flash_attention(q4, k4, v4, bias=None, causal=False, scale=None,
     if scale is None:
         scale = 1.0 / math.sqrt(q4.shape[-1])
     mode = pallas_mode()
-    # compiled dispatch is shape-aware: below the measured crossover the
-    # materializing XLA path is faster AND memory-harmless (interpret
-    # mode still runs the kernel — that mode exists to test it)
-    if mode is None or (mode == "compiled"
-                        and _use_xla_attention(*q4.shape[:2],
-                                               q4.shape[2], k4.shape[2])):
+    # dispatch policy: the registered probe encodes the measured
+    # crossover (min-sk boundary + score-byte cap) and a ledger entry
+    # for this chip/shape overrides it; the decision is trace-time
+    # static and lands in the observe event log (kernels.dispatch)
+    from ...kernels.dispatch import attention_fp, decide
+    b, h, sq, d = q4.shape
+    tier = decide("flash_attention",
+                  attention_fp(b, h, sq, k4.shape[2], d, q4.dtype,
+                               causal)).tier
+    if mode is None or tier == "xla":
         if bias is not None:
             bias = jax.lax.stop_gradient(bias)
         return attention_reference(q4, k4, v4, bias, causal, scale,

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...ops.pallas import MASKED_FILL
+from ...kernels.dispatch import MASKED_FILL
 from .softmax_xentropy import softmax_cross_entropy_loss
 
 

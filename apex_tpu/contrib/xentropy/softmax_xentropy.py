@@ -47,8 +47,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...ops.pallas import MASKED_LOGIT_THR as _MASK_THR
-from ...ops.pallas import pallas_mode as _pallas_mode
+from ...kernels.dispatch import MASKED_LOGIT_THR as _MASK_THR
+from ...kernels.dispatch import pallas_mode as _pallas_mode
 
 _f32 = jnp.float32
 # Single-shot threshold, in logits elements: one f32 temporary of this size
@@ -136,7 +136,7 @@ def _fwd_math(logits, labels, smoothing, padding_idx):
     n = math.prod(lead)
     mode = _pallas_mode()
     if _use_kernel(mode):
-        from ...ops.pallas.xentropy import xent_forward
+        from ...kernels.xentropy import xent_forward
         losses, lse = xent_forward(
             logits.reshape(n, c), labels.reshape(n), smoothing,
             padding_idx, interpret=(mode == "interpret"))
@@ -187,7 +187,7 @@ def _bwd(smoothing, padding_idx, half_to_float, res, g):
     n = math.prod(logits.shape[:-1])
     mode = _pallas_mode()
     if _use_kernel(mode):
-        from ...ops.pallas.xentropy import xent_backward
+        from ...kernels.xentropy import xent_backward
         lab = labels.reshape(n)
         gm = jnp.where(lab == padding_idx, 0.0,
                        g.reshape(n).astype(_f32))

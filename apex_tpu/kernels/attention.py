@@ -505,3 +505,60 @@ def flash_attention_bwd(q3, k3, v3, bias, out, lse, g, scale, causal,
         interpret=interpret,
     )(*args2)
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+
+
+# ---------------------------------------------------------------------------
+# Dispatch registration
+# ---------------------------------------------------------------------------
+
+# the XLA fallback's score tensor (fwd scores + softmax residual for
+# backward, f32) must also stay SMALL in absolute terms — key length
+# alone ignores the B*H factor.  128 MB keeps the fallback's footprint
+# noise-level next to activations; beyond it flash's O(S) memory is the
+# point even where it is a little slower per-FLOP.
+XLA_SCORES_BYTE_CAP = 128 * 1024 * 1024
+
+
+def flash_min_sk() -> int:
+    """Key-length threshold below which compiled dispatch prefers XLA's
+    own attention over the flash kernel.
+
+    Measured on v5e (bench --kernels-timing, fwd+bwd).  Round 3, before
+    causal block skipping: S=256 ran 0.82x XLA.  Round 4, with skipping
+    (BENCH_HISTORY round-4 A/B table): S=256 1.06x, S=512 0.96x (both
+    noise-level), S=1024 causal 1.24x, S=2048/D=128 1.19x, banded
+    S=2048/w=256 1.82x — flash decisively wins the shapes it exists
+    for, and the 256-512 boundary is a wash.  APEX_TPU_FLASH_MIN_SK
+    overrides (0 forces flash everywhere); otherwise a ledger-measured
+    win for this chip moves the boundary off the 512 prior."""
+    import os
+    env = os.environ.get("APEX_TPU_FLASH_MIN_SK")
+    if env is not None:
+        return int(env)
+    from .dispatch import measured_threshold
+    return measured_threshold("flash_attention", "sk", 512)
+
+
+def _flash_probe(dims):
+    # no-ledger default: the kernel from the measured min-sk boundary
+    # up, and ALSO wherever the XLA fallback's score tensor would be
+    # memory-harmful regardless of per-FLOP speed
+    min_sk = flash_min_sk()
+    sk = dims.get("sk", 0)
+    scores = (dims.get("b", 1) * dims.get("h", 1) * dims.get("sq", 1)
+              * sk * 4)
+    return min_sk, sk >= min_sk or scores > XLA_SCORES_BYTE_CAP
+
+
+def _register():
+    from .dispatch import register_kernel
+    register_kernel(
+        "flash_attention",
+        xla_fallback=(
+            "apex_tpu.contrib.multihead_attn.attn_funcs"
+            ".attention_reference"),
+        threshold_probe=_flash_probe,
+        doc="Blockwise online-softmax attention (fwd + recompute bwd)")
+
+
+_register()

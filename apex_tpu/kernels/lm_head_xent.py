@@ -174,7 +174,7 @@ def fused_lm_head_xent(x, emb, labels):
     per-row cross-entropy losses (N,) f32.  On a Pallas substrate the
     (N, V) logits never materialize in HBM in either pass; elsewhere the
     jnp chain runs (package dispatch duality)."""
-    from . import pallas_mode
+    from .dispatch import pallas_mode
     if pallas_mode() is None:
         return _jnp_chain(x, emb, labels)
     return _fused_kernel_path(x, emb, labels)
@@ -207,7 +207,7 @@ def _fwd(x, emb, labels):
 
 
 def _interp():
-    from . import pallas_mode
+    from .dispatch import pallas_mode
     return pallas_mode() == "interpret"
 
 

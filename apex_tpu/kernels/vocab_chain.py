@@ -1,0 +1,75 @@
+"""The vocab-chain loss as a registered kernel: fused LM-head +
+cross-entropy (Pallas, :mod:`.lm_head_xent`) with the chunked XLA chain
+(:mod:`apex_tpu.contrib.xentropy.chunked`) as the declared fallback.
+
+Round-4/5 history, now encoded as dispatch data instead of prose: the
+fused kernel measured **0.69x** against XLA's own lowering at
+(8192, 50257, 768) fwd+bwd, while the *program-level* chunked chain won
+**+13-15%** in-step — so the registered probe defaults every compiled
+shape to the chunked XLA path, and only a ledger entry with a measured
+win routes a shape to the kernel.  Interpret mode exercises the kernel
+(parity coverage); the kernel itself stays tested evidence either way.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from . import dispatch as _dispatch
+from .lm_head_xent import _fused_kernel_path
+
+
+def _vocab_chain_probe(dims):
+    # 0.69x at (n=8192, v=50257, e=768): no known win region on
+    # compiled TPU — default XLA everywhere until the ledger says
+    # otherwise (docs/kernels.md carries the receipts)
+    return None, False
+
+
+_dispatch.register_kernel(
+    "vocab_chain_loss",
+    xla_fallback="apex_tpu.contrib.xentropy.chunked.chunked_lm_head_loss",
+    threshold_probe=_vocab_chain_probe,
+    doc="Fused LM-head + cross-entropy (online-softmax over vocab blocks)")
+
+
+def vocab_chain_loss(hidden, head_weight, labels, smoothing=0.0,
+                     padding_idx=-100, logical_vocab=None,
+                     chunk_rows=None):
+    """Per-row LM-head cross-entropy, dispatch-gated between the fused
+    Pallas kernel and the chunked XLA chain.
+
+    Same contract as :func:`chunked_lm_head_loss` (returns f32 per-row
+    losses with ``hidden``'s leading shape).  The kernel arm covers the
+    plain-CE case only — smoothing or a lane-padded logical vocab
+    always takes the chunked path, which handles both exactly.
+    """
+    # lazy: contrib.xentropy.chunked imports kernels.dispatch at module
+    # top, so a module-level import here would close an import cycle
+    from ..contrib.xentropy.chunked import chunked_lm_head_loss
+
+    e = hidden.shape[-1]
+    lead = hidden.shape[:-1]
+    v = head_weight.shape[0]
+    n = math.prod(lead)
+
+    plain = isinstance(smoothing, (int, float)) and smoothing == 0.0
+    kernel_eligible = plain and (logical_vocab is None
+                                 or logical_vocab >= v)
+    if kernel_eligible:
+        fp = _dispatch.vocab_chain_fp(n, v, e, hidden.dtype)
+        d = _dispatch.decide("vocab_chain_loss", fp)
+        if d.tier == "pallas":
+            x2d = hidden.reshape(n, e)
+            lab = labels.reshape(n).astype(jnp.int32)
+            per = _fused_kernel_path(x2d, head_weight, lab)
+            # padding rows contribute zero loss AND zero gradient —
+            # the where's cotangent to the kernel branch is zero there
+            per = jnp.where(lab == padding_idx, jnp.zeros_like(per), per)
+            return per.reshape(lead)
+    return chunked_lm_head_loss(hidden, head_weight, labels,
+                                smoothing=smoothing,
+                                padding_idx=padding_idx,
+                                logical_vocab=logical_vocab,
+                                chunk_rows=chunk_rows)

@@ -51,7 +51,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from . import MASKED_LOGIT_THR as _MASK_THR
+from .dispatch import MASKED_LOGIT_THR as _MASK_THR
 
 _f32 = jnp.float32
 _NEG = -1e30

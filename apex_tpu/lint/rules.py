@@ -1087,3 +1087,95 @@ class ServeShape(Rule):
                         f" in serving-program build code — per-request "
                         f"program selection recompiles per request "
                         f"length, not per bucket")
+
+
+# ---------------------------------------------------------------------------
+# KERNEL-FALLBACK
+# ---------------------------------------------------------------------------
+
+
+def _in_kernels_package(module: Module) -> bool:
+    """True for files living in ``apex_tpu/kernels/`` — the one place a
+    raw ``pallas_call`` may appear."""
+    dotted = module.dotted or ""
+    if dotted == "apex_tpu.kernels" or \
+            dotted.startswith("apex_tpu.kernels."):
+        return True
+    rel = "/" + module.relpath.replace("\\", "/")
+    return "/apex_tpu/kernels/" in rel
+
+
+@register
+class KernelFallback(Rule):
+    """Hand-written kernels without a declared escape hatch — PR 13.
+
+    Rounds 4-5 measured most of this repo's hand-written Pallas kernels
+    LOSING to XLA's own lowering on real shapes (norms 0.93-1.03x,
+    fused LM-head chain 0.69x at GPT-2 shapes; flash attention only
+    wins >= 512 keys).  A ``pallas_call`` wired straight into a model
+    path locks those losses in: there is no seam to route the losing
+    shapes back to XLA, and no probe record to ever find out.  The
+    discipline is the ``apex_tpu.kernels`` tier: every kernel lives in
+    that package and registers through ``register_kernel`` with a
+    declared ``xla_fallback`` (the dotted path dispatch falls back to)
+    and a ``threshold_probe`` (the measured win region encoded as
+    data), so the calibration ledger — not the author's optimism —
+    decides dispatch per (chip, shape).  Flags: any ``pallas_call``
+    call or import outside ``apex_tpu/kernels/``, and a
+    ``register_kernel(...)`` missing a usable ``xla_fallback`` or
+    ``threshold_probe``.
+    """
+    id = "KERNEL-FALLBACK"
+    summary = ("pallas_call outside the kernels tier, or a kernel "
+               "registered without a declared XLA fallback + threshold "
+               "probe")
+    hint = ("move the kernel into apex_tpu/kernels/ and register it: "
+            "register_kernel(name, xla_fallback='<dotted path of the "
+            "XLA implementation>', threshold_probe=<fn(dims) -> "
+            "(threshold, use_pallas)>) — dispatch.decide() then "
+            "consults the calibration ledger and falls back below the "
+            "measured win region; see docs/kernels.md")
+
+    def _missing(self, call: ast.Call) -> List[str]:
+        """Registration keywords absent or constant-empty."""
+        kws = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        out = []
+        for need in ("xla_fallback", "threshold_probe"):
+            val = kws.get(need)
+            if val is None:
+                out.append(need)
+            elif isinstance(val, ast.Constant) and not val.value:
+                out.append(f"{need} (empty)")
+        return out
+
+    def check(self, module, ctx):
+        in_kernels = _in_kernels_package(module)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and not in_kernels:
+                for alias in node.names:
+                    if alias.name == "pallas_call":
+                        yield self.finding(
+                            module, node,
+                            "pallas_call imported outside "
+                            "apex_tpu/kernels/ — hand-written kernels "
+                            "belong in the measured-dispatch tier, not "
+                            "wired raw into model code")
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal(node.func)
+            if name == "pallas_call" and not in_kernels:
+                yield self.finding(
+                    module, node,
+                    "raw pallas_call outside apex_tpu/kernels/ — no "
+                    "XLA fallback seam, no probe record: losing shapes "
+                    "(round-5: norms 0.93-1.03x, lm_head chain 0.69x) "
+                    "can never route back to XLA")
+            elif name == "register_kernel":
+                missing = self._missing(node)
+                if missing:
+                    yield self.finding(
+                        module, node,
+                        "kernel registered without " + " / ".join(missing)
+                        + " — dispatch cannot fall back to XLA below "
+                        "the win region, and the ledger has no default "
+                        "threshold to override")

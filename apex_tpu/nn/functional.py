@@ -550,7 +550,7 @@ def cross_entropy(logits, target, weight=None, reduction="mean",
         # otherwise q = s/C would multiply their ~-1e30 log-probs into
         # the loss.  Plain logits never reach the threshold, so
         # torch-parity semantics are unchanged for unmasked inputs.
-        from ..ops.pallas import MASKED_LOGIT_THR
+        from ..kernels.dispatch import MASKED_LOGIT_THR
         valid = (logits > MASKED_LOGIT_THR).astype(logp.dtype)
         nv = jnp.maximum(valid.sum(axis=1, keepdims=True), 1.0)
         tgt = tgt * (1.0 - label_smoothing) \

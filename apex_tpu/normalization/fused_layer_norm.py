@@ -18,8 +18,8 @@ import jax.numpy as jnp
 
 from ..nn.modules import Module
 from ..nn.parameter import Parameter
-from ..ops.pallas import norm_kernel_mode, pallas_mode
-from ..ops.pallas import layer_norm as _k
+from ..kernels.dispatch import norm_kernel_mode, pallas_mode
+from ..kernels import layer_norm as _k
 
 _f32 = jnp.float32
 

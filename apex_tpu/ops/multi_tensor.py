@@ -144,9 +144,39 @@ def multi_tensor_maxnorm(noop_flag, tensor_lists, per_tensor: bool = False):
 # multi_tensor_sgd — csrc/multi_tensor_sgd_kernel.cu:29-278
 # ---------------------------------------------------------------------------
 
+def _use_fused(op: str, tensor_lists) -> bool:
+    """Whether the dispatch policy routes this group to the packed
+    Pallas kernel (apex_tpu.kernels.multi_tensor).  Trace-time static:
+    consults the calibration ledger through kernels.dispatch — on CPU
+    without a forced mode this is always False and the per-bucket
+    path below runs unchanged."""
+    if not tensor_lists or not tensor_lists[0]:
+        return False
+    from ..kernels import dispatch as _dispatch
+    from ..kernels.multi_tensor import group_fp
+    name = f"multi_tensor_{op}"
+    return _dispatch.decide(name, group_fp(op, tensor_lists[0])).tier \
+        == "pallas"
+
+
 def multi_tensor_sgd(noop_flag, tensor_lists, wd, momentum, dampening, lr,
                      nesterov: bool, first_run: bool, wd_after_momentum: bool,
                      scale=1.0):
+    """Momentum SGD over lists — dispatch-gated between the per-bucket
+    stacks (:func:`sgd_unfused`) and the packed Pallas kernel
+    (:func:`apex_tpu.kernels.multi_tensor.fused_sgd`); see
+    :func:`sgd_unfused` for the update semantics."""
+    if _use_fused("sgd", tensor_lists):
+        from ..kernels.multi_tensor import fused_sgd
+        return fused_sgd(noop_flag, tensor_lists, wd, momentum, dampening,
+                         lr, nesterov, first_run, wd_after_momentum, scale)
+    return sgd_unfused(noop_flag, tensor_lists, wd, momentum, dampening,
+                       lr, nesterov, first_run, wd_after_momentum, scale)
+
+
+def sgd_unfused(noop_flag, tensor_lists, wd, momentum, dampening, lr,
+                nesterov: bool, first_run: bool, wd_after_momentum: bool,
+                scale=1.0):
     """Momentum SGD over lists.
 
     depth 3: ``[grads, params, momenta]`` — returns (flag, params, momenta)
@@ -209,6 +239,20 @@ ADAM_MODE_DECOUPLED = 1   # AdamW decoupled weight decay
 
 def multi_tensor_adam(noop_flag, tensor_lists, lr, beta1, beta2, eps, step,
                       mode: int, bias_correction: bool, weight_decay):
+    """Adam / AdamW over lists — dispatch-gated between the per-bucket
+    stacks (:func:`adam_unfused`) and the packed Pallas kernel
+    (:func:`apex_tpu.kernels.multi_tensor.fused_adam`); see
+    :func:`adam_unfused` for the update semantics."""
+    if _use_fused("adam", tensor_lists):
+        from ..kernels.multi_tensor import fused_adam
+        return fused_adam(noop_flag, tensor_lists, lr, beta1, beta2, eps,
+                          step, mode, bias_correction, weight_decay)
+    return adam_unfused(noop_flag, tensor_lists, lr, beta1, beta2, eps,
+                        step, mode, bias_correction, weight_decay)
+
+
+def adam_unfused(noop_flag, tensor_lists, lr, beta1, beta2, eps, step,
+                 mode: int, bias_correction: bool, weight_decay):
     """Adam / AdamW over ``[grads, params, exp_avgs, exp_avg_sqs]``.
 
     Bias correction is computed host-side exactly as the reference does

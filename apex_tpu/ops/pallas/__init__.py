@@ -1,94 +1,44 @@
-"""Pallas kernel substrate — backend selection for the fused TPU kernels.
+"""Compatibility shim — the Pallas kernels moved to
+:mod:`apex_tpu.kernels` (the measured kernel tier with dispatch policy
+and calibration ledger).
 
-The reference ships each fused op twice: a CUDA extension and a pure-Python
-fallback chosen at import time (e.g. apex/parallel/__init__.py:14-19,
-apex/multi_tensor_apply/multi_tensor_apply.py:3-30 ``available``).  Our
-analogue is trace-time dispatch: on TPU the Pallas kernel compiles natively;
-elsewhere ops fall back to an equivalent pure-jnp path (same numerics — this
-duality is also the test oracle, mirroring tests/L1 "extension build vs
-python build" loss comparison).  ``interpret`` mode runs the actual Pallas
-kernels through the interpreter on CPU so kernel logic is testable without
-hardware.
+This package re-exports the dispatch surface the old location provided
+(``pallas_mode``/``force_mode``/``norm_kernel_mode`` and the
+masked-vocabulary constants) and aliases the old submodule paths
+(``apex_tpu.ops.pallas.attention`` etc.) onto the moved modules, so
+existing ``from apex_tpu.ops.pallas.attention import ...`` imports keep
+resolving to the SAME module objects.  New code should import from
+:mod:`apex_tpu.kernels` directly.
 """
-import contextlib
-import os
+from __future__ import annotations
 
-import jax
+import sys
 
-_forced = [None]
+from ...kernels import (attention, layer_norm, lm_head_xent, rms_norm,
+                        xentropy)
+from ...kernels.dispatch import (  # noqa: F401
+    MASKED_FILL,
+    MASKED_LOGIT_THR,
+    force_mode,
+    norm_kernel_mode,
+    pallas_mode,
+)
 
+for _name, _mod in (("attention", attention), ("layer_norm", layer_norm),
+                    ("rms_norm", rms_norm), ("xentropy", xentropy),
+                    ("lm_head_xent", lm_head_xent)):
+    sys.modules[__name__ + "." + _name] = _mod
+del _name, _mod
 
-def pallas_mode():
-    """Returns 'compiled' | 'interpret' | None (use the jnp fallback).
-
-    Priority: force_mode() context > APEX_TPU_PALLAS env var
-    ('off'/'0', 'interpret', 'compiled') > backend autodetect.
-    """
-    if _forced[0] is not None:
-        return None if _forced[0] == "off" else _forced[0]
-    env = os.environ.get("APEX_TPU_PALLAS", "").lower()
-    if env in ("0", "off"):
-        return None
-    if env in ("interpret", "compiled"):
-        return env
-    return "compiled" if jax.default_backend() == "tpu" else None
-
-
-@contextlib.contextmanager
-def force_mode(mode):
-    """Force kernel dispatch for a scope: 'compiled', 'interpret' or 'off'.
-
-    Note: dispatch happens at trace time, so already-jitted callables keep
-    the mode they were traced with.
-    """
-    prev = _forced[0]
-    _forced[0] = mode
-    try:
-        yield
-    finally:
-        _forced[0] = prev
-
-
-# The masked-vocabulary convention, in one place: logits at MASKED_FILL
-# (-1e30) mean "this column does not exist" (lane-padded heads'
-# pad columns, nucleus-filtered tokens); consumers treat anything at or
-# below MASKED_LOGIT_THR (-1e29) as masked — softmax contributions
-# underflow to 0 there, and the smoothing-aware losses
-# (nn.functional.cross_entropy, contrib.xentropy) exclude such columns
-# from the label-smoothing term and its divisor.
-MASKED_FILL = -1e30
-MASKED_LOGIT_THR = -1e29
-
-
-# Round-5 norm-kernel verdict (BENCH_HISTORY round 5).  The
-# variance-controlled isolated A/B (median of 5 interleaved reps)
-# put every LN/RMS row in a 0.93-1.03x band around XLA's own fusion —
-# the round-3 "1.73x LN win" was single-run noise — and the IN-STEP
-# A/B then showed routing norms to XLA is a real headline win:
-# BERT 1178->1252 (+6.3%), GPT 1044->1067 (+2.2%), Llama 1396->1469
-# (+5.2%) seq/s.  A Pallas custom call is a fusion barrier; XLA fuses
-# the norm into its producers/consumers when allowed to own it.
-# Default therefore defers to XLA on compiled TPU; the kernels stay
-# for interpret-mode parity coverage and APEX_TPU_NORM_KERNEL=1 opts
-# back in on-chip.
-_NORM_KERNEL_DEFAULT_ON = False
-
-
-def norm_kernel_mode():
-    """Effective dispatch mode for the LayerNorm/RMSNorm Pallas
-    kernels: ``pallas_mode()`` gated by APEX_TPU_NORM_KERNEL
-    ('auto'/'1'/'0') on compiled backends.  A ``force_mode`` scope
-    overrides the gate (parity checks and tests force the kernel arm
-    explicitly and must never silently self-compare); interpret mode
-    always exercises the kernels — that mode exists to test them."""
-    if _forced[0] is not None:
-        return pallas_mode()
-    mode = pallas_mode()
-    if mode != "compiled":
-        return mode
-    env = os.environ.get("APEX_TPU_NORM_KERNEL", "auto").lower()
-    if env in ("1", "on"):
-        return mode
-    if env in ("0", "off"):
-        return None
-    return mode if _NORM_KERNEL_DEFAULT_ON else None
+__all__ = [
+    "MASKED_FILL",
+    "MASKED_LOGIT_THR",
+    "attention",
+    "force_mode",
+    "layer_norm",
+    "lm_head_xent",
+    "norm_kernel_mode",
+    "pallas_mode",
+    "rms_norm",
+    "xentropy",
+]

@@ -14,6 +14,14 @@ if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8").strip()
 
+# Hermetic calibration ledger: a developer machine's warm
+# ~/.cache/apex_tpu/kernel_ledger.json must not steer kernel dispatch
+# (or planner re-ranking) inside the test suite.  Tests that WANT a warm
+# ledger point the process ledger at their own tmp file explicitly.
+os.environ.setdefault(
+    "APEX_TPU_LEDGER",
+    os.path.join("/tmp", f"apex_tpu_test_ledger_{os.getpid()}.json"))
+
 import jax  # noqa: E402
 
 # The axon TPU plugin ignores the JAX_PLATFORMS env var; the config update

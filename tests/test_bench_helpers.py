@@ -302,6 +302,49 @@ def test_overlap_microbench_records_schema():
             assert 0.2 < r[f"{knob}_overlap_factor"] < 5.0
 
 
+def test_kernel_probe_records_schema(tmp_path):
+    """--kernels calibration stage: one ledger-shaped record per
+    registered kernel/shape.  The schema is the TPU contract — off-TPU
+    the pallas arm is interpret-mode emulation, so the test asserts the
+    plumbing, not the win: every record carries the ingest_events
+    fields, mirrors as a ``bench.kernel_probe`` observe event, and a
+    ledger fed those events serves dispatch lookups."""
+    from apex_tpu import observe
+    from apex_tpu.kernels import dispatch as kdispatch
+    from apex_tpu.kernels.ledger import Ledger
+
+    recs = bench.kernel_probe_records(iters=1, reps=1)
+    by_kernel = {}
+    for r in recs:
+        assert r["metric"] == "kernel_probe"
+        assert {"kernel", "shape_fp", "pallas_us", "xla_us", "win",
+                "threshold"} <= set(r)
+        assert "error" not in r, r
+        assert r["pallas_us"] > 0 and r["xla_us"] > 0 and r["win"] > 0
+        assert kdispatch.parse_fp(r["shape_fp"])     # round-trippable key
+        by_kernel.setdefault(r["kernel"], []).append(r)
+    # every registered dispatch-tier kernel got probed
+    assert set(by_kernel) == set(kdispatch.catalog())
+    # the flash rows carry the production threshold, not the probe pin
+    assert all(r["threshold"] == 512
+               for r in by_kernel["flash_attention"])
+    # off-TPU: interpret-mode arms are emitted but never persisted into
+    # the calibration ledger (emulation timings must not steer dispatch)
+    assert all(r["mode"] == "interpret" and not r["ledger_write"]
+               for r in recs)
+    # the register_record mirror IS the ledger ingest contract
+    fps = {(r["kernel"], r["shape_fp"]) for r in recs}
+    evs = [e for e in observe.events("bench.kernel_probe")
+           if (e.get("kernel"), e.get("shape_fp")) in fps]
+    assert len(evs) >= len(recs)
+    led = Ledger(str(tmp_path / "ledger.json"))
+    assert led.ingest_events(evs) >= len(recs)
+    for r in recs:
+        entry = led.lookup_kernel(r["chip"], r["kernel"], r["shape_fp"])
+        assert entry is not None and entry["win"] == pytest.approx(
+            r["win"], rel=1e-3)
+
+
 def test_lint_records_schema():
     """--lint stage: one lint_findings record with the analyzer-health
     fields (the r06 multichip rerun records hazard-cleanliness next to

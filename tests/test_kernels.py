@@ -1,0 +1,505 @@
+"""The measured kernel tier (apex_tpu.kernels): interpret-mode parity
+pins for all three kernels (flash attention incl. causal/window masks
+and the ring sp composition, fused multi-tensor updates vs the
+per-bucket stacks, the fused vocab chain vs the chunked XLA chain),
+calibration-ledger round-trips and corrupt-entry recovery, and the
+dispatch policy itself — a below-threshold ledger entry must route to
+XLA and the deciding entry must land in the observe event log.
+
+Parity regime: fp32 comparisons are BITWISE but always jit-vs-jit —
+XLA CPU contracts mul+add into FMA under jit but not eagerly, so an
+eager arm differs from any jitted arm by ~1 ulp while two jitted arms
+(the only configuration production runs) agree exactly.
+"""
+import functools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.contrib.multihead_attn.attn_funcs import (
+    attention_reference, flash_attention)
+from apex_tpu.contrib.xentropy.chunked import chunked_lm_head_loss
+from apex_tpu.kernels import dispatch, ledger
+from apex_tpu.kernels.dispatch import force_mode
+from apex_tpu.kernels.multi_tensor import fused_adam, fused_sgd, group_fp
+from apex_tpu.kernels.vocab_chain import vocab_chain_loss
+from apex_tpu.ops import multi_tensor as ops_mt
+from apex_tpu.parallel import ring_attention
+from apex_tpu.runtime import step_cache
+
+pytestmark = pytest.mark.kernels
+
+
+@pytest.fixture
+def tmp_ledger(tmp_path):
+    """A fresh ledger file + cleared decision cache, restored after."""
+    led = ledger.set_path(str(tmp_path / "ledger.json"))
+    dispatch.reset_decisions()
+    yield led
+    ledger.set_path(None)
+    dispatch.reset_decisions()
+
+
+def _tensors(rng, shapes, dtype=jnp.float32):
+    return [jnp.asarray(rng.standard_normal(s), dtype) for s in shapes]
+
+
+SHAPES = [(33, 7), (128,), (5, 3, 11), (257,)]
+
+
+# ---------------------------------------------------------------------------
+# fused multi-tensor vs per-bucket: bitwise, jit-vs-jit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("momentum,nesterov,wd,wd_after", [
+    (0.9, False, 0.01, False),
+    (0.9, True, 0.01, True),
+    (0.0, False, 0.0, False),
+])
+def test_fused_sgd_bitwise_vs_per_bucket(rng, dtype, momentum, nesterov,
+                                         wd, wd_after):
+    gs = _tensors(rng, SHAPES, dtype)
+    ps = _tensors(rng, SHAPES, dtype)
+    ms = _tensors(rng, SHAPES, jnp.float32)
+    flag = jnp.zeros((), jnp.int32)
+    args = (wd, momentum, 0.0, 0.1, nesterov, False, wd_after, 2.0)
+    with force_mode("interpret"):
+        ref = jax.jit(lambda f, t: ops_mt.sgd_unfused(f, t, *args))(
+            flag, [gs, ps, ms])
+        got = jax.jit(lambda f, t: fused_sgd(f, t, *args))(
+            flag, [gs, ps, ms])
+    for r, g in zip(ref[1] + ref[2], got[1] + got[2]):
+        np.testing.assert_array_equal(np.asarray(r, np.float32),
+                                      np.asarray(g, np.float32))
+
+
+def test_fused_sgd_depth4_model_copy_bitwise(rng):
+    gs = _tensors(rng, SHAPES)
+    ps = _tensors(rng, SHAPES)          # fp32 masters
+    ms = _tensors(rng, SHAPES)
+    model = [p.astype(jnp.bfloat16) for p in ps]
+    flag = jnp.zeros((), jnp.int32)
+    args = (0.01, 0.9, 0.1, 0.05, False, True, False, 1.0)
+    with force_mode("interpret"):
+        ref = jax.jit(lambda f, t: ops_mt.sgd_unfused(f, t, *args))(
+            flag, [gs, ps, ms, model])
+        got = jax.jit(lambda f, t: fused_sgd(f, t, *args))(
+            flag, [gs, ps, ms, model])
+    assert len(ref) == len(got) == 4
+    for lr, lg in zip(ref[1:], got[1:]):
+        for r, g in zip(lr, lg):
+            assert r.dtype == g.dtype
+            np.testing.assert_array_equal(np.asarray(r, np.float32),
+                                          np.asarray(g, np.float32))
+
+
+def test_fused_sgd_noop_flag_skips(rng):
+    gs, ps, ms = (_tensors(rng, SHAPES) for _ in range(3))
+    flag = jnp.ones((), jnp.int32)
+    with force_mode("interpret"):
+        got = jax.jit(lambda f, t: fused_sgd(
+            f, t, 0.0, 0.9, 0.0, 0.1, False, False, False))(
+            flag, [gs, ps, ms])
+    for p, np_ in zip(ps, got[1]):
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(np_))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("mode,bias_correction,wd", [
+    (0, True, 0.01),        # ADAM_MODE_L2
+    (1, True, 0.01),        # decoupled (AdamW)
+    (0, False, 0.0),
+])
+def test_fused_adam_bitwise_vs_per_bucket(rng, dtype, mode,
+                                          bias_correction, wd):
+    gs = _tensors(rng, SHAPES, dtype)
+    ps = _tensors(rng, SHAPES, dtype)
+    ms = _tensors(rng, SHAPES, jnp.float32)
+    vs = [jnp.abs(t) for t in _tensors(rng, SHAPES, jnp.float32)]
+    flag = jnp.zeros((), jnp.int32)
+    args = (1e-3, 0.9, 0.999, 1e-8, 7, mode, bias_correction, wd)
+    with force_mode("interpret"):
+        ref = jax.jit(lambda f, t: ops_mt.adam_unfused(f, t, *args))(
+            flag, [gs, ps, ms, vs])
+        got = jax.jit(lambda f, t: fused_adam(f, t, *args))(
+            flag, [gs, ps, ms, vs])
+    for lr, lg in zip(ref[1:], got[1:]):
+        for r, g in zip(lr, lg):
+            np.testing.assert_array_equal(np.asarray(r, np.float32),
+                                          np.asarray(g, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# flash attention parity (incl. masks and the ring sp composition)
+# ---------------------------------------------------------------------------
+
+B, H, S, D = 2, 4, 64, 16
+
+
+def _qkv(rng, dtype=jnp.float32):
+    return tuple(jnp.asarray(rng.standard_normal((B, H, S, D)), dtype)
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal,window", [(False, None), (True, None),
+                                           (True, 24)])
+def test_flash_interpret_parity_masks(rng, tmp_ledger, causal, window):
+    q, k, v = _qkv(rng)
+    scale = 1.0 / np.sqrt(D)
+    ref = attention_reference(q, k, v, None, causal, scale, window=window)
+    with force_mode("interpret"):
+        out = flash_attention(q, k, v, causal=causal,
+                              sliding_window=window)
+        g = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=causal, sliding_window=window))))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(attention_reference(
+        q, k, v, None, causal, scale, window=window))))(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=3e-4, atol=3e-5)
+
+
+def test_ring_sp_composition_honors_ledger_fallback(rng, tmp_ledger):
+    """The sp plan's ring step consults the same dispatch policy: a
+    losing ledger entry for the chunk shape routes every ring chunk to
+    the XLA fallback (numerics unchanged), a winning one keeps the
+    Pallas kernel — both match the gathered-sequence oracle."""
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), ("sp",))
+    q, k, v = _qkv(rng)
+    scale = 1.0 / np.sqrt(D)
+    ref = attention_reference(q, k, v, None, True, scale)
+    chunk_fp = dispatch.attention_fp(B, H, S // n, S // n, D,
+                                     "float32", True)
+    chip = ledger.chip_name()
+
+    def run_ring():
+        fn = functools.partial(ring_attention, axis_name="sp",
+                               causal=True)
+        shard = jax.shard_map(fn, mesh=mesh,
+                              in_specs=P(None, None, "sp", None),
+                              out_specs=P(None, None, "sp", None),
+                              check_vma=False)
+        return jax.jit(shard)(q, k, v)
+
+    for pallas_us, xla_us, want_tier in ((100.0, 50.0, "xla"),
+                                         (50.0, 100.0, "pallas")):
+        tmp_ledger.record_kernel(chip, "flash_attention", chunk_fp,
+                                 pallas_us=pallas_us, xla_us=xla_us)
+        dispatch.reset_decisions()
+        with force_mode("interpret"):
+            out = run_ring()
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        decided = {(d["kernel"], d["tier"], d["source"])
+                   for d in dispatch.decisions()}
+        assert ("flash_attention", want_tier, "ledger") in decided
+
+
+# ---------------------------------------------------------------------------
+# vocab chain: fused kernel vs chunked XLA chain, fwd + bwd
+# ---------------------------------------------------------------------------
+
+
+def test_vocab_chain_fwd_bwd_bitwise(rng, tmp_ledger):
+    n, v, e = 24, 384, 64
+    hidden = jnp.asarray(rng.standard_normal((n, e)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((v, e)) * 0.02, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, (n,)), jnp.int32)
+    labels = labels.at[3].set(-100)      # padding row
+
+    def chunked_mean(h, w):
+        per = chunked_lm_head_loss(h, w, labels)
+        return per.sum() / jnp.maximum((labels != -100).sum(), 1)
+
+    def fused_mean(h, w):
+        per = vocab_chain_loss(h, w, labels)
+        return per.sum() / jnp.maximum((labels != -100).sum(), 1)
+
+    with force_mode("interpret"):
+        ref = jax.jit(chunked_mean)(hidden, w)
+        got = jax.jit(fused_mean)(hidden, w)
+        g_ref = jax.jit(jax.grad(chunked_mean, argnums=(0, 1)))(hidden, w)
+        g_got = jax.jit(jax.grad(fused_mean, argnums=(0, 1)))(hidden, w)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    for r, g in zip(g_ref, g_got):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(g),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_vocab_chain_smoothing_takes_chunked_path(rng, tmp_ledger):
+    """Smoothing is outside the kernel's contract — the dispatch-gated
+    entry must produce the chunked chain's exact result."""
+    n, v, e = 16, 256, 32
+    hidden = jnp.asarray(rng.standard_normal((2, n // 2, e)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((v, e)) * 0.02, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, (2, n // 2)), jnp.int32)
+    with force_mode("interpret"):
+        ref = chunked_lm_head_loss(hidden, w, labels, smoothing=0.1)
+        got = vocab_chain_loss(hidden, w, labels, smoothing=0.1)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    assert ref.shape == hidden.shape[:-1]
+
+
+# ---------------------------------------------------------------------------
+# ledger round-trip + corrupt-entry recovery
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_round_trip(tmp_path):
+    led = ledger.Ledger(str(tmp_path / "l.json"))
+    rec = led.record_kernel("cpu", "flash_attention", "sk=512",
+                            pallas_us=50.0, xla_us=100.0, threshold=512)
+    assert rec["win"] == pytest.approx(2.0)
+    # a second process sees the same entry from disk
+    led2 = ledger.Ledger(str(tmp_path / "l.json"))
+    hit = led2.lookup_kernel("cpu", "flash_attention", "sk=512")
+    assert hit["win"] == pytest.approx(2.0)
+    assert hit["chip"] == "cpu" and hit["shape_fp"] == "sk=512"
+    assert led2.lookup_kernel("cpu", "flash_attention", "sk=64") is None
+    assert led2.lookup_kernel("tpu v5", "flash_attention", "sk=512") is None
+    # runs accumulate on refresh
+    assert led.record_kernel("cpu", "flash_attention", "sk=512",
+                             pallas_us=55.0, xla_us=95.0)["runs"] == 2
+
+
+def test_ledger_plan_round_trip_preserves_measured(tmp_path):
+    led = ledger.Ledger(str(tmp_path / "l.json"))
+    key = (2, 1, 1, 3, 1, False)
+    led.record_plan("cpu", "params=10", key, measured_ms=1.5,
+                    predicted_ms=2.0)
+    # a later decision with no measurement must not erase the data
+    led.record_plan("cpu", "params=10", key, measured_ms=None,
+                    predicted_ms=2.1, source="decision")
+    meas = led.plan_measurements("cpu", "params=10")
+    assert meas["2/1/1/3/1/0"]["measured_ms"] == 1.5
+
+
+def test_ledger_corrupt_file_and_entries_recover(tmp_path):
+    p = tmp_path / "l.json"
+    p.write_text("{ not json")
+    led = ledger.Ledger(str(p))
+    assert led.lookup_kernel("cpu", "k", "fp") is None      # not fatal
+    led.record_kernel("cpu", "k", "fp", pallas_us=1.0, xla_us=2.0)
+    assert led.lookup_kernel("cpu", "k", "fp")["win"] == 2.0
+    # corrupt ENTRIES inside a valid document are dropped, good ones kept
+    doc = json.loads(p.read_text())
+    doc["kernels"]["cpu"]["bad"] = "not-a-dict"
+    doc["kernels"]["weird"] = 7
+    doc["plans"] = {"cpu": {"mfp": {"1/1/1/0/1/0": {"measured_ms": 3.0}}}}
+    p.write_text(json.dumps(doc))
+    led2 = ledger.Ledger(str(p))
+    assert led2.lookup_kernel("cpu", "k", "fp")["win"] == 2.0
+    assert led2.plan_measurements("cpu", "mfp")
+    # an entry without a usable win ratio cannot decide dispatch
+    led2.record_kernel("cpu", "half", "fp", pallas_us=5.0, xla_us=None)
+    assert led2.lookup_kernel("cpu", "half", "fp") is None
+
+
+def test_ledger_ingest_events(tmp_path):
+    led = ledger.Ledger(str(tmp_path / "l.json"))
+    n = led.ingest_events([
+        {"event": "bench.kernel_probe", "kernel": "flash_attention",
+         "shape_fp": "sk=512", "chip": "cpu", "pallas_us": 40.0,
+         "xla_us": 80.0, "threshold": 512},
+        {"event": "plan.auto_tune", "chip": "cpu", "model_fp": "m",
+         "plan_key": [2, 1, 1, 0, 1, 0], "measured_ms": 4.2,
+         "predicted_ms": 5.0, "plan": "dp2"},
+        {"event": "plan.auto_tune", "plan_key": [1, 1, 1, 0, 1, 0],
+         "measured_ms": 9.9},                   # no chip/model_fp: skipped
+        {"event": "unrelated", "kernel": "x"},
+        "not-a-dict",
+    ])
+    assert n == 2
+    assert led.lookup_kernel("cpu", "flash_attention", "sk=512")["win"] == 2.0
+    assert led.plan_measurements("cpu", "m")["2/1/1/0/1/0"][
+        "measured_ms"] == 4.2
+
+
+# ---------------------------------------------------------------------------
+# dispatch policy: ledger verdicts route tiers, observably
+# ---------------------------------------------------------------------------
+
+
+def _sgd_lists(rng):
+    gs, ps, ms = (_tensors(rng, [(16, 8), (40,)]) for _ in range(3))
+    return [gs, ps, ms]
+
+
+@pytest.mark.parametrize("pallas_us,xla_us,tier", [
+    (100.0, 50.0, "xla"),           # below the win region -> XLA
+    (50.0, 100.0, "pallas"),        # measured win -> the kernel
+])
+def test_dispatch_tier_pinned_via_kind_stats(rng, tmp_ledger, pallas_us,
+                                             xla_us, tier):
+    from apex_tpu.kernels.multi_tensor import multi_tensor_sgd
+    from apex_tpu.observe import registry as obs
+
+    lists = _sgd_lists(rng)
+    fp = group_fp("sgd", lists[0])
+    chip = ledger.chip_name()
+    tmp_ledger.record_kernel(chip, "multi_tensor_sgd", fp,
+                             pallas_us=pallas_us, xla_us=xla_us)
+    dispatch.reset_decisions()
+    kind = f"kernel.multi_tensor_sgd.{tier}"
+    other = f"kernel.multi_tensor_sgd.{'pallas' if tier == 'xla' else 'xla'}"
+    before = step_cache.kind_stats(kind)["dispatches"]
+    before_other = step_cache.kind_stats(other)["dispatches"]
+    with force_mode("interpret"):
+        out = multi_tensor_sgd(jnp.zeros((), jnp.int32), lists,
+                               0.0, 0.9, 0.0, 0.1, False, True, False)
+    assert len(out) == 3
+    assert step_cache.kind_stats(kind)["dispatches"] == before + 1
+    assert step_cache.kind_stats(other)["dispatches"] == before_other
+    # the deciding ledger entry is in the observe event log
+    evs = [e for e in obs.events("kernels.dispatch")
+           if e.get("kernel") == "multi_tensor_sgd"
+           and e.get("shape_fp") == fp and e.get("tier") == tier]
+    assert evs, "no kernels.dispatch event for the decision"
+    assert evs[-1]["source"] == "ledger"
+    assert evs[-1]["ledger_entry"]["pallas_us"] == pallas_us
+
+
+def test_dispatch_defaults_no_mode_is_xla(rng, tmp_ledger):
+    """CPU default (no forced mode): every kernel routes to XLA and the
+    per-bucket paths run unchanged — the tier-1 invariance guarantee."""
+    d = dispatch.decide("multi_tensor_sgd", "op=sgd,n=1,t=1,dtype=float32")
+    assert d.tier == "xla" and d.source == "mode"
+
+
+def test_dispatch_probe_decides_compiled_unmeasured(tmp_ledger):
+    """Compiled mode with an empty ledger: the registered threshold
+    probe decides (flash: sk below the 512-key prior -> XLA, above ->
+    Pallas)."""
+    with force_mode("compiled"):
+        lo = dispatch.decide(
+            "flash_attention",
+            dispatch.attention_fp(2, 4, 64, 64, 16, "float32", True))
+        hi = dispatch.decide(
+            "flash_attention",
+            dispatch.attention_fp(2, 4, 1024, 1024, 16, "float32", True))
+    assert (lo.tier, lo.source) == ("xla", "probe")
+    assert lo.threshold == 512
+    assert (hi.tier, hi.source) == ("pallas", "probe")
+
+
+def test_flash_min_sk_reads_measured_threshold(tmp_ledger, monkeypatch):
+    from apex_tpu.kernels import attention as ka
+    assert ka.flash_min_sk() == 512                  # frozen prior
+    tmp_ledger.record_kernel(
+        ledger.chip_name(), "flash_attention",
+        dispatch.attention_fp(8, 8, 256, 256, 64, "bfloat16", True),
+        pallas_us=40.0, xla_us=60.0)
+    assert ka.flash_min_sk() == 256                  # measured win at 256
+    monkeypatch.setenv("APEX_TPU_FLASH_MIN_SK", "128")
+    assert ka.flash_min_sk() == 128                  # env beats both
+
+
+def test_kernel_catalog_declares_fallbacks():
+    cat = dispatch.catalog()
+    for name in ("flash_attention", "multi_tensor_sgd",
+                 "multi_tensor_adam", "vocab_chain_loss"):
+        assert name in cat, f"{name} not registered"
+        assert cat[name].xla_fallback
+        assert callable(cat[name].threshold_probe)
+    with pytest.raises(ValueError):
+        dispatch.register_kernel("bad", xla_fallback="",
+                                 threshold_probe=lambda d: (None, False))
+
+
+# ---------------------------------------------------------------------------
+# planner: warm ledger re-prices terms and re-ranks plans
+# ---------------------------------------------------------------------------
+
+
+def _planner_setup(rng):
+    import dataclasses as dc
+
+    import apex_tpu.nn as nn
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import auto
+
+    nn.manual_seed(0)
+    model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 8))
+    opt = FusedAdam(list(model.parameters()), lr=1e-2)
+    loss = lambda o, t: F.cross_entropy(o, t)
+    x = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 8, (64,)))
+    prof = auto.profile_model(model, opt, loss, (x, y))
+    # stamp transformer geometry so the attention term prices too
+    prof = dc.replace(prof, layers=2, heads=4, hidden=64, seq_len=128)
+    return auto, model, opt, loss, (x, y), prof
+
+
+def test_planner_warm_ledger_cites_measured_terms(rng, tmp_ledger):
+    auto, model, opt, loss, batch, prof = _planner_setup(rng)
+    chip = ledger.chip_name()
+    tmp_ledger.record_kernel(
+        chip, "multi_tensor_adam",
+        dispatch.multi_tensor_fp("adam", prof.n_params,
+                                 len(prof.param_shapes)),
+        pallas_us=40.0, xla_us=60.0)
+    tmp_ledger.record_kernel(
+        chip, "flash_attention",
+        dispatch.attention_fp(64, 4, 128, 128, 16, "float32", True),
+        pallas_us=85.0, xla_us=136.0)
+    rep = auto.plan_training(model, opt, loss, batch, profile=prof)
+    text = rep.describe()
+    assert "ledger-measured" in text
+    assert "flash_attention" in text and "multi_tensor_adam" in text
+    assert rep.best.ledger_terms
+    # the citation covers both required terms
+    joined = " ".join(rep.best.ledger_terms)
+    assert joined.startswith("attention")
+    assert "optimizer" in joined
+
+
+def test_planner_cold_ledger_unchanged(rng, tmp_ledger):
+    auto, model, opt, loss, batch, prof = _planner_setup(rng)
+    rep = auto.plan_training(model, opt, loss, batch, profile=prof)
+    assert all(not p.ledger_terms for p in rep.ranked)
+    assert all(p.measured_ms is None for p in rep.ranked)
+
+
+def test_planner_reranks_from_recorded_plan_measurement(rng, tmp_ledger):
+    auto, model, opt, loss, batch, prof = _planner_setup(rng)
+    rep = auto.plan_training(model, opt, loss, batch, profile=prof)
+    assert len(rep.ranked) > 1
+    other = rep.ranked[1]
+    tmp_ledger.record_plan(
+        ledger.chip_name(), auto.model_fp(prof, 64), other.key(),
+        measured_ms=1e-3, predicted_ms=other.predicted_ms,
+        plan=other.name())
+    rep2 = auto.plan_training(model, opt, loss, batch, profile=prof)
+    assert rep2.best.key() == other.key()
+    assert rep2.best.measured_ms == 1e-3
+    assert "measured" in rep2.best.describe()
+
+
+def test_plan_decision_event_carries_ledger_keys(rng, tmp_ledger):
+    from apex_tpu.observe import registry as obs
+    from apex_tpu.training import make_train_step
+
+    auto, model, opt, loss, batch, prof = _planner_setup(rng)
+    step = make_train_step(model, opt, loss, parallel="auto",
+                           example_batch=batch,
+                           plan_options={"profile": prof})
+    evs = [e for e in obs.events("plan.decision") if e.get("model_fp")]
+    assert evs, "plan.decision missing ledger keys"
+    ev = evs[-1]
+    assert ev["chip"] == ledger.chip_name()
+    assert ev["model_fp"] == auto.model_fp(prof, 64)
+    # the decision write-through is in the ledger (predicted only)
+    assert tmp_ledger.plan_measurements(ev["chip"], ev["model_fp"]) == {}
+    doc = json.loads(open(tmp_ledger.path).read())
+    assert ev["model_fp"] in doc["plans"][ev["chip"]]
+    assert step.plan_report is not None

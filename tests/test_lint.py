@@ -33,6 +33,7 @@ RULE_FIXTURES = {
     "OBS-IN-JIT": "obs_in_jit",
     "EXEC-BYPASS": "exec_bypass",
     "SERVE-SHAPE": "serve_shape",
+    "KERNEL-FALLBACK": "kernel_fallback",
 }
 
 
@@ -52,7 +53,7 @@ def _run(paths, **kw):
 
 def test_registry_covers_required_rules():
     assert set(RULE_FIXTURES) <= set(rules.rule_ids())
-    assert len(rules.rule_ids()) >= 11
+    assert len(rules.rule_ids()) >= 12
 
 
 @pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
