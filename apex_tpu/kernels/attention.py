@@ -550,6 +550,27 @@ def _flash_probe(dims):
     return min_sk, sk >= min_sk or scores > XLA_SCORES_BYTE_CAP
 
 
+def _audit_programs():
+    """Both tiers on one abstract causal shape for the jaxpr verifier:
+    the Pallas fwd (staged pallas_call, not executed) and the declared
+    XLA reference."""
+    sds = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    q3 = sds((2, 128, 64), f32)              # (B*H, S, D) kernel layout
+    q4 = sds((1, 2, 128, 64), f32)           # (B, H, S, D) reference layout
+    scale = 64 ** -0.5
+
+    def _pallas(q, k, v):
+        return flash_attention_fwd(q, k, v, None, scale, True)[0]
+
+    def _xla(q, k, v):
+        from ..contrib.multihead_attn.attn_funcs import attention_reference
+        return attention_reference(q, k, v, None, True, scale)
+
+    return [("pallas", _pallas, (q3, q3, q3)),
+            ("xla", _xla, (q4, q4, q4))]
+
+
 def _register():
     from .dispatch import register_kernel
     register_kernel(
@@ -558,7 +579,8 @@ def _register():
             "apex_tpu.contrib.multihead_attn.attn_funcs"
             ".attention_reference"),
         threshold_probe=_flash_probe,
-        doc="Blockwise online-softmax attention (fwd + recompute bwd)")
+        doc="Blockwise online-softmax attention (fwd + recompute bwd)",
+        audit_programs=_audit_programs)
 
 
 _register()

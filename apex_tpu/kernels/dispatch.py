@@ -170,22 +170,30 @@ class KernelSpec:
     xla_fallback: str            # where the XLA path lives (dotted path)
     threshold_probe: Callable    # (dims: dict) -> (threshold, use_pallas)
     doc: str = ""
+    # () -> [(tier, fn, example_avals)] — the jaxpr verifier
+    # (lint.jaxpr_audit) traces both tiers abstractly through this
+    audit_programs: Optional[Callable] = None
 
 
 KERNELS: dict = {}
 
 
 def register_kernel(name: str, *, xla_fallback: str,
-                    threshold_probe: Callable, doc: str = "") -> KernelSpec:
+                    threshold_probe: Callable, doc: str = "",
+                    audit_programs: Optional[Callable] = None) -> KernelSpec:
     """Register a kernel with the dispatch policy.  Both ``xla_fallback``
     and ``threshold_probe`` are mandatory by construction — the
-    KERNEL-FALLBACK lint rule flags registrations without them."""
+    KERNEL-FALLBACK lint rule flags registrations without them.
+    ``audit_programs`` makes both tiers traceable by the jaxpr
+    verifier: a zero-arg callable yielding ``(tier, fn, example)``
+    triples with abstract (ShapeDtypeStruct) examples."""
     if not xla_fallback or threshold_probe is None:
         raise ValueError(
             f"kernel {name!r} must declare an XLA fallback and a "
             f"threshold probe (KERNEL-FALLBACK)")
     spec = KernelSpec(name=name, xla_fallback=xla_fallback,
-                      threshold_probe=threshold_probe, doc=doc)
+                      threshold_probe=threshold_probe, doc=doc,
+                      audit_programs=audit_programs)
     KERNELS[name] = spec
     return spec
 

@@ -265,17 +265,60 @@ def _elementwise_probe(dims):
     return None, False
 
 
+def _audit_tensor_lists(depth):
+    import jax
+    sds = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    return [[sds((8, 4), f32), sds((16,), f32)] for _ in range(depth)]
+
+
+def _audit_flag():
+    import jax
+    return jax.ShapeDtypeStruct((), jnp.int32)
+
+
+def _sgd_audit_programs():
+    from ..ops import multi_tensor as _ops
+
+    def _pallas(flag, tl):
+        return fused_sgd(flag, tl, 0.0, 0.9, 0.0, 0.05, False, True, False)
+
+    def _xla(flag, tl):
+        return _ops.sgd_unfused(flag, tl, 0.0, 0.9, 0.0, 0.05,
+                                False, True, False)
+
+    args = (_audit_flag(), _audit_tensor_lists(3))
+    return [("pallas", _pallas, args), ("xla", _xla, args)]
+
+
+def _adam_audit_programs():
+    from ..ops import multi_tensor as _ops
+
+    def _pallas(flag, tl):
+        return fused_adam(flag, tl, 1e-3, 0.9, 0.999, 1e-8, 1, 0,
+                          True, 0.0)
+
+    def _xla(flag, tl):
+        return _ops.adam_unfused(flag, tl, 1e-3, 0.9, 0.999, 1e-8, 1, 0,
+                                 True, 0.0)
+
+    args = (_audit_flag(), _audit_tensor_lists(4))
+    return [("pallas", _pallas, args), ("xla", _xla, args)]
+
+
 _dispatch.register_kernel(
     "multi_tensor_sgd",
     xla_fallback="apex_tpu.ops.multi_tensor.sgd_unfused",
     threshold_probe=_elementwise_probe,
-    doc="Packed momentum-SGD group update (fused_sgd)")
+    doc="Packed momentum-SGD group update (fused_sgd)",
+    audit_programs=_sgd_audit_programs)
 
 _dispatch.register_kernel(
     "multi_tensor_adam",
     xla_fallback="apex_tpu.ops.multi_tensor.adam_unfused",
     threshold_probe=_elementwise_probe,
-    doc="Packed Adam/AdamW group update (fused_adam)")
+    doc="Packed Adam/AdamW group update (fused_adam)",
+    audit_programs=_adam_audit_programs)
 
 
 def multi_tensor_sgd(noop_flag, tensor_lists, wd, momentum, dampening, lr,

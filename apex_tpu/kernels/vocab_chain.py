@@ -27,11 +27,27 @@ def _vocab_chain_probe(dims):
     return None, False
 
 
+def _audit_programs():
+    import jax
+    sds = jax.ShapeDtypeStruct
+    hidden = sds((32, 16), jnp.float32)
+    table = sds((40, 16), jnp.float32)
+    labels = sds((32,), jnp.int32)
+
+    def _xla(x, w, lab):
+        from ..contrib.xentropy.chunked import chunked_lm_head_loss
+        return chunked_lm_head_loss(x, w, lab)
+
+    return [("pallas", _fused_kernel_path, (hidden, table, labels)),
+            ("xla", _xla, (hidden, table, labels))]
+
+
 _dispatch.register_kernel(
     "vocab_chain_loss",
     xla_fallback="apex_tpu.contrib.xentropy.chunked.chunked_lm_head_loss",
     threshold_probe=_vocab_chain_probe,
-    doc="Fused LM-head + cross-entropy (online-softmax over vocab blocks)")
+    doc="Fused LM-head + cross-entropy (online-softmax over vocab blocks)",
+    audit_programs=_audit_programs)
 
 
 def vocab_chain_loss(hidden, head_weight, labels, smoothing=0.0,

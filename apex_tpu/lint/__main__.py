@@ -1,26 +1,65 @@
 """``python -m apex_tpu.lint`` / the ``apex-tpu-lint`` console script.
 
-Exit status: 0 = clean (no unsuppressed, non-baselined findings),
-1 = findings (including files that failed to parse), 2 = usage error.
+Exit-code contract (stable; CI keys off it):
+  0 = clean — no unsuppressed, non-baselined findings (with ``--jaxpr``:
+      every audited program passed every check),
+  1 = findings — live lint findings, files that failed to parse, or
+      (with ``--jaxpr``) at least one failing program check,
+  2 = usage error — unknown rule id, missing path, or git failure
+      under ``--changed``.  Nothing is written to stdout on exit 2.
 """
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 from . import engine, report, rules
+
+
+def _changed_files(base: str):
+    """Python files touched relative to ``base`` (plus untracked ones) —
+    the ``git diff`` scope for incremental lint runs."""
+    diff = subprocess.run(
+        ["git", "diff", "--name-only", base, "--", "*.py"],
+        capture_output=True, text=True, check=True)
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "--", "*.py"],
+        capture_output=True, text=True, check=True)
+    names = set(diff.stdout.splitlines()) | set(untracked.stdout.splitlines())
+    return sorted(p for p in names if os.path.exists(p))
+
+
+def _run_jaxpr_audit(fmt: str) -> int:
+    from . import jaxpr_audit
+    res = jaxpr_audit.run()
+    if fmt == "json":
+        import json
+        out = res.counts()
+        out["programs"] = [
+            {"name": p.name, "kind": p.kind, "passed": p.passed,
+             "checks": [{"name": c.name, "ok": c.ok, "detail": c.detail}
+                        for c in p.checks]}
+            for p in res.programs]
+        out["errors"] = res.errors
+        print(json.dumps(out, indent=1))
+    else:
+        print(res.format(verbose=fmt == "human"))
+    return 0 if res.passed else 1
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="apex-tpu-lint",
         description="AST-based TPU-hazard analyzer (rule catalog: "
-                    "docs/lint.md)")
+                    "docs/lint.md); --jaxpr runs the jaxpr-level "
+                    "program verifier instead")
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: apex_tpu "
                          "and examples under the cwd, else the cwd)")
-    ap.add_argument("--format", choices=["human", "json"], default="human")
+    ap.add_argument("--format", choices=["human", "json", "sarif"],
+                    default="human")
     ap.add_argument("--select", default=None,
                     help="comma-separated rule ids to run (default: all)")
     ap.add_argument("--ignore", default=None,
@@ -35,6 +74,16 @@ def main(argv=None):
                          "--baseline and exit 0")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed/baselined findings")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only python files changed vs REF "
+                         "(default HEAD) plus untracked ones, instead "
+                         "of the positional paths")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="run the jaxpr-level program verifier (traces "
+                         "the real train/serve/kernel entry programs "
+                         "on CPU and audits the IR) instead of the "
+                         "AST rules")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -44,16 +93,31 @@ def main(argv=None):
             print(f"{rid}: {r.summary}")
         return 0
 
-    paths = args.paths
-    if not paths:
-        paths = [p for p in ("apex_tpu", "examples") if os.path.isdir(p)]
+    if args.jaxpr:
+        return _run_jaxpr_audit(args.format)
+
+    if args.changed is not None:
+        try:
+            paths = _changed_files(args.changed)
+        except (subprocess.CalledProcessError, OSError) as e:
+            err = getattr(e, "stderr", "") or str(e)
+            print(f"apex-tpu-lint: --changed failed: {err.strip()}",
+                  file=sys.stderr)
+            return 2
         if not paths:
-            paths = ["."]
-    missing = [p for p in paths if not os.path.exists(p)]
-    if missing:
-        print(f"apex-tpu-lint: no such path(s): {missing}",
-              file=sys.stderr)
-        return 2
+            print("apex-tpu-lint: no changed python files")
+            return 0
+    else:
+        paths = args.paths
+        if not paths:
+            paths = [p for p in ("apex_tpu", "examples") if os.path.isdir(p)]
+            if not paths:
+                paths = ["."]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            print(f"apex-tpu-lint: no such path(s): {missing}",
+                  file=sys.stderr)
+            return 2
 
     select = args.select.split(",") if args.select else None
     ignore = args.ignore.split(",") if args.ignore else None
@@ -74,6 +138,8 @@ def main(argv=None):
 
     if args.format == "json":
         print(report.as_json(result, args.show_suppressed))
+    elif args.format == "sarif":
+        print(report.as_sarif(result))
     else:
         print(report.human(result, args.show_suppressed))
     return 1 if result.active() else 0

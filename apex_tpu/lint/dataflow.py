@@ -1,0 +1,774 @@
+"""Interprocedural abstract interpretation over the lint call graph.
+
+The syntactic rules can see a hazard only where it is spelled: a
+``.item()`` is flagged wherever it appears in traced-reachable code,
+even on a value that provably lives on host; a traced value laundered
+through two helper frames into ``static_argnames`` is invisible.  This
+module closes that gap with a small abstract interpreter over the
+:class:`~apex_tpu.lint.callgraph.CallGraph`: every function gets a
+flow-insensitive abstract environment mapping names to
+:class:`AbsVal` — a product of two finite lattices plus one flag —
+computed to a fixpoint across calls, returns and closures.
+
+**Taint lattice** (where does the value live under tracing?)::
+
+            TOP            (conflicting evidence)
+         /   |   \\
+     HOST STATIC TRACED    (host python / trace-time static / tracer)
+         \\   |   /
+          UNKNOWN          (no evidence)
+
+``TRACED`` seeds from a jit entry's own non-static parameters (the
+call graph's provably-traced set) and from jax/jnp/lax constructor
+results; ``HOST`` from python constants, ``float()``/``int()``,
+``.item()``, ``jax.device_get`` and numpy results; ``STATIC`` from
+``.shape``/``.dtype``/``len()`` reads and ``bucket*`` helpers.
+Arithmetic *combines* (a tracer infects the expression); control-flow
+merge *joins* (conflicts go to ``TOP``, which no rule trusts in either
+direction).
+
+**Dtype lattice**: ``UNKNOWN`` / ``WEAK`` (python scalar — jax's
+weak-typed constants are dtype-transparent) / ``I8`` / ``F16`` /
+``BF16`` / ``F32`` / ``OTHER``, with jnp's promotion for arithmetic
+(``f16 + bf16 -> f32``, weak scalars preserve the array dtype).  A
+dtype is only ever *definite*: an ``astype`` with a variable target
+yields ``UNKNOWN``, so PRECISION-SINK flags proofs, not guesses.
+
+**shape_derived**: True for values computed from a traced value's
+``.shape``/``.size``/``len()`` — the program-identity surface
+SHAPE-BRANCH polices.  Routing through any ``bucket*`` helper clears
+it (the sanctioned O(log) quantization, same convention as
+SERVE-SHAPE).
+
+Interprocedural propagation: call-site argument values join into the
+callee's parameter seeds (never into jit *entries* — their parameters
+are pinned TRACED no matter what eager code passes), return values
+summarize back to call sites, and nested defs read the enclosing
+frame's environment.  Everything is monotone over finite lattices, so
+the worklist terminates; ``max_visits`` is a safety bound only.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
+
+# -- taint lattice ----------------------------------------------------------
+
+UNKNOWN, HOST, STATIC, TRACED, TOP = 0, 1, 2, 3, 4
+
+_TAINT_NAMES = {UNKNOWN: "unknown", HOST: "host", STATIC: "static",
+                TRACED: "traced", TOP: "top"}
+
+
+def join_taint(a: int, b: int) -> int:
+    """Control-flow merge: conflicting evidence goes to TOP."""
+    if a == b:
+        return a
+    if a == UNKNOWN:
+        return b
+    if b == UNKNOWN:
+        return a
+    return TOP
+
+
+def combine_taint(a: int, b: int) -> int:
+    """Arithmetic/containment: a tracer infects the expression (a
+    traced operand makes the result traced; TOP stays poisoned; a
+    host+static mix is host python arithmetic)."""
+    if TRACED in (a, b):
+        return TRACED
+    if TOP in (a, b):
+        return TOP
+    if HOST in (a, b):
+        return HOST
+    if STATIC in (a, b):
+        return STATIC
+    return UNKNOWN
+
+
+# -- dtype lattice ----------------------------------------------------------
+
+DT_UNKNOWN, DT_WEAK, DT_I8, DT_F16, DT_BF16, DT_F32, DT_OTHER = range(7)
+
+_DTYPE_BY_NAME = {
+    "float16": DT_F16, "half": DT_F16,
+    "bfloat16": DT_BF16,
+    "float32": DT_F32, "single": DT_F32, "float_": DT_F32,
+    "int8": DT_I8,
+    "float64": DT_OTHER, "double": DT_OTHER, "int32": DT_OTHER,
+    "int64": DT_OTHER, "uint32": DT_OTHER, "bool_": DT_OTHER,
+}
+
+HALF_DTYPES = (DT_F16, DT_BF16)
+
+
+def join_dtype(a: int, b: int) -> int:
+    if a == b:
+        return a
+    if a in (DT_UNKNOWN, DT_WEAK):
+        return b if a == DT_WEAK else DT_UNKNOWN
+    if b in (DT_UNKNOWN, DT_WEAK):
+        return a if b == DT_WEAK else DT_UNKNOWN
+    return DT_UNKNOWN
+
+
+def promote_dtype(a: int, b: int) -> int:
+    """jnp-style result dtype of arithmetic: weak python scalars are
+    transparent, f16+bf16 promotes to f32, i8 promotes into floats; any
+    unknown operand makes the result unknown (never guess a half)."""
+    if a == DT_WEAK:
+        return b
+    if b == DT_WEAK:
+        return a
+    if DT_UNKNOWN in (a, b) or DT_OTHER in (a, b):
+        return DT_UNKNOWN if DT_UNKNOWN in (a, b) else DT_OTHER
+    if a == b:
+        return a
+    if DT_F32 in (a, b):
+        return DT_F32
+    if {a, b} == {DT_F16, DT_BF16}:
+        return DT_F32
+    if DT_I8 in (a, b):
+        return a if b == DT_I8 else b
+    return DT_UNKNOWN
+
+
+def dtype_const(node: ast.AST) -> int:
+    """The definite dtype a ``dtype=`` argument / ``astype`` target
+    names (``jnp.float16`` / ``np.float16`` / ``"float16"``), else
+    UNKNOWN."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _DTYPE_BY_NAME.get(node.value, DT_UNKNOWN)
+    if isinstance(node, ast.Attribute):
+        return _DTYPE_BY_NAME.get(node.attr, DT_UNKNOWN)
+    if isinstance(node, ast.Name):
+        return _DTYPE_BY_NAME.get(node.id, DT_UNKNOWN)
+    return DT_UNKNOWN
+
+
+# -- abstract values --------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AbsVal:
+    """One abstract value: taint x dtype x shape-derived flag."""
+    taint: int = UNKNOWN
+    dtype: int = DT_UNKNOWN
+    shape_derived: bool = False
+
+    def __repr__(self):
+        bits = [_TAINT_NAMES[self.taint]]
+        if self.dtype != DT_UNKNOWN:
+            bits.append(f"dt{self.dtype}")
+        if self.shape_derived:
+            bits.append("shape")
+        return f"<{' '.join(bits)}>"
+
+    @property
+    def is_traced(self) -> bool:
+        return self.taint == TRACED
+
+    @property
+    def is_host(self) -> bool:
+        return self.taint == HOST
+
+    @property
+    def is_half(self) -> bool:
+        return self.dtype in HALF_DTYPES
+
+
+BOTTOM = AbsVal()
+
+
+def join(a: AbsVal, b: AbsVal) -> AbsVal:
+    return AbsVal(join_taint(a.taint, b.taint),
+                  join_dtype(a.dtype, b.dtype),
+                  a.shape_derived or b.shape_derived)
+
+
+def combine(a: AbsVal, b: AbsVal) -> AbsVal:
+    return AbsVal(combine_taint(a.taint, b.taint),
+                  promote_dtype(a.dtype, b.dtype),
+                  a.shape_derived or b.shape_derived)
+
+
+#: array methods whose result keeps the receiver's taint and (absent a
+#: dtype= override) its dtype
+_ARRAY_METHODS = {
+    "sum", "mean", "max", "min", "prod", "cumsum", "cumprod", "dot",
+    "matmul", "reshape", "transpose", "swapaxes", "squeeze", "ravel",
+    "flatten", "copy", "conj", "clip", "round", "take", "repeat",
+    "at", "set", "add", "get", "block_until_ready", "std", "var",
+}
+
+#: methods that fetch to host
+_HOST_METHODS = {"item", "tolist", "to_py"}
+
+_HOST_BUILTINS = {"float", "int", "bool", "str", "repr", "format",
+                  "hash", "print"}
+
+#: builtins transparent to taint/shape_derived (min(n, cap) of a
+#: shape-derived extent is still shape-derived; bucket* is the one
+#: sanctioned quantizer)
+_PASSTHRU_BUILTINS = {"min", "max", "abs", "sum", "sorted", "list",
+                      "tuple", "set", "dict", "zip", "enumerate",
+                      "range", "reversed", "round", "divmod", "getattr"}
+
+#: external roots classified wholesale
+_HOST_ROOTS = ("numpy", "math", "os", "time", "random", "itertools",
+               "functools.reduce")
+
+
+@dataclasses.dataclass
+class FunctionFacts:
+    """Fixpoint result for one function."""
+    params: Dict[str, AbsVal]           # parameter seeds (joined)
+    env: Dict[str, AbsVal]              # final flow-insensitive env
+    ret: AbsVal                         # return summary
+
+
+class _State:
+    """Per-analysis evaluation state: the local env plus the closure
+    lookup chain."""
+    __slots__ = ("df", "info", "env", "ret")
+
+    def __init__(self, df, info, env):
+        self.df = df
+        self.info = info
+        self.env = env
+        self.ret = BOTTOM
+
+    def lookup(self, name: str) -> AbsVal:
+        v = self.env.get(name)
+        if v is not None:
+            return v
+        # closure chain: nested defs read the enclosing frame's env
+        path, parent = self.info.module_path, self.info.parent
+        seen = 0
+        while parent is not None and seen < 8:
+            pf = self.df.facts.get((path, parent))
+            if pf is not None and name in pf.env:
+                return pf.env[name]
+            fi = self.df.cg.functions.get((path, parent))
+            parent = fi.parent if fi is not None else None
+            seen += 1
+        return BOTTOM
+
+
+class Dataflow:
+    """The fixpoint engine plus the query API the rules use.
+
+    ``facts`` maps ``(module_path, qualname)`` to
+    :class:`FunctionFacts`; :meth:`eval_in` re-evaluates an arbitrary
+    expression under a function's final environment (joins are
+    saturated at fixpoint, so re-evaluation is side-effect-free in the
+    lattice sense).
+    """
+
+    def __init__(self, modules, callgraph, max_visits: int = 10):
+        self.cg = callgraph
+        self.max_visits = max_visits
+        self.facts: Dict[Tuple[str, str], FunctionFacts] = {}
+        self._module_globals: Dict[str, Set[str]] = {}
+        for m in modules:
+            self._module_globals[m.path] = self._collect_globals(m)
+        for key, info in self.cg.functions.items():
+            self.facts[key] = FunctionFacts(
+                params=self._seed_params(key, info), env={}, ret=BOTTOM)
+        self._callers: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for key, info in self.cg.functions.items():
+            for callee in self.cg._callees(info):
+                self._callers.setdefault(callee, set()).add(key)
+        self._run_fixpoint()
+
+    # -- setup -------------------------------------------------------------
+
+    @staticmethod
+    def _collect_globals(module) -> Set[str]:
+        out: Set[str] = set()
+        for node in module.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        return out
+
+    @staticmethod
+    def _defaulted_params(info) -> Set[str]:
+        """Parameters bound to a default at the def site.  On a traced
+        entry these are almost never passed by the tracer — jax closes
+        over the default as a trace-time constant (the ``prog=program``
+        idiom) — so they must not seed TRACED."""
+        args = getattr(info.node, "args", None)
+        if args is None:
+            return set()
+        out: Set[str] = set()
+        pos = list(getattr(args, "posonlyargs", ())) + list(args.args)
+        for a, d in zip(reversed(pos), reversed(args.defaults)):
+            if d is not None:
+                out.add(a.arg)
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None:
+                out.add(a.arg)
+        return out
+
+    def _seed_params(self, key, info) -> Dict[str, AbsVal]:
+        seeds: Dict[str, AbsVal] = {}
+        if key in self.cg._entries:
+            static = self.cg._entry_static.get(key, set())
+            defaulted = self._defaulted_params(info)
+            for p in info.params:
+                if p in defaulted:
+                    continue
+                seeds[p] = AbsVal(STATIC) if p in static \
+                    else AbsVal(TRACED)
+        return seeds
+
+    # -- fixpoint ----------------------------------------------------------
+
+    def _run_fixpoint(self):
+        order = sorted(self.cg.functions)
+        queue = deque(order)
+        queued = set(order)
+        visits: Dict[Tuple[str, str], int] = {}
+
+        def enqueue(k):
+            if k in self.cg.functions and k not in queued:
+                queue.append(k)
+                queued.add(k)
+
+        self._enqueue = enqueue
+        while queue:
+            key = queue.popleft()
+            queued.discard(key)
+            n = visits.get(key, 0)
+            if n >= self.max_visits:
+                continue
+            visits[key] = n + 1
+            if self._analyze(key):
+                for caller in self._callers.get(key, ()):
+                    enqueue(caller)
+                for child in self.cg._children.get(key, ()):
+                    enqueue(child)
+        self._enqueue = lambda k: None      # queries must not requeue
+
+    def _analyze(self, key) -> bool:
+        info = self.cg.functions[key]
+        facts = self.facts[key]
+        env = dict(facts.params)
+        st = _State(self, info, env)
+        # two passes make the flow-insensitive env closed under
+        # use-before-def within one body (joins are monotone)
+        for _ in range(2):
+            self._exec_block(info.node.body, st)
+        changed = (env != facts.env) or (st.ret != facts.ret)
+        facts.env = env
+        facts.ret = st.ret
+        return changed
+
+    # -- statements --------------------------------------------------------
+
+    def _exec_block(self, stmts, st):
+        for s in stmts:
+            self._exec_stmt(s, st)
+
+    def _exec_stmt(self, s, st):
+        if isinstance(s, ast.Assign):
+            self._bind(s.targets, s.value, st)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self._bind([s.target], s.value, st)
+        elif isinstance(s, ast.AugAssign):
+            v = combine(self.eval(ast.Name(id=s.target.id,
+                                           ctx=ast.Load())
+                                  if isinstance(s.target, ast.Name)
+                                  else s.target, st),
+                        self.eval(s.value, st)) \
+                if isinstance(s.target, ast.Name) \
+                else self.eval(s.value, st)
+            if isinstance(s.target, ast.Name):
+                st.env[s.target.id] = join(
+                    st.env.get(s.target.id, BOTTOM), v)
+        elif isinstance(s, ast.Return):
+            if s.value is not None:
+                st.ret = join(st.ret, self.eval(s.value, st))
+        elif isinstance(s, (ast.If, ast.While)):
+            self.eval(s.test, st)
+            self._exec_block(s.body, st)
+            self._exec_block(s.orelse, st)
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            it = self.eval(s.iter, st)
+            self._bind_value(s.target,
+                             AbsVal(it.taint, it.dtype,
+                                    it.shape_derived), st)
+            self._exec_block(s.body, st)
+            self._exec_block(s.orelse, st)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                v = self.eval(item.context_expr, st)
+                if item.optional_vars is not None:
+                    self._bind_value(item.optional_vars, v, st)
+            self._exec_block(s.body, st)
+        elif isinstance(s, ast.Try):
+            self._exec_block(s.body, st)
+            for h in s.handlers:
+                self._exec_block(h.body, st)
+            self._exec_block(s.orelse, st)
+            self._exec_block(s.finalbody, st)
+        elif isinstance(s, ast.Expr):
+            self.eval(s.value, st)
+        # nested FunctionDef/ClassDef: analyzed as their own functions
+
+    def _bind(self, targets, value, st):
+        # tuple-to-tuple assignments bind elementwise so `a, b = f(x), 3`
+        # does not smear f's taint onto b
+        for tgt in targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)) and \
+                    isinstance(value, (ast.Tuple, ast.List)) and \
+                    len(tgt.elts) == len(value.elts):
+                for t, v in zip(tgt.elts, value.elts):
+                    self._bind([t], v, st)
+                return
+        v = self.eval(value, st)
+        for tgt in targets:
+            self._bind_value(tgt, v, st)
+
+    def _bind_value(self, tgt, v: AbsVal, st):
+        if isinstance(tgt, ast.Name):
+            # a FIRST bind overwrites (BOTTOM means "no evidence yet",
+            # not "evidence of unknown" — joining would erase a definite
+            # dtype); later rebinds join, staying flow-insensitive
+            cur = st.env.get(tgt.id, BOTTOM)
+            st.env[tgt.id] = v if cur == BOTTOM else join(cur, v)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._bind_value(el, v, st)
+        elif isinstance(tgt, ast.Starred):
+            self._bind_value(tgt.value, v, st)
+        # Attribute/Subscript stores carry no env binding (TRACER-LEAK
+        # inspects them directly)
+
+    # -- expressions -------------------------------------------------------
+
+    def eval(self, node, st: _State) -> AbsVal:
+        if node is None:
+            return BOTTOM
+        if isinstance(node, ast.Constant):
+            dt = DT_WEAK if isinstance(node.value, (int, float, bool)) \
+                else DT_UNKNOWN
+            return AbsVal(HOST, dt, False)
+        if isinstance(node, ast.Name):
+            return st.lookup(node.id)
+        if isinstance(node, ast.Attribute):
+            return self._eval_attr(node, st)
+        if isinstance(node, ast.Subscript):
+            base = self.eval(node.value, st)
+            self.eval(node.slice, st)
+            return base
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = BOTTOM
+            for el in node.elts:
+                out = combine(out, self.eval(el, st))
+            return out
+        if isinstance(node, ast.Dict):
+            out = BOTTOM
+            for k, v in zip(node.keys, node.values):
+                if k is not None:
+                    self.eval(k, st)
+                out = combine(out, self.eval(v, st))
+            return out
+        if isinstance(node, ast.BinOp):
+            return combine(self.eval(node.left, st),
+                           self.eval(node.right, st))
+        if isinstance(node, ast.BoolOp):
+            out = BOTTOM
+            for v in node.values:
+                out = combine(out, self.eval(v, st))
+            return out
+        if isinstance(node, ast.Compare):
+            out = self.eval(node.left, st)
+            for c in node.comparators:
+                out = combine(out, self.eval(c, st))
+            # comparisons yield bools; keep taint + shape_derived only
+            return AbsVal(out.taint, DT_UNKNOWN, out.shape_derived)
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand, st)
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test, st)
+            return join(self.eval(node.body, st),
+                        self.eval(node.orelse, st))
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, st)
+        if isinstance(node, ast.Lambda):
+            return BOTTOM
+        if isinstance(node, (ast.JoinedStr, ast.FormattedValue)):
+            for child in ast.iter_child_nodes(node):
+                self.eval(child, st)
+            return AbsVal(HOST)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, st)
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            for gen in node.generators:
+                it = self.eval(gen.iter, st)
+                self._bind_value(gen.target,
+                                 AbsVal(it.taint, it.dtype,
+                                        it.shape_derived), st)
+                for cond in gen.ifs:
+                    self.eval(cond, st)
+            if isinstance(node, ast.DictComp):
+                self.eval(node.key, st)
+                return self.eval(node.value, st)
+            return self.eval(node.elt, st)
+        if isinstance(node, (ast.Slice,)):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self.eval(part, st)
+            return BOTTOM
+        if isinstance(node, ast.NamedExpr):
+            v = self.eval(node.value, st)
+            self._bind_value(node.target, v, st)
+            return v
+        if isinstance(node, ast.Await):
+            return self.eval(node.value, st)
+        return BOTTOM
+
+    def _eval_attr(self, node: ast.Attribute, st) -> AbsVal:
+        attr = node.attr
+        base = self.eval(node.value, st)
+        if attr in ("shape", "size"):
+            return AbsVal(STATIC, DT_UNKNOWN,
+                          base.taint == TRACED or base.shape_derived)
+        if attr in ("dtype", "ndim", "sharding", "device"):
+            # rank/dtype/placement are static and BOUNDED — branching on
+            # them is specialization, not traffic-driven retrace
+            return AbsVal(STATIC, DT_UNKNOWN, False)
+        if attr in ("T", "mT", "real", "imag", "data", "grad", "value"):
+            return base
+        if self._external_root(node, st) is not None:
+            # module attribute (jnp.float16, math.pi, ...) — a dtype
+            # token or host constant, not an array
+            return AbsVal(HOST)
+        # attribute of a traced container (state.params) is traced;
+        # other taints don't survive a field read we know nothing about
+        t = base.taint if base.taint in (TRACED, HOST, STATIC) \
+            else UNKNOWN
+        return AbsVal(t, DT_UNKNOWN, False)
+
+    def _external_root(self, node, st) -> Optional[str]:
+        """The external dotted module a Name/Attribute chain is rooted
+        at (``jnp.zeros`` -> "jax.numpy"), else None."""
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        table = self.cg.imports.get(st.info.module_path)
+        if table is None:
+            return None
+        return table.ext_alias.get(node.id)
+
+    # -- calls -------------------------------------------------------------
+
+    def _eval_call(self, node: ast.Call, st) -> AbsVal:
+        argvals = [self.eval(a, st) for a in node.args]
+        kwvals = {kw.arg: self.eval(kw.value, st)
+                  for kw in node.keywords}
+        func = node.func
+        tn = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+
+        # the sanctioned shape quantizer: any bucket* helper
+        if tn and "bucket" in tn:
+            return AbsVal(STATIC)
+
+        dt_kw = DT_UNKNOWN
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                dt_kw = dtype_const(kw.value)
+
+        if isinstance(func, ast.Name):
+            v = self._eval_name_call(node, tn, argvals, dt_kw, st)
+            if v is not None:
+                return v
+        elif isinstance(func, ast.Attribute):
+            v = self._eval_attr_call(node, tn, argvals, dt_kw, st)
+            if v is not None:
+                return v
+        elif isinstance(func, ast.Call):
+            # jax.jit(f, ...)(x) / partial(f, ...)(x)
+            self.eval(func, st)
+            inner = func.func
+            root = self._external_root(inner, st) or ""
+            inner_tn = inner.attr if isinstance(inner, ast.Attribute) \
+                else (inner.id if isinstance(inner, ast.Name) else "")
+            if root.startswith("jax") or inner_tn in ("jit", "pjit"):
+                return AbsVal(TRACED, dt_kw, False)
+            return BOTTOM
+        return BOTTOM
+
+    def _eval_name_call(self, node, tn, argvals, dt_kw, st):
+        a0 = argvals[0] if argvals else BOTTOM
+        if tn in _HOST_BUILTINS:
+            return AbsVal(HOST, DT_UNKNOWN, a0.shape_derived)
+        if tn == "len":
+            return AbsVal(STATIC, DT_UNKNOWN,
+                          a0.taint == TRACED or a0.shape_derived)
+        if tn in ("isinstance", "hasattr", "callable", "type", "id"):
+            return AbsVal(HOST)
+        if tn in _PASSTHRU_BUILTINS:
+            out = BOTTOM
+            for v in argvals:
+                out = combine(out, v)
+            return out
+        # bare names imported from an external module
+        root = self._external_root(node.func, st)
+        if root is not None:
+            return self._external_call(node, tn, root, argvals, dt_kw)
+        # intra-package resolution
+        callees = self._resolve_name_call(node.func.id, st)
+        if callees:
+            return self._summarize_call(node, callees, argvals, st)
+        return None
+
+    def _eval_attr_call(self, node, tn, argvals, dt_kw, st):
+        func = node.func
+        root = self._external_root(func, st)
+        if root is not None:
+            return self._external_call(node, tn, root, argvals, dt_kw)
+        # module-alias resolution into the analyzed set: `mod.fn(...)`
+        if isinstance(func.value, ast.Name):
+            table = self.cg.imports.get(st.info.module_path)
+            if table is not None and func.value.id in table.mod_alias:
+                path = table.mod_alias[func.value.id]
+                callees = [(path, qn) for qn in
+                           self.cg.by_name.get(path, {}).get(tn, ())]
+                if callees:
+                    return self._summarize_call(node, callees, argvals,
+                                                st)
+                return None
+        # method call on a value
+        base = self.eval(func.value, st)
+        if tn in _HOST_METHODS:
+            return AbsVal(HOST, base.dtype, base.shape_derived)
+        if tn == "astype":
+            target = dtype_const(node.args[0]) if node.args else DT_UNKNOWN
+            return AbsVal(base.taint, target, base.shape_derived)
+        if tn in _ARRAY_METHODS:
+            dt = dt_kw if dt_kw != DT_UNKNOWN else base.dtype
+            return AbsVal(base.taint, dt, False)
+        if base.taint == TRACED:
+            # methods of traced pytrees (state._replace(...)) stay traced
+            return AbsVal(TRACED, DT_UNKNOWN, False)
+        return None
+
+    def _external_call(self, node, tn, root, argvals, dt_kw):
+        if root.startswith("jax"):
+            if tn in ("device_get", "device_get_async"):
+                a0 = argvals[0] if argvals else BOTTOM
+                return AbsVal(HOST, a0.dtype, False)
+            dt = dt_kw
+            if dt == DT_UNKNOWN and tn not in ("zeros", "ones", "full",
+                                               "empty", "arange"):
+                # elementwise/reduction results promote operand dtypes
+                for v in argvals:
+                    dt = promote_dtype(dt, v.dtype) \
+                        if dt != DT_UNKNOWN else v.dtype
+                if any(v.dtype == DT_UNKNOWN for v in argvals):
+                    dt = DT_UNKNOWN
+            return AbsVal(TRACED, dt, False)
+        if root.startswith(_HOST_ROOTS):
+            return AbsVal(HOST, dt_kw, False)
+        return None
+
+    def _resolve_name_call(self, name: str, st):
+        out: List[Tuple[str, str]] = []
+        path = st.info.module_path
+        for qn in self.cg.by_name.get(path, {}).get(name, ()):
+            out.append((path, qn))
+        table = self.cg.imports.get(path)
+        if table is not None and name in table.func_alias:
+            tpath, fn = table.func_alias[name]
+            for qn in self.cg.by_name.get(tpath, {}).get(fn, ()):
+                out.append((tpath, qn))
+        return out
+
+    def _summarize_call(self, node, callees, argvals, st) -> AbsVal:
+        out = BOTTOM
+        for key in callees:
+            if key not in self.facts:
+                continue
+            self._seed_call_args(key, node, argvals, st)
+            out = join(out, self.facts[key].ret)
+        return out
+
+    def _seed_call_args(self, callee_key, call, argvals, st):
+        """Join this call site's argument values into the callee's
+        parameter seeds (direct calls only; jit entries keep their
+        pinned TRACED seeds — eager invocations of a jitted function
+        pass host arrays that BECOME tracers)."""
+        if callee_key in self.cg._entries:
+            return
+        cinfo = self.cg.functions[callee_key]
+        cf = self.facts[callee_key]
+        a = cinfo.node.args
+        pos = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+        if pos and pos[0] in ("self", "cls"):
+            return          # unbound-method resolution would misalign
+        changed = False
+
+        def put(name, v):
+            nonlocal changed
+            nv = join(cf.params.get(name, BOTTOM), v)
+            if nv != cf.params.get(name):
+                cf.params[name] = nv
+                changed = True
+
+        for i, v in enumerate(argvals):
+            if i < len(call.args) and \
+                    isinstance(call.args[i], ast.Starred):
+                break
+            if i < len(pos):
+                put(pos[i], v)
+            elif a.vararg is not None:
+                put(a.vararg.arg, v)
+            else:
+                break
+        for kw in call.keywords:
+            if kw.arg and kw.arg in cinfo.params:
+                put(kw.arg, self.eval(kw.value, st))
+        if changed:
+            self._enqueue(callee_key)
+
+    # -- query API ----------------------------------------------------------
+
+    def facts_for(self, module_path: str,
+                  qualname: str) -> Optional[FunctionFacts]:
+        return self.facts.get((module_path, qualname))
+
+    def eval_in(self, info, expr) -> AbsVal:
+        """Evaluate ``expr`` under ``info``'s final environment (for
+        rules; the fixpoint is saturated, so the extra joins this may
+        perform are no-ops)."""
+        facts = self.facts.get((info.module_path, info.qualname))
+        env = dict(facts.env) if facts is not None else {}
+        st = _State(self, info, env)
+        return self.eval(expr, st)
+
+    def module_globals(self, module_path: str) -> Set[str]:
+        return self._module_globals.get(module_path, set())
+
+    def functions_in(self, module_path: str):
+        """Every FunctionInfo in a file, reachable or not (sorted for
+        deterministic rule output)."""
+        return [self.cg.functions[k] for k in sorted(self.cg.functions)
+                if k[0] == module_path]
+
+
+def build(modules, callgraph) -> Dataflow:
+    return Dataflow(modules, callgraph)

@@ -24,3 +24,42 @@ def as_json(result, show_suppressed=False) -> str:
         f.to_json() for f in result.findings
         if show_suppressed or not (f.suppressed or f.baselined)]
     return json.dumps(out, indent=1)
+
+
+def as_sarif(result) -> str:
+    """SARIF 2.1.0 — the interchange schema GitHub code scanning and
+    most editors ingest.  Only live findings are emitted (suppressed and
+    baselined ones are this tool's own bookkeeping)."""
+    from . import rules as _rules
+
+    driver_rules = [
+        {"id": rid,
+         "shortDescription": {"text": _rules.REGISTRY[rid].summary}}
+        for rid in _rules.rule_ids()]
+    results = []
+    for f in result.active():
+        text = f.message if not f.hint else f"{f.message} ({f.hint})"
+        results.append({
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": text},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/")},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "apex-tpu-lint",
+                                "rules": driver_rules}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=1)

@@ -9,7 +9,7 @@ resolve is dropped, never guessed, so a finding is worth reading.
 from __future__ import annotations
 
 import ast
-import dataclasses
+import time
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .engine import Finding, Module
@@ -87,10 +87,44 @@ def _static_key_exprs(call: ast.Call) -> List[ast.AST]:
     return out
 
 
-@dataclasses.dataclass
+def _walk_own(root):
+    """Walk a function body without descending into nested defs (each
+    reachable nested def is visited as its own function)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
 class LintContext:
-    modules: List[Module]
-    callgraph: object           # callgraph.CallGraph
+    """Shared analysis context handed to every rule.
+
+    ``dataflow`` is built lazily on first access (rules that never
+    consult it keep single-fixture runs AST-only); the engine may pass
+    a zero-arg factory so the built interpreter is shared through its
+    analysis cache.  ``dataflow_ms`` records build time actually spent
+    in THIS run (0 when the cache served it)."""
+
+    def __init__(self, modules: List[Module], callgraph,
+                 dataflow=None):
+        self.modules = modules
+        self.callgraph = callgraph
+        self._dataflow = dataflow       # instance, factory, or None
+        self.dataflow_ms = 0.0
+
+    @property
+    def dataflow(self):
+        if self._dataflow is None or callable(self._dataflow):
+            from . import dataflow as _df
+            t0 = time.perf_counter()
+            built = self._dataflow() if callable(self._dataflow) \
+                else _df.build(self.modules, self.callgraph)
+            self.dataflow_ms = (time.perf_counter() - t0) * 1000.0
+            self._dataflow = built
+        return self._dataflow
 
 
 class Rule:
@@ -99,6 +133,11 @@ class Rule:
     id: str = ""
     summary: str = ""
     hint: str = ""
+    #: True for rules that only judge code inside traced-REACHABLE
+    #: functions: whether they examined a given line depends on which
+    #: entries the scanned scope contains, so the stale-suppression
+    #: audit must not call their directives dead outside that span
+    reachability_scoped: bool = False
 
     def check(self, module: Module, ctx: LintContext):
         raise NotImplementedError
@@ -156,7 +195,54 @@ class RetraceStatic(Rule):
             "scalars (jnp.asarray) — see runtime/step_cache.py's hyper "
             "tree; static keys are for program *shape* only")
 
+    def _jit_static_calls(self, call: ast.Call) -> Set[str]:
+        """static_argnames a jit/pjit/partial(jit) call declares."""
+        from .callgraph import _static_argnames_of
+        tn = _terminal(call.func)
+        if tn in ("jit", "pjit"):
+            return _static_argnames_of(call)
+        if tn == "partial" and call.args and \
+                _terminal(call.args[0]) in ("jit", "pjit"):
+            return _static_argnames_of(call)
+        return set()
+
+    def _dataflow_pass(self, module, ctx):
+        """The interprocedural half: a TRACED value bound to a declared
+        static_argname of a locally-jitted function — invisible to the
+        name heuristic when the value is not spelled like a
+        hyperparameter (it arrived through helper frames)."""
+        jit_static: Dict[str, Set[str]] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                names = self._jit_static_calls(node.value)
+                if names:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            jit_static.setdefault(tgt.id,
+                                                  set()).update(names)
+        if not jit_static:
+            return
+        df = ctx.dataflow
+        for info in df.functions_in(module.path):
+            for node in _walk_own(info.node):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Name) or \
+                        node.func.id not in jit_static:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg not in jit_static[node.func.id]:
+                        continue
+                    if df.eval_in(info, kw.value).is_traced:
+                        yield self.finding(
+                            module, kw.value,
+                            f"traced value bound to static_argname "
+                            f"'{kw.arg}' of '{node.func.id}' — every "
+                            f"distinct value retraces (and a live "
+                            f"tracer here is a ConcretizationError)")
+
     def check(self, module, ctx):
+        yield from self._dataflow_pass(module, ctx)
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -213,15 +299,19 @@ class HostSync(Rule):
     never flag.
     """
     id = "HOST-SYNC"
+    reachability_scoped = True
     summary = "host round-trip inside a jit-reachable function"
     hint = ("keep the value on device (jnp ops, lax.cond on traced "
             "flags); fetch for logging OUTSIDE the compiled step — see "
             "the on-device overflow flag in amp/scaler.py for the "
             "pattern")
 
-    def _traced_refs(self, node, params, out):
-        """Name nodes referring to traced params, skipping contexts that
-        are static under tracing (.shape/.dtype, len(), `is None`)."""
+    def _traced_refs(self, node, is_traced, out):
+        """Name nodes referring to traced values, skipping contexts that
+        are static under tracing (.shape/.dtype, len(), `is None`).
+        ``is_traced(name)`` decides tracedness — the syntactic
+        traced-params set widened by the dataflow environment, so a
+        value that arrived through helper frames still counts."""
         if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
             return
         if isinstance(node, ast.Call) and \
@@ -231,39 +321,52 @@ class HostSync(Rule):
                 all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
             return
         if isinstance(node, ast.Name):
-            if isinstance(node.ctx, ast.Load) and node.id in params:
+            if isinstance(node.ctx, ast.Load) and is_traced(node.id):
                 out.append(node)
             return
         for child in ast.iter_child_nodes(node):
-            self._traced_refs(child, params, out)
+            self._traced_refs(child, is_traced, out)
 
     def _walk_own(self, root):
-        """Walk a function body without descending into nested defs
-        (each reachable nested def is visited as its own function)."""
-        stack = list(ast.iter_child_nodes(root))
-        while stack:
-            node = stack.pop()
-            yield node
-            if not isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef, ast.Lambda)):
-                stack.extend(ast.iter_child_nodes(node))
+        return _walk_own(root)
+
+    @staticmethod
+    def _traced_pred(ctx, info, params):
+        """Name -> provably traced, via the syntactic entry-param set or
+        the interprocedural dataflow environment."""
+        facts = None
+
+        def is_traced(name):
+            nonlocal facts
+            if name in params:
+                return True
+            if facts is None:
+                facts = ctx.dataflow.facts_for(
+                    info.module_path, info.qualname) or ()
+            if not facts:
+                return False
+            v = facts.env.get(name)
+            return v is not None and v.is_traced
+        return is_traced
 
     def check(self, module, ctx):
         table = ctx.callgraph.imports.get(module.path)
         np_aliases = {a for a, d in table.ext_alias.items()
                       if d == "numpy"} if table else {"np"}
         for info in ctx.callgraph.reachable_functions(module.path):
-            # value-sensitive checks key on provably-traced params (an
-            # entry's own args minus static_argnames); .item()/device_get
-            # are flagged in every reachable function regardless
+            # value-sensitive checks key on provably-traced values: an
+            # entry's own non-static params, widened by dataflow facts;
+            # .item()/device_get flag in every reachable function UNLESS
+            # dataflow proves the operand lives on host
             params = ctx.callgraph.traced_params(info)
+            is_traced = self._traced_pred(ctx, info, params)
             for node in self._walk_own(info.node):
                 if isinstance(node, ast.Call):
-                    yield from self._check_call(module, node, params,
-                                                np_aliases)
+                    yield from self._check_call(module, node, is_traced,
+                                                np_aliases, ctx, info)
                 elif isinstance(node, (ast.If, ast.While)):
                     refs = []
-                    self._traced_refs(node.test, params, refs)
+                    self._traced_refs(node.test, is_traced, refs)
                     if refs:
                         yield self.finding(
                             module, node.test,
@@ -272,16 +375,23 @@ class HostSync(Rule):
                             f"forces a device fetch at trace boundaries "
                             f"(use jnp.where / lax.cond)")
 
-    def _check_call(self, module, node, params, np_aliases):
+    def _check_call(self, module, node, is_traced, np_aliases, ctx, info):
         tn = _terminal(node.func)
         if tn == "item" and isinstance(node.func, ast.Attribute) and \
                 not node.args:
+            # dataflow re-grounding: an .item() on a value PROVABLY on
+            # host (a numpy scalar, a config constant) costs nothing
+            if ctx.dataflow.eval_in(info, node.func.value).is_host:
+                return
             yield self.finding(
                 module, node,
                 ".item() inside traced code — blocks on a device "
                 "round-trip every step")
             return
         if tn == "device_get":
+            if node.args and \
+                    ctx.dataflow.eval_in(info, node.args[0]).is_host:
+                return
             yield self.finding(
                 module, node,
                 "jax.device_get inside traced code — host transfer on "
@@ -292,7 +402,7 @@ class HostSync(Rule):
                 isinstance(node.func.value, ast.Name) and \
                 node.func.value.id in np_aliases and node.args:
             refs = []
-            self._traced_refs(node.args[0], params, refs)
+            self._traced_refs(node.args[0], is_traced, refs)
             if refs:
                 yield self.finding(
                     module, node,
@@ -303,7 +413,7 @@ class HostSync(Rule):
                 isinstance(node.func, ast.Name) and len(node.args) == 1 \
                 and not isinstance(node.args[0], ast.Constant):
             refs = []
-            self._traced_refs(node.args[0], params, refs)
+            self._traced_refs(node.args[0], is_traced, refs)
             if refs:
                 yield self.finding(
                     module, node,
@@ -353,6 +463,33 @@ class ScanCollective(Rule):
             return best
         return None
 
+    def _rotation_only(self, body, sub):
+        """A ppermute whose result is bound and never additively
+        accumulated is a pure rotation — the loop-carried neighbor hop
+        of pipeline/ring schedules.  One hop per tick IS the algorithm
+        (nothing to hoist: the exchanged value differs every step), so
+        dataflow proves the site clean without a suppression."""
+        targets = None
+        for st in ast.walk(body):
+            if isinstance(st, ast.Assign) and st.value is sub:
+                targets = {n.id for t in st.targets
+                           for n in ast.walk(t) if isinstance(n, ast.Name)}
+                break
+        if not targets:
+            return False
+        for n in ast.walk(body):
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Add):
+                for side in (n.left, n.right):
+                    for m in ast.walk(side):
+                        if isinstance(m, ast.Name) and m.id in targets:
+                            return False
+            elif isinstance(n, ast.AugAssign) and \
+                    isinstance(n.op, ast.Add):
+                for m in ast.walk(n):
+                    if isinstance(m, ast.Name) and m.id in targets:
+                        return False
+        return True
+
     def check(self, module, ctx):
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call) or \
@@ -371,6 +508,8 @@ class ScanCollective(Rule):
                 # folded to the mesh size, no collective is emitted
                 if tn in ("psum", "pmean", "pmax", "pmin") and sub.args \
                         and isinstance(sub.args[0], ast.Constant):
+                    continue
+                if tn == "ppermute" and self._rotation_only(body, sub):
                     continue
                 yield self.finding(
                     module, sub,
@@ -784,6 +923,7 @@ class ObsInJit(Rule):
     events, heartbeats and drains belong in the eager driver.
     """
     id = "OBS-IN-JIT"
+    reachability_scoped = True
     summary = "host-side observe call inside a jit-reachable function"
     hint = ("accumulate on device via observe.telemetry (the fused "
             "step's telem carry) and log OUTSIDE the compiled step — "
@@ -1179,3 +1319,308 @@ class KernelFallback(Rule):
                         + " — dispatch cannot fall back to XLA below "
                         "the win region, and the ledger has no default "
                         "threshold to override")
+
+
+# ---------------------------------------------------------------------------
+# PRECISION-SINK / TRACER-LEAK / SHAPE-BRANCH — the dataflow-native rules
+# ---------------------------------------------------------------------------
+
+#: reductions/contractions where a half-precision input silently becomes
+#: a half-precision ACCUMULATOR unless told otherwise
+_REDUCTION_CALLS = {"sum", "mean", "prod", "cumsum", "cumprod", "dot",
+                    "matmul", "tensordot", "vdot", "einsum"}
+
+#: container mutators that smuggle a value past the end of the trace
+_LEAK_MUTATORS = {"append", "add", "extend", "insert", "setdefault",
+                  "update"}
+
+
+@register
+class PrecisionSink(Rule):
+    """Half-precision values reaching a reduction without an fp32
+    accumulator — the amp-O2 master-weight invariant as a rule.
+
+    PR 4's loss-scaling work exists because fp16 overflows at 65504 and
+    bf16 drops mantissa bits; both are fine for *storage* and matmul
+    inputs but fatal for *accumulation*.  ``jnp.sum`` of an fp16 array
+    accumulates IN fp16 unless ``preferred_element_type``/``dtype`` says
+    otherwise.  The dtype lattice proves where a half value flows into a
+    reduction with no fp32 upcast on any path — a proof, not a guess:
+    an operand the dataflow cannot type never flags.
+    """
+    id = "PRECISION-SINK"
+    reachability_scoped = True
+    summary = "fp16/bf16 value reduced/accumulated without fp32 upcast"
+    hint = ("accumulate in fp32: pass preferred_element_type="
+            "jnp.float32 (dot/matmul/einsum), dtype=jnp.float32 "
+            "(sum/mean/prod), or upcast with .astype(jnp.float32) "
+            "first — see the master-weight chain in amp/amp.py")
+
+    def _module_aliases(self, module, ctx):
+        table = ctx.callgraph.imports.get(module.path)
+        names = {"jnp", "np", "jax", "lax", "math"}
+        if table:
+            names |= set(table.ext_alias) | set(table.mod_alias)
+        return names
+
+    def _folded_dtype(self, df, info, call, mod_names):
+        """Promoted dtype of the array operands (args + non-module
+        receiver), skipping einsum subscript strings."""
+        from . import dataflow as _df
+        operands = [a for a in call.args
+                    if not (isinstance(a, ast.Constant)
+                            and isinstance(a.value, str))]
+        if isinstance(call.func, ast.Attribute):
+            recv = call.func.value
+            if not (isinstance(recv, ast.Name) and recv.id in mod_names):
+                operands.append(recv)
+        if not operands or any(isinstance(a, ast.Starred)
+                               for a in operands):
+            return _df.DT_UNKNOWN
+        dt = _df.DT_WEAK
+        for a in operands:
+            dt = _df.promote_dtype(dt, df.eval_in(info, a).dtype)
+        return dt
+
+    def _exempt(self, call):
+        from . import dataflow as _df
+        for kw in call.keywords:
+            if kw.arg == "preferred_element_type":
+                return True
+            if kw.arg in ("dtype", "accumulator_dtype") and \
+                    _df.dtype_const(kw.value) not in _df.HALF_DTYPES:
+                return True
+        return False
+
+    def check(self, module, ctx):
+        from . import dataflow as _df
+        mod_names = None
+        for info in ctx.callgraph.reachable_functions(module.path):
+            df = ctx.dataflow
+            if mod_names is None:
+                mod_names = self._module_aliases(module, ctx)
+            for node in _walk_own(info.node):
+                if isinstance(node, ast.Call):
+                    tn = _terminal(node.func)
+                    if tn not in _REDUCTION_CALLS or self._exempt(node):
+                        continue
+                    dt = self._folded_dtype(df, info, node, mod_names)
+                    if dt in _df.HALF_DTYPES:
+                        yield self.finding(
+                            module, node,
+                            f"half-precision operand reaches {tn}() — "
+                            f"the accumulator inherits the half dtype "
+                            f"(fp16 saturates at 65504)")
+                elif isinstance(node, ast.BinOp) and \
+                        isinstance(node.op, ast.MatMult):
+                    if df.eval_in(info, node).is_half:
+                        yield self.finding(
+                            module, node,
+                            "half @ half matmul accumulates in half "
+                            "precision — pass preferred_element_type="
+                            "jnp.float32 via jnp.matmul, or upcast")
+                elif isinstance(node, (ast.For, ast.While)):
+                    yield from self._loop_accum(module, df, info, node)
+
+    def _loop_accum(self, module, df, info, loop):
+        """`acc += h` / `acc = acc + h` in a python loop: each iteration
+        adds in half precision."""
+        for st in ast.walk(loop):
+            if isinstance(st, ast.AugAssign) and \
+                    isinstance(st.op, ast.Add):
+                if df.eval_in(info, st.value).is_half:
+                    yield self.finding(
+                        module, st,
+                        "loop accumulation of a half-precision value — "
+                        "running sum saturates/rounds in fp16/bf16")
+            elif isinstance(st, ast.Assign) and \
+                    isinstance(st.value, ast.BinOp) and \
+                    isinstance(st.value.op, ast.Add) and \
+                    len(st.targets) == 1 and \
+                    isinstance(st.targets[0], ast.Name):
+                tgt = st.targets[0].id
+                sides = (st.value.left, st.value.right)
+                if any(isinstance(s, ast.Name) and s.id == tgt
+                       for s in sides) and \
+                        df.eval_in(info, st.value).is_half:
+                    yield self.finding(
+                        module, st,
+                        "loop accumulation of a half-precision value — "
+                        "running sum saturates/rounds in fp16/bf16")
+
+
+def _leftmost_name(node):
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+@register
+class TracerLeak(Rule):
+    """Traced value stored into state that outlives the trace.
+
+    A tracer written to a module global, an instance attribute, or a
+    long-lived container during tracing becomes a corpse the moment the
+    trace ends: touching it later raises
+    ``UnexpectedTracerError`` (best case) or silently bakes one
+    example's abstract value into every future step (worst case — jax
+    calls this the leaked-tracer bug).  Dataflow knows which values are
+    tracers and which names are module-level, so the rule fires only on
+    proven leaks.
+    """
+    id = "TRACER-LEAK"
+    reachability_scoped = True
+    summary = "traced value escapes into state that outlives the trace"
+    hint = ("return the value from the traced function instead (carry "
+            "it through the step's outputs); host-side stores belong "
+            "outside the jit boundary — see how observe/ keeps "
+            "telemetry in the carry")
+
+    def _store_desc(self, target, gdecls, mg, local):
+        if isinstance(target, ast.Name):
+            if target.id in gdecls:
+                return f"module global '{target.id}'"
+            return None
+        base = _leftmost_name(target)
+        if base in ("self", "cls"):
+            return f"instance state '{base}.…'"
+        if base and base in mg and base not in local:
+            kind = ("module-level container"
+                    if isinstance(target, ast.Subscript)
+                    else "module-global attribute")
+            return f"{kind} '{base}'"
+        return None
+
+    def check(self, module, ctx):
+        for info in ctx.callgraph.reachable_functions(module.path):
+            df = ctx.dataflow
+            mg = df.module_globals(module.path)
+            facts = df.facts_for(info.module_path, info.qualname)
+            local = set(facts.env) if facts is not None else set()
+            gdecls = set()
+            for n in _walk_own(info.node):
+                if isinstance(n, ast.Global):
+                    gdecls.update(n.names)
+            for n in _walk_own(info.node):
+                if isinstance(n, (ast.Assign, ast.AugAssign,
+                                  ast.AnnAssign)):
+                    value = n.value
+                    if value is None or \
+                            not df.eval_in(info, value).is_traced:
+                        continue
+                    targets = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    for t in targets:
+                        desc = self._store_desc(t, gdecls, mg, local)
+                        if desc:
+                            yield self.finding(
+                                module, n,
+                                f"traced value stored into {desc} — "
+                                f"outlives the trace (leaked tracer)")
+                elif isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _LEAK_MUTATORS and n.args:
+                    desc = self._store_desc(n.func, gdecls, mg, local)
+                    if desc and any(df.eval_in(info, a).is_traced
+                                    for a in n.args):
+                        yield self.finding(
+                            module, n,
+                            f"traced value .{n.func.attr}()-ed into "
+                            f"{desc} — outlives the trace (leaked "
+                            f"tracer)")
+
+
+@register
+class ShapeBranch(Rule):
+    """Python control flow on a traced value's shape — SERVE-SHAPE's
+    program-identity hazard, generalized beyond serve.
+
+    Shapes ARE concrete at trace time, so ``if x.shape[0] > n:`` runs —
+    but each distinct shape now takes its own branch and keys its own
+    executable, which is exactly how the serve path melted before
+    bucketing (PR 9): continuous batching feeds every length that
+    arrives.  The dataflow ``shape_derived`` flag follows shape reads
+    through arithmetic and helpers; routing through any ``bucket*``
+    quantizer clears it (the sanctioned O(log) program count).
+    Raise/assert-only guards are validation, not program forks, and
+    stay exempt.
+    """
+    id = "SHAPE-BRANCH"
+    reachability_scoped = True
+    summary = "python branch/loop on a traced value's shape"
+    hint = ("quantize first (bucket_len / next_bucket-style helper) so "
+            "the program count stays O(log max) — or move the decision "
+            "on-device with jnp.where / lax.cond; see "
+            "docs/serving.md on shape buckets")
+
+    #: name fragments of sanctioned shape-quantizer helpers: branches
+    #: INSIDE them are how the O(log) program count gets computed
+    _QUANTIZER_NAMES = ("bucket", "block", "round_up", "chunk")
+
+    def _is_pad_guard(self, node):
+        """``if padded != raw: x = jnp.pad(...)`` — pad-to-multiple.
+        Both paths converge on the quantized extent, so the branch does
+        not fork program identity."""
+        if not isinstance(node, ast.If) or node.orelse:
+            return False
+        for s in node.body:
+            if not (isinstance(s, ast.Assign)
+                    and isinstance(s.value, ast.Call)
+                    and _terminal(s.value.func) == "pad"):
+                return False
+        return bool(node.body)
+
+    def check(self, module, ctx):
+        for info in ctx.callgraph.reachable_functions(module.path):
+            name = info.name.lower()
+            if any(q in name for q in self._QUANTIZER_NAMES):
+                continue
+            for node in _walk_own(info.node):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if isinstance(node, ast.If) and not node.orelse and \
+                        all(isinstance(s, (ast.Raise, ast.Assert))
+                            for s in node.body):
+                    continue   # shape-validation guard, not a fork
+                if self._is_pad_guard(node):
+                    continue
+                val = ctx.dataflow.eval_in(info, node.test)
+                if val.shape_derived:
+                    kw = type(node).__name__.lower()
+                    yield self.finding(
+                        module, node.test,
+                        f"`{kw}` on a shape-derived value — every "
+                        f"distinct input shape takes its own branch "
+                        f"and compiles its own program")
+
+
+# ---------------------------------------------------------------------------
+# STALE-SUPPRESSION — engine-driven: the directive audit
+# ---------------------------------------------------------------------------
+
+
+@register
+class StaleSuppression(Rule):
+    """``# tpu-lint: disable=RULE`` comments whose rule no longer fires
+    on that line.
+
+    Suppressions are debt with a reason attached; when the analyzer
+    gets precise enough to prove the site clean (as dataflow did for
+    the pipeline ppermute hops), the directive outlives its finding and
+    silently masks FUTURE regressions on the same line.  The engine
+    tracks which directives matched a finding during the run and
+    reports the unmatched remainder here — a rule id, so it selects,
+    suppresses and baselines like any other.
+    """
+    id = "STALE-SUPPRESSION"
+    summary = "suppression directive whose rule no longer fires here"
+    hint = ("delete the directive — the analyzer proves the line "
+            "clean; if the hazard is real but currently unprovable, "
+            "keep it and note why in the reason")
+
+    #: the engine emits these findings after the rule loop (it owns the
+    #: directive-usage bookkeeping); check() itself is empty
+    engine_driven = True
+
+    def check(self, module, ctx):
+        return iter(())

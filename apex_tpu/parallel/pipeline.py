@@ -94,8 +94,6 @@ def pipeline_apply(stage_fn, stage_params, xs, axis_name,
         outs = lax.dynamic_update_index_in_dim(
             outs, jnp.where(emit, y, prev), slot, 0)
         # activations advance one stage per tick
-        # tpu-lint: disable=SCAN-COLLECTIVE pipeline hop IS the per-tick
-        # algorithm: each activation moves one stage per scan step
         state = lax.ppermute(y, axis_name, fwd_perm)
         return (state, outs), None
 
@@ -242,10 +240,7 @@ def pipeline_1f1b_grads(stage_fn, stage_params, xs, yrefs, loss_fn,
         # --- hops: activations one stage forward, cotangents one back;
         #     production-to-consumption is exactly one tick in this
         #     schedule, so a single buffer carries each stream ---
-        # tpu-lint: disable=SCAN-COLLECTIVE 1F1B hop IS the per-tick
-        # algorithm: activations/cotangents move one stage per scan step
         act_in = lax.ppermute(y, axis_name, fwd_perm)
-        # tpu-lint: disable=SCAN-COLLECTIVE 1F1B backward hop (see above)
         ct_in = lax.ppermute(g_x, axis_name, bwd_perm)
         return (act_in, ct_in, ring, gacc, loss_sum), None
 

@@ -2665,10 +2665,12 @@ def lint_records():
     and the examples — the same scope as the tier-1 gate
     (tests/test_lint_clean.py) — so a multichip bench round also records
     whether the tree it measured was hazard-clean, and how much the
-    analyzer itself costs.  Pure-AST: needs no backend, so it reports
-    even when the TPU tunnel is wedged.
+    analyzer itself costs.  The AST pass needs no backend; the jaxpr
+    audit traces the entry programs on CPU, so both report even when
+    the TPU tunnel is wedged.
     """
     from apex_tpu import lint as tpu_lint
+    from apex_tpu.lint import jaxpr_audit
 
     repo = os.path.dirname(os.path.abspath(__file__))
     targets = [p for p in (os.path.join(repo, "apex_tpu"),
@@ -2676,15 +2678,22 @@ def lint_records():
                if os.path.isdir(p)]
     res = tpu_lint.run(targets, root=repo)
     c = res.counts()
+    audit = jaxpr_audit.run()
+    a = audit.counts()
     return [{
         "metric": "lint_findings",
         "value": c["findings"], "unit": "findings",
         "lint_findings": c["findings"],
         "lint_ms": c["lint_ms"],
+        "dataflow_ms": c["dataflow_ms"],
+        "stale_suppressions": c["stale_suppressions"],
         "rules_run": c["rules_run"],
         "files_scanned": c["files"],
         "suppressed": c["suppressed"],
         "baselined": c["baselined"],
+        "jaxpr_audit_ms": a["jaxpr_audit_ms"],
+        "programs_audited": a["programs_audited"],
+        "jaxpr_failures": a["failures"],
     }]
 
 

@@ -22,6 +22,14 @@ def branchy_step(params, grads, mode):
 jitted = jax.jit(branchy_step, static_argnames=("mode",))
 
 
+@jax.jit
+def host_const_step(params):
+    # fine: .item() on a numpy scalar — dataflow proves it lives on
+    # host, so there is no device round-trip to flag
+    cap = np.float32(8.0).item()
+    return [p * cap for p in params]
+
+
 def eager_train_loop(step, params, batches):
     """Eager driver — host syncs for logging are exactly where they
     belong, OUTSIDE the compiled step."""

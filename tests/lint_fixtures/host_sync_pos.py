@@ -35,3 +35,17 @@ def bad_scale_step(params, scale):
 train = jax.jit(bad_overflow_step)
 fetch = jax.jit(bad_fetch_step)
 scaled = jax.jit(bad_scale_step)
+
+
+def _decide(x):
+    # BAD (interprocedural): x arrives traced from the jitted caller —
+    # the branch is a device fetch even though this helper never
+    # mentions jax
+    if x > 0:
+        return x
+    return -x
+
+
+@jax.jit
+def routed_step(v):
+    return _decide(v * 2.0)

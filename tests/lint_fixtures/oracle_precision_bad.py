@@ -1,0 +1,14 @@
+"""Dynamic-oracle fixture: PRECISION-SINK flags this module statically,
+and running it proves the hazard is real — the fp16 reduction saturates
+(inf) on values every element of which is comfortably representable."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def window_energy(xs):
+    # BAD: the squared-activation energy is summed IN fp16 — the
+    # accumulator overflows fp16's 65504 max long before any single
+    # element does
+    h = xs.astype(jnp.float16)
+    return jnp.sum(h * h)

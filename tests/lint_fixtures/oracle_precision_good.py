@@ -1,0 +1,13 @@
+"""Dynamic-oracle fixture: the fp32-accumulated twin of
+oracle_precision_bad — clean statically, finite dynamically on the
+same input."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def window_energy(xs):
+    # products form in fp16 (that is the storage dtype), but the
+    # REDUCTION runs in fp32 — the accumulator cannot saturate
+    h = xs.astype(jnp.float16)
+    return jnp.sum((h * h).astype(jnp.float32))

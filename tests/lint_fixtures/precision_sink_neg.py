@@ -1,0 +1,26 @@
+"""PRECISION-SINK negative: every reduction of a half value routes
+through an fp32 accumulator — the amp-O2 master-weight discipline."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clean_loss(h):
+    hh = h.astype(jnp.float16)
+    # upcast BEFORE the reduction
+    total = jnp.sum(hh.astype(jnp.float32))
+    # or tell the reduction to accumulate in fp32
+    total2 = jnp.sum(hh, dtype=jnp.float32)
+    # or give the contraction an fp32 accumulator explicitly
+    gram = jnp.matmul(hh, hh, preferred_element_type=jnp.float32)
+    return total + total2, gram
+
+
+@jax.jit
+def clean_master(h):
+    hh = h.astype(jnp.bfloat16)
+    acc = jnp.zeros_like(h, dtype=jnp.float32)
+    for _ in range(4):
+        # fp32 running sum over half-precision increments
+        acc = acc + hh.astype(jnp.float32)
+    return acc

@@ -1,0 +1,24 @@
+"""PRECISION-SINK positive: half-precision values reaching reductions
+with no fp32 accumulator anywhere on the path."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_loss(h):
+    hh = h.astype(jnp.float16)
+    # BAD: jnp.sum of an fp16 array accumulates IN fp16 (caps at 65504)
+    total = jnp.sum(hh)
+    # BAD: fp16 @ fp16 keeps the fp16 accumulator too
+    gram = hh @ hh
+    return total, gram
+
+
+@jax.jit
+def bad_running(h):
+    hh = h.astype(jnp.bfloat16)
+    acc = hh * 0.0
+    for _ in range(4):
+        # BAD: python-loop accumulation in bf16 drops mantissa bits
+        acc = acc + hh
+    return acc

@@ -14,3 +14,17 @@ def cached_step(step_cache, params, grads, lr, build):
     args = (params, grads, jnp.asarray(lr, jnp.float32))
     fn = step_cache.program("sgd", ("cfg", True), args, build)
     return fn(*args)
+
+
+def _accum(params, grads, accum_steps):
+    del accum_steps
+    return [p - 0.1 * g for p, g in zip(params, grads)]
+
+
+WINDOWED = jax.jit(_accum, static_argnames=("accum_steps",))
+
+
+def windowed_step(params, grads, cfg):
+    # fine: the static knob is host config, never a tracer
+    k = cfg.get("accum_steps", 1)
+    return WINDOWED(params, grads, accum_steps=k)

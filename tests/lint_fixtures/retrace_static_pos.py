@@ -20,3 +20,26 @@ def cached_step(step_cache, params, grads, lr, build):
     # BAD: lr in the hashable program key — one executable per lr value
     fn = step_cache.program("sgd", ("cfg", lr), args, build)
     return fn(*args)
+
+
+def _sgd(params, grads, lr):
+    return [p - lr * g for p, g in zip(params, grads)]
+
+
+UPDATE = jax.jit(_sgd, static_argnames=("lr",))
+
+
+def _decay(x):
+    return x * 0.99
+
+
+def _anneal(base):
+    return _decay(base)
+
+
+def schedule_step(params, grads):
+    import jax.numpy as jnp
+    lr = _anneal(jnp.asarray(0.1))
+    # BAD: the traced lr schedule reaches the static argname through
+    # two helper frames — dataflow catches what the AST cannot
+    return UPDATE(params, grads, lr=lr)

@@ -1,0 +1,29 @@
+"""SHAPE-BRANCH negative: shape decisions routed through a bucket
+quantizer, and raise-only validation guards."""
+import jax
+
+
+def bucket_len(n, cap=256):
+    # fine: this IS the sanctioned quantizer — O(log) programs by
+    # construction
+    m = 8
+    while m < n and m < cap:
+        m *= 2
+    return min(m, cap)
+
+
+@jax.jit
+def clean_bucketed(x):
+    n = bucket_len(x.shape[0])
+    # fine: branches on the BUCKET, not the raw extent
+    if n > 128:
+        return x.sum() / n
+    return x.sum()
+
+
+@jax.jit
+def clean_guard(x, y):
+    # fine: a validation guard raises — it never forks program identity
+    if x.shape != y.shape:
+        raise ValueError("shape mismatch")
+    return x + y

@@ -1,0 +1,24 @@
+"""SHAPE-BRANCH positive: python control flow forking on a traced
+value's shape — every distinct input shape compiles its own program."""
+import jax
+
+
+@jax.jit
+def bad_pick_program(x):
+    # BAD: each arriving length takes its own branch (and its own XLA
+    # executable) — the unbucketed-serve pathology
+    if x.shape[0] > 128:
+        return x[:128] * 2.0
+    return x * 2.0
+
+
+def _route(n):
+    # BAD (interprocedural): n derives from a traced shape two frames up
+    while n > 1:
+        n = n // 2
+    return n
+
+
+@jax.jit
+def bad_halving(x):
+    return x * _route(x.shape[0])

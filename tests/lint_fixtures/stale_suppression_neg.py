@@ -1,0 +1,8 @@
+"""STALE-SUPPRESSION negative: the directive still earns its keep —
+RETRACE-STATIC fires on this line and the suppression consumes it."""
+import jax
+
+
+def make(update):
+    # tpu-lint: disable=RETRACE-STATIC fixture: lr deliberately static
+    return jax.jit(update, static_argnames=("lr",))

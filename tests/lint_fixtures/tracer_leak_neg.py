@@ -1,0 +1,24 @@
+"""TRACER-LEAK negative: traced values leave through the return value;
+host-side bookkeeping happens outside the trace; locals may hold
+tracers freely (they die with the trace)."""
+import jax
+import jax.numpy as jnp
+
+_STATS = {}
+
+
+@jax.jit
+def clean_step(params, grads):
+    g = grads[0]
+    # fine: a LOCAL container dies with the trace
+    scratch = {}
+    scratch["g2"] = g * g
+    out = [p - 0.1 * gi for p, gi in zip(params, grads)]
+    # traced values exit through the outputs, as they should
+    return out, jnp.sqrt(jnp.sum(scratch["g2"]))
+
+
+def record(kind, ms):
+    # fine: eager bookkeeping with host floats, outside any trace
+    _STATS[kind] = ms
+    _STATS.setdefault("count", 0)

@@ -1,0 +1,27 @@
+"""TRACER-LEAK positive: traced values parked in state that outlives
+the trace (module globals, long-lived containers, instance state)."""
+import jax
+
+_CACHE = {}
+_LAST = []
+
+
+@jax.jit
+def bad_probe_step(params, grads):
+    g = grads[0]
+    # BAD: traced value keyed into a module-level dict
+    _CACHE["last_grad"] = g
+    # BAD: traced value appended to a module-level list
+    _LAST.append(g * 2.0)
+    return [p - 0.1 * gi for p, gi in zip(params, grads)]
+
+
+_PEAK = None
+
+
+@jax.jit
+def bad_global_step(params):
+    global _PEAK
+    # BAD: traced value rebinds a module global
+    _PEAK = params[0] * params[0]
+    return params

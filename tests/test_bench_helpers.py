@@ -353,5 +353,13 @@ def test_lint_records_schema():
     assert rec["metric"] == "lint_findings"
     assert rec["value"] == rec["lint_findings"] == 0   # tree ships clean
     assert rec["lint_ms"] > 0
-    assert len(rec["rules_run"]) >= 7
+    assert len(rec["rules_run"]) >= 16
     assert rec["files_scanned"] > 100      # apex_tpu + examples
+    # lint v2 analyzer-health fields: the dataflow pass ran, the tree
+    # carries no dead suppressions, and the jaxpr audit covered the
+    # entry programs without a failure
+    assert rec["dataflow_ms"] > 0
+    assert rec["stale_suppressions"] == 0
+    assert rec["jaxpr_audit_ms"] > 0
+    assert rec["programs_audited"] >= 12
+    assert rec["jaxpr_failures"] == 0

@@ -34,6 +34,10 @@ RULE_FIXTURES = {
     "EXEC-BYPASS": "exec_bypass",
     "SERVE-SHAPE": "serve_shape",
     "KERNEL-FALLBACK": "kernel_fallback",
+    "PRECISION-SINK": "precision_sink",
+    "TRACER-LEAK": "tracer_leak",
+    "SHAPE-BRANCH": "shape_branch",
+    "STALE-SUPPRESSION": "stale_suppression",
 }
 
 
@@ -53,7 +57,7 @@ def _run(paths, **kw):
 
 def test_registry_covers_required_rules():
     assert set(RULE_FIXTURES) <= set(rules.rule_ids())
-    assert len(rules.rule_ids()) >= 12
+    assert len(rules.rule_ids()) >= 16
 
 
 @pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
@@ -184,6 +188,78 @@ def test_json_reporter_schema():
     assert {"rule", "path", "line", "col", "message",
             "hint"} <= set(row)
     assert data["rules_run"] == ["SCAN-COLLECTIVE"]
+
+
+def test_sarif_reporter_schema():
+    res = _run([_fixture("retrace_static", "pos")],
+               select=["RETRACE-STATIC"])
+    doc = json.loads(report.as_sarif(res))
+    assert doc["version"] == "2.1.0"
+    drv = doc["runs"][0]["tool"]["driver"]
+    assert drv["name"] == "apex-tpu-lint"
+    assert {"RETRACE-STATIC", "HOST-SYNC"} <= {r["id"] for r in
+                                               drv["rules"]}
+    results = doc["runs"][0]["results"]
+    assert len(results) == len(res.active()) > 0
+    r0 = results[0]
+    assert r0["ruleId"] == "RETRACE-STATIC"
+    loc = r0["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("retrace_static_pos.py")
+    assert loc["region"]["startLine"] >= 1
+    assert loc["region"]["startColumn"] >= 1      # sarif is 1-based
+
+
+def test_cli_sarif_format(capsys):
+    rc = lint_main([_fixture("retrace_static", "pos"),
+                    "--select", "RETRACE-STATIC", "--no-baseline",
+                    "--format", "sarif"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"]
+
+
+def _git(tmp_path, *argv):
+    subprocess.run(["git", "-C", str(tmp_path), *argv],
+                   check=True, capture_output=True)
+
+
+def test_cli_changed_scope(tmp_path, monkeypatch, capsys):
+    """--changed lints exactly the files touched vs the git base: an
+    unchanged committed file stays out of scope even when it carries a
+    finding; untracked and modified files are in."""
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "lint@test")
+    _git(tmp_path, "config", "user.name", "lint test")
+    committed = tmp_path / "committed.py"
+    committed.write_text(
+        "import jax\n"
+        "def mk(u):\n"
+        "    return jax.jit(u, static_argnames=('lr',))\n")
+    _git(tmp_path, "add", "committed.py")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+
+    fresh = tmp_path / "fresh.py"
+    fresh.write_text(
+        "import jax\n"
+        "def mk2(u):\n"
+        "    return jax.jit(u, static_argnames=('wd',))\n")
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main(["--changed", "--select", "RETRACE-STATIC",
+                    "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "fresh.py" in out and "committed.py" not in out
+
+    _git(tmp_path, "add", "fresh.py")
+    _git(tmp_path, "commit", "-q", "-m", "add fresh")
+    rc = lint_main(["--changed", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "no changed python files" in out
+    # an explicit base ref widens the scope back to both files
+    rc = lint_main(["--changed", "HEAD~1", "--select", "RETRACE-STATIC",
+                    "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "fresh.py" in out
 
 
 def test_list_rules_cli(capsys):
