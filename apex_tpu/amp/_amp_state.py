@@ -41,11 +41,14 @@ def warn_or_err(msg):
 
 
 def maybe_print(msg, rank0=False):
-    """Verbosity-gated print; rank0 gating via jax.process_index
-    (the reference gates on torch.distributed rank, _amp_state.py:38-50)."""
+    """Verbosity-gated print; rank0 gating through the sanctioned
+    topology helpers (the reference gates on torch.distributed rank,
+    _amp_state.py:38-50)."""
     if _amp_state.verbosity > 0:
-        if rank0 and jax.process_count() > 1 and jax.process_index() != 0:
-            return
+        if rank0:
+            from ..parallel.distributed import num_processes, rank
+            if num_processes() > 1 and rank() != 0:
+                return
         print(msg)
 
 

@@ -1,0 +1,30 @@
+"""apex_tpu.cluster — multi-host elastic runtime (docs/cluster.md).
+
+The detect→agree→replan→reshard cycle across processes: KV-backed
+membership with heartbeats and epoch-numbered views
+(:mod:`~apex_tpu.cluster.membership`,
+:mod:`~apex_tpu.cluster.coordinator`), pluggable coordination substrates
+(:mod:`~apex_tpu.cluster.kvstore` — in-memory for tier-1 simulation,
+file-backed for real multi-process runs, the ``jax.distributed``
+coordinator service for pods), and the :class:`ClusterTrainer` that
+composes them with ``runtime.elastic`` and the planner's heterogeneous
+fleets.  This package (plus ``parallel.distributed``) is the ONE
+sanctioned home for process-topology assumptions — the CLUSTER-ASSUME
+lint rule holds everything else to that.
+"""
+from .kvstore import (  # noqa: F401
+    FileKV, JaxCoordinatorKV, KVStore, MemoryKV, default_kv)
+from .membership import (  # noqa: F401
+    PREFIX, Member, MembershipView, current_epoch, current_view)
+from .coordinator import Coordinator  # noqa: F401
+from .runtime import (  # noqa: F401
+    ClusterTrainer, SimClock, SimHost, fleet_for_members,
+    spawn_member_process)
+
+__all__ = [
+    "PREFIX", "KVStore", "MemoryKV", "FileKV", "JaxCoordinatorKV",
+    "default_kv",
+    "Member", "MembershipView", "current_epoch", "current_view",
+    "Coordinator", "ClusterTrainer", "SimClock", "SimHost",
+    "fleet_for_members", "spawn_member_process",
+]

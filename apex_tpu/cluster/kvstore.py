@@ -1,0 +1,204 @@
+"""Coordinator KV stores — the substrate the cluster membership
+protocol runs on.
+
+Every piece of cluster state (member registrations, heartbeat
+timestamps, the epoch counter, membership views, acks) is a string
+value under a string key in ONE logical store, so the same protocol
+code runs against three backends:
+
+* :class:`MemoryKV` — in-process dict; the deterministic tier-1 test
+  substrate (multi-member simulation with a fake clock).
+* :class:`FileKV` — a directory of one-file-per-key entries with
+  atomic writes; crosses REAL process boundaries with no server, which
+  is how ``bench.py --cluster`` runs heartbeat members as separate OS
+  processes and how a shared filesystem can stand in for a coordinator.
+* :class:`JaxCoordinatorKV` — the ``jax.distributed`` coordinator
+  service's key-value client, for actual multi-host pods
+  (``parallel.distributed``'s presence registry goes through this).
+
+Keys are flat strings with ``/`` separators by convention
+(``apex_tpu/cluster/<namespace>/...``); ``scan(prefix)`` is the only
+query primitive the protocol needs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import urllib.parse
+from typing import Dict, Optional
+
+
+class KVStore:
+    """Protocol: the four operations the membership layer uses."""
+
+    def get(self, key: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def set(self, key: str, value: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def scan(self, prefix: str) -> Dict[str, str]:
+        """Every ``key: value`` whose key starts with ``prefix``."""
+        raise NotImplementedError
+
+
+class MemoryKV(KVStore):
+    """Dict-backed store for in-process multi-member simulation."""
+
+    def __init__(self):
+        self._data: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key, value):
+        with self._lock:
+            self._data[key] = str(value)
+
+    def delete(self, key):
+        with self._lock:
+            self._data.pop(key, None)
+
+    def scan(self, prefix):
+        with self._lock:
+            return {k: v for k, v in self._data.items()
+                    if k.startswith(prefix)}
+
+
+class FileKV(KVStore):
+    """One-file-per-key store under a directory — crosses process
+    boundaries through the filesystem.
+
+    Writes are atomic (tmp + rename, the same durability idiom as the
+    checkpoint writer) so a reader never sees a torn value; keys are
+    percent-encoded into filenames, so any string key works."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.directory,
+                            urllib.parse.quote(key, safe=""))
+
+    def get(self, key):
+        try:
+            with open(self._path(key), "r") as f:
+                return f.read()
+        except (FileNotFoundError, OSError):
+            return None
+
+    def set(self, key, value):
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            f.write(str(value))
+        os.replace(tmp, path)
+
+    def delete(self, key):
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def scan(self, prefix):
+        out = {}
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if ".tmp." in name:
+                continue
+            key = urllib.parse.unquote(name)
+            if key.startswith(prefix):
+                v = self.get(key)
+                if v is not None:
+                    out[key] = v
+        return out
+
+
+class JaxCoordinatorKV(KVStore):
+    """The ``jax.distributed`` coordinator's KV service, adapted to the
+    protocol.  Only constructible after ``init_distributed`` has run
+    (:func:`client` returns None otherwise); the coordinator service has
+    no native scan, so :meth:`scan` walks an index key the setters
+    maintain — adequate for the small, slow-changing key sets the
+    membership protocol keeps."""
+
+    _INDEX = "apex_tpu/cluster/__index__"
+
+    def __init__(self, client=None):
+        if client is None:
+            client = self.client()
+        if client is None:
+            raise RuntimeError(
+                "no jax.distributed coordinator client — call "
+                "apex_tpu.parallel.init_distributed() first, or use "
+                "FileKV/MemoryKV")
+        self._client = client
+
+    @staticmethod
+    def client():
+        """The live coordinator client, or None (single process)."""
+        try:
+            from jax._src import distributed as _jd
+            return _jd.global_state.client
+        except Exception:
+            return None
+
+    def _index(self):
+        try:
+            raw = self._client.key_value_try_get(self._INDEX)
+        except Exception:
+            return []
+        return [k for k in (raw or "").split("\n") if k]
+
+    def get(self, key):
+        try:
+            return self._client.key_value_try_get(key)
+        except Exception:
+            return None
+
+    def set(self, key, value):
+        self._client.key_value_set(key, str(value))
+        idx = self._index()
+        if key not in idx:
+            self._client.key_value_set(self._INDEX,
+                                       "\n".join(idx + [key]))
+
+    def delete(self, key):
+        # the coordinator service has no delete; tombstone instead
+        try:
+            self._client.key_value_set(key, "")
+        except Exception:
+            pass
+
+    def scan(self, prefix):
+        out = {}
+        for key in self._index():
+            if key.startswith(prefix):
+                v = self.get(key)
+                if v:
+                    out[key] = v
+        return out
+
+
+def default_kv() -> KVStore:
+    """Resolve the ambient coordination store, strongest first: the
+    live ``jax.distributed`` coordinator service when one is
+    initialized; else the :class:`FileKV` directory the multiproc
+    launcher exported (``APEX_TPU_CLUSTER_KV``); else a fresh private
+    :class:`MemoryKV` (single-process)."""
+    client = JaxCoordinatorKV.client()
+    if client is not None:
+        return JaxCoordinatorKV(client)
+    directory = os.environ.get("APEX_TPU_CLUSTER_KV")
+    if directory:
+        return FileKV(directory)
+    return MemoryKV()

@@ -1,0 +1,303 @@
+"""The multi-host elastic cycle: detect → agree → replan → reshard.
+
+``runtime.elastic`` recovers ONE process onto whatever device set came
+back.  This module lifts that loop across processes: hosts assert
+liveness through :mod:`~apex_tpu.cluster.membership`, a
+:class:`~apex_tpu.cluster.coordinator.Coordinator` condenses heartbeats
+into epoch-numbered views, and on a membership change the surviving
+fleet acks the new view, re-plans for its (possibly heterogeneous)
+device union, and streams the newest schema-3 checkpoint's shards into
+the new layout — no host ever materializes full state.
+
+Tier-1 runs the whole cycle in ONE process: :class:`ClusterTrainer`
+simulates ``n_hosts`` member agents over a shared
+:class:`~apex_tpu.cluster.kvstore.MemoryKV` and a
+:class:`SimClock`, each owning a slice of the 8-virtual-CPU-device
+mesh.  The chaos hooks (``host.loss``, ``coordinator.loss``,
+``heartbeat.delay`` — see ``runtime/chaos.py``) drive failures
+deterministically; ``bench.py --cluster`` additionally spawns REAL OS
+processes heartbeating over a :class:`~apex_tpu.cluster.kvstore.FileKV`
+(:func:`spawn_member_process`).
+
+Process-boundary rule for :class:`~apex_tpu.runtime.chaos.ChaosKilled`:
+the harness forbids catching a kill to continue the killed operation —
+and the simulation honors that by converting the kill AT the process
+boundary instead.  A member felled in :meth:`ClusterTrainer.tick` stays
+dead (its agent never beats again); a felled coordinator is replaced by
+a NEW ``Coordinator`` object over the same KV store, exactly what a
+restarted coordinator process would construct.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+from ..observe import registry as _obs
+from ..observe import spans as _spans
+from ..runtime import chaos as _chaos
+from ..runtime import executor as _executor
+from ..runtime.elastic import ElasticTrainer
+from .coordinator import Coordinator
+from .kvstore import KVStore, MemoryKV
+from .membership import PREFIX, Member, MembershipView, current_view
+
+
+class SimClock:
+    """Deterministic time source shared by members and coordinator:
+    call it for "now", :meth:`advance` to move time forward.  Tests
+    drive heartbeat deadlines without ever sleeping."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+
+    def __call__(self) -> float:
+        return self._t
+
+    def advance(self, seconds: float) -> float:
+        self._t += float(seconds)
+        return self._t
+
+
+class SimHost:
+    """One simulated host: a membership agent plus the device slice it
+    owns and the chip spec it registers (``chip`` name from
+    ``parallel.auto.CHIPS``; ``scale`` < 1 declares a straggler)."""
+
+    def __init__(self, member: Member, devices, *, chip: str = "cpu",
+                 scale: float = 1.0):
+        self.member = member
+        self.devices = list(devices)
+        self.chip = chip
+        self.scale = float(scale)
+
+    @property
+    def member_id(self) -> str:
+        return self.member.member_id
+
+    @property
+    def alive(self) -> bool:
+        return self.member.alive
+
+
+def _host_spec(chip: str, scale: float, n_devices: int) -> str:
+    return json.dumps({"chip": chip, "scale": scale,
+                       "n_devices": n_devices})
+
+
+def fleet_for_members(kv: KVStore, members) -> "object":
+    """Build the planner :class:`~apex_tpu.parallel.auto.Fleet` from the
+    REGISTERED specs of ``members`` (the kv registration records, not
+    local host objects — the coordinator plans from what hosts declared
+    at join time)."""
+    from ..parallel.auto import CHIPS, Fleet
+    specs = []
+    for mid in members:
+        raw = kv.get(f"{PREFIX}members/{mid}") or "{}"
+        try:
+            rec = json.loads(raw)
+        except (TypeError, ValueError):
+            rec = {}
+        chip = CHIPS.get(rec.get("chip", "cpu"), CHIPS["cpu"])
+        spec = chip.scaled(float(rec.get("scale", 1.0)))
+        specs.extend([spec] * int(rec.get("n_devices", 1)))
+    return Fleet(specs=tuple(specs))
+
+
+class ClusterTrainer:
+    """Multi-host elastic training, simulated in one process.
+
+    The global device set splits into ``n_hosts`` contiguous slices;
+    each slice belongs to one :class:`SimHost` whose agent heartbeats
+    through the shared ``kv``.  :meth:`join` publishes epoch 1;
+    :meth:`tick` runs one heartbeat+scan cycle (where chaos fells hosts
+    or the coordinator); :meth:`recover` runs the agree→replan→reshard
+    half onto the surviving fleet.  ``host_scales`` declares per-host
+    speed factors (straggler stand-ins) that flow into the planner's
+    heterogeneous fleet; remaining keyword arguments go to the inner
+    :class:`~apex_tpu.runtime.elastic.ElasticTrainer`.
+    """
+
+    def __init__(self, manager, model, optimizer, loss_fn: Callable, *,
+                 example_batch, n_hosts: int = 2, devices=None,
+                 kv: Optional[KVStore] = None,
+                 clock: Optional[SimClock] = None,
+                 deadline_s: float = 0.25, miss_threshold: int = 2,
+                 chip: str = "cpu", host_scales=None,
+                 plan_options: Optional[dict] = None,
+                 plan_filter: Optional[Callable] = None, **step_kwargs):
+        from ..parallel.auto import _resolve_devices
+        devs = _resolve_devices(devices)
+        if n_hosts < 1 or n_hosts > len(devs):
+            raise ValueError(f"n_hosts={n_hosts} with {len(devs)} devices")
+        if len(devs) % n_hosts:
+            raise ValueError(f"{len(devs)} devices do not split evenly "
+                             f"across {n_hosts} hosts")
+        scales = list(host_scales or [])
+        if scales and len(scales) != n_hosts:
+            raise ValueError(f"host_scales needs {n_hosts} entries, "
+                             f"got {len(scales)}")
+        self.kv = kv if kv is not None else MemoryKV()
+        self.clock = clock if clock is not None else SimClock()
+        self.deadline_s = float(deadline_s)
+        self.miss_threshold = int(miss_threshold)
+        per = len(devs) // n_hosts
+        self.hosts = []
+        for i in range(n_hosts):
+            scale = float(scales[i]) if scales else 1.0
+            member = Member(
+                self.kv, f"host{i}", clock=self.clock,
+                spec=_host_spec(chip, scale, per))
+            self.hosts.append(SimHost(member, devs[i * per:(i + 1) * per],
+                                      chip=chip, scale=scale))
+        self.coordinator = Coordinator(
+            self.kv, deadline_s=self.deadline_s,
+            miss_threshold=self.miss_threshold, clock=self.clock)
+        self.trainer = ElasticTrainer(
+            manager, model, optimizer, loss_fn,
+            example_batch=example_batch, plan_options=plan_options,
+            plan_filter=plan_filter, **step_kwargs)
+        self.view: Optional[MembershipView] = None
+        self.telemetry: dict = {}
+
+    # -- membership --------------------------------------------------------
+    def join(self) -> MembershipView:
+        """All hosts register + first-beat; the coordinator publishes
+        epoch 1 and every member acks it."""
+        for h in self.hosts:
+            h.member.join()
+        view = self.coordinator.scan()
+        for h in self.hosts:
+            if h.alive:
+                h.member.ack(view)
+        self.view = view
+        return view
+
+    def tick(self, advance_s: Optional[float] = None) -> MembershipView:
+        """One cluster cycle: advance the clock, every live host beats,
+        the coordinator scans.  Chaos kills convert at the process
+        boundary (module docstring): a felled host stays dead, a felled
+        coordinator is rebuilt over the same store and scans next tick
+        (its successor inherits the persisted epoch, not the miss
+        counters)."""
+        if advance_s is None:
+            advance_s = self.deadline_s / 2
+        self.clock.advance(advance_s)
+        for h in self.hosts:
+            if not h.alive:
+                continue
+            try:
+                h.member.beat()
+            except _chaos.ChaosKilled:
+                h.member.alive = False      # the host process is gone
+        try:
+            view = self.coordinator.scan()
+        except _chaos.ChaosKilled:
+            self.coordinator = Coordinator(
+                self.kv, deadline_s=self.deadline_s,
+                miss_threshold=self.miss_threshold, clock=self.clock)
+            view = current_view(self.kv) or self.view
+        return view
+
+    def membership_changed(self) -> bool:
+        """True when the published view is newer than the one training
+        last agreed to."""
+        view = current_view(self.kv)
+        return view is not None and (
+            self.view is None or view.epoch != self.view.epoch)
+
+    # -- recovery ----------------------------------------------------------
+    def surviving_devices(self, view: MembershipView) -> list:
+        return [d for h in self.hosts if h.member_id in view.members
+                for d in h.devices]
+
+    def recover(self) -> int:
+        """The agree→replan→reshard half of the cycle: every surviving
+        member acks the current view; once the coordinator sees full
+        agreement, the inner elastic trainer re-plans for the survivors'
+        device union (a heterogeneous fleet when host scales differ) and
+        streams the newest valid checkpoint into the new layout.
+        Returns the step training continues from."""
+        t0 = time.perf_counter()
+        view = current_view(self.kv)
+        if view is None:
+            view = self.coordinator.scan()
+        for h in self.hosts:
+            if h.alive and h.member_id in view.members:
+                h.member.ack(view)
+        if not self.coordinator.acked(view):
+            missing = [m for m in view.members
+                       if not any(h.member_id == m and h.alive
+                                  for h in self.hosts)]
+            raise RuntimeError(
+                f"cluster epoch {view.epoch} not agreed: members "
+                f"{missing} never acked (still listed but not alive?)")
+        detect_ms = (time.perf_counter() - t0) * 1e3
+        devs = self.surviving_devices(view)
+        if not devs:
+            raise RuntimeError(
+                f"cluster epoch {view.epoch}: no surviving devices")
+        self.trainer.plan_options["fleet"] = fleet_for_members(
+            self.kv, view.members)
+        with _spans.span("cluster.recover", epoch=view.epoch,
+                         members=len(view.members)):
+            start = self.trainer.restore(devices=devs)
+        _executor.set_cluster_epoch(view.epoch)
+        self.view = view
+        restore_stats = dict(
+            getattr(self.trainer.manager, "last_restore_stats", {}) or {})
+        self.telemetry = {
+            "epoch": view.epoch,
+            "members": list(view.members),
+            "n_devices": len(devs),
+            "detect_ms": round(detect_ms, 3),
+            "replan_ms": self.trainer.telemetry.get("replan_ms"),
+            "reshard_ms": self.trainer.telemetry.get("reshard_ms"),
+            "resume_step": self.trainer.resume_step,
+            "restore_mode": restore_stats.get("mode"),
+            "restore_peak_host_bytes":
+                restore_stats.get("peak_host_bytes"),
+        }
+        _obs.event("cluster.restore", **self.telemetry)
+        return start
+
+    # -- training ----------------------------------------------------------
+    def save(self, step_no: int, **extra) -> str:
+        return self.trainer.save(step_no, **extra)
+
+    def __call__(self, *batch):
+        return self.trainer(*batch)
+
+    @property
+    def plan(self):
+        return self.trainer.plan
+
+
+def spawn_member_process(kv_dir: str, member_id: str, *,
+                         interval_s: float = 0.05, beats: int = 100,
+                         spec: str = "") -> subprocess.Popen:
+    """Spawn a REAL OS process that joins membership over a
+    :class:`~apex_tpu.cluster.kvstore.FileKV` at ``kv_dir`` and
+    heartbeats ``beats`` times at ``interval_s`` — the genuinely
+    multi-process half of ``bench.py --cluster`` (a coordinator in the
+    parent detects these children exactly as it detects simulated
+    members).  The child exits cleanly after its beats run out, which a
+    coordinator observes as host loss."""
+    code = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))!r})\n"
+        "from apex_tpu.cluster.kvstore import FileKV\n"
+        "from apex_tpu.cluster.membership import Member\n"
+        f"m = Member(FileKV({kv_dir!r}), {member_id!r}, spec={spec!r})\n"
+        "m.join()\n"
+        f"for _ in range({int(beats)}):\n"
+        f"    time.sleep({float(interval_s)!r})\n"
+        "    m.beat()\n")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)

@@ -1624,3 +1624,75 @@ class StaleSuppression(Rule):
 
     def check(self, module, ctx):
         return iter(())
+
+
+# ---------------------------------------------------------------------------
+# CLUSTER-ASSUME
+# ---------------------------------------------------------------------------
+
+#: env vars that hardcode process topology — reading them outside the
+#: launcher/cluster seam bakes "the fleet I started with" into code
+#: that must survive membership changes
+_TOPOLOGY_ENV = {"APEX_TPU_NUM_PROCESSES", "APEX_TPU_PROCESS_ID"}
+
+
+@register
+class ClusterAssume(Rule):
+    """Raw process-topology assumptions outside the cluster layer — PR 15.
+
+    ``jax.process_index()`` / ``jax.process_count()`` answer "who am I
+    in the fleet the job STARTED with".  Under the elastic cluster
+    runtime that fleet is a moving target: a membership epoch can
+    retire rank 3 while code still branches on ``process_index() != 0``
+    — the incident was exactly that, a rank-0 gate in the amp logging
+    path that picked a NEW rank 0 after a shrink and silently swapped
+    which host wrote logs mid-run.  Topology questions go through the
+    sanctioned seam (``parallel.distributed.rank/num_processes/
+    init_distributed``) or key off an ``apex_tpu.cluster``
+    MembershipView epoch, which is immutable per epoch by construction.
+    """
+    id = "CLUSTER-ASSUME"
+    summary = "raw process-topology query outside the cluster layer"
+    hint = ("route through apex_tpu.parallel.distributed (rank(), "
+            "num_processes(), init_distributed()) or key off an "
+            "apex_tpu.cluster MembershipView epoch — raw process ids "
+            "go stale the moment cluster membership changes")
+
+    _CALLS = {"jax.process_index": "jax.process_index() — raw rank "
+                                   "query; stale after a membership "
+                                   "change",
+              "jax.process_count": "jax.process_count() — raw fleet "
+                                   "size; stale after a membership "
+                                   "change",
+              "jax.distributed.initialize": "bare jax.distributed."
+                                            "initialize — blocks "
+                                            "forever with no retry; "
+                                            "use init_distributed()"}
+
+    def check(self, module, ctx):
+        path = module.path.replace("\\", "/")
+        if "apex_tpu/cluster/" in path or path.endswith(
+                "apex_tpu/parallel/distributed.py"):
+            return      # the sanctioned topology homes
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                if d in self._CALLS:
+                    yield self.finding(module, node, self._CALLS[d])
+                elif d == "os.environ.get" and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        node.args[0].value in _TOPOLOGY_ENV:
+                    yield self.finding(
+                        module, node,
+                        f"os.environ.get({node.args[0].value!r}) — "
+                        f"hardcoded process-count arithmetic outside "
+                        f"the launcher seam")
+            elif isinstance(node, ast.Subscript):
+                if (_dotted(node.value) or "") == "os.environ" and \
+                        isinstance(node.slice, ast.Constant) and \
+                        node.slice.value in _TOPOLOGY_ENV:
+                    yield self.finding(
+                        module, node,
+                        f"os.environ[{node.slice.value!r}] — hardcoded "
+                        f"process-count arithmetic outside the "
+                        f"launcher seam")

@@ -49,7 +49,7 @@ import dataclasses
 import itertools
 import math
 import time
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -94,6 +94,20 @@ class ChipSpec:
     def sustained_flops(self) -> float:
         return self.peak_flops * self.efficiency
 
+    def scaled(self, factor: float) -> "ChipSpec":
+        """A speed-scaled copy (compute, HBM and ICI bandwidth all
+        multiplied by ``factor``) — the fleet syntax's straggler
+        stand-in, e.g. ``"cpu*0.5"`` is a host running at half speed."""
+        if factor <= 0:
+            raise ValueError(f"scale factor must be > 0, got {factor}")
+        if factor == 1.0:
+            return self
+        return dataclasses.replace(
+            self, name=f"{self.name}*{factor:g}",
+            peak_flops=self.peak_flops * factor,
+            hbm_bw=self.hbm_bw * factor,
+            ici_bw=self.ici_bw * factor)
+
 
 #: bf16 peaks from public spec sheets; HBM/ICI figures are the same
 #: per-chip constants bench.py's MFU math uses.  The "cpu" entry models
@@ -123,6 +137,112 @@ def chip_spec(devices=None) -> ChipSpec:
             return CHIPS.get(key, CHIPS["v5e"]) if key != "v5 lite" \
                 else CHIPS["v5e"]
     return CHIPS["v4"]
+
+
+# ---------------------------------------------------------------------------
+# Fleets — mixed chip types / speed-scaled stragglers (docs/cluster.md)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Fleet:
+    """Per-device chip specs for a (possibly mixed) device fleet, in
+    planner device order.  A homogeneous fleet prices exactly like the
+    single-``ChipSpec`` path; a heterogeneous one switches the planner
+    to the slowest-member roofline bound with per-device batch shares
+    (see :func:`predict_time_fleet`)."""
+
+    specs: Tuple[ChipSpec, ...]
+
+    def __post_init__(self):
+        if not self.specs:
+            raise ValueError("a Fleet needs at least one device")
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.specs)
+
+    @property
+    def heterogeneous(self) -> bool:
+        return len({s.name for s in self.specs}) > 1
+
+    def slowest(self) -> ChipSpec:
+        return min(self.specs, key=lambda s: s.sustained_flops())
+
+    def name(self) -> str:
+        """Canonical ``"v5e:4+v4:4"`` rendering (consecutive runs)."""
+        parts, i = [], 0
+        while i < len(self.specs):
+            j = i
+            while j < len(self.specs) and \
+                    self.specs[j].name == self.specs[i].name:
+                j += 1
+            parts.append(f"{self.specs[i].name}:{j - i}")
+            i = j
+        return "+".join(parts)
+
+
+def parse_fleet(text: str) -> Fleet:
+    """Parse the fleet syntax: ``+``-joined members, each
+    ``<chip>[*<scale>][:<count>]``.
+
+    ``"v5e:4+v4:4"`` is four v5e chips plus four v4; ``"cpu*0.5:2"`` is
+    two CPU virtual devices running at half speed (the straggler
+    stand-in the mixed-fleet tier-1 tests use — a declared slowdown the
+    cost model must rank correctly against the measured mesh).
+    """
+    specs = []
+    for member in str(text).split("+"):
+        member = member.strip()
+        if not member:
+            raise ValueError(f"empty fleet member in {text!r}")
+        count = 1
+        if ":" in member:
+            member, _, c = member.rpartition(":")
+            count = int(c)
+        scale = 1.0
+        if "*" in member:
+            member, _, s = member.partition("*")
+            scale = float(s)
+        chip = member.strip()
+        if chip not in CHIPS:
+            raise ValueError(
+                f"unknown chip {chip!r} in fleet {text!r} — known: "
+                f"{sorted(CHIPS)}")
+        if count < 1:
+            raise ValueError(f"fleet member count must be >= 1: {text!r}")
+        specs.extend([CHIPS[chip].scaled(scale)] * count)
+    return Fleet(specs=tuple(specs))
+
+
+def _fleet_of(fleet) -> Optional[Fleet]:
+    """Normalize the ``fleet=`` argument: None, a :class:`Fleet`, the
+    string syntax, or a sequence of :class:`ChipSpec`."""
+    if fleet is None:
+        return None
+    if isinstance(fleet, Fleet):
+        return fleet
+    if isinstance(fleet, str):
+        return parse_fleet(fleet)
+    return Fleet(specs=tuple(fleet))
+
+
+def apportion_shares(weights, total: int) -> Tuple[int, ...]:
+    """Largest-remainder apportionment of ``total`` integer units
+    proportional to ``weights`` — the per-device batch-share rule.  The
+    shares sum to ``total`` EXACTLY (the planner never invents or drops
+    examples); ties break toward the earlier device for determinism."""
+    n = len(weights)
+    wsum = float(sum(weights))
+    if wsum <= 0:
+        weights, wsum = [1.0] * n, float(n)
+    quotas = [w / wsum * total for w in weights]
+    shares = [int(q) for q in quotas]
+    rest = total - sum(shares)
+    by_frac = sorted(range(n), key=lambda i: (shares[i] - quotas[i], i))
+    for i in by_frac[:rest]:
+        shares[i] += 1
+    return tuple(shares)
 
 
 # ---------------------------------------------------------------------------
@@ -371,6 +491,11 @@ class Plan:
     #: calibration-ledger citations: terms whose roofline prior was
     #: replaced by a measured kernel time (strings, for describe())
     ledger_terms: tuple = ()
+    #: heterogeneous fleets only: per-device batch shares (ints summing
+    #: EXACTLY to the global batch, device order) — the planner's
+    #: replacement for the uniform global_batch/dp split.  Empty on a
+    #: homogeneous fleet (uniform split applies).
+    device_shares: tuple = ()
 
     def key(self):
         """The structural identity embedded in program cache keys."""
@@ -441,6 +566,12 @@ class Plan:
                     bd.get("compute_ms", 0.0), bd.get("hbm_ms", 0.0),
                     bd.get("collective_ms", 0.0),
                     bd.get("overhead_ms", 0.0)))
+        if self.device_shares:
+            lines.append(
+                "  device batch shares: ["
+                + ", ".join(str(s) for s in self.device_shares)
+                + "] (heterogeneous fleet — slowest-member bound; "
+                "shares sum to the global batch)")
         if self.ledger_terms:
             lines.append("  calibration-ledger re-priced terms "
                          "(measured, not roofline priors):")
@@ -568,33 +699,14 @@ def _ring_half_s(bytes_, n, spec):
     return (n - 1) / n * bytes_ / spec.ici_bw + (n - 1) * spec.ici_latency_s
 
 
-def predict_time(plan: Plan, prof: ModelProfile, spec: ChipSpec,
-                 global_batch: int):
-    """Roofline step time: ``max(compute, HBM) + collectives + overhead``.
-    Returns ``(ms, breakdown, collectives)``."""
-    n_used = plan.n_used
-    micro_b = global_batch / (plan.dp * plan.accum)
-    act_itemsize = prof.half_itemsize or 4
-    w_itemsize = prof.half_itemsize or 4
-
-    flops = (prof.flops_per_example * global_batch / n_used
-             + plan.accum * prof.flops_fixed)
-    # virtual devices split one host: per-plan sustained rate is the
-    # host's, not n_used × the host's
-    sustained = spec.sustained_flops() / (n_used if spec.shared_host else 1)
-    compute_s = flops / sustained
-
-    weight_traffic = plan.accum * prof.n_params * w_itemsize / plan.tp
-    if plan.zero_stage == 3:
-        weight_traffic /= plan.dp
-    hbm_bytes = (prof.hbm_bytes_per_example * global_batch / n_used
-                 + plan.accum * prof.hbm_bytes_fixed + weight_traffic)
-    if plan.chunked_loss and prof.logits_bytes_per_example:
-        hbm_bytes -= (prof.logits_bytes_per_example * global_batch / n_used
-                      * (1.0 - 1.0 / CHUNKS))
-    hbm_bw = spec.hbm_bw / (n_used if spec.shared_host else 1)
-    hbm_s = max(hbm_bytes, 0.0) / hbm_bw
-
+def _dp_collective_terms(plan: Plan, prof: ModelProfile, spec: ChipSpec,
+                         w_itemsize: int):
+    """The dp-axis collective terms (stage-0 grad all-reduce, or the
+    ZeRO reduce-scatter / param all-gather pair, plus the stage-3
+    per-microbatch gather with the executor's prefetch overlap).
+    Shared between :func:`predict_time` and :func:`predict_time_fleet`
+    — the fleet path hands in a slowest-link spec so every collective
+    is priced at the weakest interconnect in the ring."""
     coll_s, colls = 0.0, []
     gbytes = prof.param_bytes_fp32
     if plan.dp > 1:
@@ -628,6 +740,38 @@ def predict_time(plan: Plan, prof: ModelProfile, spec: ChipSpec,
                 colls.append(f"per-microbatch param all-gather (stage 3, "
                              f"K×{_mib(ag1)} = "
                              f"{_mib(ag3)}/step)")
+    return coll_s, colls
+
+
+def predict_time(plan: Plan, prof: ModelProfile, spec: ChipSpec,
+                 global_batch: int):
+    """Roofline step time: ``max(compute, HBM) + collectives + overhead``.
+    Returns ``(ms, breakdown, collectives)``."""
+    n_used = plan.n_used
+    micro_b = global_batch / (plan.dp * plan.accum)
+    act_itemsize = prof.half_itemsize or 4
+    w_itemsize = prof.half_itemsize or 4
+
+    flops = (prof.flops_per_example * global_batch / n_used
+             + plan.accum * prof.flops_fixed)
+    # virtual devices split one host: per-plan sustained rate is the
+    # host's, not n_used × the host's
+    sustained = spec.sustained_flops() / (n_used if spec.shared_host else 1)
+    compute_s = flops / sustained
+
+    weight_traffic = plan.accum * prof.n_params * w_itemsize / plan.tp
+    if plan.zero_stage == 3:
+        weight_traffic /= plan.dp
+    hbm_bytes = (prof.hbm_bytes_per_example * global_batch / n_used
+                 + plan.accum * prof.hbm_bytes_fixed + weight_traffic)
+    if plan.chunked_loss and prof.logits_bytes_per_example:
+        hbm_bytes -= (prof.logits_bytes_per_example * global_batch / n_used
+                      * (1.0 - 1.0 / CHUNKS))
+    hbm_bw = spec.hbm_bw / (n_used if spec.shared_host else 1)
+    hbm_s = max(hbm_bytes, 0.0) / hbm_bw
+
+    coll_s, colls = _dp_collective_terms(plan, prof, spec, w_itemsize)
+    gbytes = prof.param_bytes_fp32
     if plan.tp > 1:
         if prof.layers and prof.hidden and prof.seq_len:
             per_micro = (4.0 * prof.layers * micro_b * prof.seq_len
@@ -662,6 +806,80 @@ def predict_time(plan: Plan, prof: ModelProfile, spec: ChipSpec,
     bd = [("compute_ms", compute_s * 1e3), ("hbm_ms", hbm_s * 1e3),
           ("collective_ms", coll_s * 1e3), ("overhead_ms", overhead_s * 1e3)]
     return total_s * 1e3, bd, colls
+
+
+def predict_time_fleet(plan: Plan, prof: ModelProfile, fleet: Fleet,
+                       global_batch: int, shares=None):
+    """Slowest-member roofline for a heterogeneous fleet (AMP
+    arXiv:2210.07297, Poplar arXiv:2408.12596): every member computes
+    its batch SHARE, the step completes when the slowest member does,
+    and collectives run at the weakest link in the ring.
+
+    ``shares`` defaults to :func:`apportion_shares` proportional to each
+    member's sustained rate; pass an explicit tuple (e.g. a uniform
+    split) to price an alternative assignment — the mixed-fleet tier-1
+    test prices both and pins that their predicted order matches the
+    measured order on the CPU mesh.
+
+    Returns ``(ms, breakdown, collectives, shares)``.  Fleet plans are
+    dp-only (``_structural_reject`` enforces it), so only the dp
+    collective terms appear.
+    """
+    n_used = plan.n_used
+    specs = fleet.specs[:n_used]
+    if len(specs) < n_used:
+        raise ValueError(f"plan {plan.name()} needs {n_used} devices, "
+                         f"fleet has {fleet.n_devices}")
+    if shares is None:
+        shares = apportion_shares(
+            [s.sustained_flops() for s in specs], global_batch)
+    shares = tuple(int(s) for s in shares)
+    if len(shares) != n_used or sum(shares) != global_batch:
+        raise ValueError(
+            f"device shares {shares} must have {n_used} entries summing "
+            f"to the global batch {global_batch}")
+    w_itemsize = prof.half_itemsize or 4
+
+    # each member's roofline at its share; the step is bound by the
+    # slowest member (max over members), not the mean
+    bound_s, bound_i, bound_compute, bound_hbm = 0.0, 0, 0.0, 0.0
+    for i, (spec, share) in enumerate(zip(specs, shares)):
+        div = n_used if spec.shared_host else 1
+        flops = (prof.flops_per_example * share
+                 + plan.accum * prof.flops_fixed)
+        compute_s = flops / (spec.sustained_flops() / div)
+        weight_traffic = plan.accum * prof.n_params * w_itemsize
+        if plan.zero_stage == 3:
+            weight_traffic /= plan.dp
+        hbm_bytes = (prof.hbm_bytes_per_example * share
+                     + plan.accum * prof.hbm_bytes_fixed + weight_traffic)
+        if plan.chunked_loss and prof.logits_bytes_per_example:
+            hbm_bytes -= (prof.logits_bytes_per_example * share
+                          * (1.0 - 1.0 / CHUNKS))
+        hbm_s = max(hbm_bytes, 0.0) / (spec.hbm_bw / div)
+        member_s = max(compute_s, hbm_s)
+        if member_s > bound_s:
+            bound_s, bound_i = member_s, i
+            bound_compute, bound_hbm = compute_s, hbm_s
+
+    # collectives at the slowest link: min bandwidth, max latency
+    link = dataclasses.replace(
+        fleet.slowest(),
+        ici_bw=min(s.ici_bw for s in specs),
+        ici_latency_s=max(s.ici_latency_s for s in specs))
+    coll_s, colls = _dp_collective_terms(plan, prof, link, w_itemsize)
+    if fleet.heterogeneous and coll_s > 0:
+        colls.append(f"(all collectives priced at the slowest link: "
+                     f"{link.ici_bw / 1e9:.1f} GB/s, "
+                     f"{link.ici_latency_s * 1e6:.0f} us/hop)")
+
+    overhead_s = plan.accum * max(s.overhead_s for s in specs)
+    total_s = bound_s + coll_s + overhead_s
+    bd = [("compute_ms", bound_compute * 1e3), ("hbm_ms", bound_hbm * 1e3),
+          ("collective_ms", coll_s * 1e3),
+          ("overhead_ms", overhead_s * 1e3),
+          ("bound_member", float(bound_i))]
+    return total_s * 1e3, bd, colls, shares
 
 
 def _mib(b):
@@ -840,9 +1058,13 @@ class PlanReport:
     chip: ChipSpec
     global_batch: int
     hbm_cap: float
+    fleet: Optional[Fleet] = None
 
     def describe(self, top: int = 5) -> str:
-        out = [f"auto-parallel plan report — {self.chip.name}, "
+        chip_desc = (f"fleet {self.fleet.name()}"
+                     if self.fleet is not None and self.fleet.heterogeneous
+                     else self.chip.name)
+        out = [f"auto-parallel plan report — {chip_desc}, "
                f"global batch {self.global_batch}, HBM cap "
                f"{self.hbm_cap / 2**30:.2f} GiB/device, model "
                f"{self.profile.n_params / 1e6:.2f}M params "
@@ -879,21 +1101,36 @@ def plan_training(model, optimizer, loss_fn: Callable, example_batch, *,
                   hbm_reserve: float = HBM_RESERVE,
                   accum_max: int = 32,
                   chunked_loss=False,
-                  profile: Optional[ModelProfile] = None) -> PlanReport:
+                  profile: Optional[ModelProfile] = None,
+                  fleet=None) -> PlanReport:
     """Enumerate → prune (memory, capability) → rank (roofline).
 
     ``chunked_loss``: what the caller's ``loss_fn`` actually is (the
     planner cannot swap it) — pass ``None`` to enumerate both and see
     the lever's predicted effect in the report.
+
+    ``fleet``: a :class:`Fleet`, the ``"v5e:4+v4:4"`` string syntax, or
+    a sequence of :class:`ChipSpec` — one per device, planner order.  A
+    heterogeneous fleet switches pricing to the slowest-member bound
+    with per-device batch shares (:func:`predict_time_fleet`); memory
+    feasibility is then checked for the LARGEST share against the
+    SMALLEST member's HBM (conservative on both axes).
     """
+    flt = _fleet_of(fleet)
     devices = list(devices) if devices is not None else jax.devices()
-    spec = chip or chip_spec(devices)
+    spec = chip or (flt.slowest() if flt is not None else
+                    chip_spec(devices))
     prof = profile or profile_model(
         model, optimizer, loss_fn, example_batch, half_dtype=half_dtype,
         keep_batchnorm_fp32=keep_batchnorm_fp32)
     global_batch = _global_batch_of(example_batch)
-    cap = hbm_cap_bytes if hbm_cap_bytes is not None \
-        else spec.hbm_bytes * (1.0 - hbm_reserve)
+    if hbm_cap_bytes is not None:
+        cap = hbm_cap_bytes
+    elif flt is not None:
+        cap = min(s.hbm_bytes for s in flt.specs) * (1.0 - hbm_reserve)
+    else:
+        cap = spec.hbm_bytes * (1.0 - hbm_reserve)
+    n_plan_devices = flt.n_devices if flt is not None else len(devices)
 
     chip_key, mfp = None, None
     try:
@@ -904,11 +1141,12 @@ def plan_training(model, optimizer, loss_fn: Callable, example_batch, *,
         _kl = None
     opt_kernel = _opt_kernel_name(optimizer)
 
+    hetero = flt is not None and flt.heterogeneous
     feasible, rejected = [], []
-    for plan in enumerate_plans(len(devices), chunked_loss=chunked_loss,
+    for plan in enumerate_plans(n_plan_devices, chunked_loss=chunked_loss,
                                 accum_max=accum_max,
                                 global_batch=global_batch):
-        reason = _structural_reject(plan, prof, global_batch)
+        reason = _structural_reject(plan, prof, global_batch, fleet=flt)
         if reason is not None:
             rejected.append((plan, reason))
             continue
@@ -916,7 +1154,17 @@ def plan_training(model, optimizer, loss_fn: Callable, example_batch, *,
             plan,
             tp_axis=prof.tp_axis if plan.tp > 1 else None,
             sp_axis=prof.sp_axis if plan.sp > 1 else None)
-        mem, mem_bd = predict_memory(plan, prof, spec, global_batch)
+        if hetero:
+            # memory for the binding member: the largest share on the
+            # smallest HBM — price the uniform formula at an effective
+            # global batch of max_share × dp so micro_b == max_share
+            shares = apportion_shares(
+                [s.sustained_flops() for s in flt.specs[:plan.n_used]],
+                global_batch)
+            mem_batch = max(shares) * plan.dp
+        else:
+            shares, mem_batch = None, global_batch
+        mem, mem_bd = predict_memory(plan, prof, spec, mem_batch)
         if mem > cap:
             over = dict(mem_bd)
             reason = (
@@ -931,10 +1179,16 @@ def plan_training(model, optimizer, loss_fn: Callable, example_batch, *,
             rejected.append((dataclasses.replace(
                 plan, predicted_hbm=mem, breakdown=tuple(mem_bd)), reason))
             continue
-        ms, time_bd, colls = predict_time(plan, prof, spec, global_batch)
+        if hetero:
+            ms, time_bd, colls, shares = predict_time_fleet(
+                plan, prof, flt, global_batch, shares=shares)
+        else:
+            ms, time_bd, colls = predict_time(plan, prof, spec,
+                                              global_batch)
         plan = dataclasses.replace(
             plan, predicted_ms=ms, predicted_hbm=mem,
-            breakdown=tuple(time_bd + mem_bd), collectives=tuple(colls))
+            breakdown=tuple(time_bd + mem_bd), collectives=tuple(colls),
+            device_shares=tuple(shares) if shares is not None else ())
         if chip_key is not None:
             plan = _ledger_reprice(plan, prof, spec, global_batch,
                                    chip_key, opt_kernel)
@@ -967,11 +1221,20 @@ def plan_training(model, optimizer, loss_fn: Callable, example_batch, *,
                 p.n_used, p.zero_stage, p.accum, p.tp, p.sp))
     return PlanReport(best=feasible[0] if feasible else None,
                       ranked=feasible, rejected=rejected, profile=prof,
-                      chip=spec, global_batch=global_batch, hbm_cap=cap)
+                      chip=spec, global_batch=global_batch, hbm_cap=cap,
+                      fleet=flt)
 
 
 def _structural_reject(plan: Plan, prof: ModelProfile,
-                       global_batch: int) -> Optional[str]:
+                       global_batch: int,
+                       fleet: Optional[Fleet] = None) -> Optional[str]:
+    if fleet is not None and fleet.heterogeneous and \
+            (plan.tp > 1 or plan.sp > 1):
+        return (f"tp={plan.tp}/sp={plan.sp} across the mixed fleet "
+                f"{fleet.name()}: tensor/sequence parallelism needs "
+                f"identical per-shard throughput (lockstep layer math), "
+                f"so heterogeneous fleets are dp-only — stragglers are "
+                f"absorbed by batch shares, not layer shards")
     if plan.dp > 1 and global_batch % plan.dp:
         return (f"global batch {global_batch} not divisible by "
                 f"dp={plan.dp}")

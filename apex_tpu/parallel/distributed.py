@@ -45,6 +45,13 @@ def rank() -> int:
     return jax.process_index()
 
 
+def num_processes() -> int:
+    """Process count, from the one sanctioned home for topology
+    queries (the CLUSTER-ASSUME lint rule points everything else
+    here or to ``apex_tpu.cluster``'s membership views)."""
+    return jax.process_count()
+
+
 def apply_flat_dist_call(bucket, call, extra_args=None):
     """Apply a collective to a coalesced bucket (reference
     distributed.py:36-49).  XLA fuses the concatenation/split, so this is a
@@ -126,54 +133,58 @@ def all_reduce_mean(tensors, mesh: Optional[Mesh] = None,
     return out
 
 
-#: presence registry key prefix in the coordinator's KV store — each rank
-#: announces itself after a successful init so a later collective timeout
-#: can NAME the ranks that never arrived (or died) instead of hanging
-_PRESENCE_PREFIX = "apex_tpu/presence/"
-
-#: test seam: when set, a callable returning the list of missing rank ids
-#: (production path queries the coordinator KV store)
+#: the presence registry IS the cluster membership layer's member table:
+#: each rank joins as an ``apex_tpu.cluster`` Member over the
+#: jax.distributed coordinator's KV store after a successful init, so a
+#: later collective timeout can NAME the ranks that never arrived (or
+#: died) — and a cluster Coordinator watching the same table sees the
+#: very same registrations (one registry, two consumers).
+#: Test seam: when set, a callable returning the list of missing rank
+#: ids (production queries the coordinator KV store).
 _PRESENCE_PROBE = None
 
 
 def _kv_client():
-    try:
-        from jax._src import distributed as _jd
-        return _jd.global_state.client
-    except Exception:
-        return None
+    from ..cluster.kvstore import JaxCoordinatorKV
+    return JaxCoordinatorKV.client()
 
 
 def announce_presence():
-    """Record this process in the coordinator's presence registry
+    """Join this process into the cluster membership registry
     (best-effort; no-op single-process).  ``init_distributed`` calls it
-    after a successful initialize."""
+    after a successful initialize; the member id is the rank, the
+    registration record the hostname."""
     client = _kv_client()
     if client is None:
         return
     import socket
     try:
-        client.key_value_set(f"{_PRESENCE_PREFIX}{jax.process_index()}",
-                             socket.gethostname())
+        from ..cluster.kvstore import JaxCoordinatorKV
+        from ..cluster.membership import Member
+        Member(JaxCoordinatorKV(client), str(jax.process_index()),
+               spec=socket.gethostname()).join()
     except Exception:
         pass
 
 
 def missing_ranks() -> Optional[list]:
-    """Ranks with no presence-registry entry, or None when undeterminable
-    (single process / no coordinator client)."""
+    """Ranks with no membership registration, or None when
+    undeterminable (single process / no coordinator client)."""
     if _PRESENCE_PROBE is not None:
         return _PRESENCE_PROBE()
     client = _kv_client()
     if client is None:
         return None
-    out = []
-    for r in range(jax.process_count()):
-        try:
-            client.key_value_try_get(f"{_PRESENCE_PREFIX}{r}")
-        except Exception:
-            out.append(r)
-    return out
+    try:
+        from ..cluster.kvstore import JaxCoordinatorKV
+        from ..cluster.membership import PREFIX
+        kv = JaxCoordinatorKV(client)
+        n = len(f"{PREFIX}members/")
+        present = {k[n:] for k in kv.scan(f"{PREFIX}members/")}
+    except Exception:
+        return None
+    return [r for r in range(jax.process_count())
+            if str(r) not in present]
 
 
 def init_distributed(coordinator_address: Optional[str] = None,

@@ -9,7 +9,14 @@ variables exported here and passes them explicitly to
 ``jax.distributed.initialize`` (jax reads only the coordinator address from
 the environment on its own).
 
-Usage:  python -m apex_tpu.parallel.multiproc [--nproc N] script.py args...
+``--cluster-kv DIR`` additionally exports ``APEX_TPU_CLUSTER_KV`` so the
+children share a file-backed cluster membership store
+(``apex_tpu.cluster.kvstore.FileKV`` — what
+``apex_tpu.cluster.kvstore.default_kv`` resolves when no
+jax.distributed coordinator is up, e.g. N local CPU processes).
+
+Usage:  python -m apex_tpu.parallel.multiproc [--nproc N]
+        [--cluster-kv DIR] script.py args...
 """
 from __future__ import annotations
 
@@ -35,8 +42,12 @@ def _probe_local_device_count() -> int:
 def main():
     argv = list(sys.argv[1:])
     nproc = None
-    if argv and argv[0] == "--nproc":
-        nproc = int(argv[1])
+    cluster_kv = None
+    while argv and argv[0] in ("--nproc", "--cluster-kv"):
+        if argv[0] == "--nproc":
+            nproc = int(argv[1])
+        else:
+            cluster_kv = os.path.abspath(argv[1])
         argv = argv[2:]
     if not argv:
         print(__doc__)
@@ -53,6 +64,8 @@ def main():
         env["APEX_TPU_COORDINATOR"] = coordinator
         env["APEX_TPU_NUM_PROCESSES"] = str(nproc)
         env["APEX_TPU_PROCESS_ID"] = str(local_rank)
+        if cluster_kv is not None:
+            env["APEX_TPU_CLUSTER_KV"] = cluster_kv
         cmd = [sys.executable, argv[0], *argv[1:],
                f"--local_rank={local_rank}"]
         procs.append(subprocess.Popen(cmd, env=env))

@@ -21,6 +21,26 @@ point                 fires
                       elastic restore (disk already read; device
                       placement pending — a kill here must leave the
                       checkpoint loadable by the next attempt)
+``ckpt.shard_write``  before each schema-3 shard file write
+                      (``resilience.stream_components_to_dir``); a kill
+                      here leaves a partial shard directory with NO
+                      manifest — the previous checkpoint must stay the
+                      newest valid one
+``host.loss``         each cluster member's heartbeat tick
+                      (``cluster.membership.Member.beat``); ``"kill"``
+                      fells the host (it stops heartbeating and drops
+                      out of the next membership epoch)
+``coordinator.loss``  before each coordinator failure-detection scan
+                      (``cluster.coordinator.Coordinator.scan``);
+                      ``"kill"`` fells the coordinator — a successor
+                      rebuilt over the same KV store must keep epochs
+                      monotonic
+``heartbeat.delay``   in the heartbeat path, after the liveness decision
+                      is armed; a CALLABLE action's return value (or
+                      ``delay_s``) skews that member's heartbeat
+                      timestamp backwards — under ``miss_threshold``
+                      consecutive misses this must NOT produce a new
+                      membership epoch (false-positive guard)
 ``device.loss``       each elastic device-set detection
                       (``runtime.elastic.current_devices``); a CALLABLE
                       action's return value replaces the device set — an

@@ -187,8 +187,9 @@ class ElasticTrainer:
         return 0 if resume is None else resume + 1
 
     def save(self, step_no: int, **extra) -> str:
-        """Sharded atomic save through the one write path: the schema-2
-        manifest records the live layout + plan for the next restore."""
+        """Sharded atomic save through the one write path: the schema-3
+        manifest records the live layout + plan, and the leaf shards
+        stream to per-shard files (see docs/cluster.md)."""
         if self.step is None:
             raise RuntimeError("call restore() before save()")
         return self.manager.save_sharded(step_no, self.step, **extra)

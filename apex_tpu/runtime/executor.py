@@ -146,6 +146,25 @@ def overlap_enabled(which: str, override=None) -> bool:
     return bool(mode)
 
 
+#: the cluster membership epoch this process last agreed to (None
+#: outside a cluster run).  Dispatch spans carry it so a trace mixing
+#: pre- and post-reshard steps attributes each dispatch to the
+#: membership view it ran under (apex_tpu.cluster sets it on recover).
+_CLUSTER_EPOCH: Optional[int] = None
+
+
+def set_cluster_epoch(epoch: Optional[int]) -> None:
+    """Tag subsequent dispatch spans with the cluster membership epoch
+    (None clears the tag)."""
+    global _CLUSTER_EPOCH
+    _CLUSTER_EPOCH = None if epoch is None else int(epoch)
+
+
+def cluster_epoch() -> Optional[int]:
+    """The membership epoch dispatches are currently tagged with."""
+    return _CLUSTER_EPOCH
+
+
 # ---------------------------------------------------------------------------
 # Program descriptor
 # ---------------------------------------------------------------------------
@@ -244,7 +263,10 @@ class Executor:
         self._cache._bump("dispatches", program.kind)
         beat = program.kind in TRAIN_KINDS or program.kind in SERVE_KINDS
         if beat or _sc._DISPATCH_SPANS:
-            with _spans.span("dispatch", kind=program.kind):
+            tags = {"kind": program.kind}
+            if _CLUSTER_EPOCH is not None:
+                tags["cluster_epoch"] = _CLUSTER_EPOCH
+            with _spans.span("dispatch", **tags):
                 out = fn(*args)
         else:
             out = fn(*args)

@@ -32,6 +32,19 @@ this module makes the save/resume loop survive it:
   naming the incompatible component when they can't;
   :mod:`apex_tpu.runtime.elastic` orchestrates the full
   detect→re-plan→reshard→resume cycle.
+* Streaming shard IO (schema 3): :meth:`CheckpointManager.save_sharded`
+  no longer gathers the state onto the host before pickling — each
+  distinct array shard streams to its own file under
+  ``ckpt_<step>.shards/`` (atomic tmp+rename per file, per-shard CRC32
+  in the manifest, ``ckpt.shard_write`` chaos hook per file) and the
+  manifest container commits LAST, so a kill mid-shard leaves the
+  previous checkpoint the newest valid one.  ``restore_resharded``
+  assembles only the blocks each target device needs
+  (:func:`reshard_streamed`), never materializing the full state on one
+  host; ``read_checkpoint_file`` transparently re-assembles full host
+  arrays for legacy consumers.  Schema-2 files keep loading (gathered,
+  with a "predates shard streaming" warning) and a re-save upgrades
+  them to schema 3.
 * :class:`BadStepGuard` — escalation above the ``ScalerState`` skip logic
   (`apex_tpu/amp/scaler.py`): the scaler already halves the scale and
   skips the step on overflow, silently and forever; the guard counts
@@ -52,8 +65,8 @@ init and collective-timeout wrappers.
 
 Every failure path is exercised in tier-1 tests through the
 :mod:`apex_tpu.runtime.chaos` hook points (``ckpt.mid_write``,
-``ckpt.pre_rename``, ``ckpt.reshard``, ``train.step``, ``dist.init``,
-``dist.collective``).
+``ckpt.pre_rename``, ``ckpt.shard_write``, ``ckpt.reshard``,
+``train.step``, ``dist.init``, ``dist.collective``).
 """
 from __future__ import annotations
 
@@ -61,6 +74,7 @@ import collections
 import os
 import pickle
 import re
+import shutil
 import threading
 import warnings
 import zlib
@@ -75,9 +89,14 @@ from ..observe import spans as _spans
 #: bump when the container layout changes; readers accept <= this.
 #: Schema 2 adds OPTIONAL manifest fields only (per-component "layout",
 #: top-level "plan") — schema-1 files keep loading unchanged.
-SCHEMA_VERSION = 2
+#: Schema 3 adds an OPTIONAL per-component "streamed" manifest entry
+#: (per-shard file layout under ``ckpt_<step>.shards/``); components
+#: without it are plain schema-2 gathered payloads, so schema-2 files
+#: keep loading unchanged.
+SCHEMA_VERSION = 3
 _MAGIC = "__apex_tpu_checkpoint__"
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.pkl$")
+_SHARD_DIR_RE = re.compile(r"^ckpt_(\d+)\.shards$")
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -186,9 +205,164 @@ def _plan_meta(plan) -> Optional[dict]:
         return None
 
 
+# ---------------------------------------------------------------------------
+# streaming shard IO (schema 3)
+# ---------------------------------------------------------------------------
+
+
+class _StreamedLeaf:
+    """Placeholder pickled into the container payload in place of an
+    array leaf whose bytes live in per-shard files (schema 3).  Carries
+    only the leaf's flat index; shape/dtype/shard layout live in the
+    manifest's ``streamed`` entry."""
+
+    __slots__ = ("idx",)
+
+    def __init__(self, idx: int):
+        self.idx = int(idx)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"_StreamedLeaf({self.idx})"
+
+
+def _shard_index_meta(index, shape) -> list:
+    """Normalize a shard's index (tuple of slices from
+    ``jax.Array.addressable_shards[i].index``) into JSON-able
+    ``[[start, stop], ...]`` pairs, one per array dimension."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, _ = sl.indices(int(dim))
+        out.append([int(start), int(stop)])
+    return out
+
+
+def _write_shard_file(dir_path: str, name: str, buf: bytes) -> None:
+    # same durability contract as the manifest container: tmp + fsync +
+    # one rename, so a shard file either exists complete or not at all
+    tmp = os.path.join(dir_path, f"{name}.tmp.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(buf)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, os.path.join(dir_path, name))
+
+
+def stream_components_to_dir(dir_path: str, components: dict):
+    """Write every ``jax.Array`` leaf in ``components`` as per-shard
+    files under ``dir_path`` — one file per DISTINCT shard index
+    (replicated shards dedupe to one file), raw ``tobytes()`` content,
+    atomic per-file writes.  The host never holds more than one shard's
+    bytes at a time; the returned peak is that high-water mark.
+
+    Chaos hook ``ckpt.shard_write`` fires before each file — a kill
+    there leaves a partial shard directory and NO manifest, which is
+    exactly the debris a mid-save host loss leaves.
+
+    Returns ``(skeletons, streamed_meta, peak_bytes)``: per-component
+    pytrees with streamed leaves replaced by :class:`_StreamedLeaf`
+    placeholders (everything else passes through to the pickled
+    payload), the per-component manifest metadata, and the largest
+    single host buffer touched."""
+    os.makedirs(dir_path, exist_ok=True)
+    skeletons, streamed_meta = {}, {}
+    peak = 0
+    for comp, tree in components.items():
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        leaf_meta, out_leaves, any_streamed = [], [], False
+        comp_tag = re.sub(r"[^A-Za-z0-9_.-]", "_", comp)
+        for i, leaf in enumerate(leaves):
+            if not isinstance(leaf, jax.Array):
+                leaf_meta.append(None)
+                out_leaves.append(leaf)
+                continue
+            shards_meta, seen = [], set()
+            for shard in leaf.addressable_shards:
+                idx = _shard_index_meta(shard.index, leaf.shape)
+                key = tuple(map(tuple, idx))
+                if key in seen:
+                    continue
+                seen.add(key)
+                buf = np.asarray(shard.data).tobytes()
+                peak = max(peak, len(buf))
+                fname = f"{comp_tag}_l{i}_s{len(shards_meta)}.bin"
+                if _chaos.active():
+                    _chaos.hook("ckpt.shard_write", dir=dir_path,
+                                file=fname, component=comp, leaf=i)
+                _write_shard_file(dir_path, fname, buf)
+                shards_meta.append({"file": fname,
+                                    "crc32": zlib.crc32(buf),
+                                    "nbytes": len(buf), "index": idx})
+            leaf_meta.append({"shape": [int(d) for d in leaf.shape],
+                              "dtype": str(leaf.dtype),
+                              "shards": shards_meta})
+            out_leaves.append(_StreamedLeaf(i))
+            any_streamed = True
+        skeletons[comp] = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        if any_streamed:
+            streamed_meta[comp] = {"dir": os.path.basename(dir_path),
+                                   "leaves": leaf_meta}
+        else:
+            leaf_meta.clear()
+    _fsync_dir(dir_path)
+    return skeletons, streamed_meta, peak
+
+
+def _read_shard(base_dir: str, streamed_dir: str, shard_meta: dict,
+                dtype, source: str) -> np.ndarray:
+    """One shard file → host array of the shard's block shape, CRC- and
+    size-validated (:class:`CheckpointCorruptError` on any mismatch, and
+    on a missing file — a partial shard dir must scan like a partial
+    container)."""
+    path = os.path.join(base_dir, streamed_dir, shard_meta["file"])
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except FileNotFoundError as e:
+        raise CheckpointCorruptError(
+            f"{source}: missing shard file {shard_meta['file']!r} "
+            f"(partial shard directory?)") from e
+    if len(buf) != shard_meta["nbytes"] or \
+            zlib.crc32(buf) != shard_meta["crc32"]:
+        raise CheckpointCorruptError(
+            f"{source}: shard file {shard_meta['file']!r} failed checksum "
+            f"validation (expected crc32={shard_meta['crc32']:#010x} over "
+            f"{shard_meta['nbytes']} bytes)")
+    block_shape = tuple(b - a for a, b in shard_meta["index"])
+    return np.frombuffer(buf, dtype=dtype).reshape(block_shape)
+
+
+def _assemble_leaf(leaf_meta: dict, base_dir: str, streamed_dir: str,
+                   source: str) -> np.ndarray:
+    """Full host array for one streamed leaf — the gathered path, for
+    consumers that want exactly what :func:`_to_host` used to pickle."""
+    shape = tuple(leaf_meta["shape"])
+    dtype = np.dtype(leaf_meta["dtype"])
+    out = np.empty(shape, dtype)
+    for sh in leaf_meta["shards"]:
+        idx = tuple(slice(a, b) for a, b in sh["index"])
+        out[idx] = _read_shard(base_dir, streamed_dir, sh, dtype, source)
+    return out
+
+
+def _assemble_tree(skeleton, streamed_meta: dict, base_dir: str,
+                   source: str):
+    """Replace every :class:`_StreamedLeaf` placeholder in ``skeleton``
+    with its fully-assembled host array."""
+    leaves, treedef = jax.tree_util.tree_flatten(skeleton)
+    leaf_meta = streamed_meta["leaves"]
+    out = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, _StreamedLeaf):
+            out.append(_assemble_leaf(leaf_meta[i], base_dir,
+                                      streamed_meta["dir"], source))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def serialize_checkpoint(components: dict, *, to_host: bool = True,
                          layouts: Optional[dict] = None,
-                         plan=None) -> bytes:
+                         plan=None, streamed: Optional[dict] = None) -> bytes:
     """Pickle ``components`` into the manifested container format:
     ``{_MAGIC: schema, "manifest": {...}, "payload": {name: bytes}}``.
     Each component is pickled separately so the manifest can carry a
@@ -200,7 +374,12 @@ def serialize_checkpoint(components: dict, *, to_host: bool = True,
     caller, who must capture it themselves when passing pre-fetched host
     trees) and, when ``plan`` is given, the parallel plan's structural
     identity.  This is the metadata
-    :meth:`CheckpointManager.restore_resharded` reshards by."""
+    :meth:`CheckpointManager.restore_resharded` reshards by.
+
+    Schema 3: ``streamed`` (from :func:`stream_components_to_dir`) maps
+    component names to their per-shard file layout; those components'
+    payloads are placeholder skeletons, and the manifest entry is what
+    the streaming reader resolves shard files through."""
     if layouts is None:
         layouts = {k: capture_layout(v) for k, v in components.items()}
     if to_host:
@@ -212,6 +391,8 @@ def serialize_checkpoint(components: dict, *, to_host: bool = True,
         comp_meta[k] = {"crc32": zlib.crc32(b), "nbytes": len(b)}
         if layouts.get(k) is not None:
             comp_meta[k]["layout"] = layouts[k]
+        if streamed and streamed.get(k) is not None:
+            comp_meta[k]["streamed"] = streamed[k]
     manifest = {"schema": SCHEMA_VERSION, "components": comp_meta}
     plan_meta = _plan_meta(plan)
     if plan_meta is not None:
@@ -222,13 +403,22 @@ def serialize_checkpoint(components: dict, *, to_host: bool = True,
 
 
 def deserialize_checkpoint(blob, *, source: str = "<bytes>",
-                           return_manifest: bool = False):
+                           return_manifest: bool = False,
+                           base_dir: Optional[str] = None,
+                           assemble_streamed: bool = True):
     """Validate + unpickle a container produced by
     :func:`serialize_checkpoint` (or a legacy manifest-less pickle, with a
     warning).  ``blob`` may be bytes or an already-unpickled object.
     With ``return_manifest=True`` returns ``(components, manifest)`` —
     manifest is None for legacy pickles — so elastic restore can read the
-    saved layout/plan without a second parse."""
+    saved layout/plan without a second parse.
+
+    Schema-3 streamed components resolve their shard files relative to
+    ``base_dir`` (the directory holding the container file — callers
+    with only bytes and no directory cannot load streamed components).
+    ``assemble_streamed=False`` skips the gathered re-assembly and hands
+    back the placeholder skeletons, for the streaming reshard path that
+    reads only the blocks each target device needs."""
     if isinstance(blob, (bytes, bytearray, memoryview)):
         try:
             obj = pickle.loads(bytes(blob))
@@ -270,21 +460,32 @@ def deserialize_checkpoint(blob, *, source: str = "<bytes>",
                 f"(expected crc32={meta['crc32']:#010x} over "
                 f"{meta['nbytes']} bytes)")
         out[name] = pickle.loads(blob_i)
+        if assemble_streamed and meta.get("streamed") is not None:
+            if base_dir is None:
+                raise CheckpointCorruptError(
+                    f"{source}: component {name!r} is shard-streamed but "
+                    f"no base directory is known to resolve its shard "
+                    f"files (load via read_checkpoint_file)")
+            out[name] = _assemble_tree(out[name], meta["streamed"],
+                                       base_dir, source)
     return (out, manifest) if return_manifest else out
 
 
 def write_checkpoint_file(path: str, components: dict, *,
                           to_host: bool = True,
                           layouts: Optional[dict] = None,
-                          plan=None) -> str:
+                          plan=None, streamed: Optional[dict] = None) -> str:
     """Atomically write ``components`` to ``path``: serialize, write to a
     sibling tmp file, flush + fsync, then one ``os.rename``.  A crash at
     ANY point leaves ``path`` either absent or a complete previous
     checkpoint — never a partial file.  Chaos hooks: ``ckpt.mid_write``
     (payload half-written in the tmp file), ``ckpt.pre_rename`` (payload
-    durable, rename pending), ``ckpt.post_rename``."""
+    durable, rename pending), ``ckpt.post_rename``.  For schema-3
+    streamed saves this is the COMMIT point: the shard files are already
+    durable, and the rename here is what makes the checkpoint exist."""
     blob = serialize_checkpoint(components, to_host=to_host,
-                                layouts=layouts, plan=plan)
+                                layouts=layouts, plan=plan,
+                                streamed=streamed)
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
@@ -316,16 +517,22 @@ def write_checkpoint_file(path: str, components: dict, *,
     return path
 
 
-def read_checkpoint_file(path: str, *, return_manifest: bool = False):
+def read_checkpoint_file(path: str, *, return_manifest: bool = False,
+                         assemble_streamed: bool = True):
     """Read + validate a checkpoint written by
     :func:`write_checkpoint_file` (legacy pickles load with a warning).
     Raises :class:`CheckpointCorruptError` on any validation failure and
     ``FileNotFoundError`` when ``path`` does not exist.  See
-    :func:`deserialize_checkpoint` for ``return_manifest``."""
+    :func:`deserialize_checkpoint` for ``return_manifest`` and
+    ``assemble_streamed`` (schema-3 shard files resolve next to
+    ``path``)."""
     with open(path, "rb") as f:
         blob = f.read()
     return deserialize_checkpoint(blob, source=path,
-                                  return_manifest=return_manifest)
+                                  return_manifest=return_manifest,
+                                  base_dir=os.path.dirname(
+                                      os.path.abspath(path)),
+                                  assemble_streamed=assemble_streamed)
 
 
 # ---------------------------------------------------------------------------
@@ -345,6 +552,13 @@ def reshard_state(host_state, target_state, *, component: str = "state",
     sharding, which hands every device exactly the shard it owns under
     the new plan.  No arithmetic touches the values, so fp32 masters
     round-trip bit-exact across any plan A → plan B.
+
+    Sources need not be host arrays: when a source leaf is itself a
+    live ``jax.Array`` whose sharding already matches the target leaf's,
+    it passes through AS-IS — no host round-trip, no re-placement, the
+    identical buffers (the eager cousin of the streaming-restore fix:
+    layout-identical components cost zero).  Only genuinely relaid-out
+    leaves pay the ``device_put``.
 
     Chaos hook ``ckpt.reshard`` fires once per component before any
     device placement; the path is read-only on disk, so a kill here
@@ -383,6 +597,17 @@ def reshard_state(host_state, target_state, *, component: str = "state",
                 f"dtype {np.dtype(sdt)} != target dtype "
                 f"{np.dtype(tgt.dtype)} (reshard never casts — masters "
                 f"must stay bit-exact)")
+        if isinstance(src, jax.Array) and not src.is_deleted():
+            # layout-identical fast path: the source already holds every
+            # shard where the target wants it — hand it through bit-exact
+            try:
+                same = src.sharding.is_equivalent_to(tgt.sharding,
+                                                     src.ndim)
+            except Exception:
+                same = src.sharding == tgt.sharding
+            if same:
+                out.append(src)
+                continue
         if isinstance(tgt.sharding, jax.sharding.NamedSharding):
             out.append(jax.device_put(src, tgt.sharding))
         else:
@@ -394,6 +619,114 @@ def reshard_state(host_state, target_state, *, component: str = "state",
             import jax.numpy as jnp
             out.append(jnp.asarray(src))
     return jax.tree_util.tree_unflatten(tgt_def, out)
+
+
+def reshard_streamed(skeleton, streamed_meta: dict, target_state, *,
+                     base_dir: str, component: str = "state",
+                     source: str = "<checkpoint>"):
+    """Streaming half of elastic restore: lay a schema-3 shard-streamed
+    component out under ``target_state``'s CURRENT shardings WITHOUT
+    ever assembling the full state on the host.
+
+    For each target leaf, each addressable device's block is assembled
+    from only the overlapping source shard files
+    (``sharding.devices_indices_map`` gives the target index; a slice-
+    overlap copy fills the block) and placed via
+    ``jax.make_array_from_callback``.  Values are copied byte-for-byte —
+    the result is bitwise-equal to the gathered
+    :func:`reshard_state` path on the same checkpoint.
+
+    Same validation and chaos contract as :func:`reshard_state`
+    (``ckpt.reshard`` fires once per component;
+    :class:`CheckpointReshardError` on structure/shape/dtype mismatch).
+
+    Returns ``(state, stats)`` with ``stats["peak_host_bytes"]`` the
+    high-water mark of host bytes held at once — the number
+    ``bench.py --cluster`` compares against the gathered path's full
+    state size."""
+    if _chaos.active():
+        _chaos.hook("ckpt.reshard", component=component, source=source)
+    tgt_paths, tgt_def = jax.tree_util.tree_flatten_with_path(target_state)
+    src_leaves, src_def = jax.tree_util.tree_flatten(skeleton)
+    if src_def != tgt_def:
+        raise CheckpointReshardError(
+            f"{source}: component {component!r}: checkpoint pytree "
+            f"structure does not match the target step "
+            f"({src_def.num_leaves} vs {tgt_def.num_leaves} leaves) — "
+            f"different model/optimizer config")
+    leaves_meta = streamed_meta["leaves"]
+    stats = {"peak_host_bytes": 0, "shard_reads": 0}
+    out = []
+    for i, ((path, tgt), src) in enumerate(zip(tgt_paths, src_leaves)):
+        if not isinstance(src, _StreamedLeaf):
+            # non-array leaf (or a component mixing host leaves in):
+            # defer to the gathered per-leaf semantics
+            out.append(src if not isinstance(tgt, jax.Array)
+                       else jax.numpy.asarray(src))
+            continue
+        meta = leaves_meta[i]
+        name = jax.tree_util.keystr(path)
+        if not isinstance(tgt, jax.Array):
+            raise CheckpointReshardError(
+                f"{source}: component {component!r} leaf {name}: saved "
+                f"array has no array counterpart in the target step")
+        shape = tuple(meta["shape"])
+        dtype = np.dtype(meta["dtype"])
+        if shape != tuple(tgt.shape):
+            raise CheckpointReshardError(
+                f"{source}: component {component!r} leaf {name}: saved "
+                f"shape {shape} cannot be resharded into target shape "
+                f"{tuple(tgt.shape)}")
+        if dtype != np.dtype(tgt.dtype):
+            raise CheckpointReshardError(
+                f"{source}: component {component!r} leaf {name}: saved "
+                f"dtype {dtype} != target dtype {np.dtype(tgt.dtype)} "
+                f"(reshard never casts — masters must stay bit-exact)")
+
+        # tiny per-leaf shard cache: consecutive target blocks overlap
+        # the same source files (dp 8→4: two reads per block), so cache
+        # the last few loaded shards instead of re-reading the file
+        cache: dict = {}
+
+        def load_shard(sh):
+            key = sh["file"]
+            if key not in cache:
+                if len(cache) >= 2:
+                    cache.pop(next(iter(cache)))
+                cache[key] = _read_shard(base_dir, streamed_meta["dir"],
+                                         sh, dtype, source)
+                stats["shard_reads"] += 1
+            return cache[key]
+
+        def build_block(index):
+            norm = [(sl.indices(int(d))[0], sl.indices(int(d))[1])
+                    for sl, d in zip(index, shape)]
+            block = np.empty(tuple(b - a for a, b in norm), dtype)
+            for sh in meta["shards"]:
+                dst, srcs = [], []
+                for (t0, t1), (s0, s1) in zip(norm, sh["index"]):
+                    lo, hi = max(t0, s0), min(t1, s1)
+                    if hi <= lo:
+                        break
+                    dst.append(slice(lo - t0, hi - t0))
+                    srcs.append(slice(lo - s0, hi - s0))
+                else:
+                    block[tuple(dst)] = load_shard(sh)[tuple(srcs)]
+            held = block.nbytes + sum(a.nbytes for a in cache.values())
+            stats["peak_host_bytes"] = max(stats["peak_host_bytes"], held)
+            return block
+
+        sharding = tgt.sharding
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            out.append(jax.make_array_from_callback(shape, sharding,
+                                                    build_block))
+        else:
+            # single-device / replicated target: one full-leaf block,
+            # re-deviced UNCOMMITTED (same rationale as reshard_state)
+            full = build_block(tuple(slice(0, d) for d in shape))
+            out.append(jax.numpy.asarray(full))
+        cache.clear()
+    return jax.tree_util.tree_unflatten(tgt_def, out), stats
 
 
 # ---------------------------------------------------------------------------
@@ -465,10 +798,19 @@ class CheckpointManager:
         self._queue: collections.deque = collections.deque()
         self._worker: Optional[threading.Thread] = None
         self._closed = False
+        #: filled by save_sharded / restore_resharded — the host-memory
+        #: numbers bench.py --cluster reports
+        self.last_save_stats: dict = {}
+        self.last_restore_stats: dict = {}
 
     # -- paths -------------------------------------------------------------
     def path_for(self, step: int) -> str:
         return os.path.join(self.directory, f"ckpt_{int(step):08d}.pkl")
+
+    def shard_dir_for(self, step: int) -> str:
+        """Schema-3 shard-file directory for ``step`` (exists only for
+        checkpoints written by :meth:`save_sharded`)."""
+        return os.path.join(self.directory, f"ckpt_{int(step):08d}.shards")
 
     def all_steps(self) -> list:
         """Step numbers with a (final-path) checkpoint file, ascending.
@@ -489,11 +831,30 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def _sweep_tmp(self):
-        # debris from killed writers (ours or a predecessor's)
-        for name in os.listdir(self.directory):
+        # debris from killed writers (ours or a predecessor's): partial
+        # container tmp files, partial shard tmp files, and shard
+        # directories whose manifest never committed (a kill mid-shard
+        # leaves the dir with no ckpt_<step>.pkl — the previous
+        # checkpoint is still the newest valid one)
+        names = os.listdir(self.directory)
+        final = set(names)
+        for name in names:
+            path = os.path.join(self.directory, name)
             if ".pkl.tmp." in name:
                 try:
-                    os.unlink(os.path.join(self.directory, name))
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            m = _SHARD_DIR_RE.match(name)
+            if m:
+                if f"ckpt_{m.group(1)}.pkl" not in final:
+                    shutil.rmtree(path, ignore_errors=True)
+                    continue
+                try:
+                    for sub in os.listdir(path):
+                        if ".bin.tmp." in sub:
+                            os.unlink(os.path.join(path, sub))
                 except OSError:
                     pass
 
@@ -506,14 +867,17 @@ class CheckpointManager:
                 os.unlink(self.path_for(s))
             except OSError:
                 pass
+            shutil.rmtree(self.shard_dir_for(s), ignore_errors=True)
 
     # -- save --------------------------------------------------------------
     def _write(self, step: int, host_components: dict,
-               layouts: Optional[dict] = None, plan=None) -> str:
-        self._sweep_tmp()
+               layouts: Optional[dict] = None, plan=None,
+               streamed: Optional[dict] = None, sweep: bool = True) -> str:
+        if sweep:
+            self._sweep_tmp()
         path = write_checkpoint_file(self.path_for(step), host_components,
                                      to_host=False, layouts=layouts,
-                                     plan=plan)
+                                     plan=plan, streamed=streamed)
         self._retain(step)
         return path
 
@@ -537,11 +901,21 @@ class CheckpointManager:
     def save_sharded(self, step: int, train_step, /, **extra) -> str:
         """Blocking atomic save of a live train step WITH its elastic
         metadata: component ``"state"`` is ``train_step.state``, and the
-        schema-2 manifest records each leaf's partition spec plus the
-        step's parallel plan (``train_step.plan``) — everything
+        manifest records each leaf's partition spec plus the step's
+        parallel plan (``train_step.plan``) — everything
         :meth:`restore_resharded` needs to load this checkpoint into a
         DIFFERENT plan after the device set changes.  Extra components
-        (epoch counters, rng, ...) ride along as in :meth:`save`."""
+        (epoch counters, rng, ...) ride along as in :meth:`save`.
+
+        Schema 3: the state never gathers onto the host.  Each distinct
+        array shard streams straight to its own file under
+        :meth:`shard_dir_for` (per-shard CRC, atomic per-file writes,
+        ``ckpt.shard_write`` chaos hook per file); the manifest container
+        commits LAST, so a kill mid-shard leaves only an orphan shard
+        directory (collected by the next save's sweep) and the previous
+        checkpoint stays the newest valid one.
+        ``last_save_stats["shard_bytes_peak_host"]`` records the largest
+        single host buffer the save touched."""
         if "state" in extra:
             raise ValueError("save_sharded owns the 'state' component; "
                              "pass other data under different names")
@@ -550,10 +924,16 @@ class CheckpointManager:
         handle = SaveHandle(step, self.path_for(step))
         try:
             with _spans.span("ckpt.save", step=step, mode="sharded"):
-                self._write(step,
-                            {k: _to_host(v) for k, v in components.items()},
-                            layouts=layouts,
-                            plan=getattr(train_step, "plan", None))
+                self._sweep_tmp()
+                sdir = self.shard_dir_for(step)
+                if os.path.isdir(sdir):   # same-step re-save: fresh dir
+                    shutil.rmtree(sdir, ignore_errors=True)
+                skeletons, streamed, peak = \
+                    stream_components_to_dir(sdir, components)
+                self.last_save_stats = {"shard_bytes_peak_host": peak}
+                self._write(step, skeletons, layouts=layouts,
+                            plan=getattr(train_step, "plan", None),
+                            streamed=streamed, sweep=False)
         except BaseException as e:
             handle._finish(e)
             raise
@@ -647,9 +1027,15 @@ class CheckpointManager:
         components.  ``train_step.state`` is replaced in place via
         :func:`reshard_state`.
 
-        A legacy / schema-1 checkpoint carries no sharding metadata; its
-        arrays were still gathered at save time, so it restores the same
-        way, with a warning (no save-side layout to cross-check).
+        Schema-3 checkpoints stream: each target device's block is
+        assembled from only the overlapping shard files
+        (:func:`reshard_streamed`) — the full state never materializes
+        on this host, and ``last_restore_stats`` records the mode and
+        the host-bytes high-water mark.  A schema-2 checkpoint predates
+        shard streaming; its arrays were gathered at save time, so it
+        restores through the gathered :func:`reshard_state` path with a
+        warning (re-save to upgrade it to schema 3).  A legacy /
+        schema-1 checkpoint additionally carries no sharding metadata.
         Raises :class:`CheckpointReshardError` when the checkpoint is
         structurally incompatible with the step and
         :class:`CheckpointCorruptError` when it fails validation."""
@@ -660,23 +1046,56 @@ class CheckpointManager:
                     f"no checkpoints under {self.directory!r}")
         path = self.path_for(step)
         with _spans.span("ckpt.restore", step=step, mode="resharded"):
-            comps, manifest = read_checkpoint_file(path,
-                                                   return_manifest=True)
-        if "state" not in comps:
-            raise CheckpointReshardError(
-                f"{path}: no 'state' component to reshard (components: "
-                f"{sorted(comps)}) — written by save_sharded / "
-                f"ElasticTrainer.save?")
-        schema = (manifest or {}).get("schema", 0)
-        if schema < 2:
-            warnings.warn(
-                f"{path}: schema-{schema or 'legacy'} checkpoint predates "
-                f"sharding metadata — restoring its (gathered, full) "
-                f"arrays into the target layout without save-side "
-                f"validation", stacklevel=2)
-        train_step.state = reshard_state(comps["state"], train_step.state,
-                                         component="state", source=path)
-        return step, {k: v for k, v in comps.items() if k != "state"}
+            comps, manifest = read_checkpoint_file(
+                path, return_manifest=True, assemble_streamed=False)
+            if "state" not in comps:
+                raise CheckpointReshardError(
+                    f"{path}: no 'state' component to reshard "
+                    f"(components: {sorted(comps)}) — written by "
+                    f"save_sharded / ElasticTrainer.save?")
+            schema = (manifest or {}).get("schema", 0)
+            comp_meta = (manifest or {}).get("components", {})
+            streamed = (comp_meta.get("state") or {}).get("streamed")
+            if schema < 2:
+                warnings.warn(
+                    f"{path}: schema-{schema or 'legacy'} checkpoint "
+                    f"predates sharding metadata — restoring its "
+                    f"(gathered, full) arrays into the target layout "
+                    f"without save-side validation", stacklevel=2)
+            elif streamed is None:
+                warnings.warn(
+                    f"{path}: schema-{schema} checkpoint predates shard "
+                    f"streaming — gathered restore (re-save to upgrade "
+                    f"it to the schema-3 per-shard layout)", stacklevel=2)
+            if streamed is not None:
+                train_step.state, stats = reshard_streamed(
+                    comps["state"], streamed, train_step.state,
+                    base_dir=self.directory, component="state",
+                    source=path)
+                self.last_restore_stats = {"mode": "streamed",
+                                           "schema": schema, **stats}
+            else:
+                host_state = comps["state"]
+                gathered = sum(
+                    x.nbytes for x in
+                    jax.tree_util.tree_leaves(host_state)
+                    if isinstance(x, np.ndarray))
+                train_step.state = reshard_state(
+                    host_state, train_step.state, component="state",
+                    source=path)
+                self.last_restore_stats = {"mode": "gathered",
+                                           "schema": schema,
+                                           "peak_host_bytes": gathered}
+            extras = {}
+            for k, v in comps.items():
+                if k == "state":
+                    continue
+                k_streamed = (comp_meta.get(k) or {}).get("streamed")
+                if k_streamed is not None:   # small ride-along arrays
+                    v = _assemble_tree(v, k_streamed, self.directory,
+                                       path)
+                extras[k] = v
+        return step, extras
 
     def restore_or_initialize(self, initialize: Optional[Callable] = None):
         """Auto-resume: ``(step, components)`` from the newest checkpoint

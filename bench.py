@@ -2570,6 +2570,169 @@ def run_elastic(args):
     return 0
 
 
+def cluster_bench_records(dim=32, batch=24, n_hosts=4, pre_steps=3,
+                          directory=None, spawn_processes=True):
+    """``--cluster``: the multi-host elastic cycle on the CPU host mesh.
+
+    Runs the full detect→agree→replan→reshard cycle in-process (the
+    tier-1 simulation: ``n_hosts`` heartbeat agents over a shared
+    MemoryKV and a fake clock, one host felled by chaos) and emits one
+    ``cluster_recovery`` record with ``{membership_epochs, detect_ms,
+    replan_ms, stream_restore_ms, gathered_restore_ms,
+    shard_bytes_peak_host, gathered_state_bytes}`` — the streamed-vs-
+    gathered pair is the streaming-shard-IO claim: the streamed restore's
+    host high-water mark stays below the gathered full-state size.
+
+    With ``spawn_processes`` a second ``cluster_process_detect`` record
+    crosses REAL process boundaries: child OS processes heartbeat over a
+    FileKV until their beats run out, and the parent coordinator times
+    admission and loss detection.  CPU-forced like the elastic stage —
+    nothing here touches accelerator math.
+    """
+    import shutil
+    import tempfile
+
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.cluster import (ClusterTrainer, Coordinator, FileKV,
+                                  current_epoch, spawn_member_process)
+    from apex_tpu.nn import functional as F
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.runtime import chaos, resilience
+    from apex_tpu.runtime import executor as _executor
+    from apex_tpu.training import make_train_step
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, dim)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (batch,)))
+
+    def mk(seed=0):
+        nn.manual_seed(seed)
+        model = nn.Sequential(nn.Linear(dim, dim), nn.ReLU(),
+                              nn.Linear(dim, 10))
+        return model, FusedSGD(list(model.parameters()), lr=0.1,
+                               momentum=0.9)
+
+    base = directory or tempfile.mkdtemp(prefix="apex_tpu_cluster_bench_")
+    records = []
+    try:
+        model, opt = mk()
+        ct = ClusterTrainer(
+            os.path.join(base, "ckpts"), model, opt,
+            lambda o, t: F.cross_entropy(o, t), example_batch=(x, y),
+            n_hosts=n_hosts, half_dtype=None, loss_scale=1.0,
+            plan_filter=lambda p: p.dp == p.n_devices and p.accum == 1
+            and p.zero_stage == 0 and not p.chunked_loss)
+        ct.join()
+        ct.recover()
+        for _ in range(pre_steps):
+            ct(x, y)
+        ct.save(pre_steps - 1)
+        save_peak = ct.trainer.manager.last_save_stats.get(
+            "shard_bytes_peak_host", 0)
+
+        # one host's process dies; two stale scans fell it
+        victim = ct.hosts[-1].member_id
+
+        def kill(ctx):
+            if ctx.get("member") == victim:
+                raise chaos.ChaosKilled(f"{victim} died")
+
+        t0 = time.perf_counter()
+        with chaos.session(seed=0) as c:
+            c.on("host.loss", action=kill, times=-1)
+            ct.tick(ct.deadline_s * 1.2)
+            ct.tick(ct.deadline_s * 1.2)
+        detect_ms = (time.perf_counter() - t0) * 1e3
+        ct.recover()
+        tel = ct.telemetry
+        ct(x, y)                        # one resumed step on the survivors
+
+        # the gathered arm: assemble the full host state and reshard it
+        # into a fresh step under the SAME surviving-fleet plan
+        step_no = ct.trainer.resume_step
+        mgr = ct.trainer.manager
+        t0 = time.perf_counter()
+        host = resilience.read_checkpoint_file(mgr.path_for(step_no))
+        model2, opt2 = mk(seed=1)
+        fresh = make_train_step(
+            model2, opt2, lambda o, t: F.cross_entropy(o, t),
+            half_dtype=None, loss_scale=1.0, parallel=ct.plan,
+            devices=ct.trainer.devices)
+        fresh.state = resilience.reshard_state(host["state"], fresh.state)
+        gathered_ms = (time.perf_counter() - t0) * 1e3
+        gathered_bytes = sum(
+            a.nbytes for a in jax.tree_util.tree_leaves(host["state"])
+            if isinstance(a, np.ndarray))
+
+        records.append({
+            "metric": "cluster_recovery", "platform": "cpu",
+            "hosts": n_hosts, "membership_epochs": current_epoch(ct.kv),
+            "surviving_devices": tel["n_devices"], "plan": ct.plan.name(),
+            "detect_ms": round(detect_ms, 3),
+            "replan_ms": tel["replan_ms"],
+            "stream_restore_ms": tel["reshard_ms"],
+            "gathered_restore_ms": round(gathered_ms, 3),
+            "shard_bytes_peak_host": tel["restore_peak_host_bytes"],
+            "gathered_state_bytes": int(gathered_bytes),
+            "shard_bytes_peak_save": save_peak,
+            "restore_mode": tel["restore_mode"]})
+        _executor.set_cluster_epoch(None)
+
+        if spawn_processes:
+            kv_dir = os.path.join(base, "kv")
+            kv = FileKV(kv_dir)
+            procs = [spawn_member_process(kv_dir, f"proc{i}",
+                                          interval_s=0.05, beats=40)
+                     for i in range(2)]
+            coord = Coordinator(kv, deadline_s=1.0, miss_threshold=2)
+            t0 = time.perf_counter()
+            admitted = None
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                view = coord.scan()
+                if len(view.members) == len(procs):
+                    admitted = (time.perf_counter() - t0) * 1e3
+                    break
+                time.sleep(0.1)
+            for p in procs:
+                p.wait(timeout=60.0)
+            t0 = time.perf_counter()
+            lost = None
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if not coord.scan().members:
+                    lost = (time.perf_counter() - t0) * 1e3
+                    break
+                time.sleep(0.2)
+            records.append({
+                "metric": "cluster_process_detect", "platform": "cpu",
+                "processes": len(procs), "kv": "file",
+                "admit_ms": round(admitted, 1) if admitted else None,
+                "loss_detect_ms": round(lost, 1) if lost else None,
+                "epochs": current_epoch(kv)})
+    finally:
+        if directory is None:
+            shutil.rmtree(base, ignore_errors=True)
+    return records
+
+
+def run_cluster(args):
+    stage("cluster", "multi-host detect→agree→replan→reshard cycle, cpu")
+    for r in cluster_bench_records():
+        emit(r)
+        register_record(r)
+    return 0
+
+
 def plan_bench_records(vocab=2048, hidden=192, layers=4, heads=6, seq=128,
                        batch=16, topk=3, timed_steps=3):
     """``--plan``: the parallelism planner's predicted-vs-measured
@@ -2867,6 +3030,14 @@ def main():
                          "replan→reshard→resume cycle on the CPU host "
                          "mesh, emitting {replan_ms, reshard_ms, "
                          "resume_gap_steps} per topology transition")
+    ap.add_argument("--cluster", action="store_true",
+                    help="cluster_recovery stage: the multi-host "
+                         "detect→agree→replan→reshard cycle on the CPU "
+                         "host mesh (apex_tpu.cluster), emitting "
+                         "{membership_epochs, detect_ms, replan_ms, "
+                         "stream_restore_ms, gathered_restore_ms, "
+                         "shard_bytes_peak_host} plus a real-OS-process "
+                         "FileKV heartbeat detection record")
     ap.add_argument("--observe-microbench", action="store_true",
                     help="telemetry_overhead_us stage: the fused step "
                          "with the on-device telemetry carry vs telemetry "
@@ -2913,6 +3084,10 @@ def main():
     if args.elastic:
         start_watchdog(args.budget_s)
         return run_elastic(args)
+
+    if args.cluster:
+        start_watchdog(args.budget_s)
+        return run_cluster(args)
 
     if args.observe_microbench:
         start_watchdog(args.budget_s)

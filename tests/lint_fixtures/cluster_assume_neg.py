@@ -1,0 +1,20 @@
+"""CLUSTER-ASSUME negative: topology questions through the sanctioned
+seam — parallel.distributed helpers and cluster membership views."""
+import os
+
+from apex_tpu.cluster import current_view, default_kv
+from apex_tpu.parallel import init_distributed
+from apex_tpu.parallel.distributed import num_processes, rank
+
+
+def should_log():
+    return num_processes() > 1 and rank() != 0
+
+
+def setup(addr):
+    # bounded retry loop, launcher env consumed inside the seam
+    init_distributed(coordinator_address=addr)
+    view = current_view(default_kv())
+    # epoch-keyed, not rank-keyed: immutable per membership epoch
+    port = int(os.environ.get("APEX_TPU_COORD_PORT", "12355"))
+    return view.epoch if view is not None else 0, port

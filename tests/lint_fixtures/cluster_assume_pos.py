@@ -1,0 +1,19 @@
+"""CLUSTER-ASSUME positive: raw process-topology queries that go stale
+the moment cluster membership changes epoch."""
+import os
+
+import jax
+
+
+def should_log():
+    # BAD: rank gate on the fleet the job STARTED with
+    return jax.process_count() > 1 and jax.process_index() != 0
+
+
+def setup(addr):
+    # BAD: bare initialize — blocks forever, no retry/backoff
+    jax.distributed.initialize(coordinator_address=addr)
+    # BAD: hardcoded process-count arithmetic from the launcher env
+    n = int(os.environ["APEX_TPU_NUM_PROCESSES"])
+    me = int(os.environ.get("APEX_TPU_PROCESS_ID", "0"))
+    return me * 100 // n

@@ -230,6 +230,29 @@ def test_elastic_bench_records_schema(tmp_path):
     assert shrink["resume_gap_steps"] == 1
 
 
+def test_cluster_bench_records_schema(tmp_path):
+    """--cluster stage: one cluster_recovery record carrying the full
+    cycle's latency split and the streaming-shard-IO claim — the
+    streamed restore's host high-water mark stays strictly below the
+    gathered full-state size.  (The real-OS-process FileKV arm is
+    covered by tests/test_cluster.py; skipped here to keep this quick.)"""
+    recs = bench.cluster_bench_records(dim=16, batch=24, pre_steps=2,
+                                       directory=str(tmp_path),
+                                       spawn_processes=False)
+    (r,) = recs
+    assert r["metric"] == "cluster_recovery"
+    assert r["platform"] == "cpu"
+    assert r["membership_epochs"] >= 2       # join epoch + the host loss
+    assert r["surviving_devices"] >= 1
+    assert r["detect_ms"] >= 0
+    assert r["replan_ms"] > 0
+    assert r["stream_restore_ms"] > 0
+    assert r["gathered_restore_ms"] > 0
+    assert r["restore_mode"] == "streamed"
+    assert 0 < r["shard_bytes_peak_host"] < r["gathered_state_bytes"]
+    assert r["shard_bytes_peak_save"] > 0
+
+
 def test_observe_microbench_records_schema():
     """--observe-microbench stage: the fused step with the on-device
     telemetry carry vs telemetry off, and the observe claim — at

@@ -1,0 +1,574 @@
+"""apex_tpu.cluster end to end: the KV substrate, heartbeat membership
+with epoch-numbered views, the detect→agree→replan→reshard cycle under
+seeded chaos (host loss mid-run, coordinator loss, the delayed-heartbeat
+false-positive guard), schema-3 streaming shard IO (kill-mid-shard
+durability, streamed ≡ gathered bitwise), and heterogeneity-aware
+planning (mixed fleets, per-device batch shares, slowest-member bound)
+— all on the 8-virtual-CPU-device mesh in one process."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.nn as nn
+from apex_tpu.nn import functional as F
+from apex_tpu.cluster import (ClusterTrainer, Coordinator, FileKV, Member,
+                              MemoryKV, PREFIX, SimClock, current_epoch,
+                              current_view, fleet_for_members,
+                              spawn_member_process)
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.parallel import auto
+from apex_tpu.runtime import CheckpointManager, chaos, resilience
+from apex_tpu.runtime import executor as _executor
+from apex_tpu.runtime.elastic import ElasticTrainer
+from apex_tpu.training import make_train_step
+
+pytestmark = pytest.mark.cluster
+
+DIM, CLASSES = 16, 10
+#: divisible by every dp degree the shrink tests visit (8, 6, 4, 3, 2)
+BATCH = 24
+
+
+@pytest.fixture(autouse=True)
+def _clean_cluster_state():
+    yield
+    chaos.uninstall()
+    _executor.set_cluster_epoch(None)
+
+
+def _mlp(seed=0):
+    nn.manual_seed(seed)
+    model = nn.Sequential(nn.Linear(DIM, 32), nn.GELU(),
+                          nn.Linear(32, CLASSES))
+    opt = FusedSGD(list(model.parameters()), lr=0.1, momentum=0.9)
+    return model, opt
+
+
+def _loss(o, t):
+    return F.cross_entropy(o, t)
+
+
+def _batch(seed, b=BATCH):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.standard_normal((b, DIM)), jnp.float32),
+            jnp.asarray(rng.integers(0, CLASSES, (b,))))
+
+
+#: pin the plan family so shrink trajectories are deterministic: pure
+#: data parallel over every surviving device, no ZeRO, no accum
+def _dp_only(p):
+    return (p.dp == p.n_devices and p.zero_stage == 0 and p.accum == 1
+            and not p.chunked_loss)
+
+
+def _cluster(path, seed=0, **kw):
+    model, opt = _mlp(seed)
+    kw.setdefault("n_hosts", 4)
+    kw.setdefault("plan_filter", _dp_only)
+    return ClusterTrainer(str(path), model, opt, _loss,
+                          example_batch=_batch(0), half_dtype=None,
+                          loss_scale=1.0, **kw)
+
+
+def _kill_member(member_id):
+    """Chaos action for ``host.loss``: this one host's process dies."""
+    def act(ctx):
+        if ctx.get("member") == member_id:
+            raise chaos.ChaosKilled(f"{member_id} died")
+    return act
+
+
+# ---------------------------------------------------------------------------
+# KV substrate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("make_kv", [lambda tmp: MemoryKV(),
+                                     lambda tmp: FileKV(str(tmp / "kv"))],
+                         ids=["memory", "file"])
+def test_kvstore_roundtrip_and_scan(tmp_path, make_kv):
+    kv = make_kv(tmp_path)
+    assert kv.get("absent") is None
+    kv.set(f"{PREFIX}hb/host0", "1.5")
+    kv.set(f"{PREFIX}hb/host1", "2.5")
+    kv.set(f"{PREFIX}epoch", "3")
+    assert kv.get(f"{PREFIX}hb/host0") == "1.5"
+    got = kv.scan(f"{PREFIX}hb/")
+    assert got == {f"{PREFIX}hb/host0": "1.5",
+                   f"{PREFIX}hb/host1": "2.5"}
+    kv.delete(f"{PREFIX}hb/host0")
+    assert kv.get(f"{PREFIX}hb/host0") is None
+    assert set(kv.scan(f"{PREFIX}hb/")) == {f"{PREFIX}hb/host1"}
+    kv.delete(f"{PREFIX}hb/host0")          # idempotent
+
+
+def test_file_kv_crosses_instances_and_skips_tmp_debris(tmp_path):
+    a = FileKV(str(tmp_path / "kv"))
+    b = FileKV(str(tmp_path / "kv"))        # a second "process"
+    a.set(f"{PREFIX}members/h0", '{"chip": "cpu"}')
+    assert b.get(f"{PREFIX}members/h0") == '{"chip": "cpu"}'
+    # a torn write (tmp file left by a killed writer) never scans
+    (tmp_path / "kv" / "whatever.tmp.123").write_text("partial")
+    assert set(b.scan(PREFIX)) == {f"{PREFIX}members/h0"}
+
+
+# ---------------------------------------------------------------------------
+# membership + coordinator protocol (no trainer)
+# ---------------------------------------------------------------------------
+
+
+def test_join_scan_publishes_epoch1_and_acks():
+    kv, clock = MemoryKV(), SimClock()
+    members = [Member(kv, f"h{i}", clock=clock).join() for i in range(3)]
+    coord = Coordinator(kv, deadline_s=1.0, miss_threshold=2, clock=clock)
+    view = coord.scan()
+    assert view.epoch == 1 and view.members == ("h0", "h1", "h2")
+    assert current_epoch(kv) == 1
+    assert not coord.acked(view)
+    for m in members:
+        m.ack(view)
+    assert coord.acked(view)
+    # steady state: no change, no new epoch
+    clock.advance(0.5)
+    for m in members:
+        m.beat()
+    assert coord.scan().epoch == 1
+
+
+def test_graceful_leave_drops_without_waiting_out_misses():
+    kv, clock = MemoryKV(), SimClock()
+    members = [Member(kv, f"h{i}", clock=clock).join() for i in range(3)]
+    coord = Coordinator(kv, deadline_s=1.0, miss_threshold=5, clock=clock)
+    assert coord.scan().members == ("h0", "h1", "h2")
+    members[1].leave()
+    view = coord.scan()                     # no 5-scan wait: deregistered
+    assert view.epoch == 2 and view.members == ("h0", "h2")
+
+
+def test_consecutive_miss_detection_and_heartbeat_delay_guard():
+    kv, clock = MemoryKV(), SimClock()
+    m0 = Member(kv, "h0", clock=clock).join()
+    m1 = Member(kv, "h1", clock=clock).join()
+    coord = Coordinator(kv, deadline_s=0.25, miss_threshold=2, clock=clock)
+    assert coord.scan().epoch == 1
+
+    # one stale scan is NOT death (miss 1 of 2) ...
+    clock.advance(0.3)
+    m0.beat()
+    assert coord.scan().members == ("h0", "h1")
+    # ... and a fresh beat resets the counter: h1 keeps its seat forever
+    # under the beat-then-pause-then-beat pattern
+    clock.advance(0.3)
+    m0.beat()
+    m1.beat()
+    assert coord.scan().epoch == 1
+
+    # the chaos heartbeat.delay action skews h1's stamp backwards — a
+    # paused-but-alive host.  miss_threshold=2 absorbs it: no new epoch.
+    with chaos.session(seed=0) as c:
+        c.on("heartbeat.delay",
+             action=lambda ctx: 10.0 if ctx["member"] == "h1" else None,
+             times=1)
+        clock.advance(0.1)
+        m0.beat()
+        m1.beat()                           # lands skewed 10s backwards
+        assert coord.scan().epoch == 1      # miss 1 only
+        clock.advance(0.1)
+        m0.beat()
+        m1.beat()                           # fresh again: counter resets
+        assert coord.scan().epoch == 1
+
+    # two CONSECUTIVE stale scans do fell a member
+    clock.advance(0.3)
+    m0.beat()
+    coord.scan()
+    clock.advance(0.3)
+    m0.beat()
+    view = coord.scan()
+    assert view.epoch == 2 and view.members == ("h0",)
+
+
+def test_epoch_survives_coordinator_loss_without_resurrection():
+    """A successor coordinator over the same store continues the
+    persisted epoch counter and must NOT re-admit a dead-but-still-
+    registered member for a bogus epoch (its empty miss counters seed
+    from the published view)."""
+    kv, clock = MemoryKV(), SimClock()
+    m0 = Member(kv, "h0", clock=clock).join()
+    Member(kv, "h1", clock=clock).join()    # joins, then silently dies
+    coord = Coordinator(kv, deadline_s=0.25, miss_threshold=2, clock=clock)
+    assert coord.scan().epoch == 1
+    for _ in range(2):
+        clock.advance(0.3)
+        m0.beat()
+        view = coord.scan()
+    assert view.epoch == 2 and view.members == ("h0",)
+
+    # coordinator dies; the successor rebuilds soft state from scratch
+    successor = Coordinator(kv, deadline_s=0.25, miss_threshold=2,
+                            clock=clock)
+    clock.advance(0.1)
+    m0.beat()
+    view2 = successor.scan()
+    assert view2.epoch == 2 and view2.members == ("h0",)
+    assert current_epoch(kv) == 2
+    # only a FRESH beat readmits h1
+    m1b = Member(kv, "h1", clock=clock)
+    m1b.alive = True
+    m1b.beat()
+    view3 = successor.scan()
+    assert view3.epoch == 3 and view3.members == ("h0", "h1")
+
+
+# ---------------------------------------------------------------------------
+# the full cycle: detect → agree → replan → reshard, under chaos
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_host_loss_shrink_replan_resume_loss_parity(tmp_path):
+    """Acceptance: a host dies mid-run; the cluster detects it within
+    miss_threshold scans, agrees on a new epoch, re-plans 8→6 devices,
+    streams the newest checkpoint into the new layout, and the resumed
+    loss trajectory matches an uninterrupted run (fp32 SGD; the shrink
+    segment runs a different dp degree, so parity is to reduction-order
+    tolerance)."""
+    n = len(jax.devices())
+    assert n == 8
+    batches = [_batch(10 + i) for i in range(8)]
+
+    model, opt = _mlp()
+    ref = ElasticTrainer(str(tmp_path / "ref"), model, opt, _loss,
+                         example_batch=_batch(0), half_dtype=None,
+                         loss_scale=1.0, plan_filter=_dp_only)
+    ref.restore()
+    ref_losses = [float(ref(*b)) for b in batches]
+
+    ct = _cluster(tmp_path / "cl")
+    view = ct.join()
+    assert view.epoch == 1 and len(view.members) == 4
+    assert ct.recover() == 0 and ct.plan.dp == n
+    assert _executor.cluster_epoch() == 1
+    got = [float(ct(*b)) for b in batches[:3]]
+    ct.save(2)
+    for b in batches[3:5]:
+        ct(*b)                  # steps 3-4 run but die un-checkpointed
+
+    # host3's process dies mid-beat; two stale scans fell it
+    with chaos.session(seed=0) as c:
+        c.on("host.loss", action=_kill_member("host3"), times=-1)
+        ct.tick(0.3)
+        view = ct.tick(0.3)
+    assert ct.membership_changed()
+    assert view.epoch == 2 and set(view.members) == {"host0", "host1",
+                                                     "host2"}
+    assert not ct.hosts[3].alive
+
+    resume = ct.recover()
+    assert resume == 3          # replays exactly the un-checkpointed steps
+    assert ct.plan.dp == 6 and len(ct.trainer.devices) == 6
+    assert _executor.cluster_epoch() == 2
+    tel = ct.telemetry
+    assert tel["epoch"] == 2 and tel["n_devices"] == 6
+    assert tel["restore_mode"] == "streamed"
+    assert tel["detect_ms"] >= 0 and tel["replan_ms"] > 0
+    assert tel["resume_step"] == 2
+    got += [float(ct(*b)) for b in batches[3:]]
+
+    np.testing.assert_allclose(got, ref_losses, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.chaos
+def test_coordinator_loss_epoch_monotonic_across_successor(tmp_path):
+    """coordinator.loss mid-duty: the successor (same KV store) inherits
+    the persisted epoch — never rewinds, never resurrects the member the
+    published view already dropped."""
+    ct = _cluster(tmp_path / "cl")
+    ct.join()
+    ct.recover()
+    with chaos.session(seed=0) as c:
+        c.on("host.loss", action=_kill_member("host2"), times=-1)
+        ct.tick(0.3)
+        view = ct.tick(0.3)
+    assert view.epoch == 2 and "host2" not in view.members
+    first_coord = ct.coordinator
+
+    with chaos.session(seed=0) as c:
+        c.on("coordinator.loss", action="kill", at=0)
+        ct.tick()               # dies mid-scan; tick rebuilds over same kv
+    assert ct.coordinator is not first_coord
+    view2 = ct.tick()
+    assert view2.epoch == 2 and "host2" not in view2.members
+    assert current_epoch(ct.kv) == 2
+    ct.recover()
+    assert ct.plan.dp == 6 and _executor.cluster_epoch() == 2
+
+
+@pytest.mark.chaos
+def test_heartbeat_delay_does_not_cost_a_seat(tmp_path):
+    """A delayed (skewed-backwards) heartbeat under miss_threshold=2 is
+    a false-positive guard: no epoch change, no replan needed."""
+    ct = _cluster(tmp_path / "cl")
+    view = ct.join()
+    with chaos.session(seed=1) as c:
+        c.on("heartbeat.delay",
+             action=lambda ctx: 10.0 if ctx["member"] == "host1" else None,
+             times=1)
+        ct.tick()
+    after = ct.tick()
+    assert after.epoch == view.epoch
+    assert "host1" in after.members
+    assert not ct.membership_changed()
+
+
+# ---------------------------------------------------------------------------
+# streaming shard IO (schema 3)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_kill_mid_shard_previous_epoch_restorable(tmp_path):
+    """A kill during a shard-file write leaves only an orphan shard
+    directory — no manifest, so the previous checkpoint stays the newest
+    valid one, and the next save's sweep collects the debris."""
+    ct = _cluster(tmp_path / "cl")
+    ct.join()
+    ct.recover()
+    for i in range(3):
+        ct(*_batch(60 + i))
+    ct.save(1)
+    mgr = ct.trainer.manager
+    masters_before = [np.asarray(a) for a in
+                      ct.trainer.step.state.master_params]
+
+    ct(*_batch(63))
+    with chaos.session(seed=0) as c:
+        c.on("ckpt.shard_write", action="kill", after=2)
+        with pytest.raises(chaos.ChaosKilled):
+            ct.save(2)
+    # debris: some shard files for step 2, but no committed manifest
+    assert mgr.all_steps() == [1]
+    assert not resilience.os.path.exists(mgr.path_for(2))
+
+    ct2 = _cluster(tmp_path / "cl", seed=1)
+    ct2.join()
+    assert ct2.recover() == 2       # resumes from step-1 checkpoint
+    for a, b in zip(ct2.trainer.step.state.master_params, masters_before):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    # the next sharded save sweeps the orphan step-2 shard dir
+    ct2.save(2)
+    assert sorted(ct2.trainer.manager.all_steps()) == [1, 2]
+
+
+def test_streamed_restore_bitwise_equals_gathered(tmp_path):
+    """Acceptance: the streaming reshard (per-block shard reads) is
+    bitwise-equal to the gathered path on the same checkpoint, and its
+    host-bytes high-water mark is strictly below the gathered full-state
+    size."""
+    model, opt = _mlp()
+    src = make_train_step(model, opt, _loss, half_dtype=None,
+                          loss_scale=1.0,
+                          parallel=auto.Plan(dp=8, zero_stage=3,
+                                             n_devices=8))
+    src(*_batch(1))
+    src(*_batch(2))
+    mgr = CheckpointManager(str(tmp_path), keep_n=2)
+    mgr.save_sharded(5, src, epoch=9)
+    assert mgr.last_save_stats["shard_bytes_peak_host"] > 0
+
+    target_plan = auto.Plan(dp=4, zero_stage=1, n_devices=8)
+
+    # streamed: blocks assembled from only the overlapping shard files
+    m2, o2 = _mlp(seed=1)
+    streamed = make_train_step(m2, o2, _loss, half_dtype=None,
+                               loss_scale=1.0, parallel=target_plan)
+    got, extras = mgr.restore_resharded(streamed)
+    assert got == 5 and extras == {"epoch": 9}
+    stats = mgr.last_restore_stats
+    assert stats["mode"] == "streamed" and stats["schema"] == 3
+    assert stats["shard_reads"] > 0
+
+    # gathered: assemble the full host arrays, reshard_state them in
+    host, manifest = resilience.read_checkpoint_file(
+        mgr.path_for(5), return_manifest=True)
+    assert manifest["schema"] == 3
+    gathered_bytes = sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(host["state"])
+        if isinstance(x, np.ndarray))
+    m3, o3 = _mlp(seed=2)
+    gathered = make_train_step(m3, o3, _loss, half_dtype=None,
+                               loss_scale=1.0, parallel=target_plan)
+    gathered.state = resilience.reshard_state(host["state"],
+                                              gathered.state)
+
+    for a, b in zip(jax.tree_util.tree_leaves(streamed.state),
+                    jax.tree_util.tree_leaves(gathered.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restore never materialized the full state on this host
+    assert 0 < stats["peak_host_bytes"] < gathered_bytes
+
+    # resumed steps agree bitwise too
+    np.testing.assert_array_equal(float(streamed(*_batch(3))),
+                                  float(gathered(*_batch(3))))
+
+
+def test_reshard_layout_identical_fast_path_is_zero_copy():
+    """Live source arrays whose sharding already matches the target pass
+    through reshard_state AS-IS — the identical buffers, no host
+    round-trip (the eager cousin of the streaming-restore block reads)."""
+    plan = auto.Plan(dp=4, zero_stage=1, n_devices=8)
+    model, opt = _mlp()
+    a = make_train_step(model, opt, _loss, half_dtype=None,
+                        loss_scale=1.0, parallel=plan)
+    a(*_batch(7))
+    m2, o2 = _mlp(seed=1)
+    b = make_train_step(m2, o2, _loss, half_dtype=None,
+                        loss_scale=1.0, parallel=plan)
+    out = resilience.reshard_state(a.state, b.state)
+    for src, got in zip(jax.tree_util.tree_leaves(a.state),
+                        jax.tree_util.tree_leaves(out)):
+        if isinstance(src, jax.Array):
+            assert got is src
+
+
+# ---------------------------------------------------------------------------
+# heterogeneity-aware planning
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_fleet_shares_sum_and_slowest_member_bound():
+    fleet = auto.parse_fleet("cpu:3+cpu*0.5:1")
+    assert fleet.n_devices == 4 and fleet.heterogeneous
+    assert fleet.name() == "cpu:3+cpu*0.5:1"
+
+    model, opt = _mlp()
+    rep = auto.plan_training(model, opt, _loss, _batch(0, b=8),
+                             fleet=fleet)
+    assert rep.best is not None and rep.fleet is fleet
+    for p in rep.ranked:
+        # heterogeneous fleets are dp-only: stragglers absorbed by batch
+        # shares, never by layer shards
+        assert p.tp == 1 and p.sp == 1
+        assert sum(p.device_shares) == 8
+        assert len(p.device_shares) == p.dp
+    assert any("heterogeneous fleets are dp-only" in reason
+               for _, reason in rep.rejected)
+
+    dp4 = [p for p in rep.ranked if p.dp == 4]
+    assert dp4, "no dp=4 plan feasible on the mixed fleet"
+    shares = dp4[0].device_shares
+    # the half-speed straggler gets the smallest share
+    assert shares[3] == min(shares) and shares[3] < shares[0]
+
+    # uniform split is bound by the straggler; weighted shares beat it
+    prof = rep.profile
+    ms_w, bd_w, _, _ = auto.predict_time_fleet(dp4[0], prof, fleet, 8)
+    ms_u, bd_u, _, _ = auto.predict_time_fleet(dp4[0], prof, fleet, 8,
+                                               shares=(2, 2, 2, 2))
+    assert ms_w < ms_u
+    assert dict(bd_u)["bound_member"] == 3.0
+
+
+def test_fleet_predicted_order_matches_measured_order():
+    """Ground the slowest-member model in a REAL measured step: time an
+    actual dp step on the CPU mesh, derive each member's time as
+    measured-per-sample × share ÷ declared speed, and check the
+    planner's predicted ordering (weighted shares beat uniform) is the
+    measured ordering."""
+    fleet = auto.parse_fleet("cpu:3+cpu*0.5:1")
+    model, opt = _mlp()
+    rep = auto.plan_training(model, opt, _loss, _batch(0, b=8),
+                             fleet=fleet)
+    dp4 = [p for p in rep.ranked if p.dp == 4][0]
+    weighted = dp4.device_shares
+    uniform = (2, 2, 2, 2)
+    scales = (1.0, 1.0, 1.0, 0.5)
+
+    ms_w = auto.predict_time_fleet(dp4, rep.profile, fleet, 8)[0]
+    ms_u = auto.predict_time_fleet(dp4, rep.profile, fleet, 8,
+                                   shares=uniform)[0]
+
+    step = make_train_step(model, opt, _loss, half_dtype=None,
+                           loss_scale=1.0,
+                           parallel=auto.Plan(dp=4, n_devices=4),
+                           devices=jax.devices()[:4])
+    x, y = _batch(3, b=8)
+    step(x, y)                              # compile
+    t0 = time.perf_counter()
+    for _ in range(3):
+        step(x, y)
+    per_sample_s = (time.perf_counter() - t0) / 3 / 8
+    assert per_sample_s > 0
+
+    def makespan(shares):
+        return max(per_sample_s * s / sc for s, sc in zip(shares, scales))
+
+    measured = {"weighted": makespan(weighted),
+                "uniform": makespan(uniform)}
+    predicted = {"weighted": ms_w, "uniform": ms_u}
+    assert (predicted["weighted"] < predicted["uniform"]) == \
+        (measured["weighted"] < measured["uniform"])
+    assert measured["weighted"] < measured["uniform"]
+
+
+def test_cluster_trainer_heterogeneous_fleet_from_member_specs(tmp_path):
+    """host_scales declare a straggler host; its registered spec flows
+    through the KV into fleet_for_members, and the recovered plan
+    carries per-device batch shares summing to the global batch."""
+    ct = _cluster(tmp_path / "cl", n_hosts=4,
+                  host_scales=[1.0, 1.0, 1.0, 0.5])
+    view = ct.join()
+    fleet = fleet_for_members(ct.kv, view.members)
+    assert fleet.n_devices == 8 and fleet.heterogeneous
+    assert "cpu*0.5" in fleet.name()
+
+    ct.recover()
+    plan = ct.plan
+    assert plan.dp == 8 and len(plan.device_shares) == 8
+    assert sum(plan.device_shares) == BATCH
+    # the straggler host's two devices carry the smallest shares
+    assert plan.device_shares[6] == min(plan.device_shares)
+    assert plan.device_shares[6] < plan.device_shares[0]
+    assert plan.device_shares[6] == plan.device_shares[7]
+    assert np.isfinite(float(ct(*_batch(1))))
+
+
+# ---------------------------------------------------------------------------
+# real OS processes over FileKV
+# ---------------------------------------------------------------------------
+
+
+def test_spawned_member_process_joins_and_is_detected_lost(tmp_path):
+    """spawn_member_process heartbeats over a FileKV from a REAL child
+    process; a coordinator in this process admits it, then detects the
+    loss when the child's beats run out."""
+    kv = FileKV(str(tmp_path / "kv"))
+    proc = spawn_member_process(str(tmp_path / "kv"), "proc0",
+                                interval_s=0.05, beats=30,
+                                spec='{"chip": "cpu", "n_devices": 1}')
+    try:
+        coord = Coordinator(kv, deadline_s=1.0, miss_threshold=2)
+        deadline = time.monotonic() + 120.0     # child pays jax import
+        view = None
+        while time.monotonic() < deadline:
+            view = coord.scan()
+            if "proc0" in view.members:
+                break
+            time.sleep(0.2)
+        assert view is not None and "proc0" in view.members
+        assert proc.wait(timeout=60.0) == 0     # beats run out, clean exit
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            view = coord.scan()
+            if "proc0" not in view.members:
+                break
+            time.sleep(0.3)
+        assert "proc0" not in view.members
+        assert view.epoch >= 2
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

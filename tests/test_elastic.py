@@ -1,8 +1,10 @@
-"""Elastic training end to end (ROADMAP item 3): schema-2 manifests
-with sharding layout + plan identity, cross-plan reshard-on-restore
-(ZeRO-3 dp8 → dp2×tp4, masters bit-exact), and the chaos-driven
+"""Elastic training end to end (ROADMAP item 3): schema-3 manifests
+with sharding layout + plan identity + per-shard file streaming,
+cross-plan reshard-on-restore (ZeRO-3 dp8 → dp2×tp4, masters
+bit-exact), legacy schema-1/2 compatibility, and the chaos-driven
 preempt→shrink→replan→resume→regrow cycle on the 8-CPU-device mesh."""
 import pickle
+import warnings
 import zlib
 
 import jax
@@ -94,11 +96,11 @@ def test_device_loss_hook_explicit_list_and_validation():
 
 
 # ---------------------------------------------------------------------------
-# schema 2 manifest: layout + plan metadata, legacy compat
+# schema 3 manifest: layout + plan metadata + shard streaming, legacy compat
 # ---------------------------------------------------------------------------
 
 
-def test_manifest_v2_records_layout_and_plan(tmp_path):
+def test_manifest_v3_records_layout_plan_and_streaming(tmp_path):
     model, opt = _mlp()
     plan = auto.Plan(dp=8, zero_stage=3, n_devices=8)
     step = make_train_step(model, opt, _loss, half_dtype=None,
@@ -109,7 +111,7 @@ def test_manifest_v2_records_layout_and_plan(tmp_path):
 
     comps, manifest = resilience.read_checkpoint_file(
         mgr.path_for(0), return_manifest=True)
-    assert manifest["schema"] == 2
+    assert manifest["schema"] == 3
     assert manifest["plan"]["key"] == list(plan.key())
     assert manifest["plan"]["zero_stage"] == 3
     assert manifest["plan"]["n_devices"] == 8
@@ -126,10 +128,22 @@ def test_manifest_v2_records_layout_and_plan(tmp_path):
     # schema-1 integrity fields unchanged
     meta = manifest["components"]["state"]
     assert meta["nbytes"] > 0 and isinstance(meta["crc32"], int)
-    # non-array components carry no layout
+    # non-array components carry no layout (and no shard files)
     assert "layout" not in manifest["components"]["epoch"]
+    assert "streamed" not in manifest["components"]["epoch"]
     assert comps["epoch"] == 3
-    # the payload stores GATHERED full arrays, not shards
+    # schema 3: the state's bytes live in per-shard files under
+    # ckpt_<step>.shards/, and the manifest's "streamed" entry is how
+    # the reader resolves them
+    streamed = manifest["components"]["state"]["streamed"]
+    sdir = mgr.shard_dir_for(0)
+    assert streamed["dir"] == resilience.os.path.basename(sdir)
+    first = next(m for m in streamed["leaves"] if m is not None)
+    for sh in first["shards"]:
+        assert resilience.os.path.exists(
+            resilience.os.path.join(sdir, sh["file"]))
+    # ... while read_checkpoint_file still hands back full host arrays
+    # (assembled from the shard files)
     host = comps["state"]
     assert host.master_params[0].shape == \
         tuple(step.state.master_params[0].shape)
@@ -154,7 +168,7 @@ def test_schema1_roundtrip_and_elastic_warning(tmp_path):
     """Backward compat both ways: a schema-1 checkpoint still loads via
     restore_or_initialize with no warning, restores elastically with a
     'predates sharding metadata' warning, and a fresh save through the
-    same manager writes schema 2."""
+    same manager writes the current schema."""
     mgr = CheckpointManager(str(tmp_path), keep_n=3)
     model, opt = _mlp()
     step = make_train_step(model, opt, _loss, half_dtype=None,
@@ -180,7 +194,73 @@ def test_schema1_roundtrip_and_elastic_warning(tmp_path):
     mgr.save(8, state=host)
     _, manifest = resilience.read_checkpoint_file(mgr.path_for(8),
                                                   return_manifest=True)
-    assert manifest["schema"] == 2
+    assert manifest["schema"] == resilience.SCHEMA_VERSION
+
+
+def _write_schema2(path, components, layouts=None, plan=None):
+    """A byte-accurate schema-2 container (layout + plan metadata,
+    gathered full-array payloads, no shard streaming), as the previous
+    release wrote them."""
+    components = {k: resilience._to_host(v) for k, v in components.items()}
+    payload = {k: pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
+               for k, v in components.items()}
+    comp_meta = {}
+    for k, b in payload.items():
+        comp_meta[k] = {"crc32": zlib.crc32(b), "nbytes": len(b)}
+        if layouts and layouts.get(k) is not None:
+            comp_meta[k]["layout"] = layouts[k]
+    manifest = {"schema": 2, "components": comp_meta}
+    if plan is not None:
+        manifest["plan"] = resilience._plan_meta(plan)
+    blob = pickle.dumps({"__apex_tpu_checkpoint__": 2,
+                         "manifest": manifest, "payload": payload})
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+def test_schema2_gathered_restore_and_resave_upgrade(tmp_path):
+    """Pre-streaming compat: a schema-2 checkpoint (gathered full
+    arrays, no shard files) still restores elastically — through the
+    gathered reshard path, with a 'predates shard streaming' warning —
+    and a re-save through the same manager upgrades it to the schema-3
+    per-shard layout."""
+    model, opt = _mlp()
+    plan = auto.Plan(dp=8, zero_stage=1, n_devices=8)
+    step = make_train_step(model, opt, _loss, half_dtype=None,
+                           loss_scale=1.0, parallel=plan)
+    step(*_batch(1))
+    mgr = CheckpointManager(str(tmp_path), keep_n=3)
+    layouts = {"state": resilience.capture_layout(step.state)}
+    _write_schema2(mgr.path_for(4), {"state": step.state, "epoch": 2},
+                   layouts=layouts, plan=plan)
+
+    model2, opt2 = _mlp(seed=1)
+    step2 = make_train_step(model2, opt2, _loss, half_dtype=None,
+                            loss_scale=1.0, parallel=plan)
+    with pytest.warns(UserWarning, match="predates shard streaming"):
+        got, extras = mgr.restore_resharded(step2, step=4)
+    assert got == 4 and extras == {"epoch": 2}
+    assert mgr.last_restore_stats["mode"] == "gathered"
+    assert mgr.last_restore_stats["schema"] == 2
+    for a, b in zip(step2.state.master_params, step.state.master_params):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # re-save: same manager, same state — now schema 3, shard-streamed
+    mgr.save_sharded(5, step2, epoch=2)
+    _, manifest = resilience.read_checkpoint_file(mgr.path_for(5),
+                                                  return_manifest=True)
+    assert manifest["schema"] == 3
+    assert manifest["components"]["state"]["streamed"] is not None
+    import os as _os
+    assert _os.path.isdir(mgr.shard_dir_for(5))
+    # and the upgraded copy streams on the next restore — no warning
+    model3, opt3 = _mlp(seed=2)
+    step3 = make_train_step(model3, opt3, _loss, half_dtype=None,
+                            loss_scale=1.0, parallel=plan)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert mgr.restore_resharded(step3, step=5)[0] == 5
+    assert mgr.last_restore_stats["mode"] == "streamed"
 
 
 # ---------------------------------------------------------------------------

@@ -38,6 +38,7 @@ RULE_FIXTURES = {
     "TRACER-LEAK": "tracer_leak",
     "SHAPE-BRANCH": "shape_branch",
     "STALE-SUPPRESSION": "stale_suppression",
+    "CLUSTER-ASSUME": "cluster_assume",
 }
 
 
@@ -57,7 +58,7 @@ def _run(paths, **kw):
 
 def test_registry_covers_required_rules():
     assert set(RULE_FIXTURES) <= set(rules.rule_ids())
-    assert len(rules.rule_ids()) >= 16
+    assert len(rules.rule_ids()) >= 17
 
 
 @pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
