@@ -1,9 +1,13 @@
 """Inference-side utilities: weight-only int8 quantization for the
 bandwidth-bound decode path (quant.py), draft-verified greedy
-speculative decoding (speculative.py), beam search (beam.py), the
-rolling sliding-window KV cache (rolling.py), and stateful multi-turn
-decode sessions (session.py)."""
+speculative decoding (speculative.py) with draft construction and
+distillation (draft.py), beam search (beam.py), the rolling
+sliding-window KV cache (rolling.py), and stateful multi-turn decode
+sessions (session.py).  This surface is the package boundary: the
+serve engine consumes speculation through these names, never through
+module internals."""
 from .beam import beam_generate  # noqa: F401
+from .draft import make_self_draft, train_draft  # noqa: F401
 from .session import DecodeSession, PagedSession  # noqa: F401
 from .quant import (QuantKV, QuantTensor, absmax_int8,  # noqa: F401
                     gather_rows, kv_value, kv_write, make_kv_cache,

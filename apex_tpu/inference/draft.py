@@ -1,0 +1,86 @@
+"""Draft models for speculative decoding: construction + distillation.
+
+A draft model's only job is agreeing with the target's greedy argmax —
+acceptance rate is the single quality axis (speculative decoding is
+exact for ANY draft; see speculative.py).  Two entry points:
+
+* :func:`make_self_draft` — an exact copy of the target.  Acceptance
+  is 100% by construction, which makes it the measurement fixture: the
+  CPU tier-1 speculative arm pins its >= 2 tokens/tick floor on a
+  self-draft trace, isolating the verify machinery's overhead from
+  draft quality.  (In production a self-draft is pointless — it costs
+  as much as the target — but a QUANTIZED self-draft is not: serve the
+  copy int8 weight-only and its decode is cheaper while acceptance
+  stays near-perfect.)
+* :func:`train_draft` — hard-label distillation of a small draft
+  toward the target's own argmax stream, the label the acceptance test
+  actually applies.  Runs through the standard fused train step
+  (:func:`apex_tpu.training.step.make_train_step` + ``FusedAdam``), so
+  draft training inherits the runtime's compile-once discipline.
+
+``apex_tpu.serve`` consumes drafts only through this module and
+:func:`~apex_tpu.inference.speculative.speculative_generate`'s public
+surface — the serve engine never reaches into speculative.py
+internals.
+"""
+from __future__ import annotations
+
+import copy
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["make_self_draft", "train_draft"]
+
+
+def make_self_draft(target):
+    """An independent deep copy of ``target`` in eval mode — the
+    full-acceptance draft (see module docstring for when that is
+    useful).  The copy shares nothing with the original: serving it
+    from its own (typically int8) KV pool or quantizing its weights
+    never touches the target."""
+    draft = copy.deepcopy(target)
+    draft.eval()
+    return draft
+
+
+def train_draft(draft, target, tokens, *, steps=50, batch_size=8,
+                seq_len=32, lr=1e-3, seed=0):
+    """Distill ``draft`` toward ``target``'s greedy labels over a token
+    stream.
+
+    ``tokens`` is a flat 1-D id array (any corpus sample); each step
+    draws ``batch_size`` random ``seq_len`` windows, labels every
+    position with the TARGET's argmax next-token prediction (hard-label
+    distillation — exactly the event the acceptance rule tests), and
+    takes one fused train step on the draft.  Returns the per-step loss
+    list (monitoring only; the metric that matters is the acceptance
+    rate the served draft achieves).
+    """
+    from .. import nn as _nn
+    from ..optimizers.fused_adam import FusedAdam
+    from ..training.step import make_train_step
+
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    if tokens.size < seq_len + 1:
+        raise ValueError(
+            f"train_draft needs at least seq_len+1={seq_len + 1} "
+            f"tokens, got {tokens.size}")
+    target.eval()
+    draft.train()
+    opt = FusedAdam(list(draft.parameters()), lr=lr)
+    step = make_train_step(
+        draft, opt,
+        lambda o, t: _nn.functional.cross_entropy(
+            o.reshape((-1, o.shape[-1])), t.reshape((-1,))))
+    rng = np.random.default_rng(seed)
+    losses = []
+    for _ in range(int(steps)):
+        starts = rng.integers(0, tokens.size - seq_len, size=batch_size)
+        xs = np.stack([tokens[s:s + seq_len] for s in starts])
+        labels = np.argmax(
+            np.asarray(target(jnp.asarray(xs))), -1).astype(np.int32)
+        loss = step(jnp.asarray(xs), jnp.asarray(labels))
+        losses.append(float(loss))
+    draft.eval()
+    return losses

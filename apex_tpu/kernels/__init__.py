@@ -55,6 +55,7 @@ from . import xentropy  # noqa: F401
 from . import lm_head_xent  # noqa: F401
 from . import multi_tensor  # noqa: F401
 from . import vocab_chain  # noqa: F401
+from . import spec_verify  # noqa: F401
 
 from .multi_tensor import (  # noqa: F401
     fused_adam,

@@ -9,6 +9,7 @@ resolve is dropped, never guessed, so a finding is worth reading.
 from __future__ import annotations
 
 import ast
+import re
 import time
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
@@ -1106,10 +1107,19 @@ class ExecBypass(Rule):
 
 #: the serving program kinds (runtime/executor.py SERVE_KINDS) — string
 #: literals only; a kind the rule cannot resolve is not guessed
-_SERVE_PROGRAM_KINDS = {"prefill_step", "decode_step"}
+_SERVE_PROGRAM_KINDS = {"prefill_step", "decode_step",
+                        "draft_prefill_step", "spec_verify_step"}
 
 #: attribute reads that surface a request-dependent extent
 _SHAPE_ATTRS = {"shape", "size", "ndim"}
+
+#: identifiers carrying a speculative tick's ragged acceptance count —
+#: 1..k+1 per sequence per tick, the most request-dependent extent in
+#: the serve path.  Matched by name because the value is a plain host
+#: int by the time it could steer a program (``n_acc``, ``accepted_len``
+#: and the like); routing through ``bucket*`` launders it exactly like
+#: any other extent.
+_ACCEPT_NAME_RE = re.compile(r"accept|(^|_)n_acc(_|$)")
 
 
 def _serve_kind_of(call: ast.Call) -> Optional[str]:
@@ -1144,34 +1154,44 @@ class ServeShape(Rule):
     and block-table lengths; the step cache keys programs by (kind,
     static_key, operand signature).  Let a raw per-request extent —
     ``len(prompt)``, ``tokens.shape``, ``len(table)`` — reach a
-    ``prefill_step`` / ``decode_step`` static key (or steer which
-    program gets built) and every distinct request length compiles a
+    serve-kind static key (``prefill_step`` / ``decode_step`` /
+    ``draft_prefill_step`` / ``spec_verify_step``) or steer which
+    program gets built, and every distinct request length compiles a
     fresh executable: recompilation scales with TRAFFIC, not with
     config, and tail latency spikes exactly when load does.  The serve
     engine's discipline is a bucket table: every dynamic extent is
     rounded up through ``serve.scheduler.bucket`` (powers of two capped
     at the config maximum) before it touches program identity, so the
     shape set is ``O(log·log)`` and decode is recompile-free after
-    warmup.  Flags, on serve-kind ``Program(...)`` constructions:
-    ``len(...)`` / ``.shape`` / ``.size`` / ``.ndim`` inside the static
-    key unless routed through a ``bucket*`` call, and ``if``/``while``
-    tests on those extents inside the functions that build the
-    programs (per-request program selection is the same recompile
-    surface by another route).
+    warmup.  Speculative decoding adds the worst extent of all: the
+    per-tick ragged acceptance count (``n_acc``/``accepted_len``, 1..k+1
+    PER SEQUENCE PER TICK) — key or steer a program on it raw and the
+    engine recompiles mid-stream on the first tick whose acceptance
+    pattern is new (the PR 16 incident; docs/lint.md).  Flags, on
+    serve-kind ``Program(...)`` constructions: ``len(...)`` /
+    ``.shape`` / ``.size`` / ``.ndim`` / acceptance-count identifiers
+    inside the static key unless routed through a ``bucket*`` call,
+    and ``if``/``while`` tests on those extents inside the functions
+    that build the programs (per-request program selection is the same
+    recompile surface by another route).
     """
     id = "SERVE-SHAPE"
     summary = ("request-dependent shape in a serving program key / "
                "build path (recompiles per request, not per bucket)")
-    hint = ("round every request-dependent extent through the bucket "
-            "table (serve.scheduler.bucket: next power of two, capped "
-            "at the config maximum) before it reaches a Program static "
-            "key or build-time branch — operand signatures then "
+    hint = ("round every request-dependent extent — lengths, shapes, "
+            "and speculative acceptance counts alike — through the "
+            "bucket table (serve.scheduler.bucket: next power of two, "
+            "capped at the config maximum) before it reaches a Program "
+            "static key or build-time branch — operand signatures then "
             "complete the cache key and decode re-hits after warmup; "
-            "see docs/serving.md's keying discipline")
+            "ragged acceptance belongs in operand VALUES (the host "
+            "commit loop), never in program identity; see "
+            "docs/serving.md's keying discipline")
 
     def _dynamic_exprs(self, expr):
-        """``len()`` calls and ``.shape``/``.size``/``.ndim`` reads in
-        ``expr`` that are NOT routed through a ``bucket*`` call —
+        """``len()`` calls, ``.shape``/``.size``/``.ndim`` reads, and
+        acceptance-count identifiers (``n_acc``/``accepted_len``/...)
+        in ``expr`` that are NOT routed through a ``bucket*`` call —
         descent stops at any call whose name contains ``bucket``: its
         result is by construction one of O(log) values."""
         stack = [expr]
@@ -1187,6 +1207,10 @@ class ServeShape(Rule):
             if isinstance(node, ast.Attribute) and \
                     node.attr in _SHAPE_ATTRS:
                 yield node, f".{node.attr}"
+                continue
+            if isinstance(node, ast.Name) and \
+                    _ACCEPT_NAME_RE.search(node.id):
+                yield node, f"raw acceptance count '{node.id}'"
                 continue
             stack.extend(ast.iter_child_nodes(node))
 

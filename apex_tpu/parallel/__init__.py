@@ -28,8 +28,9 @@ from .expert_parallel import switch_moe  # noqa: F401
 from .zero import ZeroTrainStep, zero_state_sharding  # noqa: F401
 from . import auto  # noqa: F401
 from .auto import (  # noqa: F401
-    ChipSpec, Fleet, ModelProfile, Plan, PlanReport, chip_spec,
-    parse_fleet, plan_training, profile_model)
+    ChipSpec, Fleet, ModelProfile, Plan, PlanReport, ServePhaseSplit,
+    chip_spec, parse_fleet, plan_serve_phase_split, plan_training,
+    profile_model)
 
 
 def convert_syncbn_model(module, process_group=None, channel_last=False,

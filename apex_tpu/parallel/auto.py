@@ -246,6 +246,67 @@ def apportion_shares(weights, total: int) -> Tuple[int, ...]:
 
 
 # ---------------------------------------------------------------------------
+# Serve phase split — disaggregated prefill/decode placement
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ServePhaseSplit:
+    """Device assignment for a disaggregated serving deployment
+    (:class:`apex_tpu.serve.DisaggregatedEngine`): ``prefill`` /
+    ``decode`` are index tuples into the fleet's device order.  On a
+    single device the phases colocate (``colocated=True``, both tuples
+    ``(0,)``) — that is the unified engine, not a degenerate split."""
+
+    prefill: Tuple[int, ...]
+    decode: Tuple[int, ...]
+    colocated: bool
+    reason: str
+
+    def name(self) -> str:
+        if self.colocated:
+            return "colocated"
+        return f"prefill:{len(self.prefill)}+decode:{len(self.decode)}"
+
+
+def plan_serve_phase_split(fleet=None, *, prefill_weight: float = 1.0,
+                           decode_weight: float = 1.0) -> ServePhaseSplit:
+    """Split a (possibly heterogeneous) fleet between the two serving
+    phases.  Phase demands are opposite corners of the roofline:
+    prefill is one wide compute-bound matmul over the prompt (ranked by
+    ``sustained_flops``), decode re-reads the whole KV cache per token
+    (ranked by ``hbm_bw``) — so in a mixed fleet the members with the
+    most HBM bandwidth per unit compute go to decode and the
+    biggest-MXU members to prefill.  Phase sizes come from
+    :func:`apportion_shares` over the declared demand weights (tokens
+    of prefill vs decode work per request, roughly prompt length vs
+    ``max_new_tokens``), clamped so each phase keeps at least one
+    device."""
+    flt = _fleet_of(fleet)
+    if flt is None:
+        flt = Fleet(specs=(chip_spec(),))
+    n = flt.n_devices
+    if n == 1:
+        return ServePhaseSplit(
+            prefill=(0,), decode=(0,), colocated=True,
+            reason="single device: phases colocated (unified engine)")
+    n_pre, n_dec = apportion_shares(
+        [float(prefill_weight), float(decode_weight)], n)
+    n_pre = max(1, min(n - 1, n_pre))
+    n_dec = n - n_pre
+    bw_per_flop = [s.hbm_bw / max(s.sustained_flops(), 1.0)
+                   for s in flt.specs]
+    order = sorted(range(n), key=lambda i: (-bw_per_flop[i], i))
+    decode_ids = tuple(sorted(order[:n_dec]))
+    prefill_ids = tuple(sorted(order[n_dec:]))
+    return ServePhaseSplit(
+        prefill=prefill_ids, decode=decode_ids, colocated=False,
+        reason=(f"{flt.name()}: decode→{n_dec} member(s) with the "
+                f"highest HBM-BW per sustained FLOP, prefill→{n_pre} "
+                f"compute-heaviest"))
+
+
+# ---------------------------------------------------------------------------
 # Model profile — XLA-measured FLOPs/activation footprint + capabilities
 # ---------------------------------------------------------------------------
 

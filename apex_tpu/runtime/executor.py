@@ -60,7 +60,8 @@ TRAIN_KINDS = frozenset({"train_step", "zero_train_step",
 #: stall watchdog guards the decode loop the same way it guards the
 #: train loop.  Unlike eager kinds there is no microbenchmarked
 #: hot path concern: a serve dispatch covers a whole batched tick.
-SERVE_KINDS = frozenset({"prefill_step", "decode_step"})
+SERVE_KINDS = frozenset({"prefill_step", "decode_step",
+                         "draft_prefill_step", "spec_verify_step"})
 
 _UNSET = object()
 

@@ -45,6 +45,17 @@ this module makes the save/resume loop survive it:
   arrays for legacy consumers.  Schema-2 files keep loading (gathered,
   with a "predates shard streaming" warning) and a re-save upgrades
   them to schema 3.
+* Serve KV-block handoff: :func:`stream_kv_handoff` /
+  :func:`load_kv_handoff` move one session's paged KV blocks between a
+  disaggregated prefill engine and a decode engine
+  (:mod:`apex_tpu.serve.disagg`) through the SAME schema-3 shard-file
+  contract — per-block files (int8 payload + fp32 scales stream as
+  separate parts), per-file CRC32, manifest commits last, one block's
+  bytes on the host at a time, ``serve.kv_handoff`` chaos hook per
+  file.  Validation splits the same way checkpoints do: partial or
+  bit-rotted handoffs raise :class:`CheckpointCorruptError` (the
+  coordinator discards and re-streams), mismatched pool geometry
+  raises :class:`CheckpointReshardError` (a config error — no retry).
 * :class:`BadStepGuard` — escalation above the ``ScalerState`` skip logic
   (`apex_tpu/amp/scaler.py`): the scaler already halves the scale and
   skips the step on overflow, silently and forever; the guard counts
@@ -66,7 +77,8 @@ init and collective-timeout wrappers.
 Every failure path is exercised in tier-1 tests through the
 :mod:`apex_tpu.runtime.chaos` hook points (``ckpt.mid_write``,
 ``ckpt.pre_rename``, ``ckpt.shard_write``, ``ckpt.reshard``,
-``train.step``, ``dist.init``, ``dist.collective``).
+``serve.kv_handoff``, ``train.step``, ``dist.init``,
+``dist.collective``).
 """
 from __future__ import annotations
 
@@ -358,6 +370,168 @@ def _assemble_tree(skeleton, streamed_meta: dict, base_dir: str,
         else:
             out.append(leaf)
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# serve KV-block handoff (disaggregated prefill/decode)
+# ---------------------------------------------------------------------------
+
+_KV_MANIFEST = "KV_MANIFEST.pkl"
+_KV_MAGIC = "__apex_tpu_kv_handoff__"
+
+
+def _pool_parts(pool):
+    """``[("kv", array)]`` for a plain pool, ``[("q", ...), ("scale",
+    ...)]`` for the int8 :class:`~apex_tpu.inference.quant.QuantKV`
+    pair — duck-typed so this module never imports serve."""
+    if hasattr(pool, "q") and hasattr(pool, "scale"):
+        return [("q", pool.q), ("scale", pool.scale)]
+    return [("kv", pool)]
+
+
+def stream_kv_handoff(dir_path: str, pool, table, *,
+                      source: str = "kv_handoff"):
+    """Stream one session's KV blocks out of a paged pool into
+    ``dir_path`` under the schema-3 shard-file contract: one file per
+    (block, pool-part) — raw ``tobytes()``, atomic tmp+fsync+rename,
+    CRC32 in the manifest — and the manifest commits LAST, so a kill
+    mid-handoff leaves debris with no manifest, never a manifest over
+    missing blocks.  The host holds ONE block's bytes at a time — KV
+    never round-trips through a gathered whole-pool (or whole-session)
+    buffer, which is the point of the disaggregated handoff path.
+
+    ``table`` is the session's physical block-id list, in logical
+    order; logical order is what the manifest records, so the loader's
+    fresh id list maps positionally.  Chaos hook ``serve.kv_handoff``
+    fires before each block file.
+
+    Returns ``(manifest, peak_bytes)`` — peak is the largest single
+    host buffer touched (the bench's ``handoff_bytes_peak_host``)."""
+    os.makedirs(dir_path, exist_ok=True)
+    parts = _pool_parts(pool)
+    blocks_meta = []
+    peak = 0
+    for logical, bid in enumerate(table):
+        entry = {}
+        for part, buf_arr in parts:
+            block = np.asarray(buf_arr[:, :, int(bid)])
+            buf = block.tobytes()
+            peak = max(peak, len(buf))
+            fname = f"kvblk{logical}_{part}.bin"
+            if _chaos.active():
+                _chaos.hook("serve.kv_handoff", dir=dir_path,
+                            file=fname, block=logical)
+            _write_shard_file(dir_path, fname, buf)
+            entry[part] = {"file": fname, "crc32": zlib.crc32(buf),
+                           "nbytes": len(buf)}
+        blocks_meta.append(entry)
+    manifest = {
+        _KV_MAGIC: SCHEMA_VERSION,
+        "kind": "kv_handoff",
+        "quant": len(parts) == 2,
+        "parts": {part: {"shape": [int(d) for d in arr.shape[:2]]
+                         + [int(d) for d in arr.shape[3:]],
+                         "dtype": str(arr.dtype)}
+                  for part, arr in parts},
+        "n_blocks": len(blocks_meta),
+        "blocks": blocks_meta,
+        "source": source,
+    }
+    _write_shard_file(dir_path, _KV_MANIFEST, pickle.dumps(manifest))
+    _fsync_dir(dir_path)
+    return manifest, peak
+
+
+def load_kv_handoff(dir_path: str, pool, new_ids):
+    """Scatter a streamed KV handoff into ``pool`` at the freshly
+    allocated physical ids ``new_ids`` (logical order — entry i of the
+    manifest lands in ``new_ids[i]``).  Bitwise: block bytes are
+    written into the destination pool verbatim, int8 payloads AND
+    their fp32 scales alike, so a handed-off session's continuation is
+    the unified engine's continuation.
+
+    Raises :class:`CheckpointCorruptError` when the handoff directory
+    is missing its manifest (a mid-handoff kill), a block file is
+    absent, or a CRC/size check fails; raises
+    :class:`CheckpointReshardError` when the manifest validates but
+    describes a different pool geometry (dtype, per-block shape, or
+    quantization) or a different block count than ``new_ids`` — that
+    is a config error, not corruption.  Returns
+    ``(new_pool, peak_bytes)``."""
+    src = os.path.join(dir_path, _KV_MANIFEST)
+    try:
+        with open(src, "rb") as f:
+            manifest = pickle.loads(f.read())
+    except FileNotFoundError as e:
+        raise CheckpointCorruptError(
+            f"{dir_path}: no KV handoff manifest (mid-handoff "
+            f"kill?)") from e
+    if not isinstance(manifest, dict) or \
+            manifest.get(_KV_MAGIC) is None or \
+            manifest.get("kind") != "kv_handoff":
+        raise CheckpointCorruptError(
+            f"{dir_path}: not a KV handoff manifest")
+    if manifest[_KV_MAGIC] > SCHEMA_VERSION:
+        raise CheckpointCorruptError(
+            f"{dir_path}: handoff schema {manifest[_KV_MAGIC]} is newer "
+            f"than this reader ({SCHEMA_VERSION})")
+    parts = _pool_parts(pool)
+    if manifest["quant"] != (len(parts) == 2):
+        raise CheckpointReshardError(
+            f"{dir_path}: handoff quant={manifest['quant']} but the "
+            f"destination pool is "
+            f"{'int8' if len(parts) == 2 else 'dense'}")
+    for part, arr in parts:
+        meta = manifest["parts"][part]
+        want = [int(d) for d in arr.shape[:2]] \
+            + [int(d) for d in arr.shape[3:]]
+        if meta["shape"] != want or meta["dtype"] != str(arr.dtype):
+            raise CheckpointReshardError(
+                f"{dir_path}: handoff part {part!r} is "
+                f"{meta['shape']}/{meta['dtype']}, destination pool "
+                f"block is {want}/{arr.dtype} — pools must share "
+                f"geometry (layers/heads/block_size/head_dim/dtype)")
+    new_ids = list(new_ids)
+    if len(new_ids) != manifest["n_blocks"]:
+        raise CheckpointReshardError(
+            f"{dir_path}: handoff carries {manifest['n_blocks']} "
+            f"blocks, caller allocated {len(new_ids)}")
+    peak = 0
+    out = {part: arr for part, arr in parts}
+    for logical, entry in enumerate(manifest["blocks"]):
+        nid = int(new_ids[logical])
+        for part, arr in list(out.items()):
+            meta = entry[part]
+            path = os.path.join(dir_path, meta["file"])
+            try:
+                with open(path, "rb") as f:
+                    buf = f.read()
+            except FileNotFoundError as e:
+                raise CheckpointCorruptError(
+                    f"{dir_path}: missing handoff block file "
+                    f"{meta['file']!r} (partial handoff "
+                    f"directory?)") from e
+            if len(buf) != meta["nbytes"] or \
+                    zlib.crc32(buf) != meta["crc32"]:
+                raise CheckpointCorruptError(
+                    f"{dir_path}: handoff block file {meta['file']!r} "
+                    f"failed checksum validation")
+            peak = max(peak, len(buf))
+            block_shape = arr.shape[:2] + arr.shape[3:]
+            block = np.frombuffer(
+                buf, dtype=arr.dtype).reshape(block_shape)
+            out[part] = out[part].at[:, :, nid].set(block)
+    if len(parts) == 2:
+        new_pool = type(pool)(out["q"], out["scale"])
+    else:
+        new_pool = out["kv"]
+    return new_pool, peak
+
+
+def discard_kv_handoff(dir_path: str) -> None:
+    """Remove a handoff directory — after a successful ingest, or to
+    clear partial debris before a retry."""
+    shutil.rmtree(dir_path, ignore_errors=True)
 
 
 def serialize_checkpoint(components: dict, *, to_host: bool = True,

@@ -3,18 +3,23 @@
 The multi-tenant leg of the decode stack: thousands of sessions share
 one preallocated HBM block pool (:mod:`pool`), a continuous-batching
 scheduler re-packs the live set every tick (:mod:`scheduler`), and the
-engine (:mod:`engine`) dispatches two program kinds —
-``prefill_step`` / ``decode_step`` — through the one-runtime executor,
-inheriting its step-cache keying, dispatch spans, donation policy and
-watchdog heartbeats.  Shape discipline (bucketed operands, traced
-request state) is enforced by the SERVE-SHAPE lint rule; see
-docs/serving.md.
+engine (:mod:`engine`) dispatches a small family of program kinds —
+``prefill_step`` / ``decode_step`` / ``draft_prefill_step`` /
+``spec_verify_step`` — through the one-runtime executor, inheriting
+its step-cache keying, dispatch spans, donation policy and watchdog
+heartbeats.  :mod:`disagg` splits the engine into a prefill phase and
+a decode phase (optionally speculative, with a draft model served
+int8 from its own pool) joined by the schema-3 streamed KV handoff.
+Shape discipline (bucketed operands, traced request state) is
+enforced by the SERVE-SHAPE lint rule; see docs/serving.md.
 """
+from .disagg import DisaggregatedEngine
 from .engine import ServeEngine
 from .pool import BlockPool, NULL_BLOCK, blocks_for, init_pool_buffer
 from .scheduler import Request, Scheduler, Session, bucket
 
 __all__ = [
-    "ServeEngine", "Request", "Scheduler", "Session", "bucket",
-    "BlockPool", "NULL_BLOCK", "blocks_for", "init_pool_buffer",
+    "DisaggregatedEngine", "ServeEngine", "Request", "Scheduler",
+    "Session", "bucket", "BlockPool", "NULL_BLOCK", "blocks_for",
+    "init_pool_buffer",
 ]

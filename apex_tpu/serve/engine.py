@@ -45,18 +45,26 @@ from typing import Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from ..kernels.dispatch import decide as _decide
+from ..kernels.spec_verify import spec_verify_fp
 from ..models.gpt import _sharded_decode_axes
 from ..observe import registry as _obs
 from ..observe import watchdog as _watchdog
 from ..runtime import executor as _executor
 from . import kernels as _kernels
-from .pool import BlockPool, init_pool_buffer
+from .pool import BlockPool, blocks_for, init_pool_buffer
 from .scheduler import DECODE, Request, Scheduler, Session, bucket
 
 #: per-engine token in the serve program static keys — two engines over
 #: identically-shaped models must never share a cache entry (their
 #: program closures hold different parameter objects)
 _SERVE_TOKENS = itertools.count()
+
+#: engine roles in a disaggregated deployment (serve/disagg.py): the
+#: phase joins every serve program's static key, so a prefill engine
+#: and a decode engine over the same weights never collide in the step
+#: cache even when their geometry matches
+PHASES = ("unified", "prefill", "decode")
 
 
 class ServeEngine:
@@ -72,12 +80,18 @@ class ServeEngine:
 
     def __init__(self, model, *, num_blocks, block_size=16, max_batch=8,
                  prefill_chunk=32, cache_dtype=None,
-                 max_prefill_backlog=None, window=None):
+                 max_prefill_backlog=None, window=None, phase="unified",
+                 draft=None, spec_k=4, draft_cache_dtype="int8",
+                 spec_policy="on"):
         self._validate_model(model)
+        if phase not in PHASES:
+            raise ValueError(f"phase must be one of {PHASES}, got "
+                             f"{phase!r}")
         self.model = model
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
         self.window = window
+        self._phase = phase
         blk0 = model.blocks[0]
         self._params = list(model.parameters()) + list(model.buffers())
         dtype = cache_dtype if cache_dtype is not None \
@@ -88,19 +102,51 @@ class ServeEngine:
             len(model.blocks), blk0.attn.num_heads, blk0.attn.head_dim,
             self.num_blocks, self.block_size, dtype)
         self.block_pool = BlockPool(self.num_blocks, self.block_size)
+        # -- speculative mode: a draft model served from its OWN pool
+        # buffer (int8 by default — weight-only drafts are bandwidth
+        # bound) whose block ids come from the SAME BlockPool free-list
+        self.spec = draft is not None
+        self.draft = draft
+        self.spec_k = int(spec_k)
+        self._spec_policy = spec_policy
+        self._d_params: List = []
+        self._d_dtype_name = None
+        self.dpool = None
+        if self.spec:
+            self._validate_spec(model, draft, window, self.spec_k,
+                                spec_policy)
+            dblk0 = draft.blocks[0]
+            self._d_params = list(draft.parameters()) \
+                + list(draft.buffers())
+            d_dtype = draft_cache_dtype if draft_cache_dtype is not None \
+                else draft.tok_emb.weight.data.dtype
+            self._d_dtype_name = d_dtype if isinstance(d_dtype, str) \
+                else jnp.dtype(d_dtype).name
+            self.dpool = init_pool_buffer(
+                len(draft.blocks), dblk0.attn.num_heads,
+                dblk0.attn.head_dim, self.num_blocks, self.block_size,
+                d_dtype)
         if max_prefill_backlog is None:
             max_prefill_backlog = 4 * prefill_chunk
         self.scheduler = Scheduler(
             self.block_pool, max_batch=max_batch,
             prefill_chunk=prefill_chunk,
             max_prefill_backlog=max_prefill_backlog,
-            max_positions=model.max_positions)
+            max_positions=model.max_positions,
+            spec_tables=self.spec,
+            pos_slack=self.spec_k if self.spec else 0)
         self._token = next(_SERVE_TOKENS)
         self._donate = _executor.donation.enabled
         self._decode_prog = None
         self._prefill_prog = None
+        self._draft_prefill_prog = None
+        self._spec_prog = None
         self._dispatch_no = itertools.count(1)
         self._tick = 0
+        self._spec_ticks = 0
+        self._spec_committed = 0
+        self._spec_offered = 0
+        self._spec_accepted = 0
         self.results: Dict[str, List[int]] = {}
 
     @staticmethod
@@ -132,6 +178,30 @@ class ServeEngine:
                 f"ServeEngine runs single-shard; the model was built "
                 f"with {names}")
 
+    def _validate_spec(self, model, draft, window, spec_k, spec_policy):
+        self._validate_model(draft)
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if window is not None:
+            raise NotImplementedError(
+                "speculative mode + sliding window: the verify chunk "
+                "would need a per-row band mask over retired blocks — "
+                "serve one mode or the other")
+        if spec_policy not in ("on", "auto"):
+            raise ValueError(
+                f"spec_policy must be 'on' (always speculate) or "
+                f"'auto' (decide() per bucket shape), got "
+                f"{spec_policy!r}")
+        if draft.tok_emb.weight.shape[0] < model.tok_emb.weight.shape[0]:
+            raise ValueError(
+                "draft vocabulary is smaller than the target's — "
+                "verified tokens could not be re-fed to the draft")
+        if draft.max_positions < model.max_positions:
+            raise ValueError(
+                f"draft.max_positions {draft.max_positions} < target's "
+                f"{model.max_positions}: the draft cache must cover "
+                f"every position the target can reach")
+
     # -- programs ----------------------------------------------------------
     # One Program instance per kind: operand shapes (bucketed batch /
     # blocks / chunk) complete the step-cache key through the argument
@@ -139,8 +209,8 @@ class ServeEngine:
 
     def _programs(self):
         if self._decode_prog is None:
-            key = (self._token, self.block_size, self._dtype_name,
-                   self.window, self._donate)
+            key = (self._token, self._phase, self.block_size,
+                   self._dtype_name, self.window, self._donate)
             self._decode_prog = _executor.Program(
                 "decode_step", key,
                 _kernels.build_decode_fn(
@@ -155,8 +225,31 @@ class ServeEngine:
                 donate_argnums=(1,) if self._donate else ())
         return self._prefill_prog, self._decode_prog
 
+    def _spec_programs(self):
+        if self._spec_prog is None:
+            key = (self._token, self._phase, self.block_size,
+                   self._dtype_name, self._d_dtype_name, self.spec_k,
+                   self._donate)
+            self._draft_prefill_prog = _executor.Program(
+                "draft_prefill_step", key,
+                _kernels.build_prefill_fn(
+                    self.draft, self._d_params, self.block_size,
+                    self.num_blocks, None),
+                donate_argnums=(1,) if self._donate else ())
+            self._spec_prog = _executor.Program(
+                "spec_verify_step", key,
+                _kernels.build_spec_verify_fn(
+                    self.model, self._params, self.draft,
+                    self._d_params, self.block_size, self.num_blocks,
+                    self.spec_k),
+                donate_argnums=(2, 3) if self._donate else ())
+        return self._draft_prefill_prog, self._spec_prog
+
     def _vals(self):
         return [p.data for p in self._params]
+
+    def _d_vals(self):
+        return [p.data for p in self._d_params]
 
     # -- intake ------------------------------------------------------------
 
@@ -171,8 +264,16 @@ class ServeEngine:
     # -- the tick ----------------------------------------------------------
 
     def step(self) -> bool:
-        """One engine tick: admit, one prefill chunk, one decode tick.
-        Returns True while any request is live or queued."""
+        """One engine tick: admit, one prefill (or draft catch-up)
+        chunk, one decode/speculative tick.  Returns True while any
+        request is live or queued.
+
+        A ``phase="prefill"`` engine stops after the prefill stage —
+        sessions that complete prefill wait in DECODE state for the
+        disaggregation coordinator (:mod:`apex_tpu.serve.disagg`) to
+        stream their KV blocks out.  A ``phase="decode"`` engine runs
+        the full tick (its prefill stage serves recompute-mode
+        re-admissions after local preemption)."""
         self._tick += 1
         t0 = time.monotonic()
         for s in self.scheduler.admit():
@@ -181,10 +282,23 @@ class ServeEngine:
         ps = self.scheduler.next_prefill()
         if ps is not None:
             self._prefill_chunk(ps)
+        elif self.spec:
+            cs = self._next_draft_catchup()
+            if cs is not None:
+                self._draft_catchup_chunk(cs)
+        if self._phase == "prefill":
+            _obs.gauge("serve.queue_depth").set(
+                len(self.scheduler.queue))
+            _obs.gauge("serve.active_sessions").set(
+                len(self.scheduler.sessions))
+            return self.scheduler.has_work()
         self._ensure_decode_blocks()
-        ds = self.scheduler.decode_sessions()
+        ds = self._decode_ready()
         if ds:
-            self._decode_tick(ds)
+            if self.spec and self._spec_pays(ds):
+                self._spec_tick(ds)
+            else:
+                self._decode_tick(ds)
             _obs.histogram("serve.decode_tick_ms").observe(
                 (time.monotonic() - t0) * 1e3)
         _obs.gauge("serve.queue_depth").set(len(self.scheduler.queue))
@@ -231,7 +345,8 @@ class ServeEngine:
         prefill_prog, _ = self._programs()
         chunk = self.scheduler.prefill_chunk
         n = min(chunk, s.prefill_remaining)
-        toks = list(s.prefill_src[s.position:s.position + n])
+        t0 = s.position
+        toks = list(s.prefill_src[t0:t0 + n])
         toks += [0] * (chunk - n)
         nb = bucket(len(s.table))
         table = s.table + [0] * (nb - len(s.table))
@@ -239,9 +354,25 @@ class ServeEngine:
             prefill_prog,
             (self._vals(), self.pool,
              np.asarray([toks], np.int32), np.asarray([table], np.int32),
-             np.int32(s.position), np.int32(n)),
+             np.int32(t0), np.int32(n)),
             step=next(self._dispatch_no))
-        s.position += n
+        if self.spec:
+            # lockstep draft ingest: the draft's cache tracks the
+            # target's row for row through prefill (and recompute
+            # re-prefill), so a fresh session is spec-ready the tick
+            # its prefill completes
+            draft_prog, _ = self._spec_programs()
+            nbd = bucket(len(s.draft_table))
+            d_table = s.draft_table + [0] * (nbd - len(s.draft_table))
+            _dl, self.dpool = _executor.executor.submit(
+                draft_prog,
+                (self._d_vals(), self.dpool,
+                 np.asarray([toks], np.int32),
+                 np.asarray([d_table], np.int32),
+                 np.int32(t0), np.int32(n)),
+                step=next(self._dispatch_no))
+            s.draft_position = t0 + n
+        s.position = t0 + n
         if self.window is not None:
             self.scheduler.retire_window_blocks(s, self.window)
         if s.prefill_remaining > 0:
@@ -259,14 +390,77 @@ class ServeEngine:
             if s.finished():
                 self._finish(s)
 
+    def _next_draft_catchup(self) -> Optional[Session]:
+        """Oldest decoding session whose draft cache lags its target
+        cache — only handed-off sessions (or plain-decode fallback
+        ticks) create the lag; one catch-up chunk per tick repairs it
+        in the prefill slot."""
+        for s in self.scheduler.sessions:
+            if s.state == DECODE and s.draft_position < s.position:
+                return s
+        return None
+
+    def _draft_catchup_chunk(self, s: Session) -> None:
+        draft_prog, _ = self._spec_programs()
+        chunk = self.scheduler.prefill_chunk
+        fed = s.fed_tokens
+        d0 = s.draft_position
+        n = min(chunk, s.position - d0)
+        toks = list(fed[d0:d0 + n]) + [0] * (chunk - n)
+        nbd = bucket(len(s.draft_table))
+        d_table = s.draft_table + [0] * (nbd - len(s.draft_table))
+        _dl, self.dpool = _executor.executor.submit(
+            draft_prog,
+            (self._d_vals(), self.dpool,
+             np.asarray([toks], np.int32), np.asarray([d_table], np.int32),
+             np.int32(d0), np.int32(n)),
+            step=next(self._dispatch_no))
+        s.draft_position = d0 + n
+
+    def _decode_ready(self) -> List[Session]:
+        """Sessions eligible for this tick's decode dispatch: every
+        DECODE session, minus (spec mode) those whose draft cache is
+        still catching up — including them would verify against stale
+        draft rows."""
+        ds = self.scheduler.decode_sessions()
+        if not self.spec:
+            return ds
+        return [s for s in ds if s.draft_position == s.position]
+
+    def _spec_pays(self, sessions: List[Session]) -> bool:
+        """``spec_policy="on"`` always speculates; ``"auto"`` asks the
+        kernel-dispatch ledger (decide(), cached per bucket shape)
+        whether the measured verify win covers this shape — below the
+        win region the engine falls back to plain decode ticks and the
+        catch-up path keeps the draft cache consistent."""
+        if self._spec_policy == "on":
+            return True
+        b = bucket(len(sessions), self.scheduler.max_batch)
+        nbt = bucket(max(len(s.table) for s in sessions))
+        nbd = bucket(max(len(s.draft_table) for s in sessions))
+        fp = spec_verify_fp(b=b, k=self.spec_k,
+                            s_t=nbt * self.block_size,
+                            s_d=nbd * self.block_size,
+                            dtype=self._dtype_name)
+        return _decide("spec_verify", fp).tier == "pallas"
+
     def _ensure_decode_blocks(self) -> None:
-        """Every decoding session needs its table to cover the row this
-        tick writes; a dry pool preempts the newest session (recompute
-        mode) until the survivors fit."""
+        """Every decoding session needs its table to cover the rows
+        this tick writes — one row for plain decode, ``spec_k + 1``
+        rows across BOTH tables for a speculative tick; a dry pool
+        preempts the newest session (recompute mode) until the
+        survivors fit."""
+        slack = self.spec_k if self.spec else 0
         for s in list(self.scheduler.decode_sessions()):
             if s.state != DECODE:
                 continue                     # preempted below us
-            while not self.scheduler.grow(s, s.position + 1):
+            if self.spec and s.draft_position < s.position:
+                continue                     # catch-up session: no tick
+            need = s.position + 1 + slack
+            while not (self.scheduler.grow(s, need)
+                       and (not self.spec
+                            or self.scheduler.grow(s, need,
+                                                   draft=True))):
                 victim = self.scheduler.preempt_for(s)
                 _obs.counter("serve.preemptions").inc()
                 _obs.event("serve.request", rid=victim.rid,
@@ -296,6 +490,135 @@ class ServeEngine:
             if s.finished():
                 self._finish(s)
 
+    def _spec_tick(self, sessions: List[Session]) -> None:
+        """One batched speculative tick: a single ``spec_verify_step``
+        dispatch drafts ``spec_k`` proposals and verifies them with one
+        (k+1)-wide target pass; the host commits the ragged accepted
+        prefix per row.  Commitment rule: row i's emitted tokens are
+        the TARGET's argmax at positions p..p+k conditioned on its own
+        committed prefix, and ``n_acc`` only ever truncates that stream
+        where the draft diverged — so the committed token sequence is
+        bitwise the plain-decode sequence, whatever the acceptance
+        pattern, eos/max_new truncation, or preemption does to tick
+        boundaries."""
+        _, spec_prog = self._spec_programs()
+        b, nbt, nbd, tokens, positions, t_tables, d_tables = \
+            self.scheduler.pack_spec(sessions)
+        emitted, n_acc, self.pool, self.dpool = _executor.executor.submit(
+            spec_prog,
+            (self._vals(), self._d_vals(), self.pool, self.dpool,
+             np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
+             np.asarray(t_tables, np.int32),
+             np.asarray(d_tables, np.int32)),
+            step=next(self._dispatch_no))
+        emitted = np.asarray(emitted)
+        n_acc = np.asarray(n_acc)
+        committed_total = 0
+        for i, s in enumerate(sessions):
+            m = 0
+            for j in range(int(n_acc[i])):
+                tok = int(emitted[i, j])
+                s.out.append(tok)
+                s.pending_tok = tok
+                s.position += 1
+                m += 1
+                if s.finished():
+                    break
+            # rows p..p+m-1 of the draft cache hold exactly the
+            # committed tokens (the rejected tail past them is rewritten
+            # by the next tick's chunk before any mask can read it)
+            s.draft_position = s.position
+            committed_total += m
+            self._spec_offered += self.spec_k
+            self._spec_accepted += max(0, m - 1)
+            if s.finished():
+                self._finish(s)
+        self._spec_ticks += 1
+        self._spec_committed += committed_total
+        _obs.histogram("serve.spec.accepted_tokens").observe(
+            committed_total)
+        if self._spec_offered:
+            _obs.gauge("serve.spec.accept_rate").set(
+                self._spec_accepted / self._spec_offered)
+
+    # -- disaggregation handoff --------------------------------------------
+
+    def harvest_ready(self) -> List[Session]:
+        """Prefill-phase engines: sessions whose prefill completed
+        (DECODE state, first token emitted) and now wait for the
+        coordinator to stream their KV blocks to a decode engine."""
+        return [s for s in self.scheduler.decode_sessions()
+                if not s.finished()]
+
+    def release_handoff(self, s: Session) -> None:
+        """Drop a session whose KV blocks were streamed out: frees its
+        blocks and batch slot without recording a result — the decode
+        engine owns the request from here."""
+        self.scheduler.finish(s)
+
+    def ingest_handoff(self, request: Request, *, out, pending_tok,
+                       position, handoff_dir, t_queued=0.0,
+                       t_first=None) -> Optional[Session]:
+        """Decode-phase engines: adopt a prefilled session whose KV
+        blocks were streamed into ``handoff_dir`` (schema-3 shard
+        files, runtime/resilience.py).  Allocates a fresh target table
+        sized exactly like the prefill engine's admission grant and
+        scatters the streamed blocks into this engine's pool verbatim
+        — bitwise, no recompute; in spec mode a draft table of the
+        same size is allocated but the draft cache starts EMPTY and
+        catches up through the prefill slot.  Returns the new session,
+        or None when a batch slot / blocks are not available right now
+        (the coordinator retries next tick)."""
+        from ..runtime.resilience import load_kv_handoff
+        need_pos = len(request.prompt) + request.max_new_tokens \
+            + self.scheduler.pos_slack
+        if need_pos > self.scheduler.max_positions:
+            raise ValueError(
+                f"request {request.rid}: {need_pos} positions exceed "
+                f"decode engine max_positions "
+                f"{self.scheduler.max_positions}")
+        if len(self.scheduler.sessions) >= self.scheduler.max_batch:
+            return None
+        # the prefill engine's table is exactly its admission grant —
+        # blocks_for(prompt + 1) — because prefill-phase engines never
+        # decode, so the streamed block count is deterministic
+        have = blocks_for(len(request.prompt) + 1, self.block_size)
+        ids = self.block_pool.alloc(have)
+        if ids is None:
+            return None
+        draft_ids: List[int] = []
+        if self.spec:
+            draft_ids = self.block_pool.alloc(have)
+            if draft_ids is None:
+                self.block_pool.free(ids)
+                return None
+        try:
+            self.pool, _peak = load_kv_handoff(
+                handoff_dir, self.pool, ids)
+        except Exception:
+            self.block_pool.free(ids)
+            if draft_ids:
+                self.block_pool.free(draft_ids)
+            raise
+        s = Session(request, self.scheduler._seq)
+        self.scheduler._seq += 1
+        s.table = ids
+        s.draft_table = draft_ids
+        s.position = int(position)
+        s.draft_position = 0
+        s.state = DECODE
+        s.prefill_src = ()
+        s.emit_on_prefill = False
+        s.pending_tok = int(pending_tok)
+        s.out = list(out)
+        s.t_queued = t_queued
+        s.t_first = t_first
+        self.scheduler.sessions.append(s)
+        _obs.event("serve.request", rid=s.rid, phase="ingested",
+                   tick=self._tick, blocks=have,
+                   generated=len(s.out))
+        return s
+
     def _finish(self, s: Session) -> None:
         self.results[s.rid] = list(s.out)
         s.t_done = time.monotonic()
@@ -318,7 +641,7 @@ class ServeEngine:
         the engine's own gauges/histograms."""
         from ..runtime import step_cache as _sc
         snap = _obs.get_registry().snapshot()
-        return {
+        out = {
             "decode": _sc.kind_stats("decode_step"),
             "prefill": _sc.kind_stats("prefill_step"),
             "pool_occupancy": self.block_pool.occupancy,
@@ -326,3 +649,18 @@ class ServeEngine:
             "histograms": {k: v for k, v in snap["histograms"].items()
                            if k.startswith("serve.")},
         }
+        if self.spec:
+            out["spec_verify"] = _sc.kind_stats("spec_verify_step")
+            out["draft_prefill"] = _sc.kind_stats("draft_prefill_step")
+            out["spec"] = {
+                "ticks": self._spec_ticks,
+                "committed_tokens": self._spec_committed,
+                "offered": self._spec_offered,
+                "accepted": self._spec_accepted,
+                "accept_rate": (self._spec_accepted / self._spec_offered
+                                if self._spec_offered else 0.0),
+                "tokens_per_tick": (self._spec_committed
+                                    / self._spec_ticks
+                                    if self._spec_ticks else 0.0),
+            }
+        return out

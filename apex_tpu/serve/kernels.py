@@ -31,7 +31,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..inference.quant import QuantKV, absmax_int8, gather_rows
+from ..inference import QuantKV, absmax_int8, gather_rows
 from ..nn.modules import Ctx
 
 _f32 = jnp.float32
@@ -257,4 +257,145 @@ def build_prefill_fn(model, params, block_size, num_blocks,
             pool = scatter_pool(pool, layer, 1, tgt, offs,
                                 jnp.swapaxes(v_new[0], 0, 1))
         return last, pool
+    return fn
+
+
+def _scatter_chunk(pool, fresh, tables, positions, block_size,
+                   num_blocks, width):
+    """Batched multi-position scatter: write ``fresh`` — per-layer
+    ``(k_new, v_new)`` of shape ``(B, H, width, D)`` — so chunk row
+    ``j`` of batch row ``b`` lands at logical position
+    ``positions[b] + j``.  Dead rows (``positions == -1``) redirect past
+    the pool and drop; live rows are distinct (position, table) pairs,
+    so the scatter has no write conflicts."""
+    bs = block_size
+    nb = tables.shape[1]
+    p = positions[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+    pc = jnp.clip(p, 0)
+    tgt = jnp.take_along_axis(tables, jnp.minimum(pc // bs, nb - 1),
+                              axis=1)
+    tgt = jnp.where(positions[:, None] >= 0, tgt,
+                    num_blocks).reshape(-1)
+    offs = (pc % bs).reshape(-1)
+    for layer, (k_new, v_new) in enumerate(fresh):
+        _, h, _, d_ = k_new.shape
+        pool = scatter_pool(pool, layer, 0, tgt, offs,
+                            jnp.swapaxes(k_new, 1, 2).reshape(-1, h, d_))
+        pool = scatter_pool(pool, layer, 1, tgt, offs,
+                            jnp.swapaxes(v_new, 1, 2).reshape(-1, h, d_))
+    return pool
+
+
+def build_spec_verify_fn(target, t_params, draft, d_params, block_size,
+                         num_blocks, k):
+    """The speculative decode-tick program body: draft-propose + target-
+    verify, fused into ONE dispatch per tick.
+
+    ``fn(t_vals, d_vals, t_pool, d_pool, tokens, positions, t_tables,
+    d_tables) -> (emitted, n_acc, t_pool, d_pool)`` with ``tokens (B,)``
+    each session's pending token, ``positions (B,)`` its ingest position
+    (``-1`` = dead pad row), and separate block tables into the target
+    and draft pools (same :class:`~apex_tpu.serve.pool.BlockPool`
+    free-list, two geometry-matched buffers).
+
+    Inside the program:
+
+    1. the DRAFT runs ``k + 1`` sequential paged decode steps from the
+       pending token — the extra step writes the draft KV row for the
+       all-accepted case (speculative.py's ``m + k`` cache-coverage
+       rule) — proposing greedy tokens ``d_1..d_k``;
+    2. the TARGET verifies the chunk ``[x_0, d_1..d_k]`` at positions
+       ``p..p+k`` in one batched multi-position paged pass (the prefill
+       body's insert mask, per batch row), yielding greedy tokens
+       ``g_1..g_{k+1}``;
+    3. ragged greedy acceptance PER ROW: ``n_acc[b] - 1`` is the length
+       of the longest prefix where ``d_i == g_i``, so row ``b`` commits
+       ``emitted[b, :n_acc[b]]`` — between 1 and ``k + 1`` tokens, each
+       one exactly what the plain decode program would have emitted.
+
+    Both pools are written through position ``p + k`` every tick; rows
+    past the committed point hold KV of rejected continuations and are
+    overwritten by the next tick's chunk (positions ``p'..p'+k`` with
+    ``p' <= p + k + 1``) before any query's validity mask can reach
+    them — the cache-staleness invariant speculative.py documents,
+    expressed in block tables.  Shape-static like every serve body: the
+    batch bucket, the two table buckets and ``k`` key compilation;
+    acceptance lengths are DATA (`n_acc`), never shapes."""
+    bs = block_size
+    kp1 = k + 1
+
+    def fn(t_vals, d_vals, t_pool, d_pool, tokens, positions,
+           t_tables, d_tables):
+        t_ctx = _ctx(t_params, t_vals)
+        d_ctx = _ctx(d_params, d_vals)
+        live = positions >= 0
+        # ---- draft proposes: kp1 sequential paged single-token steps
+        # against one up-front gather; fresh rows accumulate in the
+        # linear view and scatter back once at the end.
+        d_read = gather_pool(d_pool, d_tables)
+        d_slots = jnp.arange(d_tables.shape[1] * bs, dtype=jnp.int32)
+        d_lins = [list(d_read(layer))
+                  for layer in range(len(draft.blocks))]
+        d_fresh = [[] for _ in draft.blocks]
+        chunk_toks = [tokens]
+        tok = tokens
+        for j in range(kp1):
+            pos_j = jnp.where(live, positions + j, -1)
+            x = _embed(d_ctx, draft, tok[:, None], pos_j[:, None])
+            for layer, blk in enumerate(draft.blocks):
+                q, k_new, v_new = blk._chunk_qkv(d_ctx, x)
+                own = (d_slots[None, :]
+                       == pos_j[:, None])[:, None, :, None]
+                k_lin, v_lin = insert_row(
+                    d_pool, d_lins[layer][0], d_lins[layer][1],
+                    k_new, v_new, own)
+                d_lins[layer] = [k_lin, v_lin]
+                o = _paged_attend(blk, x, q, k_lin, v_lin,
+                                  pos_j[:, None], d_slots, None)
+                x = blk._attn_mlp_tail(d_ctx, x, o)
+                d_fresh[layer].append((k_new, v_new))
+            if j < k:                  # step k only writes its KV row
+                x = draft.ln_f.forward(d_ctx, x)
+                logits = _head(d_ctx, draft, x)[:, 0]
+                tok = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+                chunk_toks.append(tok)
+        chunk = jnp.stack(chunk_toks, axis=1)       # (B, kp1)
+        d_pool = _scatter_chunk(
+            d_pool,
+            [(jnp.concatenate([f[0] for f in per], axis=2),
+              jnp.concatenate([f[1] for f in per], axis=2))
+             for per in d_fresh],
+            d_tables, positions, bs, num_blocks, kp1)
+        # ---- target verifies the whole chunk in one paged pass
+        t_read = gather_pool(t_pool, t_tables)
+        slots = jnp.arange(t_tables.shape[1] * bs, dtype=jnp.int32)
+        offs_q = jnp.arange(kp1, dtype=jnp.int32)[None, :]
+        q_pos = jnp.where(live[:, None], positions[:, None] + offs_q, -1)
+        x = _embed(t_ctx, target, chunk, q_pos)
+        d = slots[None, :] - positions[:, None]             # (B, S)
+        own = ((d >= 0) & (d < kp1)
+               & live[:, None])[:, None, :, None]
+        src = jnp.clip(d, 0, k)[:, None, :, None]
+        t_fresh = []
+        for layer, blk in enumerate(target.blocks):
+            q, k_new, v_new = blk._chunk_qkv(t_ctx, x)   # (B, H, kp1, D)
+            k_lin, v_lin = t_read(layer)
+            k_ins = jnp.take_along_axis(k_new, src, axis=2)
+            v_ins = jnp.take_along_axis(v_new, src, axis=2)
+            k_lin, v_lin = insert_row(t_pool, k_lin, v_lin, k_ins,
+                                      v_ins, own)
+            o = _paged_attend(blk, x, q, k_lin, v_lin, q_pos, slots,
+                              None)
+            x = blk._attn_mlp_tail(t_ctx, x, o)
+            t_fresh.append((k_new, v_new))
+        x = target.ln_f.forward(t_ctx, x)
+        logits = _head(t_ctx, target, x)                # (B, kp1, V)
+        emitted = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+        agree = chunk[:, 1:] == emitted[:, :k]
+        stop = jnp.concatenate(
+            [agree, jnp.zeros((agree.shape[0], 1), bool)], axis=1)
+        n_acc = jnp.argmin(stop.astype(jnp.int32), axis=1) + 1
+        t_pool = _scatter_chunk(t_pool, t_fresh, t_tables, positions,
+                                bs, num_blocks, kp1)
+        return emitted, n_acc, t_pool, d_pool
     return fn

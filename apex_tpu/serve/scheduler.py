@@ -89,6 +89,12 @@ class Session:
     emit_on_prefill: bool = True           # fresh: 1st token from logits
     pending_tok: Optional[int] = None      # next token to ingest
     out: List[int] = field(default_factory=list)
+    # speculative mode only: the draft model's own block table over the
+    # SAME BlockPool free-list, and how many draft KV rows are written
+    # (lags `position` when a handed-off session's draft cache is still
+    # catching up on the prompt; equal once spec ticks may include it)
+    draft_table: List[int] = field(default_factory=list)
+    draft_position: int = 0
     # lifecycle timestamps (engine-stamped, telemetry only — no
     # scheduling decision reads them, so packing stays deterministic)
     t_queued: float = 0.0
@@ -102,6 +108,16 @@ class Session:
     @property
     def prefill_remaining(self) -> int:
         return len(self.prefill_src) - self.position
+
+    @property
+    def fed_tokens(self) -> Tuple[int, ...]:
+        """Every token whose target KV row is committed: the prompt
+        plus all output except the last (still pending ingest) —
+        exactly the recompute-mode prefill source, and the draft
+        catch-up source for handed-off speculative sessions."""
+        if self.out:
+            return self.request.prompt + tuple(self.out[:-1])
+        return self.request.prompt
 
     def finished(self) -> bool:
         r = self.request
@@ -117,7 +133,8 @@ class Scheduler:
 
     def __init__(self, pool: BlockPool, *, max_batch: int,
                  prefill_chunk: int, max_prefill_backlog: int,
-                 max_positions: int):
+                 max_positions: int, spec_tables: bool = False,
+                 pos_slack: int = 0):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if prefill_chunk < 1:
@@ -128,6 +145,13 @@ class Scheduler:
         self.prefill_chunk = prefill_chunk
         self.max_prefill_backlog = max_prefill_backlog
         self.max_positions = max_positions
+        # speculative mode: every session also owns a draft block table
+        # (admission doubles its block ask, finish/preempt free both),
+        # and each tick may write up to `pos_slack` rows PAST the last
+        # committed position (the verify chunk's rejected tail), so
+        # admission budgets that headroom out of max_positions up front
+        self.spec_tables = spec_tables
+        self.pos_slack = int(pos_slack)
         self.queue: deque = deque()
         self.sessions: List[Session] = []      # admission order
         self._seq = 0
@@ -139,10 +163,13 @@ class Scheduler:
         """Queue a request (FIFO).  Requests that can NEVER fit — more
         positions than the model or the whole pool can hold — are
         rejected now, loudly, instead of deadlocking the queue head."""
-        need = len(request.prompt) + request.max_new_tokens
+        need = len(request.prompt) + request.max_new_tokens \
+            + self.pos_slack
+        blocks_need = blocks_for(need, self.pool.block_size)
+        if self.spec_tables:
+            blocks_need *= 2               # target + draft tables
         cap_blocks = self.pool.capacity
-        if need > self.max_positions or \
-                blocks_for(need, self.pool.block_size) > cap_blocks:
+        if need > self.max_positions or blocks_need > cap_blocks:
             self.rejected.append(request.rid)
             raise ValueError(
                 f"request {request.rid}: {need} positions exceed "
@@ -174,11 +201,22 @@ class Scheduler:
             ids = self.pool.alloc(need)
             if ids is None:
                 break
+            draft_ids: List[int] = []
+            if self.spec_tables:
+                # all-or-nothing across BOTH tables: a session holding
+                # a target table but no draft table would deadlock the
+                # spec tick exactly like a half-admitted prompt
+                draft_ids = self.pool.alloc(need)
+                if draft_ids is None:
+                    self.pool.free(ids)
+                    break
             self.queue.popleft()
             s.seq = self._seq
             self._seq += 1
             s.table = ids
+            s.draft_table = draft_ids
             s.position = 0
+            s.draft_position = 0
             s.state = PREFILL
             s.prefill_src = src
             self.sessions.append(s)
@@ -201,17 +239,20 @@ class Scheduler:
 
     # -- block growth / preemption ----------------------------------------
 
-    def grow(self, s: Session, n_positions: int) -> bool:
-        """Extend ``s.table`` to cover ``n_positions`` KV rows; False if
-        the pool is dry (caller preempts and retries)."""
+    def grow(self, s: Session, n_positions: int,
+             draft: bool = False) -> bool:
+        """Extend ``s.table`` (or ``s.draft_table``) to cover
+        ``n_positions`` KV rows; False if the pool is dry (caller
+        preempts and retries)."""
+        table = s.draft_table if draft else s.table
         need = blocks_for(n_positions, self.pool.block_size) \
-            - len(s.table)
+            - len(table)
         if need <= 0:
             return True
         ids = self.pool.alloc(need)
         if ids is None:
             return False
-        s.table.extend(ids)
+        table.extend(ids)
         return True
 
     def preempt_for(self, needy: Session) -> Optional[Session]:
@@ -223,9 +264,13 @@ class Scheduler:
         victims = [s for s in self.sessions if s is not needy]
         victim = max(victims, key=lambda s: s.seq) if victims else needy
         self.pool.free(b for b in victim.table if b != NULL_BLOCK)
+        self.pool.free(b for b in victim.draft_table
+                       if b != NULL_BLOCK)
         self.sessions.remove(victim)
         victim.table = []
+        victim.draft_table = []
         victim.position = 0
+        victim.draft_position = 0
         victim.state = QUEUED
         if victim.out:
             # recompute mode: re-prefill prompt + generated-so-far
@@ -244,7 +289,9 @@ class Scheduler:
 
     def finish(self, s: Session) -> None:
         self.pool.free(b for b in s.table if b != NULL_BLOCK)
+        self.pool.free(b for b in s.draft_table if b != NULL_BLOCK)
         s.table = []
+        s.draft_table = []
         s.state = DONE
         self.sessions.remove(s)
 
@@ -284,3 +331,27 @@ class Scheduler:
             positions.append(-1)
             tables.append([NULL_BLOCK] * nb)
         return b, nb, tokens, positions, tables
+
+    def pack_spec(self, sessions: List[Session]):
+        """Bucketed operands for one speculative tick:
+        ``(bucket_batch, bucket_t_blocks, bucket_d_blocks, tokens,
+        positions, t_tables, d_tables)`` — the decode packing plus the
+        draft pool's tables, bucketed independently (the draft cache
+        may cover fewer rows than the target's after a handoff)."""
+        b = bucket(len(sessions), self.max_batch)
+        nbt = bucket(max(len(s.table) for s in sessions))
+        nbd = bucket(max(len(s.draft_table) for s in sessions))
+        tokens, positions, t_tables, d_tables = [], [], [], []
+        for s in sessions:
+            tokens.append(s.pending_tok)
+            positions.append(s.position)
+            t_tables.append(s.table
+                            + [NULL_BLOCK] * (nbt - len(s.table)))
+            d_tables.append(s.draft_table
+                            + [NULL_BLOCK] * (nbd - len(s.draft_table)))
+        for _ in range(b - len(sessions)):
+            tokens.append(0)
+            positions.append(-1)
+            t_tables.append([NULL_BLOCK] * nbt)
+            d_tables.append([NULL_BLOCK] * nbd)
+        return b, nbt, nbd, tokens, positions, t_tables, d_tables

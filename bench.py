@@ -911,6 +911,58 @@ def kernel_probe_records(iters=2, reps=3):
                    kdispatch.vocab_chain_fp(n_, v_, e_, "float32"),
                    build_vc, (hx, wx)))
 
+    # --- spec_verify: the fused draft-propose + target-verify serve
+    # tick vs the k+1 chained plain decode dispatches it replaces
+    # (self-draft, so acceptance is full and both arms commit the same
+    # k+1 tokens per call — equal useful work, pure dispatch-count
+    # comparison).  Both arms bypass decide() for the same reason the
+    # vocab probe does: the probe MEASURES the tiers ---
+    import apex_tpu.nn as _ann
+    from apex_tpu.kernels.spec_verify import spec_verify_fp
+    from apex_tpu.models.gpt import GptModel as _Gpt
+    from apex_tpu.serve.kernels import (build_decode_fn,
+                                        build_spec_verify_fn)
+    from apex_tpu.serve.pool import init_pool_buffer
+
+    _ann.manual_seed(0)
+    sp_model = _Gpt(vocab_size=73, hidden=32, layers=2, heads=4,
+                    max_positions=96, dropout=0.0, attn_dropout=0.0)
+    sp_model.eval()
+    sp_params = list(sp_model.parameters()) + list(sp_model.buffers())
+    sp_vals = [p.data for p in sp_params]
+    sp_k, sp_b, sp_blocks, sp_bs = 3, 4, 10, 8
+    sp_pool = init_pool_buffer(2, 4, 8, sp_blocks, sp_bs)
+    sp_dpool = init_pool_buffer(2, 4, 8, sp_blocks, sp_bs)
+    sp_pos = 2  # rows 0..1 hold "context"; verify writes 2..2+k
+    sp_tabs = jnp.asarray(
+        [[1 + 2 * i, 2 + 2 * i] for i in range(sp_b)], jnp.int32)
+    sp_toks = jnp.asarray(
+        rng.integers(1, 72, (sp_b,)), jnp.int32)
+    sp_positions = jnp.full((sp_b,), sp_pos, jnp.int32)
+
+    def build_spec(arm):
+        if arm == "pallas":
+            fused = build_spec_verify_fn(
+                sp_model, sp_params, sp_model, sp_params, sp_bs,
+                sp_blocks, sp_k)
+            return jax.jit(fused)
+        dec = build_decode_fn(sp_model, sp_params, sp_bs, sp_blocks)
+
+        def chain(t_vals, d_vals, t_pool, d_pool, toks, pos, t_tab,
+                  d_tab):
+            tk, p = toks, t_pool
+            for j in range(sp_k + 1):
+                tk, _lg, p = dec(t_vals, p, tk, pos + j, t_tab)
+            return tk, p
+        return jax.jit(chain)
+    probes.append((
+        "spec_verify",
+        spec_verify_fp(b=sp_b, k=sp_k, s_t=sp_blocks * sp_bs,
+                       s_d=sp_blocks * sp_bs, dtype="float32"),
+        build_spec,
+        (sp_vals, sp_vals, sp_pool, sp_dpool, sp_toks, sp_positions,
+         sp_tabs, sp_tabs)))
+
     write_ledger = mode == "compiled"
     led = kledger.get_ledger() if write_ledger else None
     records = []
@@ -2279,31 +2331,52 @@ def run_overlap_microbench(args):
 
 def serve_bench_records(n_requests=200, seed=0, num_blocks=96,
                         block_size=8, max_batch=8, prefill_chunk=8,
-                        arrival_rate=2.0):
-    """``serve_throughput`` stage: the continuous-batching paged-KV
-    engine under a seeded Poisson open-loop trace of ``n_requests``
-    synthetic sessions (random prompt lengths / generation budgets,
-    request i visible at its arrival tick whether or not the engine is
-    keeping up — open loop, so queueing delay shows in the tail).
+                        arrival_rate=2.0, spec_k=3,
+                        arms=("unified", "disaggregated", "speculative")):
+    """``serve_throughput`` stage: the serving engine under a seeded
+    Poisson open-loop trace of ``n_requests`` synthetic sessions
+    (random prompt lengths / generation budgets, request i visible at
+    its arrival tick whether or not the engine is keeping up — open
+    loop, so queueing delay shows in the tail), one record per arm:
+
+    * ``unified`` — one :class:`ServeEngine` time-slicing both phases
+      (the PR 12 baseline record; its fields are a superset of the old
+      single-record schema).
+    * ``disaggregated`` — prefill engine + decode engine joined by the
+      schema-3 streamed KV handoff
+      (:class:`~apex_tpu.serve.DisaggregatedEngine`);
+      ``handoff_bytes_peak_host`` is the largest single block buffer
+      the handoff ever held on the host — the "KV never round-trips
+      through one host" claim, measured.
+    * ``speculative`` — disaggregated + batched speculative decoding
+      on the decode engine: an int8-cached SELF-draft
+      (:func:`~apex_tpu.inference.make_self_draft`), so acceptance is
+      full and ``spec_tokens_per_tick`` isolates the verify
+      machinery's committed tokens/tick (the >= 2 floor the tier-1
+      schema test pins) from draft quality.
 
     CPU-forced like the microbenches; the model is the parity-test
     tiny GPT, so the numbers track the ENGINE (packing, paged gather/
-    scatter, admission) rather than CPU matmul throughput.  Emits
-    latency percentiles from per-request lifecycle events (queued →
-    first_token → done), peak pool occupancy sampled every tick, and
-    the serving engine's load-bearing claim: ``decode_compiles`` after
-    the whole trace stays within ``bucket_bound`` — the batch-bucket ×
-    table-bucket grid — because bucketed operand shapes are the only
-    decode shapes that exist (SERVE-SHAPE's invariant, measured)."""
+    scatter, admission, handoff, verify) rather than CPU matmul
+    throughput.  Every arm re-checks the serving engine's load-bearing
+    claim: decode-path compiles after the whole trace stay within
+    ``bucket_bound`` — the bucket grid — because bucketed operand
+    shapes are the only decode shapes that exist (SERVE-SHAPE's
+    invariant, measured; ragged acceptance included)."""
+    import shutil
+    import tempfile
+
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
     import apex_tpu.nn as nn
+    from apex_tpu.inference import make_self_draft
     from apex_tpu.models.gpt import GptModel
     from apex_tpu.observe import registry as obs
     from apex_tpu.runtime import step_cache as sc
-    from apex_tpu.serve import Request, ServeEngine, blocks_for, bucket
+    from apex_tpu.serve import (DisaggregatedEngine, Request,
+                                ServeEngine, blocks_for, bucket)
 
     rng = np.random.default_rng(seed)
     nn.manual_seed(seed)
@@ -2319,62 +2392,129 @@ def serve_bench_records(n_requests=200, seed=0, num_blocks=96,
     arrivals = np.cumsum(rng.poisson(arrival_rate, n_requests)).tolist()
 
     reg = obs.get_registry()
-    reg.clear_events()
-    sc.reset_stats()
-    sc.clear()
-    eng = ServeEngine(model, num_blocks=num_blocks,
-                      block_size=block_size, max_batch=max_batch,
-                      prefill_chunk=prefill_chunk)
-    peak_occ = 0.0
-    i = 0
-    t0 = time.perf_counter()
-    while True:
-        while i < n_requests and arrivals[i] <= eng.tick:
-            eng.submit(reqs[i])
-            i += 1
-        more = eng.step()
-        peak_occ = max(peak_occ, eng.block_pool.occupancy)
-        if not more and i >= n_requests:
-            break
-    wall_s = time.perf_counter() - t0
-    eng.block_pool.check_no_leaks()
-
-    out = eng.results
-    total_tokens = sum(len(v) for v in out.values())
-    ts = {(e["rid"], e["phase"]): e["ts_ms"]
-          for e in reg.events("serve.request")}
-    ttft = [ts[(r.rid, "first_token")] - ts[(r.rid, "queued")]
-            for r in reqs]
-    e2e = [ts[(r.rid, "done")] - ts[(r.rid, "queued")] for r in reqs]
-
     # every decode shape the bucket tables can produce: batch buckets x
     # table buckets (the worst-case table covers the longest request
-    # plus one block of growth headroom)
-    max_table = blocks_for(int(lens.max()) + int(news.max()), block_size) + 1
-    bucket_bound = \
-        len({bucket(b, max_batch) for b in range(1, max_batch + 1)}) * \
-        len({bucket(t) for t in range(1, max_table + 1)})
-    return [{
-        "metric": "serve_throughput",
-        "config": f"gpt_tiny_poisson_n{n_requests}",
-        "platform": "cpu",
-        "requests": n_requests,
-        "ticks": eng.tick,
-        "tokens_per_s_per_chip": round(total_tokens / wall_s, 1),
-        "p50_ms": round(float(np.percentile(e2e, 50)), 2),
-        "p99_ms": round(float(np.percentile(e2e, 99)), 2),
-        "ttft_p50_ms": round(float(np.percentile(ttft, 50)), 2),
-        "pool_occupancy": round(peak_occ, 3),
-        "decode_compiles": int(sc.kind_stats("decode_step")["compiles"]),
-        "bucket_bound": bucket_bound,
-        "preemptions": int(obs.counter("serve.preemptions").value),
-    }]
+    # plus one block of growth headroom; speculative tables add spec_k
+    # rows of verify headroom and the draft table bucket dimension)
+    max_table = blocks_for(int(lens.max()) + int(news.max()),
+                           block_size) + 1
+    max_table_sp = blocks_for(int(lens.max()) + int(news.max()) + spec_k,
+                              block_size) + 1
+    n_batch_buckets = len({bucket(b, max_batch)
+                           for b in range(1, max_batch + 1)})
+    n_table_buckets = len({bucket(t) for t in range(1, max_table + 1)})
+    n_table_buckets_sp = len({bucket(t)
+                              for t in range(1, max_table_sp + 1)})
+
+    records = []
+    for arm in arms:
+        stage("serve", f"arm {arm}")
+        reg.clear_events()
+        sc.reset_stats()
+        sc.clear()
+        preempt0 = int(obs.counter("serve.preemptions").value)
+        tmp = None
+        if arm == "unified":
+            eng = ServeEngine(model, num_blocks=num_blocks,
+                              block_size=block_size,
+                              max_batch=max_batch,
+                              prefill_chunk=prefill_chunk)
+            pools = [eng.block_pool]
+            decode_eng = eng
+        else:
+            tmp = tempfile.mkdtemp(prefix="apex_bench_handoff_")
+            draft = make_self_draft(model) if arm == "speculative" \
+                else None
+            eng = DisaggregatedEngine(
+                model, num_blocks=num_blocks, block_size=block_size,
+                max_batch=max_batch, prefill_chunk=prefill_chunk,
+                handoff_dir=tmp,
+                decode_blocks=(2 * num_blocks if draft is not None
+                               else num_blocks),
+                draft=draft, spec_k=spec_k)
+            pools = [eng.prefill.block_pool, eng.decode.block_pool]
+            decode_eng = eng.decode
+        peak_occ = 0.0
+        i = 0
+        t0 = time.perf_counter()
+        while True:
+            while i < n_requests and arrivals[i] <= eng.tick:
+                eng.submit(reqs[i])
+                i += 1
+            more = eng.step()
+            peak_occ = max([peak_occ] + [p.occupancy for p in pools])
+            if not more and i >= n_requests:
+                break
+        wall_s = time.perf_counter() - t0
+        for p in pools:
+            p.check_no_leaks()
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+        out = eng.results
+        assert len(out) == n_requests
+        total_tokens = sum(len(v) for v in out.values())
+        ts = {(e["rid"], e["phase"]): e["ts_ms"]
+              for e in reg.events("serve.request")}
+        ttft = [ts[(r.rid, "first_token")] - ts[(r.rid, "queued")]
+                for r in reqs]
+        e2e = [ts[(r.rid, "done")] - ts[(r.rid, "queued")]
+               for r in reqs]
+
+        if arm == "speculative":
+            decode_compiles = \
+                int(sc.kind_stats("spec_verify_step")["compiles"]) \
+                + int(sc.kind_stats("decode_step")["compiles"])
+            # verify shapes: batch x target-table x draft-table buckets
+            bucket_bound = (n_batch_buckets * n_table_buckets_sp
+                            * n_table_buckets_sp)
+        else:
+            decode_compiles = \
+                int(sc.kind_stats("decode_step")["compiles"])
+            bucket_bound = n_batch_buckets * n_table_buckets
+
+        rec = {
+            "metric": "serve_throughput",
+            "arm": arm,
+            "config": f"gpt_tiny_poisson_n{n_requests}",
+            "platform": "cpu",
+            "requests": n_requests,
+            "ticks": eng.tick,
+            "tokens_per_s_per_chip": round(total_tokens / wall_s, 1),
+            "p50_ms": round(float(np.percentile(e2e, 50)), 2),
+            "p99_ms": round(float(np.percentile(e2e, 99)), 2),
+            "ttft_p50_ms": round(float(np.percentile(ttft, 50)), 2),
+            "pool_occupancy": round(peak_occ, 3),
+            "decode_compiles": decode_compiles,
+            "bucket_bound": bucket_bound,
+            "preemptions": int(obs.counter("serve.preemptions").value)
+            - preempt0,
+            "accept_rate": 0.0,
+            "handoff_bytes_peak_host": 0,
+        }
+        if arm != "unified":
+            h = eng.metrics()["handoff"]
+            rec["handoff_bytes_peak_host"] = int(h["bytes_peak_host"])
+            rec["handoffs"] = int(h["count"])
+        if arm == "speculative":
+            spec = decode_eng.metrics()["spec"]
+            rec["accept_rate"] = round(float(spec["accept_rate"]), 4)
+            # committed tokens per SEQUENCE per speculative tick — the
+            # >= 2 tokens/tick acceptance floor is per sequence, so a
+            # big batch can't fake it
+            seq_ticks = spec["offered"] / spec_k if spec["offered"] \
+                else 0
+            rec["spec_tokens_per_tick"] = round(
+                spec["committed_tokens"] / seq_ticks, 3) if seq_ticks \
+                else 0.0
+        records.append(rec)
+    return records
 
 
 def run_serve(args):
     stage("serve",
           "continuous-batching paged-KV engine, 200-session Poisson "
-          "open loop, cpu")
+          "open loop (unified / disaggregated / speculative), cpu")
     for rec in serve_bench_records():
         emit(rec)
         register_record(rec)

@@ -30,3 +30,30 @@ def pack_batch(sessions, max_batch):
 def train_key(batch):
     # GOOD: non-serve kinds are out of scope for this rule
     return _executor.Program("train_step", (len(batch),), lambda x: x)
+
+
+def make_spec_programs(block_size, dtype_name, spec_k, build_draft,
+                       build_verify):
+    # GOOD: the speculative kinds key on config (spec_k is a config
+    # constant, not a per-tick acceptance) + the builder token
+    key = (next(_TOKENS), block_size, dtype_name, spec_k)
+    draft = _executor.Program("draft_prefill_step", key, build_draft)
+    verify = _executor.Program("spec_verify_step", key, build_verify)
+    return draft, verify
+
+
+def commit_accepted(sessions, emitted, n_acc):
+    # GOOD: ragged acceptance consumed as operand VALUES in the host
+    # commit loop — it never reaches program identity
+    for i, s in enumerate(sessions):
+        for j in range(int(n_acc[i])):
+            s.out.append(int(emitted[i, j]))
+    return sessions
+
+
+def spec_batch_key(sessions, max_batch):
+    # GOOD: acceptance-adjacent extents rounded through the bucket
+    # table before they can influence any program shape
+    b = bucket(len(sessions), max_batch)
+    nbd = bucket(max(len(s.draft_table) for s in sessions))
+    return b, nbd

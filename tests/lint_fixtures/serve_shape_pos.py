@@ -19,3 +19,19 @@ def make_prefill_program(prompt, build_fn):
     else:
         key = ("short", len(prompt))
     return _executor.Program("prefill_step", key, build_fn)
+
+
+def make_spec_program(n_acc, build_fn):
+    # BAD: a speculative tick's ragged acceptance count in the static
+    # key — acceptance varies 1..k+1 per sequence per tick, so the
+    # engine recompiles mid-stream the first time a new pattern shows
+    key = ("spec", int(n_acc))
+    return _executor.Program("spec_verify_step", key, build_fn)
+
+
+def pick_verify_program(accepted_len, wide_fn, narrow_fn):
+    # BAD: acceptance steering which program gets built — the same
+    # recompile surface as keying on it
+    if accepted_len > 2:
+        return _executor.Program("spec_verify_step", ("wide",), wide_fn)
+    return _executor.Program("draft_prefill_step", ("narrow",), narrow_fn)

@@ -286,21 +286,43 @@ def test_observe_microbench_records_schema():
 
 
 def test_serve_bench_records_schema():
-    """--serve stage: the continuous-batching paged-KV engine under a
-    Poisson open-loop trace.  Schema plus the serving claim: the decode
-    compile count after the whole trace stays within the batch-bucket x
-    table-bucket grid — recompile-free decode past warmup."""
+    """--serve stage: the serving engine under a Poisson open-loop
+    trace, one record per arm (unified / disaggregated / speculative).
+    Schema plus the serving claims: every arm's decode-path compile
+    count after the whole trace stays within its bucket grid
+    (recompile-free decode past warmup, ragged acceptance included);
+    the disaggregated arms hand KV off one block buffer at a time
+    (``handoff_bytes_peak_host`` bounded by a single block's bytes);
+    the speculative arm commits >= 2 tokens per sequence per tick on
+    the self-draft trace."""
     recs = bench.serve_bench_records(n_requests=40, arrival_rate=1.0)
-    (r,) = recs
-    assert r["metric"] == "serve_throughput"
-    assert r["platform"] == "cpu"
-    assert r["requests"] == 40 and r["ticks"] > 0
-    assert r["tokens_per_s_per_chip"] > 0
-    assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"]
-    assert r["ttft_p50_ms"] > 0
-    assert 0.0 < r["pool_occupancy"] <= 1.0
-    assert r["preemptions"] >= 0
-    assert 1 <= r["decode_compiles"] <= r["bucket_bound"]
+    assert [r["arm"] for r in recs] == \
+        ["unified", "disaggregated", "speculative"]
+    for r in recs:
+        assert r["metric"] == "serve_throughput"
+        assert r["platform"] == "cpu"
+        assert r["requests"] == 40 and r["ticks"] > 0
+        assert r["tokens_per_s_per_chip"] > 0
+        assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"]
+        assert r["ttft_p50_ms"] > 0
+        assert 0.0 < r["pool_occupancy"] <= 1.0
+        assert r["preemptions"] >= 0
+        assert 1 <= r["decode_compiles"] <= r["bucket_bound"]
+        assert r["accept_rate"] >= 0.0
+        assert r["handoff_bytes_peak_host"] >= 0
+    uni, dis, spec = recs
+    assert uni["handoff_bytes_peak_host"] == 0
+    # one fp32 KV block for the tiny GPT: 2 layers x K+V x 4 heads x
+    # block_size 8 x head_dim 8 x 4 bytes — the streamed handoff never
+    # holds more than one block buffer on the host
+    block_bytes = 2 * 2 * 4 * 8 * 8 * 4
+    for r in (dis, spec):
+        assert r["handoffs"] == 40
+        assert 0 < r["handoff_bytes_peak_host"] <= block_bytes
+    # self-draft: full acceptance, and the committed-tokens floor the
+    # ISSUE pins — >= 2 tokens per sequence per speculative tick
+    assert spec["accept_rate"] > 0.5
+    assert spec["spec_tokens_per_tick"] >= 2.0
 
 
 def test_overlap_microbench_records_schema():
