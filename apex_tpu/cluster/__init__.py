@@ -18,7 +18,7 @@ from .membership import (  # noqa: F401
     PREFIX, Member, MembershipView, current_epoch, current_view)
 from .coordinator import Coordinator  # noqa: F401
 from .runtime import (  # noqa: F401
-    ClusterTrainer, SimClock, SimHost, fleet_for_members,
+    ClusterTrainer, SimClock, SimHost, beat_and_scan, fleet_for_members,
     spawn_member_process)
 
 __all__ = [
@@ -26,5 +26,5 @@ __all__ = [
     "default_kv",
     "Member", "MembershipView", "current_epoch", "current_view",
     "Coordinator", "ClusterTrainer", "SimClock", "SimHost",
-    "fleet_for_members", "spawn_member_process",
+    "beat_and_scan", "fleet_for_members", "spawn_member_process",
 ]

@@ -88,6 +88,37 @@ def _host_spec(chip: str, scale: float, n_devices: int) -> str:
                        "n_devices": n_devices})
 
 
+def beat_and_scan(kv: KVStore, clock: SimClock, members, coordinator,
+                  make_coordinator, *, advance_s: float,
+                  fallback_view: Optional[MembershipView] = None):
+    """One membership cycle, shared by :class:`ClusterTrainer` and the
+    serve fleet (:class:`apex_tpu.serve.elastic.ServeFleet`): advance
+    the clock, every live member beats, the coordinator scans.
+    ``ChaosKilled`` converts at the process boundary exactly as the
+    module docstring demands — a felled member is marked dead and
+    reported (its agent never beats again); a felled coordinator is
+    replaced by ``make_coordinator()`` (what a restarted coordinator
+    process would construct over the same store) and the previously
+    published view stands until its first scan.  Returns
+    ``(view, coordinator, felled_member_ids)``."""
+    clock.advance(advance_s)
+    felled = []
+    for m in members:
+        if not m.alive:
+            continue
+        try:
+            m.beat()
+        except _chaos.ChaosKilled:
+            m.alive = False              # the host process is gone
+            felled.append(m.member_id)
+    try:
+        view = coordinator.scan()
+    except _chaos.ChaosKilled:
+        coordinator = make_coordinator()
+        view = current_view(kv) or fallback_view
+    return view, coordinator, felled
+
+
 def fleet_for_members(kv: KVStore, members) -> "object":
     """Build the planner :class:`~apex_tpu.parallel.auto.Fleet` from the
     REGISTERED specs of ``members`` (the kv registration records, not
@@ -176,6 +207,11 @@ class ClusterTrainer:
         self.view = view
         return view
 
+    def _make_coordinator(self) -> Coordinator:
+        return Coordinator(
+            self.kv, deadline_s=self.deadline_s,
+            miss_threshold=self.miss_threshold, clock=self.clock)
+
     def tick(self, advance_s: Optional[float] = None) -> MembershipView:
         """One cluster cycle: advance the clock, every live host beats,
         the coordinator scans.  Chaos kills convert at the process
@@ -185,21 +221,10 @@ class ClusterTrainer:
         counters)."""
         if advance_s is None:
             advance_s = self.deadline_s / 2
-        self.clock.advance(advance_s)
-        for h in self.hosts:
-            if not h.alive:
-                continue
-            try:
-                h.member.beat()
-            except _chaos.ChaosKilled:
-                h.member.alive = False      # the host process is gone
-        try:
-            view = self.coordinator.scan()
-        except _chaos.ChaosKilled:
-            self.coordinator = Coordinator(
-                self.kv, deadline_s=self.deadline_s,
-                miss_threshold=self.miss_threshold, clock=self.clock)
-            view = current_view(self.kv) or self.view
+        view, self.coordinator, _felled = beat_and_scan(
+            self.kv, self.clock, [h.member for h in self.hosts],
+            self.coordinator, self._make_coordinator,
+            advance_s=advance_s, fallback_view=self.view)
         return view
 
     def membership_changed(self) -> bool:

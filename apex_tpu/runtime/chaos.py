@@ -55,6 +55,34 @@ point                 fires
                       before gradients are unscaled
 ====================  =====================================================
 
+Serve-fleet hook points (the elastic serving failure surface;
+docs/resilience.md carries the failure-mode table):
+
+==========================  ===============================================
+point                       fires
+==========================  ===============================================
+``serve.kv_handoff``        before each KV block file of a streamed
+                            handoff or session snapshot
+                            (``resilience.stream_kv_handoff``); a kill
+                            leaves a manifest-less shard directory the
+                            adopter must reject, a fail is a recoverable
+                            stream fault (the disagg coordinator discards
+                            and re-streams once)
+``serve.session_snapshot``  before each live-session KV snapshot the
+                            serve fleet writes
+                            (``serve.elastic.ServeFleet``); a kill fells
+                            the snapshotting replica mid-cycle (its
+                            debris must be rejected, the previous
+                            committed snapshot stands), a fail skips this
+                            round cleanly
+``serve.migrate``           before each restore of a lost session into a
+                            survivor's pool; a kill fells the ADOPTING
+                            replica (the snapshot stays on shared storage
+                            for the next epoch), a fail abandons the
+                            restore cleanly — the session falls back to
+                            the recompute re-prefill path
+==========================  ===============================================
+
 Actions: ``"kill"`` raises :class:`ChaosKilled` (a simulated preemption —
 deliberately NOT a subclass of ``Exception``-wrapping framework errors, so
 recovery code that catches "expected" failures still dies to it the way a

@@ -390,7 +390,8 @@ def _pool_parts(pool):
 
 
 def stream_kv_handoff(dir_path: str, pool, table, *,
-                      source: str = "kv_handoff"):
+                      source: str = "kv_handoff",
+                      extra_meta: Optional[dict] = None):
     """Stream one session's KV blocks out of a paged pool into
     ``dir_path`` under the schema-3 shard-file contract: one file per
     (block, pool-part) — raw ``tobytes()``, atomic tmp+fsync+rename,
@@ -404,6 +405,12 @@ def stream_kv_handoff(dir_path: str, pool, table, *,
     order; logical order is what the manifest records, so the loader's
     fresh id list maps positionally.  Chaos hook ``serve.kv_handoff``
     fires before each block file.
+
+    ``extra_meta`` rides in the manifest under ``"meta"`` — the elastic
+    fleet stores a session's host-side state (generated tokens,
+    pending token, position, SLO class) there, so manifest-commits-last
+    covers the metadata too: a committed meta record implies committed
+    KV blocks, and debris carries neither.
 
     Returns ``(manifest, peak_bytes)`` — peak is the largest single
     host buffer touched (the bench's ``handoff_bytes_peak_host``)."""
@@ -437,9 +444,38 @@ def stream_kv_handoff(dir_path: str, pool, table, *,
         "blocks": blocks_meta,
         "source": source,
     }
+    if extra_meta is not None:
+        manifest["meta"] = dict(extra_meta)
     _write_shard_file(dir_path, _KV_MANIFEST, pickle.dumps(manifest))
     _fsync_dir(dir_path)
     return manifest, peak
+
+
+def read_kv_handoff_meta(dir_path: str) -> dict:
+    """Load and validate a KV handoff directory's MANIFEST without
+    touching the block files.  The elastic serve fleet reads a lost
+    session's metadata (``manifest["meta"]``) and block count here
+    before allocating destination blocks — and a mid-stream kill's
+    manifest-less debris is rejected here with
+    :class:`CheckpointCorruptError`, never adopted."""
+    src = os.path.join(dir_path, _KV_MANIFEST)
+    try:
+        with open(src, "rb") as f:
+            manifest = pickle.loads(f.read())
+    except FileNotFoundError as e:
+        raise CheckpointCorruptError(
+            f"{dir_path}: no KV handoff manifest (mid-handoff "
+            f"kill?)") from e
+    if not isinstance(manifest, dict) or \
+            manifest.get(_KV_MAGIC) is None or \
+            manifest.get("kind") != "kv_handoff":
+        raise CheckpointCorruptError(
+            f"{dir_path}: not a KV handoff manifest")
+    if manifest[_KV_MAGIC] > SCHEMA_VERSION:
+        raise CheckpointCorruptError(
+            f"{dir_path}: handoff schema {manifest[_KV_MAGIC]} is newer "
+            f"than this reader ({SCHEMA_VERSION})")
+    return manifest
 
 
 def load_kv_handoff(dir_path: str, pool, new_ids):
@@ -458,23 +494,7 @@ def load_kv_handoff(dir_path: str, pool, new_ids):
     quantization) or a different block count than ``new_ids`` — that
     is a config error, not corruption.  Returns
     ``(new_pool, peak_bytes)``."""
-    src = os.path.join(dir_path, _KV_MANIFEST)
-    try:
-        with open(src, "rb") as f:
-            manifest = pickle.loads(f.read())
-    except FileNotFoundError as e:
-        raise CheckpointCorruptError(
-            f"{dir_path}: no KV handoff manifest (mid-handoff "
-            f"kill?)") from e
-    if not isinstance(manifest, dict) or \
-            manifest.get(_KV_MAGIC) is None or \
-            manifest.get("kind") != "kv_handoff":
-        raise CheckpointCorruptError(
-            f"{dir_path}: not a KV handoff manifest")
-    if manifest[_KV_MAGIC] > SCHEMA_VERSION:
-        raise CheckpointCorruptError(
-            f"{dir_path}: handoff schema {manifest[_KV_MAGIC]} is newer "
-            f"than this reader ({SCHEMA_VERSION})")
+    manifest = read_kv_handoff_meta(dir_path)
     parts = _pool_parts(pool)
     if manifest["quant"] != (len(parts) == 2):
         raise CheckpointReshardError(

@@ -261,6 +261,28 @@ class ServeEngine:
                    tick=self._tick, prompt_len=len(request.prompt),
                    max_new=request.max_new_tokens)
 
+    def submit_recompute(self, request: Request, out) -> None:
+        """Queue a request that already generated ``out`` tokens on
+        another engine (a session shed or lost during a fleet shrink):
+        admission re-prefills ``prompt + out[:-1]`` in recompute mode,
+        so the continuation is bitwise the uninterrupted one."""
+        self.scheduler.submit_recompute(request, out)
+        sess = self.scheduler.queue[-1]
+        sess.t_queued = time.monotonic()
+        _obs.event("serve.request", rid=request.rid, phase="requeued",
+                   tick=self._tick, generated=len(sess.out))
+
+    def evict_session(self, s: Session) -> Session:
+        """Shed a live session: free its blocks (both tables) and hand
+        it back in recompute mode for the caller — the elastic fleet —
+        to re-home on another engine.  Local preemption stays
+        ``preempt_for`` (re-queues here); this is the cross-engine
+        half."""
+        self.scheduler.evict(s)
+        _obs.event("serve.request", rid=s.rid, phase="shed",
+                   tick=self._tick, generated=len(s.out))
+        return s
+
     # -- the tick ----------------------------------------------------------
 
     def step(self) -> bool:
@@ -558,16 +580,20 @@ class ServeEngine:
 
     def ingest_handoff(self, request: Request, *, out, pending_tok,
                        position, handoff_dir, t_queued=0.0,
-                       t_first=None) -> Optional[Session]:
+                       t_first=None, n_blocks=None) -> Optional[Session]:
         """Decode-phase engines: adopt a prefilled session whose KV
         blocks were streamed into ``handoff_dir`` (schema-3 shard
         files, runtime/resilience.py).  Allocates a fresh target table
-        sized exactly like the prefill engine's admission grant and
-        scatters the streamed blocks into this engine's pool verbatim
-        — bitwise, no recompute; in spec mode a draft table of the
-        same size is allocated but the draft cache starts EMPTY and
-        catches up through the prefill slot.  Returns the new session,
-        or None when a batch slot / blocks are not available right now
+        and scatters the streamed blocks into this engine's pool
+        verbatim — bitwise, no recompute; in spec mode a draft table of
+        the same size is allocated but the draft cache starts EMPTY and
+        catches up through the prefill slot.  ``n_blocks`` is the
+        streamed block count when the source table had grown past the
+        admission grant (the elastic fleet passes the snapshot
+        manifest's count — a mid-decode session owns
+        ``blocks_for(position)`` blocks); None means the
+        disaggregation default below.  Returns the new session, or
+        None when a batch slot / blocks are not available right now
         (the coordinator retries next tick)."""
         from ..runtime.resilience import load_kv_handoff
         need_pos = len(request.prompt) + request.max_new_tokens \
@@ -579,10 +605,13 @@ class ServeEngine:
                 f"{self.scheduler.max_positions}")
         if len(self.scheduler.sessions) >= self.scheduler.max_batch:
             return None
-        # the prefill engine's table is exactly its admission grant —
-        # blocks_for(prompt + 1) — because prefill-phase engines never
-        # decode, so the streamed block count is deterministic
-        have = blocks_for(len(request.prompt) + 1, self.block_size)
+        if n_blocks is None:
+            # the prefill engine's table is exactly its admission grant
+            # — blocks_for(prompt + 1) — because prefill-phase engines
+            # never decode, so the streamed block count is deterministic
+            n_blocks = blocks_for(len(request.prompt) + 1,
+                                  self.block_size)
+        have = int(n_blocks)
         ids = self.block_pool.alloc(have)
         if ids is None:
             return None
@@ -627,6 +656,29 @@ class ServeEngine:
         _obs.event("serve.request", rid=s.rid, phase="done",
                    tick=self._tick, generated=len(s.out))
         self.scheduler.finish(s)
+
+    # -- teardown ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Tear the engine down: return every live session's blocks —
+        target AND draft tables — to the :class:`BlockPool`, drop the
+        queue (queued sessions hold no blocks), and assert the pool is
+        leak-free.  An engine dropped mid-run without this strands its
+        resident sessions' blocks; the elastic fleet also calls it when
+        a replica's simulated process dies (the pool's memory dies with
+        the process).  Idempotent; no result is recorded for the
+        sessions it drops."""
+        for s in list(self.scheduler.sessions):
+            self.scheduler.finish(s)
+        self.scheduler.queue.clear()
+        self.block_pool.check_no_leaks()
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
 
     # -- introspection -----------------------------------------------------
 

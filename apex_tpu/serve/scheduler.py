@@ -54,15 +54,25 @@ def bucket(n: int, cap: Optional[int] = None) -> int:
     return b if cap is None else min(b, cap)
 
 
+#: admission classes the elastic fleet routes/sheds by — "latency"
+#: sessions migrate on capacity loss, "batch" sessions are re-queued
+SLO_CLASSES = ("latency", "batch")
+
+
 @dataclass
 class Request:
     """One serving request: ``prompt`` token ids, up to
     ``max_new_tokens`` generated (greedy), optional ``eos`` stop id
-    (emitted, then the session finishes)."""
+    (emitted, then the session finishes).  ``slo`` is the request's
+    service class (:data:`SLO_CLASSES`) — a single engine ignores it;
+    the elastic fleet (serve/elastic.py) migrates latency-tier sessions
+    on a shrink and sheds batch-tier ones first (re-queued, not
+    dropped)."""
     rid: str
     prompt: Tuple[int, ...]
     max_new_tokens: int
     eos: Optional[int] = None
+    slo: str = "latency"
 
     def __post_init__(self):
         self.prompt = tuple(int(t) for t in self.prompt)
@@ -72,6 +82,10 @@ class Request:
             raise ValueError(
                 f"request {self.rid}: max_new_tokens must be >= 1, got "
                 f"{self.max_new_tokens}")
+        if self.slo not in SLO_CLASSES:
+            raise ValueError(
+                f"request {self.rid}: slo must be one of {SLO_CLASSES}, "
+                f"got {self.slo!r}")
 
 
 @dataclass
@@ -159,10 +173,7 @@ class Scheduler:
 
     # -- intake ------------------------------------------------------------
 
-    def submit(self, request: Request) -> None:
-        """Queue a request (FIFO).  Requests that can NEVER fit — more
-        positions than the model or the whole pool can hold — are
-        rejected now, loudly, instead of deadlocking the queue head."""
+    def _reject_never_fit(self, request: Request) -> None:
         need = len(request.prompt) + request.max_new_tokens \
             + self.pos_slack
         blocks_need = blocks_for(need, self.pool.block_size)
@@ -175,7 +186,33 @@ class Scheduler:
                 f"request {request.rid}: {need} positions exceed "
                 f"max_positions {self.max_positions} / pool capacity "
                 f"{cap_blocks * self.pool.block_size}")
+
+    def submit(self, request: Request) -> None:
+        """Queue a request (FIFO).  Requests that can NEVER fit — more
+        positions than the model or the whole pool can hold — are
+        rejected now, loudly, instead of deadlocking the queue head."""
+        self._reject_never_fit(request)
         self.queue.append(Session(request, -1))
+
+    def submit_recompute(self, request: Request, out) -> None:
+        """Queue a request whose first ``len(out)`` tokens were already
+        generated on ANOTHER engine (a session shed or lost during a
+        fleet shrink, re-homed here).  Admission treats it exactly like
+        a locally preempted session: re-prefill ``prompt + out[:-1]``
+        with ``out[-1]`` pending — greedy decode makes the continuation
+        bitwise the one the shrink interrupted (the preemption pin)."""
+        self._reject_never_fit(request)
+        s = Session(request, -1)
+        s.state = QUEUED
+        out = [int(t) for t in out]
+        if out:
+            s.out = out
+            s.prefill_src = request.prompt + tuple(out[:-1])
+            s.emit_on_prefill = False
+            s.pending_tok = out[-1]
+        else:
+            s.prefill_src = request.prompt
+        self.queue.append(s)
 
     def _backlog_tokens(self) -> int:
         return sum(s.prefill_remaining for s in self.sessions
@@ -255,14 +292,14 @@ class Scheduler:
         table.extend(ids)
         return True
 
-    def preempt_for(self, needy: Session) -> Optional[Session]:
-        """Evict the last-admitted live session other than ``needy``
-        (or ``needy`` itself if it is alone — it re-queues with its
-        progress and re-admits when blocks exist).  Freed state:
-        ALL the victim's blocks; the victim re-enters the queue FRONT
-        in recompute mode."""
-        victims = [s for s in self.sessions if s is not needy]
-        victim = max(victims, key=lambda s: s.seq) if victims else needy
+    def evict(self, victim: Session) -> Session:
+        """Free a live session's blocks (BOTH tables) and detach it
+        from the live set in recompute mode, WITHOUT re-queueing it
+        locally — local preemption (:meth:`preempt_for`) re-queues at
+        the queue front; the elastic fleet instead re-homes the evicted
+        session to another engine (its shed path).  Either way the
+        recompute re-prefill of ``prompt + out[:-1]`` continues
+        bitwise."""
         self.pool.free(b for b in victim.table if b != NULL_BLOCK)
         self.pool.free(b for b in victim.draft_table
                        if b != NULL_BLOCK)
@@ -284,6 +321,17 @@ class Scheduler:
             victim.prefill_src = victim.request.prompt
             victim.emit_on_prefill = True
             victim.pending_tok = None
+        return victim
+
+    def preempt_for(self, needy: Session) -> Optional[Session]:
+        """Evict the last-admitted live session other than ``needy``
+        (or ``needy`` itself if it is alone — it re-queues with its
+        progress and re-admits when blocks exist).  Freed state:
+        ALL the victim's blocks; the victim re-enters the queue FRONT
+        in recompute mode."""
+        victims = [s for s in self.sessions if s is not needy]
+        victim = max(victims, key=lambda s: s.seq) if victims else needy
+        self.evict(victim)
         self.queue.appendleft(victim)
         return victim
 

@@ -2521,6 +2521,101 @@ def run_serve(args):
     return 0
 
 
+def serve_elastic_bench_records(n_requests=24, seed=0, n_engines=3,
+                                num_blocks=48, block_size=8,
+                                max_batch=4, prefill_chunk=4,
+                                snapshot_every=2, miss_threshold=2):
+    """``serve_elastic_recovery`` stage: the membership-backed
+    :class:`~apex_tpu.serve.ServeFleet` through one full
+    detect→shed→migrate→resume cycle — a replica hosting live
+    sessions is chaos-felled mid-decode, the coordinator publishes
+    the shrink epoch, batch-tier sessions are re-queued, latency-tier
+    sessions restore from their committed snapshots into survivor
+    pools, and every request still completes.  CPU-forced with
+    SimClock + MemoryKV like the cluster bench, so ``detect_ms`` /
+    ``migrate_ms`` measure the RUNTIME's bookkeeping (scan, manifest
+    reads, block scatter), not accelerator speed.  One record."""
+    import random
+    import shutil
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import apex_tpu.nn as nn
+    from apex_tpu.models.gpt import GptModel
+    from apex_tpu.runtime import chaos
+    from apex_tpu.serve import Request, ServeFleet
+
+    nn.manual_seed(6)
+    model = GptModel(vocab_size=73, hidden=32, layers=2, heads=4,
+                     max_positions=96, dropout=0.0,
+                     attn_dropout=0.0).eval()
+    rng = random.Random(seed)
+    reqs = [Request(f"b{i}",
+                    tuple(rng.randrange(1, 70)
+                          for _ in range(rng.randrange(2, 10))),
+                    rng.randrange(4, 12))
+            for i in range(n_requests)]
+    slos = [rng.choice(("latency", "batch")) for _ in range(n_requests)]
+
+    def _kill(member_id):
+        def act(ctx):
+            if ctx.get("member") == member_id:
+                raise chaos.ChaosKilled(f"bench: felled {member_id}")
+        return act
+
+    snap_root = tempfile.mkdtemp(prefix="apex_serve_elastic_bench_")
+    try:
+        with chaos.session(seed=seed) as c:
+            # fell one replica once the fleet is warm: past the first
+            # snapshot cadence, with sessions mid-decode everywhere
+            kill_after = n_engines * (3 * snapshot_every + 2)
+            c.on("host.loss", _kill("serve0"), after=kill_after,
+                 times=-1)
+            fleet = ServeFleet(
+                model, n_engines=n_engines, num_blocks=num_blocks,
+                block_size=block_size, max_batch=max_batch,
+                prefill_chunk=prefill_chunk,
+                snapshot_every=snapshot_every,
+                miss_threshold=miss_threshold, snapshot_dir=snap_root)
+            with fleet:
+                fleet.join()
+                results = fleet.run(reqs, slos=slos)
+                m = fleet.metrics()
+    finally:
+        shutil.rmtree(snap_root, ignore_errors=True)
+
+    if len(results) != n_requests:
+        fail(f"serve_elastic_incomplete: {len(results)} of "
+             f"{n_requests} requests completed across the shrink")
+    return [{
+        "metric": "serve_elastic_recovery",
+        "platform": "cpu",
+        "engines": n_engines,
+        "requests": n_requests,
+        "completed": len(results),
+        "epoch": m["epoch"],
+        "detect_ms": m["detect_ms"],
+        "migrate_ms": m["migrate_ms"],
+        "sessions_migrated": m["sessions_migrated"],
+        "sessions_shed_requeued": m["sessions_shed_requeued"],
+        "sessions_recomputed": m["sessions_recomputed"],
+        "snapshot_bytes_peak_host": m["snapshot_bytes_peak_host"],
+    }]
+
+
+def run_serve_elastic(args):
+    stage("serve_elastic",
+          "membership-backed serve fleet through one "
+          "detect→shed→migrate→resume cycle (chaos host loss "
+          "mid-decode), cpu")
+    for rec in serve_elastic_bench_records():
+        emit(rec)
+        register_record(rec)
+    return 0
+
+
 def ckpt_microbench_records(total_mb=64, n_tensors=32, repeats=3,
                             directory=None):
     """``ckpt_save_ms`` microbench: CheckpointManager sync save vs async
@@ -3201,6 +3296,15 @@ def main():
                          "ttft_p50_ms, pool_occupancy, decode_compiles}; "
                          "decode_compiles must stay within bucket_bound "
                          "(recompile-free decode after warmup)")
+    ap.add_argument("--serve-elastic", action="store_true",
+                    help="serve_elastic_recovery stage: the "
+                         "membership-backed ServeFleet through one full "
+                         "detect→shed→migrate→resume cycle under chaos "
+                         "host loss, CPU-forced — emits {detect_ms, "
+                         "migrate_ms, sessions_migrated, "
+                         "sessions_shed_requeued, sessions_recomputed, "
+                         "snapshot_bytes_peak_host, epoch}; every "
+                         "request must complete across the shrink")
     ap.add_argument("--budget-s", type=float,
                     default=float(os.environ.get("GRAFT_BENCH_BUDGET_S", 540)))
     args = ap.parse_args()
@@ -3240,6 +3344,10 @@ def main():
     if args.serve:
         start_watchdog(args.budget_s)
         return run_serve(args)
+
+    if args.serve_elastic:
+        start_watchdog(args.budget_s)
+        return run_serve_elastic(args)
 
     if args.plan:
         start_watchdog(args.budget_s)

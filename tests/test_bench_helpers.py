@@ -285,6 +285,27 @@ def test_observe_microbench_records_schema():
     assert d16["overhead_pct"] < allowed, d16
 
 
+def test_serve_elastic_bench_records_schema(tmp_path):
+    """--serve-elastic stage: one serve_elastic_recovery record for a
+    full detect→shed→migrate→resume cycle — every request completes
+    across the shrink, the epoch advanced past the host loss, and the
+    recovery split (migrated / shed-requeued / recomputed) accounts
+    for at least one session actually re-homed."""
+    recs = bench.serve_elastic_bench_records(n_requests=12)
+    (r,) = recs
+    assert r["metric"] == "serve_elastic_recovery"
+    assert r["platform"] == "cpu"
+    assert r["engines"] >= 2
+    assert r["completed"] == r["requests"] == 12
+    assert r["epoch"] >= 2                   # join epoch + the host loss
+    assert r["detect_ms"] >= 0.0
+    assert r["migrate_ms"] >= 0.0
+    assert r["sessions_migrated"] + r["sessions_shed_requeued"] + \
+        r["sessions_recomputed"] >= 1        # someone was re-homed
+    assert r["sessions_migrated"] >= 0
+    assert r["snapshot_bytes_peak_host"] > 0
+
+
 def test_serve_bench_records_schema():
     """--serve stage: the serving engine under a Poisson open-loop
     trace, one record per arm (unified / disaggregated / speculative).

@@ -367,3 +367,29 @@ def test_metrics_snapshot_schema(model):
     for kind in ("decode", "prefill"):
         assert set(m[kind]) == {"compiles", "cache_hits", "dispatches"}
         assert m[kind]["dispatches"] >= 1
+
+
+def test_close_returns_all_live_blocks(model):
+    """close() mid-run returns every live session's blocks — target
+    AND draft tables — so check_no_leaks holds even with sessions
+    still decoding; the context-manager form does the same."""
+    from apex_tpu.inference import make_self_draft
+    eng = ServeEngine(model, num_blocks=48, block_size=8, max_batch=4,
+                      prefill_chunk=4, draft=make_self_draft(model))
+    for i, p in enumerate([[5, 9, 11, 3], [7, 2], [12, 30, 4]]):
+        eng.submit(Request(f"c{i}", p, 12))
+    for _ in range(4):                    # mid-flight: live sessions
+        eng.step()
+    assert eng.scheduler.sessions         # something is decoding
+    assert eng.block_pool.in_use > 0
+    eng.close()                           # runs check_no_leaks itself
+    assert eng.block_pool.in_use == 0
+    assert not eng.scheduler.has_work()
+
+    with ServeEngine(model, num_blocks=32, block_size=8, max_batch=2,
+                     prefill_chunk=4) as eng2:
+        eng2.submit(Request("cm", [3, 4, 5], 8))
+        eng2.step()
+        eng2.step()
+        assert eng2.block_pool.in_use > 0
+    assert eng2.block_pool.in_use == 0

@@ -1,0 +1,388 @@
+"""Elastic serving end to end: a membership-backed ServeFleet under
+seeded chaos.  Pins the acceptance surface of the serve.elastic
+subsystem — live session migration is BITWISE the uninterrupted
+engine's continuation (fp32, int8, and mid-speculative-decode), shrink
+sheds the batch tier first (re-queued, never dropped) while the
+latency tier migrates, stale-epoch submissions are refused, kill
+mid-snapshot leaves only rejectable debris, kill mid-migrate fells the
+adopter but the session still completes, a coordinator felled
+mid-migration is succeeded without losing the recovery queue, a
+delayed-but-alive replica never triggers migration, and fleet-wide
+FIFO admission order survives re-homing.  All on CPU with SimClock +
+MemoryKV, like test_cluster.py."""
+import os
+
+import pytest
+
+from apex_tpu import nn
+from apex_tpu.inference import make_self_draft
+from apex_tpu.models.gpt import GptModel
+from apex_tpu.runtime import chaos
+from apex_tpu.runtime.resilience import (CheckpointCorruptError,
+                                         read_kv_handoff_meta,
+                                         stream_kv_handoff)
+from apex_tpu.serve import (Request, SLO_CLASSES, ServeEngine, ServeFleet,
+                            StaleEpochError)
+from apex_tpu.serve.pool import BlockPool, init_pool_buffer
+
+pytestmark = pytest.mark.elastic_serve
+
+PROMPTS = [[5, 9, 11, 3], [7, 2], [1, 2, 3, 4, 5, 6, 7, 8, 9], [12, 30, 4]]
+MAX_NEW = 6
+SLOS = ["latency", "batch", "latency", "batch"]
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    yield
+    chaos.uninstall()
+
+
+@pytest.fixture(scope="module")
+def model():
+    nn.manual_seed(6)
+    m = GptModel(vocab_size=73, hidden=32, layers=2, heads=4,
+                 max_positions=96, dropout=0.0, attn_dropout=0.0)
+    return m.eval()
+
+
+def _reqs():
+    return [Request(f"r{i}", tuple(p), MAX_NEW)
+            for i, p in enumerate(PROMPTS)]
+
+
+@pytest.fixture(scope="module")
+def base(model):
+    return _unified_out(model)
+
+
+def _unified_out(model, *, cache_dtype=None, draft=None):
+    eng = ServeEngine(model, num_blocks=64, block_size=8, max_batch=4,
+                      prefill_chunk=4, cache_dtype=cache_dtype,
+                      draft=draft)
+    out = eng.run(_reqs())
+    eng.block_pool.check_no_leaks()
+    return out
+
+
+def _fleet(model, tmp_path, **kw):
+    kw.setdefault("n_engines", 2)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("snapshot_every", 2)
+    kw.setdefault("miss_threshold", 2)
+    kw.setdefault("snapshot_dir", str(tmp_path / "snaps"))
+    return ServeFleet(model, **kw)
+
+
+def _kill_member(member_id):
+    def act(ctx):
+        if ctx.get("member") == member_id:
+            raise chaos.ChaosKilled(f"chaos: felled {member_id}")
+    return act
+
+
+def _assert_no_leaks(fleet):
+    for m in fleet.members.values():
+        if not m.closed:
+            m.engine.block_pool.check_no_leaks()
+
+
+# -- fleet basics ----------------------------------------------------------
+
+def test_fleet_parity_no_chaos(model, base, tmp_path):
+    with _fleet(model, tmp_path) as fleet:
+        fleet.join()
+        out = fleet.run(_reqs(), slos=SLOS)
+        m = fleet.metrics()
+        assert out == base
+        assert m["epoch"] == 1
+        assert m["completed"] == len(PROMPTS)
+        assert m["sessions_migrated"] == 0
+        assert m["snapshot_bytes_peak_host"] > 0
+        _assert_no_leaks(fleet)
+
+
+def test_submit_validation(model, tmp_path):
+    with _fleet(model, tmp_path) as fleet:
+        with pytest.raises(RuntimeError, match="join"):
+            fleet.submit(Request("x", (1, 2), 2))
+        fleet.join()
+        fleet.submit(Request("a", (1, 2), 2), slo="batch")
+        with pytest.raises(ValueError, match="duplicate"):
+            fleet.submit(Request("a", (1, 2), 2))
+        with pytest.raises(ValueError, match="slo"):
+            fleet.submit(Request("b", (1, 2), 2), slo="bulk")
+        assert set(SLO_CLASSES) == {"latency", "batch"}
+
+
+def test_stale_epoch_refused(model, tmp_path):
+    with chaos.session(seed=0) as c:
+        c.on("host.loss", _kill_member("serve0"), after=4, times=-1)
+        with _fleet(model, tmp_path) as fleet:
+            fleet.join()
+            # epoch 1 is current: an epoch-addressed submit is accepted
+            fleet.submit(Request("e1", (3, 4, 5), 3), epoch=1)
+            while fleet.metrics()["epoch"] < 2:
+                fleet.step()
+            with pytest.raises(StaleEpochError):
+                fleet.submit(Request("e2", (3, 4), 3), epoch=1)
+            fleet.submit(Request("e3", (3, 4), 3), epoch=2)
+            while fleet.has_work():
+                fleet.step()
+            assert set(fleet.results) == {"e1", "e3"}
+            _assert_no_leaks(fleet)
+
+
+# -- migration parity ------------------------------------------------------
+
+@pytest.mark.parametrize("cache_dtype", [None, "int8"],
+                         ids=["fp32", "int8"])
+def test_migration_bitwise_parity(model, base, tmp_path, cache_dtype):
+    if cache_dtype is not None:
+        base = _unified_out(model, cache_dtype=cache_dtype)
+    with chaos.session(seed=0) as c:
+        c.on("host.loss", _kill_member("serve0"), after=10, times=-1)
+        with _fleet(model, tmp_path, cache_dtype=cache_dtype) as fleet:
+            fleet.join()
+            out = fleet.run(_reqs(), slos=SLOS)
+            m = fleet.metrics()
+    assert out == base
+    assert m["epoch"] >= 2
+    assert m["sessions_migrated"] >= 1
+    assert m["detect_ms"] >= 0.0 and m["migrate_ms"] > 0.0
+
+
+def test_migration_mid_spec_decode(model, tmp_path):
+    """A session migrated mid-speculative-decode restores its target
+    KV verbatim, gets an EMPTY draft table, catches up through the
+    survivor's prefill slot, and continues bitwise."""
+    draft = make_self_draft(model)
+    # spec decode emits up to k+1 tokens a tick — longer generations
+    # keep sessions mid-flight across the detection window
+    reqs = [Request(f"s{i}", tuple(p), 16)
+            for i, p in enumerate(PROMPTS)]
+    eng = ServeEngine(model, num_blocks=64, block_size=8, max_batch=4,
+                      prefill_chunk=4, draft=draft)
+    base = eng.run([Request(f"s{i}", tuple(p), 16)
+                    for i, p in enumerate(PROMPTS)])
+    eng.block_pool.check_no_leaks()
+    with chaos.session(seed=0) as c:
+        c.on("host.loss", _kill_member("serve0"), after=6, times=-1)
+        with _fleet(model, tmp_path, num_blocks=48,
+                    draft=draft) as fleet:
+            fleet.join()
+            out = fleet.run(reqs, slos=SLOS)
+            m = fleet.metrics()
+    assert out == base
+    assert m["sessions_migrated"] >= 1
+
+
+def test_shrink_sheds_batch_tier_first(model, base, tmp_path):
+    """Capacity loss: batch tier is re-queued (NEVER dropped), latency
+    tier migrates; everyone still completes, bitwise."""
+    with chaos.session(seed=0) as c:
+        # serve1 is where headroom routing homes the batch tier here
+        c.on("host.loss", _kill_member("serve1"), after=8, times=-1)
+        with _fleet(model, tmp_path, num_blocks=24) as fleet:
+            fleet.join()
+            for r, s in zip(_reqs(), SLOS):
+                fleet.submit(r, slo=s)
+            shed_rids = set()
+            while fleet.has_work():
+                fleet.step()
+                shed_rids |= {rid for rid, mid
+                              in fleet.assignments().items()
+                              if mid is None and rid in fleet._queue}
+            out = dict(fleet.results)
+            m = fleet.metrics()
+    assert out == base                       # zero requests dropped
+    assert m["completed"] == len(PROMPTS)
+    assert m["sessions_shed_requeued"] >= 1  # batch tier shed, re-queued
+    # shedding only ever names the batch tier: no latency-tier session
+    # was ever returned to the fleet queue as shed
+    assert all(fleet.slo_of(rid) == "batch" for rid in shed_rids)
+
+
+def test_stale_snapshot_falls_back_to_recompute(model, base, tmp_path):
+    """snapshot_max_age_ticks=0 declares every snapshot stale: no
+    migration happens, every lost latency session recomputes — still
+    bitwise (the recompute path is the preemption path, already
+    pinned)."""
+    with chaos.session(seed=0) as c:
+        c.on("host.loss", _kill_member("serve0"), after=10, times=-1)
+        with _fleet(model, tmp_path,
+                    snapshot_max_age_ticks=0) as fleet:
+            fleet.join()
+            out = fleet.run(_reqs(), slos=SLOS)
+            m = fleet.metrics()
+    assert out == base
+    assert m["sessions_migrated"] == 0
+    assert m["sessions_recomputed"] >= 1
+
+
+# -- chaos durability ------------------------------------------------------
+
+def test_kill_mid_snapshot_debris_rejected(model, base, tmp_path):
+    """A replica killed half-way through a session snapshot leaves a
+    manifest-less shard directory.  Recovery finds it, rejects it
+    (CheckpointCorruptError → debris counter), and completes the
+    session through an older snapshot or recompute — bitwise either
+    way.  Debris is never adopted."""
+    with chaos.session(seed=0) as c:
+        # the 1st block file of the 1st snapshot round dies mid-stream
+        c.on("serve.kv_handoff", "kill", at=0)
+        with _fleet(model, tmp_path) as fleet:
+            fleet.join()
+            out = fleet.run(_reqs(), slos=SLOS)
+            m = fleet.metrics()
+            assert any(p == "serve.kv_handoff" for p, _, _ in c.log)
+    assert out == base
+    assert m["epoch"] >= 2
+    assert m["debris_rejected"] >= 1
+    assert m["sessions_recomputed"] + m["sessions_migrated"] >= 1
+
+
+def test_kill_mid_migrate_adopter_fells(model, base, tmp_path):
+    """The ADOPTING replica dies mid-restore: the snapshot stays on
+    shared storage, recovery resumes on whoever survives, and the
+    session completes bitwise."""
+    with chaos.session(seed=0) as c:
+        c.on("host.loss", _kill_member("serve0"), after=15, times=-1)
+        c.on("serve.migrate", "kill", at=0)
+        with _fleet(model, tmp_path, n_engines=3,
+                    num_blocks=24) as fleet:
+            fleet.join()
+            out = fleet.run(_reqs(), slos=SLOS)
+            m = fleet.metrics()
+            felled = [mid for mid, mm in fleet.members.items()
+                      if mm.closed]
+    assert out == base
+    assert len(felled) >= 2          # the victim AND the adopter died
+    assert m["completed"] == len(PROMPTS)
+
+
+def test_migrate_fail_abandons_cleanly(model, base, tmp_path):
+    """An injected recoverable fault during restore abandons the
+    migration cleanly — the session falls back to recompute and still
+    completes bitwise."""
+    with chaos.session(seed=0) as c:
+        c.on("host.loss", _kill_member("serve0"), after=10, times=-1)
+        c.on("serve.migrate", "fail", at=0)
+        with _fleet(model, tmp_path) as fleet:
+            fleet.join()
+            out = fleet.run(_reqs(), slos=SLOS)
+            m = fleet.metrics()
+    assert out == base
+    assert m["sessions_recomputed"] >= 1
+
+
+def test_coordinator_loss_mid_migration(model, base, tmp_path):
+    """The coordinator dies while the recovery queue is still
+    draining (migrate_per_tick=1 spreads the drain over ticks).  The
+    successor keeps epochs monotonic and the front-end's recovery
+    queue survives the succession: every migration completes (or
+    cleanly falls back) — manifest-commits-last means no half-adopted
+    session can exist."""
+    with chaos.session(seed=0) as c:
+        c.on("host.loss", _kill_member("serve0"), after=10, times=-1)
+        # scans 0.. are join + steps; fell the coordinator a few scans
+        # after the death is detectable, i.e. mid-recovery
+        c.on("coordinator.loss", "kill", at=8)
+        with _fleet(model, tmp_path, migrate_per_tick=1) as fleet:
+            fleet.join()
+            out = fleet.run(_reqs(), slos=SLOS)
+            m = fleet.metrics()
+            assert any(p == "coordinator.loss" for p, _, _ in c.log)
+    assert out == base
+    assert m["epoch"] >= 2           # monotonic across the succession
+    assert m["completed"] == len(PROMPTS)
+
+
+def test_heartbeat_delay_never_migrates(model, base, tmp_path):
+    """A delayed-but-alive replica (skew under miss_threshold
+    consecutive misses) must NOT produce a new epoch or trigger
+    migration — the false-positive guard holds through the serve
+    fleet."""
+    with chaos.session(seed=0) as c:
+        c.on("heartbeat.delay",
+             lambda ctx: 0.3 if ctx.get("member") == "serve0" else None,
+             at=4)
+        with _fleet(model, tmp_path) as fleet:
+            fleet.join()
+            out = fleet.run(_reqs(), slos=SLOS)
+            m = fleet.metrics()
+            assert any(p == "heartbeat.delay" for p, _, _ in c.log)
+    assert out == base
+    assert m["epoch"] == 1
+    assert m["sessions_migrated"] == 0
+    assert m["sessions_recomputed"] == 0
+
+
+# -- FIFO fairness (property-style) ----------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fifo_order_preserved_across_rehoming(model, tmp_path, seed):
+    """Fleet-wide FIFO: at every tick, every engine's admission queue
+    is sorted by fleet submission order — a re-homed session with an
+    older seat admits AHEAD of a survivor's younger native entries.
+    Randomized SLO mix and kill timing per seed."""
+    import random
+    rng = random.Random(seed)
+    n = 6
+    reqs = [Request(f"p{i}", tuple(rng.randrange(1, 70)
+                                   for _ in range(rng.randrange(2, 8))),
+                    4) for i in range(n)]
+    slos = [rng.choice(SLO_CLASSES) for _ in range(n)]
+    kill_after = rng.randrange(6, 14)
+    with chaos.session(seed=seed) as c:
+        c.on("host.loss", _kill_member("serve1"), after=kill_after,
+             times=-1)
+        with _fleet(model, tmp_path, num_blocks=20,
+                    max_batch=2) as fleet:
+            fleet.join()
+            for r, s in zip(reqs, slos):
+                fleet.submit(r, slo=s)
+            seq_of = {rid: rec.seq for rid, rec in fleet._recs.items()}
+            ticks = 0
+            while fleet.has_work():
+                fleet.step()
+                ticks += 1
+                assert ticks < 500, "fleet failed to converge"
+                for m in fleet.members.values():
+                    if m.closed:
+                        continue
+                    seqs = [seq_of[s.rid]
+                            for s in m.engine.scheduler.queue]
+                    assert seqs == sorted(seqs), (
+                        f"engine queue out of fleet FIFO order: {seqs}")
+            assert set(fleet.results) == {r.rid for r in reqs}
+            _assert_no_leaks(fleet)
+
+
+# -- snapshot meta plumbing ------------------------------------------------
+
+def test_kv_handoff_extra_meta_roundtrip(tmp_path):
+    """extra_meta rides in the manifest (commits LAST, so committed
+    meta implies committed KV) and read_kv_handoff_meta validates
+    without touching block files; a manifest-less dir is debris."""
+    pool = init_pool_buffer(layers=1, heads=2, head_dim=4,
+                            num_blocks=8, block_size=4, dtype=None)
+    bp = BlockPool(8, 4)
+    table = bp.alloc(2)
+    d = str(tmp_path / "snap0")
+    meta = {"rid": "r0", "out": [1, 2, 3], "pending_tok": 3,
+            "position": 7, "slo": "latency", "tick": 4, "epoch": 2}
+    manifest, _peak = stream_kv_handoff(d, pool, table,
+                                        source="snapshot:r0",
+                                        extra_meta=meta)
+    assert manifest["meta"] == meta
+    back = read_kv_handoff_meta(d)
+    assert back["meta"] == meta and back["n_blocks"] == 2
+    os.remove(os.path.join(d, "KV_MANIFEST.pkl"))
+    with pytest.raises(CheckpointCorruptError, match="manifest"):
+        read_kv_handoff_meta(d)
+    bp.free(table)
+    bp.check_no_leaks()
