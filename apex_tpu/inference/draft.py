@@ -18,6 +18,15 @@ exact for ANY draft; see speculative.py).  Two entry points:
   (:func:`apex_tpu.training.step.make_train_step` + ``FusedAdam``), so
   draft training inherits the runtime's compile-once discipline.
 
+:func:`make_distill_step` is the persistent core both entry points and
+the rollout runtime share: optimizer + fused step built ONCE, then
+``dstep(xs)`` labels and steps for as long as the job lives.
+``train_draft`` used to rebuild the optimizer per call — fine for a
+one-shot offline distill, wrong for *online* distillation where the
+draft trains continuously against live acceptance telemetry
+(``apex_tpu.rollout.OnlineDistiller``): Adam moments and the compiled
+program must survive across publish windows.
+
 ``apex_tpu.serve`` consumes drafts only through this module and
 :func:`~apex_tpu.inference.speculative.speculative_generate`'s public
 surface — the serve engine never reaches into speculative.py
@@ -30,7 +39,7 @@ import copy
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["make_self_draft", "train_draft"]
+__all__ = ["make_self_draft", "make_distill_step", "train_draft"]
 
 
 def make_self_draft(target):
@@ -44,6 +53,50 @@ def make_self_draft(target):
     return draft
 
 
+class DistillStep:
+    """Persistent hard-label distillation step (see
+    :func:`make_distill_step`).  ``self.step`` is the underlying fused
+    :class:`~apex_tpu.training.step.TrainStep` — its ``state`` is what a
+    rollout checkpoint saves so a resumed job keeps the draft's Adam
+    moments and loss-scale history (loss-trajectory reproducibility)."""
+
+    def __init__(self, draft, target, *, lr=1e-3):
+        from .. import nn as _nn
+        from ..optimizers.fused_adam import FusedAdam
+        from ..training.step import make_train_step
+
+        target.eval()
+        draft.train()
+        self.draft = draft
+        self.target = target
+        self.optimizer = FusedAdam(list(draft.parameters()), lr=lr)
+        self.step = make_train_step(
+            draft, self.optimizer,
+            lambda o, t: _nn.functional.cross_entropy(
+                o.reshape((-1, o.shape[-1])), t.reshape((-1,))))
+        self.calls = 0
+
+    def __call__(self, xs) -> float:
+        """Label ``xs`` (B,S int ids) with the live target's argmax and
+        take one fused step on the draft.  The target is read at CALL
+        time — when it is a serve engine's hot-swapped model, labels
+        track the published weights automatically."""
+        xs = jnp.asarray(np.asarray(xs, np.int32))
+        labels = np.argmax(
+            np.asarray(self.target(xs)), -1).astype(np.int32)
+        loss = float(self.step(xs, jnp.asarray(labels)))
+        self.calls += 1
+        return loss
+
+
+def make_distill_step(draft, target, *, lr=1e-3) -> DistillStep:
+    """Build the persistent distillation step: one ``FusedAdam`` + one
+    fused train step over ``draft``, labels from ``target``'s argmax.
+    Call the result with ``(B,S)`` id batches for as long as the job
+    lives — compile-once, moments persist."""
+    return DistillStep(draft, target, lr=lr)
+
+
 def train_draft(draft, target, tokens, *, steps=50, batch_size=8,
                 seq_len=32, lr=1e-3, seed=0):
     """Distill ``draft`` toward ``target``'s greedy labels over a token
@@ -53,34 +106,22 @@ def train_draft(draft, target, tokens, *, steps=50, batch_size=8,
     draws ``batch_size`` random ``seq_len`` windows, labels every
     position with the TARGET's argmax next-token prediction (hard-label
     distillation — exactly the event the acceptance rule tests), and
-    takes one fused train step on the draft.  Returns the per-step loss
+    takes one fused train step on the draft (one
+    :func:`make_distill_step`, built once).  Returns the per-step loss
     list (monitoring only; the metric that matters is the acceptance
     rate the served draft achieves).
     """
-    from .. import nn as _nn
-    from ..optimizers.fused_adam import FusedAdam
-    from ..training.step import make_train_step
-
     tokens = np.asarray(tokens, np.int32).reshape(-1)
     if tokens.size < seq_len + 1:
         raise ValueError(
             f"train_draft needs at least seq_len+1={seq_len + 1} "
             f"tokens, got {tokens.size}")
-    target.eval()
-    draft.train()
-    opt = FusedAdam(list(draft.parameters()), lr=lr)
-    step = make_train_step(
-        draft, opt,
-        lambda o, t: _nn.functional.cross_entropy(
-            o.reshape((-1, o.shape[-1])), t.reshape((-1,))))
+    dstep = make_distill_step(draft, target, lr=lr)
     rng = np.random.default_rng(seed)
     losses = []
     for _ in range(int(steps)):
         starts = rng.integers(0, tokens.size - seq_len, size=batch_size)
         xs = np.stack([tokens[s:s + seq_len] for s in starts])
-        labels = np.argmax(
-            np.asarray(target(jnp.asarray(xs))), -1).astype(np.int32)
-        loss = step(jnp.asarray(xs), jnp.asarray(labels))
-        losses.append(float(loss))
+        losses.append(dstep(xs))
     draft.eval()
     return losses

@@ -1720,3 +1720,77 @@ class ClusterAssume(Rule):
                         f"os.environ[{node.slice.value!r}] — hardcoded "
                         f"process-count arithmetic outside the "
                         f"launcher seam")
+
+
+# ---------------------------------------------------------------------------
+# WEIGHT-PUBLISH
+# ---------------------------------------------------------------------------
+
+#: identifier fragments that name model/optimizer state pytrees — the
+#: things whose placement must stay measured (raw movement of a batch
+#: named `images` or a telemetry leaf is fine)
+_WEIGHTY = ("param", "master", "weight", "state")
+
+
+@register
+class WeightPublish(Rule):
+    """Raw device placement of model-parameter pytrees — PR 18.
+
+    ``jax.device_put`` / ``jax.device_get`` of weights outside the
+    sanctioned seams is weight movement the runtime cannot see: it
+    skips ``reshard_state``'s layout-identical zero-copy fast path, its
+    dtype/shape validation, and the per-leaf hit stats every measured
+    sync reports — the incident (docs/lint.md) was a rollout publish
+    hand-rolled with ``device_get``+``device_put`` that silently
+    gathered 100% of the masters to host every epoch and re-placed
+    them, turning a zero-copy swap into the slowest stage of the loop.
+    Weight movement goes through ``runtime/resilience.py`` (reshard /
+    checkpoint), the ``parallel/`` placement layer, or the rollout
+    publish path (``apex_tpu/rollout/publish.py``).
+    """
+    id = "WEIGHT-PUBLISH"
+    summary = "raw device_put/device_get of a parameter pytree"
+    hint = ("move weights through the measured surfaces — "
+            "runtime.resilience.reshard_state (validated, zero-copy "
+            "where layouts match, per-leaf stats) or "
+            "rollout.WeightPublisher (cast-once, versioned, telemetered)"
+            " — raw placement is invisible to the sync accounting")
+
+    _CALLS = {"jax.device_put", "jax.device_get"}
+
+    @staticmethod
+    def _weighty_arg(arg: ast.AST) -> Optional[str]:
+        """The first weight-ish identifier fragment in the arg subtree
+        ('master_params', 'step.state', ...), else None."""
+        for sub in ast.walk(arg):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name is None:
+                continue
+            low = name.lower()
+            if any(t in low for t in _WEIGHTY):
+                return name
+        return None
+
+    def check(self, module, ctx):
+        path = module.path.replace("\\", "/")
+        if path.endswith("apex_tpu/runtime/resilience.py") \
+                or "apex_tpu/parallel/" in path \
+                or path.endswith("apex_tpu/rollout/publish.py"):
+            return      # the sanctioned weight-movement homes
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func) or ""
+            if d not in self._CALLS or not node.args:
+                continue
+            name = self._weighty_arg(node.args[0])
+            if name is not None:
+                yield self.finding(
+                    module, node,
+                    f"{d}({name}, ...) — raw placement of what looks "
+                    f"like model/optimizer state; unmeasured weight "
+                    f"movement bypasses the reshard surface")

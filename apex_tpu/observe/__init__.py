@@ -9,10 +9,14 @@ One telemetry choke point for the whole library:
 - :mod:`telemetry` — the jit-safe on-device step accumulator carried in
   ``StepState.telem`` (the one submodule allowed inside traced code).
 - :mod:`watchdog` — heartbeat thread firing a typed stall diagnostic.
+- :mod:`catalog` — the documented name → meaning table for metric
+  consumers (dashboards, bench stages, tests).
 
 Everything except :mod:`telemetry` is host-side only; calls reachable
 from jit-traced code are flagged by the OBS-IN-JIT lint rule.
 """
+from .catalog import CATALOG, describe
+from .catalog import names as catalog_names
 from .registry import (SCHEMA_VERSION, Counter, Gauge, Histogram,
                        MetricsRegistry, counter, event, events, gauge,
                        get_registry, histogram)
@@ -26,4 +30,5 @@ __all__ = [
     "span", "last_span",
     "StepTelemetry", "init_telemetry", "accumulate",
     "StallWatchdog", "heartbeat", "last_heartbeat", "STALL_HINT",
+    "CATALOG", "describe", "catalog_names",
 ]

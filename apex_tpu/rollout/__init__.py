@@ -1,0 +1,20 @@
+"""apex_tpu.rollout — the generate-then-train runtime.
+
+Closes the serve/train loop the repo has so far run only in separate
+jobs: a :class:`RolloutRuntime` drives a ServeEngine generating
+continuations while the fused train step consumes completed rollouts
+from a bounded-staleness :class:`RolloutBuffer`, and trainer weights
+flow back serve-ward through the measured, versioned
+:class:`WeightPublisher` (reshard_state + the layout-identical
+zero-copy fast path).  :class:`OnlineDistiller` is the first concrete
+scenario: the speculative draft trains continuously against live
+acceptance telemetry and publishes improved drafts into the engine's
+speculative pool.  See docs/rollout.md.
+"""
+from .buffer import RolloutBuffer, RolloutSample
+from .distill import OnlineDistiller
+from .publish import WeightPublisher, master_leaves
+from .runtime import RolloutRuntime
+
+__all__ = ["RolloutBuffer", "RolloutSample", "OnlineDistiller",
+           "WeightPublisher", "master_leaves", "RolloutRuntime"]

@@ -180,6 +180,16 @@ class ElasticTrainer:
             "reshard_ms": round(reshard_ms, 3),
             "resume_step": resume,
         }
+        # per-leaf placement accounting from reshard_state's stats_out
+        # (gathered restores; streamed restores report their own mode) —
+        # "how much of the restore was zero-copy" is now in the event
+        rstats = getattr(self.manager, "last_restore_stats", {}) or {}
+        if rstats:
+            self.telemetry["restore_mode"] = rstats.get("mode")
+            for k in ("zero_copy_leaves", "copied_leaves",
+                      "reshard_bytes_moved"):
+                if k in rstats:
+                    self.telemetry[k] = rstats[k]
         _obs.event("elastic.restore", **self.telemetry)
         _obs.histogram("elastic.replan_ms").observe(replan_ms)
         if resume is not None:

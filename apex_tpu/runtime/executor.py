@@ -63,6 +63,13 @@ TRAIN_KINDS = frozenset({"train_step", "zero_train_step",
 SERVE_KINDS = frozenset({"prefill_step", "decode_step",
                          "draft_prefill_step", "spec_verify_step"})
 
+#: rollout-loop kinds (apex_tpu.rollout): the generate-then-train
+#: runtime's own dispatches.  ``weight_publish`` is the one fused
+#: train→serve cast (masters cast once to the serve dtype in a single
+#: dispatch); like train/serve kinds it spans and heartbeats — a wedged
+#: publish stalls the whole loop, so the watchdog must see it.
+ROLLOUT_KINDS = frozenset({"weight_publish"})
+
 _UNSET = object()
 
 
@@ -262,7 +269,8 @@ class Executor:
         """
         fn = self.compile(program, args)
         self._cache._bump("dispatches", program.kind)
-        beat = program.kind in TRAIN_KINDS or program.kind in SERVE_KINDS
+        beat = (program.kind in TRAIN_KINDS or program.kind in SERVE_KINDS
+                or program.kind in ROLLOUT_KINDS)
         if beat or _sc._DISPATCH_SPANS:
             tags = {"kind": program.kind}
             if _CLUSTER_EPOCH is not None:

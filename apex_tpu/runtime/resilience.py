@@ -735,7 +735,7 @@ def read_checkpoint_file(path: str, *, return_manifest: bool = False,
 
 
 def reshard_state(host_state, target_state, *, component: str = "state",
-                  source: str = "<checkpoint>"):
+                  source: str = "<checkpoint>", stats_out=None):
     """Lay a host checkpoint pytree out under ``target_state``'s CURRENT
     shardings — the plan-B half of elastic restore.
 
@@ -758,6 +758,16 @@ def reshard_state(host_state, target_state, *, component: str = "state",
     device placement; the path is read-only on disk, so a kill here
     leaves the checkpoint loadable by the next attempt.
 
+    ``stats_out``, when a dict, is filled with per-leaf placement
+    accounting — which leaves took the zero-copy fast path and which
+    actually paid a copy: ``{"leaves", "zero_copy", "copied",
+    "bytes_moved", "per_leaf": [(name, mode), ...]}`` where ``mode`` is
+    ``"zero_copy"``, ``"device_put"`` or ``"host"``.  The return value
+    is unchanged; callers that don't pass it pay nothing.  Elastic
+    restore surfaces these in ``elastic.restore`` telemetry and the
+    rollout weight-publish path in ``rollout.weight_sync`` — "zero-copy
+    or priced" stops being a guess.
+
     Raises :class:`CheckpointReshardError` naming the component (and
     leaf, where one is identifiable) when the structures are
     incompatible — a checkpoint from a different model/optimizer config
@@ -773,6 +783,8 @@ def reshard_state(host_state, target_state, *, component: str = "state",
             f"({src_def.num_leaves} vs {tgt_def.num_leaves} leaves) — "
             f"different model/optimizer config")
     out = []
+    n_zero = n_copied = bytes_moved = 0
+    per_leaf = []
     for (path, tgt), src in zip(tgt_paths, src_leaves):
         if not isinstance(tgt, jax.Array):
             out.append(src)
@@ -801,8 +813,14 @@ def reshard_state(host_state, target_state, *, component: str = "state",
                 same = src.sharding == tgt.sharding
             if same:
                 out.append(src)
+                n_zero += 1
+                per_leaf.append((name, "zero_copy"))
                 continue
+        n_copied += 1
+        bytes_moved += int(np.prod(shp, dtype=np.int64)) \
+            * np.dtype(tgt.dtype).itemsize
         if isinstance(tgt.sharding, jax.sharding.NamedSharding):
+            per_leaf.append((name, "device_put"))
             out.append(jax.device_put(src, tgt.sharding))
         else:
             # single-device / replicated target (plain jit or the
@@ -811,7 +829,12 @@ def reshard_state(host_state, target_state, *, component: str = "state",
             # committing to the fresh state's literal device would pin a
             # shard_map's replicated operand to one device and fail
             import jax.numpy as jnp
+            per_leaf.append((name, "host"))
             out.append(jnp.asarray(src))
+    if stats_out is not None:
+        stats_out.update(leaves=n_zero + n_copied, zero_copy=n_zero,
+                         copied=n_copied, bytes_moved=bytes_moved,
+                         per_leaf=per_leaf)
     return jax.tree_util.tree_unflatten(tgt_def, out)
 
 
@@ -1274,12 +1297,16 @@ class CheckpointManager:
                     x.nbytes for x in
                     jax.tree_util.tree_leaves(host_state)
                     if isinstance(x, np.ndarray))
+                rs: dict = {}
                 train_step.state = reshard_state(
                     host_state, train_step.state, component="state",
-                    source=path)
-                self.last_restore_stats = {"mode": "gathered",
-                                           "schema": schema,
-                                           "peak_host_bytes": gathered}
+                    source=path, stats_out=rs)
+                self.last_restore_stats = {
+                    "mode": "gathered", "schema": schema,
+                    "peak_host_bytes": gathered,
+                    "zero_copy_leaves": rs.get("zero_copy", 0),
+                    "copied_leaves": rs.get("copied", 0),
+                    "reshard_bytes_moved": rs.get("bytes_moved", 0)}
             extras = {}
             for k, v in comps.items():
                 if k == "state":

@@ -148,6 +148,12 @@ class ServeEngine:
         self._spec_offered = 0
         self._spec_accepted = 0
         self.results: Dict[str, List[int]] = {}
+        # weight hot-swap bookkeeping (apex_tpu.rollout): monotonically
+        # growing epoch per weight set; every finished request is
+        # attributed to the target epoch it was ADMITTED under (epochs
+        # only grow, so that is the oldest weights any token saw)
+        self.weight_epochs: Dict[str, int] = {"target": 0, "draft": 0}
+        self.result_meta: Dict[str, dict] = {}
 
     @staticmethod
     def _validate_model(model):
@@ -283,6 +289,70 @@ class ServeEngine:
                    tick=self._tick, generated=len(s.out))
         return s
 
+    # -- weight hot-swap (apex_tpu.rollout) --------------------------------
+
+    def publish_weights(self, leaves, *, which: str = "target",
+                        epoch: Optional[int] = None) -> int:
+        """Swap the ``which`` model's parameter values between ticks —
+        the serve half of the rollout weight-publish path.
+
+        No program is invalidated: the bucketed serve programs pass
+        parameter VALUES as traced operands (``_vals()`` reads
+        ``p.data`` at every dispatch) and their static keys are
+        config-only, so rebinding ``.data`` on the SAME Parameter
+        objects changes what the next dispatch computes without a
+        recompile.  Shapes and dtypes must match the current values
+        exactly — a different shape/dtype is a different engine, not a
+        new epoch (and the KV pool dtype was derived from the old
+        weights).  Buffers are not swapped.
+
+        Live sessions keep their KV cache: rows written under the old
+        weights stay as-is, so a mid-generation swap continues the
+        sequence under mixed weights.  That is the documented semantics
+        (docs/rollout.md) — each request is attributed to the epoch it
+        was ADMITTED under, the oldest weights any of its tokens saw.
+
+        ``epoch`` pins the recorded epoch (checkpoint restore republishes
+        at the saved epoch); default bumps the counter by one.  Returns
+        the epoch now being served.
+        """
+        if which not in ("target", "draft"):
+            raise ValueError(f"which must be 'target' or 'draft', "
+                             f"got {which!r}")
+        if which == "draft":
+            if not self.spec:
+                raise RuntimeError(
+                    "publish_weights(which='draft') on a non-speculative "
+                    "engine — no draft to publish into")
+            params = list(self.draft.parameters())
+        else:
+            params = list(self.model.parameters())
+        leaves = list(leaves)
+        if len(leaves) != len(params):
+            raise ValueError(
+                f"publish_weights({which!r}): {len(leaves)} leaves for "
+                f"{len(params)} parameters — different model config")
+        for p, v in zip(params, leaves):
+            if tuple(getattr(v, "shape", ())) != tuple(p.data.shape):
+                raise ValueError(
+                    f"publish_weights({which!r}): leaf {p.name or '?'} "
+                    f"shape {tuple(getattr(v, 'shape', ()))} != serving "
+                    f"shape {tuple(p.data.shape)}")
+            if jnp.dtype(getattr(v, "dtype", None)) != \
+                    jnp.dtype(p.data.dtype):
+                raise ValueError(
+                    f"publish_weights({which!r}): leaf {p.name or '?'} "
+                    f"dtype {jnp.dtype(v.dtype)} != serving dtype "
+                    f"{jnp.dtype(p.data.dtype)} — cast on the publish "
+                    f"side (rollout.WeightPublisher casts once)")
+        for p, v in zip(params, leaves):
+            p.data = v
+        ep = self.weight_epochs[which] + 1 if epoch is None else int(epoch)
+        self.weight_epochs[which] = ep
+        _obs.event("serve.weight_swap", which=which, epoch=ep,
+                   tick=self._tick, leaves=len(leaves))
+        return ep
+
     # -- the tick ----------------------------------------------------------
 
     def step(self) -> bool:
@@ -299,8 +369,10 @@ class ServeEngine:
         self._tick += 1
         t0 = time.monotonic()
         for s in self.scheduler.admit():
+            s.weight_epoch = self.weight_epochs["target"]
             _obs.event("serve.request", rid=s.rid, phase="prefill",
-                       tick=self._tick, blocks=len(s.table))
+                       tick=self._tick, blocks=len(s.table),
+                       weight_epoch=s.weight_epoch)
         ps = self.scheduler.next_prefill()
         if ps is not None:
             self._prefill_chunk(ps)
@@ -642,6 +714,7 @@ class ServeEngine:
         s.out = list(out)
         s.t_queued = t_queued
         s.t_first = t_first
+        s.weight_epoch = self.weight_epochs["target"]
         self.scheduler.sessions.append(s)
         _obs.event("serve.request", rid=s.rid, phase="ingested",
                    tick=self._tick, blocks=have,
@@ -650,11 +723,14 @@ class ServeEngine:
 
     def _finish(self, s: Session) -> None:
         self.results[s.rid] = list(s.out)
+        self.result_meta[s.rid] = {"weight_epoch": s.weight_epoch,
+                                   "prompt_len": len(s.request.prompt)}
         s.t_done = time.monotonic()
         _obs.histogram("serve.e2e_ms").observe(
             (s.t_done - s.t_queued) * 1e3)
         _obs.event("serve.request", rid=s.rid, phase="done",
-                   tick=self._tick, generated=len(s.out))
+                   tick=self._tick, generated=len(s.out),
+                   weight_epoch=s.weight_epoch)
         self.scheduler.finish(s)
 
     # -- teardown ----------------------------------------------------------

@@ -109,6 +109,11 @@ class Session:
     # catching up on the prompt; equal once spec ticks may include it)
     draft_table: List[int] = field(default_factory=list)
     draft_position: int = 0
+    # target weight epoch the session was admitted under (engine-stamped
+    # at admission; epochs only grow, so this is the OLDEST weights any
+    # of its tokens saw — the conservative age a staleness bound wants).
+    # -1 until admission on an engine that publishes weights.
+    weight_epoch: int = -1
     # lifecycle timestamps (engine-stamped, telemetry only — no
     # scheduling decision reads them, so packing stays deterministic)
     t_queued: float = 0.0

@@ -2616,6 +2616,134 @@ def run_serve_elastic(args):
     return 0
 
 
+def rollout_bench_records(rounds=8, seed=0, num_blocks=64,
+                          rollouts_per_round=4, train_steps_per_round=2,
+                          publish_every=1):
+    """``rollout_loop`` stage: the generate-then-train runtime
+    (:class:`~apex_tpu.rollout.RolloutRuntime`) driven end to end —
+    seeded prompt stream → speculative serve engine → bounded-staleness
+    buffer → fused train step → measured weight publish back into the
+    engine, with the online draft distiller riding the same rounds.
+    CPU-forced with the parity-test tiny GPT, so the numbers track the
+    LOOP (scheduling, buffer replay, reshard accounting, hot-swap),
+    not matmul throughput.  One record:
+
+    * ``rollout_tokens_per_s`` / ``train_steps_per_s`` — generated
+      tokens and fused steps over the loop's wall clock (the loop is
+      serial by construction, so one clock prices both sides);
+    * ``weight_sync_ms`` — median over every ``rollout.weight_sync``
+      event (target + draft publishes);
+    * ``zero_copy_frac`` — the last target publish's per-leaf
+      zero-copy hit fraction (1.0 on cpu: identical layouts, donation
+      off, so the fast path aliases every leaf);
+    * ``accept_rate_trend`` — acceptance measured under each outgoing
+      draft, logged by the distiller at publish time (should climb as
+      the draft distills against the live target);
+    * ``buffer_staleness_p50`` — median over the per-round median
+      sample ages, in weight epochs (the staleness bound, observed).
+    """
+    import statistics
+    import time as _time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    import apex_tpu.nn.functional as F
+    from apex_tpu.inference import make_self_draft
+    from apex_tpu.models.gpt import GptModel
+    from apex_tpu.observe import registry as obs
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+    from apex_tpu.rollout import OnlineDistiller, RolloutRuntime
+    from apex_tpu.serve import ServeEngine
+    from apex_tpu.training.step import make_train_step
+
+    V = 73
+    nn.manual_seed(6)
+    train_m = GptModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                       max_positions=96, dropout=0.0, attn_dropout=0.0)
+    serve_m = make_self_draft(train_m)
+    nn.manual_seed(99)
+    draft_master = GptModel(vocab_size=V, hidden=32, layers=2, heads=4,
+                            max_positions=96, dropout=0.0,
+                            attn_dropout=0.0)
+
+    def lm_loss(logits, ids):
+        flat = logits[:, :-1].reshape((-1, V))
+        return F.cross_entropy(flat, ids[:, 1:].reshape((-1,)))
+
+    eng = ServeEngine(serve_m, num_blocks=num_blocks, block_size=8,
+                      max_batch=4, prefill_chunk=4,
+                      draft=make_self_draft(draft_master),
+                      spec_k=4, spec_policy="on")
+    step = make_train_step(
+        train_m, FusedAdam(list(train_m.parameters()), lr=1e-3),
+        lm_loss, loss_scale=1.0)
+    rt = RolloutRuntime(
+        eng, step, distiller=OnlineDistiller(eng, draft_master, lr=1e-3),
+        rollouts_per_round=rollouts_per_round,
+        train_steps_per_round=train_steps_per_round,
+        publish_every=publish_every, prompt_len=6, max_new_tokens=6,
+        seq_len=16, seed=seed)
+
+    reg = obs.get_registry()
+    reg.clear_events()
+    # warmup round outside the clock: first round pays every serve /
+    # train / distill / publish compile, which would otherwise dominate
+    # the per-second rates at toy scale
+    rt.run_round()
+    tokens0, steps0 = rt.tokens_generated, len(rt.losses)
+    t0 = _time.perf_counter()
+    round_recs = rt.run(rounds)
+    wall_s = _time.perf_counter() - t0
+
+    sync_ms = [ev["weight_sync_ms"]
+               for ev in reg.events("rollout.weight_sync")]
+    p50s = [r["staleness_p50"] for r in round_recs
+            if r["staleness_p50"] is not None]
+    trend = [r["accept_rate"] for r in rt.distiller.publish_log
+             if r["accept_rate"] is not None]
+    rec = {
+        "metric": "rollout_loop", "config": "toy_gpt_distill",
+        "platform": "cpu", "rounds": rounds,
+        "rollout_tokens_per_s": round(
+            (rt.tokens_generated - tokens0) / wall_s, 1),
+        "train_steps_per_s": round(
+            (len(rt.losses) - steps0) / wall_s, 2),
+        "weight_sync_ms": round(statistics.median(sync_ms), 3)
+            if sync_ms else None,
+        "zero_copy_frac": rt.publisher.last_stats.get("zero_copy_frac"),
+        "accept_rate_trend": [round(float(r), 4) for r in trend],
+        "buffer_staleness_p50": float(np.median(p50s)) if p50s else None,
+        "weight_epoch": eng.weight_epochs["target"],
+        "publishes": rt.publisher.publishes,
+        "backpressure_rounds": rt.backpressure_rounds,
+        "loss_first": round(rt.losses[0], 4),
+        "loss_last": round(rt.losses[-1], 4),
+    }
+    eng.close()
+    return [rec]
+
+
+def run_rollout(args):
+    stage("rollout",
+          "generate-then-train loop: seeded prompts → spec serve → "
+          "staleness-bounded buffer → fused step → measured weight "
+          "publish (+ online draft distillation), cpu")
+    # the loop crosses the serve engine, the executor, and the reshard
+    # surface in one process — wedge-proof it like the backend probes
+    recs = _run_with_timeout(
+        rollout_bench_records, args.budget_s,
+        "rollout_wedged: the generate-then-train loop did not complete "
+        f"within {args.budget_s}s — a serve dispatch or publish is "
+        "likely stuck")
+    for rec in recs:
+        emit(rec)
+        register_record(rec)
+    return 0
+
+
 def ckpt_microbench_records(total_mb=64, n_tensors=32, repeats=3,
                             directory=None):
     """``ckpt_save_ms`` microbench: CheckpointManager sync save vs async
@@ -3305,6 +3433,18 @@ def main():
                          "sessions_shed_requeued, sessions_recomputed, "
                          "snapshot_bytes_peak_host, epoch}; every "
                          "request must complete across the shrink")
+    ap.add_argument("--rollout", action="store_true",
+                    help="rollout_loop stage: the generate-then-train "
+                         "runtime end to end (seeded prompts → "
+                         "speculative serve → bounded-staleness buffer "
+                         "→ fused train step → measured weight publish "
+                         "+ online draft distillation), CPU-forced — "
+                         "emits {rollout_tokens_per_s, "
+                         "train_steps_per_s, weight_sync_ms, "
+                         "zero_copy_frac, accept_rate_trend, "
+                         "buffer_staleness_p50}; zero_copy_frac is 1.0 "
+                         "on cpu (layout-identical publish, donation "
+                         "off)")
     ap.add_argument("--budget-s", type=float,
                     default=float(os.environ.get("GRAFT_BENCH_BUDGET_S", 540)))
     args = ap.parse_args()
@@ -3348,6 +3488,10 @@ def main():
     if args.serve_elastic:
         start_watchdog(args.budget_s)
         return run_serve_elastic(args)
+
+    if args.rollout:
+        start_watchdog(args.budget_s)
+        return run_rollout(args)
 
     if args.plan:
         start_watchdog(args.budget_s)

@@ -1,0 +1,24 @@
+"""WEIGHT-PUBLISH negative: weight movement through the measured
+surfaces, and raw placement of things that are NOT weights (batches)."""
+import jax
+
+from apex_tpu.rollout import WeightPublisher, master_leaves
+from apex_tpu.runtime.resilience import reshard_state
+
+
+def publish(step, engine):
+    # the sanctioned path: cast-once, zero-copy where layouts match,
+    # versioned, telemetered
+    WeightPublisher(engine, which="target").publish(master_leaves(step))
+
+
+def restore(host_state, train_step):
+    # validated reshard — per-leaf stats available via stats_out
+    stats = {}
+    return reshard_state(host_state, train_step.state, stats_out=stats)
+
+
+def stage_batch(images, labels, device):
+    # batch data is not a weight pytree — raw placement is fine
+    return (jax.device_put(images, device),
+            jax.device_put(labels, device))

@@ -1,0 +1,18 @@
+"""WEIGHT-PUBLISH positive: raw placement of parameter/state pytrees —
+weight movement the sync accounting never sees."""
+import jax
+
+
+def hand_rolled_publish(step, engine_params, device):
+    # BAD: gather ALL masters to host every epoch ...
+    masters = jax.device_get(step.state.master_params)
+    # BAD: ... then re-place them raw — no validation, no zero-copy
+    # fast path, no per-leaf stats, no weight epoch
+    placed = jax.device_put(masters, device)
+    for p, v in zip(engine_params, placed):
+        p.data = v
+
+
+def reload_weights(host_weights, sharding):
+    # BAD: raw placement of a weight pytree outside the reshard surface
+    return jax.device_put(host_weights, sharding)

@@ -346,6 +346,32 @@ def test_serve_bench_records_schema():
     assert spec["spec_tokens_per_tick"] >= 2.0
 
 
+def test_rollout_bench_records_schema():
+    """--rollout stage: one rollout_loop record for the generate-then-
+    train runtime — both sides of the loop made progress (tokens
+    generated, fused steps run), every weight sync was measured, the
+    cpu publish path is fully zero-copy (layout-identical leaves,
+    donation off), the per-round staleness medians respect the default
+    bound, and the distiller logged an acceptance trend."""
+    recs = bench.rollout_bench_records(rounds=4)
+    (r,) = recs
+    assert r["metric"] == "rollout_loop"
+    assert r["platform"] == "cpu"
+    assert r["rounds"] == 4
+    assert r["rollout_tokens_per_s"] > 0
+    assert r["train_steps_per_s"] > 0
+    assert r["weight_sync_ms"] > 0.0
+    assert r["zero_copy_frac"] == 1.0
+    assert isinstance(r["accept_rate_trend"], list)
+    assert len(r["accept_rate_trend"]) >= 1
+    assert all(0.0 <= a <= 1.0 for a in r["accept_rate_trend"])
+    # default max_staleness=2: the observed median age never exceeds it
+    assert 0.0 <= r["buffer_staleness_p50"] <= 2.0
+    # publish_every=1 with a warmup round: epoch == publishes == rounds+1
+    assert r["weight_epoch"] == r["publishes"] == 5
+    assert r["loss_last"] < r["loss_first"]
+
+
 def test_overlap_microbench_records_schema():
     """--overlap-microbench stage: the executor overlap knobs (ZeRO
     all-gather prefetch, async H2D double-buffering) off vs on per K.

@@ -39,6 +39,7 @@ RULE_FIXTURES = {
     "SHAPE-BRANCH": "shape_branch",
     "STALE-SUPPRESSION": "stale_suppression",
     "CLUSTER-ASSUME": "cluster_assume",
+    "WEIGHT-PUBLISH": "weight_publish",
 }
 
 
@@ -58,7 +59,7 @@ def _run(paths, **kw):
 
 def test_registry_covers_required_rules():
     assert set(RULE_FIXTURES) <= set(rules.rule_ids())
-    assert len(rules.rule_ids()) >= 17
+    assert len(rules.rule_ids()) >= 18
 
 
 @pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
