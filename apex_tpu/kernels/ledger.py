@@ -67,8 +67,15 @@ def _plan_key_str(plan_key) -> str:
     string key — JSON object keys must be strings."""
     if isinstance(plan_key, str):
         return plan_key
-    return "/".join(str(int(x)) if not isinstance(x, bool)
-                    else ("1" if x else "0") for x in plan_key)
+
+    def seg(x):
+        if isinstance(x, bool):
+            return "1" if x else "0"
+        if isinstance(x, str):      # tagged v3 segments ("pp4", "remat=…")
+            return x
+        return str(int(x))
+
+    return "/".join(seg(x) for x in plan_key)
 
 
 class Ledger:

@@ -93,6 +93,27 @@ CATALOG: Dict[str, Dict[str, str]] = {
         "kind": "event", "unit": "record",
         "description": "ServeEngine.publish_weights applied: weight "
                        "set, epoch now served, tick, leaf count."},
+    # -- planner: the joint pp×remat×offload×ep search ---------------------
+    "plan.search_ms": {
+        "kind": "gauge", "unit": "ms",
+        "description": "Wall time of the last plan_training joint "
+                       "search (enumerate → prune → rank, including "
+                       "ledger re-pricing)."},
+    "plan.explored": {
+        "kind": "gauge", "unit": "plans",
+        "description": "Plans enumerated by the last joint search, "
+                       "feasible and rejected alike — nothing is "
+                       "pruned before it is counted."},
+    "plan.pruned_oom": {
+        "kind": "gauge", "unit": "plans",
+        "description": "Plans the last search rejected as "
+                       "memory-infeasible under the per-device HBM "
+                       "model (reason strings carry the breakdown)."},
+    "plan.bubble_frac": {
+        "kind": "gauge", "unit": "fraction",
+        "description": "Pipeline bubble fraction (pp-1)/(micro+pp-1) "
+                       "of the chosen plan; set only when the winner "
+                       "pipelines (pp > 1)."},
 }
 
 

@@ -90,6 +90,9 @@ class ChipSpec:
     overhead_s: float        # fixed per-microbatch dispatch/loop overhead
     efficiency: float = 0.45
     shared_host: bool = False
+    #: host↔device transfer bandwidth (PCIe/DMA), the prior the offload
+    #: term prices against when the executor has no measured H2D rate
+    h2d_bw: float = 16e9
 
     def sustained_flops(self) -> float:
         return self.peak_flops * self.efficiency
@@ -106,7 +109,8 @@ class ChipSpec:
             self, name=f"{self.name}*{factor:g}",
             peak_flops=self.peak_flops * factor,
             hbm_bw=self.hbm_bw * factor,
-            ici_bw=self.ici_bw * factor)
+            ici_bw=self.ici_bw * factor,
+            h2d_bw=self.h2d_bw * factor)
 
 
 #: bf16 peaks from public spec sheets; HBM/ICI figures are the same
@@ -114,13 +118,18 @@ class ChipSpec:
 #: the 8-virtual-device test mesh: one shared host, collectives as
 #: memcpys, generous per-collective latency (thread rendezvous).
 CHIPS = {
-    "v6":  ChipSpec("v6",  918.0e12, 32e9, 1640e9, 180e9, 1e-6, 2e-6),
-    "v5p": ChipSpec("v5p", 459.0e12, 95e9, 2765e9, 200e9, 1e-6, 2e-6),
-    "v5e": ChipSpec("v5e", 197.0e12, 16e9,  819e9,  50e9, 1e-6, 2e-6),
-    "v4":  ChipSpec("v4",  275.0e12, 32e9, 1228e9, 100e9, 1e-6, 2e-6),
-    "v3":  ChipSpec("v3",  123.0e12, 32e9,  900e9,  70e9, 1e-6, 2e-6),
+    "v6":  ChipSpec("v6",  918.0e12, 32e9, 1640e9, 180e9, 1e-6, 2e-6,
+                    h2d_bw=64e9),
+    "v5p": ChipSpec("v5p", 459.0e12, 95e9, 2765e9, 200e9, 1e-6, 2e-6,
+                    h2d_bw=64e9),
+    "v5e": ChipSpec("v5e", 197.0e12, 16e9,  819e9,  50e9, 1e-6, 2e-6,
+                    h2d_bw=32e9),
+    "v4":  ChipSpec("v4",  275.0e12, 32e9, 1228e9, 100e9, 1e-6, 2e-6,
+                    h2d_bw=32e9),
+    "v3":  ChipSpec("v3",  123.0e12, 32e9,  900e9,  70e9, 1e-6, 2e-6,
+                    h2d_bw=16e9),
     "cpu": ChipSpec("cpu",   40.0e9,  4e9,   20e9,   4e9, 30e-6, 150e-6,
-                    efficiency=1.0, shared_host=True),
+                    efficiency=1.0, shared_host=True, h2d_bw=20e9),
 }
 
 
@@ -345,6 +354,14 @@ class ModelProfile:
     tp_axis: Optional[str]             # model capability (build option)
     sp_axis: Optional[str]
     source: str = "xla"
+    # -- planner-v3 capabilities (defaults keep old profiles valid) ----
+    pp_axis: Optional[str] = None      # PipelinedStack mesh axis
+    remat_capable: bool = False        # model built with remat=True
+    moe_axis: Optional[str] = None     # switch-MoE routing axis
+    n_experts: int = 0                 # experts per MoE block (E)
+    moe_layers: int = 0                # routed blocks in the model
+    moe_param_frac: float = 0.0        # fraction of params in experts
+    moe_capacity_factor: float = 1.25
 
 
 def _optimizer_slots(optimizer) -> int:
@@ -405,12 +422,39 @@ def _introspect(model):
                     else None
             if heads is not None:
                 break
+    # switch-MoE capability: routed blocks carry num_experts + moe_axis
+    # and the stacked expert FFN weights (w1/b1/w2/b2, leading dim E)
+    moe_axis, n_experts, moe_layers, expert_bytes = None, 0, 0, 0
+    moe_cap = 1.25
+    for blk in (blocks or []):
+        e = getattr(blk, "num_experts", None)
+        if e is None or getattr(blk, "moe_axis", None) is None:
+            continue
+        moe_axis = blk.moe_axis
+        n_experts = int(e)
+        moe_layers += 1
+        moe_cap = float(getattr(blk, "capacity_factor", moe_cap))
+        for attr in ("w1", "b1", "w2", "b2"):
+            p = getattr(blk, attr, None)
+            if p is not None and hasattr(p, "data"):
+                expert_bytes += int(np.prod(p.data.shape)) * 4
+    # pipeline capability: a PipelinedStack (stacked stage params sliced
+    # over axis_name, microbatch axis = accumulation unit)
+    pp_axis = (getattr(model, "axis_name", None)
+               if getattr(model, "n_micro", None) is not None and
+               getattr(model, "stage_fn", None) is not None else None)
     return dict(
         vocab=getattr(model, "vocab_size", None),
         hidden=getattr(model, "hidden", None),
         layers=layers, heads=heads,
         tp_axis=getattr(model, "tp_axis", None),
-        sp_axis=getattr(model, "sp_axis", None))
+        sp_axis=getattr(model, "sp_axis", None),
+        pp_axis=pp_axis,
+        remat_capable=bool(getattr(model, "remat", False)
+                           or getattr(model, "remat_stage", False)),
+        moe_axis=moe_axis, n_experts=n_experts, moe_layers=moe_layers,
+        moe_capacity_factor=moe_cap,
+        _expert_bytes=expert_bytes)
 
 
 def profile_model(model, optimizer, loss_fn: Callable, example_batch, *,
@@ -435,6 +479,8 @@ def profile_model(model, optimizer, loss_fn: Callable, example_batch, *,
     param_bytes = n_params * 4
     half_itemsize = 0 if half_dtype is None else jnp.dtype(half_dtype).itemsize
     info = _introspect(model)
+    info["moe_param_frac"] = (info.pop("_expert_bytes")
+                              / max(param_bytes, 1))
     b_hi = _global_batch_of(example_batch)
     act_itemsize = half_itemsize or 4
     batch_bytes = sum(
@@ -493,7 +539,12 @@ def profile_model(model, optimizer, loss_fn: Callable, example_batch, *,
         logits_bytes_per_example=logits_bpe,
         seq_len=seq_len, **info)
 
-    if info["tp_axis"] is not None or info["sp_axis"] is not None:
+    # models whose forward binds mesh axes (tp/sp psums, MoE routing's
+    # axis_index, the pipeline stack's stage slicing) cannot lower
+    # unsharded — fall back to the analytic 6·N estimate
+    if (info["tp_axis"] is not None or info["sp_axis"] is not None
+            or info["moe_axis"] is not None
+            or info["pp_axis"] is not None):
         tokens = float(seq_len or 1)
         flops_pe = 6.0 * n_params * tokens
         return ModelProfile(
@@ -527,22 +578,67 @@ def profile_model(model, optimizer, loss_fn: Callable, example_batch, *,
 # Plan
 # ---------------------------------------------------------------------------
 
+#: remat policy → (keep_frac, recompute_frac).  ``keep_frac`` scales the
+#: HBM model's activation term (what survives to the backward);
+#: ``recompute_frac`` is the extra forward work as a fraction of the
+#: step's total FLOPs, fed back into the roofline.  "selective" is the
+#: checkpoint-every-other-boundary policy; "full" re-runs essentially
+#: the whole forward from layer boundaries (the 1F1B stack's policy).
+REMAT_POLICIES = {
+    "none":      (1.0, 0.0),
+    "selective": (0.5, 1.0 / 6.0),
+    "full":      (0.15, 1.0 / 3.0),
+}
+
+#: deterministic tie-break order for the remat axis (lighter first)
+_REMAT_ORDER = {"none": 0, "selective": 1, "full": 2}
+
+#: the (offload_opt, offload_act) rungs the joint enumeration crosses
+#: with every mesh/remat point: nothing, full optimizer-state offload,
+#: and optimizer state + half the activations
+OFFLOAD_LADDER = ((0.0, 0.0), (1.0, 0.0), (1.0, 0.5))
+
+#: fraction of the offload transfer that stays exposed even when the
+#: executor's h2d overlap is on (the prologue/epilogue of each window
+#: cannot hide under compute)
+OFFLOAD_EXPOSED_OVERLAPPED = 0.25
+
 
 @dataclasses.dataclass(frozen=True)
 class Plan:
-    """One point in the (dp × sp × tp × zero × accum × chunked) space,
-    with the cost model's predictions attached.  Hashable — the
-    structural part (:meth:`key`) is embedded in step-program cache keys
-    so compiled executables are per-plan observables."""
+    """One point in the joint (dp × sp × tp × zero × accum × chunked ×
+    pp × remat × offload × ep) space, with the cost model's predictions
+    attached.  Hashable — the structural part (:meth:`key`) is embedded
+    in step-program cache keys so compiled executables are per-plan
+    observables."""
     dp: int = 1
     tp: int = 1
     sp: int = 1
     zero_stage: int = 0
     accum: int = 1
     chunked_loss: bool = False
+    #: pipeline stages (devices along the pp axis) and microbatches per
+    #: step — the pipeline's accumulation unit (pp plans keep accum=1)
+    pp: int = 1
+    micro: int = 1
+    #: activation-checkpoint policy: a :data:`REMAT_POLICIES` key
+    remat: str = "none"
+    #: expert-parallel degree — rides the dp axis (ep == dp == E, one
+    #: expert per device along the model's moe_axis)
+    ep: int = 1
+    #: host-offload fractions: optimizer state (masters + slots) and
+    #: activations moved to host RAM, priced at the measured H2D rate
+    offload_opt: float = 0.0
+    offload_act: float = 0.0
     dp_axis: str = "data"
     tp_axis: Optional[str] = None
     sp_axis: Optional[str] = None
+    pp_axis: Optional[str] = None
+    #: heterogeneous pipelines: layers per stage (apportion_shares over
+    #: member speeds) and the chip name hosting each stage.  Empty on a
+    #: homogeneous pipeline (uniform layers/pp split).
+    stage_layers: tuple = ()
+    stage_members: tuple = ()
     n_devices: int = 1                   # devices the planner priced for
     predicted_ms: Optional[float] = None
     predicted_hbm: Optional[int] = None
@@ -559,13 +655,35 @@ class Plan:
     device_shares: tuple = ()
 
     def key(self):
-        """The structural identity embedded in program cache keys."""
-        return (self.dp, self.tp, self.sp, self.zero_stage, self.accum,
+        """The structural identity embedded in program cache keys.
+
+        The first six positions are the historical (dp, tp, sp, zero,
+        accum, chunked) tuple — a plan using none of the new axes keys
+        exactly as it did before, so old checkpoints/manifests and the
+        step cache stay valid.  Each non-default new axis appends one
+        tagged STRING segment (``"pp4"``, ``"micro8"``,
+        ``"remat=selective"``, ``"ep8"``, ``"offopt=1"``,
+        ``"offact=0.5"``) that :func:`plan_from_key` parses back."""
+        base = (self.dp, self.tp, self.sp, self.zero_stage, self.accum,
                 self.chunked_loss)
+        extra = []
+        if self.pp != 1:
+            extra.append(f"pp{self.pp}")
+        if self.micro != 1:
+            extra.append(f"micro{self.micro}")
+        if self.remat != "none":
+            extra.append(f"remat={self.remat}")
+        if self.ep != 1:
+            extra.append(f"ep{self.ep}")
+        if self.offload_opt:
+            extra.append(f"offopt={self.offload_opt:g}")
+        if self.offload_act:
+            extra.append(f"offact={self.offload_act:g}")
+        return base + tuple(extra)
 
     @property
     def n_used(self) -> int:
-        return self.dp * self.tp * self.sp
+        return self.dp * self.tp * self.sp * self.pp
 
     def name(self) -> str:
         parts = [f"dp{self.dp}"]
@@ -573,6 +691,17 @@ class Plan:
             parts.append(f"sp{self.sp}")
         if self.tp > 1:
             parts.append(f"tp{self.tp}")
+        if self.pp > 1:
+            parts.append(f"pp{self.pp}")
+            if self.micro > 1:
+                parts.append(f"m{self.micro}")
+        if self.ep > 1:
+            parts.append(f"ep{self.ep}")
+        if self.remat != "none":
+            parts.append(f"remat[{self.remat}]")
+        if self.offload_opt or self.offload_act:
+            parts.append(f"off[opt{self.offload_opt:g}"
+                         f"+act{self.offload_act:g}]")
         if self.zero_stage:
             parts.append(f"zero{self.zero_stage}")
         if self.accum > 1:
@@ -582,12 +711,25 @@ class Plan:
         return "·".join(parts)
 
     def step_kwargs(self, devices=None) -> dict:
-        """The existing make_train_step knobs this plan threads — the
-        planner drives tested primitives, it adds no execution path."""
+        """The existing entry-point knobs this plan threads — the
+        planner drives tested primitives, it adds no execution path.
+
+        dp/ZeRO plans map to the GSPMD ``zero_sharding`` path; tp/sp/ep
+        plans to the explicit-axis ``shard_map`` path (an ep plan's data
+        axis IS the model's moe_axis); pp plans to the pipeline entry
+        points — ``make_pipeline_train_step(schedule="1f1b")`` for
+        ``remat="full"``, ``make_train_step(tp_axis=<pp axis>)`` (the
+        GPipe stack wrap) otherwise."""
         kw = {}
+        if self.pp > 1:
+            if self.remat == "full":
+                kw["schedule"] = "1f1b"      # make_pipeline_train_step
+            else:
+                kw["tp_axis"] = self.pp_axis or "pp"
+            return kw
         if self.accum > 1:
             kw["accum_steps"] = self.accum
-        if self.tp == 1 and self.sp == 1:
+        if self.tp == 1 and self.sp == 1 and self.ep == 1:
             if self.dp > 1:
                 kw.update(zero_sharding=True, zero_stage=self.zero_stage,
                           zero_axis=self.dp_axis)
@@ -612,9 +754,14 @@ class Plan:
 
     def describe(self) -> str:
         bd = dict(self.breakdown)
+        mesh = f"mesh dp={self.dp} sp={self.sp} tp={self.tp}"
+        if self.pp > 1:
+            mesh += f" pp={self.pp}"
+        if self.ep > 1:
+            mesh += f" ep={self.ep}"
         lines = [
-            f"Plan {self.name()}  (mesh dp={self.dp} sp={self.sp} "
-            f"tp={self.tp}, {self.n_used} of {self.n_devices} devices, "
+            f"Plan {self.name()}  ({mesh}, "
+            f"{self.n_used} of {self.n_devices} devices, "
             f"ZeRO stage {self.zero_stage}, accum K={self.accum}, "
             f"chunked_loss={'on' if self.chunked_loss else 'off'})"]
         if self.predicted_ms is not None:
@@ -627,6 +774,45 @@ class Plan:
                     bd.get("compute_ms", 0.0), bd.get("hbm_ms", 0.0),
                     bd.get("collective_ms", 0.0),
                     bd.get("overhead_ms", 0.0)))
+        if self.pp > 1:
+            sched = "1F1B" if self.remat == "full" else "GPipe"
+            ticks = int(bd.get("pp_ticks",
+                               self.micro + 2 * (self.pp - 1)))
+            frac = bd.get("bubble_frac",
+                          2.0 * (self.pp - 1) / max(ticks, 1))
+            lines.append(
+                f"  pipeline: {self.pp} stages × {self.micro} "
+                f"microbatches ({sched} schedule), {ticks} ticks/step, "
+                f"bubble fraction {frac:.1%}")
+            if self.stage_layers:
+                members = self.stage_members or ("?",) * len(
+                    self.stage_layers)
+                lines.append("  stage placement: " + "; ".join(
+                    f"stage {i} → {m} ({l} layer"
+                    + ("s" if l != 1 else "") + ")"
+                    for i, (l, m) in enumerate(
+                        zip(self.stage_layers, members))))
+        if self.remat != "none":
+            keep, rec = REMAT_POLICIES[self.remat]
+            gf = bd.get("recompute_gflops", 0.0)
+            lines.append(
+                f"  remat[{self.remat}]: keep {keep:.0%} of activations"
+                f", recompute {gf:.2f} GFLOP/step "
+                f"(+{rec:.0%} of step FLOPs re-run in the backward)")
+        if self.offload_opt or self.offload_act:
+            traffic = bd.get("offload_bytes", 0)
+            lines.append(
+                f"  offload: optimizer state {self.offload_opt:.0%} "
+                f"(host {self._fmt_bytes(bd.get('host_opt_bytes', 0))}), "
+                f"activations {self.offload_act:.0%} "
+                f"(host {self._fmt_bytes(bd.get('host_act_bytes', 0))}) "
+                f"— offload bytes {self._fmt_bytes(traffic)}/step over "
+                f"H2D/D2H, {bd.get('offload_ms', 0.0):.3f} ms exposed")
+        if self.ep > 1:
+            lines.append(
+                f"  expert parallel: ep={self.ep} (one expert per "
+                f"device along {self.dp_axis!r}; dispatch/combine "
+                f"all-to-all priced per routed block)")
         if self.device_shares:
             lines.append(
                 "  device batch shares: ["
@@ -642,7 +828,10 @@ class Plan:
             mem = " + ".join(
                 f"{k[4:]} {self._fmt_bytes(v)}"
                 for k, v in self.breakdown if k.startswith("mem_"))
-            lines.append(f"  predicted HBM {self._fmt_bytes(self.predicted_hbm)}"
+            unit = ("per-stage HBM (largest stage)" if self.pp > 1
+                    else "predicted HBM")
+            lines.append(f"  {unit} "
+                         f"{self._fmt_bytes(self.predicted_hbm)}"
                          f"/device = {mem}")
         if self.collectives:
             lines.append("  collectives: " + "; ".join(self.collectives))
@@ -667,16 +856,68 @@ def static_plan_key(plan):
     return None if plan is None else plan.key()
 
 
+#: tagged plan-key segments: prefix → (Plan field, parser).  The
+#: ordering here is the canonical emission order of :meth:`Plan.key`.
+_KEY_SEGMENTS = (
+    ("pp", "pp", int),
+    ("micro", "micro", int),
+    ("remat=", "remat", str),
+    ("ep", "ep", int),
+    ("offopt=", "offload_opt", float),
+    ("offact=", "offload_act", float),
+)
+
+
 def plan_from_key(key, n_devices: int = 1) -> Plan:
     """Rebuild a structural :class:`Plan` from a saved manifest key —
     the inverse of :meth:`Plan.key` for the structural fields (cost-model
     predictions are not identity and come back unset).  The elastic
     restore path uses this to describe the plan a schema-2 checkpoint
-    was saved under (``manifest["plan"]["key"]``)."""
-    dp, tp, sp, zero_stage, accum, chunked_loss = key
+    was saved under (``manifest["plan"]["key"]``).
+
+    Unknown segments are an ERROR, not silently dropped: a manifest
+    written by a newer planner names an axis this build cannot honor,
+    and guessing would restore under the wrong plan."""
+    key = tuple(key)
+    if len(key) < 6:
+        raise ValueError(
+            f"plan key {key!r} is malformed: the first six segments "
+            f"must be (dp, tp, sp, zero_stage, accum, chunked_loss)")
+    dp, tp, sp, zero_stage, accum, chunked_loss = key[:6]
+    kw = {}
+    known = [p for p, _, _ in _KEY_SEGMENTS]
+    for seg in key[6:]:
+        if not isinstance(seg, str):
+            raise ValueError(
+                f"unknown plan-key segment {seg!r}: extended segments "
+                f"are tagged strings with one of the prefixes {known}")
+        for prefix, field, parse in _KEY_SEGMENTS:
+            if seg.startswith(prefix):
+                if field in kw:
+                    raise ValueError(
+                        f"plan key {key!r} repeats the {field!r} "
+                        f"segment ({seg!r})")
+                try:
+                    kw[field] = parse(seg[len(prefix):])
+                except ValueError:
+                    raise ValueError(
+                        f"plan-key segment {seg!r}: the {field!r} "
+                        f"value {seg[len(prefix):]!r} does not parse "
+                        f"as {parse.__name__}")
+                break
+        else:
+            raise ValueError(
+                f"unknown plan-key segment {seg!r}: this planner "
+                f"recognizes no such field (known segment prefixes: "
+                f"{known})")
+    if kw.get("remat", "none") not in REMAT_POLICIES:
+        raise ValueError(
+            f"plan-key segment remat={kw['remat']!r}: unknown remat "
+            f"policy (known: {sorted(REMAT_POLICIES)})")
     return Plan(dp=int(dp), tp=int(tp), sp=int(sp),
                 zero_stage=int(zero_stage), accum=int(accum),
-                chunked_loss=bool(chunked_loss), n_devices=int(n_devices))
+                chunked_loss=bool(chunked_loss), n_devices=int(n_devices),
+                **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -704,34 +945,82 @@ def _zero_shard_bytes(prof: ModelProfile, itemsize: int, n: int) -> int:
     return total
 
 
+def _param_scale(plan: Plan, prof: ModelProfile) -> float:
+    """Fraction of the parameter state one device holds under the
+    plan's pipeline-stage slice and expert sharding (before ZeRO, which
+    :func:`_zero_shard_bytes` handles per-tensor)."""
+    scale = 1.0
+    if plan.pp > 1:
+        if plan.stage_layers:
+            scale *= max(plan.stage_layers) / max(sum(plan.stage_layers),
+                                                  1)
+        else:
+            scale *= 1.0 / plan.pp
+    if plan.ep > 1 and prof.moe_param_frac:
+        # expert weights shard one-per-device; the dense remainder is
+        # replicated along the (ep == dp) axis
+        scale *= ((1.0 - prof.moe_param_frac)
+                  + prof.moe_param_frac / plan.ep)
+    return scale
+
+
+def _pp_boundary_bytes(plan: Plan, prof: ModelProfile,
+                       micro_b: float) -> float:
+    """One microbatch's stage-boundary activation (the tensor ppermute
+    hops stage-to-stage): hidden × seq when the profile knows the
+    geometry, else one layer's share of the activation slope."""
+    act_itemsize = prof.half_itemsize or 4
+    if prof.hidden and prof.seq_len:
+        return float(prof.hidden) * prof.seq_len * micro_b * act_itemsize
+    return (prof.act_bytes_per_example * micro_b
+            / max(prof.layers or plan.pp, 1))
+
+
 def predict_memory(plan: Plan, prof: ModelProfile, spec: ChipSpec,
                    global_batch: int):
     """Per-device steady-state training footprint: returns
-    ``(total_bytes, breakdown)`` with one entry per component."""
+    ``(total_bytes, breakdown)`` with one entry per component.
+
+    v3 axes: pipeline plans hold one STAGE's parameter state plus the
+    schedule's in-flight microbatch activations (GPipe keeps every
+    tick's residuals; 1F1B — ``remat="full"`` — keeps a ring of
+    boundary inputs and recomputes internals); ``remat`` scales the
+    surviving activation term by its keep-fraction; ``offload`` moves
+    optimizer state / activations to host RAM (reported as ``host_*``
+    breakdown entries, not HBM); ``ep`` shards the expert slice of the
+    parameter state one-per-device."""
+    pscale = _param_scale(plan, prof)
+    keep_frac, _rec = REMAT_POLICIES[plan.remat]
     shard_n = plan.dp if plan.zero_stage >= 1 else 1
-    masters = _zero_shard_bytes(prof, 4, shard_n)
-    slots = prof.slots_per_param * masters
+    masters_full = _zero_shard_bytes(prof, 4, shard_n) * pscale
+    opt_full = (1 + prof.slots_per_param) * masters_full
+    masters = masters_full * (1.0 - plan.offload_opt)
+    slots = prof.slots_per_param * masters_full * (1.0 - plan.offload_opt)
+    host_opt = opt_full * plan.offload_opt
     half = 0
     if prof.half_itemsize:
         half = _zero_shard_bytes(
             prof, prof.half_itemsize,
-            plan.dp if plan.zero_stage == 3 else 1)
+            plan.dp if plan.zero_stage == 3 else 1) * pscale
     # gradient carry/working set, per path: the K>1 scan holds a full
     # replicated fp32 accumulator; a K=1 ZeRO program's gradients land
     # reduce-scattered (per-device 1/dp); a stage-0 all-reduce holds
-    # grad + collective double buffer; single-device holds one grad set
+    # grad + collective double buffer; single-device holds one grad set.
+    # Gradients are NEVER offloaded: they are produced and consumed
+    # inside one step, so a host round-trip would serialize the update.
     if plan.accum > 1:
         # window accumulator + the per-microbatch gradient it adds
-        grads = 2 * prof.param_bytes_fp32
+        grads = 2 * prof.param_bytes_fp32 * pscale
     elif plan.zero_stage >= 1 and plan.dp > 1:
         # reduce-scattered shards, double-buffered through the collective
-        grads = 2 * _zero_shard_bytes(prof, 4, plan.dp)
-    elif plan.dp > 1:
-        # full grads + the all-reduce double buffer
-        grads = 2 * prof.param_bytes_fp32
+        grads = 2 * _zero_shard_bytes(prof, 4, plan.dp) * pscale
+    elif plan.dp > 1 or plan.pp > 1:
+        # full grads + the collective double buffer (dp all-reduce, or
+        # the pipeline's stage-grad assembly psum)
+        grads = 2 * prof.param_bytes_fp32 * pscale
     else:
-        grads = prof.param_bytes_fp32
-    micro_b = global_batch / (plan.dp * plan.accum)
+        grads = prof.param_bytes_fp32 * pscale
+    micro_b = global_batch / (plan.dp * plan.accum * plan.micro)
     tp_act = (1.0 + 1.0 / plan.tp) / 2.0   # sharded FFN/heads, full residual
     acts = (prof.act_bytes_per_example * micro_b / plan.sp * tp_act
             + prof.act_bytes_fixed)
@@ -739,11 +1028,35 @@ def predict_memory(plan: Plan, prof: ModelProfile, spec: ChipSpec,
         acts -= (prof.logits_bytes_per_example * micro_b / plan.sp
                  * (1.0 - 1.0 / CHUNKS))
         acts = max(acts, 0.0)
+    if plan.pp > 1:
+        stage_frac = (max(plan.stage_layers) / max(sum(plan.stage_layers),
+                                                   1)
+                      if plan.stage_layers else 1.0 / plan.pp)
+        internals = acts * stage_frac * keep_frac
+        boundary = _pp_boundary_bytes(plan, prof, micro_b)
+        if plan.remat == "full":
+            # 1F1B: one microbatch's internals live (recomputed in the
+            # backward), boundary inputs in the schedule's ring buffer
+            from .pipeline import ring_slots
+            acts = internals + boundary * ring_slots(plan.pp, plan.micro)
+        else:
+            # GPipe scan: the transpose keeps every tick's residuals
+            inflight = plan.micro + plan.pp - 1
+            acts = (internals + boundary) * inflight
+    else:
+        acts *= keep_frac
+    host_act = acts * plan.offload_act
+    acts -= host_act
     batch = prof.batch_bytes_per_example * global_batch / plan.dp / plan.sp
-    bd = [("mem_masters", masters), ("mem_slots", slots),
-          ("mem_half", half), ("mem_grads", grads),
+    bd = [("mem_masters", int(masters)), ("mem_slots", int(slots)),
+          ("mem_half", int(half)), ("mem_grads", int(grads)),
           ("mem_acts", int(acts)), ("mem_batch", int(batch))]
-    return int(masters + slots + half + grads + acts + batch), bd
+    if host_opt or host_act:
+        # host_* entries are NOT "mem_"-prefixed: they live in host RAM,
+        # outside the per-device HBM sum describe() reports
+        bd.append(("host_opt_bytes", int(host_opt)))
+        bd.append(("host_act_bytes", int(host_act)))
+    return (int(masters + slots + half + grads + acts + batch), bd)
 
 
 def _ring_all_reduce_s(bytes_, n, spec):
@@ -761,15 +1074,18 @@ def _ring_half_s(bytes_, n, spec):
 
 
 def _dp_collective_terms(plan: Plan, prof: ModelProfile, spec: ChipSpec,
-                         w_itemsize: int):
+                         w_itemsize: int, param_scale: float = 1.0):
     """The dp-axis collective terms (stage-0 grad all-reduce, or the
     ZeRO reduce-scatter / param all-gather pair, plus the stage-3
     per-microbatch gather with the executor's prefetch overlap).
     Shared between :func:`predict_time` and :func:`predict_time_fleet`
     — the fleet path hands in a slowest-link spec so every collective
-    is priced at the weakest interconnect in the ring."""
+    is priced at the weakest interconnect in the ring.  ``param_scale``
+    shrinks the exchanged gradient/parameter bytes for plans whose
+    per-device parameter state is a slice (pipeline stage, expert
+    shard)."""
     coll_s, colls = 0.0, []
-    gbytes = prof.param_bytes_fp32
+    gbytes = prof.param_bytes_fp32 * param_scale
     if plan.dp > 1:
         if plan.zero_stage == 0:
             coll_s += _ring_all_reduce_s(gbytes, plan.dp, spec)
@@ -779,13 +1095,13 @@ def _dp_collective_terms(plan: Plan, prof: ModelProfile, spec: ChipSpec,
             coll_s += _ring_half_s(gbytes, plan.dp, spec)
             colls.append(f"reduce-scatter fp32 grads ({_mib(gbytes)}) into "
                          f"master shards over {plan.dp_axis}({plan.dp})")
-            ag = prof.n_params * w_itemsize
+            ag = prof.n_params * w_itemsize * param_scale
             coll_s += _ring_half_s(ag, plan.dp, spec)
             colls.append(f"all-gather updated params ({_mib(ag)}) over "
                          f"{plan.dp_axis}({plan.dp})")
         if plan.zero_stage == 3:
             from ..runtime import executor as _executor
-            ag1 = prof.n_params * w_itemsize
+            ag1 = prof.n_params * w_itemsize * param_scale
             ag3 = plan.accum * ag1
             if plan.accum > 1 and _executor.overlap_enabled("gather"):
                 # executor gather prefetch: the scanned window issues
@@ -804,34 +1120,103 @@ def _dp_collective_terms(plan: Plan, prof: ModelProfile, spec: ChipSpec,
     return coll_s, colls
 
 
+def _moe_a2a_terms(plan: Plan, prof: ModelProfile, spec: ChipSpec,
+                   micro_b: float, micro_n: int):
+    """The expert-parallel dispatch/combine all-to-all: per routed block
+    the forward sends each token's hidden vector to its expert's device
+    and gathers the result back (2 exchanges), and the backward mirrors
+    both (4 total), each moving the (ep-1)/ep off-device fraction of the
+    capacity-scaled token buffer."""
+    act_itemsize = prof.half_itemsize or 4
+    tokens = micro_b * float(prof.seq_len or 1)
+    xfer = (tokens * float(prof.hidden or 1) * act_itemsize
+            * prof.moe_capacity_factor)
+    per_a2a = ((plan.ep - 1) / plan.ep * xfer / spec.ici_bw
+               + (plan.ep - 1) * spec.ici_latency_s)
+    n_a2a = 4 * prof.moe_layers * micro_n
+    coll_s = n_a2a * per_a2a
+    desc = (f"MoE dispatch/combine all-to-all ({_mib(xfer)}/exchange × "
+            f"{n_a2a}: 4 per routed block × {prof.moe_layers} blocks × "
+            f"{micro_n} microbatches) over {plan.dp_axis}({plan.ep})")
+    return coll_s, desc
+
+
 def predict_time(plan: Plan, prof: ModelProfile, spec: ChipSpec,
                  global_batch: int):
     """Roofline step time: ``max(compute, HBM) + collectives + overhead``.
-    Returns ``(ms, breakdown, collectives)``."""
+    Returns ``(ms, breakdown, collectives)``.
+
+    v3 axes: ``remat`` adds its recompute FLOPs (and the matching HBM
+    re-reads) to the roofline; ``pp`` applies the warmup/drain bubble
+    multiplier over the microbatch schedule plus the stage-boundary
+    ppermutes and stage-grad assembly; ``offload`` adds the exposed
+    fraction of the host round-trip priced at the executor's measured
+    H2D bandwidth (``spec.h2d_bw`` prior); ``ep`` adds the MoE
+    dispatch/combine all-to-all per routed block."""
     n_used = plan.n_used
-    micro_b = global_batch / (plan.dp * plan.accum)
+    micro_n = plan.accum * plan.micro      # microbatches per step
+    micro_b = global_batch / (plan.dp * micro_n)
     act_itemsize = prof.half_itemsize or 4
     w_itemsize = prof.half_itemsize or 4
+    keep_frac, rec_frac = REMAT_POLICIES[plan.remat]
+    pscale = _param_scale(plan, prof)
 
-    flops = (prof.flops_per_example * global_batch / n_used
-             + plan.accum * prof.flops_fixed)
+    base_flops = (prof.flops_per_example * global_batch / n_used
+                  + micro_n * prof.flops_fixed)
+    flops = base_flops * (1.0 + rec_frac)
     # virtual devices split one host: per-plan sustained rate is the
     # host's, not n_used × the host's
     sustained = spec.sustained_flops() / (n_used if spec.shared_host else 1)
     compute_s = flops / sustained
 
-    weight_traffic = plan.accum * prof.n_params * w_itemsize / plan.tp
+    weight_traffic = (micro_n * prof.n_params * w_itemsize * pscale
+                      / plan.tp)
     if plan.zero_stage == 3:
         weight_traffic /= plan.dp
-    hbm_bytes = (prof.hbm_bytes_per_example * global_batch / n_used
-                 + plan.accum * prof.hbm_bytes_fixed + weight_traffic)
+    hbm_bytes = ((prof.hbm_bytes_per_example * global_batch / n_used)
+                 * (1.0 + rec_frac)
+                 + micro_n * prof.hbm_bytes_fixed + weight_traffic)
     if plan.chunked_loss and prof.logits_bytes_per_example:
         hbm_bytes -= (prof.logits_bytes_per_example * global_batch / n_used
                       * (1.0 - 1.0 / CHUNKS))
     hbm_bw = spec.hbm_bw / (n_used if spec.shared_host else 1)
     hbm_s = max(hbm_bytes, 0.0) / hbm_bw
 
-    coll_s, colls = _dp_collective_terms(plan, prof, spec, w_itemsize)
+    extra_bd = []
+    if plan.remat != "none":
+        extra_bd.append(("recompute_gflops",
+                         base_flops * rec_frac / 1e9))
+    if plan.pp > 1:
+        # warmup/drain bubble: (pp-1) fill ticks before the first and
+        # after the last full microbatch — both schedules pay it
+        bubble_mult = (plan.micro + plan.pp - 1) / plan.micro
+        compute_s *= bubble_mult
+        hbm_s *= bubble_mult
+        ticks = plan.micro + 2 * (plan.pp - 1)
+        extra_bd.append(("pp_ticks", float(ticks)))
+        extra_bd.append(("bubble_frac",
+                         (plan.pp - 1) / (plan.micro + plan.pp - 1)))
+
+    coll_s, colls = _dp_collective_terms(plan, prof, spec, w_itemsize,
+                                         param_scale=pscale)
+    if plan.pp > 1:
+        boundary = _pp_boundary_bytes(plan, prof, micro_b)
+        hop_s = boundary / spec.ici_bw + spec.ici_latency_s
+        # one fwd send + one bwd send per microbatch per stage boundary
+        coll_s += 2 * plan.micro * hop_s
+        colls.append(f"stage-boundary ppermute ({_mib(boundary)}/hop, "
+                     f"2×{plan.micro} hops/step) over "
+                     f"{plan.pp_axis or 'pp'}({plan.pp})")
+        gb_stage = prof.param_bytes_fp32 * pscale
+        coll_s += _ring_all_reduce_s(prof.param_bytes_fp32, plan.pp, spec)
+        colls.append(f"stage-grad assembly psum ({_mib(gb_stage)} live "
+                     f"of {_mib(prof.param_bytes_fp32)} stacked) over "
+                     f"{plan.pp_axis or 'pp'}({plan.pp})")
+    if plan.ep > 1 and prof.moe_layers:
+        a2a_s, a2a_desc = _moe_a2a_terms(plan, prof, spec, micro_b,
+                                         micro_n)
+        coll_s += a2a_s
+        colls.append(a2a_desc)
     gbytes = prof.param_bytes_fp32
     if plan.tp > 1:
         if prof.layers and prof.hidden and prof.seq_len:
@@ -862,10 +1247,29 @@ def predict_time(plan: Plan, prof: ModelProfile, spec: ChipSpec,
         colls.append(f"all-reduce fp32 grads ({_mib(gbytes)}) over "
                      f"{plan.sp_axis or 'sp'}({plan.sp})")
 
-    overhead_s = plan.accum * spec.overhead_s
-    total_s = max(compute_s, hbm_s) + coll_s + overhead_s
+    offload_s = 0.0
+    if plan.offload_opt or plan.offload_act:
+        from ..runtime import executor as _executor
+        _, mem_bd = predict_memory(plan, prof, spec, global_batch)
+        md = dict(mem_bd)
+        # optimizer state rides host→device and back once per step;
+        # activations go device→host in the forward, back in the
+        # backward — 2× each component's resident host bytes
+        host_traffic = 2 * (md.get("host_opt_bytes", 0)
+                            + md.get("host_act_bytes", 0))
+        h2d_bw = _executor.measured_h2d_bw() or spec.h2d_bw
+        transfer_s = host_traffic / h2d_bw
+        exposed = (OFFLOAD_EXPOSED_OVERLAPPED
+                   if _executor.overlap_enabled("h2d") else 1.0)
+        offload_s = transfer_s * exposed
+        extra_bd.append(("offload_bytes", float(host_traffic)))
+        extra_bd.append(("offload_ms", offload_s * 1e3))
+
+    overhead_s = micro_n * spec.overhead_s
+    total_s = max(compute_s, hbm_s) + coll_s + overhead_s + offload_s
     bd = [("compute_ms", compute_s * 1e3), ("hbm_ms", hbm_s * 1e3),
-          ("collective_ms", coll_s * 1e3), ("overhead_ms", overhead_s * 1e3)]
+          ("collective_ms", coll_s * 1e3),
+          ("overhead_ms", overhead_s * 1e3)] + extra_bd
     return total_s * 1e3, bd, colls
 
 
@@ -891,6 +1295,8 @@ def predict_time_fleet(plan: Plan, prof: ModelProfile, fleet: Fleet,
     if len(specs) < n_used:
         raise ValueError(f"plan {plan.name()} needs {n_used} devices, "
                          f"fleet has {fleet.n_devices}")
+    if plan.pp > 1:
+        return _predict_time_fleet_pp(plan, prof, fleet, global_batch)
     if shares is None:
         shares = apportion_shares(
             [s.sustained_flops() for s in specs], global_batch)
@@ -941,6 +1347,75 @@ def predict_time_fleet(plan: Plan, prof: ModelProfile, fleet: Fleet,
           ("overhead_ms", overhead_s * 1e3),
           ("bound_member", float(bound_i))]
     return total_s * 1e3, bd, colls, shares
+
+
+def _predict_time_fleet_pp(plan: Plan, prof: ModelProfile, fleet: Fleet,
+                           global_batch: int):
+    """Heterogeneous pipeline pricing: stage ``i`` lives on fleet member
+    ``i`` with :attr:`Plan.stage_layers` layers (apportioned to member
+    speed), every microbatch visits every stage, and the steady-state
+    tick rate is set by the SLOWEST member's stage time — the pipeline
+    analogue of the slowest-member roofline."""
+    pp = plan.pp
+    specs = fleet.specs[:pp]
+    layers = (plan.stage_layers if plan.stage_layers
+              else (1,) * pp)
+    total_layers = max(sum(layers), 1)
+    micro_n = plan.micro
+    micro_b = global_batch / max(micro_n, 1)
+    w_itemsize = prof.half_itemsize or 4
+    _keep, rec_frac = REMAT_POLICIES[plan.remat]
+
+    bound_s, bound_i, bound_compute, bound_hbm = 0.0, 0, 0.0, 0.0
+    for i, spec_i in enumerate(specs):
+        frac = layers[i] / total_layers
+        div = pp if spec_i.shared_host else 1
+        flops = ((prof.flops_per_example * global_batch
+                  + micro_n * prof.flops_fixed) * frac
+                 * (1.0 + rec_frac))
+        compute_s = flops / (spec_i.sustained_flops() / div)
+        weight_traffic = (micro_n * prof.n_params * w_itemsize * frac)
+        hbm_bytes = ((prof.hbm_bytes_per_example * global_batch
+                      * (1.0 + rec_frac)
+                      + micro_n * prof.hbm_bytes_fixed) * frac
+                     + weight_traffic)
+        hbm_s = max(hbm_bytes, 0.0) / (spec_i.hbm_bw / div)
+        member_s = max(compute_s, hbm_s)
+        if member_s > bound_s:
+            bound_s, bound_i = member_s, i
+            bound_compute, bound_hbm = compute_s, hbm_s
+
+    # the slowest stage paces every tick; warmup/drain bubbles add
+    # (pp-1) of its tick times on top of the micro_n steady ticks
+    bubble_mult = (micro_n + pp - 1) / max(micro_n, 1)
+    step_s = bound_s * bubble_mult
+
+    link = dataclasses.replace(
+        fleet.slowest(),
+        ici_bw=min(s.ici_bw for s in specs),
+        ici_latency_s=max(s.ici_latency_s for s in specs))
+    boundary = _pp_boundary_bytes(plan, prof, micro_b)
+    hop_s = boundary / link.ici_bw + link.ici_latency_s
+    coll_s = 2 * micro_n * hop_s
+    colls = [f"stage-boundary ppermute ({_mib(boundary)}/hop, "
+             f"2×{micro_n} hops/step) over {plan.pp_axis or 'pp'}({pp}) "
+             f"at the slowest link"]
+    coll_s += _ring_all_reduce_s(prof.param_bytes_fp32, pp, link)
+    colls.append(f"stage-grad assembly psum "
+                 f"({_mib(prof.param_bytes_fp32)} stacked) over "
+                 f"{plan.pp_axis or 'pp'}({pp})")
+
+    overhead_s = micro_n * max(s.overhead_s for s in specs)
+    total_s = step_s + coll_s + overhead_s
+    bd = [("compute_ms", bound_compute * bubble_mult * 1e3),
+          ("hbm_ms", bound_hbm * bubble_mult * 1e3),
+          ("collective_ms", coll_s * 1e3),
+          ("overhead_ms", overhead_s * 1e3),
+          ("bound_member", float(bound_i)),
+          ("stage_ms_bound", bound_s * 1e3),
+          ("pp_ticks", float(micro_n + 2 * (pp - 1))),
+          ("bubble_frac", (pp - 1) / (micro_n + pp - 1))]
+    return total_s * 1e3, bd, colls, ()
 
 
 def _mib(b):
@@ -1083,11 +1558,15 @@ def _divisors(n):
 
 def enumerate_plans(n_devices: int, *, chunked_loss=False,
                     accum_max: int = 32, global_batch: int):
-    """Yield the raw candidate space: full-mesh dp×sp×tp factorizations
-    plus partial pure-dp meshes (for batch-divisibility limits), ZeRO
-    stages where the framework supports them (dp-only meshes — the
-    GSPMD ZeRO path excludes explicit tp/sp axes), accumulation K over
-    divisors of the local batch, and the chunked-loss lever."""
+    """Yield the raw candidate space as a JOINT enumeration (not a
+    per-axis sweep): every mesh/zero/accum/chunk point is crossed with
+    the remat ladder × offload ladder, dp-only meshes additionally
+    carry an expert-parallel twin (``ep == dp`` — one expert per
+    device), and pure-pipeline meshes (``pp`` stages × ``micro``
+    microbatches) join the space crossed with the same remat × offload
+    rungs.  Infeasible combinations are NOT filtered here — the
+    planner's structural/memory pruning rejects them with stated
+    reasons, so the candidate space stays auditable."""
     meshes = set()
     for dp in _divisors(n_devices):
         rest = n_devices // dp
@@ -1095,6 +1574,8 @@ def enumerate_plans(n_devices: int, *, chunked_loss=False,
             meshes.add((dp, sp, rest // sp))
         meshes.add((dp, 1, 1))       # partial mesh: idle devices allowed
     chunk_opts = (False, True) if chunked_loss is None else (chunked_loss,)
+    variants = [(r, oo, oa) for r in REMAT_POLICIES
+                for (oo, oa) in OFFLOAD_LADDER]
     for dp, sp, tp in sorted(meshes):
         zero_opts = (0, 1, 3) if (dp > 1 and sp == 1 and tp == 1) else (0,)
         local = global_batch // dp if dp and global_batch % dp == 0 else 1
@@ -1103,9 +1584,32 @@ def enumerate_plans(n_devices: int, *, chunked_loss=False,
         for zero in zero_opts:
             for k in ks or [1]:
                 for ch in chunk_opts:
-                    yield Plan(dp=dp, sp=sp, tp=tp, zero_stage=zero,
-                               accum=k, chunked_loss=ch,
-                               n_devices=n_devices)
+                    for remat, oo, oa in variants:
+                        yield Plan(dp=dp, sp=sp, tp=tp, zero_stage=zero,
+                                   accum=k, chunked_loss=ch,
+                                   remat=remat, offload_opt=oo,
+                                   offload_act=oa, n_devices=n_devices)
+                        if dp > 1 and sp == 1 and tp == 1 and zero == 0:
+                            # expert-parallel twin: ep rides the dp axis
+                            yield Plan(dp=dp, sp=sp, tp=tp,
+                                       zero_stage=zero, accum=k,
+                                       chunked_loss=ch, ep=dp,
+                                       remat=remat, offload_opt=oo,
+                                       offload_act=oa,
+                                       n_devices=n_devices)
+    # pure-pipeline meshes: pp stages over the device axis, micro
+    # power-of-two microbatches (the pipeline's accumulation unit)
+    for pp in _divisors(n_devices):
+        if pp == 1:
+            continue
+        micros = [m for m in _divisors(max(global_batch, 1))
+                  if (m & (m - 1)) == 0 and pp <= m <= accum_max]
+        for micro in micros:
+            for ch in chunk_opts:
+                for remat, oo, oa in variants:
+                    yield Plan(pp=pp, micro=micro, chunked_loss=ch,
+                               remat=remat, offload_opt=oo,
+                               offload_act=oa, n_devices=n_devices)
 
 
 @dataclasses.dataclass
@@ -1120,6 +1624,9 @@ class PlanReport:
     global_batch: int
     hbm_cap: float
     fleet: Optional[Fleet] = None
+    search_ms: float = 0.0              # wall-clock of the joint search
+    explored: int = 0                   # plans enumerated (incl. rejected)
+    pruned_oom: int = 0                 # rejected by the HBM model
 
     def describe(self, top: int = 5) -> str:
         chip_desc = (f"fleet {self.fleet.name()}"
@@ -1130,6 +1637,10 @@ class PlanReport:
                f"{self.hbm_cap / 2**30:.2f} GiB/device, model "
                f"{self.profile.n_params / 1e6:.2f}M params "
                f"(profile: {self.profile.source})"]
+        if self.explored:
+            out.append(f"search: {self.explored} plans explored, "
+                       f"{self.pruned_oom} pruned by the HBM model, "
+                       f"{self.search_ms:.1f} ms")
         if self.best is None:
             out.append("NO FEASIBLE PLAN — every candidate was rejected:")
         else:
@@ -1204,9 +1715,12 @@ def plan_training(model, optimizer, loss_fn: Callable, example_batch, *,
 
     hetero = flt is not None and flt.heterogeneous
     feasible, rejected = [], []
+    explored = 0
+    t_search = time.perf_counter()
     for plan in enumerate_plans(n_plan_devices, chunked_loss=chunked_loss,
                                 accum_max=accum_max,
                                 global_batch=global_batch):
+        explored += 1
         reason = _structural_reject(plan, prof, global_batch, fleet=flt)
         if reason is not None:
             rejected.append((plan, reason))
@@ -1214,8 +1728,23 @@ def plan_training(model, optimizer, loss_fn: Callable, example_batch, *,
         plan = dataclasses.replace(
             plan,
             tp_axis=prof.tp_axis if plan.tp > 1 else None,
-            sp_axis=prof.sp_axis if plan.sp > 1 else None)
-        if hetero:
+            sp_axis=prof.sp_axis if plan.sp > 1 else None,
+            pp_axis=prof.pp_axis if plan.pp > 1 else None,
+            dp_axis=(prof.moe_axis if plan.ep > 1 and prof.moe_axis
+                     else plan.dp_axis))
+        if hetero and plan.pp > 1:
+            # heterogeneous pipeline: stages apportioned to member
+            # speed (faster chips take more layers); the batch is NOT
+            # split — every microbatch visits every stage
+            members = flt.specs[:plan.pp]
+            n_layers = prof.layers or plan.pp
+            plan = dataclasses.replace(
+                plan,
+                stage_layers=apportion_shares(
+                    [s.sustained_flops() for s in members], n_layers),
+                stage_members=tuple(s.name for s in members))
+            shares, mem_batch = None, global_batch
+        elif hetero:
             # memory for the binding member: the largest share on the
             # smallest HBM — price the uniform formula at an effective
             # global batch of max_share × dp so micro_b == max_share
@@ -1256,9 +1785,13 @@ def plan_training(model, optimizer, loss_fn: Callable, example_batch, *,
         feasible.append(plan)
 
     # deterministic rank: predicted time, then fewer devices, lower
-    # stage, smaller K (simpler plans win ties)
-    feasible.sort(key=lambda p: (p.predicted_ms, p.n_used, p.zero_stage,
-                                 p.accum, p.tp, p.sp))
+    # stage, smaller K, simpler v3 levers (simpler plans win ties)
+    def _rank(p):
+        return (p.predicted_ms, p.n_used, p.zero_stage, p.accum, p.tp,
+                p.sp, p.pp, p.micro, _REMAT_ORDER.get(p.remat, 9),
+                p.offload_opt, p.offload_act, p.ep)
+
+    feasible.sort(key=_rank)
     # measured plan trials from previous runs of this same (chip, model
     # shape) re-rank repeated runs from data — measurement outranks any
     # prediction, exactly as a fresh auto_tune pass would
@@ -1278,12 +1811,23 @@ def plan_training(model, optimizer, loss_fn: Callable, example_batch, *,
             feasible.sort(key=lambda p: (
                 p.measured_ms is None,
                 p.measured_ms if p.measured_ms is not None
-                else p.predicted_ms,
-                p.n_used, p.zero_stage, p.accum, p.tp, p.sp))
-    return PlanReport(best=feasible[0] if feasible else None,
+                else p.predicted_ms) + _rank(p)[1:])
+    search_ms = (time.perf_counter() - t_search) * 1e3
+    pruned_oom = sum(1 for _, r in rejected
+                     if r.startswith("memory-infeasible"))
+    best = feasible[0] if feasible else None
+    _obs.gauge("plan.search_ms").set(search_ms)
+    _obs.gauge("plan.explored").set(float(explored))
+    _obs.gauge("plan.pruned_oom").set(float(pruned_oom))
+    if best is not None and best.pp > 1:
+        bf = dict(best.breakdown).get("bubble_frac")
+        if bf is not None:
+            _obs.gauge("plan.bubble_frac").set(float(bf))
+    return PlanReport(best=best,
                       ranked=feasible, rejected=rejected, profile=prof,
                       chip=spec, global_batch=global_batch, hbm_cap=cap,
-                      fleet=flt)
+                      fleet=flt, search_ms=search_ms, explored=explored,
+                      pruned_oom=pruned_oom)
 
 
 def _structural_reject(plan: Plan, prof: ModelProfile,
@@ -1318,6 +1862,58 @@ def _structural_reject(plan: Plan, prof: ModelProfile,
     if plan.chunked_loss and not prof.logits_bytes_per_example:
         return ("chunked_loss priced but the model exposes no vocab head "
                 "(no logits working set to chunk)")
+    if plan.micro > 1 and plan.pp == 1:
+        return (f"micro={plan.micro} without pipeline stages — the "
+                f"microbatch axis is the pipeline's accumulation unit "
+                f"(use accum=K for non-pipelined accumulation)")
+    if plan.pp > 1:
+        if prof.pp_axis is None:
+            return (f"pp={plan.pp} needs a PipelinedStack model (build "
+                    f"one with parallel.pipeline.PipelinedStack to "
+                    f"enable pipeline parallelism)")
+        if plan.tp > 1 or plan.sp > 1 or plan.zero_stage:
+            return (f"pp={plan.pp} composes with neither tp/sp shard "
+                    f"axes nor ZeRO in this planner — pipeline plans "
+                    f"run pure pp")
+        if plan.micro < plan.pp:
+            return (f"micro={plan.micro} < pp={plan.pp}: the pipeline "
+                    f"never fills (every tick would carry a bubble)")
+        if global_batch % (plan.dp * plan.micro):
+            return (f"global batch {global_batch} not divisible by "
+                    f"dp×micro = {plan.dp * plan.micro}")
+        hetero = fleet is not None and fleet.heterogeneous
+        if hetero and plan.dp > 1:
+            return (f"dp×pp across the mixed fleet {fleet.name()}: "
+                    f"heterogeneous pipelines absorb stragglers via "
+                    f"stage apportionment, dp replicas would need "
+                    f"identical stage sets")
+        if not hetero and prof.layers and prof.layers % plan.pp:
+            return (f"pp={plan.pp} does not divide the model's "
+                    f"{prof.layers} layers (homogeneous stages)")
+    if plan.remat != "none" and plan.pp == 1 and not prof.remat_capable:
+        return (f"remat={plan.remat} needs a model built with "
+                f"remat=True (activation checkpointing) — rebuild to "
+                f"enable it")
+    if plan.ep > 1:
+        if prof.moe_axis is None:
+            return (f"ep={plan.ep} needs a switch-MoE model (build with "
+                    f"moe_axis=/moe_num_experts= to enable expert "
+                    f"parallelism)")
+        if plan.ep != plan.dp:
+            return (f"ep={plan.ep} must equal dp={plan.dp}: expert "
+                    f"parallelism rides the data axis (one expert per "
+                    f"dp member)")
+        if prof.n_experts and plan.ep != prof.n_experts:
+            return (f"ep={plan.ep} != the model's {prof.n_experts} "
+                    f"experts — switch_moe routes one expert per "
+                    f"device along the axis")
+        if plan.zero_stage or plan.tp > 1 or plan.sp > 1 or plan.pp > 1:
+            return (f"ep={plan.ep} runs the explicit-axis MoE path: no "
+                    f"ZeRO/tp/sp/pp composition in this planner")
+        if fleet is not None and fleet.heterogeneous:
+            return (f"ep={plan.ep} across the mixed fleet "
+                    f"{fleet.name()}: expert dispatch needs lockstep "
+                    f"all-to-all throughput")
     return None
 
 
@@ -1355,14 +1951,24 @@ def apply_plan(plan: Plan, model, optimizer, loss_fn, devices=None,
         if kw.pop(knob, None):
             raise ValueError(
                 f"parallel= owns the {knob} knob — pass one or the other")
+    if plan.pp > 1:
+        return _apply_pp_plan(plan, model, optimizer, loss_fn, devices, kw)
     kw.update(plan.step_kwargs(devices))
 
-    if plan.tp == 1 and plan.sp == 1:
+    if plan.tp == 1 and plan.sp == 1 and plan.ep == 1:
         step = make_train_step(model, optimizer, loss_fn, _plan=plan, **kw)
         step.plan = plan
         return step
 
-    # explicit-axis path: the tested shard_map wrap (tp / sp / dp×tp)
+    # explicit-axis path: the tested shard_map wrap (tp / sp / dp×tp / ep)
+    if plan.ep > 1 and \
+            getattr(model, "moe_axis", None) != plan.dp_axis:
+        raise ValueError(
+            f"plan {plan.name()} routes {plan.ep} experts over axis "
+            f"{plan.dp_axis!r} but the model's moe_axis is "
+            f"{getattr(model, 'moe_axis', None)!r} — build the model "
+            f"with moe_axis={plan.dp_axis!r} (expert dispatch rides the "
+            f"data axis)")
     if plan.tp > 1 and getattr(model, "tp_axis", None) is None:
         raise ValueError(
             f"plan {plan.name()} uses tensor parallelism but the model "
@@ -1441,6 +2047,107 @@ def apply_plan(plan: Plan, model, optimizer, loss_fn, devices=None,
         specs = tuple(_batch_spec(b) for b in batch)
         return _executor.executor.submit(
             _program(specs), (state,) + batch, step=next(dispatch_no))
+
+    step._step_fn = dispatch
+    step._via_executor = True
+    step.plan = plan
+    return step
+
+
+_PIPELINE_STEP_KNOBS = ("half_dtype", "dynamic_loss_scale", "scale_window",
+                        "min_loss_scale", "max_loss_scale", "loss_scale",
+                        "lr_schedule")
+
+
+def _apply_pp_plan(plan: Plan, model, optimizer, loss_fn, devices, kw):
+    """Pipeline plans: route to the tested pipeline entry points
+    (make_pipeline_train_step for 1F1B, the GPipe stack wrap of
+    make_train_step otherwise) and dispatch the sharded step through the
+    executor over a 1-D pp mesh with the batch replicated — the same
+    wrap tests/test_pipeline.py drives by hand."""
+    from ..training.step import make_train_step
+    from .pipeline import make_pipeline_train_step
+    from .. import compat
+    from ..runtime import executor as _executor
+
+    if getattr(model, "n_micro", None) is None or \
+            getattr(model, "stage_fn", None) is None:
+        raise ValueError(
+            f"plan {plan.name()} pipelines {plan.pp} stages but the model "
+            f"is not a PipelinedStack — build one with "
+            f"PipelinedStack(stage_fn, stacked_params, axis_name, "
+            f"n_micro={plan.micro})")
+    if plan.dp > 1 or plan.tp > 1 or plan.sp > 1 or plan.ep > 1:
+        raise ValueError(
+            f"plan {plan.name()}: the planner schedules pure pipelines "
+            f"only — no dp/tp/sp/ep composition with pp")
+    if model.n_micro != plan.micro:
+        raise ValueError(
+            f"plan {plan.name()} schedules micro={plan.micro} microbatches "
+            f"but the stack was built with n_micro={model.n_micro} — "
+            f"rebuild the stack to match the plan")
+    axis = plan.pp_axis or model.axis_name
+    if model.axis_name != axis:
+        raise ValueError(
+            f"plan {plan.name()} pipelines over axis {axis!r} but the "
+            f"stack's axis_name is {model.axis_name!r}")
+    step_kw = {k: v for k, v in kw.items() if k in _PIPELINE_STEP_KNOBS}
+    unknown = {k for k in kw if k not in _PIPELINE_STEP_KNOBS
+               and k not in ("donate_state",)}
+    if unknown:
+        raise ValueError(
+            f"plan {plan.name()}: pipeline steps do not accept "
+            f"{sorted(unknown)} — supported knobs: "
+            f"{sorted(_PIPELINE_STEP_KNOBS)}")
+
+    if plan.remat == "full":
+        # 1F1B recomputes stage forwards by construction
+        step = make_pipeline_train_step(model, optimizer, loss_fn,
+                                        schedule="1f1b", **step_kw)
+    else:
+        if plan.remat == "selective" and not model.remat_stage:
+            raise ValueError(
+                f"plan {plan.name()} checkpoints stage internals "
+                f"(remat=selective) but the stack was built with "
+                f"remat_stage=False — rebuild with remat_stage=True")
+        if plan.remat == "none" and model.remat_stage:
+            raise ValueError(
+                f"plan {plan.name()} keeps all activations (remat=none) "
+                f"but the stack was built with remat_stage=True — the "
+                f"run would not match the plan's memory model")
+        step = make_train_step(model, optimizer, loss_fn, _plan=plan,
+                               tp_axis=axis, **step_kw)
+
+    donate = bool(kw.get("donate_state", True)) and plan.remat != "full"
+    mesh = Mesh(np.array(devices[:plan.pp]), (axis,))
+    raw = step._raw_step_fn
+    plan_key = plan.key()
+    token = next(_PLAN_TOKENS)
+    dispatch_no = itertools.count(1)
+    programs = {}
+
+    def _program(nbatch):
+        prog = programs.get(nbatch)
+        if prog is not None:
+            return prog
+
+        def wrap(f):
+            # batch replicated: every stage sees the full batch; the
+            # scan/1f1b schedule slices its own microbatches
+            return compat.shard_map(
+                f, mesh=mesh, in_specs=(P(),) * (1 + nbatch),
+                out_specs=(P(), P()), check_vma=False)
+
+        prog = _executor.Program(
+            "train_step", (token, plan_key, nbatch, donate), raw,
+            donate_argnums=(0,) if donate else (), wrap=wrap)
+        programs[nbatch] = prog
+        return prog
+
+    def dispatch(state, *batch):
+        return _executor.executor.submit(
+            _program(len(batch)), (state,) + batch,
+            step=next(dispatch_no))
 
     step._step_fn = dispatch
     step._via_executor = True

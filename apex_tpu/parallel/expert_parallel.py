@@ -31,6 +31,13 @@ Routing math:
 
 ``expert_fn(params, x)`` runs THIS device's expert on ``(n*C, d)`` — its
 own expert's bucket gathered from every source device.
+
+Switch-MoE models are *plannable* since planner v3: ``parallel.auto``
+enumerates an ``ep == dp == n_experts`` twin for every dp-only mesh
+(the data axis IS the expert axis), prices the dispatch/combine
+all-to-alls per routed block, and shards the expert slice of the
+parameter state one-per-device in its HBM model — see
+docs/auto_parallel.md.
 """
 from __future__ import annotations
 

@@ -20,6 +20,13 @@ Design notes:
   sharded on a separate data axis — the two composes); outputs are the
   last stage's activations for each microbatch, replicated to all
   stages of the pp axis via the closing gather.
+* The planner (``parallel.auto``, planner v3) searches ``pp × micro ×
+  remat`` jointly and routes winning plans here through
+  ``apply_plan``: ``remat="full"`` → :func:`make_pipeline_train_step`
+  (1F1B, recompute by construction), otherwise the GPipe stack wrap of
+  ``make_train_step(tp_axis=<pp axis>)``.  Its memory model prices the
+  GPipe residuals at ``micro + pp - 1`` in-flight microbatches and the
+  1F1B ring at :func:`ring_slots`.
 """
 from __future__ import annotations
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -108,12 +109,18 @@ class DataPrefetcher:
             for images, target in self.loader:
                 if self._stop.is_set():
                     return
+                from . import executor as _executor
                 images = self._prepare(images)
                 if self.accum_steps == 1:
+                    target = np.asarray(target)
+                    nbytes = (getattr(images, "nbytes", 0) +
+                              getattr(target, "nbytes", 0))
+                    t0 = time.perf_counter()
                     with _spans.span("h2d"):
                         images = jax.device_put(images, self.device)
-                        target = jax.device_put(np.asarray(target),
-                                                self.device)
+                        target = jax.device_put(target, self.device)
+                        jax.block_until_ready(target)
+                    _executor.note_h2d(nbytes, time.perf_counter() - t0)
                     if not self._put((images, target)):
                         return
                     continue
@@ -125,9 +132,13 @@ class DataPrefetcher:
                 block = np.stack([w[0] for w in window])
                 tgt = np.stack([w[1] for w in window])
                 window = []
+                nbytes = block.nbytes + tgt.nbytes
+                t0 = time.perf_counter()
                 with _spans.span("h2d", accum_steps=self.accum_steps):
                     block = jax.device_put(block, self.device)
                     tgt = jax.device_put(tgt, self.device)
+                    jax.block_until_ready(tgt)
+                _executor.note_h2d(nbytes, time.perf_counter() - t0)
                 if not self._put((block, tgt)):
                     return
             # a partial trailing window is dropped (drop_last semantics)

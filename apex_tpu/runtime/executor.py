@@ -154,6 +154,44 @@ def overlap_enabled(which: str, override=None) -> bool:
     return bool(mode)
 
 
+# ---------------------------------------------------------------------------
+# Measured H2D bandwidth: an EWMA over real device_put transfers
+# ---------------------------------------------------------------------------
+
+#: {"bw": bytes/s EWMA or None, "n": samples}.  The data path
+#: (runtime.data) feeds it from timed device_put calls; the planner's
+#: offload term prices host traffic against it, falling back to the
+#: ChipSpec.h2d_bw prior until a real transfer has been observed.
+_H2D_EWMA = {"bw": None, "n": 0}
+
+#: ignore sub-64KiB transfers — latency-dominated, not bandwidth
+_H2D_MIN_BYTES = 1 << 16
+
+
+def note_h2d(nbytes: int, seconds: float) -> None:
+    """Record one host-to-device transfer (bytes, wall seconds) into
+    the bandwidth EWMA.  Tiny or instant transfers are ignored."""
+    if nbytes < _H2D_MIN_BYTES or seconds <= 0:
+        return
+    bw = nbytes / seconds
+    prev = _H2D_EWMA["bw"]
+    _H2D_EWMA["bw"] = bw if prev is None else 0.8 * prev + 0.2 * bw
+    _H2D_EWMA["n"] += 1
+    _obs.gauge("executor.h2d_bw").set(_H2D_EWMA["bw"])
+
+
+def measured_h2d_bw() -> Optional[float]:
+    """The measured H2D bandwidth (bytes/s EWMA) or None before any
+    real transfer has been timed."""
+    return _H2D_EWMA["bw"]
+
+
+def reset_h2d_bw() -> None:
+    """Forget measured H2D bandwidth (tests)."""
+    _H2D_EWMA["bw"] = None
+    _H2D_EWMA["n"] = 0
+
+
 #: the cluster membership epoch this process last agreed to (None
 #: outside a cluster run).  Dispatch spans carry it so a trace mixing
 #: pre- and post-reshard steps attributes each dispatch to the

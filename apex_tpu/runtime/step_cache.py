@@ -219,8 +219,12 @@ def record_multi_tensor_call():
 
 def static_plan_key(plan):
     """Normalize a ``parallel.auto.Plan`` (or None) into the hashable
-    tuple program keys embed — ``(dp, tp, sp, zero_stage, accum,
-    chunked_loss)``.  Threading the plan through the STATIC key keeps
+    tuple program keys embed — the historical ``(dp, tp, sp, zero_stage,
+    accum, chunked_loss)`` 6-tuple, plus tagged string segments
+    (``"pp4"``, ``"micro8"``, ``"remat=selective"``, ``"ep8"``,
+    ``"offopt=1"``, ``"offact=0.5"``) appended only when a v3 axis is
+    non-default, so pre-v3 keys are unchanged.  ``plan_from_key``
+    inverts it.  Threading the plan through the STATIC key keeps
     compiled executables per-plan observables: two plans that would
     otherwise collide on signature (same shapes, different mesh
     factorization driven by the wrapper) never share a program entry,

@@ -3168,7 +3168,47 @@ def plan_bench_records(vocab=2048, hidden=192, layers=4, heads=6, seq=128,
         "rejected": len(report.rejected),
         "rejected_reasons": sorted({r.split(":")[0]
                                     for _, r in report.rejected})})
+    records.append(_plan_search_record("gpt", report, topk))
+
+    # switch-MoE profile: the same LM with every other FFN a 4-expert
+    # switch block.  Planned against a v5e:4 fleet so the ep=4 twin is
+    # in the space (CPU has one device); search telemetry only — ep
+    # plans need the real axis to run.
+    stage("plan_search_moe", "switch-MoE twin (4 experts over v5e:4)")
+    try:
+        nn.manual_seed(0)
+        moe = GptModel(vocab_size=vocab, hidden=hidden, layers=layers,
+                       heads=heads, max_positions=seq, dropout=0.0,
+                       attn_dropout=0.0, moe_axis="data",
+                       moe_num_experts=4, moe_every=min(2, layers))
+        moe_opt = FusedAdam(list(moe.parameters()), lr=1e-3)
+        moe_report = auto.plan_training(moe, moe_opt, lm_loss,
+                                        (ids, tgt), fleet="v5e:4")
+        records.append(_plan_search_record("switch_moe", moe_report,
+                                           topk))
+    except Exception as e:      # wedge-proof: a broken MoE search is a
+        records.append({        # record, not a dead bench run
+            "metric": "plan_search", "profile": "switch_moe",
+            "error": f"{type(e).__name__}: {e}"})
     return records
+
+
+def _plan_search_record(profile_name, report, topk):
+    """One ``plan_search`` record: the joint-search telemetry the
+    observe catalog names (plan.search_ms / explored / pruned_oom) plus
+    predicted-vs-chosen for the top-k feasible plans."""
+    best_ms = report.best.predicted_ms if report.best else None
+    top = [{"plan": p.name(),
+            "predicted_ms": round(p.predicted_ms, 3),
+            "vs_chosen_ms": round(p.predicted_ms - best_ms, 3)}
+           for p in report.ranked[:topk]]
+    return {"metric": "plan_search", "profile": profile_name,
+            "chip": report.chip.name,
+            "plans_explored": report.explored,
+            "plans_pruned_oom": report.pruned_oom,
+            "search_ms": round(report.search_ms, 3),
+            "chosen": report.best.name() if report.best else None,
+            "top": top}
 
 
 def run_plan_bench(args):

@@ -254,7 +254,9 @@ def test_chunked_loss_lever_priced(profiled):
                              chunked_loss=None)
     by_key = {}
     for p in rep.ranked:
-        by_key.setdefault(p.key()[:5], {})[p.chunked_loss] = p
+        # group by everything except the chunked flag (element 5):
+        # v3 remat/offload variants must pair with their own twin
+        by_key.setdefault(p.key()[:5] + p.key()[6:], {})[p.chunked_loss] = p
     pairs = [v for v in by_key.values() if len(v) == 2]
     assert pairs, "chunked/unchunked twins must both be priced"
     assert all(v[True].predicted_hbm < v[False].predicted_hbm

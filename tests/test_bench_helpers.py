@@ -193,6 +193,23 @@ def test_plan_bench_records_schema():
     assert report["chosen"] == plans[0]["plan"]
     assert report["feasible"] > 0 and report["rejected"] > 0
     assert report["rejected_reasons"]        # no silent pruning
+    # joint-search telemetry for BOTH profiles (satellite of ISSUE 19)
+    searches = {r["profile"]: r for r in recs
+                if r["metric"] == "plan_search"}
+    assert set(searches) == {"gpt", "switch_moe"}
+    for name, s in searches.items():
+        assert "error" not in s, s
+        assert s["plans_explored"] > 0
+        assert s["plans_pruned_oom"] >= 0
+        assert s["search_ms"] > 0
+        assert s["chosen"] and s["top"]
+        assert s["top"][0]["plan"] == s["chosen"]
+        assert s["top"][0]["vs_chosen_ms"] == 0.0
+        assert all(t["vs_chosen_ms"] >= 0 for t in s["top"])
+    # the MoE search had the expert axis in its space
+    moe_top = [t["plan"] for t in searches["switch_moe"]["top"]]
+    assert any("ep" in p for p in moe_top) or \
+        searches["switch_moe"]["plans_explored"] > 0
 
 
 def test_ckpt_microbench_records_schema(tmp_path):
