@@ -232,6 +232,16 @@ class PagedSession:
         self._table = []
         self.position = 0
         self._last_logits = None
+        # prefix-cache state: every ingested token in order (the chain
+        # source), the rolling chain keys of committed full blocks, and
+        # the tag the chain was built under — a weight republish
+        # mid-session changes the engine's tag and stops this session
+        # from publishing further (mixed-epoch) blocks
+        self._tokens = []
+        self._chain = []
+        self._committed = 0
+        self._cache_tag = None
+        self._cacheable = True
 
     # -- block-table state -------------------------------------------------
 
@@ -258,6 +268,84 @@ class PagedSession:
                     f"engine with more num_blocks")
             self._table.extend(ids)
 
+    def _commit_full(self):
+        """Publish every newly full block into the engine pool's hash
+        index (rolling chain over the session's ingested tokens) —
+        the PagedSession half of the serve scheduler's note_commit."""
+        from ..serve.pool import chain_key
+        eng = self.engine
+        sched = eng.scheduler
+        if not sched.prefix_cache or not self._cacheable:
+            return
+        if self._cache_tag is None:
+            self._cache_tag = sched.cache_tag
+        elif sched.cache_tag != self._cache_tag:
+            # publish_weights re-tagged the engine mid-session: rows
+            # already written used the old weights, so nothing this
+            # session writes from here on may enter the index
+            self._cacheable = False
+            return
+        bs = eng.block_size
+        full = min(self.position // bs, len(self._table),
+                   len(self._tokens) // bs)
+        while self._committed < full:
+            i = self._committed
+            prev = self._chain[i - 1] if i else ""
+            key = chain_key(prev, self._tokens[i * bs:(i + 1) * bs],
+                            self._cache_tag)
+            self._chain.append(key)
+            eng.block_pool.commit(self._table[i], key)
+            self._committed = i + 1
+
+    def _adopt_prefix(self, toks) -> int:
+        """First-append prefix walk: adopt every cached full block of
+        ``toks`` shared and return the number of already-ingested
+        positions.  A FULL-chain hit forks the last shared block
+        copy-on-write (the final token must re-ingest for its logits,
+        and that row lands inside the shared block)."""
+        import numpy as np
+        from ..serve.pool import chain_keys
+        from ..runtime import executor as _executor
+        from ..observe import registry as _obs
+        eng = self.engine
+        sched = eng.scheduler
+        if not sched.prefix_cache:
+            return 0
+        tag = sched.cache_tag
+        keys = chain_keys(toks, eng.block_size, tag)
+        shared = eng.block_pool.acquire_prefix(keys)
+        if not shared:
+            return 0
+        self._cache_tag = tag
+        if len(shared) * eng.block_size >= toks.size:
+            # full hit — fork the last shared block so the re-ingested
+            # final token writes an exclusive copy
+            fdst_l = eng.block_pool.alloc(1)
+            if fdst_l is None:
+                # no room for the fork: fall back to a partial hit by
+                # releasing the last shared block (it retires cached)
+                eng.block_pool.free([shared[-1]])
+                shared = shared[:-1]
+            else:
+                fsrc, fdst = shared[-1], fdst_l[0]
+                prog = eng._copy_program()
+                eng.pool = _executor.executor.submit(
+                    prog, (eng.pool, np.int32(fsrc), np.int32(fdst)),
+                    step=next(eng._dispatch_no))
+                eng.block_pool.free([fsrc])   # copy is in the stream
+                eng._cow_forks += 1
+                _obs.counter("serve.prefix.cow_forks").inc()
+                self._table = shared[:-1] + [fdst]
+                self._chain = keys[:len(shared) - 1]
+                self._committed = len(shared) - 1
+                self.position = toks.size - 1
+                return self.position
+        self._table = list(shared)
+        self._chain = keys[:len(shared)]
+        self._committed = len(shared)
+        self.position = len(shared) * eng.block_size
+        return self.position
+
     # -- public ------------------------------------------------------------
 
     def append(self, tokens):
@@ -273,6 +361,11 @@ class PagedSession:
         prefill_prog, _ = eng._programs()
         chunk = eng.scheduler.prefill_chunk
         done = 0
+        if self.position == 0:
+            # empty session: a conversation replay (or a shared system
+            # prompt another session committed) is a natural prefix hit
+            done = self._adopt_prefix(toks)
+        self._tokens.extend(int(t) for t in toks)
         while done < toks.size:
             n = int(min(chunk, toks.size - done))
             self._ensure(self.position + n, "append")
@@ -289,6 +382,7 @@ class PagedSession:
                 step=next(eng._dispatch_no))
             self.position += n
             done += n
+            self._commit_full()
         self._last_logits = last
         return last
 
@@ -323,6 +417,8 @@ class PagedSession:
                  np.asarray([self.position], np.int32), table),
                 step=next(eng._dispatch_no))
             self.position += 1
+            self._tokens.append(out[-1])
+            self._commit_full()
             self._last_logits = logits
             if i < max_new_tokens - 1:
                 out.append(int(np.asarray(nxt)[0]))
@@ -336,6 +432,11 @@ class PagedSession:
         self._table = []
         self.position = 0
         self._last_logits = None
+        self._tokens = []
+        self._chain = []
+        self._committed = 0
+        self._cache_tag = None
+        self._cacheable = True
 
     close = reset
 

@@ -1794,3 +1794,99 @@ class WeightPublish(Rule):
                     f"{d}({name}, ...) — raw placement of what looks "
                     f"like model/optimizer state; unmeasured weight "
                     f"movement bypasses the reshard surface")
+
+
+# ---------------------------------------------------------------------------
+# POOL-ALIAS
+# ---------------------------------------------------------------------------
+
+#: BlockPool bookkeeping attributes no code outside serve/pool.py may
+#: touch — mutating them directly desynchronizes refcounts from block
+#: tables, which the pool can only report as a leak or a double free
+_POOL_PRIVATE_ATTRS = {"_free", "_refs", "_cached", "_hash_index",
+                       "_block_hash"}
+
+#: jnp ``.at[...]`` scatter methods that WRITE (``.get`` reads)
+_AT_WRITE_METHODS = {"set", "add", "subtract", "multiply", "divide",
+                     "min", "max", "apply", "power"}
+
+
+def _names_a_pool(node: ast.AST) -> bool:
+    """True when a dotted expression's name says it is a KV pool
+    (``pool``, ``self.pool``, ``engine.dpool``, ``block_pool.q`` ...).
+    Name-based on purpose: pool buffers are plain jnp arrays by the
+    time they are scattered into, so there is no type to resolve —
+    and the repo's naming convention is exactly what the rule audits."""
+    d = _dotted(node)
+    if d is None:
+        return False
+    return any("pool" in part.lower() for part in d.split("."))
+
+
+@register
+class PoolAlias(Rule):
+    """Pool-block writes outside the refcount API — PR 20.
+
+    The prefix cache made pool blocks SHARED: ``acquire_prefix`` hands
+    N sessions the same physical block, ``commit`` publishes it in the
+    hash index, and the only safe mutations are the pool's own
+    refcounted verbs (``alloc`` / ``free`` / ``commit`` /
+    ``acquire_prefix`` / ``flush_cache``).  Two aliasing hazards exist
+    and both are silent at the write site.  (1) An in-place scatter
+    (``pool.at[..., blk].set(...)``) into a shared block rewrites KV
+    that OTHER sessions' attention is reading — cross-session
+    corruption with no crash, just wrong tokens for whoever shares the
+    prefix; every legitimate scatter lives in the serve kernel bodies
+    (serve/kernels.py, including the copy-on-write fork) or the
+    handoff restore (runtime/resilience.py), where the scheduler has
+    proven the target block exclusive.  (2) Reaching into the pool's
+    private bookkeeping (``pool._free`` / ``pool._refs`` / the hash
+    index) instead of calling ``free`` bypasses refcounting entirely:
+    a block two tables still reference returns to the free list, the
+    allocator re-grants it, and two sessions now scatter into each
+    other.  Flags both patterns on any pool-named base outside the
+    sanctioned homes; docs/lint.md carries the incident.
+    """
+    id = "POOL-ALIAS"
+    summary = ("direct free/scatter-write of KV pool blocks outside "
+               "serve/pool.py's refcount API (shared-block corruption)")
+    hint = ("go through the BlockPool verbs — alloc/free/commit/"
+            "acquire_prefix keep refcounts and block tables in sync; "
+            "a write into a shared block belongs behind a copy-on-write "
+            "fork (scheduler cow_pending + the block_copy program), "
+            "never an ad-hoc scatter; see docs/serving.md's Prefix "
+            "caching section")
+
+    def check(self, module, ctx):
+        path = module.path.replace("\\", "/")
+        if path.endswith("apex_tpu/serve/pool.py"):
+            return      # the refcount API's own implementation
+        kernel_home = path.endswith("apex_tpu/serve/kernels.py") \
+            or path.endswith("apex_tpu/runtime/resilience.py")
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in _POOL_PRIVATE_ATTRS and \
+                    _names_a_pool(node.value):
+                yield self.finding(
+                    module, node,
+                    f"direct access to pool bookkeeping "
+                    f"'.{node.attr}' — mutating it desynchronizes "
+                    f"refcounts from live block tables (double grants "
+                    f"of shared blocks)")
+                continue
+            if kernel_home or not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in _AT_WRITE_METHODS
+                    and isinstance(fn.value, ast.Subscript)):
+                continue
+            at = fn.value.value
+            if isinstance(at, ast.Attribute) and at.attr == "at" and \
+                    _names_a_pool(at.value):
+                yield self.finding(
+                    module, node,
+                    f"in-place .at[...].{fn.attr}() scatter into a KV "
+                    f"pool buffer — if the target block is shared "
+                    f"(prefix cache), this rewrites KV other sessions "
+                    f"are reading")

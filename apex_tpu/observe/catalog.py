@@ -93,6 +93,41 @@ CATALOG: Dict[str, Dict[str, str]] = {
         "kind": "event", "unit": "record",
         "description": "ServeEngine.publish_weights applied: weight "
                        "set, epoch now served, tick, leaf count."},
+    # -- serve: prefix cache (content-addressed KV block reuse) ------------
+    "serve.prefix.hit_rate": {
+        "kind": "gauge", "unit": "fraction",
+        "description": "Prompt tokens admission found already cached "
+                       "over all prompt tokens submitted, engine "
+                       "lifetime-cumulative (docs/serving.md, Prefix "
+                       "caching)."},
+    "serve.prefix.tokens_saved": {
+        "kind": "counter", "unit": "tokens",
+        "description": "Prompt tokens whose prefill was skipped "
+                       "because their KV blocks were adopted from the "
+                       "hash index."},
+    "serve.prefix.cow_forks": {
+        "kind": "counter", "unit": "blocks",
+        "description": "Copy-on-write forks of shared blocks (a "
+                       "full-chain hit re-ingests its final token into "
+                       "an exclusive copy)."},
+    "serve.cache.evictions": {
+        "kind": "counter", "unit": "blocks",
+        "description": "Cached-tier blocks evicted under allocation "
+                       "pressure (hash entry dropped, id returned to "
+                       "the free list)."},
+    "serve.pool.free": {
+        "kind": "gauge", "unit": "blocks",
+        "description": "Free-list blocks: allocatable without evicting "
+                       "any cached-tier entry."},
+    "serve.pool.cached": {
+        "kind": "gauge", "unit": "blocks",
+        "description": "Cached-tier blocks: refcount zero with a live "
+                       "hash entry — reclaimable headroom, not "
+                       "occupancy."},
+    "serve.pool.active": {
+        "kind": "gauge", "unit": "blocks",
+        "description": "Blocks held by at least one live block table "
+                       "(refcount >= 1)."},
     # -- planner: the joint pp×remat×offload×ep search ---------------------
     "plan.search_ms": {
         "kind": "gauge", "unit": "ms",

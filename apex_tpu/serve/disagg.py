@@ -64,10 +64,10 @@ class PendingHandoff:
     blocks."""
 
     __slots__ = ("request", "out", "pending_tok", "position", "dir",
-                 "t_queued", "t_first")
+                 "t_queued", "t_first", "hash_chain", "weight_epoch")
 
     def __init__(self, request, out, pending_tok, position, dir_path,
-                 t_queued, t_first):
+                 t_queued, t_first, hash_chain=(), weight_epoch=-1):
         self.request = request
         self.out = list(out)
         self.pending_tok = pending_tok
@@ -75,6 +75,8 @@ class PendingHandoff:
         self.dir = dir_path
         self.t_queued = t_queued
         self.t_first = t_first
+        self.hash_chain = list(hash_chain)
+        self.weight_epoch = weight_epoch
 
 
 class DisaggregatedEngine:
@@ -92,7 +94,8 @@ class DisaggregatedEngine:
                  prefill_chunk=32, cache_dtype=None, window=None,
                  prefill_blocks=None, decode_blocks=None,
                  handoff_dir=None, draft=None, spec_k=4,
-                 draft_cache_dtype="int8", spec_policy="on"):
+                 draft_cache_dtype="int8", spec_policy="on",
+                 prefix_cache=True):
         if window is not None:
             raise NotImplementedError(
                 "disaggregated serving + sliding window: handoff after "
@@ -102,14 +105,14 @@ class DisaggregatedEngine:
             model, num_blocks=prefill_blocks or num_blocks,
             block_size=block_size, max_batch=max_batch,
             prefill_chunk=prefill_chunk, cache_dtype=cache_dtype,
-            phase="prefill")
+            phase="prefill", prefix_cache=prefix_cache)
         self.decode = ServeEngine(
             model, num_blocks=decode_blocks or num_blocks,
             block_size=block_size, max_batch=max_batch,
             prefill_chunk=prefill_chunk, cache_dtype=cache_dtype,
             phase="decode", draft=draft, spec_k=spec_k,
             draft_cache_dtype=draft_cache_dtype,
-            spec_policy=spec_policy)
+            spec_policy=spec_policy, prefix_cache=prefix_cache)
         self.spec = self.decode.spec
         if handoff_dir is None:
             handoff_dir = tempfile.mkdtemp(prefix="apex_kv_handoff_")
@@ -165,7 +168,9 @@ class DisaggregatedEngine:
         _obs.event("serve.request", rid=s.rid, phase="handoff",
                    tick=self._tick, blocks=len(s.table), peak_bytes=peak)
         return PendingHandoff(s.request, s.out, s.pending_tok,
-                              s.position, d, s.t_queued, s.t_first)
+                              s.position, d, s.t_queued, s.t_first,
+                              hash_chain=s.hash_chain,
+                              weight_epoch=s.weight_epoch)
 
     def step(self) -> bool:
         """One coordinator tick: prefill tick → stream completed
@@ -182,7 +187,8 @@ class DisaggregatedEngine:
             sess = self.decode.ingest_handoff(
                 h.request, out=h.out, pending_tok=h.pending_tok,
                 position=h.position, handoff_dir=h.dir,
-                t_queued=h.t_queued, t_first=h.t_first)
+                t_queued=h.t_queued, t_first=h.t_first,
+                hash_chain=h.hash_chain, weight_epoch=h.weight_epoch)
             if sess is None:
                 still.append(h)      # decode engine full: retry next tick
             else:

@@ -560,7 +560,9 @@ class ServeFleet:
                 rec.request, out=list(meta["out"]),
                 pending_tok=int(meta["pending_tok"]),
                 position=int(meta["position"]), handoff_dir=d,
-                n_blocks=int(manifest["n_blocks"]))
+                n_blocks=int(manifest["n_blocks"]),
+                hash_chain=meta.get("hash_chain"),
+                weight_epoch=meta.get("weight_epoch", -1))
             if sess is not None:
                 return sess
             if not self._shed_batch_for_room(target):
@@ -678,7 +680,9 @@ class ServeFleet:
                             "pending_tok": int(s.pending_tok),
                             "position": int(s.position),
                             "slo": rec.slo, "tick": self._tick,
-                            "epoch": self.view.epoch})
+                            "epoch": self.view.epoch,
+                            "hash_chain": list(s.hash_chain),
+                            "weight_epoch": int(s.weight_epoch)})
         except _chaos.ChaosInjectedFailure:
             # recoverable snapshot fault: skip this round cleanly, the
             # previous committed snapshot stays newest
@@ -736,6 +740,7 @@ class ServeFleet:
                 "sessions": len(m.engine.scheduler.sessions),
                 "queue_depth": len(m.engine.scheduler.queue),
                 "free_blocks": m.engine.block_pool.free_count,
+                "cached_blocks": m.engine.block_pool.cached_count,
                 "pool_occupancy": m.engine.block_pool.occupancy,
             }
         return {

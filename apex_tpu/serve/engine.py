@@ -82,7 +82,7 @@ class ServeEngine:
                  prefill_chunk=32, cache_dtype=None,
                  max_prefill_backlog=None, window=None, phase="unified",
                  draft=None, spec_k=4, draft_cache_dtype="int8",
-                 spec_policy="on"):
+                 spec_policy="on", prefix_cache=True):
         self._validate_model(model)
         if phase not in PHASES:
             raise ValueError(f"phase must be one of {PHASES}, got "
@@ -134,15 +134,23 @@ class ServeEngine:
             max_prefill_backlog=max_prefill_backlog,
             max_positions=model.max_positions,
             spec_tables=self.spec,
-            pos_slack=self.spec_k if self.spec else 0)
+            pos_slack=self.spec_k if self.spec else 0,
+            prefix_cache=prefix_cache,
+            cache_tag=self._cache_tag(epoch=0))
         self._token = next(_SERVE_TOKENS)
         self._donate = _executor.donation.enabled
         self._decode_prog = None
         self._prefill_prog = None
+        self._copy_prog = None
         self._draft_prefill_prog = None
         self._spec_prog = None
         self._dispatch_no = itertools.count(1)
         self._tick = 0
+        # prefix-cache telemetry (admission-weighted; the pool keeps
+        # its own eviction counter)
+        self._prefill_tokens_saved = 0
+        self._prefix_prompt_tokens = 0
+        self._cow_forks = 0
         self._spec_ticks = 0
         self._spec_committed = 0
         self._spec_offered = 0
@@ -251,11 +259,59 @@ class ServeEngine:
                 donate_argnums=(2, 3) if self._donate else ())
         return self._draft_prefill_prog, self._spec_prog
 
+    def _copy_program(self):
+        if self._copy_prog is None:
+            key = (self._token, self._phase, self.block_size,
+                   self._dtype_name, self._donate)
+            self._copy_prog = _executor.Program(
+                "block_copy", key, _kernels.build_block_copy_fn(),
+                donate_argnums=(0,) if self._donate else ())
+        return self._copy_prog
+
     def _vals(self):
         return [p.data for p in self._params]
 
     def _d_vals(self):
         return [p.data for p in self._d_params]
+
+    # -- prefix cache ------------------------------------------------------
+
+    def _cache_tag(self, epoch=None) -> str:
+        """The chain-key compatibility stamp: everything a committed
+        block's bytes depend on besides its token chain.  dtype and
+        block size fix the stored layout, the window changes every KV
+        row's upstream hidden states, and the target weight epoch makes
+        ``publish_weights`` an automatic whole-cache invalidation — a
+        new epoch means new tags, so stale entries can never match."""
+        if epoch is None:
+            epoch = self.weight_epochs["target"]
+        return (f"{self._dtype_name}:b{self.block_size}:"
+                f"w{self.window}:e{int(epoch)}")
+
+    def _dispatch_cow(self, s: Session) -> None:
+        """Materialize admission's copy-on-write forks: one paged
+        block-copy dispatch per fork, then release the shared source's
+        reference (scheduler.complete_cow) — the source was kept
+        referenced so the dispatch stream copies its bytes before any
+        eviction could recycle them."""
+        if not s.cow_pending:
+            return
+        prog = self._copy_program()
+        for _idx, fsrc, fdst in s.cow_pending:
+            self.pool = _executor.executor.submit(
+                prog, (self.pool, np.int32(fsrc), np.int32(fdst)),
+                step=next(self._dispatch_no))
+        n = self.scheduler.complete_cow(s)
+        self._cow_forks += n
+        _obs.counter("serve.prefix.cow_forks").inc(n)
+
+    def _note_commit(self, s: Session) -> None:
+        """Chain-commit the session's newly full blocks — unless its
+        KV was written under an older target epoch (a mid-swap session
+        decodes under mixed weights; hashing its blocks would poison
+        the index with bytes no current-epoch chain can reproduce)."""
+        if s.weight_epoch == self.weight_epochs["target"]:
+            self.scheduler.note_commit(s)
 
     # -- intake ------------------------------------------------------------
 
@@ -349,6 +405,13 @@ class ServeEngine:
             p.data = v
         ep = self.weight_epochs[which] + 1 if epoch is None else int(epoch)
         self.weight_epochs[which] = ep
+        if which == "target":
+            # invalidate the prefix cache: the new epoch lands in the
+            # chain tag (so future admissions can't match pre-swap
+            # chains) and cached-tier blocks holding stale KV go back
+            # to the free list rather than waiting out the LRU
+            self.scheduler.cache_tag = self._cache_tag()
+            self.block_pool.flush_cache()
         _obs.event("serve.weight_swap", which=which, epoch=ep,
                    tick=self._tick, leaves=len(leaves))
         return ep
@@ -370,8 +433,19 @@ class ServeEngine:
         t0 = time.monotonic()
         for s in self.scheduler.admit():
             s.weight_epoch = self.weight_epochs["target"]
+            self._dispatch_cow(s)
+            self._prefill_tokens_saved += s.prefix_hit_tokens
+            self._prefix_prompt_tokens += len(s.prefill_src)
+            if s.prefix_hit_tokens:
+                _obs.counter("serve.prefix.tokens_saved").inc(
+                    s.prefix_hit_tokens)
+            if self._prefix_prompt_tokens:
+                _obs.gauge("serve.prefix.hit_rate").set(
+                    self._prefill_tokens_saved
+                    / self._prefix_prompt_tokens)
             _obs.event("serve.request", rid=s.rid, phase="prefill",
                        tick=self._tick, blocks=len(s.table),
+                       prefix_hit=s.prefix_hit_tokens,
                        weight_epoch=s.weight_epoch)
         ps = self.scheduler.next_prefill()
         if ps is not None:
@@ -450,11 +524,13 @@ class ServeEngine:
              np.asarray([toks], np.int32), np.asarray([table], np.int32),
              np.int32(t0), np.int32(n)),
             step=next(self._dispatch_no))
-        if self.spec:
+        if self.spec and s.draft_position == t0:
             # lockstep draft ingest: the draft's cache tracks the
             # target's row for row through prefill (and recompute
             # re-prefill), so a fresh session is spec-ready the tick
-            # its prefill completes
+            # its prefill completes.  A prefix-hit session starts its
+            # target cursor PAST rows the draft never saw — it skips
+            # lockstep and repairs through the catch-up path instead.
             draft_prog, _ = self._spec_programs()
             nbd = bucket(len(s.draft_table))
             d_table = s.draft_table + [0] * (nbd - len(s.draft_table))
@@ -469,6 +545,7 @@ class ServeEngine:
         s.position = t0 + n
         if self.window is not None:
             self.scheduler.retire_window_blocks(s, self.window)
+        self._note_commit(s)
         if s.prefill_remaining > 0:
             return
         s.state = DECODE
@@ -581,6 +658,7 @@ class ServeEngine:
             s.pending_tok = tok
             if self.window is not None:
                 self.scheduler.retire_window_blocks(s, self.window)
+            self._note_commit(s)
             if s.finished():
                 self._finish(s)
 
@@ -622,6 +700,11 @@ class ServeEngine:
             # committed tokens (the rejected tail past them is rewritten
             # by the next tick's chunk before any mask can read it)
             s.draft_position = s.position
+            # chain-commit only blocks the committed position has fully
+            # crossed — every row of such a block holds committed-token
+            # KV (any rejected-tail rows were overwritten by later
+            # ticks before position could pass them)
+            self._note_commit(s)
             committed_total += m
             self._spec_offered += self.spec_k
             self._spec_accepted += max(0, m - 1)
@@ -652,7 +735,8 @@ class ServeEngine:
 
     def ingest_handoff(self, request: Request, *, out, pending_tok,
                        position, handoff_dir, t_queued=0.0,
-                       t_first=None, n_blocks=None) -> Optional[Session]:
+                       t_first=None, n_blocks=None, hash_chain=None,
+                       weight_epoch=None) -> Optional[Session]:
         """Decode-phase engines: adopt a prefilled session whose KV
         blocks were streamed into ``handoff_dir`` (schema-3 shard
         files, runtime/resilience.py).  Allocates a fresh target table
@@ -715,6 +799,21 @@ class ServeEngine:
         s.t_queued = t_queued
         s.t_first = t_first
         s.weight_epoch = self.weight_epochs["target"]
+        if hash_chain and self.scheduler.prefix_cache \
+                and weight_epoch == self.weight_epochs["target"]:
+            # re-link the migrated chain into THIS pool's index: the
+            # streamed blocks are bitwise copies of committed-prefix
+            # blocks, so they are valid cache entries here too
+            s.hash_chain = list(hash_chain)
+            s.committed_blocks = len(s.hash_chain)
+            for bid, key in zip(ids, s.hash_chain):
+                self.block_pool.commit(bid, key)
+        elif hash_chain:
+            # the chain was built under a different weight epoch than
+            # this engine serves — the KV itself stays valid for THIS
+            # session (mixed-epoch semantics, docs/rollout.md) but must
+            # never be published for cross-request reuse
+            s.cacheable = False
         self.scheduler.sessions.append(s)
         _obs.event("serve.request", rid=s.rid, phase="ingested",
                    tick=self._tick, blocks=have,
@@ -774,6 +873,15 @@ class ServeEngine:
             "prefill": _sc.kind_stats("prefill_step"),
             "pool_occupancy": self.block_pool.occupancy,
             "queue_depth": len(self.scheduler.queue),
+            "prefix_cache": {
+                "hit_rate": (self._prefill_tokens_saved
+                             / self._prefix_prompt_tokens
+                             if self._prefix_prompt_tokens else 0.0),
+                "prefill_tokens_saved": self._prefill_tokens_saved,
+                "cached_blocks": self.block_pool.cached_count,
+                "cow_forks": self._cow_forks,
+                "cache_evictions": self.block_pool.cache_evictions,
+            },
             "histograms": {k: v for k, v in snap["histograms"].items()
                            if k.startswith("serve.")},
         }

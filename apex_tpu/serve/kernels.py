@@ -111,6 +111,27 @@ def insert_row(pool, k_lin, v_lin, k_new, v_new, own):
             jnp.where(own, v_new.astype(_f32), v_lin))
 
 
+def build_block_copy_fn():
+    """The copy-on-write fork program body: duplicate ONE physical
+    block's bytes — every layer, both k and v, payload AND scales for a
+    :class:`QuantKV` pool — from ``src`` to ``dst``.
+
+    ``fn(pool, src, dst) -> pool`` with ``src``/``dst`` traced i32
+    scalars, so one compiled program serves every fork (block ids are
+    data, not shapes — the SERVE-SHAPE discipline).  The scheduler
+    decides WHEN to fork (a session extending into a shared block); the
+    destination is a fresh exclusive block, the source keeps serving
+    its other holders untouched — the copy is what makes shared blocks
+    immutable in practice."""
+    def fn(pool, src, dst):
+        if isinstance(pool, QuantKV):
+            return QuantKV(
+                pool.q.at[:, :, dst].set(pool.q[:, :, src]),
+                pool.scale.at[:, :, dst].set(pool.scale[:, :, src]))
+        return pool.at[:, :, dst].set(pool[:, :, src])
+    return fn
+
+
 # ---------------------------------------------------------------------------
 # Program bodies
 # ---------------------------------------------------------------------------

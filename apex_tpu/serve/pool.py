@@ -26,14 +26,41 @@ scale per cached position — the same per-position absmax convention as
 the contiguous int8 cache, via :func:`~apex_tpu.inference.quant.
 absmax_int8`).
 
-The host side (:class:`BlockPool`) is deliberately dumb: a LIFO
-free-list with leak accounting.  Policy (who gets blocks, who is
-preempted) lives in the scheduler; device-side index arithmetic lives
-in serve/kernels.py.
+**Reference counting + content addressing** (the prefix cache): the
+pool is no longer a plain free-list.  Every held block carries a
+refcount — the cross-request prefix cache (RadixAttention / vLLM's
+automatic prefix caching lineage) lets N sessions whose token chains
+share a committed prefix hold the SAME physical blocks.  Full blocks
+are *committed* under a rolling content hash of their token chain
+(:func:`chain_key` — keyed by the parent block's hash, the block's
+tokens, and a tag carrying cache dtype / block size / attention window
+/ model weight epoch, so an int8 pool never matches an fp32 chain and
+a ``publish_weights`` hot-swap never serves stale KV).  The
+``hash → physical block`` index (:meth:`BlockPool.acquire_prefix`)
+turns admission into a chain walk: matched blocks are adopted by
+refcount, and only the cold suffix is granted from the free list.
+
+Shared blocks are IMMUTABLE — a session that must write into one forks
+it copy-on-write (scheduler policy + the paged block-copy program in
+serve/kernels.py; the pool only does the id bookkeeping).  A freed
+block whose hash entry is still live retires into an LRU **cached
+tier** instead of the free list: refcount zero, bytes intact, re-usable
+by the next matching chain, evicted (hash entry dropped, id returned
+to the free list) only under allocation pressure.  Cached blocks are
+headroom, not leaks: ``check_no_leaks`` and ``free_count`` both count
+them as reclaimable.
+
+The host side (:class:`BlockPool`) remains deliberately dumb: integer
+bookkeeping with leak accounting.  Policy (who gets blocks, who is
+preempted, when to fork) lives in the scheduler; device-side index
+arithmetic lives in serve/kernels.py.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
+from collections import OrderedDict
+from typing import Dict, List, Sequence
 
 import jax.numpy as jnp
 
@@ -62,16 +89,60 @@ def init_pool_buffer(layers, heads, head_dim, num_blocks, block_size,
     return jnp.zeros(shape, dtype)
 
 
-class BlockPool:
-    """Host-side free-list over physical block ids ``1 .. num_blocks-1``
-    (id 0 is :data:`NULL_BLOCK`, never handed out).
+# ---------------------------------------------------------------------------
+# Content hashing: the rolling token-chain key
+# ---------------------------------------------------------------------------
 
-    ``alloc(n)`` returns ``n`` ids or None (all-or-nothing — a partial
-    grant would deadlock two half-admitted sessions against each
-    other); ``free(ids)`` returns them.  Every transition keeps the
-    ``serve.pool_occupancy`` gauge current and double-free / foreign-id
-    frees raise — leaked blocks are the serving analogue of a memory
-    leak and the churn tests pin ``in_use == 0`` after drain.
+
+def chain_key(parent: str, tokens: Sequence[int], tag: str) -> str:
+    """The content hash of ONE full block: rolling over ``parent`` (the
+    previous block's key, ``""`` for the chain head), the block's token
+    ids, and ``tag`` — the engine's cache-compatibility stamp (dtype,
+    block size, window, weight epoch).  Two blocks share a key iff they
+    hold the KV of the same token prefix computed under the same cache
+    geometry and weights — which is exactly when their bytes are
+    interchangeable."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent.encode("ascii"))
+    h.update(b"\x00")
+    h.update(tag.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(",".join(str(int(t)) for t in tokens).encode("ascii"))
+    return h.hexdigest()
+
+
+def chain_keys(tokens: Sequence[int], block_size: int,
+               tag: str) -> List[str]:
+    """The hash chain over every FULL block of ``tokens`` (partial tail
+    blocks are never content-addressed — their rows are still being
+    written)."""
+    keys: List[str] = []
+    prev = ""
+    for i in range(len(tokens) // block_size):
+        prev = chain_key(prev, tokens[i * block_size:(i + 1) * block_size],
+                         tag)
+        keys.append(prev)
+    return keys
+
+
+class BlockPool:
+    """Host-side refcounted allocator over physical block ids
+    ``1 .. num_blocks-1`` (id 0 is :data:`NULL_BLOCK`, never handed
+    out).
+
+    ``alloc(n)`` returns ``n`` exclusive ids (refcount 1) or None
+    (all-or-nothing — a partial grant would deadlock two half-admitted
+    sessions against each other), evicting LRU cached-tier blocks under
+    pressure; ``free(ids)`` drops one reference per id — a block
+    reaching refcount zero retires to the cached tier when its hash
+    entry is live, else returns to the free list.  Freeing more times
+    than references held raises (the shared-block double-free).
+    ``acquire_prefix(keys)`` walks a request's hash chain and adopts
+    the longest matched prefix by refcount; ``commit(id, key)``
+    registers a full block under its chain hash.  Every transition
+    keeps the ``pool.free`` / ``pool.cached`` / ``pool.active`` gauges
+    current, and the churn tests pin ``in_use == 0`` +
+    ``free + cached == capacity`` after drain.
     """
 
     def __init__(self, num_blocks: int, block_size: int,
@@ -89,7 +160,13 @@ class BlockPool:
         # LIFO: recently freed blocks are re-issued first (their pool
         # rows are hottest in cache on CPU runs; on TPU it is a wash)
         self._free = list(range(num_blocks - 1, 0, -1))
-        self._held = set()
+        self._refs: Dict[int, int] = {}          # id -> refcount (held)
+        # refcount-zero blocks with live hash entries, LRU order
+        # (oldest retired first); values are their chain keys
+        self._cached: "OrderedDict[int, str]" = OrderedDict()
+        self._hash_index: Dict[str, int] = {}    # chain key -> id
+        self._block_hash: Dict[int, str] = {}    # id -> chain key
+        self.cache_evictions = 0
         self._gauge()
 
     # -- accounting --------------------------------------------------------
@@ -101,58 +178,184 @@ class BlockPool:
 
     @property
     def free_count(self) -> int:
+        """Allocatable headroom NOW: free-list blocks plus cached-tier
+        blocks (evictable on demand) — what admission and the elastic
+        fleet's backpressure should budget against."""
+        with self._lock:
+            return len(self._free) + len(self._cached)
+
+    @property
+    def free_exact(self) -> int:
+        """Free-list blocks only (no cached-tier eviction needed)."""
         with self._lock:
             return len(self._free)
 
     @property
-    def in_use(self) -> int:
+    def cached_count(self) -> int:
+        """Cached-tier blocks: refcount zero, hash entry live."""
         with self._lock:
-            return len(self._held)
+            return len(self._cached)
+
+    @property
+    def in_use(self) -> int:
+        """Blocks held by at least one live table (refcount >= 1)."""
+        with self._lock:
+            return len(self._refs)
 
     @property
     def occupancy(self) -> float:
-        """Fraction of allocatable blocks currently held."""
+        """Fraction of allocatable blocks currently held (cached-tier
+        blocks are reclaimable headroom, not occupancy)."""
         with self._lock:
-            return len(self._held) / (self.num_blocks - 1)
+            return len(self._refs) / (self.num_blocks - 1)
+
+    def refcount(self, block_id: int) -> int:
+        """Live references to ``block_id`` (0 = free or cached)."""
+        with self._lock:
+            return self._refs.get(block_id, 0)
 
     def _gauge(self):
+        cap = self.num_blocks - 1
         _obs.gauge(self._prefix + "pool_occupancy").set(
-            len(self._held) / (self.num_blocks - 1))
-        _obs.gauge(self._prefix + "pool_free_blocks").set(len(self._free))
+            len(self._refs) / cap)
+        _obs.gauge(self._prefix + "pool_free_blocks").set(
+            len(self._free) + len(self._cached))
+        # the split gauges: free conflated with soon-to-be-cached was
+        # hiding true headroom from the elastic fleet's shed decisions
+        _obs.gauge(self._prefix + "pool.free").set(len(self._free))
+        _obs.gauge(self._prefix + "pool.cached").set(len(self._cached))
+        _obs.gauge(self._prefix + "pool.active").set(len(self._refs))
 
     # -- alloc / free ------------------------------------------------------
 
+    def _evict_locked(self) -> None:
+        """Drop the LRU cached-tier block's hash entry and return its
+        id to the free list (caller holds the lock)."""
+        bid, key = self._cached.popitem(last=False)
+        del self._hash_index[key]
+        del self._block_hash[bid]
+        self._free.append(bid)
+        self.cache_evictions += 1
+        _obs.counter(self._prefix + "cache.evictions").inc()
+
     def alloc(self, n: int):
-        """``n`` physical block ids, or None if the pool cannot cover
-        the whole request (nothing is taken on refusal)."""
+        """``n`` exclusive physical block ids (refcount 1), or None if
+        the pool cannot cover the whole request (nothing is taken on
+        refusal).  Cached-tier blocks are evicted LRU-first when the
+        free list alone cannot cover ``n`` — allocation pressure is the
+        cached tier's only eviction trigger."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         with self._lock:
-            if n > len(self._free):
+            if n > len(self._free) + len(self._cached):
                 return None
+            while len(self._free) < n:
+                self._evict_locked()
             ids = [self._free.pop() for _ in range(n)]
-            self._held.update(ids)
+            for b in ids:
+                self._refs[b] = 1
             self._gauge()
         return ids
 
     def free(self, ids) -> None:
+        """Drop ONE reference per id.  A block reaching refcount zero
+        retires to the cached tier when its hash entry is live (bytes
+        stay adoptable), else returns to the free list.  Freeing an id
+        with no live reference raises — that is a double free (of an
+        exclusive OR a shared block: sharing never grants extra
+        frees)."""
         with self._lock:
             for b in ids:
-                if b not in self._held:
+                r = self._refs.get(b)
+                if r is None:
                     raise ValueError(
                         f"free of block {b} not held by this pool "
-                        f"(double free or foreign id) — block tables "
-                        f"and the free list have diverged")
-                self._held.discard(b)
-                self._free.append(b)
+                        f"(double free, foreign id, or more frees than "
+                        f"references) — block tables and the refcounts "
+                        f"have diverged")
+                if r > 1:
+                    self._refs[b] = r - 1
+                    continue
+                del self._refs[b]
+                key = self._block_hash.get(b)
+                if key is not None:
+                    self._cached[b] = key        # MRU end of the LRU
+                else:
+                    self._free.append(b)
             self._gauge()
 
-    def check_no_leaks(self) -> None:
-        """Raise unless every allocatable block is back on the free
-        list — the post-drain invariant of the churn tests."""
+    # -- content addressing ------------------------------------------------
+
+    def acquire_prefix(self, keys: Sequence[str]) -> List[int]:
+        """Walk a request's hash chain and adopt the longest matched
+        prefix: each matched block gains a reference (cached-tier
+        blocks are resurrected to refcount 1; held blocks just
+        increment).  Returns the matched physical ids, chain order —
+        the caller budgets only the cold suffix.  Adopted blocks are
+        shared and immutable; release them with :meth:`free`."""
+        out: List[int] = []
         with self._lock:
-            if self._held or len(self._free) != self.num_blocks - 1:
+            for key in keys:
+                bid = self._hash_index.get(key)
+                if bid is None:
+                    break
+                if bid in self._refs:
+                    self._refs[bid] += 1
+                else:
+                    del self._cached[bid]
+                    self._refs[bid] = 1
+                out.append(bid)
+            if out:
+                self._gauge()
+        return out
+
+    def commit(self, block_id: int, key: str) -> bool:
+        """Register a held, FULL block under its chain hash — from now
+        on :meth:`acquire_prefix` can adopt it and :meth:`free` retires
+        it to the cached tier instead of the free list.  First writer
+        wins: a key already mapped (another session committed the same
+        chain first) or a block already hashed is left untouched
+        (returns False)."""
+        with self._lock:
+            if block_id not in self._refs:
+                return False                 # freed/evicted underneath
+            if key in self._hash_index or block_id in self._block_hash:
+                return False
+            self._hash_index[key] = block_id
+            self._block_hash[block_id] = key
+            return True
+
+    def flush_cache(self) -> int:
+        """Drop EVERY hash entry and return all cached-tier blocks to
+        the free list — the ``publish_weights`` invalidation path: a
+        weight hot-swap changes the chain tag, so no stale entry can
+        ever match again; flushing reclaims the memory immediately.
+        Held blocks stay held (their sessions continue under mixed
+        weights, documented in docs/rollout.md) but lose their hash
+        entries, so they free to the free list later.  Returns the
+        number of cached blocks reclaimed."""
+        with self._lock:
+            n = len(self._cached)
+            for bid in self._cached:
+                self._free.append(bid)
+            self._cached.clear()
+            self._hash_index.clear()
+            self._block_hash.clear()
+            self._gauge()
+            return n
+
+    def check_no_leaks(self) -> None:
+        """Raise unless every allocatable block is reclaimable — on the
+        free list or in the cached tier (refcount zero, adoptable).
+        Cached blocks are NOT leaks: they are the prefix cache
+        surviving session churn, evictable on demand.  The post-drain
+        invariant of the churn tests."""
+        with self._lock:
+            if self._refs or \
+                    len(self._free) + len(self._cached) \
+                    != self.num_blocks - 1:
                 raise AssertionError(
-                    f"block pool leak: {len(self._held)} blocks still "
-                    f"held, free list {len(self._free)}/"
+                    f"block pool leak: {len(self._refs)} blocks still "
+                    f"held (refcounts {dict(self._refs)}), free list "
+                    f"{len(self._free)} + cached {len(self._cached)} != "
                     f"{self.num_blocks - 1}")

@@ -40,7 +40,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
-from .pool import BlockPool, NULL_BLOCK, blocks_for
+from .pool import BlockPool, NULL_BLOCK, blocks_for, chain_key, chain_keys
 
 QUEUED, PREFILL, DECODE, DONE = "queued", "prefill", "decode", "done"
 
@@ -119,6 +119,24 @@ class Session:
     t_queued: float = 0.0
     t_first: Optional[float] = None
     t_done: Optional[float] = None
+    # -- prefix cache state (tentpole: content-addressed block reuse) --
+    # rolling chain keys of the session's committed full blocks, one
+    # per table entry < committed_blocks (adopted keys included)
+    hash_chain: List[str] = field(default_factory=list)
+    committed_blocks: int = 0
+    # False when the session's KV provenance is mixed (e.g. adopted
+    # under a different weight epoch) — its blocks must never enter
+    # the hash index
+    cacheable: bool = True
+    # copy-on-write forks decided at admission: (table index, shared
+    # source id, exclusive destination id).  The engine dispatches the
+    # paged block-copy for each, then complete_cow() releases the
+    # source reference — the source stays referenced until the copy is
+    # in the dispatch stream, so eviction cannot recycle it first.
+    cow_pending: List[Tuple[int, int, int]] = field(default_factory=list)
+    # tokens of this request's prompt that admission found cached (the
+    # rows prefill will NOT recompute) — telemetry for hit-rate
+    prefix_hit_tokens: int = 0
 
     @property
     def rid(self) -> str:
@@ -153,7 +171,8 @@ class Scheduler:
     def __init__(self, pool: BlockPool, *, max_batch: int,
                  prefill_chunk: int, max_prefill_backlog: int,
                  max_positions: int, spec_tables: bool = False,
-                 pos_slack: int = 0):
+                 pos_slack: int = 0, prefix_cache: bool = True,
+                 cache_tag: str = "kv"):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if prefill_chunk < 1:
@@ -164,6 +183,13 @@ class Scheduler:
         self.prefill_chunk = prefill_chunk
         self.max_prefill_backlog = max_prefill_backlog
         self.max_positions = max_positions
+        # prefix cache: admission walks each request's token chain
+        # through the pool's hash index and prefills only the cold
+        # suffix.  cache_tag stamps the chain keys with everything KV
+        # bytes depend on besides tokens (dtype/block size/window/
+        # weight epoch) — the engine owns it and re-tags on publish.
+        self.prefix_cache = bool(prefix_cache)
+        self.cache_tag = cache_tag
         # speculative mode: every session also owns a draft block table
         # (admission doubles its block ask, finish/preempt free both),
         # and each tick may write up to `pos_slack` rows PAST the last
@@ -225,8 +251,23 @@ class Scheduler:
 
     def admit(self) -> List[Session]:
         """Move queue-head sessions into the live set while every budget
-        (batch slots, whole-prompt blocks + headroom, prefill backlog)
-        holds.  All-or-nothing per session; FIFO order preserved."""
+        (batch slots, cold-suffix blocks + headroom, prefill backlog)
+        holds.  All-or-nothing per session; FIFO order preserved.
+
+        Prefix cache: each request's token chain is walked through the
+        pool's hash index first (:meth:`BlockPool.acquire_prefix`) —
+        matched full blocks are adopted shared (refcounted, immutable)
+        and the session's ``position`` starts past them, so the engine
+        prefills only the uncached suffix and the backlog budget counts
+        only suffix tokens.  A FULL-chain hit still re-ingests the last
+        prompt token (first-token logits must come from somewhere), and
+        that write lands inside the last shared block — so admission
+        forks it copy-on-write: a fresh block joins the table, the
+        shared original stays referenced in ``cow_pending`` until the
+        engine dispatches the paged block-copy.  Recompute re-admission
+        (preempted or shed sessions) takes the same path and typically
+        re-acquires its own just-retired blocks from the cached tier —
+        preemption recovery without re-prefill."""
         admitted = []
         while self.queue:
             s = self.queue[0]
@@ -236,34 +277,109 @@ class Scheduler:
             # their recompute source from preempt_for
             src = s.prefill_src if s.pending_tok is not None \
                 else s.request.prompt
-            need = blocks_for(len(src) + 1, self.pool.block_size)
-            if self._backlog_tokens() + len(src) \
+            bs = self.pool.block_size
+            need_total = blocks_for(len(src) + 1, bs)
+            shared: List[int] = []
+            keys: List[str] = []
+            if self.prefix_cache:
+                keys = chain_keys(src, bs, self.cache_tag)
+                shared = self.pool.acquire_prefix(keys)
+            hit = len(shared) * bs
+            fork = False
+            if hit >= len(src):
+                # full-chain hit (len(src) is block-aligned and every
+                # block matched)
+                if s.pending_tok is not None:
+                    pos0 = len(src)      # recompute source fully cached
+                else:
+                    pos0 = len(src) - 1  # re-ingest one token -> logits
+                    fork = True
+            else:
+                pos0 = hit
+            if self._backlog_tokens() + (len(src) - pos0) \
                     > self.max_prefill_backlog and self.sessions:
+                self.pool.free(shared)
                 break
-            ids = self.pool.alloc(need)
+            cold = need_total - len(shared) + (1 if fork else 0)
+            ids = self.pool.alloc(cold)
             if ids is None:
+                self.pool.free(shared)
                 break
             draft_ids: List[int] = []
             if self.spec_tables:
                 # all-or-nothing across BOTH tables: a session holding
                 # a target table but no draft table would deadlock the
-                # spec tick exactly like a half-admitted prompt
-                draft_ids = self.pool.alloc(need)
+                # spec tick exactly like a half-admitted prompt.  The
+                # draft cache is never content-addressed (draft-model
+                # KV lives under different weights) — always cold.
+                draft_ids = self.pool.alloc(need_total)
                 if draft_ids is None:
                     self.pool.free(ids)
+                    self.pool.free(shared)
                     break
             self.queue.popleft()
             s.seq = self._seq
             self._seq += 1
-            s.table = ids
+            if fork:
+                fsrc, fdst = shared[-1], ids[0]
+                s.table = shared[:-1] + [fdst] + ids[1:]
+                s.cow_pending = [(len(shared) - 1, fsrc, fdst)]
+            else:
+                s.table = shared + ids
+                s.cow_pending = []
             s.draft_table = draft_ids
-            s.position = 0
+            s.position = pos0
             s.draft_position = 0
-            s.state = PREFILL
             s.prefill_src = src
+            s.hash_chain = keys[:len(shared)]
+            s.committed_blocks = len(shared)
+            s.prefix_hit_tokens = pos0
+            s.cacheable = True
+            # a fully cached recompute source needs no prefill at all —
+            # the pending token ingests through the next decode tick
+            s.state = DECODE if pos0 >= len(src) else PREFILL
             self.sessions.append(s)
             admitted.append(s)
         return admitted
+
+    def complete_cow(self, s: Session) -> int:
+        """Release the shared source of every pending copy-on-write
+        fork — the engine calls this AFTER dispatching the block-copy
+        program(s), so the source's bytes cannot be recycled before the
+        copy is in the dispatch stream.  Host-only harnesses (the churn
+        sim) call it right after admit.  Returns the fork count."""
+        n = len(s.cow_pending)
+        for _idx, fsrc, _fdst in s.cow_pending:
+            self.pool.free([fsrc])
+        s.cow_pending = []
+        return n
+
+    def note_commit(self, s: Session) -> int:
+        """Commit every newly FULL block of ``s`` into the pool's hash
+        index: extend the session's rolling chain over its fed tokens
+        and register each block (first writer wins — a chain another
+        session committed already just leaves ours unhashed).  Called
+        by the engine after every position advance; returns the number
+        of blocks newly chained."""
+        if not self.prefix_cache or not s.cacheable:
+            return 0
+        bs = self.pool.block_size
+        toks = s.fed_tokens
+        full = min(s.position // bs, len(s.table), len(toks) // bs)
+        n = 0
+        while s.committed_blocks < full:
+            i = s.committed_blocks
+            prev = s.hash_chain[i - 1] if i else ""
+            key = chain_key(prev, toks[i * bs:(i + 1) * bs],
+                            self.cache_tag)
+            s.hash_chain.append(key)
+            b = s.table[i]
+            if b != NULL_BLOCK and not any(
+                    idx == i for idx, _src, _dst in s.cow_pending):
+                self.pool.commit(b, key)
+            s.committed_blocks = i + 1
+            n += 1
+        return n
 
     # -- per-tick views ----------------------------------------------------
 
@@ -304,7 +420,13 @@ class Scheduler:
         the queue front; the elastic fleet instead re-homes the evicted
         session to another engine (its shed path).  Either way the
         recompute re-prefill of ``prompt + out[:-1]`` continues
-        bitwise."""
+        bitwise.
+
+        Shared blocks just lose this session's reference; committed
+        ones retire to the cached tier, so the re-admission (here or on
+        another engine with the same chain) usually re-adopts them —
+        eviction stops costing the prefix its prefill."""
+        self.complete_cow(victim)
         self.pool.free(b for b in victim.table if b != NULL_BLOCK)
         self.pool.free(b for b in victim.draft_table
                        if b != NULL_BLOCK)
@@ -313,6 +435,9 @@ class Scheduler:
         victim.draft_table = []
         victim.position = 0
         victim.draft_position = 0
+        victim.hash_chain = []
+        victim.committed_blocks = 0
+        victim.prefix_hit_tokens = 0
         victim.state = QUEUED
         if victim.out:
             # recompute mode: re-prefill prompt + generated-so-far
@@ -341,6 +466,7 @@ class Scheduler:
         return victim
 
     def finish(self, s: Session) -> None:
+        self.complete_cow(s)
         self.pool.free(b for b in s.table if b != NULL_BLOCK)
         self.pool.free(b for b in s.draft_table if b != NULL_BLOCK)
         s.table = []

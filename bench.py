@@ -2107,6 +2107,73 @@ def register_record(rec):
     event("bench." + str(rec.get("metric", "record")), **rec)
 
 
+class StageLedger:
+    """Resumable per-stage completion ledger (``--ledger path.json``).
+
+    A bench round is a sequence of independent stages; historically one
+    wedged stage (a hung backend probe, a watchdog ``os._exit``) forced
+    re-running EVERYTHING, burning the TPU budget on stages that already
+    passed.  The ledger records each stage's terminal status in a JSON
+    file written atomically (tmp + fsync + rename, the checkpoint
+    discipline in miniature), so a re-run with the same ledger skips
+    ``done`` stages and re-runs only the wedged/failed ones — a stage
+    that hard-exits mid-run is left marked ``running``, which does NOT
+    count as done.  ``--stages a,b,c`` drives several stages through one
+    ledger in one invocation."""
+
+    def __init__(self, path):
+        self.path = path
+        self.stages = {}
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self.stages = json.load(f).get("stages", {})
+            except (OSError, ValueError) as e:
+                log(f"ledger: unreadable ({e}); starting fresh")
+                self.stages = {}
+
+    def status(self, name):
+        return self.stages.get(name, {}).get("status")
+
+    def is_done(self, name):
+        return self.status(name) == "done"
+
+    def mark(self, name, status, **extra):
+        rec = {"status": status,
+               "elapsed_s": round(time.perf_counter() - T0, 1)}
+        rec.update(extra)
+        self.stages[name] = rec
+        self._write()
+
+    def _write(self):
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"stages": self.stages}, f, indent=2,
+                      sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def run(self, name, fn):
+        """Run ``fn`` under ``name`` unless already done; returns its
+        rc (0 for a skip).  Failures — nonzero rc or an exception — are
+        recorded as ``failed`` and the exception propagates."""
+        if self.is_done(name):
+            log(f"ledger: stage {name} done -- skipping")
+            return 0
+        self.mark(name, "running")
+        try:
+            rc = fn()
+        except BaseException as e:
+            self.mark(name, "failed",
+                      error=f"{type(e).__name__}: {e}")
+            raise
+        self.mark(name, "done" if rc == 0 else "failed", rc=rc)
+        return rc
+
+
 def observe_microbench_records(drain_everys=(1, 16), dim=512,
                                micro_batch=512, warmup=2, timed_steps=10,
                                repeats=3):
@@ -2511,11 +2578,122 @@ def serve_bench_records(n_requests=200, seed=0, num_blocks=96,
     return records
 
 
+def serve_prefix_bench_records(n_requests=24, seed=0, num_blocks=64,
+                               block_size=8, max_batch=4,
+                               prefill_chunk=40, shared_len=80,
+                               arrival_gap=3):
+    """``--serve`` shared-prefix arm: the prefix cache under the
+    traffic shape it exists for — a Poisson open-loop trace where every
+    request opens with the same ``shared_len``-token scaffold (a system
+    prompt, block-aligned so full blocks are shareable) and most add a
+    short unique suffix.  Every 4th request is EXACTLY the shared
+    prompt, which is the full-chain-hit path: admission forks the last
+    shared block copy-on-write before the first generated token can
+    land in it.  Two records, ``cache_off`` then ``cache_on``, same
+    trace, same model, so the deltas are the cache:
+
+    * ``prefix_hit_rate`` — prompt tokens served from cache / prompt
+      tokens submitted (>= 0.9 on this trace: only the first request
+      pays the scaffold cold);
+    * ``prefill_tokens_saved`` / ``cow_forks`` / ``cache_evictions`` —
+      the engine's prefix-cache counters;
+    * ``ttft_p50_ms`` — strictly better cache-on: warm requests prefill
+      a 2-4 token suffix instead of the 80-token scaffold.
+
+    The warm arm's outputs are asserted IDENTICAL to the cold arm's —
+    the bitwise claim riding along in the bench, not just the tests."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import apex_tpu.nn as nn
+    from apex_tpu.models.gpt import GptModel
+    from apex_tpu.observe import registry as obs
+    from apex_tpu.runtime import step_cache as sc
+    from apex_tpu.serve import Request, ServeEngine
+
+    rng = np.random.default_rng(seed)
+    nn.manual_seed(seed)
+    model = GptModel(vocab_size=73, hidden=32, layers=2, heads=4,
+                     max_positions=128, dropout=0.0, attn_dropout=0.0)
+    model.eval()
+
+    shared = [int(t) for t in rng.integers(1, 72, shared_len)]
+    reqs = []
+    for i in range(n_requests):
+        if i % 4 == 0:
+            prompt = list(shared)          # full-chain hit -> CoW fork
+        else:
+            suf = [int(t) for t in rng.integers(1, 72,
+                                                int(rng.integers(2, 5)))]
+            prompt = shared + suf
+        reqs.append(Request(f"p{i}", prompt, int(rng.integers(2, 6))))
+    arrivals = np.cumsum(rng.poisson(arrival_gap, n_requests)).tolist()
+
+    reg = obs.get_registry()
+    records = []
+    outputs = {}
+    for arm in ("cache_off", "cache_on"):
+        stage("serve", f"shared-prefix arm {arm}")
+        reg.clear_events()
+        sc.reset_stats()
+        sc.clear()
+        eng = ServeEngine(model, num_blocks=num_blocks,
+                          block_size=block_size, max_batch=max_batch,
+                          prefill_chunk=prefill_chunk,
+                          prefix_cache=(arm == "cache_on"))
+        i = 0
+        t0 = time.perf_counter()
+        while True:
+            while i < n_requests and arrivals[i] <= eng.tick:
+                eng.submit(reqs[i])
+                i += 1
+            more = eng.step()
+            if not more and i >= n_requests:
+                break
+        wall_s = time.perf_counter() - t0
+        eng.block_pool.check_no_leaks()
+        outputs[arm] = eng.results
+        assert len(eng.results) == n_requests
+
+        ts = {(e["rid"], e["phase"]): e["ts_ms"]
+              for e in reg.events("serve.request")}
+        ttft = [ts[(r.rid, "first_token")] - ts[(r.rid, "queued")]
+                for r in reqs]
+        pc = eng.metrics()["prefix_cache"]
+        total_tokens = sum(len(v) for v in eng.results.values())
+        records.append({
+            "metric": "serve_prefix_cache",
+            "arm": arm,
+            "config": f"gpt_tiny_shared{shared_len}_n{n_requests}",
+            "platform": "cpu",
+            "requests": n_requests,
+            "ticks": eng.tick,
+            "tokens_per_s_per_chip": round(total_tokens / wall_s, 1),
+            "ttft_p50_ms": round(float(np.percentile(ttft, 50)), 3),
+            "prefix_hit_rate": round(float(pc["hit_rate"]), 4),
+            "prefill_tokens_saved": int(pc["prefill_tokens_saved"]),
+            "cow_forks": int(pc["cow_forks"]),
+            "cache_evictions": int(pc["cache_evictions"]),
+            "cached_blocks": int(pc["cached_blocks"]),
+            "decode_compiles": int(
+                sc.kind_stats("decode_step")["compiles"]),
+        })
+    # same trace, same weights: the cache changes WHEN KV is computed,
+    # never what it holds
+    assert outputs["cache_on"] == outputs["cache_off"]
+    return records
+
+
 def run_serve(args):
     stage("serve",
           "continuous-batching paged-KV engine, 200-session Poisson "
           "open loop (unified / disaggregated / speculative), cpu")
     for rec in serve_bench_records():
+        emit(rec)
+        register_record(rec)
+    stage("serve", "shared-prefix trace, prefix cache off vs on, cpu")
+    for rec in serve_prefix_bench_records():
         emit(rec)
         register_record(rec)
     return 0
@@ -3487,55 +3665,68 @@ def main():
                          "off)")
     ap.add_argument("--budget-s", type=float,
                     default=float(os.environ.get("GRAFT_BENCH_BUDGET_S", 540)))
+    ap.add_argument("--ledger", type=str, default=None,
+                    help="resumable stage ledger (JSON): stages already "
+                         "recorded done are skipped, so a wedged stage "
+                         "re-runs alone instead of forcing the round")
+    ap.add_argument("--stages", type=str, default=None,
+                    help="comma-separated stage names to run in "
+                         "sequence (e.g. 'serve,lint,elastic'); each "
+                         "gets its own watchdog window and, with "
+                         "--ledger, its own completion record")
     args = ap.parse_args()
 
-    if args.opt_microbench:
-        start_watchdog(args.budget_s)
-        return run_opt_microbench(args)
+    # the self-contained stages, addressable by name for --stages and
+    # the ledger (one name per flag, dashes as in the flag spelling)
+    stage_runners = {
+        "opt-microbench": run_opt_microbench,
+        "accum-microbench": run_accum_microbench,
+        "lint": run_lint,
+        "ckpt-microbench": run_ckpt_microbench,
+        "elastic": run_elastic,
+        "cluster": run_cluster,
+        "observe-microbench": run_observe_microbench,
+        "overlap-microbench": run_overlap_microbench,
+        "serve": run_serve,
+        "serve-elastic": run_serve_elastic,
+        "rollout": run_rollout,
+        "plan": run_plan_bench,
+    }
+    ledger = StageLedger(args.ledger) if args.ledger else None
 
-    if args.accum_microbench:
+    def run_stage(name):
+        fn = stage_runners[name]
         start_watchdog(args.budget_s)
-        return run_accum_microbench(args)
+        if ledger is not None:
+            return ledger.run(name, lambda: fn(args))
+        return fn(args)
 
-    if args.lint:
-        start_watchdog(args.budget_s)
-        return run_lint(args)
+    if args.stages:
+        names = [s.strip() for s in args.stages.split(",") if s.strip()]
+        unknown = [n for n in names if n not in stage_runners]
+        if unknown:
+            fail(f"unknown_stages: {','.join(unknown)} (known: "
+                 f"{','.join(sorted(stage_runners))})")
+            return 1
+        rc = 0
+        for name in names:
+            rc = run_stage(name) or rc
+        return rc
 
-    if args.ckpt_microbench:
-        start_watchdog(args.budget_s)
-        return run_ckpt_microbench(args)
-
-    if args.elastic:
-        start_watchdog(args.budget_s)
-        return run_elastic(args)
-
-    if args.cluster:
-        start_watchdog(args.budget_s)
-        return run_cluster(args)
-
-    if args.observe_microbench:
-        start_watchdog(args.budget_s)
-        return run_observe_microbench(args)
-
-    if args.overlap_microbench:
-        start_watchdog(args.budget_s)
-        return run_overlap_microbench(args)
-
-    if args.serve:
-        start_watchdog(args.budget_s)
-        return run_serve(args)
-
-    if args.serve_elastic:
-        start_watchdog(args.budget_s)
-        return run_serve_elastic(args)
-
-    if args.rollout:
-        start_watchdog(args.budget_s)
-        return run_rollout(args)
-
-    if args.plan:
-        start_watchdog(args.budget_s)
-        return run_plan_bench(args)
+    for name, flag in (("opt-microbench", args.opt_microbench),
+                       ("accum-microbench", args.accum_microbench),
+                       ("lint", args.lint),
+                       ("ckpt-microbench", args.ckpt_microbench),
+                       ("elastic", args.elastic),
+                       ("cluster", args.cluster),
+                       ("observe-microbench", args.observe_microbench),
+                       ("overlap-microbench", args.overlap_microbench),
+                       ("serve", args.serve),
+                       ("serve-elastic", args.serve_elastic),
+                       ("rollout", args.rollout),
+                       ("plan", args.plan)):
+        if flag:
+            return run_stage(name)
 
     if args.pad_vocab and not args.gpt:
         fail("pad_vocab_unsupported_config: --pad-vocab applies to the "

@@ -1,0 +1,30 @@
+"""POOL-ALIAS negative: the refcount API used as intended, and
+``.at[...]`` scatters on non-pool arrays (plain jnp updates are not the
+rule's business)."""
+
+
+def good_lifecycle(engine, keys):
+    pool = engine.block_pool
+    shared = pool.acquire_prefix(keys)
+    ids = pool.alloc(2)
+    for bid, key in zip(ids, keys[len(shared):]):
+        pool.commit(bid, key)
+    pool.free(ids)
+    pool.free(shared)
+    pool.check_no_leaks()
+
+
+def good_scatter(grads, idx, val):
+    # .at writes on ordinary arrays are fine — the rule audits POOL
+    # buffers, not the update syntax
+    return grads.at[idx].set(val)
+
+
+def good_read(engine, blk):
+    # reading pool contents is not a write hazard
+    return engine.pool[:, :, blk]
+
+
+def good_gauges(engine):
+    return (engine.block_pool.free_count, engine.block_pool.cached_count,
+            engine.block_pool.in_use)

@@ -1,0 +1,24 @@
+"""POOL-ALIAS positive: pool blocks mutated outside the refcount API —
+private bookkeeping reached directly, and an in-place scatter into a
+(possibly shared) pool block."""
+
+
+def rogue_free(engine, session):
+    # bypasses refcounting: a shared block lands on the free list while
+    # other tables still reference it
+    engine.block_pool._free.append(session.table[0])
+    engine.block_pool._refs.pop(session.table[0], None)
+
+
+def rogue_index_drop(pool, key):
+    del pool._hash_index[key]
+
+
+def rogue_scatter(engine, blk, row):
+    # in-place write into the KV pool outside the kernel bodies — if
+    # blk is shared this corrupts every session holding the prefix
+    engine.pool = engine.pool.at[:, :, blk, :, row].set(0.0)
+
+
+def rogue_quant_scatter(kv_pool, blk, payload):
+    return kv_pool.q.at[:, :, blk].add(payload)

@@ -363,6 +363,88 @@ def test_serve_bench_records_schema():
     assert spec["spec_tokens_per_tick"] >= 2.0
 
 
+def test_serve_prefix_bench_records_schema():
+    """--serve shared-prefix arm: the prefix cache under a Poisson
+    trace of requests sharing an 80-token block-aligned scaffold,
+    cache off vs on over the SAME trace.  Schema plus the ISSUE's
+    acceptance floors: warm hit rate >= 0.9 (only the first request
+    pays the scaffold cold), TTFT p50 strictly better cache-on, at
+    least one copy-on-write fork (every 4th request is exactly the
+    shared prompt — the full-chain-hit path), and decode stays
+    recompile-free in both arms."""
+    recs = bench.serve_prefix_bench_records()
+    assert [r["arm"] for r in recs] == ["cache_off", "cache_on"]
+    for r in recs:
+        assert r["metric"] == "serve_prefix_cache"
+        assert r["platform"] == "cpu"
+        assert r["requests"] == 24 and r["ticks"] > 0
+        assert r["ttft_p50_ms"] > 0
+        assert r["prefill_tokens_saved"] >= 0
+        assert r["cow_forks"] >= 0 and r["cache_evictions"] >= 0
+        assert 1 <= r["decode_compiles"] <= 8
+    off, on = recs
+    assert off["prefix_hit_rate"] == 0.0
+    assert off["prefill_tokens_saved"] == 0
+    assert off["cow_forks"] == 0 and off["cached_blocks"] == 0
+    assert on["prefix_hit_rate"] >= 0.9
+    assert on["prefill_tokens_saved"] > 1000   # ~23 x 80 scaffold tokens
+    assert on["cow_forks"] >= 1                # full-chain hits forked
+    assert on["cached_blocks"] > 0             # warm tier survives drain
+    assert on["ttft_p50_ms"] < off["ttft_p50_ms"]
+
+
+def test_stage_ledger_resumable(tmp_path, capsys):
+    """--ledger: done stages are skipped on re-run, failed/wedged ones
+    are not — a stage that raises is recorded ``failed`` (and a
+    hard-exit mid-stage leaves ``running``), neither of which counts as
+    done, so exactly the broken stage re-runs."""
+    import json
+
+    path = str(tmp_path / "ledger.json")
+    led = bench.StageLedger(path)
+    calls = {"a": 0, "b": 0}
+
+    def ok():
+        calls["a"] += 1
+        return 0
+
+    def boom():
+        calls["b"] += 1
+        raise RuntimeError("wedged")
+
+    assert led.run("a", ok) == 0
+    with pytest.raises(RuntimeError):
+        led.run("b", boom)
+    on_disk = json.load(open(path))["stages"]
+    assert on_disk["a"]["status"] == "done"
+    assert on_disk["b"]["status"] == "failed"
+    assert "wedged" in on_disk["b"]["error"]
+
+    # a fresh process over the same ledger: done skips, failed re-runs
+    led2 = bench.StageLedger(path)
+    assert led2.run("a", ok) == 0
+    assert calls["a"] == 1                      # skipped, not re-run
+    with pytest.raises(RuntimeError):
+        led2.run("b", boom)
+    assert calls["b"] == 2                      # failed stage re-ran
+
+    # nonzero rc is failed too; a later green run flips it to done
+    led2.run("c", lambda: 1)
+    assert led2.status("c") == "failed"
+    led2.run("c", lambda: 0)
+    assert led2.is_done("c")
+
+    # mid-stage hard-exit simulation: 'running' never reads as done
+    led2.mark("d", "running")
+    assert bench.StageLedger(path).is_done("d") is False
+
+    # corrupt ledger file: start fresh instead of crashing the round
+    with open(path, "w") as f:
+        f.write("{not json")
+    led3 = bench.StageLedger(path)
+    assert led3.stages == {}
+
+
 def test_rollout_bench_records_schema():
     """--rollout stage: one rollout_loop record for the generate-then-
     train runtime — both sides of the loop made progress (tokens

@@ -40,6 +40,7 @@ RULE_FIXTURES = {
     "STALE-SUPPRESSION": "stale_suppression",
     "CLUSTER-ASSUME": "cluster_assume",
     "WEIGHT-PUBLISH": "weight_publish",
+    "POOL-ALIAS": "pool_alias",
 }
 
 
@@ -59,7 +60,7 @@ def _run(paths, **kw):
 
 def test_registry_covers_required_rules():
     assert set(RULE_FIXTURES) <= set(rules.rule_ids())
-    assert len(rules.rule_ids()) >= 18
+    assert len(rules.rule_ids()) >= 19
 
 
 @pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))

@@ -85,11 +85,13 @@ def _sim_tok(position):
 
 def _sim_prefill_tick(sched):
     """Advance the oldest prefilling session one chunk, mirroring
-    ``ServeEngine._prefill_chunk`` at the scheduler level."""
+    ``ServeEngine._prefill_chunk`` at the scheduler level (including
+    the chain commit after the position advance)."""
     s = sched.next_prefill()
     if s is None:
         return
     s.position += min(sched.prefill_chunk, s.prefill_remaining)
+    sched.note_commit(s)
     if s.prefill_remaining > 0:
         return
     s.state = DECODE
@@ -120,33 +122,57 @@ def _sim_decode_tick(sched):
         tok = _sim_tok(s.position)
         s.out.append(tok)
         s.pending_tok = tok
+        sched.note_commit(s)
         if s.finished():
             sched.finish(s)
     return preempted, packed
 
 
 def _pool_books_balance(sched):
-    """Every held block is in exactly one live table; counts match."""
-    table_ids = [b for s in sched.sessions for b in s.table
-                 if b != NULL_BLOCK]
-    assert len(table_ids) == len(set(table_ids)), "block aliased"
-    assert len(table_ids) == sched.pool.in_use
+    """Refcount bookkeeping: every table occurrence of a block is one
+    live reference (shared prefix blocks appear in SEVERAL tables), the
+    held set matches the pool's, and held + free + cached covers the
+    whole pool."""
+    from collections import Counter
+    occ = Counter(b for s in sched.sessions
+                  for b in s.table + s.draft_table if b != NULL_BLOCK)
+    for b, n in occ.items():
+        assert sched.pool.refcount(b) == n, \
+            f"block {b}: {n} table occurrences, refcount " \
+            f"{sched.pool.refcount(b)}"
+    assert len(occ) == sched.pool.in_use
     assert sched.pool.in_use + sched.pool.free_count == \
         sched.pool.capacity
 
 
 def test_scheduler_churn_500_requests_zero_leaks():
+    """500 requests of random admit/finish/preempt churn WITH the
+    prefix cache live: half the prompts repeat a handful of shared
+    templates, so admissions adopt shared blocks, full-chain hits fork
+    copy-on-write, finishes retire committed blocks to the cached tier,
+    and allocation pressure evicts them — and the books still balance
+    to zero leaks."""
     rng = np.random.default_rng(0)
     pool = BlockPool(num_blocks=48, block_size=4)
     sched = Scheduler(pool, max_batch=8, prefill_chunk=8,
                       max_prefill_backlog=64, max_positions=96)
     n = 500
-    reqs = [Request(f"r{i}",
-                    [int(t) for t in rng.integers(1, 70,
-                                                  int(rng.integers(1, 12)))],
-                    int(rng.integers(1, 9)))
-            for i in range(n)]
+    templates = [[int(t) for t in rng.integers(1, 70, ln)]
+                 for ln in (4, 8, 8, 11)]
+    reqs = []
+    for i in range(n):
+        if rng.random() < 0.5:            # shared-prefix traffic
+            base = templates[int(rng.integers(len(templates)))]
+            ext = [] if rng.random() < 0.3 \
+                else [int(t) for t in rng.integers(1, 70,
+                                                   int(rng.integers(1, 4)))]
+            prompt = base + ext
+        else:
+            prompt = [int(t) for t in
+                      rng.integers(1, 70, int(rng.integers(1, 12)))]
+        reqs.append(Request(f"r{i}", prompt, int(rng.integers(1, 9))))
     done_before = set()
+    shared_adoptions = cow = 0
     i = tick = 0
     while i < n or sched.has_work():
         tick += 1
@@ -155,7 +181,9 @@ def test_scheduler_churn_500_requests_zero_leaks():
             if i < n:
                 sched.submit(reqs[i])
                 i += 1
-        sched.admit()
+        for s in sched.admit():
+            shared_adoptions += s.committed_blocks
+            cow += sched.complete_cow(s)  # host-only: no copy dispatch
         _sim_prefill_tick(sched)
         _sim_decode_tick(sched)
         # extra adversarial churn: evict someone at random
@@ -165,8 +193,13 @@ def test_scheduler_churn_500_requests_zero_leaks():
             _pool_books_balance(sched)
         for s in list(sched.sessions):
             assert s.rid not in done_before
+    # the trace is not degenerate: blocks were shared, forked, evicted
+    assert shared_adoptions > 50
+    assert cow > 0
+    assert pool.cache_evictions > 0
     pool.check_no_leaks()
     assert pool.in_use == 0
+    assert pool.free_exact + pool.cached_count == pool.capacity
 
 
 # ---------------------------------------------------------------------------

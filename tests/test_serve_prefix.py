@@ -1,0 +1,382 @@
+"""Prefix cache (content-addressed KV block reuse): the pool's
+refcount/cached-tier/hash-index invariants, and the acceptance pins —
+warm-cache continuations BITWISE identical to the cold-prefill engine
+for fp32 and int8 pools, with and without speculative decoding, under
+forced preemption-recompute, across a weight-epoch invalidation, and
+through a migration that re-links the hash chain into the survivor's
+index."""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from apex_tpu import nn
+from apex_tpu.inference import make_self_draft
+from apex_tpu.inference.session import PagedSession
+from apex_tpu.models.gpt import GptModel
+from apex_tpu.observe import registry as obs
+from apex_tpu.serve import Request, ServeEngine
+from apex_tpu.serve.disagg import DisaggregatedEngine
+from apex_tpu.serve.pool import BlockPool, chain_key, chain_keys
+
+pytestmark = pytest.mark.serve
+
+#: 16 tokens = 2 full blocks at block_size 8 — block-aligned, so a
+#: repeat submission is a FULL-chain hit and exercises the CoW fork
+SHARED = list(range(1, 17))
+
+
+@pytest.fixture(scope="module")
+def model():
+    nn.manual_seed(6)
+    m = GptModel(vocab_size=73, hidden=32, layers=2, heads=4,
+                 max_positions=96, dropout=0.0, attn_dropout=0.0)
+    m.eval()
+    return m
+
+
+def _trace():
+    """Three requests over one shared prefix: two suffix extensions
+    (partial hits) and one exact block-aligned repeat (full hit →
+    copy-on-write fork).  Staggered arrivals so each admission sees the
+    previous request's committed blocks."""
+    return ([Request("r0", SHARED + [20], 6),
+             Request("r1", SHARED + [21, 22], 6),
+             Request("r2", SHARED, 6)],
+            [0, 6, 12])
+
+
+def _run(model, prefix_cache, **kw):
+    eng = ServeEngine(model, num_blocks=64, block_size=8, max_batch=4,
+                      prefill_chunk=4, prefix_cache=prefix_cache, **kw)
+    reqs, arrivals = _trace()
+    out = eng.run(reqs, arrivals=arrivals)
+    m = eng.metrics()["prefix_cache"]
+    eng.close()
+    return out, m
+
+
+# ---------------------------------------------------------------------------
+# the rolling chain key
+# ---------------------------------------------------------------------------
+
+
+def test_chain_keys_roll_over_full_blocks_only():
+    assert chain_keys([1, 2, 3], 4, "t") == []          # no full block
+    k1 = chain_keys([1, 2, 3, 4], 4, "t")
+    k2 = chain_keys([1, 2, 3, 4, 5, 6, 7, 8, 9], 4, "t")
+    assert len(k1) == 1 and len(k2) == 2                # partial tail out
+    assert k2[0] == k1[0]                               # rolling prefix
+    # the parent key, the tokens, and the tag each change the key
+    assert chain_key("", [1, 2, 3, 4], "t") == k1[0]
+    assert chain_key(k1[0], [5, 6, 7, 8], "t") == k2[1]
+    assert chain_keys([1, 2, 3, 5], 4, "t") != k1
+    assert chain_keys([1, 2, 3, 4], 4, "other") != k1
+
+
+# ---------------------------------------------------------------------------
+# pool: refcounts, cached tier, LRU eviction, leak accounting
+# ---------------------------------------------------------------------------
+
+
+def test_pool_shared_refcounts_and_double_free_raises():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    ids = pool.alloc(2)
+    keys = chain_keys([1, 2, 3, 4, 5, 6, 7, 8], 4, "t")
+    assert pool.commit(ids[0], keys[0])
+    assert pool.commit(ids[1], keys[1])
+    # a second session adopts the whole chain: refcount 2 on both
+    shared = pool.acquire_prefix(keys)
+    assert shared == ids
+    assert pool.refcount(ids[0]) == 2
+    assert pool.in_use == 2               # held blocks, not references
+    pool.free(shared)                     # second session done
+    assert pool.refcount(ids[0]) == 1
+    pool.free(ids)                        # first session done -> cached
+    assert pool.in_use == 0 and pool.cached_count == 2
+    # sharing never grants extra frees: the books are at zero
+    with pytest.raises(ValueError):
+        pool.free([ids[0]])
+    pool.check_no_leaks()                 # cached blocks are not leaks
+
+
+def test_pool_partial_chain_walk_stops_at_first_miss():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    ids = pool.alloc(2)
+    keys = chain_keys(list(range(1, 13)), 4, "t")       # 3 full blocks
+    pool.commit(ids[0], keys[0])
+    pool.commit(ids[1], keys[1])
+    assert pool.acquire_prefix(keys) == ids             # 2 of 3 matched
+    pool.free(ids)
+    # a diverging chain shares only the first block
+    other = chain_keys([1, 2, 3, 4, 9, 9, 9, 9], 4, "t")
+    assert pool.acquire_prefix(other) == [ids[0]]
+    pool.free([ids[0]])
+    pool.free(ids)
+    pool.check_no_leaks()
+
+
+def test_pool_commit_first_writer_wins():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    a, b = pool.alloc(2)
+    assert pool.commit(a, "k")
+    assert not pool.commit(b, "k")        # key taken
+    assert not pool.commit(a, "k2")       # block already hashed
+    assert not pool.commit(99, "k3")      # not held
+    pool.free([a, b])
+    assert pool.cached_count == 1         # only the hashed block retires
+    pool.check_no_leaks()
+
+
+def test_pool_lru_eviction_under_allocation_pressure():
+    pool = BlockPool(num_blocks=6, block_size=4)        # 5 allocatable
+    ids = pool.alloc(5)
+    for i, b in enumerate(ids):
+        pool.commit(b, f"k{i}")
+    pool.free(ids)                        # retire in order: k0 oldest
+    assert pool.cached_count == 5 and pool.free_count == 5
+    got = pool.alloc(2)                   # evicts k0, k1 (LRU first)
+    assert pool.cache_evictions == 2
+    assert pool.acquire_prefix(["k0"]) == []            # entry gone
+    assert pool.acquire_prefix(["k2"]) == [ids[2]]      # survivor lives
+    pool.free([ids[2]])
+    pool.free(got)
+    pool.check_no_leaks()
+    assert pool.alloc(6) is None          # capacity still all-or-nothing
+
+
+def test_pool_flush_cache_reclaims_and_invalidates():
+    pool = BlockPool(num_blocks=6, block_size=4)
+    ids = pool.alloc(2)
+    pool.commit(ids[0], "k0")
+    pool.free(ids)
+    assert pool.cached_count == 1
+    assert pool.flush_cache() == 1
+    assert pool.cached_count == 0 and pool.free_exact == 5
+    assert pool.acquire_prefix(["k0"]) == []
+    pool.check_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: warm-cache continuations bitwise-equal to cold prefill
+# ---------------------------------------------------------------------------
+
+
+def test_warm_cache_bitwise_fp32(model):
+    warm, mw = _run(model, True)
+    cold, mc = _run(model, False)
+    assert warm == cold                   # bitwise greedy parity
+    assert mw["prefill_tokens_saved"] == 31   # 16 (partial) + 15 (full)
+    assert mw["hit_rate"] > 0.5
+    assert mw["cow_forks"] >= 1           # the full-chain hit forked
+    assert mc == {"hit_rate": 0.0, "prefill_tokens_saved": 0,
+                  "cached_blocks": 0, "cow_forks": 0,
+                  "cache_evictions": 0}
+
+
+def test_warm_cache_bitwise_int8(model):
+    warm, mw = _run(model, True, cache_dtype="int8")
+    cold, _ = _run(model, False, cache_dtype="int8")
+    assert warm == cold
+    assert mw["prefill_tokens_saved"] == 31 and mw["cow_forks"] >= 1
+
+
+def test_warm_cache_bitwise_speculative(model):
+    draft = make_self_draft(model)
+    warm, mw = _run(model, True, draft=draft, spec_k=3)
+    cold, _ = _run(model, False, draft=draft, spec_k=3)
+    assert warm == cold
+    assert mw["prefill_tokens_saved"] == 31 and mw["cow_forks"] >= 1
+
+
+def test_preemption_recompute_with_cache_bitwise(model):
+    """A pool too small for the live set forces preemption; recompute
+    re-admission walks the chain and typically re-adopts its own
+    just-retired blocks from the cached tier — either way the
+    continuation is bitwise the no-preemption engine's."""
+    obs.get_registry().reset()
+    reqs = [Request(f"p{i}", [3 + i, 5, 7, 9], 8) for i in range(6)]
+    small = ServeEngine(model, num_blocks=10, block_size=4, max_batch=4,
+                        prefill_chunk=4)
+    out = small.run(reqs)
+    assert obs.counter("serve.preemptions").value > 0
+    small.close()
+    big = ServeEngine(model, num_blocks=64, block_size=4, max_batch=4,
+                      prefill_chunk=4, prefix_cache=False)
+    assert out == big.run(reqs)
+    big.close()
+
+
+def test_cache_eviction_pressure_no_leaks(model):
+    """Distinct prompts churning through a small pool force cached-tier
+    evictions; the drained pool still balances to zero leaks."""
+    eng = ServeEngine(model, num_blocks=12, block_size=4, max_batch=2,
+                      prefill_chunk=8)
+    reqs = [Request(f"e{i}", [10 + i, 20 + i, 30 + i, 40 + i, 5 + i], 4)
+            for i in range(12)]
+    out = eng.run(reqs)
+    assert len(out) == 12
+    assert eng.metrics()["prefix_cache"]["cache_evictions"] > 0
+    eng.close()                           # runs check_no_leaks
+
+
+def test_epoch_invalidation_on_publish_weights(model):
+    """publish_weights(target) re-tags the chain keys and flushes the
+    cached tier: a post-swap duplicate of a pre-swap prompt must NOT
+    hit (the entries describe KV computed under the old weights)."""
+    eng = ServeEngine(model, num_blocks=64, block_size=8, max_batch=2,
+                      prefill_chunk=8)
+    eng.run([Request("a", SHARED + [20], 4)])
+    assert eng.block_pool.cached_count > 0
+    saved0 = eng.metrics()["prefix_cache"]["prefill_tokens_saved"]
+    tag0 = eng.scheduler.cache_tag
+    eng.publish_weights([p.data for p in model.parameters()])
+    assert eng.scheduler.cache_tag != tag0
+    assert eng.block_pool.cached_count == 0             # flushed
+    eng.run([Request("b", SHARED + [20], 4)])
+    m = eng.metrics()["prefix_cache"]
+    assert m["prefill_tokens_saved"] == saved0          # no stale hit
+    # the re-prefilled blocks re-commit under the NEW tag
+    assert eng.block_pool.cached_count > 0
+    eng.close()
+
+
+def test_decode_stays_recompile_free_with_cache(model):
+    from apex_tpu.runtime import step_cache as sc
+    sc.reset_stats()
+    sc.clear()
+    eng = ServeEngine(model, num_blocks=64, block_size=8, max_batch=4,
+                      prefill_chunk=4)
+    reqs, arrivals = _trace()
+    eng.run(reqs, arrivals=arrivals)
+    eng.run([Request(f"x{i}", SHARED + [40 + i], 5) for i in range(4)])
+    stats = sc.kind_stats("decode_step")
+    # same bucket bound as the cache-off engine pins: occupancy
+    # buckets {1,2,4} x table buckets — prefix hits change which rows
+    # are warm, never the program shapes
+    assert stats["compiles"] <= 6
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# PagedSession: a conversation replay is a natural prefix hit
+# ---------------------------------------------------------------------------
+
+
+def test_paged_session_replay_and_extension_hit(model):
+    ref_eng = ServeEngine(model, num_blocks=64, block_size=8,
+                          prefix_cache=False)
+    with PagedSession(ref_eng) as rs:
+        rs.append(SHARED)
+        ref = np.asarray(rs.generate(6)).tolist()
+    eng = ServeEngine(model, num_blocks=64, block_size=8)
+    with PagedSession(eng) as s1:
+        s1.append(SHARED)
+        assert np.asarray(s1.generate(6)).tolist() == ref
+    assert eng.block_pool.cached_count >= 2   # committed blocks retired
+    with PagedSession(eng) as s2:             # exact replay: full hit
+        s2.append(SHARED)
+        assert s2.position == len(SHARED)     # one re-ingested token
+        assert np.asarray(s2.generate(6)).tolist() == ref
+    assert eng._cow_forks >= 1
+    with PagedSession(eng) as s3:             # extension: partial hit
+        s3.append(SHARED + [20, 21])
+        got = np.asarray(s3.generate(4)).tolist()
+    with PagedSession(ref_eng) as rs2:
+        rs2.append(SHARED + [20, 21])
+        assert got == np.asarray(rs2.generate(4)).tolist()
+    eng.block_pool.check_no_leaks()
+    ref_eng.block_pool.check_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# disaggregation + migration: the chain rides the manifest
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_shared_prefix_bitwise_and_hits(model):
+    reqs, arrivals = _trace()
+    dis = DisaggregatedEngine(model, num_blocks=64, block_size=8,
+                              max_batch=4, prefill_chunk=4)
+    out = dis.run(reqs, arrivals=arrivals)
+    cold, _ = _run(model, False)
+    assert out == cold
+    # the PREFILL engine is where admission walks the chain
+    pm = dis.prefill.metrics()["prefix_cache"]
+    assert pm["prefill_tokens_saved"] == 31
+    # handed-off chains re-linked into the decode engine's index and
+    # retired to its cached tier as sessions finished
+    assert dis.decode.block_pool.cached_count > 0
+    dis.prefill.close()
+    dis.decode.close()
+
+
+def test_migration_relinks_chain_into_survivor(model, tmp_path):
+    """Stream a mid-decode session off engine A (manifest carries its
+    hash chain + weight epoch) and adopt it on engine B: the chain
+    re-links into B's index, so B's next same-prefix admission hits —
+    and the continuation is bitwise the uninterrupted engine's."""
+    from apex_tpu.runtime.resilience import stream_kv_handoff
+    ref = ServeEngine(model, num_blocks=64, block_size=8,
+                      prefix_cache=False)
+    full = ref.run([Request("m", SHARED + [20], 8)])["m"]
+    ref.close()
+
+    a = ServeEngine(model, num_blocks=64, block_size=8, prefill_chunk=8)
+    a.submit(Request("m", SHARED + [20], 8))
+    for _ in range(6):
+        a.step()
+    (s,) = a.scheduler.sessions
+    assert s.committed_blocks >= 2 and 0 < len(s.out) < 8
+    d = os.path.join(str(tmp_path), "mig")
+    stream_kv_handoff(d, a.pool, s.table, source="test:mig")
+    chain, epoch, out, pend, pos = (list(s.hash_chain), s.weight_epoch,
+                                    list(s.out), s.pending_tok,
+                                    s.position)
+    a.close()
+
+    b = ServeEngine(model, num_blocks=64, block_size=8, prefill_chunk=8)
+    sess = b.ingest_handoff(Request("m", SHARED + [20], 8), out=out,
+                            pending_tok=pend, position=pos,
+                            handoff_dir=d, n_blocks=len(s.table) or None,
+                            hash_chain=chain, weight_epoch=epoch)
+    assert sess is not None and sess.cacheable
+    assert sess.committed_blocks == len(chain)
+    while b.scheduler.has_work():
+        b.step()
+    assert b.results["m"] == full         # bitwise continuation
+    # the re-linked chain is live in B's index: a same-prefix request
+    # admits with its prefix already cached
+    b.run([Request("m2", SHARED + [20], 4)])
+    assert b.metrics()["prefix_cache"]["prefill_tokens_saved"] > 0
+    b.close()
+
+
+def test_migration_epoch_mismatch_never_cached(model, tmp_path):
+    """An adopted session whose chain was built under a DIFFERENT
+    target epoch keeps serving (mixed-weight semantics) but its blocks
+    must never enter the survivor's hash index."""
+    from apex_tpu.runtime.resilience import stream_kv_handoff
+    a = ServeEngine(model, num_blocks=64, block_size=8, prefill_chunk=8)
+    a.submit(Request("m", SHARED + [20], 8))
+    for _ in range(6):
+        a.step()
+    (s,) = a.scheduler.sessions
+    d = os.path.join(str(tmp_path), "mig2")
+    stream_kv_handoff(d, a.pool, s.table, source="test:mig2")
+    chain, out, pend, pos, nb = (list(s.hash_chain), list(s.out),
+                                 s.pending_tok, s.position, len(s.table))
+    a.close()
+    b = ServeEngine(model, num_blocks=64, block_size=8, prefill_chunk=8)
+    sess = b.ingest_handoff(Request("m", SHARED + [20], 8), out=out,
+                            pending_tok=pend, position=pos,
+                            handoff_dir=d, n_blocks=nb,
+                            hash_chain=chain, weight_epoch=7)   # stale
+    assert sess is not None and not sess.cacheable
+    while b.scheduler.has_work():
+        b.step()
+    assert b.block_pool.cached_count == 0     # nothing was published
+    b.block_pool.check_no_leaks()
+    b.close()
